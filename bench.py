@@ -1,0 +1,72 @@
+"""Headline benchmark: OneMax GA, pop=100k, 100-bit genomes, eaSimple
+config (cxTwoPoint cxpb=.5, mutFlipBit(0.05) mutpb=.2, selTournament(3))
+— the BASELINE.json north-star configuration.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "gens/sec", "vs_baseline": N}
+
+``vs_baseline`` is measured against the reference CPU implementation run
+on this machine: examples/ga/onemax.py scaled to pop=100k = 0.1681
+generations/sec (5.947 s/gen, see BASELINE.md). Target is >=100x.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deap_tpu import ops
+from deap_tpu.algorithms import evaluate_invalid, var_and
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import gather, init_population
+from deap_tpu.core.toolbox import Toolbox
+
+REFERENCE_GENS_PER_SEC = 0.1681  # CPU DEAP, measured 2026-07-29 (BASELINE.md)
+
+POP = 100_000
+LENGTH = 100
+NGEN = 100
+
+
+def main():
+    tb = Toolbox()
+    tb.register("evaluate", lambda g: g.sum(-1).astype(jnp.float32))
+    tb.register("mate", ops.cx_two_point)
+    tb.register("mutate", ops.mut_flip_bit, indpb=0.05)
+    tb.register("select", ops.sel_tournament, tournsize=3)
+
+    pop = init_population(
+        jax.random.key(1), POP, ops.bernoulli_genome(LENGTH),
+        FitnessSpec((1.0,)))
+    pop = evaluate_invalid(pop, tb.evaluate)
+
+    def gen_step(pop, key):
+        k_sel, k_var = jax.random.split(key)
+        idx = tb.select(k_sel, pop.wvalues, pop.size)
+        off = var_and(k_var, gather(pop, idx), tb, 0.5, 0.2)
+        return evaluate_invalid(off, tb.evaluate), None
+
+    @jax.jit
+    def run(key, pop):
+        pop, _ = lax.scan(gen_step, pop, jax.random.split(key, NGEN))
+        return pop
+
+    # compile + warmup
+    jax.block_until_ready(run(jax.random.key(2), pop))
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(run(jax.random.key(3), pop))
+    dt = time.perf_counter() - t0
+
+    gens_per_sec = NGEN / dt
+    print(json.dumps({
+        "metric": "onemax_pop100k_generations_per_sec",
+        "value": round(gens_per_sec, 2),
+        "unit": "gens/sec",
+        "vs_baseline": round(gens_per_sec / REFERENCE_GENS_PER_SEC, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

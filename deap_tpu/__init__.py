@@ -1,0 +1,24 @@
+"""deap_tpu — a TPU-native evolutionary-computation framework.
+
+A from-scratch JAX/XLA framework with the capabilities of DEAP
+(reference: /root/reference): genetic algorithms over tensor populations,
+genetic programming via a batched prefix-tree interpreter, evolution
+strategies (CMA-ES and friends), multi-objective selection (NSGA-II/III,
+SPEA2), island-model and multi-host distribution over device meshes, and
+DEAP-style support tooling (toolbox registry, statistics/logbook,
+hall-of-fame/Pareto archives, checkpointing, benchmark suite).
+
+Design stance (see SURVEY.md §7): populations are struct-of-arrays pytrees,
+operators are pure functions `(key, ...) -> ...`, algorithms are `lax.scan`
+loops compiled as a single XLA program per generation, and distribution is
+`shard_map`/`pjit` over a `jax.sharding.Mesh` — not per-individual Python
+dispatch.
+"""
+
+__version__ = "0.1.0"
+
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import Population
+from deap_tpu.core.toolbox import Toolbox
+
+__all__ = ["FitnessSpec", "Population", "Toolbox", "__version__"]

@@ -1,0 +1,330 @@
+"""Algorithms — the reference's generational loops as compiled scan steps.
+
+Counterpart of /root/reference/deap/algorithms.py (varAnd :33-82, eaSimple
+:85-189, varOr :192-245, eaMuPlusLambda :248-337, eaMuCommaLambda
+:340-437, eaGenerateUpdate :440-503). Where the reference runs serial
+Python per generation with ``toolbox.map`` as the only parallel seam
+(SURVEY.md §3.1), each loop here is one jit-compiled ``lax.scan`` whose
+step does selection → variation → masked re-evaluation → archive/stats
+entirely on device. The toolbox alias convention is preserved:
+
+- ``toolbox.evaluate``: ``genomes -> values [n] | [n, nobj]`` (batched)
+- ``toolbox.mate``:     ``(key, g1, g2) -> (c1, c2)`` per pair
+- ``toolbox.mutate``:   ``(key, g) -> g`` per genome
+- ``toolbox.select``:   ``(key, wvalues, k) -> int32[k]``
+
+The reference's "delete fitness on variation, re-evaluate only invalid"
+protocol (algorithms.py:75-80) is encoded as the population's ``valid``
+mask: every row is recomputed by the batched evaluate but only invalid
+rows are *written*, so stochastic evaluators keep the reference's
+semantics and ``nevals`` counts exactly the reference's evaluations.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deap_tpu.core.population import Population, concat, gather
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.support.hof import HallOfFame, hof_init, hof_update
+from deap_tpu.support.logbook import Logbook, logbook_from_records
+from deap_tpu.support.stats import Statistics
+
+
+def _tree_where(mask: jnp.ndarray, a: Any, b: Any) -> Any:
+    def w(x, y):
+        m = mask.reshape(mask.shape + (1,) * (x.ndim - mask.ndim))
+        return jnp.where(m, x, y)
+    return jax.tree_util.tree_map(w, a, b)
+
+
+def _as2d(values: jnp.ndarray) -> jnp.ndarray:
+    return values[:, None] if values.ndim == 1 else values
+
+
+def evaluate_invalid(pop: Population, evaluate: Callable) -> Population:
+    """Batch-evaluate and write back only the invalid rows
+    (the tensor form of ``toolbox.map(toolbox.evaluate, invalid)``,
+    algorithms.py:149-152)."""
+    values = _as2d(evaluate(pop.genomes))
+    return pop.with_fitness(values, mask=~pop.valid)
+
+
+def var_and(key: jax.Array, pop: Population, toolbox, cxpb: float,
+            mutpb: float) -> Population:
+    """Crossover AND mutation variation (algorithms.py:33-82).
+
+    Adjacent pairs (0,1), (2,3), ... mate with probability ``cxpb``; each
+    individual then mutates with probability ``mutpb``; every touched row
+    is invalidated. An odd last individual never mates, like the
+    reference's pairwise zip.
+    """
+    n = pop.size
+    npairs = n // 2
+    k_pair, k_cx, k_ind, k_mut = jax.random.split(key, 4)
+
+    genomes = pop.genomes
+    if npairs:
+        even = jax.tree_util.tree_map(lambda a: a[0 : 2 * npairs : 2], genomes)
+        odd = jax.tree_util.tree_map(lambda a: a[1 : 2 * npairs : 2], genomes)
+        cx_keys = jax.random.split(k_cx, npairs)
+        c1, c2 = jax.vmap(toolbox.mate)(cx_keys, even, odd)
+        do_cx = jax.random.bernoulli(k_pair, cxpb, (npairs,))
+        even = _tree_where(do_cx, c1, even)
+        odd = _tree_where(do_cx, c2, odd)
+
+        def interleave(e, o, orig):
+            out = orig
+            out = out.at[0 : 2 * npairs : 2].set(e)
+            out = out.at[1 : 2 * npairs : 2].set(o)
+            return out
+
+        genomes = jax.tree_util.tree_map(interleave, even, odd, genomes)
+        cx_touched = jnp.zeros(n, bool).at[: 2 * npairs].set(
+            jnp.repeat(do_cx, 2))
+    else:
+        cx_touched = jnp.zeros(n, bool)
+
+    mut_keys = jax.random.split(k_mut, n)
+    mutated = jax.vmap(toolbox.mutate)(mut_keys, genomes)
+    do_mut = jax.random.bernoulli(k_ind, mutpb, (n,))
+    genomes = _tree_where(do_mut, mutated, genomes)
+
+    touched = cx_touched | do_mut
+    return pop.replace(genomes=genomes).invalidate(touched)
+
+
+def var_or(key: jax.Array, pop: Population, toolbox, lambda_: int,
+           cxpb: float, mutpb: float) -> Population:
+    """Crossover OR mutation OR reproduction (algorithms.py:192-245).
+
+    Each of the ``lambda_`` children independently: with prob cxpb the
+    first child of a mating of two distinct random parents; elif with
+    prob mutpb a mutant of a random parent; else an unchanged copy that
+    *keeps* its parent's (valid) fitness, exactly like the reference.
+    """
+    n = pop.size
+    k_u, k_p1, k_p2, k_pm, k_cx, k_mut = jax.random.split(key, 6)
+    u = jax.random.uniform(k_u, (lambda_,))
+    choice_cx = u < cxpb
+    choice_mut = (u >= cxpb) & (u < cxpb + mutpb)
+
+    # distinct parent pair per child (random.sample(population, 2))
+    i = jax.random.randint(k_p1, (lambda_,), 0, n)
+    j = jax.random.randint(k_p2, (lambda_,), 0, n - 1)
+    j = jnp.where(j >= i, j + 1, j)
+    m = jax.random.randint(k_pm, (lambda_,), 0, n)
+
+    base_idx = jnp.where(choice_cx, i, m)
+    children = gather(pop, base_idx)
+
+    ga = lambda idx: jax.tree_util.tree_map(
+        lambda a: jnp.take(a, idx, axis=0), pop.genomes)
+    cx_keys = jax.random.split(k_cx, lambda_)
+    c1, _ = jax.vmap(toolbox.mate)(cx_keys, ga(i), ga(j))
+    mut_keys = jax.random.split(k_mut, lambda_)
+    mutants = jax.vmap(toolbox.mutate)(mut_keys, ga(m))
+
+    genomes = _tree_where(choice_cx, c1, children.genomes)
+    genomes = _tree_where(choice_mut, mutants, genomes)
+    return children.replace(genomes=genomes).invalidate(choice_cx | choice_mut)
+
+
+# ------------------------------------------------------------------ loops ----
+
+def _maybe_stats(stats: Optional[Statistics], pop: Population):
+    return stats.compile(pop) if stats is not None else {}
+
+
+def ea_simple(key: jax.Array, pop: Population, toolbox, cxpb: float,
+              mutpb: float, ngen: int, stats: Optional[Statistics] = None,
+              halloffame_size: int = 0, verbose: bool = False,
+              ) -> Tuple[Population, Logbook, Optional[HallOfFame]]:
+    """The canonical generational GA (algorithms.py:85-189).
+
+    select n → varAnd → evaluate invalid → replace, scanned over ``ngen``
+    generations as one compiled program.
+    """
+    kscan = key
+    pop = evaluate_invalid(pop, toolbox.evaluate)
+    hof = hof_init(halloffame_size, pop) if halloffame_size else None
+    if hof is not None:
+        hof = hof_update(hof, pop)
+    record0 = {"nevals": pop.size, **_maybe_stats(stats, pop)}
+
+    def step(carry, key):
+        pop, hof = carry
+        k_sel, k_var = jax.random.split(key)
+        idx = toolbox.select(k_sel, pop.wvalues, pop.size)
+        off = var_and(k_var, gather(pop, idx), toolbox, cxpb, mutpb)
+        nevals = jnp.sum(~off.valid)
+        off = evaluate_invalid(off, toolbox.evaluate)
+        if hof is not None:
+            new_hof = hof_update(hof, off)
+        else:
+            new_hof = None
+        rec = {"nevals": nevals, **_maybe_stats(stats, off)}
+        return (off, new_hof), rec
+
+    (pop, hof), records = lax.scan(step, (pop, hof), jax.random.split(kscan, ngen))
+    logbook = _build_logbook(record0, records, stats)
+    if verbose:
+        print(logbook.stream)
+    return pop, logbook, hof
+
+
+def _build_logbook(record0, records, stats) -> Logbook:
+    fields = ["gen", "nevals"]
+    if stats is not None:
+        fields += list(stats.fields)
+    logbook = Logbook()
+    logbook.header = fields
+    logbook.record(gen=0, **record0)
+    body = logbook_from_records(records)
+    merged = []
+    for gen in range(len(body)):
+        entry = dict(body[gen])
+        for name, chapter in body.chapters.items():
+            entry[name] = dict(chapter[gen])
+        merged.append(entry)
+    for gen, entry in enumerate(merged, start=1):
+        logbook.record(gen=gen, **entry)
+    return logbook
+
+
+def ea_mu_plus_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
+                      lambda_: int, cxpb: float, mutpb: float, ngen: int,
+                      stats: Optional[Statistics] = None,
+                      halloffame_size: int = 0, verbose: bool = False,
+                      ) -> Tuple[Population, Logbook, Optional[HallOfFame]]:
+    """(μ + λ) evolution (algorithms.py:248-337): parents survive into the
+    selection pool."""
+    assert cxpb + mutpb <= 1.0, (
+        "The sum of the crossover and mutation probabilities must be <= 1.0.")
+    kscan = key
+    pop = evaluate_invalid(pop, toolbox.evaluate)
+    hof = hof_init(halloffame_size, pop) if halloffame_size else None
+    if hof is not None:
+        hof = hof_update(hof, pop)
+    record0 = {"nevals": pop.size, **_maybe_stats(stats, pop)}
+
+    def step(carry, key):
+        pop, hof = carry
+        k_var, k_sel = jax.random.split(key)
+        off = var_or(k_var, pop, toolbox, lambda_, cxpb, mutpb)
+        nevals = jnp.sum(~off.valid)
+        off = evaluate_invalid(off, toolbox.evaluate)
+        pool = concat([pop, off])
+        idx = toolbox.select(k_sel, pool.wvalues, mu)
+        new_pop = gather(pool, idx)
+        new_hof = hof_update(hof, off) if hof is not None else None
+        rec = {"nevals": nevals, **_maybe_stats(stats, new_pop)}
+        return (new_pop, new_hof), rec
+
+    (pop, hof), records = lax.scan(step, (pop, hof), jax.random.split(kscan, ngen))
+    logbook = _build_logbook(record0, records, stats)
+    if verbose:
+        print(logbook.stream)
+    return pop, logbook, hof
+
+
+def ea_mu_comma_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
+                       lambda_: int, cxpb: float, mutpb: float, ngen: int,
+                       stats: Optional[Statistics] = None,
+                       halloffame_size: int = 0, verbose: bool = False,
+                       ) -> Tuple[Population, Logbook, Optional[HallOfFame]]:
+    """(μ, λ) evolution (algorithms.py:340-437): only offspring survive."""
+    assert lambda_ >= mu, "lambda must be greater or equal to mu."
+    assert cxpb + mutpb <= 1.0, (
+        "The sum of the crossover and mutation probabilities must be <= 1.0.")
+    kscan = key
+    pop = evaluate_invalid(pop, toolbox.evaluate)
+    hof = hof_init(halloffame_size, pop) if halloffame_size else None
+    if hof is not None:
+        hof = hof_update(hof, pop)
+    record0 = {"nevals": pop.size, **_maybe_stats(stats, pop)}
+
+    def step(carry, key):
+        pop, hof = carry
+        k_var, k_sel = jax.random.split(key)
+        off = var_or(k_var, pop, toolbox, lambda_, cxpb, mutpb)
+        nevals = jnp.sum(~off.valid)
+        off = evaluate_invalid(off, toolbox.evaluate)
+        idx = toolbox.select(k_sel, off.wvalues, mu)
+        new_pop = gather(off, idx)
+        new_hof = hof_update(hof, off) if hof is not None else None
+        rec = {"nevals": nevals, **_maybe_stats(stats, new_pop)}
+        return (new_pop, new_hof), rec
+
+    (pop, hof), records = lax.scan(step, (pop, hof), jax.random.split(kscan, ngen))
+    logbook = _build_logbook(record0, records, stats)
+    if verbose:
+        print(logbook.stream)
+    return pop, logbook, hof
+
+
+def ea_generate_update(key: jax.Array, state: Any, toolbox, ngen: int,
+                       spec: FitnessSpec,
+                       stats: Optional[Statistics] = None,
+                       halloffame_size: int = 0, verbose: bool = False,
+                       ) -> Tuple[Any, Logbook, Optional[HallOfFame]]:
+    """Ask-tell loop (algorithms.py:440-503) driving CMA-ES/PBIL/EMNA-style
+    strategies:
+
+    - ``toolbox.generate``: ``(key, state) -> genomes``
+    - ``toolbox.update``:   ``(state, genomes, values) -> state``
+
+    The whole generate → evaluate → update cycle is one scanned step; the
+    strategy state is a pytree in the carry.
+    """
+    # Shape template for the hall of fame, without running compute.
+    g_shape = jax.eval_shape(toolbox.generate, jax.random.key(0), state)
+    lam = jax.tree_util.tree_leaves(g_shape)[0].shape[0]
+    v_shape = jax.eval_shape(toolbox.evaluate, g_shape)
+    nobj = 1 if len(v_shape.shape) == 1 else v_shape.shape[-1]
+    template = Population(
+        genomes=jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), g_shape),
+        fitness=jnp.zeros((lam, nobj), jnp.float32),
+        valid=jnp.zeros(lam, bool),
+        spec=spec,
+    )
+    hof = hof_init(halloffame_size, template) if halloffame_size else None
+
+    def step(carry, key):
+        state, hof = carry
+        genomes = toolbox.generate(key, state)
+        values = _as2d(toolbox.evaluate(genomes))
+        pop = Population(
+            genomes=genomes, fitness=values,
+            valid=jnp.ones(lam, bool), spec=spec)
+        new_state = toolbox.update(state, genomes, values)
+        new_hof = hof_update(hof, pop) if hof is not None else None
+        rec = {"nevals": jnp.asarray(lam), **_maybe_stats(stats, pop)}
+        return (new_state, new_hof), rec
+
+    (state, hof), records = lax.scan(step, (state, hof), jax.random.split(key, ngen))
+    body = logbook_from_records(records)
+    logbook = Logbook()
+    logbook.header = ["gen", "nevals"] + (list(stats.fields) if stats else [])
+    for gen in range(len(body)):
+        entry = dict(body[gen])
+        for name, chapter in body.chapters.items():
+            entry[name] = dict(chapter[gen])
+        logbook.record(gen=gen, **entry)
+    if verbose:
+        print(logbook.stream)
+    return state, logbook, hof
+
+
+# DEAP-style aliases
+varAnd = var_and
+varOr = var_or
+eaSimple = ea_simple
+eaMuPlusLambda = ea_mu_plus_lambda
+eaMuCommaLambda = ea_mu_comma_lambda
+eaGenerateUpdate = ea_generate_update

@@ -1,0 +1,23 @@
+from deap_tpu.core.fitness import (
+    FitnessSpec,
+    dominates,
+    lex_gt,
+    lex_ge,
+    lex_sort_desc,
+    wvalues,
+)
+from deap_tpu.core.population import Population, gather, concat
+from deap_tpu.core.toolbox import Toolbox
+
+__all__ = [
+    "FitnessSpec",
+    "Population",
+    "Toolbox",
+    "dominates",
+    "lex_gt",
+    "lex_ge",
+    "lex_sort_desc",
+    "wvalues",
+    "gather",
+    "concat",
+]

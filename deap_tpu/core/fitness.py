@@ -1,0 +1,102 @@
+"""Fitness semantics as pure array functions.
+
+TPU-native counterpart of the reference's ``base.Fitness``
+(/root/reference/deap/base.py:125-270). The reference stores
+``wvalues = values * weights`` at assignment time and implements all
+comparisons (lexicographic rich-compare at base.py:234-250, Pareto
+``dominates`` at base.py:209-224, ``valid`` at base.py:226-229) on the
+weighted values, so minimisation/maximisation is uniform "bigger is
+better". Here fitness is a ``f32[n, nobj]`` tensor of *raw* objective
+values plus a static weights tuple; all comparison helpers take weighted
+values and are batched array ops usable inside ``jit``/``vmap``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class FitnessSpec:
+    """Static description of a fitness: objective weights.
+
+    Negative weight = minimise, positive = maximise, exactly like the
+    reference's class-level ``weights`` tuple (base.py:148-161). The
+    spec is hashable so it can be a static argument to jit'd functions.
+    """
+
+    weights: Tuple[float, ...]
+
+    def __init__(self, weights: Sequence[float]):
+        object.__setattr__(self, "weights", tuple(float(w) for w in weights))
+
+    @property
+    def nobj(self) -> int:
+        return len(self.weights)
+
+    @property
+    def warray(self) -> jnp.ndarray:
+        return jnp.asarray(self.weights, dtype=jnp.float32)
+
+    def wvalues(self, values: jnp.ndarray) -> jnp.ndarray:
+        """Weighted values: ``values * weights`` (base.py:187-198)."""
+        return jnp.asarray(values, dtype=jnp.float32) * self.warray
+
+
+# Module-level helpers operate on *weighted* values (maximisation convention).
+
+def wvalues(values: jnp.ndarray, weights: jnp.ndarray) -> jnp.ndarray:
+    return values * weights
+
+
+def dominates(wa: jnp.ndarray, wb: jnp.ndarray) -> jnp.ndarray:
+    """Pareto dominance of weighted values ``wa`` over ``wb``.
+
+    ``a`` dominates ``b`` iff a is no worse in every objective and
+    strictly better in at least one (base.py:209-224). Broadcasts over
+    leading axes: ``dominates(w[:, None], w[None, :])`` yields the full
+    pairwise [n, n] dominance matrix in one fused op — the TPU-friendly
+    formulation of the reference's per-pair Python loop.
+    """
+    return jnp.all(wa >= wb, axis=-1) & jnp.any(wa > wb, axis=-1)
+
+
+def lex_gt(wa: jnp.ndarray, wb: jnp.ndarray) -> jnp.ndarray:
+    """Lexicographic tuple compare ``wa > wb`` (base.py:234-250).
+
+    The reference compares wvalues tuples with Python's ``>``; this is
+    the broadcasting array equivalent: the first differing objective
+    decides.
+    """
+    neq = wa != wb
+    first = jnp.argmax(neq, axis=-1)
+    a = jnp.take_along_axis(wa, first[..., None], axis=-1)[..., 0]
+    b = jnp.take_along_axis(wb, first[..., None], axis=-1)[..., 0]
+    return jnp.any(neq, axis=-1) & (a > b)
+
+
+def lex_ge(wa: jnp.ndarray, wb: jnp.ndarray) -> jnp.ndarray:
+    return ~lex_gt(wb, wa)
+
+
+def lex_sort_desc(w: jnp.ndarray) -> jnp.ndarray:
+    """Indices sorting rows of ``w`` lexicographically descending.
+
+    Matches Python's ``sorted(..., key=attrgetter("fitness"), reverse=True)``
+    over Fitness objects (e.g. HallOfFame insertion order,
+    support.py:517-543): objective 0 is the primary key. Stable.
+    """
+    # jnp.lexsort treats the *last* key as primary and sorts ascending,
+    # so feed negated columns in reverse objective order.
+    keys = tuple(-w[..., j] for j in range(w.shape[-1] - 1, -1, -1))
+    return jnp.lexsort(keys)
+
+
+def lex_best_index(w: jnp.ndarray, valid: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Index of the lexicographically-largest row (single best individual)."""
+    if valid is not None:
+        w = jnp.where(valid[..., None], w, -jnp.inf)
+    return lex_sort_desc(w)[..., 0]

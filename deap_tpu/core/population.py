@@ -1,0 +1,139 @@
+"""Population as a struct-of-arrays pytree.
+
+The reference represents a population as a Python list of individual
+objects created by ``creator.create`` (/root/reference/deap/creator.py:96-171)
+each carrying a ``fitness`` attribute; variation operators mutate
+individuals in place and *delete* their fitness to mark them for
+re-evaluation (/root/reference/deap/algorithms.py:75-80). Here the whole
+population is one pytree of device tensors:
+
+- ``genomes``: any pytree of arrays with a shared leading population axis
+  (a single ``[n, L]`` array for bitstring/real/permutation genomes, a
+  full parameter pytree for neuroevolution, node/const arrays for GP).
+- ``fitness``: ``f32[n, nobj]`` raw objective values.
+- ``valid``: ``bool[n]`` — the functional encoding of "fitness was
+  deleted"; algorithms re-evaluate exactly the invalid rows, preserving
+  the reference's who-gets-re-evaluated semantics (SURVEY.md §7.3).
+- ``extras``: per-individual auxiliary arrays (ES ``strategy`` vectors —
+  cf. mutation.py:180; PSO ``speed``/``best``; lineage ids).
+- ``spec``: static :class:`FitnessSpec` (the weights tuple).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from deap_tpu.core.fitness import FitnessSpec, lex_best_index, lex_sort_desc
+
+
+@struct.dataclass
+class Population:
+    genomes: Any
+    fitness: jnp.ndarray
+    valid: jnp.ndarray
+    extras: Dict[str, Any] = struct.field(default_factory=dict)
+    spec: FitnessSpec = struct.field(pytree_node=False, default=FitnessSpec((1.0,)))
+
+    @property
+    def size(self) -> int:
+        return self.fitness.shape[0]
+
+    @property
+    def nobj(self) -> int:
+        return self.fitness.shape[-1]
+
+    @property
+    def wvalues(self) -> jnp.ndarray:
+        """Weighted values, the comparison currency (base.py:187-198).
+
+        Invalid rows are forced to -inf in every objective so they sort
+        last and never dominate.
+        """
+        w = self.fitness * self.spec.warray
+        return jnp.where(self.valid[:, None], w, -jnp.inf)
+
+    def with_fitness(self, values: jnp.ndarray, mask: jnp.ndarray | None = None) -> "Population":
+        """Assign raw objective values; ``mask`` limits which rows update.
+
+        Rows updated become valid (the analog of ``ind.fitness.values =
+        fit``, base.py:187-198).
+        """
+        values = jnp.asarray(values, dtype=self.fitness.dtype)
+        if values.ndim == 1:
+            values = values[:, None]
+        if mask is None:
+            return self.replace(fitness=values, valid=jnp.ones_like(self.valid))
+        fit = jnp.where(mask[:, None], values, self.fitness)
+        return self.replace(fitness=fit, valid=self.valid | mask)
+
+    def invalidate(self, mask: jnp.ndarray) -> "Population":
+        """Mark rows for re-evaluation (the analog of ``del ind.fitness.values``)."""
+        return self.replace(valid=self.valid & ~mask)
+
+    def best_index(self) -> jnp.ndarray:
+        return lex_best_index(self.fitness * self.spec.warray, self.valid)
+
+    def sorted_desc(self) -> "Population":
+        """Population sorted best-first by lexicographic weighted fitness."""
+        return gather(self, lex_sort_desc(self.wvalues))
+
+
+def init_population(
+    key: jax.Array,
+    n: int,
+    init_genome: Callable[[jax.Array], Any],
+    spec: FitnessSpec,
+    extras_init: Dict[str, Callable[[jax.Array], Any]] | None = None,
+) -> Population:
+    """Build an n-individual population by vmapping a per-genome initialiser.
+
+    Counterpart of ``tools.initRepeat(list, toolbox.individual, n)``
+    (/root/reference/deap/tools/init.py:3-25) — but the initialiser runs
+    batched on device with an explicit split key per individual.
+    """
+    keys = jax.random.split(key, n + 1)
+    genomes = jax.vmap(init_genome)(keys[:n])
+    extras = {}
+    if extras_init:
+        for name, fn in extras_init.items():
+            ek = jax.random.split(keys[n], n)
+            extras[name] = jax.vmap(fn)(ek)
+    return Population(
+        genomes=genomes,
+        fitness=jnp.zeros((n, spec.nobj), dtype=jnp.float32),
+        valid=jnp.zeros((n,), dtype=bool),
+        extras=extras,
+        spec=spec,
+    )
+
+
+def gather(pop: Population, idx: jnp.ndarray) -> Population:
+    """Select individuals by index — the functional ``toolbox.clone``.
+
+    The reference's selection returns references and ``varAnd`` deepcopies
+    them (algorithms.py:68); a gather is both at once, with no aliasing
+    possible.
+    """
+    take = lambda a: jnp.take(a, idx, axis=0)
+    return pop.replace(
+        genomes=jax.tree_util.tree_map(take, pop.genomes),
+        fitness=take(pop.fitness),
+        valid=take(pop.valid),
+        extras=jax.tree_util.tree_map(take, pop.extras),
+    )
+
+
+def concat(pops: Sequence[Population]) -> Population:
+    """Concatenate populations along the individual axis (e.g. mu+lambda)."""
+    cat = lambda *xs: jnp.concatenate(xs, axis=0)
+    first = pops[0]
+    return first.replace(
+        genomes=jax.tree_util.tree_map(cat, *[p.genomes for p in pops]),
+        fitness=cat(*[p.fitness for p in pops]),
+        valid=cat(*[p.valid for p in pops]),
+        extras=jax.tree_util.tree_map(cat, *[p.extras for p in pops]),
+    )

@@ -1,0 +1,62 @@
+"""Toolbox — the alias registry that is the framework's plugin boundary.
+
+Re-creation (not a copy) of the reference's ``base.Toolbox``
+(/root/reference/deap/base.py:33-122): ``register(alias, fn, *args, **kw)``
+stores a partial application under ``toolbox.<alias>`` with the wrapped
+function's ``__name__``/``__doc__``; ``unregister`` removes it;
+``decorate`` re-wraps the underlying function with decorators while
+keeping the bound arguments. The conventional aliases (``evaluate``,
+``mate``, ``mutate``, ``select``, ``map``, ``clone``) are the entire
+configuration surface of the reference, and replacing ``map`` is its
+entire distribution story (SURVEY.md §1) — here the same seam dispatches
+between the tensor (JAX) backend and the CPU/list compat backend.
+
+In the tensor backend, registered functions are *pure*: they take a PRNG
+key and arrays, return arrays, and are safe to close over inside ``jit``.
+A Toolbox is therefore configuration, resolved at trace time — it never
+appears inside a compiled program.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+
+class Toolbox:
+    def __init__(self):
+        # Defaults mirror the reference (base.py:48-50): clone and map.
+        # In the tensor backend clone is a no-op (values are immutable);
+        # the compat backend re-registers deepcopy.
+        self.register("map", map)
+        self.register("clone", lambda x: x)
+
+    def register(self, alias: str, function: Callable, *args: Any, **kwargs: Any) -> None:
+        """Bind ``function`` with default args under ``self.<alias>``.
+
+        Later positional/keyword arguments at call time are appended /
+        override, exactly like ``functools.partial`` (base.py:81-91).
+        """
+        pfunc = functools.partial(function, *args, **kwargs)
+        pfunc.__name__ = getattr(function, "__name__", alias)
+        pfunc.__doc__ = getattr(function, "__doc__", None)
+        if hasattr(function, "__dict__") and not isinstance(function, type):
+            pfunc.__dict__.update(function.__dict__.copy())
+        setattr(self, alias, pfunc)
+
+    def unregister(self, alias: str) -> None:
+        """Remove an alias (base.py:93-98) — e.g. to strip unpicklable
+        closures before shipping the toolbox to workers."""
+        delattr(self, alias)
+
+    def decorate(self, alias: str, *decorators: Callable) -> None:
+        """Re-register ``alias`` with its function wrapped by ``decorators``
+        (applied in order), preserving bound default arguments
+        (base.py:100-122). Used for staticLimit, penalty wrappers,
+        History tracking, benchmark transforms.
+        """
+        pfunc = getattr(self, alias)
+        function, args, kwargs = pfunc.func, pfunc.args, pfunc.keywords
+        for decorator in decorators:
+            function = decorator(function)
+        self.register(alias, function, *args, **kwargs)

@@ -1,0 +1,94 @@
+"""Operator library — the counterpart of the reference's ``deap.tools``
+operator modules, as pure batched array functions.
+
+DEAP-style camelCase aliases are exported alongside the snake_case
+canonical names so reference users find the operators they know
+(cxTwoPoint, mutFlipBit, selTournament, ...). Multi-objective selection
+(NSGA-II/III, SPEA2) lives in :mod:`deap_tpu.mo`; migration in
+:mod:`deap_tpu.parallel`.
+"""
+
+from deap_tpu.ops.init import (
+    bernoulli_genome,
+    constant_genome,
+    init_cycle,
+    init_iterate,
+    init_repeat,
+    normal_genome,
+    permutation_genome,
+    randint_genome,
+    uniform_genome,
+)
+from deap_tpu.ops.crossover import (
+    cx_blend,
+    cx_es_blend,
+    cx_es_two_point,
+    cx_messy_one_point,
+    cx_one_point,
+    cx_ordered,
+    cx_partialy_matched,
+    cx_simulated_binary,
+    cx_simulated_binary_bounded,
+    cx_two_point,
+    cx_uniform,
+    cx_uniform_partialy_matched,
+    pair_vmap,
+)
+from deap_tpu.ops.mutation import (
+    genome_vmap,
+    mut_es_log_normal,
+    mut_flip_bit,
+    mut_gaussian,
+    mut_polynomial_bounded,
+    mut_shuffle_indexes,
+    mut_uniform_int,
+    strategy_floor,
+)
+from deap_tpu.ops.selection import (
+    sel_automatic_epsilon_lexicase,
+    sel_best,
+    sel_double_tournament,
+    sel_epsilon_lexicase,
+    sel_lexicase,
+    sel_random,
+    sel_roulette,
+    sel_stochastic_universal_sampling,
+    sel_tournament,
+    sel_worst,
+)
+
+# DEAP-style aliases (reference names → tensor ops)
+cxOnePoint = cx_one_point
+cxTwoPoint = cx_two_point
+cxUniform = cx_uniform
+cxPartialyMatched = cx_partialy_matched
+cxUniformPartialyMatched = cx_uniform_partialy_matched
+cxOrdered = cx_ordered
+cxBlend = cx_blend
+cxSimulatedBinary = cx_simulated_binary
+cxSimulatedBinaryBounded = cx_simulated_binary_bounded
+cxMessyOnePoint = cx_messy_one_point
+cxESBlend = cx_es_blend
+cxESTwoPoint = cx_es_two_point
+
+mutGaussian = mut_gaussian
+mutPolynomialBounded = mut_polynomial_bounded
+mutShuffleIndexes = mut_shuffle_indexes
+mutFlipBit = mut_flip_bit
+mutUniformInt = mut_uniform_int
+mutESLogNormal = mut_es_log_normal
+
+selRandom = sel_random
+selBest = sel_best
+selWorst = sel_worst
+selTournament = sel_tournament
+selRoulette = sel_roulette
+selDoubleTournament = sel_double_tournament
+selStochasticUniversalSampling = sel_stochastic_universal_sampling
+selLexicase = sel_lexicase
+selEpsilonLexicase = sel_epsilon_lexicase
+selAutomaticEpsilonLexicase = sel_automatic_epsilon_lexicase
+
+initRepeat = init_repeat
+initIterate = init_iterate
+initCycle = init_cycle

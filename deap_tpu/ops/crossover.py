@@ -1,0 +1,280 @@
+"""Crossover operators as pure per-pair functions.
+
+Counterpart of /root/reference/deap/tools/crossover.py. Every operator is
+``(key, g1, g2, **params) -> (c1, c2)`` on single genomes ``[L]``; batch
+them over a population with :func:`pair_vmap` (or ``jax.vmap`` directly).
+Where the reference draws ``random.random() < p`` per gene inside Python
+loops, these draw whole Bernoulli/uniform masks in one op; where it
+mutates lists in place, these build children with ``where`` masks and
+functional scatters. Distributional behaviour matches the reference;
+RNG streams obviously do not (explicit `jax.random` keys replace the
+global `random` module).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pair_vmap(cx):
+    """Lift a per-pair crossover to ``(key, G1, G2, ...)`` over ``[n, L]``."""
+    def batched(key, g1, g2, *args, **kwargs):
+        keys = jax.random.split(key, g1.shape[0])
+        return jax.vmap(lambda k, a, b: cx(k, a, b, *args, **kwargs))(keys, g1, g2)
+    return batched
+
+
+# ---------------------------------------------------------------- generic ----
+
+def cx_one_point(key, g1, g2):
+    """One-point crossover (crossover.py:18-34): swap tails after a point
+    drawn in [1, L-1]."""
+    size = g1.shape[0]
+    point = jax.random.randint(key, (), 1, size)
+    mask = jnp.arange(size) >= point
+    return jnp.where(mask, g2, g1), jnp.where(mask, g1, g2)
+
+
+def _two_points(key, size):
+    """The reference's two-point draw (crossover.py:44-50): p1 ~ U{1..L-1},
+    p2 ~ U{1..L-2} bumped past p1 — a uniform distinct ordered pair."""
+    k1, k2 = jax.random.split(key)
+    p1 = jax.random.randint(k1, (), 1, size)
+    p2 = jax.random.randint(k2, (), 1, size - 1)
+    p2 = jnp.where(p2 >= p1, p2 + 1, p2)
+    return jnp.minimum(p1, p2), jnp.maximum(p1, p2)
+
+
+def cx_two_point(key, g1, g2):
+    """Two-point crossover (crossover.py:37-60): swap the middle segment."""
+    lo, hi = _two_points(key, g1.shape[0])
+    idx = jnp.arange(g1.shape[0])
+    mask = (idx >= lo) & (idx < hi)
+    return jnp.where(mask, g2, g1), jnp.where(mask, g1, g2)
+
+
+def cx_uniform(key, g1, g2, indpb):
+    """Uniform crossover (crossover.py:73-91): per-gene swap with prob indpb."""
+    mask = jax.random.bernoulli(key, indpb, g1.shape)
+    return jnp.where(mask, g2, g1), jnp.where(mask, g1, g2)
+
+
+# ----------------------------------------------------------- permutations ----
+
+def _positions(perm):
+    """pos[value] = index of value in perm."""
+    size = perm.shape[0]
+    return jnp.zeros(size, jnp.int32).at[perm].set(jnp.arange(size, dtype=jnp.int32))
+
+
+def cx_partialy_matched(key, g1, g2):
+    """PMX (Goldberg & Lingle 1985; crossover.py:94-141).
+
+    Sequentially swaps matched value pairs inside a random segment while
+    maintaining value→position lookups — the data dependence is inherent,
+    so it runs as a ``fori_loop`` over gene slots (masked outside the
+    segment) and is vmapped across the population.
+    """
+    size = g1.shape[0]
+    k1, k2 = jax.random.split(key)
+    # reference draw: c1 ~ U{0..L}, c2 ~ U{0..L-1} bumped past c1
+    c1 = jax.random.randint(k1, (), 0, size + 1)
+    c2 = jax.random.randint(k2, (), 0, size)
+    c2 = jnp.where(c2 >= c1, c2 + 1, c2)
+    lo, hi = jnp.minimum(c1, c2), jnp.maximum(c1, c2)
+
+    a = g1.astype(jnp.int32)
+    b = g2.astype(jnp.int32)
+    p1, p2 = _positions(a), _positions(b)
+
+    def body(i, carry):
+        a, b, p1, p2 = carry
+        t1, t2 = a[i], b[i]
+        j1, j2 = p1[t2], p2[t1]
+        a2 = a.at[i].set(t2).at[j1].set(t1)
+        b2 = b.at[i].set(t1).at[j2].set(t2)
+        p1_2 = p1.at[t1].set(j1).at[t2].set(i)
+        p2_2 = p2.at[t2].set(j2).at[t1].set(i)
+        in_seg = (i >= lo) & (i < hi)
+        pick = lambda new, old: jnp.where(in_seg, new, old)
+        return pick(a2, a), pick(b2, b), pick(p1_2, p1), pick(p2_2, p2)
+
+    a, b, _, _ = lax.fori_loop(0, size, body, (a, b, p1, p2))
+    return a.astype(g1.dtype), b.astype(g2.dtype)
+
+
+def cx_uniform_partialy_matched(key, g1, g2, indpb):
+    """UPMX (Cicirello & Smith 2000; crossover.py:144-186): PMX swap at
+    each slot independently with prob indpb."""
+    size = g1.shape[0]
+    kmask, _ = jax.random.split(key)
+    do = jax.random.bernoulli(kmask, indpb, (size,))
+    a = g1.astype(jnp.int32)
+    b = g2.astype(jnp.int32)
+    p1, p2 = _positions(a), _positions(b)
+
+    def body(i, carry):
+        a, b, p1, p2 = carry
+        t1, t2 = a[i], b[i]
+        j1, j2 = p1[t2], p2[t1]
+        a2 = a.at[i].set(t2).at[j1].set(t1)
+        b2 = b.at[i].set(t1).at[j2].set(t2)
+        p1_2 = p1.at[t1].set(j1).at[t2].set(i)
+        p2_2 = p2.at[t2].set(j2).at[t1].set(i)
+        pick = lambda new, old: jnp.where(do[i], new, old)
+        return pick(a2, a), pick(b2, b), pick(p1_2, p1), pick(p2_2, p2)
+
+    a, b, _, _ = lax.fori_loop(0, size, body, (a, b, p1, p2))
+    return a.astype(g1.dtype), b.astype(g2.dtype)
+
+
+def cx_ordered(key, g1, g2):
+    """Ordered crossover OX (Goldberg 1989; crossover.py:188-239).
+
+    Child 1 keeps parent 2's segment [a, b] and fills the remaining slots
+    (starting after b, wrapping) with parent 1's values not present in
+    that segment, in parent-1 rotation order — and symmetrically.
+    """
+    size = g1.shape[0]
+    k1, k2 = jax.random.split(key)
+    # random.sample(range(L), 2) → uniform distinct unordered pair, ordered
+    i1 = jax.random.randint(k1, (), 0, size)
+    i2 = jax.random.randint(k2, (), 0, size - 1)
+    i2 = jnp.where(i2 >= i1, i2 + 1, i2)
+    lo, hi = jnp.minimum(i1, i2), jnp.maximum(i1, i2)  # segment inclusive
+
+    a = g1.astype(jnp.int32)
+    b = g2.astype(jnp.int32)
+    posa, posb = _positions(a), _positions(b)
+    # value v is a "hole" for child1 iff v sits inside b's segment
+    hole1 = (posb >= lo) & (posb <= hi)
+    hole2 = (posa >= lo) & (posa <= hi)
+
+    def body(i, carry):
+        c1, k1p, c2, k2p = carry
+        j = (i + hi + 1) % size
+        v1, v2 = a[j], b[j]
+        take1, take2 = ~hole1[v1], ~hole2[v2]
+        c1 = jnp.where(take1, c1.at[k1p % size].set(v1), c1)
+        c2 = jnp.where(take2, c2.at[k2p % size].set(v2), c2)
+        return c1, k1p + take1, c2, k2p + take2
+
+    c1, _, c2, _ = lax.fori_loop(0, size, body, (a, hi + 1, b, hi + 1))
+    idx = jnp.arange(size)
+    in_seg = (idx >= lo) & (idx <= hi)
+    c1 = jnp.where(in_seg, b, c1)
+    c2 = jnp.where(in_seg, a, c2)
+    return c1.astype(g1.dtype), c2.astype(g2.dtype)
+
+
+# ------------------------------------------------------------- real-valued ----
+
+def cx_blend(key, g1, g2, alpha):
+    """BLX-alpha blend (crossover.py:241-260): per-gene gamma in
+    [-alpha, 1+alpha]."""
+    gamma = (1.0 + 2.0 * alpha) * jax.random.uniform(key, g1.shape) - alpha
+    c1 = (1.0 - gamma) * g1 + gamma * g2
+    c2 = gamma * g1 + (1.0 - gamma) * g2
+    return c1, c2
+
+
+def _sbx_beta(rand, eta):
+    beta = jnp.where(rand <= 0.5, 2.0 * rand, 1.0 / (2.0 * (1.0 - rand)))
+    return beta ** (1.0 / (eta + 1.0))
+
+
+def cx_simulated_binary(key, g1, g2, eta):
+    """SBX (crossover.py:263-289): spread factor beta per gene."""
+    beta = _sbx_beta(jax.random.uniform(key, g1.shape), eta)
+    c1 = 0.5 * ((1 + beta) * g1 + (1 - beta) * g2)
+    c2 = 0.5 * ((1 - beta) * g1 + (1 + beta) * g2)
+    return c1, c2
+
+
+def cx_simulated_binary_bounded(key, g1, g2, eta, low, up):
+    """Bounded SBX per Deb's NSGA-II C code (crossover.py:291-364).
+
+    Per gene: applied with prob 0.5 and only when the parents differ;
+    children are clipped to [low, up] and swapped with prob 0.5.
+    """
+    low = jnp.broadcast_to(jnp.asarray(low, g1.dtype), g1.shape)
+    up = jnp.broadcast_to(jnp.asarray(up, g1.dtype), g1.shape)
+    kg, kr, ks = jax.random.split(key, 3)
+    gate = jax.random.bernoulli(kg, 0.5, g1.shape) & (jnp.abs(g1 - g2) > 1e-14)
+    rand = jax.random.uniform(kr, g1.shape)
+    swap = jax.random.bernoulli(ks, 0.5, g1.shape)
+
+    x1 = jnp.minimum(g1, g2)
+    x2 = jnp.maximum(g1, g2)
+    diff = jnp.where(gate, x2 - x1, 1.0)  # avoid 0-div on inactive lanes
+
+    def child(bound_term, sign):
+        beta = 1.0 + 2.0 * bound_term / diff
+        alpha = 2.0 - beta ** -(eta + 1.0)
+        beta_q = jnp.where(
+            rand <= 1.0 / alpha,
+            (rand * alpha) ** (1.0 / (eta + 1.0)),
+            (1.0 / (2.0 - rand * alpha)) ** (1.0 / (eta + 1.0)),
+        )
+        return 0.5 * (x1 + x2 + sign * beta_q * diff)
+
+    c1 = jnp.clip(child(x1 - low, -1.0), low, up)
+    c2 = jnp.clip(child(up - x2, +1.0), low, up)
+    o1 = jnp.where(swap, c2, c1)
+    o2 = jnp.where(swap, c1, c2)
+    return jnp.where(gate, o1, g1), jnp.where(gate, o2, g2)
+
+
+# ------------------------------------------------------- length-changing ----
+
+def cx_messy_one_point(key, g1, len1, g2, len2):
+    """Messy one-point crossover (crossover.py:367-383) for fixed-capacity
+    padded genomes with explicit lengths.
+
+    ``c1 = g1[:k1] ++ g2[k2:len2]`` (and symmetrically); the reference
+    lets lists grow unboundedly — here results are truncated at the
+    padded capacity, the standard tensor formulation of ragged genomes
+    (SURVEY.md §7.3).
+    """
+    cap = g1.shape[0]
+    k1key, k2key = jax.random.split(key)
+    k1 = jax.random.randint(k1key, (), 0, len1 + 1)
+    k2 = jax.random.randint(k2key, (), 0, len2 + 1)
+    idx = jnp.arange(cap)
+
+    def splice(a, ka, b, kb, lb):
+        # child[i] = a[i] for i < ka else b[i - ka + kb]
+        src = jnp.clip(idx - ka + kb, 0, cap - 1)
+        child = jnp.where(idx < ka, a, b[src])
+        newlen = jnp.minimum(ka + jnp.maximum(lb - kb, 0), cap)
+        return jnp.where(idx < newlen, child, jnp.zeros_like(child)), newlen
+
+    c1, n1 = splice(g1, k1, g2, k2, len2)
+    c2, n2 = splice(g2, k2, g1, k1, len1)
+    return (c1, n1), (c2, n2)
+
+
+# ------------------------------------------------------------------- ES ----
+
+def cx_es_blend(key, g1, s1, g2, s2, alpha):
+    """ES blend (crossover.py:390-417): independent gammas for values and
+    strategies."""
+    kg, ks = jax.random.split(key)
+    c1, c2 = cx_blend(kg, g1, g2, alpha)
+    n1, n2 = cx_blend(ks, s1, s2, alpha)
+    return (c1, n1), (c2, n2)
+
+
+def cx_es_two_point(key, g1, s1, g2, s2):
+    """ES two-point (crossover.py:419-445): same crossover points applied
+    to values and strategy vectors."""
+    lo, hi = _two_points(key, g1.shape[0])
+    idx = jnp.arange(g1.shape[0])
+    mask = (idx >= lo) & (idx < hi)
+    c1 = jnp.where(mask, g2, g1)
+    c2 = jnp.where(mask, g1, g2)
+    n1 = jnp.where(mask, s2, s1)
+    n2 = jnp.where(mask, s1, s2)
+    return (c1, n1), (c2, n2)

@@ -1,0 +1,90 @@
+"""Genome initialisers and structural combinators.
+
+Counterpart of /root/reference/deap/tools/init.py (initRepeat :3-25,
+initIterate :27-52, initCycle :54-75). In the tensor backend an
+"attribute generator" is a pure function ``key -> array`` and an
+individual initialiser is built by composing them; populations are built
+by vmapping the individual initialiser over split keys
+(:func:`deap_tpu.core.population.init_population`).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+# ---- attribute/genome generators (the `attr_bool`-style building blocks) ----
+
+def bernoulli_genome(length: int, p: float = 0.5, dtype=jnp.bool_):
+    """`attr_bool` x length: random bitstring (cf. examples/ga/onemax.py)."""
+    def init(key):
+        return jax.random.bernoulli(key, p, (length,)).astype(dtype)
+    return init
+
+
+def uniform_genome(length: int, minval: float = 0.0, maxval: float = 1.0,
+                   dtype=jnp.float32):
+    """`random.uniform` x length: real-valued genome."""
+    def init(key):
+        return jax.random.uniform(key, (length,), dtype=dtype,
+                                  minval=minval, maxval=maxval)
+    return init
+
+
+def normal_genome(length: int, mu: float = 0.0, sigma: float = 1.0,
+                  dtype=jnp.float32):
+    def init(key):
+        return mu + sigma * jax.random.normal(key, (length,), dtype=dtype)
+    return init
+
+
+def randint_genome(length: int, low: int, high: int, dtype=jnp.int32):
+    """`random.randint(low, high)` x length — high inclusive like the
+    reference's random.randint."""
+    def init(key):
+        return jax.random.randint(key, (length,), low, high + 1, dtype=dtype)
+    return init
+
+
+def permutation_genome(length: int, dtype=jnp.int32):
+    """`random.sample(range(n), n)`: permutation genome (TSP, NQueens)."""
+    def init(key):
+        return jax.random.permutation(key, length).astype(dtype)
+    return init
+
+
+def constant_genome(value: jnp.ndarray):
+    def init(key):
+        del key
+        return jnp.asarray(value)
+    return init
+
+
+# ---- structural combinators (initRepeat / initIterate / initCycle) ----
+
+def init_repeat(genome_init: Callable, n: int):
+    """Stack ``n`` draws of ``genome_init`` — initRepeat (init.py:3-25)."""
+    def init(key):
+        return jax.vmap(genome_init)(jax.random.split(key, n))
+    return init
+
+
+def init_iterate(genome_inits: Sequence[Callable]):
+    """Concatenate one draw of each generator — initIterate (init.py:27-52),
+    for heterogeneous genomes laid out as one flat vector."""
+    def init(key):
+        keys = jax.random.split(key, len(genome_inits))
+        parts = [jnp.atleast_1d(g(k)) for g, k in zip(genome_inits, keys)]
+        return jnp.concatenate(parts)
+    return init
+
+
+def init_cycle(genome_inits: Sequence[Callable], n: int = 1):
+    """``n`` cycles through the generators — initCycle (init.py:54-75)."""
+    def init(key):
+        keys = jax.random.split(key, n)
+        return jnp.concatenate([init_iterate(genome_inits)(k) for k in keys])
+    return init

@@ -1,0 +1,124 @@
+"""Mutation operators as pure per-genome functions.
+
+Counterpart of /root/reference/deap/tools/mutation.py. Signature
+convention: ``(key, genome, **params) -> genome`` (ES log-normal also
+takes and returns the strategy vector). The reference's per-gene
+``random.random() < indpb`` loops become whole Bernoulli masks drawn in
+one op; batch over a population with ``jax.vmap``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def genome_vmap(mut):
+    """Lift a per-genome mutation to ``(key, G, ...)`` over ``[n, L]``."""
+    def batched(key, g, *args, **kwargs):
+        keys = jax.random.split(key, g.shape[0])
+        return jax.vmap(lambda k, x: mut(k, x, *args, **kwargs))(keys, g)
+    return batched
+
+
+def mut_gaussian(key, g, mu, sigma, indpb):
+    """Gaussian additive mutation (mutation.py:17-48): each gene gets
+    ``+ N(mu, sigma)`` with prob indpb."""
+    km, kn = jax.random.split(key)
+    mask = jax.random.bernoulli(km, indpb, g.shape)
+    noise = mu + sigma * jax.random.normal(kn, g.shape, dtype=g.dtype)
+    return jnp.where(mask, g + noise, g)
+
+
+def mut_polynomial_bounded(key, g, eta, low, up, indpb):
+    """Deb's polynomial bounded mutation (mutation.py:51-97), per-gene
+    with prob indpb, clipped to [low, up]."""
+    low = jnp.broadcast_to(jnp.asarray(low, g.dtype), g.shape)
+    up = jnp.broadcast_to(jnp.asarray(up, g.dtype), g.shape)
+    km, kr = jax.random.split(key)
+    mask = jax.random.bernoulli(km, indpb, g.shape)
+    rand = jax.random.uniform(kr, g.shape)
+
+    span = up - low
+    delta_1 = (g - low) / span
+    delta_2 = (up - g) / span
+    mut_pow = 1.0 / (eta + 1.0)
+
+    val_lo = 2.0 * rand + (1.0 - 2.0 * rand) * (1.0 - delta_1) ** (eta + 1.0)
+    val_hi = 2.0 * (1.0 - rand) + 2.0 * (rand - 0.5) * (1.0 - delta_2) ** (eta + 1.0)
+    delta_q = jnp.where(rand < 0.5, val_lo ** mut_pow - 1.0, 1.0 - val_hi ** mut_pow)
+
+    out = jnp.clip(g + delta_q * span, low, up)
+    return jnp.where(mask, out, g)
+
+
+def mut_shuffle_indexes(key, g, indpb):
+    """Positional shuffle (mutation.py:100-122): sequentially, each slot i
+    swaps with a uniformly-drawn other slot with prob indpb. Sequential
+    data dependence → fori_loop, vmapped across the population."""
+    size = g.shape[0]
+    km, kj = jax.random.split(key)
+    do = jax.random.bernoulli(km, indpb, (size,))
+    # reference: randint(0, size-2) bumped past i → uniform over others
+    raw = jax.random.randint(kj, (size,), 0, size - 1)
+    partner = jnp.where(raw >= jnp.arange(size), raw + 1, raw)
+
+    def body(i, arr):
+        j = partner[i]
+        swapped = arr.at[i].set(arr[j]).at[j].set(arr[i])
+        return jnp.where(do[i], swapped, arr)
+
+    return lax.fori_loop(0, size, body, g)
+
+
+def mut_flip_bit(key, g, indpb):
+    """Bit flip (mutation.py:124-142): logical-not with prob indpb."""
+    mask = jax.random.bernoulli(key, indpb, g.shape)
+    flipped = (~g.astype(bool)).astype(g.dtype)
+    return jnp.where(mask, flipped, g)
+
+
+def mut_uniform_int(key, g, low, up, indpb):
+    """Integer replacement (mutation.py:145-172): redraw in [low, up]
+    (inclusive) with prob indpb."""
+    km, kv = jax.random.split(key)
+    mask = jax.random.bernoulli(km, indpb, g.shape)
+    low_a = jnp.broadcast_to(jnp.asarray(low, g.dtype), g.shape)
+    up_a = jnp.broadcast_to(jnp.asarray(up, g.dtype), g.shape)
+    # per-gene bounds via uniform scaling (handles sequence low/up)
+    u = jax.random.uniform(kv, g.shape)
+    draw = (low_a + jnp.floor(u * (up_a - low_a + 1))).astype(g.dtype)
+    return jnp.where(mask, draw, g)
+
+
+def mut_es_log_normal(key, g, strategy, c, indpb):
+    """Self-adaptive ES mutation (Beyer & Schwefel 2002; mutation.py:180-215).
+
+    One global draw n0 scales all strategies this call
+    (``t0 = c/sqrt(2L)``); per gene with prob indpb the strategy is
+    log-normally perturbed (``t = c/sqrt(2 sqrt(L))``) and the value
+    moves by ``strategy * N(0,1)``. Returns ``(genome, strategy)``.
+    """
+    size = g.shape[0]
+    t = c / jnp.sqrt(2.0 * jnp.sqrt(float(size)))
+    t0 = c / jnp.sqrt(2.0 * float(size))
+    k0, km, k1, k2 = jax.random.split(key, 4)
+    n0 = jax.random.normal(k0, ())
+    mask = jax.random.bernoulli(km, indpb, g.shape)
+    n1 = jax.random.normal(k1, g.shape, dtype=g.dtype)
+    n2 = jax.random.normal(k2, g.shape, dtype=g.dtype)
+    new_strategy = strategy * jnp.exp(t0 * n0 + t * n1)
+    new_g = g + new_strategy * n2
+    return (jnp.where(mask, new_g, g), jnp.where(mask, new_strategy, strategy))
+
+
+def strategy_floor(minstrategy):
+    """Decorator enforcing a minimum strategy value — counterpart of the
+    ``checkStrategy`` decorator pattern in examples/es/fctmin.py."""
+    def decorator(mut):
+        def wrapper(*args, **kwargs):
+            g, s = mut(*args, **kwargs)
+            return g, jnp.maximum(s, minstrategy)
+        return wrapper
+    return decorator

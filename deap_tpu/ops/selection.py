@@ -1,0 +1,202 @@
+"""Selection operators — batched, index-returning.
+
+Counterpart of /root/reference/deap/tools/selection.py. Every operator
+takes weighted fitness values ``w: f32[n, nobj]`` (the comparison
+currency, see core.fitness) and returns ``int32[k]`` indices into the
+population; callers materialise the selection with
+:func:`deap_tpu.core.population.gather`. Returning indices keeps
+selection a pure gather — the reference returns *references* into the
+input list and relies on ``varAnd`` to clone (algorithms.py:68), which a
+gather subsumes.
+
+The lexicase family takes the raw per-case error matrix plus per-case
+weights, matching the reference's use of fitness.values as cases
+(selection.py:214-330).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deap_tpu.core.fitness import lex_gt, lex_sort_desc
+
+
+def _lex_sort_asc(w):
+    keys = tuple(w[..., j] for j in range(w.shape[-1] - 1, -1, -1))
+    return jnp.lexsort(keys)
+
+
+def _tournament_winners(w, aspirants):
+    """Lexicographic-best aspirant per row; ties go to the earliest drawn,
+    matching Python ``max`` (selection.py:51-69)."""
+    t = aspirants.shape[-1]
+    best = aspirants[..., 0]
+    for j in range(1, t):
+        cand = aspirants[..., j]
+        better = lex_gt(jnp.take(w, cand, axis=0), jnp.take(w, best, axis=0))
+        best = jnp.where(better, cand, best)
+    return best
+
+
+def sel_random(key, w, k):
+    """k uniform draws with replacement (selection.py:12-24)."""
+    n = w.shape[0]
+    return jax.random.randint(key, (k,), 0, n)
+
+
+def sel_best(key, w, k):
+    """k lexicographically-best (selection.py:27-36). Stable."""
+    del key
+    return lex_sort_desc(w)[:k]
+
+
+def sel_worst(key, w, k):
+    """k lexicographically-worst (selection.py:39-48). Stable ascending."""
+    del key
+    return _lex_sort_asc(w)[:k]
+
+
+def sel_tournament(key, w, k, tournsize):
+    """k tournaments of tournsize uniform aspirants (selection.py:51-69)."""
+    n = w.shape[0]
+    aspirants = jax.random.randint(key, (k, tournsize), 0, n)
+    return _tournament_winners(w, aspirants)
+
+
+def sel_roulette(key, w, k, values: Optional[jnp.ndarray] = None):
+    """Fitness-proportionate selection on the first objective
+    (selection.py:71-103): individuals sorted best-first, k spins over the
+    cumulative raw first-objective values. ``values`` defaults to the
+    first column of ``w`` (equal to raw values for weight +1; the
+    reference likewise only makes sense for positive maximised fitness).
+    """
+    if values is None:
+        values = w[..., 0]
+    order = lex_sort_desc(w)
+    sorted_vals = jnp.take(values, order)
+    cs = jnp.cumsum(sorted_vals)
+    total = cs[-1]
+    u = jax.random.uniform(key, (k,)) * total
+    # first index with cumsum > u (reference: `if sum_ > u: break`)
+    pick = jnp.searchsorted(cs, u, side="right")
+    return jnp.take(order, jnp.clip(pick, 0, w.shape[0] - 1))
+
+
+def sel_stochastic_universal_sampling(key, w, k, values: Optional[jnp.ndarray] = None):
+    """SUS (Baker 1987; selection.py:182-212): k evenly spaced pointers
+    from one random start over the best-first cumulative distribution."""
+    if values is None:
+        values = w[..., 0]
+    order = lex_sort_desc(w)
+    sorted_vals = jnp.take(values, order)
+    cs = jnp.cumsum(sorted_vals)
+    total = cs[-1]
+    distance = total / k
+    start = jax.random.uniform(key, ()) * distance
+    points = start + distance * jnp.arange(k)
+    # first index with cumsum >= p (reference: `while sum_ < p`)
+    pick = jnp.searchsorted(cs, points, side="left")
+    return jnp.take(order, jnp.clip(pick, 0, w.shape[0] - 1))
+
+
+def sel_double_tournament(key, w, lengths, k, fitness_size, parsimony_size,
+                          fitness_first):
+    """Luke & Panait's double (fitness + parsimony) tournament
+    (selection.py:105-180). ``lengths`` is the per-individual genome size
+    used by the 2-way size tournament; the shorter wins with prob
+    ``parsimony_size / 2`` (0.5 on ties).
+    """
+    n = w.shape[0]
+    base_prob = parsimony_size / 2.0
+    ka, ku = jax.random.split(key)
+
+    def size_round(ku, i1, i2):
+        l1 = jnp.take(lengths, i1)
+        l2 = jnp.take(lengths, i2)
+        first = jnp.where(l1 > l2, i2, i1)
+        second = jnp.where(l1 > l2, i1, i2)
+        p = jnp.where(l1 == l2, 0.5, base_prob)
+        u = jax.random.uniform(ku, i1.shape)
+        return jnp.where(u < p, first, second)
+
+    if fitness_first:
+        aspirants = jax.random.randint(ka, (k, 2, fitness_size), 0, n)
+        finalists = _tournament_winners(w, aspirants)  # [k, 2]
+        return size_round(ku, finalists[:, 0], finalists[:, 1])
+    else:
+        aspirants = jax.random.randint(ka, (k, fitness_size, 2), 0, n)
+        cands = size_round(ku, aspirants[..., 0], aspirants[..., 1])  # [k, fs]
+        return _tournament_winners(w, cands)
+
+
+# ------------------------------------------------------------- lexicase ----
+
+def _masked_extreme(vals, mask, maximize):
+    hi = jnp.max(jnp.where(mask, vals, -jnp.inf))
+    lo = jnp.min(jnp.where(mask, vals, jnp.inf))
+    return jnp.where(maximize, hi, lo)
+
+
+def _masked_median(vals, mask):
+    s = jnp.sort(jnp.where(mask, vals, jnp.inf))
+    m = jnp.sum(mask)
+    lo = jnp.take(s, jnp.maximum((m - 1) // 2, 0))
+    hi = jnp.take(s, jnp.clip(m // 2, 0, vals.shape[0] - 1))
+    return 0.5 * (lo + hi)
+
+
+def _lexicase_select(key, values, weights, k, survive_fn):
+    """Shared scaffold (selection.py:214-330): per pick, shuffle cases and
+    successively filter the candidate mask; keeping the filter running
+    after one candidate remains is a no-op, so no data-dependent exit is
+    needed — the loop is a clean `lax.scan` over cases."""
+    n, ncases = values.shape
+    maximize = weights > 0
+
+    def one(key):
+        kp, kc = jax.random.split(key)
+        order = jax.random.permutation(kp, ncases)
+
+        def body(mask, case):
+            v = values[:, case]
+            best = _masked_extreme(v, mask, maximize[case])
+            keep = survive_fn(v, mask, best, maximize[case], case)
+            return mask & keep, None
+
+        mask, _ = lax.scan(body, jnp.ones(n, bool), order)
+        p = mask / jnp.sum(mask)
+        return jax.random.choice(kc, n, p=p)
+
+    return jax.vmap(one)(jax.random.split(key, k))
+
+
+def sel_lexicase(key, values, weights, k):
+    """Lexicase selection (Spector; selection.py:214-243): survive a case
+    only by exactly matching the elite error on it."""
+    def survive(v, mask, best, maximize, case):
+        del mask, maximize, case
+        return v == best
+    return _lexicase_select(key, values, jnp.asarray(weights), k, survive)
+
+
+def sel_epsilon_lexicase(key, values, weights, k, epsilon):
+    """ε-lexicase (La Cava 2016, epsilon_y; selection.py:247-280)."""
+    def survive(v, mask, best, maximize, case):
+        del mask, case
+        return jnp.where(maximize, v >= best - epsilon, v <= best + epsilon)
+    return _lexicase_select(key, values, jnp.asarray(weights), k, survive)
+
+
+def sel_automatic_epsilon_lexicase(key, values, weights, k):
+    """Automatic-ε-lexicase (lambda_epsilon_y; selection.py:283-330):
+    ε = median absolute deviation of the surviving candidates' errors."""
+    def survive(v, mask, best, maximize, case):
+        del case
+        med = _masked_median(v, mask)
+        mad = _masked_median(jnp.abs(v - med), mask)
+        return jnp.where(maximize, v >= best - mad, v <= best + mad)
+    return _lexicase_select(key, values, jnp.asarray(weights), k, survive)

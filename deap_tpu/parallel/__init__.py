@@ -1,0 +1,13 @@
+from deap_tpu.parallel.mesh import population_mesh, shard_population
+from deap_tpu.parallel.migration import mig_ring, migRing
+from deap_tpu.parallel.island import IslandState, island_init, make_island_step
+
+__all__ = [
+    "population_mesh",
+    "shard_population",
+    "mig_ring",
+    "migRing",
+    "IslandState",
+    "island_init",
+    "make_island_step",
+]

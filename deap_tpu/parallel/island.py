@@ -1,0 +1,129 @@
+"""Island-model evolution over a device mesh.
+
+Counterpart of the reference's island examples: master-driven SCOOP
+islands (examples/ga/onemax_island_scoop.py:51-69, P4), peer-to-peer
+pipe-ring processes (examples/ga/onemax_island.py:45-75, P5) and
+in-process multi-demic evolution (onemax_multidemic.py, P6). Here all
+three collapse into one SPMD program: demes are stacked in a
+``[n_islands, island_size, ...]`` tensor, sharded over the mesh's
+``"island"`` axis with ``shard_map``; every deme evolves ``freq``
+generations locally (a vmapped, scanned generation step), then the
+emigrant block rides a ``ppermute`` ring one hop — intra-device demes
+shift locally, the boundary deme crosses ICI. The blocking send/recv of
+the reference's ``migPipe`` is inherent to SPMD lockstep.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deap_tpu.algorithms import evaluate_invalid, var_and
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import Population, gather, init_population
+from deap_tpu.ops.selection import sel_best
+
+IslandState = Population  # demes stacked on the leading axis
+
+
+def island_init(key: jax.Array, n_islands: int, island_size: int,
+                init_genome: Callable, spec: FitnessSpec) -> Population:
+    """Stacked island populations: leaves ``[n_islands, island_size, ...]``."""
+    keys = jax.random.split(key, n_islands)
+    return jax.vmap(
+        lambda k: init_population(k, island_size, init_genome, spec))(keys)
+
+
+def _local_generation(key, pop, toolbox, cxpb, mutpb):
+    """One eaSimple generation on a single deme (algorithms.py:163-181)."""
+    k_sel, k_var = jax.random.split(key)
+    idx = toolbox.select(k_sel, pop.wvalues, pop.size)
+    off = var_and(k_var, gather(pop, idx), toolbox, cxpb, mutpb)
+    return evaluate_invalid(off, toolbox.evaluate)
+
+
+def _migrate_local(key, pops, k, selection):
+    """Ring-shift emigrants across the deme axis of a stacked tensor."""
+    from deap_tpu.parallel.migration import mig_ring
+    return mig_ring(key, pops, k, selection=selection)
+
+
+def _migrate_sharded(key, pops, k, selection, axis_name):
+    """Ring migration when the deme axis is split over ``axis_name``:
+    demes shift emigrants locally; the last local deme's emigrants
+    ppermute to the next mesh slice's first deme."""
+    m = pops.valid.shape[0]  # local demes per device
+    key = jax.random.fold_in(key, lax.axis_index(axis_name))
+    keys = jax.random.split(key, m)
+
+    w = pops.fitness * pops.spec.warray
+    w = jnp.where(pops.valid[..., None], w, -jnp.inf)
+    emi_idx = jax.vmap(lambda kk, ww: selection(kk, ww, k))(keys, w)
+
+    def take_rows(a):
+        return jax.vmap(lambda x, i: jnp.take(x, i, axis=0))(a, emi_idx)
+
+    def shift(rows):
+        # rows: [m, k, ...]; destination deme j gets rows from deme j-1,
+        # deme 0 gets the previous device's deme m-1 over the ring.
+        n = lax.axis_size(axis_name)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        incoming0 = lax.ppermute(rows[-1], axis_name, perm)
+        return jnp.concatenate([incoming0[None], rows[:-1]], axis=0)
+
+    def put_rows(a, rows):
+        return jax.vmap(lambda x, i, r: x.at[i].set(r))(a, emi_idx, rows)
+
+    move = lambda a: put_rows(a, shift(take_rows(a)))
+    return pops.replace(
+        genomes=jax.tree_util.tree_map(move, pops.genomes),
+        extras=jax.tree_util.tree_map(move, pops.extras),
+        fitness=move(pops.fitness),
+        valid=put_rows(pops.valid,
+                       shift(jax.vmap(jnp.take)(pops.valid, emi_idx))),
+    )
+
+
+def make_island_step(toolbox, cxpb: float, mutpb: float, freq: int,
+                     mig_k: int, mesh: Optional[Mesh] = None,
+                     axis_name: str = "island",
+                     selection: Callable = sel_best):
+    """Build ``step(key, pops) -> pops``: ``freq`` local generations then
+    one ring migration (the reference's FREQ-generation epoch,
+    onemax_island_scoop.py:64-67). Jit-compatible; pass a ``mesh`` to run
+    each deme on its own mesh slice.
+    """
+
+    def epoch(key, pops, migrate):
+        n_local = pops.valid.shape[0]
+
+        def gen(pops, k):
+            keys = jax.random.split(k, n_local)
+            return jax.vmap(
+                lambda kk, p: _local_generation(kk, p, toolbox, cxpb, mutpb)
+            )(keys, pops), None
+
+        k_gen, k_mig = jax.random.split(key)
+        pops, _ = lax.scan(gen, pops, jax.random.split(k_gen, freq))
+        return migrate(k_mig, pops)
+
+    if mesh is None:
+        return jax.jit(lambda key, pops: epoch(
+            key, pops, partial(_migrate_local, k=mig_k, selection=selection)))
+
+    spec_sharded = P(axis_name)
+
+    def sharded_epoch(key, pops):
+        return epoch(key, pops, lambda kk, pp: _migrate_sharded(
+            kk, pp, mig_k, selection, axis_name))
+
+    mapped = jax.shard_map(
+        sharded_epoch, mesh=mesh,
+        in_specs=(P(), spec_sharded), out_specs=spec_sharded,
+        check_vma=False)
+    return jax.jit(mapped)

@@ -1,0 +1,60 @@
+"""Device mesh construction and population sharding.
+
+The reference's distribution story is "swap toolbox.map for a parallel
+map" — multiprocessing.Pool (P2), SCOOP network futures (P3)
+(SURVEY.md §2.3). The TPU-native equivalent is data placement: the
+population tensor is sharded over a `jax.sharding.Mesh` and every
+compiled generation step runs SPMD, XLA inserting ICI/DCN collectives
+where the program needs them. Multi-host (the SCOOP analog) is the same
+program under `jax.distributed` initialisation — no code change.
+
+Axes convention:
+- ``"pop"``   — data-parallel population sharding (P2/P3): selection is
+  kept device-local or global depending on the operator's needs.
+- ``"island"``— one sub-population per mesh slice (P4/P5/P6), migration
+  via `lax.ppermute` ring (see migration.py).
+- ``"genome"``— genome-axis (SP/CP-shaped) sharding for very large
+  genomes, e.g. neuroevolution weight vectors (SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def population_mesh(n_devices: Optional[int] = None,
+                    axis_names: Sequence[str] = ("pop",),
+                    shape: Optional[Sequence[int]] = None) -> Mesh:
+    """Build a mesh over the first ``n_devices`` devices.
+
+    Default is a 1-D ``("pop",)`` mesh; pass ``axis_names=("island",)``
+    for island runs or ``("island", "genome")`` with ``shape`` for 2-D
+    layouts.
+    """
+    devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    devices = devices[:n_devices]
+    if shape is None:
+        shape = (n_devices,) + (1,) * (len(axis_names) - 1)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axis_names)
+
+
+def shard_population(pop, mesh: Mesh, axis: str = "pop"):
+    """Place a Population with its individual axis sharded over ``axis``.
+
+    All leaves share leading axis n; fitness/valid/extras follow the same
+    partitioning so a generation step touches only local rows until a
+    collective is explicitly requested.
+    """
+    sharding = NamedSharding(mesh, P(axis))
+
+    def place(x):
+        return jax.device_put(x, sharding)
+
+    return jax.tree_util.tree_map(place, pop)

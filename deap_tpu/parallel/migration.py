@@ -1,0 +1,112 @@
+"""Ring migration between demes.
+
+Counterpart of /root/reference/deap/tools/migration.py:4-51 (``migRing``)
+and the pipe-ring of examples/ga/onemax_island.py:45-75. Two layouts:
+
+- :func:`mig_ring` — demes stacked in one tensor ``[n_demes, deme, ...]``
+  on one device (P6, multi-demic in-process): pure ``jnp.roll`` of the
+  emigrant block.
+- :func:`mig_ring_collective` — inside ``shard_map`` with one deme per
+  mesh slice (P4/P5): the emigrant block rides a ``lax.ppermute`` ring
+  over ICI; SPMD lockstep gives the blocking send/recv semantics of the
+  reference's ``migPipe`` for free (SURVEY.md §2.3).
+
+Selection semantics mirror the reference: ``selection`` picks the k
+emigrants of each deme; ``replacement`` picks which k rows of the
+*destination* deme are overwritten (default: the same rows the
+destination's own emigrants came from, i.e. emigrants are replaced —
+migration.py:23-27).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deap_tpu.core.population import Population, gather
+from deap_tpu.ops.selection import sel_best, sel_worst
+
+
+def _emigrant_idx(key, pop, k, selection):
+    return selection(key, pop.wvalues, k)
+
+
+def mig_ring(key: jax.Array, pops: Population, k: int,
+             selection: Callable = sel_best,
+             replacement: Optional[Callable] = None) -> Population:
+    """Ring migration over stacked demes ``[n_demes, deme_size, ...]``.
+
+    Deme i's emigrants overwrite the replaced rows of deme i+1 (mod n).
+    """
+    n_demes = pops.valid.shape[0]
+    keys = jax.random.split(key, 2 * n_demes)
+    sel_keys, rep_keys = keys[:n_demes], keys[n_demes:]
+
+    def per_deme_idx(key, w):
+        return selection(key, w, k)
+
+    w = pops.fitness * pops.spec.warray
+    w = jnp.where(pops.valid[..., None], w, -jnp.inf)
+    emi_idx = jax.vmap(per_deme_idx)(sel_keys, w)  # [n_demes, k]
+    if replacement is None:
+        rep_idx = emi_idx
+    else:
+        rep_idx = jax.vmap(lambda kk, ww: replacement(kk, ww, k))(rep_keys, w)
+
+    def take_rows(a):
+        # a: [n_demes, deme, ...] → emigrant rows [n_demes, k, ...]
+        return jax.vmap(lambda x, i: jnp.take(x, i, axis=0))(a, emi_idx)
+
+    def put_rows(a, rows):
+        return jax.vmap(lambda x, i, r: x.at[i].set(r))(a, rep_idx, rows)
+
+    roll = lambda r: jnp.roll(r, shift=1, axis=0)  # deme i → deme i+1
+
+    genomes = jax.tree_util.tree_map(
+        lambda a: put_rows(a, roll(take_rows(a))), pops.genomes)
+    extras = jax.tree_util.tree_map(
+        lambda a: put_rows(a, roll(take_rows(a))), pops.extras)
+    fitness = put_rows(pops.fitness, roll(take_rows(pops.fitness)))
+    valid_rows = jax.vmap(lambda v, i: jnp.take(v, i))(pops.valid, emi_idx)
+    valid = put_rows(pops.valid, roll(valid_rows))
+    return pops.replace(genomes=genomes, extras=extras, fitness=fitness,
+                        valid=valid)
+
+
+def mig_ring_collective(key: jax.Array, pop: Population, k: int,
+                        axis_name: str,
+                        selection: Callable = sel_best,
+                        replacement: Optional[Callable] = None) -> Population:
+    """Ring migration across mesh slices, for use inside ``shard_map``.
+
+    ``pop`` is the device-local deme; emigrants travel one hop along
+    ``axis_name`` via ``lax.ppermute`` (P4/P5 over ICI).
+    """
+    ksel, krep = jax.random.split(jax.random.fold_in(key, lax.axis_index(axis_name)))
+    w = pop.wvalues
+    emi_idx = selection(ksel, w, k)
+    rep_idx = emi_idx if replacement is None else replacement(krep, w, k)
+
+    emigrants = gather(pop, emi_idx)
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    incoming = jax.tree_util.tree_map(
+        lambda x: lax.ppermute(x, axis_name, perm), emigrants)
+
+    genomes = jax.tree_util.tree_map(
+        lambda a, r: a.at[rep_idx].set(r), pop.genomes, incoming.genomes)
+    extras = jax.tree_util.tree_map(
+        lambda a, r: a.at[rep_idx].set(r), pop.extras, incoming.extras)
+    return pop.replace(
+        genomes=genomes,
+        extras=extras,
+        fitness=pop.fitness.at[rep_idx].set(incoming.fitness),
+        valid=pop.valid.at[rep_idx].set(incoming.valid),
+    )
+
+
+# DEAP-style alias
+migRing = mig_ring

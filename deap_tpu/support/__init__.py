@@ -1,0 +1,17 @@
+from deap_tpu.support.stats import Statistics, MultiStatistics
+from deap_tpu.support.logbook import Logbook
+from deap_tpu.support.hof import HallOfFame, hof_init, hof_update, hof_best
+from deap_tpu.support.pareto import ParetoArchive, pareto_init, pareto_update
+
+__all__ = [
+    "Statistics",
+    "MultiStatistics",
+    "Logbook",
+    "HallOfFame",
+    "hof_init",
+    "hof_update",
+    "hof_best",
+    "ParetoArchive",
+    "pareto_init",
+    "pareto_update",
+]

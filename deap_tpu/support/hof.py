@@ -1,0 +1,141 @@
+"""HallOfFame — fixed-capacity best-ever archive, resident on device.
+
+Counterpart of /root/reference/deap/tools/support.py:490-588: a sorted,
+bounded archive of the best individuals ever seen, with duplicate
+suppression (the reference's ``similar=operator.eq``). Implemented so
+``hof_update`` can run inside a scanned generation step: the population's
+top-k rows are merged with the archive, lex-sorted, genome-deduplicated
+and truncated — all static shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from deap_tpu.core.fitness import FitnessSpec, lex_sort_desc
+from deap_tpu.core.population import Population
+
+
+@struct.dataclass
+class HallOfFame:
+    genomes: Any
+    fitness: jnp.ndarray  # [k, nobj]
+    filled: jnp.ndarray   # [k] bool
+    spec: FitnessSpec = struct.field(pytree_node=False, default=FitnessSpec((1.0,)))
+
+    @property
+    def maxsize(self) -> int:
+        return self.filled.shape[0]
+
+    @property
+    def wvalues(self) -> jnp.ndarray:
+        w = self.fitness * self.spec.warray
+        return jnp.where(self.filled[:, None], w, -jnp.inf)
+
+
+def hof_init(maxsize: int, pop: Population) -> HallOfFame:
+    """Empty archive shaped like (maxsize copies of) one individual."""
+    take0 = lambda a: jnp.zeros((maxsize,) + a.shape[1:], a.dtype)
+    return HallOfFame(
+        genomes=jax.tree_util.tree_map(take0, pop.genomes),
+        fitness=jnp.zeros((maxsize, pop.nobj), pop.fitness.dtype),
+        filled=jnp.zeros(maxsize, bool),
+        spec=pop.spec,
+    )
+
+
+def _genome_eq_matrix(genomes) -> jnp.ndarray:
+    """[m, m] matrix of exact genome equality across a (small) pytree batch."""
+    leaves = jax.tree_util.tree_leaves(genomes)
+    m = leaves[0].shape[0]
+    eq = jnp.ones((m, m), bool)
+    for leaf in leaves:
+        flat = leaf.reshape(m, -1)
+        eq &= jnp.all(flat[:, None, :] == flat[None, :, :], axis=-1)
+    return eq
+
+
+def _genome_hash(genomes) -> jnp.ndarray:
+    """Cheap order-independent-free int32 hash per row (wrapping int
+    arithmetic). Equal genomes always hash equal; used only as a sort
+    tie-key so exact duplicates land adjacent — correctness never depends
+    on collision-freedom."""
+    from jax import lax
+
+    leaves = jax.tree_util.tree_leaves(genomes)
+    n = leaves[0].shape[0]
+    h = jnp.zeros(n, jnp.int32)
+    for leaf in leaves:
+        flat = leaf.reshape(n, -1)
+        if jnp.issubdtype(flat.dtype, jnp.floating):
+            ints = lax.bitcast_convert_type(flat.astype(jnp.float32), jnp.int32)
+        else:
+            ints = flat.astype(jnp.int32)
+        mult = (jnp.arange(flat.shape[1], dtype=jnp.int32) * jnp.int32(-1640531527)
+                + jnp.int32(97))
+        h = h * jnp.int32(31) + jnp.sum(ints * mult, axis=-1, dtype=jnp.int32)
+    return h
+
+
+def _adjacent_dup(sorted_w, sorted_h, sorted_genomes, sorted_valid):
+    """dup[i]: row i is an exact-genome duplicate of row i-1. Because the
+    pool is sorted by (wvalues, hash), all copies of a genome are
+    contiguous (duplicates share fitness under deterministic evaluation),
+    so adjacent comparison removes every copy but the first."""
+    same = jnp.all(sorted_w[1:] == sorted_w[:-1], axis=-1)
+    same &= sorted_h[1:] == sorted_h[:-1]
+    for leaf in jax.tree_util.tree_leaves(sorted_genomes):
+        flat = leaf.reshape(leaf.shape[0], -1)
+        same &= jnp.all(flat[1:] == flat[:-1], axis=-1)
+    same &= sorted_valid[1:] & sorted_valid[:-1]
+    return jnp.concatenate([jnp.zeros(1, bool), same])
+
+
+def hof_update(hof: HallOfFame, pop: Population, dedup: bool = True) -> HallOfFame:
+    """Merge a population into the archive (support.py:517-543).
+
+    Pool = archive ∪ full population, lex-sorted best-first with a genome
+    hash as the final tie-key, adjacent-deduplicated on exact genome
+    equality, truncated to ``maxsize``. O((n+k) log(n+k)) — no pairwise
+    matrix, so it scales to 100k populations inside the scanned step.
+    """
+    k = hof.maxsize
+    cat = lambda a, b: jnp.concatenate([a, b], axis=0)
+    all_g = jax.tree_util.tree_map(cat, hof.genomes, pop.genomes)
+    all_f = cat(hof.fitness, pop.fitness)
+    all_valid = cat(hof.filled, pop.valid)
+
+    w = all_f * hof.spec.warray
+    w = jnp.where(all_valid[:, None], w, -jnp.inf)
+    h = _genome_hash(all_g)
+    # lexsort: last key is primary → (hash, w[nobj-1], ..., w[0]) negated
+    keys = (h,) + tuple(-w[:, j] for j in range(w.shape[1] - 1, -1, -1))
+    order = jnp.lexsort(keys)
+    take = lambda a: jnp.take(a, order, axis=0)
+    all_g = jax.tree_util.tree_map(take, all_g)
+    all_f = take(all_f)
+    all_valid = take(all_valid)
+    w = take(w)
+    h = take(h)
+
+    keep = all_valid
+    if dedup:
+        keep = keep & ~_adjacent_dup(w, h, all_g, all_valid)
+
+    perm = jnp.argsort(~keep, stable=True)[:k]
+    return HallOfFame(
+        genomes=jax.tree_util.tree_map(lambda a: jnp.take(a, perm, axis=0), all_g),
+        fitness=jnp.take(all_f, perm, axis=0),
+        filled=jnp.take(keep, perm),
+        spec=hof.spec,
+    )
+
+
+def hof_best(hof: HallOfFame):
+    """Best genome + fitness (the reference's ``hof[0]``)."""
+    g = jax.tree_util.tree_map(lambda a: a[0], hof.genomes)
+    return g, hof.fitness[0]

@@ -1,0 +1,93 @@
+"""ParetoArchive — capacity-bounded non-dominated archive on device.
+
+Counterpart of /root/reference/deap/tools/support.py:591-640
+(``ParetoFront``): keeps every individual not dominated by any other seen
+so far, dropping newly-dominated members. The reference archive is
+unbounded (a Python list); a device archive needs static shapes, so this
+one has a fixed capacity — overflow drops lexicographically-worst
+members, and the unbounded variant lives in the host/compat backend.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from deap_tpu.core.fitness import FitnessSpec, dominates, lex_sort_desc
+from deap_tpu.core.population import Population
+from deap_tpu.support.hof import _genome_eq_matrix
+
+
+@struct.dataclass
+class ParetoArchive:
+    genomes: Any
+    fitness: jnp.ndarray
+    filled: jnp.ndarray
+    spec: FitnessSpec = struct.field(pytree_node=False, default=FitnessSpec((1.0,)))
+
+    @property
+    def capacity(self) -> int:
+        return self.filled.shape[0]
+
+
+def pareto_init(capacity: int, pop: Population) -> ParetoArchive:
+    take0 = lambda a: jnp.zeros((capacity,) + a.shape[1:], a.dtype)
+    return ParetoArchive(
+        genomes=jax.tree_util.tree_map(take0, pop.genomes),
+        fitness=jnp.zeros((capacity, pop.nobj), pop.fitness.dtype),
+        filled=jnp.zeros(capacity, bool),
+        spec=pop.spec,
+    )
+
+
+def nondominated_mask(w: jnp.ndarray, valid: jnp.ndarray | None = None) -> jnp.ndarray:
+    """bool[n]: rows not Pareto-dominated by any other row.
+
+    The O(n²) pairwise dominance matrix is one fused batched comparison —
+    the TPU-friendly replacement for the reference's per-pair loop
+    (support.py:612-633). Intended for selection-sized fronts.
+    """
+    dom = dominates(w[None, :, :], w[:, None, :])  # dom[i, j]: j dominates i
+    if valid is not None:
+        dom &= valid[None, :]
+        return valid & ~jnp.any(dom, axis=1)
+    return ~jnp.any(dom, axis=1)
+
+
+def pareto_update(archive: ParetoArchive, pop: Population,
+                  dedup: bool = True) -> ParetoArchive:
+    """Merge a population into the archive.
+
+    Pool = archive ∪ population, keep the pool's non-dominated subset
+    (deduplicated on genome equality), lex-sorted, truncated at capacity.
+    """
+    cap = archive.capacity
+    # Reduce the population to its lex-best min(n, 4*cap) rows first when
+    # it is much larger than the archive? No — dominance is not aligned
+    # with lex order in multi-objective spaces; merge the full population.
+    cat = lambda a, b: jnp.concatenate([a, b], axis=0)
+    all_g = jax.tree_util.tree_map(cat, archive.genomes, pop.genomes)
+    all_f = cat(archive.fitness, pop.fitness)
+    all_valid = cat(archive.filled, pop.valid)
+
+    w = all_f * archive.spec.warray
+    w = jnp.where(all_valid[:, None], w, -jnp.inf)
+    nd = nondominated_mask(w, all_valid)
+
+    if dedup:
+        eq = _genome_eq_matrix(all_g)
+        earlier = jnp.tril(jnp.ones_like(eq), k=-1)
+        is_dup = jnp.any(eq & earlier & all_valid[None, :], axis=1)
+        nd &= ~is_dup
+
+    order = lex_sort_desc(jnp.where(nd[:, None], w, -jnp.inf))[:cap]
+    take = lambda a: jnp.take(a, order, axis=0)
+    return ParetoArchive(
+        genomes=jax.tree_util.tree_map(take, all_g),
+        fitness=take(all_f),
+        filled=take(nd),
+        spec=archive.spec,
+    )

@@ -1,0 +1,71 @@
+"""Statistics / MultiStatistics — on-device per-generation reductions.
+
+Counterpart of /root/reference/deap/tools/support.py:154-259. The
+reference's ``Statistics(key)`` extracts a value per individual and
+applies registered numpy reducers; here ``key`` extracts a batched array
+from the whole :class:`Population` (default: raw fitness values of valid
+rows, with invalid rows masked to NaN-safe values) and reducers are jnp
+functions, so ``compile`` can run *inside* a jit'd/scanned generation
+step — the per-generation stats come back as stacked arrays, one slice
+per generation, and feed the host-side :class:`Logbook`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax.numpy as jnp
+
+
+def _default_key(pop):
+    return pop.fitness
+
+
+class Statistics:
+    """``Statistics(key)`` + ``register(name, fn)`` → ``compile(pop)``.
+
+    Reducers are applied over the population axis (axis 0), mirroring the
+    reference's numpy-over-list behaviour (support.py:199-210).
+    """
+
+    def __init__(self, key: Callable = _default_key):
+        self.key = key
+        self.functions: Dict[str, Callable] = {}
+        self.fields = []
+
+    def register(self, name: str, function: Callable, *args, **kwargs) -> None:
+        self.functions[name] = lambda x: function(x, *args, **kwargs)
+        self.fields.append(name)
+
+    def compile(self, pop) -> Dict[str, jnp.ndarray]:
+        data = self.key(pop)
+        return {name: fn(data) for name, fn in self.functions.items()}
+
+
+class MultiStatistics(dict):
+    """Named chapters of Statistics (support.py:212-259)."""
+
+    def __init__(self, **chapters: Statistics):
+        super().__init__(chapters)
+
+    @property
+    def fields(self):
+        return sorted(self.keys())
+
+    def register(self, name: str, function: Callable, *args, **kwargs) -> None:
+        for stats in self.values():
+            stats.register(name, function, *args, **kwargs)
+
+    def compile(self, pop):
+        return {chapter: stats.compile(pop) for chapter, stats in self.items()}
+
+
+def fitness_stats(axis: int | None = 0) -> Statistics:
+    """The conventional avg/std/min/max fitness statistics block used by
+    every reference example (e.g. examples/ga/onemax.py)."""
+    stats = Statistics(lambda pop: pop.fitness[:, 0] if pop.nobj == 1 else pop.fitness)
+    stats.register("avg", jnp.mean, axis=axis)
+    stats.register("std", jnp.std, axis=axis)
+    stats.register("min", jnp.min, axis=axis)
+    stats.register("max", jnp.max, axis=axis)
+    return stats

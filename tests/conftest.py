@@ -1,0 +1,23 @@
+"""Test configuration: force an 8-virtual-device CPU platform.
+
+This is the TPU-native analog of the reference's "test multi-node without
+a cluster" strategy (pickle round-trips, SURVEY.md §4.3): all sharding /
+island / multi-host-shaped tests run against
+``--xla_force_host_platform_device_count=8`` so CI needs no TPU.
+
+Note: the environment's TPU plugin pins ``jax_platforms`` to
+``axon,cpu``, overriding the JAX_PLATFORMS env var — so CPU must be
+forced through ``jax.config`` after import, while XLA_FLAGS still must
+be set *before* backend initialisation.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

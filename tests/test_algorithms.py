@@ -1,0 +1,136 @@
+"""Algorithm integration tests — quality-threshold style, the reference's
+signature pattern (deap/tests/test_algorithms.py; SURVEY.md §4.1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deap_tpu import algorithms, ops
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import init_population
+from deap_tpu.core.toolbox import Toolbox
+from deap_tpu.support import hof_best
+from deap_tpu.support.stats import fitness_stats
+
+
+def onemax_toolbox(length=60):
+    tb = Toolbox()
+    tb.register("evaluate", lambda g: g.sum(-1).astype(jnp.float32))
+    tb.register("mate", ops.cx_two_point)
+    tb.register("mutate", ops.mut_flip_bit, indpb=0.05)
+    tb.register("select", ops.sel_tournament, tournsize=3)
+    return tb
+
+
+def test_ea_simple_solves_onemax():
+    # reference config: README.md:74-104 (pop=300, cxpb=.5, mutpb=.2, ngen=40)
+    length = 60
+    tb = onemax_toolbox(length)
+    key = jax.random.key(64)
+    pop = init_population(
+        jax.random.key(1), 300, ops.bernoulli_genome(length), FitnessSpec((1.0,)))
+    stats = fitness_stats()
+    pop, logbook, hof = algorithms.ea_simple(
+        key, pop, tb, cxpb=0.5, mutpb=0.2, ngen=40, stats=stats,
+        halloffame_size=3)
+    best_g, best_f = hof_best(hof)
+    assert float(best_f[0]) >= 0.95 * length
+    assert float(best_f[0]) == float(np.asarray(best_g).sum())
+    # logbook sanity: gen 0..40, nevals full at gen 0
+    assert len(logbook) == 41
+    assert logbook[0]["nevals"] == 300
+    gens = logbook.select("gen")
+    assert gens == list(range(41))
+    maxes = logbook.select("max")
+    assert maxes[-1] >= maxes[0]
+    text = logbook.stream
+    assert "gen" in text.splitlines()[0] and len(text.splitlines()) == 42
+
+
+def test_ea_simple_nevals_counts_touched_only():
+    tb = onemax_toolbox(20)
+    pop = init_population(
+        jax.random.key(2), 100, ops.bernoulli_genome(20), FitnessSpec((1.0,)))
+    _, logbook, _ = algorithms.ea_simple(
+        jax.random.key(0), pop, tb, cxpb=0.0, mutpb=0.0, ngen=3)
+    # no variation → nothing ever re-evaluated after gen 0
+    assert logbook.select("nevals")[1:] == [0, 0, 0]
+
+
+def test_ea_mu_plus_lambda_monotone_best():
+    # elitist (mu+lambda) never loses the best individual
+    length = 40
+    tb = onemax_toolbox(length)
+    pop = init_population(
+        jax.random.key(3), 100, ops.bernoulli_genome(length), FitnessSpec((1.0,)))
+    stats = fitness_stats()
+    pop, logbook, _ = algorithms.ea_mu_plus_lambda(
+        jax.random.key(4), pop, tb, mu=100, lambda_=200, cxpb=0.4, mutpb=0.4,
+        ngen=25, stats=stats)
+    maxes = logbook.select("max")
+    assert all(b >= a - 1e-6 for a, b in zip(maxes, maxes[1:]))
+    assert maxes[-1] >= 0.9 * length
+
+
+def test_ea_mu_comma_lambda_runs():
+    length = 30
+    tb = onemax_toolbox(length)
+    pop = init_population(
+        jax.random.key(5), 50, ops.bernoulli_genome(length), FitnessSpec((1.0,)))
+    pop, logbook, hof = algorithms.ea_mu_comma_lambda(
+        jax.random.key(6), pop, tb, mu=50, lambda_=100, cxpb=0.3, mutpb=0.5,
+        ngen=15, halloffame_size=1)
+    _, best_f = hof_best(hof)
+    assert float(best_f[0]) >= 0.8 * length
+    assert pop.size == 50
+
+
+def test_var_or_reproduction_keeps_fitness():
+    tb = onemax_toolbox(16)
+    pop = init_population(
+        jax.random.key(7), 64, ops.bernoulli_genome(16), FitnessSpec((1.0,)))
+    pop = algorithms.evaluate_invalid(pop, tb.evaluate)
+    # all reproduction: children must carry valid parent fitness
+    off = algorithms.var_or(jax.random.key(8), pop, tb, 64, cxpb=0.0, mutpb=0.0)
+    assert bool(off.valid.all())
+    # all crossover: every child invalid
+    off = algorithms.var_or(jax.random.key(9), pop, tb, 64, cxpb=1.0, mutpb=0.0)
+    assert not bool(off.valid.any())
+
+
+def test_var_and_invalidates_touched():
+    tb = onemax_toolbox(16)
+    pop = init_population(
+        jax.random.key(10), 64, ops.bernoulli_genome(16), FitnessSpec((1.0,)))
+    pop = algorithms.evaluate_invalid(pop, tb.evaluate)
+    off = algorithms.var_and(jax.random.key(11), pop, tb, cxpb=1.0, mutpb=0.0)
+    assert not bool(off.valid.any())
+    off = algorithms.var_and(jax.random.key(12), pop, tb, cxpb=0.0, mutpb=0.0)
+    assert bool(off.valid.all())
+
+
+def test_ea_generate_update_ask_tell():
+    # toy strategy: state = mean vector; generate = mean + noise;
+    # update = mean of top half (a (mu/2, lambda) ES on sphere)
+    spec = FitnessSpec((-1.0,))
+    dim, lam = 8, 64
+
+    def generate(key, state):
+        return state[None, :] + 0.3 * jax.random.normal(key, (lam, dim))
+
+    def update(state, genomes, values):
+        order = jnp.argsort(values[:, 0])
+        return genomes[order[: lam // 8]].mean(0)
+
+    tb = Toolbox()
+    tb.register("generate", generate)
+    tb.register("update", update)
+    tb.register("evaluate", lambda g: (g ** 2).sum(-1))
+
+    state = jnp.full((dim,), 5.0)
+    state, logbook, hof = algorithms.ea_generate_update(
+        jax.random.key(13), state, tb, ngen=60, spec=spec, halloffame_size=1)
+    assert float((state ** 2).sum()) < 0.5
+    _, best = hof_best(hof)
+    assert float(best[0]) < 0.5
+    assert logbook.select("nevals")[0] == lam

@@ -1,0 +1,164 @@
+"""Core semantics tests — counterpart of the reference's creator/Fitness
+unit tests (deap/tests/test_creator.py, base.py:209-250 semantics)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deap_tpu.core import (
+    FitnessSpec,
+    Population,
+    Toolbox,
+    dominates,
+    lex_gt,
+    lex_sort_desc,
+)
+from deap_tpu.core.population import concat, gather, init_population
+
+
+def test_wvalues_sign_convention():
+    spec = FitnessSpec((-1.0, 2.0))
+    w = spec.wvalues(jnp.array([3.0, 4.0]))
+    np.testing.assert_allclose(w, [-3.0, 8.0])
+
+
+def test_dominates_matches_reference_semantics():
+    # minimisation on both objectives: weights (-1, -1)
+    spec = FitnessSpec((-1.0, -1.0))
+    a = spec.wvalues(jnp.array([1.0, 2.0]))
+    b = spec.wvalues(jnp.array([2.0, 2.0]))
+    assert bool(dominates(a, b))
+    assert not bool(dominates(b, a))
+    assert not bool(dominates(a, a))  # equal never dominates
+
+
+def test_dominance_matrix_broadcast():
+    spec = FitnessSpec((-1.0, -1.0))
+    vals = jnp.array([[1.0, 1.0], [2.0, 2.0], [1.0, 3.0]])
+    w = vals * spec.warray
+    m = dominates(w[:, None], w[None, :])
+    expected = np.array(
+        [[False, True, True], [False, False, False], [False, False, False]]
+    )
+    np.testing.assert_array_equal(np.asarray(m), expected)
+
+
+def test_lexicographic_compare():
+    # reference compares wvalues tuples with > (base.py:234-250)
+    a = jnp.array([1.0, 5.0])
+    b = jnp.array([1.0, 4.0])
+    c = jnp.array([2.0, 0.0])
+    assert bool(lex_gt(a, b))
+    assert not bool(lex_gt(b, a))
+    assert bool(lex_gt(c, a))
+    assert not bool(lex_gt(a, a))
+
+
+def test_lex_sort_desc_stable_and_primary_first():
+    w = jnp.array([[1.0, 0.0], [2.0, 0.0], [2.0, 1.0], [0.0, 9.0]])
+    order = lex_sort_desc(w)
+    np.testing.assert_array_equal(np.asarray(order), [2, 1, 0, 3])
+
+
+def test_population_roundtrip_and_masked_fitness():
+    key = jax.random.key(0)
+    spec = FitnessSpec((1.0,))
+    pop = init_population(
+        key, 8, lambda k: jax.random.bernoulli(k, 0.5, (10,)), spec
+    )
+    assert pop.size == 8
+    assert not bool(pop.valid.any())
+
+    vals = jnp.arange(8.0)[:, None]
+    pop = pop.with_fitness(vals)
+    assert bool(pop.valid.all())
+    assert int(pop.best_index()) == 7
+
+    # invalidate half, masked re-assign only touches invalid rows
+    mask = jnp.arange(8) < 4
+    pop = pop.invalidate(mask)
+    assert int(pop.valid.sum()) == 4
+    pop2 = pop.with_fitness(jnp.full((8, 1), 100.0), mask=~pop.valid)
+    np.testing.assert_allclose(np.asarray(pop2.fitness[:4, 0]), 100.0)
+    np.testing.assert_allclose(np.asarray(pop2.fitness[4:, 0]), np.arange(4.0, 8.0))
+    assert bool(pop2.valid.all())
+
+
+def test_invalid_rows_sort_last_and_never_dominate():
+    spec = FitnessSpec((1.0,))
+    pop = Population(
+        genomes=jnp.zeros((3, 2)),
+        fitness=jnp.array([[1.0], [99.0], [2.0]]),
+        valid=jnp.array([True, False, True]),
+        spec=spec,
+    )
+    assert int(pop.best_index()) == 2
+    w = pop.wvalues
+    assert not bool(dominates(w[1], w[0]))
+
+
+def test_gather_and_concat():
+    spec = FitnessSpec((1.0,))
+    pop = Population(
+        genomes={"x": jnp.arange(6.0).reshape(3, 2)},
+        fitness=jnp.arange(3.0)[:, None],
+        valid=jnp.ones(3, bool),
+        extras={"s": jnp.arange(3.0)},
+        spec=spec,
+    )
+    sub = gather(pop, jnp.array([2, 0]))
+    np.testing.assert_allclose(np.asarray(sub.genomes["x"][0]), [4.0, 5.0])
+    np.testing.assert_allclose(np.asarray(sub.extras["s"]), [2.0, 0.0])
+    both = concat([pop, sub])
+    assert both.size == 5
+
+
+def test_population_is_jittable_pytree():
+    spec = FitnessSpec((-1.0,))
+
+    @jax.jit
+    def step(pop):
+        return pop.with_fitness(pop.genomes.sum(-1, keepdims=True))
+
+    pop = Population(
+        genomes=jnp.ones((4, 3)),
+        fitness=jnp.zeros((4, 1)),
+        valid=jnp.zeros(4, bool),
+        spec=spec,
+    )
+    out = step(pop)
+    np.testing.assert_allclose(np.asarray(out.fitness[:, 0]), 3.0)
+    # best under minimisation is any row (all equal) — smoke the wvalues sign
+    assert float(out.wvalues[0, 0]) == -3.0
+
+
+def test_toolbox_register_unregister_decorate():
+    tb = Toolbox()
+
+    def mate(a, b, scale=1.0):
+        """docstring survives"""
+        return (a + b) * scale
+
+    tb.register("mate", mate, scale=2.0)
+    assert tb.mate.__name__ == "mate"
+    assert tb.mate.__doc__ == "docstring survives"
+    assert tb.mate(1, 2) == 6.0
+    assert tb.mate(1, 2, scale=1.0) == 3.0
+
+    def double_result(fn):
+        def wrapper(*args, **kw):
+            return 2 * fn(*args, **kw)
+        return wrapper
+
+    tb.decorate("mate", double_result)
+    assert tb.mate(1, 2) == 12.0  # bound scale=2.0 preserved, then doubled
+
+    tb.unregister("mate")
+    assert not hasattr(tb, "mate")
+
+
+def test_toolbox_defaults():
+    tb = Toolbox()
+    assert list(tb.map(lambda x: x + 1, [1, 2])) == [2, 3]
+    assert tb.clone(5) == 5

@@ -1,0 +1,251 @@
+"""Operator unit tests — exact invariants plus light distributional checks
+(counterpart of the reference's operator doctests, SURVEY.md §4.4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deap_tpu import ops
+from deap_tpu.core.fitness import FitnessSpec
+
+
+KEYS = [jax.random.key(i) for i in range(5)]
+
+
+def _is_permutation(x):
+    return np.array_equal(np.sort(np.asarray(x)), np.arange(len(x)))
+
+
+# ------------------------------------------------------------- crossover ----
+
+def test_cx_one_point_swaps_tails():
+    a = jnp.zeros(10, jnp.int32)
+    b = jnp.ones(10, jnp.int32)
+    c1, c2 = ops.cx_one_point(KEYS[0], a, b)
+    c1, c2 = np.asarray(c1), np.asarray(c2)
+    # complementary children; single switch point in [1, L-1]
+    assert (c1 + c2 == 1).all()
+    switches = np.count_nonzero(np.diff(c1))
+    assert switches == 1
+    assert c1[0] == 0 and c2[0] == 1
+
+
+def test_cx_two_point_swaps_segment():
+    a = jnp.zeros(12, jnp.int32)
+    b = jnp.ones(12, jnp.int32)
+    c1, c2 = ops.cx_two_point(KEYS[1], a, b)
+    c1 = np.asarray(c1)
+    assert (c1 + np.asarray(c2) == 1).all()
+    assert np.count_nonzero(np.diff(c1)) in (1, 2)  # segment may touch the end
+    assert c1[0] == 0  # segment starts at >= 1
+
+
+def test_cx_uniform_only_swaps():
+    a = jnp.arange(50)
+    b = jnp.arange(50) + 100
+    c1, c2 = ops.cx_uniform(KEYS[2], a, b, indpb=0.5)
+    swapped = np.asarray(c1 != a)
+    assert swapped.any() and not swapped.all()
+    np.testing.assert_array_equal(np.asarray(c1 + c2), np.asarray(a + b))
+
+
+@pytest.mark.parametrize("cx", [ops.cx_partialy_matched, ops.cx_ordered])
+def test_permutation_crossovers_preserve_permutation(cx):
+    for key in KEYS:
+        k1, k2 = jax.random.split(key)
+        a = jax.random.permutation(k1, 12).astype(jnp.int32)
+        b = jax.random.permutation(k2, 12).astype(jnp.int32)
+        c1, c2 = cx(key, a, b)
+        assert _is_permutation(c1), cx.__name__
+        assert _is_permutation(c2), cx.__name__
+
+
+def test_cx_upmx_preserves_permutation():
+    for key in KEYS:
+        k1, k2 = jax.random.split(key)
+        a = jax.random.permutation(k1, 15).astype(jnp.int32)
+        b = jax.random.permutation(k2, 15).astype(jnp.int32)
+        c1, c2 = ops.cx_uniform_partialy_matched(key, a, b, indpb=0.4)
+        assert _is_permutation(c1) and _is_permutation(c2)
+
+
+def test_cx_ordered_keeps_other_parents_segment():
+    # with identical parents OX must be identity
+    a = jnp.arange(10, dtype=jnp.int32)
+    c1, c2 = ops.cx_ordered(KEYS[0], a, a)
+    np.testing.assert_array_equal(np.asarray(c1), np.asarray(a))
+    np.testing.assert_array_equal(np.asarray(c2), np.asarray(a))
+
+
+def test_cx_blend_and_sbx_mean_preserving():
+    a = jnp.array([1.0, 2.0, 3.0])
+    b = jnp.array([5.0, 6.0, 7.0])
+    c1, c2 = ops.cx_blend(KEYS[3], a, b, alpha=0.5)
+    np.testing.assert_allclose(np.asarray(c1 + c2), np.asarray(a + b), rtol=1e-5)
+    c1, c2 = ops.cx_simulated_binary(KEYS[3], a, b, eta=15.0)
+    np.testing.assert_allclose(np.asarray(c1 + c2), np.asarray(a + b), rtol=1e-5)
+
+
+def test_cx_sbx_bounded_respects_bounds():
+    key = KEYS[4]
+    a = jax.random.uniform(KEYS[0], (30,), minval=-3.0, maxval=3.0)
+    b = jax.random.uniform(KEYS[1], (30,), minval=-3.0, maxval=3.0)
+    c1, c2 = ops.cx_simulated_binary_bounded(key, a, b, eta=20.0, low=-3.0, up=3.0)
+    assert float(jnp.max(jnp.abs(c1))) <= 3.0 + 1e-6
+    assert float(jnp.max(jnp.abs(c2))) <= 3.0 + 1e-6
+    # multiset of genes preserved where untouched: every gene of child is
+    # produced from the same gene slot of the parents
+    touched = np.asarray((c1 != a) | (c2 != b))
+    assert touched.any()
+
+
+def test_cx_messy_one_point_lengths():
+    g1 = jnp.arange(1, 7, dtype=jnp.int32)  # len 6 of cap 10
+    g1 = jnp.pad(g1, (0, 4))
+    g2 = jnp.arange(101, 105, dtype=jnp.int32)  # len 4 of cap 10
+    g2 = jnp.pad(g2, (0, 6))
+    (c1, n1), (c2, n2) = ops.cx_messy_one_point(KEYS[2], g1, 6, g2, 4)
+    n1, n2 = int(n1), int(n2)
+    c1, c2 = np.asarray(c1), np.asarray(c2)
+    assert (c1[n1:] == 0).all() and (c2[n2:] == 0).all()
+    assert n1 + n2 == 10  # total genes conserved (no truncation here)
+
+
+def test_cx_es_variants():
+    a, sa = jnp.zeros(8), jnp.full(8, 0.5)
+    b, sb = jnp.ones(8), jnp.full(8, 2.0)
+    (c1, s1), (c2, s2) = ops.cx_es_two_point(KEYS[0], a, sa, b, sb)
+    # same points for values and strategies
+    np.testing.assert_array_equal(np.asarray(c1 == b), np.asarray(s1 == sb))
+    (c1, s1), (c2, s2) = ops.cx_es_blend(KEYS[1], a, sa, b, sb, alpha=0.1)
+    np.testing.assert_allclose(np.asarray(s1 + s2), 2.5, rtol=1e-5)
+
+
+# -------------------------------------------------------------- mutation ----
+
+def test_mut_gaussian_masks():
+    g = jnp.zeros(1000)
+    out = ops.mut_gaussian(KEYS[0], g, mu=0.0, sigma=1.0, indpb=0.1)
+    frac = float((out != 0).mean())
+    assert 0.05 < frac < 0.2
+
+
+def test_mut_flip_bit():
+    g = jnp.zeros(1000, dtype=bool)
+    out = ops.mut_flip_bit(KEYS[1], g, indpb=0.05)
+    frac = float(out.mean())
+    assert 0.01 < frac < 0.12
+
+
+def test_mut_uniform_int_bounds():
+    g = jnp.zeros(500, jnp.int32)
+    out = ops.mut_uniform_int(KEYS[2], g, low=2, up=5, indpb=1.0)
+    o = np.asarray(out)
+    assert o.min() >= 2 and o.max() <= 5
+    assert set(np.unique(o)) == {2, 3, 4, 5}
+
+
+def test_mut_polynomial_bounded_in_bounds():
+    g = jax.random.uniform(KEYS[0], (200,), minval=-3.0, maxval=3.0)
+    out = ops.mut_polynomial_bounded(KEYS[3], g, eta=20.0, low=-3.0, up=3.0, indpb=1.0)
+    assert float(jnp.max(jnp.abs(out))) <= 3.0 + 1e-6
+    assert bool(jnp.any(out != g))
+
+
+def test_mut_shuffle_preserves_multiset():
+    g = jnp.arange(20, dtype=jnp.int32)
+    out = ops.mut_shuffle_indexes(KEYS[4], g, indpb=0.5)
+    assert _is_permutation(out)
+    assert bool(jnp.any(out != g))
+
+
+def test_mut_es_log_normal():
+    g = jnp.zeros(16)
+    s = jnp.full(16, 1.0)
+    g2, s2 = ops.mut_es_log_normal(KEYS[0], g, s, c=1.0, indpb=1.0)
+    assert bool(jnp.all(s2 > 0))
+    assert bool(jnp.any(g2 != 0))
+    # strategy floor decorator
+    floored = ops.strategy_floor(0.9)(ops.mut_es_log_normal)
+    _, s3 = floored(KEYS[1], g, s, c=1.0, indpb=1.0)
+    assert float(jnp.min(s3)) >= 0.9 - 1e-6
+
+
+# ------------------------------------------------------------- selection ----
+
+def _w(values, weights=(1.0,)):
+    spec = FitnessSpec(weights)
+    v = jnp.asarray(values, jnp.float32)
+    if v.ndim == 1:
+        v = v[:, None]
+    return v * spec.warray
+
+
+def test_sel_best_worst():
+    w = _w([3.0, 1.0, 2.0, 5.0])
+    np.testing.assert_array_equal(np.asarray(ops.sel_best(None, w, 2)), [3, 0])
+    np.testing.assert_array_equal(np.asarray(ops.sel_worst(None, w, 2)), [1, 2])
+
+
+def test_sel_tournament_pressure():
+    w = _w(jnp.arange(100.0))
+    idx = ops.sel_tournament(KEYS[0], w, 1000, tournsize=3)
+    assert float(jnp.mean(idx)) > 60  # max of 3 uniform draws ≈ 74 mean
+
+
+def test_sel_roulette_proportionate():
+    w = _w([1.0, 1.0, 8.0])
+    idx = np.asarray(ops.sel_roulette(KEYS[1], w, 2000))
+    frac2 = (idx == 2).mean()
+    assert 0.7 < frac2 < 0.9
+
+
+def test_sel_sus_spread():
+    w = _w(jnp.ones(10))
+    idx = np.asarray(ops.sel_stochastic_universal_sampling(KEYS[2], w, 10))
+    # equal fitness → every individual picked exactly once
+    assert sorted(idx.tolist()) == list(range(10))
+
+
+def test_sel_double_tournament_parsimony():
+    # equal fitness → pure parsimony pressure toward short genomes
+    w = _w(jnp.ones(50))
+    lengths = jnp.arange(50.0)
+    idx = ops.sel_double_tournament(
+        KEYS[3], w, lengths, 500, fitness_size=2, parsimony_size=2.0,
+        fitness_first=True)
+    assert float(jnp.mean(jnp.take(lengths, idx))) < 20.0
+
+
+def test_sel_lexicase_elite_always_wins():
+    # individual 0 strictly best on every case (minimisation)
+    values = jnp.array([[0.0, 0.0], [1.0, 2.0], [2.0, 1.0]])
+    idx = ops.sel_lexicase(KEYS[4], values, weights=jnp.array([-1.0, -1.0]), k=20)
+    assert set(np.asarray(idx).tolist()) == {0}
+
+
+def test_sel_epsilon_lexicase():
+    values = jnp.array([[0.0, 0.0], [0.05, 0.05], [5.0, 5.0]])
+    idx = ops.sel_epsilon_lexicase(
+        KEYS[0], values, weights=jnp.array([-1.0, -1.0]), k=40, epsilon=0.1)
+    picked = set(np.asarray(idx).tolist())
+    assert 2 not in picked and picked <= {0, 1} and len(picked) == 2
+
+
+def test_sel_automatic_epsilon_lexicase():
+    values = jnp.array([[0.0], [0.01], [0.02], [10.0]])
+    idx = ops.sel_automatic_epsilon_lexicase(
+        KEYS[1], values, weights=jnp.array([-1.0]), k=30)
+    assert 3 not in set(np.asarray(idx).tolist())
+
+
+def test_batched_helpers():
+    key = KEYS[0]
+    G1 = jnp.zeros((6, 8), jnp.int32)
+    G2 = jnp.ones((6, 8), jnp.int32)
+    c1, c2 = ops.pair_vmap(ops.cx_two_point)(key, G1, G2)
+    assert c1.shape == (6, 8)
+    np.testing.assert_array_equal(np.asarray(c1 + c2), 1)
+    out = ops.genome_vmap(ops.mut_flip_bit)(key, G1.astype(bool), indpb=0.3)
+    assert out.shape == (6, 8)

@@ -1,0 +1,139 @@
+"""Support-object tests — Statistics exact outputs, Logbook formatting,
+HallOfFame/ParetoArchive semantics (counterpart of test_statistics.py,
+test_logbook.py and HallOfFame behaviour in the reference)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import Population
+from deap_tpu.support import (
+    HallOfFame,
+    Logbook,
+    MultiStatistics,
+    Statistics,
+    hof_best,
+    hof_init,
+    hof_update,
+    pareto_init,
+    pareto_update,
+)
+
+
+def _pop(values, genomes=None, weights=(1.0,)):
+    v = jnp.asarray(values, jnp.float32)
+    if v.ndim == 1:
+        v = v[:, None]
+    n = v.shape[0]
+    if genomes is None:
+        genomes = jnp.arange(n, dtype=jnp.float32)[:, None] * jnp.ones((1, 3))
+    return Population(
+        genomes=jnp.asarray(genomes), fitness=v, valid=jnp.ones(n, bool),
+        spec=FitnessSpec(weights))
+
+
+def test_statistics_exact_values():
+    # counterpart of deap/tests/test_statistics.py exact-dict assertions
+    stats = Statistics(key=lambda pop: pop.fitness[:, 0])
+    stats.register("avg", jnp.mean)
+    stats.register("max", jnp.max)
+    res = stats.compile(_pop([1.0, 2.0, 3.0, 4.0]))
+    assert float(res["avg"]) == 2.5
+    assert float(res["max"]) == 4.0
+
+
+def test_multistatistics_chapters():
+    s1 = Statistics(key=lambda pop: pop.fitness[:, 0])
+    s2 = Statistics(key=lambda pop: pop.genomes.sum(-1))
+    ms = MultiStatistics(fitness=s1, size=s2)
+    ms.register("avg", jnp.mean)
+    res = ms.compile(_pop([2.0, 4.0]))
+    assert set(res.keys()) == {"fitness", "size"}
+    assert float(res["fitness"]["avg"]) == 3.0
+
+
+def test_logbook_chapters_stream():
+    # counterpart of deap/tests/test_logbook.py smoke formatting
+    logbook = Logbook()
+    logbook.header = ["gen", "fitness", "size"]
+    logbook.record(gen=0, fitness={"avg": 1.0, "max": 2.0}, size={"avg": 3.0})
+    logbook.record(gen=1, fitness={"avg": 1.5, "max": 2.5}, size={"avg": 2.0})
+    text = str(logbook)
+    assert "fitness" in text and "size" in text and "avg" in text
+    assert logbook.chapters["fitness"].select("avg") == [1.0, 1.5]
+    # stream is incremental
+    lb2 = Logbook()
+    lb2.record(a=1)
+    first = lb2.stream
+    lb2.record(a=2)
+    second = lb2.stream
+    assert "1" in first and "2" in second and "1" not in second.splitlines()[-1]
+
+
+def test_hof_tracks_best_and_dedups():
+    pop = _pop([3.0, 1.0, 3.0, 5.0],
+               genomes=jnp.array([[1.0], [2.0], [1.0], [3.0]]))
+    hof = hof_init(3, pop)
+    hof = hof_update(hof, pop)
+    assert bool(hof.filled.all())
+    # duplicate genome (1.0) at fitness 3.0 appears once; the third slot
+    # falls through to the genuinely-next individual (fitness 1.0)
+    np.testing.assert_allclose(np.asarray(hof.fitness[:, 0]), [5.0, 3.0, 1.0])
+    g = np.asarray(hof.genomes[:, 0])
+    assert g[0] == 3.0 and set(g[1:]) == {1.0, 2.0}
+
+    # updating with a worse population changes nothing
+    worse = _pop([0.5, 0.2], genomes=jnp.array([[9.0], [8.0]]))
+    hof2 = hof_update(hof, worse)
+    np.testing.assert_allclose(np.asarray(hof2.fitness), np.asarray(hof.fitness))
+
+    # a new best displaces the tail
+    better = _pop([7.0], genomes=jnp.array([[4.0]]))
+    hof3 = hof_update(hof2, better)
+    np.testing.assert_allclose(np.asarray(hof3.fitness[:, 0]), [7.0, 5.0, 3.0])
+    bg, bf = hof_best(hof3)
+    assert float(bf[0]) == 7.0 and float(bg[0]) == 4.0
+
+
+def test_hof_update_inside_jit():
+    pop = _pop([1.0, 2.0])
+    hof = hof_init(2, pop)
+
+    @jax.jit
+    def f(hof, pop):
+        return hof_update(hof, pop)
+
+    out = f(hof, pop)
+    assert float(out.fitness[0, 0]) == 2.0
+
+
+def test_pareto_archive_keeps_nondominated():
+    # two-objective minimisation
+    pop = _pop(
+        jnp.array([[1.0, 4.0], [2.0, 2.0], [4.0, 1.0], [3.0, 3.0], [1.0, 4.0]]),
+        genomes=jnp.array([[1.0], [2.0], [3.0], [4.0], [5.0]]),
+        weights=(-1.0, -1.0))
+    arch = pareto_init(8, pop)
+    arch = pareto_update(arch, pop)
+    filled = np.asarray(arch.filled)
+    fits = np.asarray(arch.fitness)[filled]
+    # [3,3] dominated by [2,2]; one duplicate [1,4] genome 5 dropped? No —
+    # distinct genomes with equal fitness both stay (neither dominates).
+    assert filled.sum() == 4
+    assert [3.0, 3.0] not in fits.tolist()
+    # a new dominating point evicts dominated members
+    better = _pop(jnp.array([[0.5, 0.5]]), genomes=jnp.array([[6.0]]),
+                  weights=(-1.0, -1.0))
+    arch2 = pareto_update(arch, better)
+    filled2 = np.asarray(arch2.filled)
+    assert filled2.sum() == 1
+    np.testing.assert_allclose(np.asarray(arch2.fitness[0]), [0.5, 0.5])
+
+
+def test_pareto_archive_dedups_equal_genomes():
+    pop = _pop(jnp.array([[1.0, 1.0], [1.0, 1.0]]),
+               genomes=jnp.array([[1.0], [1.0]]), weights=(-1.0, -1.0))
+    arch = pareto_init(4, pop)
+    arch = pareto_update(arch, pop)
+    assert int(np.asarray(arch.filled).sum()) == 1
