@@ -150,11 +150,12 @@ def ea_simple(key: jax.Array, pop: Population, toolbox, cxpb: float,
     generations as one compiled program.
     """
     kscan = key
+    nevals0 = jnp.sum(~pop.valid)  # like the reference's len(invalid_ind)
     pop = evaluate_invalid(pop, toolbox.evaluate)
     hof = hof_init(halloffame_size, pop) if halloffame_size else None
     if hof is not None:
         hof = hof_update(hof, pop)
-    record0 = {"nevals": pop.size, **_maybe_stats(stats, pop)}
+    record0 = {"nevals": nevals0, **_maybe_stats(stats, pop)}
 
     def step(carry, key):
         pop, hof = carry
@@ -206,11 +207,12 @@ def ea_mu_plus_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
     assert cxpb + mutpb <= 1.0, (
         "The sum of the crossover and mutation probabilities must be <= 1.0.")
     kscan = key
+    nevals0 = jnp.sum(~pop.valid)  # like the reference's len(invalid_ind)
     pop = evaluate_invalid(pop, toolbox.evaluate)
     hof = hof_init(halloffame_size, pop) if halloffame_size else None
     if hof is not None:
         hof = hof_update(hof, pop)
-    record0 = {"nevals": pop.size, **_maybe_stats(stats, pop)}
+    record0 = {"nevals": nevals0, **_maybe_stats(stats, pop)}
 
     def step(carry, key):
         pop, hof = carry
@@ -242,11 +244,12 @@ def ea_mu_comma_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
     assert cxpb + mutpb <= 1.0, (
         "The sum of the crossover and mutation probabilities must be <= 1.0.")
     kscan = key
+    nevals0 = jnp.sum(~pop.valid)  # like the reference's len(invalid_ind)
     pop = evaluate_invalid(pop, toolbox.evaluate)
     hof = hof_init(halloffame_size, pop) if halloffame_size else None
     if hof is not None:
         hof = hof_update(hof, pop)
-    record0 = {"nevals": pop.size, **_maybe_stats(stats, pop)}
+    record0 = {"nevals": nevals0, **_maybe_stats(stats, pop)}
 
     def step(carry, key):
         pop, hof = carry

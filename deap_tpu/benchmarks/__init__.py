@@ -1,0 +1,309 @@
+"""Benchmark objective functions — jnp ports of the reference suite.
+
+Counterpart of /root/reference/deap/benchmarks/__init__.py (single-
+objective :26-362, multi-objective :364-688). Convention: every function
+takes one genome ``x: f32[n_dims]`` and returns ``f32[nobj]`` — batch
+over a population with ``jax.vmap(fn)`` (or register directly:
+``toolbox.register("evaluate", jax.vmap(benchmarks.rastrigin))``).
+All are pure jnp and fuse into the generation step under jit.
+
+Weights conventions match the reference docs (minimisation for most,
+h1/shekel maximisation; kursawe/zdt*/dtlz* multi-objective
+minimisation).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu.benchmarks import binary, gp, movingpeaks, tools  # noqa: F401
+
+# ------------------------------------------------------------ unimodal ----
+
+def rand(key, individual):
+    """Random "fitness" (benchmarks/__init__.py:26-42). Unlike the rest,
+    needs an explicit PRNG key."""
+    del individual
+    return jax.random.uniform(key, (1,))
+
+
+def plane(x):
+    """f = x_0 (minimisation, :44-60)."""
+    return x[:1]
+
+
+def sphere(x):
+    """f = Σ x_i² (:62-78)."""
+    return jnp.sum(x * x, keepdims=True)
+
+
+def cigar(x):
+    """f = x_0² + 1e6 Σ_{i>0} x_i² (:80-96)."""
+    return (x[0] ** 2 + 1e6 * jnp.sum(x[1:] ** 2))[None]
+
+
+def rosenbrock(x):
+    """f = Σ 100(x_i² - x_{i+1})² + (1 - x_i)² (:98-117; note the
+    reference's (x²-y)² form)."""
+    a, b = x[:-1], x[1:]
+    return jnp.sum(100.0 * (a * a - b) ** 2 + (1.0 - a) ** 2, keepdims=True)
+
+
+def h1(x):
+    """2-D multimodal maximisation, optimum 2 at (8.6998, 6.7665)
+    (:120-146)."""
+    num = jnp.sin(x[0] - x[1] / 8.0) ** 2 + jnp.sin(x[1] + x[0] / 8.0) ** 2
+    den = jnp.sqrt((x[0] - 8.6998) ** 2 + (x[1] - 6.7665) ** 2) + 1.0
+    return (num / den)[None]
+
+
+# ----------------------------------------------------------- multimodal ----
+
+def ackley(x):
+    """Ackley (:150-171), optimum 0 at origin."""
+    n = x.shape[0]
+    return (20.0 - 20.0 * jnp.exp(-0.2 * jnp.sqrt(jnp.mean(x * x)))
+            + math.e - jnp.exp(jnp.mean(jnp.cos(2.0 * jnp.pi * x))))[None]
+
+
+def bohachevsky(x):
+    """Bohachevsky (:174-194)."""
+    a, b = x[:-1], x[1:]
+    return jnp.sum(a ** 2 + 2.0 * b ** 2
+                   - 0.3 * jnp.cos(3.0 * jnp.pi * a)
+                   - 0.4 * jnp.cos(4.0 * jnp.pi * b) + 0.7, keepdims=True)
+
+
+def griewank(x):
+    """Griewank (:197-217)."""
+    i = jnp.arange(1, x.shape[0] + 1, dtype=x.dtype)
+    return (jnp.sum(x * x) / 4000.0
+            - jnp.prod(jnp.cos(x / jnp.sqrt(i))) + 1.0)[None]
+
+
+def rastrigin(x):
+    """Rastrigin (:220-239), optimum 0 at origin."""
+    return (10.0 * x.shape[0]
+            + jnp.sum(x * x - 10.0 * jnp.cos(2.0 * jnp.pi * x)))[None]
+
+
+def rastrigin_scaled(x):
+    """Scaled Rastrigin (:242-251)."""
+    n = x.shape[0]
+    i = jnp.arange(n, dtype=x.dtype)
+    s = 10.0 ** (i / (n - 1))
+    return (10.0 * n + jnp.sum((s * x) ** 2
+                               - 10.0 * jnp.cos(2.0 * jnp.pi * s * x)))[None]
+
+
+def rastrigin_skew(x):
+    """Skewed Rastrigin (:253-265)."""
+    y = jnp.where(x > 0, 10.0 * x, x)
+    return (10.0 * x.shape[0]
+            + jnp.sum(y * y - 10.0 * jnp.cos(2.0 * jnp.pi * y)))[None]
+
+
+def schaffer(x):
+    """Schaffer (:267-288)."""
+    a, b = x[:-1], x[1:]
+    s = a * a + b * b
+    return jnp.sum(s ** 0.25 * (jnp.sin(50.0 * s ** 0.1) ** 2 + 1.0),
+                   keepdims=True)
+
+
+def schwefel(x):
+    """Schwefel (:291-313), optimum 0 at 420.96874636..."""
+    return (418.9828872724339 * x.shape[0]
+            - jnp.sum(x * jnp.sin(jnp.sqrt(jnp.abs(x)))))[None]
+
+
+def himmelblau(x):
+    """Himmelblau (:315-338), four optima at value 0."""
+    return ((x[0] ** 2 + x[1] - 11.0) ** 2
+            + (x[0] + x[1] ** 2 - 7.0) ** 2)[None]
+
+
+def shekel(x, a, c):
+    """Shekel foxholes maximisation (:341-361). ``a``: [M, N] maxima
+    locations, ``c``: [M] widths."""
+    a = jnp.asarray(a, x.dtype)
+    c = jnp.asarray(c, x.dtype)
+    d = jnp.sum((x[None, :] - a) ** 2, axis=1)
+    return jnp.sum(1.0 / (c + d), keepdims=True)
+
+
+# -------------------------------------------------------- multi-objective ----
+
+def kursawe(x):
+    """Kursawe 2-obj (:364-376)."""
+    a, b = x[:-1], x[1:]
+    f1 = jnp.sum(-10.0 * jnp.exp(-0.2 * jnp.sqrt(a * a + b * b)))
+    f2 = jnp.sum(jnp.abs(x) ** 0.8 + 5.0 * jnp.sin(x ** 3))
+    return jnp.stack([f1, f2])
+
+
+def schaffer_mo(x):
+    """Schaffer's 2-obj on one attribute (:379-389)."""
+    return jnp.stack([x[0] ** 2, (x[0] - 2.0) ** 2])
+
+
+def _zdt_g(x):
+    return 1.0 + 9.0 * jnp.sum(x[1:]) / (x.shape[0] - 1)
+
+
+def zdt1(x):
+    """ZDT1 (:391-403)."""
+    g = _zdt_g(x)
+    f1 = x[0]
+    return jnp.stack([f1, g * (1.0 - jnp.sqrt(f1 / g))])
+
+
+def zdt2(x):
+    """ZDT2 (:405-419)."""
+    g = _zdt_g(x)
+    f1 = x[0]
+    return jnp.stack([f1, g * (1.0 - (f1 / g) ** 2)])
+
+
+def zdt3(x):
+    """ZDT3 (:421-435)."""
+    g = _zdt_g(x)
+    f1 = x[0]
+    return jnp.stack([
+        f1,
+        g * (1.0 - jnp.sqrt(f1 / g) - f1 / g * jnp.sin(10.0 * jnp.pi * f1))])
+
+
+def zdt4(x):
+    """ZDT4 (:437-450)."""
+    g = (1.0 + 10.0 * (x.shape[0] - 1)
+         + jnp.sum(x[1:] ** 2 - 10.0 * jnp.cos(4.0 * jnp.pi * x[1:])))
+    f1 = x[0]
+    return jnp.stack([f1, g * (1.0 - jnp.sqrt(f1 / g))])
+
+
+def zdt6(x):
+    """ZDT6 (:452-465)."""
+    g = 1.0 + 9.0 * (jnp.sum(x[1:]) / (x.shape[0] - 1)) ** 0.25
+    f1 = 1.0 - jnp.exp(-4.0 * x[0]) * jnp.sin(6.0 * jnp.pi * x[0]) ** 6
+    return jnp.stack([f1, g * (1.0 - (f1 / g) ** 2)])
+
+
+def dtlz1(x, obj):
+    """DTLZ1 (:467-493); returns ``obj`` objectives."""
+    xm = x[obj - 1:]
+    g = 100.0 * (xm.shape[0] + jnp.sum(
+        (xm - 0.5) ** 2 - jnp.cos(20.0 * jnp.pi * (xm - 0.5))))
+    xc = x[: obj - 1]
+    # f_0 = 0.5 Π xc (1+g); f_k = 0.5 Π xc[:m] (1 - xc[m]) (1+g)
+    cum = jnp.concatenate([jnp.ones(1, x.dtype), jnp.cumprod(xc)])  # [obj]
+    fs = [0.5 * cum[obj - 1] * (1.0 + g)]
+    for m in range(obj - 2, -1, -1):
+        fs.append(0.5 * cum[m] * (1.0 - xc[m]) * (1.0 + g))
+    return jnp.stack(fs)
+
+
+def _dtlz_spherical(x, obj, g, transform=lambda t: t):
+    xc = transform(x[: obj - 1])
+    cosc = jnp.cos(0.5 * jnp.pi * xc)
+    cum = jnp.concatenate([jnp.ones(1, x.dtype), jnp.cumprod(cosc)])  # [obj]
+    fs = [(1.0 + g) * cum[obj - 1]]
+    for m in range(obj - 2, -1, -1):
+        fs.append((1.0 + g) * cum[m] * jnp.sin(0.5 * jnp.pi * xc[m]))
+    return jnp.stack(fs)
+
+
+def dtlz2(x, obj):
+    """DTLZ2 (:495-521)."""
+    g = jnp.sum((x[obj - 1:] - 0.5) ** 2)
+    return _dtlz_spherical(x, obj, g)
+
+
+def dtlz3(x, obj):
+    """DTLZ3 (:523-548): DTLZ2 geometry with the Rastrigin-like g."""
+    xm = x[obj - 1:]
+    g = 100.0 * (xm.shape[0] + jnp.sum(
+        (xm - 0.5) ** 2 - jnp.cos(20.0 * jnp.pi * (xm - 0.5))))
+    return _dtlz_spherical(x, obj, g)
+
+
+def dtlz4(x, obj, alpha):
+    """DTLZ4 (:550-577): DTLZ2 with meta-variable mapping x→x^alpha."""
+    g = jnp.sum((x[obj - 1:] - 0.5) ** 2)
+    return _dtlz_spherical(x, obj, g, transform=lambda t: t ** alpha)
+
+
+def _dtlz_theta(x, n_objs, g):
+    """Shared DTLZ5/6 geometry (:579-617): first angle is x_0 directly,
+    the rest pass through theta(.)"""
+    theta = jnp.pi / (4.0 * (1.0 + g)) * (1.0 + 2.0 * g * x)
+    c0 = jnp.cos(0.5 * jnp.pi * x[0])
+    s0 = jnp.sin(0.5 * jnp.pi * x[0])
+    cos_t = jnp.cos(theta)
+    # cumulative products of cos(theta(x_1..x_k))
+    cum = jnp.concatenate(
+        [jnp.ones(1, x.dtype), jnp.cumprod(cos_t[1:])])
+    fs = [(1.0 + g) * c0 * cum[x.shape[0] - 1]]
+    for m in range(n_objs - 1, 0, -1):
+        if m == 1:
+            fs.append((1.0 + g) * s0)
+        else:
+            fs.append((1.0 + g) * c0 * cum[m - 2]
+                      * jnp.sin(theta[m - 1]))
+    return jnp.stack(fs)
+
+
+def dtlz5(x, n_objs):
+    """DTLZ5 (:579-597)."""
+    g = jnp.sum((x[n_objs - 1:] - 0.5) ** 2)
+    return _dtlz_theta(x, n_objs, g)
+
+
+def dtlz6(x, n_objs):
+    """DTLZ6 (:599-617): DTLZ5 with g = Σ x_i^0.1."""
+    g = jnp.sum(x[n_objs - 1:] ** 0.1)
+    return _dtlz_theta(x, n_objs, g)
+
+
+def dtlz7(x, n_objs):
+    """DTLZ7 (:619-628)."""
+    tail = x[n_objs - 1:]
+    g = 1.0 + 9.0 / tail.shape[0] * jnp.sum(tail)
+    head = x[: n_objs - 1]
+    last = (1.0 + g) * (n_objs - jnp.sum(
+        head / (1.0 + g) * (1.0 + jnp.sin(3.0 * jnp.pi * head))))
+    return jnp.concatenate([head, last[None]])
+
+
+def fonseca(x):
+    """Fonseca-Fleming 2-obj (:630-643), 3 attributes."""
+    inv_sqrt = 1.0 / jnp.sqrt(3.0)
+    f1 = 1.0 - jnp.exp(-jnp.sum((x[:3] - inv_sqrt) ** 2))
+    f2 = 1.0 - jnp.exp(-jnp.sum((x[:3] + inv_sqrt) ** 2))
+    return jnp.stack([f1, f2])
+
+
+def poloni(x):
+    """Poloni 2-obj maximisation (:645-668)."""
+    a1 = (0.5 * jnp.sin(1.0) - 2.0 * jnp.cos(1.0)
+          + jnp.sin(2.0) - 1.5 * jnp.cos(2.0))
+    a2 = (1.5 * jnp.sin(1.0) - jnp.cos(1.0)
+          + 2.0 * jnp.sin(2.0) - 0.5 * jnp.cos(2.0))
+    b1 = (0.5 * jnp.sin(x[0]) - 2.0 * jnp.cos(x[0])
+          + jnp.sin(x[1]) - 1.5 * jnp.cos(x[1]))
+    b2 = (1.5 * jnp.sin(x[0]) - jnp.cos(x[0])
+          + 2.0 * jnp.sin(x[1]) - 0.5 * jnp.cos(x[1]))
+    return jnp.stack([1.0 + (a1 - b1) ** 2 + (a2 - b2) ** 2,
+                      (x[0] + 3.0) ** 2 + (x[1] + 1.0) ** 2])
+
+
+def dent(x, lambda_: float = 0.85):
+    """Dent 2-obj (:670-687)."""
+    d = lambda_ * jnp.exp(-((x[0] - x[1]) ** 2))
+    s = jnp.sqrt(1.0 + (x[0] + x[1]) ** 2) + jnp.sqrt(1.0 + (x[0] - x[1]) ** 2)
+    f1 = 0.5 * (s + x[0] - x[1]) + d
+    f2 = 0.5 * (s - x[0] + x[1]) + d
+    return jnp.stack([f1, f2])

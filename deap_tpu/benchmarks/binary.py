@@ -1,0 +1,112 @@
+"""Binary/deceptive benchmark functions and the genotype-decode decorator.
+
+Counterpart of /root/reference/deap/benchmarks/binary.py: ``bin2float``
+(:20-41), trap/inv_trap (:44-59), chuang_f1/f2/f3, royal_road1/2. All
+operate on a bit genome ``x: {0,1}[L]`` (bool or int) and vectorise the
+reference's string-conversion loops into reshapes + dot products with
+powers of two.
+"""
+
+from __future__ import annotations
+
+from functools import wraps
+
+import jax.numpy as jnp
+
+
+def bin2float(min_, max_, nbits):
+    """Decorator: decode a bit genome into ``L // nbits`` floats in
+    [min_, max_] before calling the wrapped evaluation (binary.py:20-41).
+    """
+    def wrap(function):
+        @wraps(function)
+        def wrapped(individual, *args, **kwargs):
+            bits = individual.astype(jnp.float32)
+            nelem = bits.shape[0] // nbits
+            chunks = bits[: nelem * nbits].reshape(nelem, nbits)
+            weights = 2.0 ** jnp.arange(nbits - 1, -1, -1, dtype=jnp.float32)
+            gene = chunks @ weights
+            decoded = min_ + gene / (2.0 ** nbits - 1.0) * (max_ - min_)
+            return function(decoded, *args, **kwargs)
+        return wrapped
+    return wrap
+
+
+def _trap_window(u, k):
+    """trap on a window with unitation u of size k (binary.py:44-51)."""
+    return jnp.where(u == k, jnp.asarray(k, jnp.float32), k - 1.0 - u)
+
+
+def _inv_trap_window(u, k):
+    """inverse trap (binary.py:54-59)."""
+    return jnp.where(u == 0, jnp.asarray(k, jnp.float32), u - 1.0)
+
+
+def trap(x):
+    u = jnp.sum(x.astype(jnp.float32))
+    return _trap_window(u, x.shape[0])[None]
+
+
+def inv_trap(x):
+    u = jnp.sum(x.astype(jnp.float32))
+    return _inv_trap_window(u, x.shape[0])[None]
+
+
+def _windowed_unitation(x, width):
+    n = (x.shape[0] // width) * width
+    return jnp.sum(x[:n].astype(jnp.float32).reshape(-1, width), axis=1)
+
+
+def chuang_f1(x):
+    """Chuang & Hsu deceptive f1 (binary.py:65-77): 40+1 bits; last bit
+    selects trap vs inv_trap over ten 4-bit windows."""
+    u = _windowed_unitation(x[:-1], 4)
+    t = jnp.sum(_trap_window(u, 4))
+    i = jnp.sum(_inv_trap_window(u, 4))
+    return jnp.where(x[-1] == 0, i, t)[None]
+
+
+def chuang_f2(x):
+    """Chuang & Hsu f2 (binary.py:80-99): 40+2 bits; last two bits select
+    trap/inv_trap per 4-bit half of each 8-bit window."""
+    body = x[:-2]
+    u = _windowed_unitation(body, 4)          # [10] windows of 4
+    first = u[0::2]
+    second = u[1::2]
+    b0, b1 = x[-2], x[-1]
+    f_first = jnp.where(b0 == 0, jnp.sum(_inv_trap_window(first, 4)),
+                        jnp.sum(_trap_window(first, 4)))
+    f_second = jnp.where(b1 == 0, jnp.sum(_inv_trap_window(second, 4)),
+                         jnp.sum(_trap_window(second, 4)))
+    return (f_first + f_second)[None]
+
+
+def chuang_f3(x):
+    """Chuang & Hsu f3 (binary.py:102-117): like f1 but the 1-branch uses
+    windows shifted by two with a wrapped trap on the seam."""
+    u0 = _windowed_unitation(x[:-1], 4)
+    branch0 = jnp.sum(_inv_trap_window(u0, 4))
+    body = x[:-1]
+    u1 = _windowed_unitation(body[2:], 4)
+    seam = jnp.concatenate([x[-2:], x[:2]]).astype(jnp.float32)
+    branch1 = (jnp.sum(_inv_trap_window(u1, 4))
+               + _trap_window(jnp.sum(seam), 4))
+    return jnp.where(x[-1] == 0, branch0, branch1)[None]
+
+
+def royal_road1(x, order):
+    """Mitchell's Royal Road R1 (binary.py:121-131): each complete block
+    of ``order`` bits scores ``order`` iff all ones."""
+    u = _windowed_unitation(x, order)
+    return (order * jnp.sum(jnp.floor(u / order)))[None]
+
+
+def royal_road2(x, order):
+    """Royal Road R2 (binary.py:134-143): sum of R1 at doubling orders
+    up to order²."""
+    total = jnp.zeros(())
+    norder = order
+    while norder < order ** 2:
+        total = total + royal_road1(x, norder)[0]
+        norder *= 2
+    return total[None]

@@ -1,0 +1,56 @@
+"""Symbolic-regression target functions.
+
+Counterpart of /root/reference/deap/benchmarks/gp.py (:18-130). Each
+takes ``data: f32[n_dims]`` (a single input point) and returns a scalar;
+vmap over sample points. These are the ground-truth functions a GP run
+tries to rediscover.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def kotanchek(data):
+    """exp(-(x0-1)²) / (3.2 + (x1-2.5)²), x ∈ [-1, 7]² (gp.py:18)."""
+    return jnp.exp(-((data[0] - 1.0) ** 2)) / (3.2 + (data[1] - 2.5) ** 2)
+
+
+def salustowicz_1d(data):
+    """e^-x x³ cos x sin x (cos x sin²x - 1), x ∈ [0, 10] (gp.py:32)."""
+    x = data[0]
+    return (jnp.exp(-x) * x ** 3 * jnp.cos(x) * jnp.sin(x)
+            * (jnp.cos(x) * jnp.sin(x) ** 2 - 1.0))
+
+
+def salustowicz_2d(data):
+    """salustowicz_1d(x0) · (x1 - 5), x ∈ [0, 7]² (gp.py:46)."""
+    return salustowicz_1d(data) * (data[1] - 5.0)
+
+
+def unwrapped_ball(data):
+    """10 / (5 + Σ (x_i - 3)²), x ∈ [-2, 8]ⁿ (gp.py:60)."""
+    return 10.0 / (5.0 + jnp.sum((data - 3.0) ** 2))
+
+
+def rational_polynomial(data):
+    """30 (x0-1)(x2-1) / (x1² (x0-10)) (gp.py:74)."""
+    return (30.0 * (data[0] - 1.0) * (data[2] - 1.0)
+            / (data[1] ** 2 * (data[0] - 10.0)))
+
+
+def sin_cos(data):
+    """6 sin(x0) cos(x1), x ∈ [0, 6]² (gp.py:88)."""
+    return 6.0 * jnp.sin(data[0]) * jnp.cos(data[1])
+
+
+def ripple(data):
+    """(x0-3)(x1-3) + 2 sin((x0-4)(x1-4)), x ∈ [-5, 5]² (gp.py:102)."""
+    return ((data[0] - 3.0) * (data[1] - 3.0)
+            + 2.0 * jnp.sin((data[0] - 4.0) * (data[1] - 4.0)))
+
+
+def rational_polynomial2(data):
+    """((x0-3)⁴ + (x1-3)³ - (x1-3)) / ((x1-2)⁴ + 10) (gp.py:116)."""
+    return (((data[0] - 3.0) ** 4 + (data[1] - 3.0) ** 3 - (data[1] - 3.0))
+            / ((data[1] - 2.0) ** 4 + 10.0))

@@ -1,0 +1,225 @@
+"""Moving Peaks — a dynamic fitness landscape, device-resident.
+
+Counterpart of /root/reference/deap/benchmarks/movingpeaks.py: peaks of
+changing position/height/width (peak functions cone/sphere/function1,
+:33-59), evaluation-count-triggered landscape changes (:209-252,
+``changePeaks`` :252-332), offline/current error tracking (:246-249) and
+the SCENARIO_1/2/3 parameter sets (:334+).
+
+Functional redesign: the landscape is a :class:`MovingPeaksState` pytree
+(peak arrays + PRNG key + error accumulators) and every operation is a
+pure function usable inside jit/scan:
+
+- :func:`mp_init` → state
+- :func:`mp_evaluate` — batched evaluation of a whole population;
+  bumps ``nevals``, updates the running current/offline error exactly
+  like the reference's per-call bookkeeping (cumulative-min over the
+  batch), and triggers :func:`change_peaks` through ``lax.cond`` when
+  the evaluation counter crosses a period boundary. The change lands at
+  batch granularity rather than mid-population — the batched analog of
+  the reference's per-individual trigger.
+
+Divergence kept deliberately: the reference can fluctuate the *number*
+of peaks ([min, init, max] npeaks, :126-129); here the peak count is
+static per jit program — fluctuation would need a capacity mask, noted
+for the host-level wrapper.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+
+def cone(x, position, height, width):
+    """h - w·‖x - p‖ (movingpeaks.py:33-42). Batched over peaks."""
+    d = jnp.sqrt(jnp.sum((x[None, :] - position) ** 2, axis=-1))
+    return height - width * d
+
+
+def sphere_peak(x, position, height, width):
+    """h·‖x - p‖² (movingpeaks.py:44-48)."""
+    del width
+    return height * jnp.sum((x[None, :] - position) ** 2, axis=-1)
+
+
+def function1(x, position, height, width):
+    """h / (1 + w·‖x - p‖²) (movingpeaks.py:50-59)."""
+    d2 = jnp.sum((x[None, :] - position) ** 2, axis=-1)
+    return height / (1.0 + width * d2)
+
+
+@struct.dataclass
+class MovingPeaksState:
+    position: jnp.ndarray       # [npeaks, dim]
+    height: jnp.ndarray         # [npeaks]
+    width: jnp.ndarray          # [npeaks]
+    last_change: jnp.ndarray    # [npeaks, dim]
+    key: jax.Array
+    nevals: jnp.ndarray         # int32 scalar
+    current_error: jnp.ndarray  # f32 scalar
+    offline_error_sum: jnp.ndarray  # f32 scalar
+
+
+@dataclasses.dataclass(frozen=True)
+class MovingPeaksConfig:
+    """Static configuration (the SCENARIO dict equivalent)."""
+    dim: int
+    npeaks: int = 5
+    pfunc: Callable = function1
+    bfunc: Optional[Callable] = None
+    min_coord: float = 0.0
+    max_coord: float = 100.0
+    min_height: float = 30.0
+    max_height: float = 70.0
+    uniform_height: float = 50.0
+    min_width: float = 0.0001
+    max_width: float = 0.2
+    uniform_width: float = 0.1
+    lambda_: float = 0.0
+    move_severity: float = 1.0
+    height_severity: float = 7.0
+    width_severity: float = 0.01
+    period: int = 5000
+
+
+SCENARIO_1 = dict(npeaks=5, pfunc=function1, bfunc=None, min_coord=0.0,
+                  max_coord=100.0, min_height=30.0, max_height=70.0,
+                  uniform_height=50.0, min_width=0.0001, max_width=0.2,
+                  uniform_width=0.1, lambda_=0.0, move_severity=1.0,
+                  height_severity=7.0, width_severity=0.01, period=5000)
+SCENARIO_2 = dict(npeaks=10, pfunc=cone, bfunc=None, min_coord=0.0,
+                  max_coord=100.0, min_height=30.0, max_height=70.0,
+                  uniform_height=50.0, min_width=1.0, max_width=12.0,
+                  uniform_width=0.0, lambda_=0.5, move_severity=1.5,
+                  height_severity=7.0, width_severity=1.0, period=5000)
+SCENARIO_3 = dict(npeaks=50, pfunc=cone, bfunc=lambda x: jnp.asarray(10.0),
+                  min_coord=0.0, max_coord=100.0, min_height=30.0,
+                  max_height=70.0, uniform_height=0.0, min_width=1.0,
+                  max_width=12.0, uniform_width=0.0, lambda_=0.5,
+                  move_severity=1.0, height_severity=1.0,
+                  width_severity=0.5, period=1000)
+
+
+def mp_init(key: jax.Array, cfg: MovingPeaksConfig) -> MovingPeaksState:
+    kp, kh, kw, kc, knext = jax.random.split(key, 5)
+    position = jax.random.uniform(
+        kp, (cfg.npeaks, cfg.dim), minval=cfg.min_coord, maxval=cfg.max_coord)
+    if cfg.uniform_height > 0:
+        height = jnp.full((cfg.npeaks,), cfg.uniform_height)
+    else:
+        height = jax.random.uniform(
+            kh, (cfg.npeaks,), minval=cfg.min_height, maxval=cfg.max_height)
+    if cfg.uniform_width > 0:
+        width = jnp.full((cfg.npeaks,), cfg.uniform_width)
+    else:
+        width = jax.random.uniform(
+            kw, (cfg.npeaks,), minval=cfg.min_width, maxval=cfg.max_width)
+    last_change = jax.random.uniform(kc, (cfg.npeaks, cfg.dim)) - 0.5
+    return MovingPeaksState(
+        position=position, height=height, width=width,
+        last_change=last_change, key=knext,
+        nevals=jnp.zeros((), jnp.int32),
+        current_error=jnp.asarray(jnp.inf),
+        offline_error_sum=jnp.zeros(()))
+
+
+def _landscape(cfg: MovingPeaksConfig, state: MovingPeaksState, x):
+    vals = cfg.pfunc(x, state.position, state.height, state.width)
+    best = jnp.max(vals)
+    if cfg.bfunc is not None:
+        best = jnp.maximum(best, cfg.bfunc(x))
+    return best
+
+
+def global_maximum(cfg: MovingPeaksConfig, state: MovingPeaksState):
+    """Current optimum value: the best landscape value over all peak
+    centres (movingpeaks.py:182-193)."""
+    vals = jax.vmap(lambda p: _landscape(cfg, state, p))(state.position)
+    return jnp.max(vals)
+
+
+def _bounce(new, old, delta, lo, hi):
+    below = new < lo
+    above = new > hi
+    bounced = jnp.where(below, 2.0 * lo - old - delta,
+                        jnp.where(above, 2.0 * hi - old - delta, new))
+    flipped = jnp.where(below | above, -delta, delta)
+    return bounced, flipped
+
+
+def change_peaks(cfg: MovingPeaksConfig, state: MovingPeaksState
+                 ) -> MovingPeaksState:
+    """One landscape change (movingpeaks.py:252-332): correlated random
+    walk of positions (severity-normalised, lambda-blended with the last
+    move, bounced at the coordinate bounds) and Gaussian height/width
+    perturbations bounced at their bounds."""
+    key, ks, kh, kw = jax.random.split(state.key, 4)
+    shift = jax.random.uniform(ks, state.position.shape) - 0.5
+    norm = jnp.sqrt(jnp.sum(shift ** 2, axis=1, keepdims=True))
+    shift = jnp.where(norm > 0, cfg.move_severity * shift / norm, 0.0)
+    shift = (1.0 - cfg.lambda_) * shift + cfg.lambda_ * state.last_change
+    norm = jnp.sqrt(jnp.sum(shift ** 2, axis=1, keepdims=True))
+    shift = jnp.where(norm > 0, cfg.move_severity * shift / norm, 0.0)
+
+    new_pos, final_shift = _bounce(
+        state.position + shift, state.position, shift,
+        cfg.min_coord, cfg.max_coord)
+
+    dh = jax.random.normal(kh, state.height.shape) * cfg.height_severity
+    new_h, _ = _bounce(state.height + dh, state.height, dh,
+                       cfg.min_height, cfg.max_height)
+    dw = jax.random.normal(kw, state.width.shape) * cfg.width_severity
+    new_w, _ = _bounce(state.width + dw, state.width, dw,
+                       cfg.min_width, cfg.max_width)
+
+    return state.replace(position=new_pos, height=new_h, width=new_w,
+                         last_change=final_shift, key=key)
+
+
+def mp_evaluate(cfg: MovingPeaksConfig, state: MovingPeaksState,
+                genomes: jnp.ndarray):
+    """Evaluate a population ``[n, dim]`` → (new_state, values [n, 1]).
+
+    Error bookkeeping matches the reference's sequential semantics
+    (movingpeaks.py:225-244): running min of |f - optimum| threaded
+    through the batch, summed into the offline error. The peak change
+    fires once per batch if ``nevals`` crosses a period boundary.
+    """
+    n = genomes.shape[0]
+    values = jax.vmap(lambda x: _landscape(cfg, state, x))(genomes)
+
+    optimum = global_maximum(cfg, state)
+    errs = jnp.abs(values - optimum)
+    run_min = lax.associative_scan(jnp.minimum, jnp.concatenate(
+        [state.current_error[None], errs]))
+    new_state = state.replace(
+        nevals=state.nevals + n,
+        current_error=run_min[-1],
+        offline_error_sum=state.offline_error_sum + jnp.sum(run_min[1:]))
+
+    if cfg.period > 0:
+        crossed = (new_state.nevals // cfg.period) > (state.nevals // cfg.period)
+        # A landscape change restarts the running error minimum, like the
+        # reference's `self._optimum = None` at the end of changePeaks
+        # (movingpeaks.py:332) which re-initialises _error on the next call.
+        new_state = lax.cond(
+            crossed,
+            lambda s: change_peaks(cfg, s).replace(
+                current_error=jnp.asarray(jnp.inf)),
+            lambda s: s, new_state)
+    return new_state, values[:, None]
+
+
+def offline_error(state: MovingPeaksState):
+    """Mean running error over all evaluations (movingpeaks.py:246-247)."""
+    return state.offline_error_sum / jnp.maximum(state.nevals, 1)
+
+
+def current_error(state: MovingPeaksState):
+    return state.current_error

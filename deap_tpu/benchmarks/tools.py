@@ -1,0 +1,198 @@
+"""Benchmark transform decorators and multi-objective quality metrics.
+
+Counterpart of /root/reference/deap/benchmarks/tools.py: evaluation
+transforms translate (:25), rotate (:64), noise (:117), scale (:171),
+bound (:212) and metrics diversity (:256), convergence (:278),
+hypervolume (:299), igd (:314).
+
+The transforms are decorator *objects* carrying a mutable parameter with
+an update method, exactly like the reference (so
+``evaluate.translate(new_vector)`` works); they pre-transform the genome
+before the wrapped evaluation, which therefore sees "a plain array" —
+and everything stays jnp so the composition still jits. ``noise`` takes
+an explicit PRNG key (the functional replacement for the reference's
+global-``random`` noise draw): the decorated evaluate's signature
+becomes ``(x, key)``.
+
+Metrics operate on plain arrays of objective values (minimisation),
+rather than lists of individuals.
+"""
+
+from __future__ import annotations
+
+from functools import wraps
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deap_tpu.native import hypervolume as _hv
+
+
+class translate:
+    """Translate the objective function by ``vector`` (tools.py:25-62)."""
+
+    def __init__(self, vector):
+        self.vector = jnp.asarray(vector)
+
+    def __call__(self, func):
+        @wraps(func)
+        def wrapper(individual, *args, **kwargs):
+            return func(individual - self.vector, *args, **kwargs)
+        wrapper.translate = self.translate
+        return wrapper
+
+    def translate(self, vector):
+        self.vector = jnp.asarray(vector)
+
+
+class rotate:
+    """Rotate the objective function by an orthogonal ``matrix``; the
+    inverse rotation is applied to the genome (tools.py:64-115)."""
+
+    def __init__(self, matrix):
+        self.matrix = jnp.linalg.inv(jnp.asarray(matrix))
+
+    def __call__(self, func):
+        @wraps(func)
+        def wrapper(individual, *args, **kwargs):
+            return func(self.matrix @ individual, *args, **kwargs)
+        wrapper.rotate = self.rotate
+        return wrapper
+
+    def rotate(self, matrix):
+        self.matrix = jnp.linalg.inv(jnp.asarray(matrix))
+
+
+class scale:
+    """Scale the objective function by ``factor`` per dimension; the
+    inverse factor is applied to the genome (tools.py:171-210)."""
+
+    def __init__(self, factor):
+        self.factor = 1.0 / jnp.asarray(factor)
+
+    def __call__(self, func):
+        @wraps(func)
+        def wrapper(individual, *args, **kwargs):
+            return func(individual * self.factor, *args, **kwargs)
+        wrapper.scale = self.scale
+        return wrapper
+
+    def scale(self, factor):
+        self.factor = 1.0 / jnp.asarray(factor)
+
+
+class noise:
+    """Additive objective noise (tools.py:117-169). ``sigma`` may be a
+    scalar or per-objective; the decorated evaluation takes an explicit
+    key: ``evaluate(x, key)``."""
+
+    def __init__(self, sigma):
+        self.sigma = None if sigma is None else jnp.asarray(sigma)
+
+    def __call__(self, func):
+        @wraps(func)
+        def wrapper(individual, key, *args, **kwargs):
+            values = func(individual, *args, **kwargs)
+            if self.sigma is None:
+                return values
+            return values + self.sigma * jax.random.normal(
+                key, jnp.shape(values))
+        wrapper.noise = self.noise
+        return wrapper
+
+    def noise(self, sigma):
+        self.sigma = None if sigma is None else jnp.asarray(sigma)
+
+
+class bound:
+    """Clip/wrap/mirror decorated *operator* outputs back into [low, up]
+    (tools.py:212-254 — a stub in the reference; functional here)."""
+
+    def __init__(self, bounds, type_="clip"):
+        self.low, self.up = (jnp.asarray(b) for b in bounds)
+        if type_ not in ("clip", "wrap", "mirror"):
+            raise ValueError(type_)
+        self.type = type_
+
+    def _apply(self, x):
+        low, up = self.low, self.up
+        if self.type == "clip":
+            return jnp.clip(x, low, up)
+        span = up - low
+        if self.type == "wrap":
+            return low + jnp.mod(x - low, span)
+        t = jnp.mod(x - low, 2 * span)
+        return low + jnp.where(t > span, 2 * span - t, t)
+
+    def __call__(self, func):
+        @wraps(func)
+        def wrapper(*args, **kwargs):
+            out = func(*args, **kwargs)
+            if isinstance(out, tuple):
+                return tuple(self._apply(o) for o in out)
+            return self._apply(out)
+        return wrapper
+
+
+# ------------------------------------------------------------- metrics ----
+
+def diversity(first_front, first, last):
+    """Deb's NSGA-II spread Δ (tools.py:256-276): ``first_front`` is
+    [n, 2] objective values in front order; ``first``/``last`` the
+    extreme points of the optimal front. Smaller is better."""
+    ff = jnp.asarray(first_front)
+    df = jnp.hypot(ff[0, 0] - first[0], ff[0, 1] - first[1])
+    dl = jnp.hypot(ff[-1, 0] - last[0], ff[-1, 1] - last[1])
+    if ff.shape[0] == 1:
+        return float(df + dl)
+    dt = jnp.hypot(ff[:-1, 0] - ff[1:, 0], ff[:-1, 1] - ff[1:, 1])
+    dm = jnp.mean(dt)
+    di = jnp.sum(jnp.abs(dt - dm))
+    return float((df + dl + di) / (df + dl + dt.shape[0] * dm))
+
+
+def convergence(first_front, optimal_front):
+    """Mean distance from each front member to its nearest optimal point
+    (tools.py:278-296). Smaller is better."""
+    a = jnp.asarray(first_front)[:, None, :]
+    z = jnp.asarray(optimal_front)[None, :, :]
+    d = jnp.sqrt(jnp.sum((a - z) ** 2, axis=-1))
+    return float(jnp.mean(jnp.min(d, axis=1)))
+
+
+def hypervolume(front, ref=None, weights=None):
+    """Hypervolume of a front (tools.py:299-311).
+
+    ``front`` is a Population, or an array of raw objective values with
+    ``weights`` (defaults to minimisation), or weighted values directly.
+    Internally flipped to minimisation space like the reference's
+    ``wvalues * -1``.
+    """
+    from deap_tpu.core.population import Population
+
+    if isinstance(front, Population):
+        w = front.fitness * front.spec.warray
+        w = np.asarray(w)[np.asarray(front.valid)]
+    else:
+        front = np.asarray(front)
+        if weights is None:
+            weights = -np.ones(front.shape[-1])
+        w = front * np.asarray(weights)
+    wobj = -w
+    if ref is None:
+        ref = np.max(wobj, axis=0) + 1
+    return _hv(wobj, np.asarray(ref))
+
+
+def igd(A, Z):
+    """Inverted generational distance (tools.py:314-320): mean over A? —
+    the reference averages, per its scipy formulation, the minimum
+    distance from each member of ``A`` to ``Z`` taken column-wise
+    (``min(cdist(A, Z), axis=0)``): the average nearest-neighbour
+    distance from each reference point in ``Z`` to the approximation
+    ``A``."""
+    a = jnp.asarray(A)[:, None, :]
+    z = jnp.asarray(Z)[None, :, :]
+    d = jnp.sqrt(jnp.sum((a - z) ** 2, axis=-1))  # [|A|, |Z|]
+    return float(jnp.mean(jnp.min(d, axis=0)))

@@ -1,0 +1,25 @@
+"""Native (C++) accelerated routines, with graceful Python fallbacks.
+
+Mirrors the reference's import pattern for its C hypervolume extension
+(/root/reference/deap/tools/indicator.py:3-8, benchmarks/tools.py:18-23):
+try the compiled extension, fall back to the pure implementation with a
+warning.
+"""
+
+import warnings
+
+try:
+    from deap_tpu.native.hv_binding import hypervolume as _hv_native
+    HAVE_NATIVE_HV = True
+
+    def hypervolume(points, ref):
+        return _hv_native(points, ref)
+except Exception:  # pragma: no cover - exercised when the ext is absent
+    HAVE_NATIVE_HV = False
+    warnings.warn(
+        "Native hypervolume extension not built; using the pure-Python "
+        "WFG fallback (slow for large fronts). Build it with "
+        "`python -m deap_tpu.native.build`.")
+    from deap_tpu.native.pyhv import hypervolume
+
+__all__ = ["hypervolume", "HAVE_NATIVE_HV"]

@@ -38,11 +38,13 @@ def cx_one_point(key, g1, g2):
 
 
 def _two_points(key, size):
-    """The reference's two-point draw (crossover.py:44-50): p1 ~ U{1..L-1},
-    p2 ~ U{1..L-2} bumped past p1 — a uniform distinct ordered pair."""
+    """The reference's two-point draw (crossover.py:44-50): p1 ~ U{1..L}
+    (randint is inclusive there), p2 ~ U{1..L-1} bumped past p1 — a
+    uniform distinct ordered pair whose segment may include the last
+    gene."""
     k1, k2 = jax.random.split(key)
-    p1 = jax.random.randint(k1, (), 1, size)
-    p2 = jax.random.randint(k2, (), 1, size - 1)
+    p1 = jax.random.randint(k1, (), 1, size + 1)
+    p2 = jax.random.randint(k2, (), 1, size)
     p2 = jnp.where(p2 >= p1, p2 + 1, p2)
     return jnp.minimum(p1, p2), jnp.maximum(p1, p2)
 

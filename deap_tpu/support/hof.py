@@ -95,6 +95,21 @@ def _adjacent_dup(sorted_w, sorted_h, sorted_genomes, sorted_valid):
     return jnp.concatenate([jnp.zeros(1, bool), same])
 
 
+def duplicate_mask(genomes, w: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """bool[n] in ORIGINAL order: row is an exact-genome duplicate of
+    another (earlier in (w, hash) sort order) valid row. O(n log n) —
+    the scalable dedup shared by hof_update and pareto_update."""
+    h = _genome_hash(genomes)
+    keys = (h,) + tuple(-w[:, j] for j in range(w.shape[1] - 1, -1, -1))
+    order = jnp.lexsort(keys)
+    sw = jnp.take(w, order, axis=0)
+    sh = jnp.take(h, order)
+    sv = jnp.take(valid, order)
+    sg = jax.tree_util.tree_map(lambda a: jnp.take(a, order, axis=0), genomes)
+    dup_sorted = _adjacent_dup(sw, sh, sg, sv)
+    return jnp.zeros_like(valid).at[order].set(dup_sorted)
+
+
 def hof_update(hof: HallOfFame, pop: Population, dedup: bool = True) -> HallOfFame:
     """Merge a population into the archive (support.py:517-543).
 

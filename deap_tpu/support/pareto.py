@@ -16,9 +16,11 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
+from jax import lax
+
 from deap_tpu.core.fitness import FitnessSpec, dominates, lex_sort_desc
 from deap_tpu.core.population import Population
-from deap_tpu.support.hof import _genome_eq_matrix
+from deap_tpu.support.hof import duplicate_mask
 
 
 @struct.dataclass
@@ -43,18 +45,31 @@ def pareto_init(capacity: int, pop: Population) -> ParetoArchive:
     )
 
 
-def nondominated_mask(w: jnp.ndarray, valid: jnp.ndarray | None = None) -> jnp.ndarray:
+def nondominated_mask(w: jnp.ndarray, valid: jnp.ndarray | None = None,
+                      chunk: int = 512) -> jnp.ndarray:
     """bool[n]: rows not Pareto-dominated by any other row.
 
-    The O(n²) pairwise dominance matrix is one fused batched comparison —
-    the TPU-friendly replacement for the reference's per-pair loop
-    (support.py:612-633). Intended for selection-sized fronts.
+    The O(n²) dominance work is one fused batched comparison — the
+    TPU-friendly replacement for the reference's per-pair loop
+    (support.py:612-633) — computed in row chunks so peak memory is
+    O(chunk · n · nobj) instead of O(n²): usable at 100k populations
+    inside a scanned step.
     """
-    dom = dominates(w[None, :, :], w[:, None, :])  # dom[i, j]: j dominates i
-    if valid is not None:
-        dom &= valid[None, :]
-        return valid & ~jnp.any(dom, axis=1)
-    return ~jnp.any(dom, axis=1)
+    n = w.shape[0]
+    if valid is None:
+        valid = jnp.ones(n, bool)
+    pad = (-n) % chunk
+    wp = jnp.pad(w, ((0, pad), (0, 0)))
+    vp = jnp.pad(valid, (0, pad))
+
+    def block(args):
+        wi, vi = args  # [chunk, nobj], [chunk]
+        dom = dominates(w[None, :, :], wi[:, None, :]) & valid[None, :]
+        return vi & ~jnp.any(dom, axis=1)
+
+    out = lax.map(block, (wp.reshape(-1, chunk, w.shape[1]),
+                          vp.reshape(-1, chunk)))
+    return out.reshape(-1)[:n]
 
 
 def pareto_update(archive: ParetoArchive, pop: Population,
@@ -65,9 +80,10 @@ def pareto_update(archive: ParetoArchive, pop: Population,
     (deduplicated on genome equality), lex-sorted, truncated at capacity.
     """
     cap = archive.capacity
-    # Reduce the population to its lex-best min(n, 4*cap) rows first when
-    # it is much larger than the archive? No — dominance is not aligned
-    # with lex order in multi-objective spaces; merge the full population.
+    # Dominance is not aligned with lex order in multi-objective spaces,
+    # so the full population must be merged; the dominance pass is
+    # chunked and the dedup is sort-based, so the cost is O(n²/chunk)
+    # compute with O(chunk·n) memory — fine at 100k inside a scan.
     cat = lambda a, b: jnp.concatenate([a, b], axis=0)
     all_g = jax.tree_util.tree_map(cat, archive.genomes, pop.genomes)
     all_f = cat(archive.fitness, pop.fitness)
@@ -78,10 +94,7 @@ def pareto_update(archive: ParetoArchive, pop: Population,
     nd = nondominated_mask(w, all_valid)
 
     if dedup:
-        eq = _genome_eq_matrix(all_g)
-        earlier = jnp.tril(jnp.ones_like(eq), k=-1)
-        is_dup = jnp.any(eq & earlier & all_valid[None, :], axis=1)
-        nd &= ~is_dup
+        nd &= ~duplicate_mask(all_g, w, all_valid)
 
     order = lex_sort_desc(jnp.where(nd[:, None], w, -jnp.inf))[:cap]
     take = lambda a: jnp.take(a, order, axis=0)

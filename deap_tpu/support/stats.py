@@ -3,11 +3,16 @@
 Counterpart of /root/reference/deap/tools/support.py:154-259. The
 reference's ``Statistics(key)`` extracts a value per individual and
 applies registered numpy reducers; here ``key`` extracts a batched array
-from the whole :class:`Population` (default: raw fitness values of valid
-rows, with invalid rows masked to NaN-safe values) and reducers are jnp
-functions, so ``compile`` can run *inside* a jit'd/scanned generation
-step — the per-generation stats come back as stacked arrays, one slice
-per generation, and feed the host-side :class:`Logbook`.
+from the whole :class:`Population` (default: the raw fitness tensor) and
+reducers are jnp functions, so ``compile`` can run *inside* a jit'd /
+scanned generation step — the per-generation stats come back as stacked
+arrays, one slice per generation, and feed the host-side
+:class:`Logbook`.
+
+Like the reference, statistics are meant to be compiled *after*
+evaluation (algorithms do so): invalid rows are NOT masked, so compiling
+mid-variation would include stale fitness values. Pass a custom ``key``
+that filters by ``pop.valid`` if you need mid-variation stats.
 """
 
 from __future__ import annotations

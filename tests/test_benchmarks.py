@@ -1,0 +1,182 @@
+"""Benchmark-suite tests: known optima/values (self-contained versions of
+the parity sweep run against the reference at build time — all functions
+matched the reference numerically to rtol 2e-4 on random inputs)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deap_tpu import benchmarks as bm
+from deap_tpu.benchmarks import binary, movingpeaks as mp, tools as btools
+from deap_tpu.native import hypervolume as native_hv
+
+
+def test_single_objective_known_optima():
+    z6 = jnp.zeros(6)
+    assert float(bm.sphere(z6)[0]) == 0.0
+    assert float(bm.rastrigin(z6)[0]) == 0.0
+    assert abs(float(bm.ackley(z6)[0])) < 1e-6
+    assert float(bm.griewank(z6)[0]) == 0.0
+    assert float(bm.rosenbrock(jnp.ones(6))[0]) == 0.0
+    assert abs(float(bm.bohachevsky(z6)[0])) < 1e-6
+    assert abs(float(bm.schwefel(jnp.full(4, 420.96874636))[0])) < 1e-2
+    assert abs(float(bm.himmelblau(jnp.array([3.0, 2.0]))[0])) < 1e-10
+    # h1 maximum is 2 at (8.6998, 6.7665)
+    assert abs(float(bm.h1(jnp.array([8.6998, 6.7665]))[0]) - 2.0) < 1e-3
+
+
+def test_multiobjective_shapes_and_fronts():
+    x = jnp.concatenate([jnp.array([0.3]), jnp.zeros(29)])
+    f = bm.zdt1(x)
+    # on the optimal front (g=1): f2 = 1 - sqrt(f1)
+    np.testing.assert_allclose(
+        np.asarray(f), [0.3, 1.0 - np.sqrt(0.3)], rtol=1e-5)
+    f = bm.zdt2(x)
+    np.testing.assert_allclose(np.asarray(f), [0.3, 1.0 - 0.09], rtol=1e-5)
+    for fn, nobj in [(bm.kursawe, 2), (bm.fonseca, 2), (bm.poloni, 2),
+                     (bm.dent, 2)]:
+        out = fn(jnp.full(3, 0.5))
+        assert out.shape == (nobj,)
+    for obj in (2, 3, 4):
+        for fn in (bm.dtlz1, bm.dtlz2, bm.dtlz3, bm.dtlz5, bm.dtlz6,
+                   bm.dtlz7):
+            assert fn(jnp.full(8, 0.4), obj).shape == (obj,)
+    # dtlz2 optimal front: tail at 0.5 → Σ f² = 1
+    f = bm.dtlz2(jnp.concatenate([jnp.array([0.3, 0.7]), jnp.full(6, 0.5)]), 3)
+    assert abs(float(jnp.sum(f ** 2)) - 1.0) < 1e-5
+
+
+def test_benchmarks_vmap_batched():
+    pop = jax.random.uniform(jax.random.key(0), (128, 10))
+    vals = jax.vmap(bm.rastrigin)(pop)
+    assert vals.shape == (128, 1)
+    vals = jax.vmap(bm.zdt1)(pop)
+    assert vals.shape == (128, 2)
+
+
+def test_binary_traps_and_royal_road():
+    ones = jnp.ones(8, jnp.int32)
+    zeros = jnp.zeros(8, jnp.int32)
+    assert float(binary.trap(ones)[0]) == 8.0
+    assert float(binary.trap(zeros)[0]) == 7.0
+    assert float(binary.inv_trap(zeros)[0]) == 8.0
+    assert float(binary.inv_trap(ones)[0]) == 7.0
+    # chuang_f1 has optima 40 at all-ones+[1] and all-zeros+[0]
+    f1_ones = binary.chuang_f1(jnp.ones(41, jnp.int32))
+    f1_zeros = binary.chuang_f1(jnp.zeros(41, jnp.int32))
+    assert float(f1_ones[0]) == 40.0 and float(f1_zeros[0]) == 40.0
+    # royal road: all ones of 64 bits order 8 → 64
+    assert float(binary.royal_road1(jnp.ones(64, jnp.int32), 8)[0]) == 64.0
+    assert float(binary.royal_road1(jnp.zeros(64, jnp.int32), 8)[0]) == 0.0
+    assert float(binary.royal_road2(jnp.ones(64, jnp.int32), 4)[0]) > 64.0
+
+
+def test_bin2float_decodes():
+    @binary.bin2float(0.0, 1.0, 4)
+    def decoded_sum(d):
+        return jnp.sum(d, keepdims=True)
+
+    bits = jnp.array([1, 1, 1, 1, 0, 0, 0, 0], jnp.int32)
+    np.testing.assert_allclose(np.asarray(decoded_sum(bits)), [1.0], rtol=1e-6)
+
+
+def test_transform_decorators():
+    evaluate = btools.translate(jnp.array([1.0, 1.0]))(bm.sphere)
+    np.testing.assert_allclose(
+        np.asarray(evaluate(jnp.array([1.0, 1.0]))), [0.0], atol=1e-6)
+    evaluate.translate(jnp.zeros(2))
+    np.testing.assert_allclose(
+        np.asarray(evaluate(jnp.array([1.0, 1.0]))), [2.0], atol=1e-6)
+
+    theta = jnp.pi / 2
+    rot = jnp.array([[jnp.cos(theta), -jnp.sin(theta)],
+                     [jnp.sin(theta), jnp.cos(theta)]])
+    evaluate = btools.rotate(rot)(bm.plane)
+    # inverse rotation of (0, 1) is (1, 0) → plane = 1
+    np.testing.assert_allclose(
+        np.asarray(evaluate(jnp.array([0.0, 1.0]))), [1.0], atol=1e-6)
+
+    evaluate = btools.scale(jnp.array([2.0, 2.0]))(bm.sphere)
+    np.testing.assert_allclose(
+        np.asarray(evaluate(jnp.array([2.0, 2.0]))), [2.0], atol=1e-6)
+
+    noisy = btools.noise(0.5)(bm.sphere)
+    v1 = noisy(jnp.ones(2), jax.random.key(0))
+    v2 = noisy(jnp.ones(2), jax.random.key(1))
+    assert float(v1[0]) != float(v2[0])
+
+    clipper = btools.bound((jnp.zeros(3), jnp.ones(3)), "clip")(
+        lambda x: x * 3.0)
+    assert float(clipper(jnp.ones(3)).max()) == 1.0
+    mirror = btools.bound((jnp.zeros(1), jnp.ones(1)), "mirror")(
+        lambda x: x)
+    np.testing.assert_allclose(np.asarray(mirror(jnp.array([1.2]))), [0.8],
+                               rtol=1e-5)
+
+
+def test_hypervolume_exact_values():
+    # 2-D staircase
+    assert native_hv(np.array([[1.0, 2.0], [2.0, 1.0]]), np.array([3.0, 3.0])) == 3.0
+    # 3-D inclusion-exclusion
+    pts = np.array([[0.0, 0.0, 1.0], [1.0, 1.0, 0.0]])
+    assert native_hv(pts, np.array([2.0, 2.0, 2.0])) == 5.0
+    # dominated point contributes nothing
+    pts = np.array([[1.0, 1.0], [1.5, 1.5]])
+    assert native_hv(pts, np.array([2.0, 2.0])) == 1.0
+    # metric wrapper flips weighted values to minimisation space
+    hv = btools.hypervolume(np.array([[1.0, 2.0], [2.0, 1.0]]),
+                            ref=[3.0, 3.0])
+    assert hv == 3.0
+
+
+def test_metrics():
+    front = jnp.array([[0.0, 1.0], [0.5, 0.5], [1.0, 0.0]])
+    opt = jnp.array([[0.0, 1.0], [0.5, 0.5], [1.0, 0.0]])
+    assert btools.convergence(front, opt) == 0.0
+    assert btools.igd(front, opt) == 0.0
+    d = btools.diversity(front, (0.0, 1.0), (1.0, 0.0))
+    assert d < 1e-6  # perfectly spread
+
+
+def test_movingpeaks_eval_and_change():
+    cfg = mp.MovingPeaksConfig(dim=2, **{k: v for k, v in
+                                         mp.SCENARIO_1.items()})
+    cfg = mp.MovingPeaksConfig(dim=2, npeaks=5, period=100)
+    state = mp.mp_init(jax.random.key(0), cfg)
+    pop = jax.random.uniform(jax.random.key(1), (50, 2), minval=0.0,
+                             maxval=100.0)
+    state1, vals = mp.mp_evaluate(cfg, state, pop)
+    assert vals.shape == (50, 1)
+    assert int(state1.nevals) == 50
+    # peaks unchanged until the period boundary
+    np.testing.assert_allclose(np.asarray(state1.position),
+                               np.asarray(state.position))
+    state2, _ = mp.mp_evaluate(cfg, state1, pop)  # nevals 100 → change
+    assert not np.allclose(np.asarray(state2.position),
+                           np.asarray(state1.position))
+    # the change resets the running error (reference: _optimum = None)
+    assert float(state2.current_error) == float("inf")
+    assert float(mp.offline_error(state2)) > 0.0
+    # next batch re-establishes a finite running minimum
+    state3, _ = mp.mp_evaluate(cfg, state2, pop)
+    assert np.isfinite(float(state3.current_error))
+    assert np.isfinite(float(mp.offline_error(state3)))
+    # evaluating exactly at a peak is optimal
+    peak0 = state.position[0]
+    _, v = mp.mp_evaluate(cfg, state, peak0[None, :])
+    assert float(v[0, 0]) <= float(mp.global_maximum(cfg, state)) + 1e-5
+
+
+def test_movingpeaks_inside_jit():
+    cfg = mp.MovingPeaksConfig(dim=3, npeaks=4, period=10)
+    state = mp.mp_init(jax.random.key(2), cfg)
+
+    @jax.jit
+    def step(state, genomes):
+        return mp.mp_evaluate(cfg, state, genomes)
+
+    g = jax.random.uniform(jax.random.key(3), (12, 3), maxval=100.0)
+    state, vals = step(state, g)
+    assert int(state.nevals) == 12
+    assert bool(jnp.isfinite(vals).all())

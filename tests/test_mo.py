@@ -1,0 +1,156 @@
+"""Multi-objective selection tests: unit semantics + the reference's
+quality-gate integration tests (NSGA-II/III on ZDT1, 100 gens, MU=16,
+hypervolume > 116.0 with ref point [11, 11] — deap/tests/
+test_algorithms.py:32,110-116,227-230)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+from deap_tpu import benchmarks as bm
+from deap_tpu import mo, ops
+from deap_tpu.algorithms import evaluate_invalid, var_and
+from deap_tpu.benchmarks.tools import hypervolume
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import Population, concat, gather, init_population
+from deap_tpu.core.toolbox import Toolbox
+
+
+def _w_min(values):
+    return -jnp.asarray(values, jnp.float32)  # weights (-1, -1)
+
+
+def test_nd_rank_three_fronts():
+    values = jnp.array([
+        [1.0, 1.0],   # front 0
+        [2.0, 2.0],   # front 1 (dominated by [1,1])
+        [1.0, 3.0],   # front 0 (incomparable with [1,1]? no — [1,1] dominates)
+        [3.0, 3.0],   # front 2
+    ])
+    ranks = mo.nd_rank(_w_min(values))
+    # [1,1] dominates all others; [2,2] and [1,3] incomparable
+    np.testing.assert_array_equal(np.asarray(ranks), [0, 1, 1, 2])
+
+
+def test_nd_rank_equal_fitness_share_rank():
+    values = jnp.array([[1.0, 1.0], [1.0, 1.0], [2.0, 2.0]])
+    ranks = mo.nd_rank(_w_min(values))
+    np.testing.assert_array_equal(np.asarray(ranks), [0, 0, 1])
+
+
+def test_crowding_distances_exact():
+    # one front, 4 points on a line; interior distances per Deb's formula
+    values = jnp.array([[0.0, 3.0], [1.0, 2.0], [2.0, 1.0], [3.0, 0.0]])
+    w = _w_min(values)
+    ranks = jnp.zeros(4, jnp.int32)
+    d = mo.crowding_distances(w, ranks)
+    d = np.asarray(d)
+    assert np.isinf(d[0]) and np.isinf(d[3])
+    # interior: ((2-0)/ (2*3)) * 2 objectives = 2/3 total
+    np.testing.assert_allclose(d[1:3], 2.0 / 3.0, rtol=1e-5)
+
+
+def test_sel_nsga2_takes_fronts_then_crowding():
+    values = jnp.array([
+        [0.0, 2.0], [2.0, 0.0], [1.0, 1.0],      # front 0
+        [2.0, 2.0],                               # front 1
+        [3.0, 3.0],                               # front 2
+    ])
+    idx = mo.sel_nsga2(None, _w_min(values), 4)
+    picked = set(np.asarray(idx).tolist())
+    assert {0, 1, 2} <= picked and 4 not in picked
+
+
+def test_sel_tournament_dcd_prefers_dominating():
+    values = jnp.array([[0.0, 0.0]] + [[5.0, 5.0]] * 7)
+    idx = mo.sel_tournament_dcd(jax.random.key(0), _w_min(values), 8)
+    # individual 0 dominates everyone: it must win every tournament it enters
+    counts = np.bincount(np.asarray(idx), minlength=8)
+    assert counts[0] >= 1
+    # a dominated individual facing 0 never wins
+    assert bool(jnp.all(values[idx].sum(-1) <= 10.0))
+
+
+def test_sel_spea2_keeps_nondominated():
+    values = jnp.array([
+        [1.0, 4.0], [2.0, 2.0], [4.0, 1.0],      # nondominated
+        [5.0, 5.0], [6.0, 6.0],
+    ])
+    idx = mo.sel_spea2(jax.random.key(1), _w_min(values), 3)
+    assert set(np.asarray(idx).tolist()) == {0, 1, 2}
+    # truncation: 4 nondominated, keep 3 — drops one of the crowded pair
+    values = jnp.array([[0.0, 4.0], [1.9, 2.0], [2.0, 1.9], [4.0, 0.0]])
+    idx = mo.sel_spea2(jax.random.key(2), _w_min(values), 3)
+    picked = set(np.asarray(idx).tolist())
+    assert len(picked) == 3 and {0, 3} <= picked
+
+
+def test_uniform_reference_points():
+    rp = mo.uniform_reference_points(3, p=4)
+    assert rp.shape == (15, 3)
+    np.testing.assert_allclose(np.asarray(rp.sum(1)), 1.0, rtol=1e-6)
+
+
+ZDT1_SPEC = FitnessSpec((-1.0, -1.0))
+NDIM = 5  # the reference gate config (test_algorithms.py:70)
+MU = 16
+
+
+def _zdt1_toolbox():
+    tb = Toolbox()
+    tb.register("evaluate", jax.vmap(bm.zdt1))
+    tb.register("mate", ops.cx_simulated_binary_bounded, eta=20.0, low=0.0,
+                up=1.0)
+    tb.register("mutate", ops.mut_polynomial_bounded, eta=20.0, low=0.0,
+                up=1.0, indpb=1.0 / NDIM)
+    return tb
+
+
+def _run_zdt1(key, environmental_select, ngen=100):
+    tb = _zdt1_toolbox()
+    kinit, krun = jax.random.split(jax.random.key(7) if key is None else key)
+    pop = init_population(kinit, MU, ops.uniform_genome(NDIM), ZDT1_SPEC)
+    pop = evaluate_invalid(pop, tb.evaluate)
+
+    def step(pop, key):
+        k1, k2, k3 = jax.random.split(key, 3)
+        idx = mo.sel_tournament_dcd(k1, pop.wvalues, MU)
+        off = var_and(k2, gather(pop, idx), tb, cxpb=0.9, mutpb=1.0)
+        off = evaluate_invalid(off, tb.evaluate)
+        pool = concat([pop, off])
+        sel = environmental_select(k3, pool.wvalues, MU)
+        return gather(pool, sel), None
+
+    run = jax.jit(lambda pop, keys: lax.scan(step, pop, keys)[0])
+    return run(pop, jax.random.split(krun, ngen))
+
+
+def test_nsga2_zdt1_hypervolume_gate():
+    pop = _run_zdt1(jax.random.key(11), mo.sel_nsga2)
+    hv = hypervolume(pop, ref=[11.0, 11.0])
+    assert hv > 116.0, hv  # optimum 120.777 (test_algorithms.py:32)
+    # bounds check like the reference (:115-116)
+    g = np.asarray(pop.genomes)
+    assert g.min() >= 0.0 and g.max() <= 1.0
+
+
+def test_nsga3_zdt1_hypervolume_gate():
+    rp = mo.uniform_reference_points(2, p=12)
+    select = lambda key, w, k: mo.sel_nsga3(key, w, k, rp)
+    pop = _run_zdt1(jax.random.key(12), select)
+    hv = hypervolume(pop, ref=[11.0, 11.0])
+    assert hv > 116.0, hv
+    g = np.asarray(pop.genomes)
+    assert g.min() >= 0.0 and g.max() <= 1.0
+
+
+def test_nsga3_with_memory_runs():
+    rp = mo.uniform_reference_points(2, p=6)
+    sel = mo.emo.SelNSGA3WithMemory(rp)
+    values = jax.random.uniform(jax.random.key(3), (20, 2))
+    idx1 = sel(jax.random.key(4), -values, 8)
+    idx2 = sel(jax.random.key(5), -values, 8)
+    assert idx1.shape == (8,) and idx2.shape == (8,)
+    assert sel.memory is not None
