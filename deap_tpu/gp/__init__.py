@@ -1,0 +1,66 @@
+"""Genetic programming over tensor prefix trees.
+
+Counterpart of /root/reference/deap/gp.py, re-designed for TPUs: trees
+are fixed-width prefix arrays, evaluation is a batched stack interpreter
+(one XLA program for the whole population × all datapoints — replacing
+the reference's per-individual string-codegen ``eval``, gp.py:462-487),
+and the generators / crossovers / mutations are pure index arithmetic
+usable inside jit (SURVEY.md §7.2 item 8).
+"""
+
+from deap_tpu.gp.interpreter import make_interpreter, make_population_evaluator
+from deap_tpu.gp.pset import (
+    PrimitiveSet,
+    bool_set,
+    math_set,
+    protected_div,
+)
+from deap_tpu.gp.tree import (
+    Genome,
+    gen_full,
+    gen_grow,
+    gen_half_and_half,
+    make_cx_one_point,
+    make_cx_one_point_leaf_biased,
+    make_generator,
+    make_mut_ephemeral,
+    make_mut_insert,
+    make_mut_node_replacement,
+    make_mut_shrink,
+    make_mut_uniform,
+    static_limit,
+    subtree_end,
+    tree_height,
+)
+from deap_tpu.gp.string import to_string
+
+__all__ = [
+    "Genome",
+    "PrimitiveSet",
+    "bool_set",
+    "math_set",
+    "protected_div",
+    "make_interpreter",
+    "make_population_evaluator",
+    "make_generator",
+    "gen_full",
+    "gen_grow",
+    "gen_half_and_half",
+    "make_cx_one_point",
+    "make_cx_one_point_leaf_biased",
+    "make_mut_uniform",
+    "make_mut_node_replacement",
+    "make_mut_ephemeral",
+    "make_mut_insert",
+    "make_mut_shrink",
+    "static_limit",
+    "subtree_end",
+    "tree_height",
+    "to_string",
+]
+
+# DEAP-style aliases
+genFull = gen_full
+genGrow = gen_grow
+genHalfAndHalf = gen_half_and_half
+staticLimit = static_limit

@@ -1,0 +1,101 @@
+"""Batched GP evaluation — a stack machine over prefix arrays.
+
+This replaces the reference's per-individual string codegen + Python
+``eval`` (/root/reference/deap/gp.py:462-487, the most TPU-hostile stack
+in the reference per SURVEY.md §3.3) with a vectorised prefix-tree
+interpreter: one ``lax.scan`` over node slots, operating on a stack of
+*data vectors*, ``vmap``-batched over the population. Evaluating a
+population of trees on all datapoints is a single XLA program with no
+per-individual dispatch, and — unlike the reference, which hits a
+MemoryError past depth ~90 via nested lambda eval (gp.py:481-487) — cost
+is strictly O(max_len · vocab · points).
+
+Execution model: scan the prefix right-to-left; terminals push their
+value vector; an operator of arity k pops k operand vectors and pushes
+the result. Per slot, every primitive is evaluated on the stack top
+(vocab is small — the VPU eats the redundancy) and the node id selects
+the row; this is branch-free and fuses completely.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deap_tpu.gp.pset import PrimitiveSet
+
+
+def make_interpreter(pset: PrimitiveSet, max_len: int) -> Callable:
+    """Build ``evaluate(genome, X) -> f32[points]`` for one tree.
+
+    ``genome`` is the dict ``{"nodes": int32[max_len], "consts":
+    f32[max_len], "length": int32}``; ``X`` is ``f32[points, n_args]``.
+    vmap over genomes for populations, over X for multiple datasets.
+    """
+    arity = pset.arity_table()
+    n_ops = pset.n_ops
+    max_ar = max(pset.max_arity, 1)
+    prims = list(pset.primitives)
+
+    def interpret(genome, X):
+        nodes, consts, length = (genome["nodes"], genome["consts"],
+                                 genome["length"])
+        P = X.shape[0]
+        argsT = X.T.astype(jnp.float32)            # [n_args, P]
+        stack0 = jnp.zeros((max_len + max_ar, P), jnp.float32)
+
+        def step(carry, t):
+            stack, sp = carry
+            rt = length - 1 - t                    # walk the prefix backwards
+            valid = rt >= 0
+            slot = jnp.maximum(rt, 0)
+            node = nodes[slot]
+            # operand vectors from the stack top
+            ops_in = [
+                lax.dynamic_index_in_dim(stack, sp - 1 - i, keepdims=False)
+                for i in range(max_ar)
+            ]
+            rows = []
+            for p in prims:
+                rows.append(p.fn(*ops_in[: p.arity]))
+            rows.extend(argsT)                      # argument terminals
+            rows.append(jnp.broadcast_to(consts[slot], (P,)))  # constant
+            allv = jnp.stack(rows)                  # [n_ops + n_args + 1, P]
+            # every constant-family id (fixed terminal or ERC) shares the
+            # one constant row
+            row = jnp.minimum(node, jnp.int32(n_ops + pset.n_args))
+            res = lax.dynamic_index_in_dim(allv, row, keepdims=False)
+            ar = arity[node]
+            new_sp = sp - ar + 1
+            new_stack = lax.dynamic_update_index_in_dim(
+                stack, res, new_sp - 1, axis=0)
+            stack = jnp.where(valid, new_stack, stack)
+            sp = jnp.where(valid, new_sp, sp)
+            return (stack, sp), None
+
+        (stack, sp), _ = lax.scan(
+            step, (stack0, jnp.int32(0)), jnp.arange(max_len))
+        return stack[0]
+
+    return interpret
+
+
+def make_population_evaluator(pset: PrimitiveSet, max_len: int,
+                              loss: Callable) -> Callable:
+    """``evaluate(genomes, X, y) -> f32[pop]``-style batched evaluator:
+    interpret every tree on every datapoint and reduce with ``loss(pred,
+    X, ...)``. The usual symbolic-regression fitness (mean squared error
+    over the sample points, examples/gp/symbreg.py:55-61) is
+    ``loss=lambda pred, y: jnp.mean((pred - y) ** 2)``.
+    """
+    interp = make_interpreter(pset, max_len)
+
+    def evaluate(genomes, X, y):
+        preds = jax.vmap(lambda g: interp(g, X))(genomes)   # [pop, points]
+        return jax.vmap(lambda p: loss(p, y))(preds)
+
+    return evaluate

@@ -1,0 +1,236 @@
+"""Primitive sets — the GP instruction vocabulary as static tables.
+
+Counterpart of the reference's ``PrimitiveSet`` / ``PrimitiveSetTyped``
+(/root/reference/deap/gp.py:260-456), re-designed for tensor trees: the
+set compiles to static arrays (arity table, terminal masks, constant
+pool) consumed by the batched interpreter and the on-device tree
+operators. Where the reference stores Python callables evaluated through
+string codegen + ``eval`` (gp.py:462-487), primitives here are jnp
+element-wise functions applied to stack slices — no codegen, no eval,
+jit-safe.
+
+Node-id encoding for a set with ``n_ops`` operators, ``n_args`` inputs
+and a constant pool:
+
+- ``0 .. n_ops-1``       — operators (arity from ``arity_table``)
+- ``n_ops .. n_ops+n_args-1`` — input arguments ARG0..ARGn
+- ``n_ops+n_args .. +n_consts-1`` — fixed constant terminals
+- ``n_ops+n_args+n_consts``        — the ephemeral constant (ERC)
+
+Every constant-family node reads its value from the parallel ``consts``
+array (covering the reference's fixed terminals and ephemerals,
+gp.py:187-257); distinct ids let ``mut_ephemeral`` target only ERCs
+while the interpreter collapses all of them onto one shared stack row.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class _Primitive:
+    name: str
+    fn: Callable            # (a, b, c ...) element-wise jnp function
+    arity: int
+    fmt: Optional[str] = None  # e.g. "({0} + {1})" for pretty printing
+
+    def format(self, *args: str) -> str:
+        if self.fmt:
+            return self.fmt.format(*args)
+        return f"{self.name}({', '.join(args)})"
+
+
+class PrimitiveSet:
+    """Untyped strongly-vectorised primitive set.
+
+    :param name: set name (kept for parity with gp.py:447-456).
+    :param arity: number of input arguments (the reference's ``in_types``
+        count for untyped sets).
+    :param prefix: argument name prefix (``ARG0``, ``ARG1``, ...).
+    """
+
+    def __init__(self, name: str, arity: int, prefix: str = "ARG"):
+        self.name = name
+        self.n_args = arity
+        self.arg_names = [f"{prefix}{i}" for i in range(arity)]
+        self.primitives: List[_Primitive] = []
+        self.const_values: List[float] = []     # fixed terminal pool
+        self.const_names: List[str] = []
+        self.erc_sampler: Optional[Callable] = None
+        self.erc_name: Optional[str] = None
+
+    # ------------------------------------------------------------ builder ----
+
+    def add_primitive(self, fn: Callable, arity: int,
+                      name: Optional[str] = None,
+                      fmt: Optional[str] = None) -> None:
+        """Register an operator (gp.py:339-360). ``fn`` must be an
+        element-wise jnp function of ``arity`` arrays."""
+        assert arity >= 1, "arity should be >= 1"
+        self.primitives.append(
+            _Primitive(name or fn.__name__, fn, arity, fmt))
+
+    def add_terminal(self, value: float, name: Optional[str] = None) -> None:
+        """Register a constant terminal (gp.py:362-382). Stored in the
+        constant pool; sampled uniformly among fixed terminals."""
+        self.const_values.append(float(value))
+        self.const_names.append(name if name is not None else repr(value))
+
+    def add_ephemeral_constant(self, name: str,
+                               sampler: Callable[[jax.Array], jnp.ndarray]) -> None:
+        """Register an ephemeral random constant (gp.py:384-414):
+        ``sampler(key) -> scalar`` drawn fresh for every ERC node."""
+        if self.erc_sampler is not None:
+            raise ValueError("one ephemeral constant pool per set")
+        self.erc_sampler = sampler
+        self.erc_name = name
+
+    def rename_arguments(self, **kwargs: str) -> None:
+        """Rename ARGi (gp.py:418-428): ``pset.rename_arguments(ARG0='x')``."""
+        for key, val in kwargs.items():
+            if key.startswith("ARG"):
+                self.arg_names[int(key[3:])] = val
+
+    # ------------------------------------------------------------- tables ----
+
+    @property
+    def n_ops(self) -> int:
+        return len(self.primitives)
+
+    @property
+    def n_consts(self) -> int:
+        return len(self.const_values)
+
+    @property
+    def has_erc(self) -> bool:
+        return self.erc_sampler is not None
+
+    @property
+    def const_id(self) -> int:
+        """First constant-family node id; every id >= this reads the
+        ``consts`` array (one shared interpreter row)."""
+        return self.n_ops + self.n_args
+
+    @property
+    def erc_id(self) -> int:
+        """Node id of the ephemeral constant (valid only if has_erc)."""
+        return self.n_ops + self.n_args + self.n_consts
+
+    @property
+    def vocab(self) -> int:
+        return self.n_ops + self.n_args + self.n_consts + (1 if self.has_erc else 0)
+
+    @property
+    def max_arity(self) -> int:
+        return max((p.arity for p in self.primitives), default=0)
+
+    @property
+    def n_terminal_choices(self) -> int:
+        """Distinct terminal draws: args + fixed consts + ERC
+        (the denominator of the reference's terminalRatio, gp.py:306)."""
+        return self.n_args + self.n_consts + (1 if self.has_erc else 0)
+
+    @property
+    def terminal_ratio(self) -> float:
+        """terminals / (terminals + primitives) (gp.py:303-308)."""
+        t = self.n_terminal_choices
+        return t / (t + self.n_ops)
+
+    def arity_table(self) -> jnp.ndarray:
+        """int32[vocab] — operator arities then zeros for terminals."""
+        n_term = self.vocab - self.n_ops
+        return jnp.asarray(
+            [p.arity for p in self.primitives] + [0] * n_term, jnp.int32)
+
+    def sample_terminal(self, key: jax.Array) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Uniform terminal draw → (node_id, const_value)."""
+        k_c, k_v = jax.random.split(key)
+        n_t = self.n_terminal_choices
+        choice = jax.random.randint(k_c, (), 0, n_t)
+        node = self.n_ops + choice                 # ids are laid out in order
+        if self.n_consts:
+            pool = jnp.asarray(self.const_values, jnp.float32)
+            fixed = pool[jnp.clip(choice - self.n_args, 0, self.n_consts - 1)]
+        else:
+            fixed = jnp.float32(0.0)
+        if self.has_erc:
+            erc = self.erc_sampler(k_v)
+            value = jnp.where(choice == self.n_args + self.n_consts, erc, fixed)
+        else:
+            value = fixed
+        return node.astype(jnp.int32), jnp.asarray(value, jnp.float32)
+
+    def sample_op(self, key: jax.Array,
+                  max_arity: Optional[int] = None) -> jnp.ndarray:
+        """Uniform operator draw; ``max_arity`` restricts to ops whose
+        arity fits the remaining space."""
+        if max_arity is None or max_arity >= self.max_arity:
+            return jax.random.randint(key, (), 0, self.n_ops, jnp.int32)
+        ok = np.asarray([p.arity <= max_arity for p in self.primitives])
+        idx = np.flatnonzero(ok)
+        pick = jax.random.randint(key, (), 0, len(idx))
+        return jnp.asarray(idx, jnp.int32)[pick]
+
+    # ------------------------------------------------------------ display ----
+
+    def node_name(self, node_id: int, const: float = 0.0) -> str:
+        if node_id < self.n_ops:
+            return self.primitives[node_id].name
+        if node_id < self.const_id:
+            return self.arg_names[node_id - self.n_ops]
+        if node_id < self.erc_id:
+            return self.const_names[node_id - self.const_id]
+        return repr(round(float(const), 6))
+
+
+# ------------------------------------------------------- stock primitives ----
+
+def protected_div(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """x/y with 1 where y == 0 (the reference's protectedDiv pattern,
+    examples/gp/symbreg.py:33-37)."""
+    return jnp.where(b == 0.0, 1.0, a / jnp.where(b == 0.0, 1.0, b))
+
+
+def math_set(n_args: int = 1, erc_low: float = -1.0, erc_high: float = 1.0,
+             trig: bool = True, erc: bool = True,
+             name: str = "MAIN") -> PrimitiveSet:
+    """The canonical symbolic-regression vocabulary
+    (examples/gp/symbreg.py:40-51: add/sub/mul/protectedDiv/neg/cos/sin +
+    uniform ERC)."""
+    ps = PrimitiveSet(name, n_args)
+    ps.add_primitive(jnp.add, 2, "add", "({0} + {1})")
+    ps.add_primitive(jnp.subtract, 2, "sub", "({0} - {1})")
+    ps.add_primitive(jnp.multiply, 2, "mul", "({0} * {1})")
+    ps.add_primitive(protected_div, 2, "protectedDiv", "({0} / {1})")
+    ps.add_primitive(jnp.negative, 1, "neg", "(-{0})")
+    if trig:
+        ps.add_primitive(jnp.cos, 1, "cos")
+        ps.add_primitive(jnp.sin, 1, "sin")
+    if erc:
+        ps.add_ephemeral_constant(
+            "rand101", lambda k: jax.random.uniform(
+                k, (), minval=erc_low, maxval=erc_high))
+    return ps
+
+
+def bool_set(n_args: int, name: str = "BOOL") -> PrimitiveSet:
+    """Boolean vocabulary over {0.0, 1.0} floats — the untyped tensor
+    formulation of the reference's parity/multiplexer sets
+    (examples/gp/parity.py:46-57, examples/gp/multiplexer.py:45-57)."""
+    ps = PrimitiveSet(name, n_args)
+    ps.add_primitive(lambda a, b: a * b, 2, "and_", "({0} & {1})")
+    ps.add_primitive(lambda a, b: jnp.minimum(a + b, 1.0), 2, "or_",
+                     "({0} | {1})")
+    ps.add_primitive(lambda a: 1.0 - a, 1, "not_", "(~{0})")
+    ps.add_primitive(lambda a, b: jnp.abs(a - b), 2, "xor_", "({0} ^ {1})")
+    ps.add_primitive(lambda c, a, b: jnp.where(c > 0.5, a, b), 3,
+                     "if_then_else")
+    ps.add_terminal(0.0, "False")
+    ps.add_terminal(1.0, "True")
+    return ps

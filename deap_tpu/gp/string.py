@@ -1,0 +1,79 @@
+"""Host-side tree display and parsing.
+
+Counterpart of the reference's ``PrimitiveTree.__str__`` (stack-based
+prefix→infix printer, /root/reference/deap/gp.py:90-104) and
+``PrimitiveTree.from_string`` (gp.py:106-153) — for logging, debugging
+and checkpoint round-trips. These run on host numpy arrays; the device
+never needs strings.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from deap_tpu.gp.pset import PrimitiveSet
+
+
+def to_string(genome, pset: PrimitiveSet) -> str:
+    """Render a prefix-array genome as a readable expression."""
+    nodes = np.asarray(genome["nodes"])
+    consts = np.asarray(genome["consts"])
+    length = int(genome["length"])
+
+    def render(i: int) -> Tuple[str, int]:
+        node = int(nodes[i])
+        if node < pset.n_ops:
+            prim = pset.primitives[node]
+            args, j = [], i + 1
+            for _ in range(prim.arity):
+                s, j = render(j)
+                args.append(s)
+            return prim.format(*args), j
+        return pset.node_name(node, consts[i]), i + 1
+
+    if length == 0:
+        return ""
+    s, end = render(0)
+    assert end == length, f"malformed prefix tree: used {end} of {length}"
+    return s
+
+
+def from_string(expr: str, pset: PrimitiveSet, max_len: int):
+    """Parse ``name(arg, ...)`` prefix syntax into a genome dict
+    (gp.py:106-153). Tokens must name primitives, arguments, fixed
+    terminals, or be numeric literals (stored as constants)."""
+    import re
+
+    tokens = re.split(r"[ \t\n\r\f\v(),]+", expr)
+    tokens = [t for t in tokens if t]
+    prim_by_name = {p.name: i for i, p in enumerate(pset.primitives)}
+    arg_by_name = {n: pset.n_ops + i for i, n in enumerate(pset.arg_names)}
+    const_by_name = {n: pset.const_id + i
+                     for i, n in enumerate(pset.const_names)}
+
+    nodes = np.full(max_len, pset.const_id, np.int32)
+    consts = np.zeros(max_len, np.float32)
+    for t, tok in enumerate(tokens):
+        if t >= max_len:
+            raise ValueError(f"expression longer than max_len={max_len}")
+        if tok in prim_by_name:
+            nodes[t] = prim_by_name[tok]
+        elif tok in arg_by_name:
+            nodes[t] = arg_by_name[tok]
+        elif tok in const_by_name:
+            nodes[t] = const_by_name[tok]
+            consts[t] = pset.const_values[const_by_name[tok] - pset.const_id]
+        else:
+            try:
+                value = float(tok)
+            except ValueError:
+                raise TypeError(
+                    f"unknown symbol {tok!r} in expression") from None
+            nodes[t] = pset.erc_id if pset.has_erc else pset.const_id
+            consts[t] = value
+    import jax.numpy as jnp
+
+    return {"nodes": jnp.asarray(nodes), "consts": jnp.asarray(consts),
+            "length": jnp.int32(len(tokens))}

@@ -1,0 +1,440 @@
+"""Tensor prefix trees — generation and variation as index arithmetic.
+
+Counterpart of the reference's ``PrimitiveTree`` machinery
+(/root/reference/deap/gp.py): the generators genFull/genGrow/
+genHalfAndHalf (gp.py:519-638), subtree search (searchSubtree,
+gp.py:174-184), crossover (cxOnePoint gp.py:645-682,
+cxOnePointLeafBiased gp.py:685-737) and mutations (mutUniform :743,
+mutNodeReplacement :760, mutEphemeral :786, mutInsert :814, mutShrink
+:854), plus the staticLimit bloat-control decorator (gp.py:890-931).
+
+A tree is a fixed-width prefix array (SURVEY.md §7.2 item 8):
+``{"nodes": int32[max_len], "consts": f32[max_len], "length": int32}``.
+Slots past ``length`` are padding. All operators are pure jax functions
+usable inside jit/vmap/scan; "would exceed max_len" replaces the
+reference's unbounded list growth and returns the parent unchanged —
+the array-width analog of staticLimit's reject-and-keep-parent.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deap_tpu.gp.pset import PrimitiveSet
+
+Genome = Dict[str, jnp.ndarray]
+
+
+# ------------------------------------------------------------- generation ----
+
+def make_generator(pset: PrimitiveSet, max_len: int, min_depth: int,
+                   max_depth: int, mode: str = "half_and_half",
+                   ) -> Callable[[jax.Array], Genome]:
+    """Build ``gen(key) -> genome``, the tensor counterpart of
+    genFull/genGrow/genHalfAndHalf (gp.py:519-638).
+
+    The tree grows by scanning slots with a LIFO stack of pending
+    (depth-of-slot) entries. A node is a terminal when its depth reaches
+    the height budget, when the array is nearly full, or — in grow mode —
+    with probability ``terminalRatio`` once past ``min_depth``
+    (gp.py:555-582 semantics, vectorised).
+    """
+    if mode not in ("full", "grow", "half_and_half"):
+        raise ValueError(mode)
+    t_ratio = pset.terminal_ratio
+    arity = pset.arity_table()
+
+    def gen(key: jax.Array) -> Genome:
+        k_h, k_mode, k_scan = jax.random.split(key, 3)
+        height = jax.random.randint(k_h, (), min_depth, max_depth + 1)
+        if mode == "full":
+            grow = jnp.bool_(False)
+        elif mode == "grow":
+            grow = jnp.bool_(True)
+        else:
+            grow = jax.random.bernoulli(k_mode, 0.5)
+
+        nodes0 = jnp.full((max_len,), pset.const_id, jnp.int32)
+        consts0 = jnp.zeros((max_len,), jnp.float32)
+        depth_stack0 = jnp.zeros((max_len + 1,), jnp.int32)
+
+        def step(carry, inp):
+            nodes, consts, stack, sp, length = carry
+            t, k = inp
+            pending = sp > 0
+            d = stack[jnp.maximum(sp - 1, 0)]
+            sp_pop = sp - 1
+
+            k_t, k_term, k_op = jax.random.split(k, 3)
+            # space guard: after this node the pending subtrees must each
+            # still fit one slot
+            room = max_len - t - sp_pop - 1
+            force_term = (d >= height) | (room < 1)
+            grow_term = grow & (d >= min_depth) & (
+                jax.random.uniform(k_t) < t_ratio)
+            is_term = force_term | grow_term
+
+            term_node, term_val = pset.sample_terminal(k_term)
+            op_node = pset.sample_op(k_op)
+            # operator whose arity overflows the space guard → terminal
+            is_term = is_term | (arity[op_node] > room)
+            node = jnp.where(is_term, term_node, op_node)
+            val = jnp.where(is_term, term_val, 0.0)
+
+            nodes = jnp.where(pending, nodes.at[t].set(node), nodes)
+            consts = jnp.where(pending, consts.at[t].set(val), consts)
+            # push children (depth d+1); LIFO order makes the walk prefix
+            ar = jnp.where(is_term, 0, arity[op_node])
+            idx = jnp.arange(max_len + 1)
+            push = (idx >= sp_pop) & (idx < sp_pop + ar)
+            stack = jnp.where(push, d + 1, stack)
+            sp = jnp.where(pending, sp_pop + ar, sp)
+            length = length + pending.astype(jnp.int32)
+            return (nodes, consts, stack, sp, length), None
+
+        keys = jax.random.split(k_scan, max_len)
+        init = (nodes0, consts0, depth_stack0.at[0].set(0), jnp.int32(1),
+                jnp.int32(0))
+        (nodes, consts, _, _, length), _ = lax.scan(
+            step, init, (jnp.arange(max_len), keys))
+        return {"nodes": nodes, "consts": consts, "length": length}
+
+    return gen
+
+
+def gen_full(pset, max_len, min_, max_):
+    return make_generator(pset, max_len, min_, max_, "full")
+
+
+def gen_grow(pset, max_len, min_, max_):
+    return make_generator(pset, max_len, min_, max_, "grow")
+
+
+def gen_half_and_half(pset, max_len, min_, max_):
+    return make_generator(pset, max_len, min_, max_, "half_and_half")
+
+
+# -------------------------------------------------------- tree arithmetic ----
+
+def subtree_end(nodes: jnp.ndarray, arity: jnp.ndarray,
+                begin: jnp.ndarray) -> jnp.ndarray:
+    """Exclusive end of the subtree rooted at ``begin`` — the arity walk
+    of searchSubtree (gp.py:174-184) as a cumulative sum: the subtree
+    closes at the first j ≥ begin where 1 + Σ(arity−1) over [begin, j]
+    hits zero."""
+    L = nodes.shape[0]
+    deficit = arity[nodes] - 1                      # -1 terminals, +k ops
+    cs = jnp.cumsum(deficit)
+    prev = jnp.where(begin > 0, cs[jnp.maximum(begin - 1, 0)], 0)
+    total = 1 + cs - prev                           # pending count after j
+    closed = (total == 0) & (jnp.arange(L) >= begin)
+    return jnp.argmax(closed) + 1
+
+
+def tree_height(genome: Genome, pset: PrimitiveSet) -> jnp.ndarray:
+    """Tree height (root at 0), the measure of staticLimit/height
+    (gp.py:155-166). Prefix-walk with a depth stack."""
+    arity = pset.arity_table()
+    nodes, length = genome["nodes"], genome["length"]
+    L = nodes.shape[0]
+
+    def step(carry, t):
+        stack, sp, height = carry
+        pending = t < length
+        d = stack[jnp.maximum(sp - 1, 0)]
+        sp_pop = sp - 1
+        ar = arity[nodes[t]]
+        idx = jnp.arange(L + 1)
+        push = (idx >= sp_pop) & (idx < sp_pop + ar)
+        stack = jnp.where(pending & push, d + 1, stack)
+        sp = jnp.where(pending, sp_pop + ar, sp)
+        height = jnp.where(pending, jnp.maximum(height, d), height)
+        return (stack, sp, height), None
+
+    init = (jnp.zeros((L + 1,), jnp.int32), jnp.int32(1), jnp.int32(0))
+    (_, _, height), _ = lax.scan(step, init, jnp.arange(L))
+    return height
+
+
+def _splice(g: Genome, begin, end, donor_nodes, donor_consts, donor_begin,
+            donor_len) -> Genome:
+    """Replace ``g[begin:end]`` with ``donor[donor_begin:+donor_len]``.
+
+    Pure gather over output slots; if the result would exceed max_len the
+    parent is returned unchanged (the fixed-width staticLimit analog)."""
+    L = g["nodes"].shape[0]
+    seg = end - begin
+    new_len = g["length"] - seg + donor_len
+    k = jnp.arange(L)
+    in_head = k < begin
+    in_donor = (k >= begin) & (k < begin + donor_len)
+    src_tail = jnp.clip(k - donor_len + seg, 0, L - 1)
+    src_donor = jnp.clip(donor_begin + k - begin, 0, L - 1)
+
+    def mix(own, donor):
+        return jnp.where(in_head, own,
+                         jnp.where(in_donor, donor[src_donor], own[src_tail]))
+
+    ok = new_len <= L
+    nodes = jnp.where(ok, mix(g["nodes"], donor_nodes), g["nodes"])
+    consts = jnp.where(ok, mix(g["consts"], donor_consts), g["consts"])
+    length = jnp.where(ok, new_len, g["length"])
+    return {"nodes": nodes, "consts": consts, "length": length}
+
+
+# -------------------------------------------------------------- crossover ----
+
+def make_cx_one_point(pset: PrimitiveSet) -> Callable:
+    """One-point subtree crossover (gp.py:645-682): swap a random subtree
+    of each parent, roots excluded; trees shorter than 2 nodes pass
+    through unchanged, as in the reference."""
+    arity = pset.arity_table()
+
+    def cx(key: jax.Array, g1: Genome, g2: Genome) -> Tuple[Genome, Genome]:
+        k1, k2 = jax.random.split(key)
+        len1, len2 = g1["length"], g2["length"]
+        ok = (len1 >= 2) & (len2 >= 2)
+        i1 = jnp.where(len1 >= 2,
+                       jax.random.randint(k1, (), 1, jnp.maximum(len1, 2)), 0)
+        i2 = jnp.where(len2 >= 2,
+                       jax.random.randint(k2, (), 1, jnp.maximum(len2, 2)), 0)
+        e1 = subtree_end(g1["nodes"], arity, i1)
+        e2 = subtree_end(g2["nodes"], arity, i2)
+        c1 = _splice(g1, i1, e1, g2["nodes"], g2["consts"], i2, e2 - i2)
+        c2 = _splice(g2, i2, e2, g1["nodes"], g1["consts"], i1, e1 - i1)
+
+        def pick(child, parent):
+            return jax.tree_util.tree_map(
+                lambda a, b: jnp.where(ok, a, b), child, parent)
+
+        return pick(c1, g1), pick(c2, g2)
+
+    return cx
+
+
+def make_cx_one_point_leaf_biased(pset: PrimitiveSet,
+                                  termpb: float = 0.1) -> Callable:
+    """Leaf-biased crossover (gp.py:685-737): each tree independently
+    picks a terminal point with probability ``termpb``, else an internal
+    operator (the Koza 90/10 rule; draws are per-tree like the
+    reference's two separate ``random.random() < termpb`` tests,
+    gp.py:710-711)."""
+    arity = pset.arity_table()
+
+    def pick_point(key, g, want_leaf):
+        nodes, length = g["nodes"], g["length"]
+        L = nodes.shape[0]
+        in_tree = (jnp.arange(L) >= 1) & (jnp.arange(L) < length)
+        is_leaf = arity[nodes] == 0
+        mask = in_tree & jnp.where(want_leaf, is_leaf, ~is_leaf)
+        # fall back to any non-root node when the class is empty
+        mask = jnp.where(mask.any(), mask, in_tree)
+        scores = jax.random.uniform(key, (L,))
+        return jnp.argmax(jnp.where(mask, scores, -1.0))
+
+    def cx(key: jax.Array, g1: Genome, g2: Genome) -> Tuple[Genome, Genome]:
+        k_b1, k_b2, k1, k2 = jax.random.split(key, 4)
+        ok = (g1["length"] >= 2) & (g2["length"] >= 2)
+        i1 = pick_point(k1, g1, jax.random.bernoulli(k_b1, termpb))
+        i2 = pick_point(k2, g2, jax.random.bernoulli(k_b2, termpb))
+        e1 = subtree_end(g1["nodes"], arity, i1)
+        e2 = subtree_end(g2["nodes"], arity, i2)
+        c1 = _splice(g1, i1, e1, g2["nodes"], g2["consts"], i2, e2 - i2)
+        c2 = _splice(g2, i2, e2, g1["nodes"], g1["consts"], i1, e1 - i1)
+
+        def pick(child, parent):
+            return jax.tree_util.tree_map(
+                lambda a, b: jnp.where(ok, a, b), child, parent)
+
+        return pick(c1, g1), pick(c2, g2)
+
+    return cx
+
+
+# -------------------------------------------------------------- mutations ----
+
+def make_mut_uniform(pset: PrimitiveSet, expr: Callable) -> Callable:
+    """Replace a random subtree with a fresh expression from ``expr``
+    (mutUniform, gp.py:743-757; symbreg uses genFull(0, 2) for expr)."""
+    arity = pset.arity_table()
+
+    def mut(key: jax.Array, g: Genome) -> Genome:
+        k_i, k_e = jax.random.split(key)
+        i = jax.random.randint(k_i, (), 0, jnp.maximum(g["length"], 1))
+        e = subtree_end(g["nodes"], arity, i)
+        new = expr(k_e)
+        return _splice(g, i, e, new["nodes"], new["consts"], 0,
+                       new["length"])
+
+    return mut
+
+
+def make_mut_node_replacement(pset: PrimitiveSet) -> Callable:
+    """Swap one node for another of the same arity (mutNodeReplacement,
+    gp.py:760-783): terminals get a fresh terminal draw, operators an
+    operator of equal arity."""
+    arity = pset.arity_table()
+    import numpy as np
+    # same-arity pools as a static [max_arity+1, n_ops] mask
+    pools = np.zeros((pset.max_arity + 1, max(pset.n_ops, 1)), bool)
+    for j, p in enumerate(pset.primitives):
+        pools[p.arity, j] = True
+    pools_j = jnp.asarray(pools)
+
+    def mut(key: jax.Array, g: Genome) -> Genome:
+        k_i, k_t, k_o = jax.random.split(key, 3)
+        i = jax.random.randint(k_i, (), 0, jnp.maximum(g["length"], 1))
+        node = g["nodes"][i]
+        ar = arity[node]
+        term_node, term_val = pset.sample_terminal(k_t)
+        scores = jax.random.uniform(k_o, (max(pset.n_ops, 1),))
+        op_node = jnp.argmax(
+            jnp.where(pools_j[ar], scores, -1.0)).astype(jnp.int32)
+        is_term = ar == 0
+        new_node = jnp.where(is_term, term_node, op_node)
+        new_val = jnp.where(is_term, term_val, g["consts"][i])
+        return {
+            "nodes": g["nodes"].at[i].set(new_node),
+            "consts": g["consts"].at[i].set(new_val),
+            "length": g["length"],
+        }
+
+    return mut
+
+
+def make_mut_ephemeral(pset: PrimitiveSet, mode: str = "one") -> Callable:
+    """Resample ephemeral constants (mutEphemeral, gp.py:786-811):
+    ``mode='one'`` redraws a single random ERC node, ``'all'`` every one."""
+    if not pset.has_erc:
+        raise ValueError("primitive set has no ephemeral constant")
+    if mode not in ("one", "all"):
+        raise ValueError(mode)
+
+    def mut(key: jax.Array, g: Genome) -> Genome:
+        L = g["nodes"].shape[0]
+        k_pick, k_val = jax.random.split(key)
+        is_erc = (g["nodes"] == pset.erc_id) & (jnp.arange(L) < g["length"])
+        new_vals = jax.vmap(pset.erc_sampler)(jax.random.split(k_val, L))
+        if mode == "one":
+            scores = jax.random.uniform(k_pick, (L,))
+            chosen = jnp.argmax(jnp.where(is_erc, scores, -1.0))
+            target = is_erc & (jnp.arange(L) == chosen)
+        else:
+            target = is_erc
+        return {
+            "nodes": g["nodes"],
+            "consts": jnp.where(target, new_vals, g["consts"]),
+            "length": g["length"],
+        }
+
+    return mut
+
+
+def make_mut_insert(pset: PrimitiveSet) -> Callable:
+    """Insert a new operator above a random subtree (mutInsert,
+    gp.py:814-851): the old subtree becomes one randomly-chosen argument
+    of the new node; the remaining arguments are fresh terminals."""
+    arity = pset.arity_table()
+    max_ar = max(pset.max_arity, 1)
+
+    def mut(key: jax.Array, g: Genome) -> Genome:
+        L = g["nodes"].shape[0]
+        k_i, k_op, k_slot, k_terms = jax.random.split(key, 4)
+        i = jax.random.randint(k_i, (), 0, jnp.maximum(g["length"], 1))
+        e = subtree_end(g["nodes"], arity, i)
+        seg = e - i
+        op = pset.sample_op(k_op)
+        ar = arity[op]
+        pos = jax.random.randint(k_slot, (), 0, jnp.maximum(ar, 1))
+        t_nodes, t_vals = jax.vmap(pset.sample_terminal)(
+            jax.random.split(k_terms, max_ar))
+
+        # donor = [op] + pos terminals + subtree + (ar-1-pos) terminals
+        DL = 1 + max_ar + L
+        k = jnp.arange(DL)
+        donor_nodes = jnp.zeros((DL,), jnp.int32)
+        donor_consts = jnp.zeros((DL,), jnp.float32)
+        donor_nodes = donor_nodes.at[0].set(op)
+        in_pre = (k >= 1) & (k < 1 + pos)
+        in_sub = (k >= 1 + pos) & (k < 1 + pos + seg)
+        in_post = (k >= 1 + pos + seg) & (k < 1 + seg + ar - 1)
+        src_term_pre = jnp.clip(k - 1, 0, max_ar - 1)
+        src_sub = jnp.clip(i + k - 1 - pos, 0, L - 1)
+        src_term_post = jnp.clip(k - 1 - seg, 0, max_ar - 1)
+        donor_nodes = jnp.where(
+            in_pre, t_nodes[src_term_pre], jnp.where(
+                in_sub, g["nodes"][src_sub], jnp.where(
+                    in_post, t_nodes[src_term_post], donor_nodes)))
+        donor_consts = jnp.where(
+            in_pre, t_vals[src_term_pre], jnp.where(
+                in_sub, g["consts"][src_sub], jnp.where(
+                    in_post, t_vals[src_term_post], donor_consts)))
+        donor_len = 1 + (ar - 1) + seg
+        return _splice(g, i, e, donor_nodes, donor_consts, 0, donor_len)
+
+    return mut
+
+
+def make_mut_shrink(pset: PrimitiveSet) -> Callable:
+    """Collapse a random operator node to one of its argument subtrees
+    (mutShrink, gp.py:854-887); trees with no operator below the root
+    pass through unchanged."""
+    arity = pset.arity_table()
+    max_ar = max(pset.max_arity, 1)
+
+    def mut(key: jax.Array, g: Genome) -> Genome:
+        L = g["nodes"].shape[0]
+        k_i, k_c = jax.random.split(key)
+        # the reference exempts the root and tiny trees (len < 3 or
+        # height <= 1, gp.py:858-860): shrink only operators below root
+        in_tree = (jnp.arange(L) >= 1) & (jnp.arange(L) < g["length"])
+        is_op = (arity[g["nodes"]] > 0) & in_tree
+        has_op = is_op.any() & (g["length"] >= 3)
+        scores = jax.random.uniform(k_i, (L,))
+        i = jnp.argmax(jnp.where(is_op, scores, -1.0))
+        ar = arity[g["nodes"]][i]
+        child = jax.random.randint(k_c, (), 0, jnp.maximum(ar, 1))
+
+        # walk to the chosen child's start: c0 = i+1, c_{k+1} = end(c_k)
+        def walk(j, start):
+            return jnp.where(j < child,
+                             subtree_end(g["nodes"], arity, start), start)
+
+        c_begin = lax.fori_loop(0, max_ar, walk, i + 1)
+        c_end = subtree_end(g["nodes"], arity, c_begin)
+        e = subtree_end(g["nodes"], arity, i)
+        out = _splice(g, i, e, g["nodes"], g["consts"], c_begin,
+                      c_end - c_begin)
+        return jax.tree_util.tree_map(
+            lambda a, b: jnp.where(has_op, a, b), out, g)
+
+    return mut
+
+
+# ------------------------------------------------------------ bloat control ----
+
+def static_limit(measure: Callable, max_value: int) -> Callable:
+    """Decorator keeping the parent when an offspring exceeds the limit
+    (staticLimit, gp.py:890-931; Koza's height-17 rule). ``measure``
+    maps a genome to a scalar (e.g. ``tree_height`` partial or
+    ``lambda g: g['length']``)."""
+
+    def decorator(op):
+        def wrapped(key, *genomes):
+            out = op(key, *genomes)
+            outs = out if isinstance(out, tuple) else (out,)
+            kept = []
+            for child, parent in zip(outs, genomes):
+                bad = measure(child) > max_value
+                kept.append(jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(bad, b, a), child, parent))
+            return tuple(kept) if isinstance(out, tuple) else kept[0]
+
+        return wrapped
+
+    return decorator

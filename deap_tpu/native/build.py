@@ -1,0 +1,31 @@
+"""Build the native hypervolume shared library.
+
+Counterpart of the reference's extension build (setup.py:60 with its
+graceful build-failure fallback, setup.py:35-53): ``python -m
+deap_tpu.native.build`` compiles ``src/hv.cpp`` with g++ into
+``_libhv.so`` next to this file; the ctypes binding picks it up on the
+next import, and :mod:`deap_tpu.native` falls back to the pure-Python
+WFG implementation when it is absent.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import subprocess
+
+HERE = pathlib.Path(__file__).resolve().parent
+SRC = HERE / "src" / "hv.cpp"
+LIB = HERE / "_libhv.so"
+
+
+def build(verbose: bool = True) -> pathlib.Path:
+    cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+           str(SRC), "-o", str(LIB)]
+    if verbose:
+        print(" ".join(cmd))
+    subprocess.run(cmd, check=True)
+    return LIB
+
+
+if __name__ == "__main__":
+    print(build())

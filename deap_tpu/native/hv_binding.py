@@ -1,0 +1,66 @@
+"""ctypes binding for the native hypervolume library.
+
+The reference binds its C hypervolume through a hand-written CPython
+module (/root/reference/deap/tools/_hypervolume/hv.cpp:29-121); here the
+C++ core exports a plain C ABI and this module loads it with ctypes —
+no compiled Python glue to keep in sync. Importing this module raises
+if the shared library is missing (triggering the pure-Python fallback
+in :mod:`deap_tpu.native`); build it with ``python -m
+deap_tpu.native.build``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+
+import numpy as np
+
+_LIB_PATH = pathlib.Path(__file__).resolve().parent / "_libhv.so"
+
+if not _LIB_PATH.exists():
+    # One cheap automatic build attempt, mirroring setup.py's optional
+    # build with graceful failure (reference setup.py:93-108).
+    from deap_tpu.native.build import build
+
+    build(verbose=False)
+
+_lib = ctypes.CDLL(str(_LIB_PATH))
+
+_lib.deap_tpu_hypervolume.restype = ctypes.c_double
+_lib.deap_tpu_hypervolume.argtypes = [
+    ctypes.POINTER(ctypes.c_double), ctypes.c_int, ctypes.c_int,
+    ctypes.POINTER(ctypes.c_double)]
+_lib.deap_tpu_hv_contributions.restype = None
+_lib.deap_tpu_hv_contributions.argtypes = [
+    ctypes.POINTER(ctypes.c_double), ctypes.c_int, ctypes.c_int,
+    ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double)]
+
+
+def _as_c(points, ref):
+    pts = np.ascontiguousarray(points, dtype=np.float64)
+    r = np.ascontiguousarray(ref, dtype=np.float64)
+    if pts.ndim != 2 or pts.shape[1] != r.shape[0]:
+        raise ValueError("points must be [n, d] with d == len(ref)")
+    return pts, r
+
+
+def hypervolume(points, ref) -> float:
+    """Exact hypervolume (minimisation) of ``points`` w.r.t. ``ref``."""
+    pts, r = _as_c(points, ref)
+    n, d = pts.shape
+    return float(_lib.deap_tpu_hypervolume(
+        pts.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), n, d,
+        r.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+
+
+def hv_contributions(points, ref) -> np.ndarray:
+    """Leave-one-out exclusive hypervolume contribution per point."""
+    pts, r = _as_c(points, ref)
+    n, d = pts.shape
+    out = np.empty(n, dtype=np.float64)
+    _lib.deap_tpu_hv_contributions(
+        pts.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), n, d,
+        r.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    return out

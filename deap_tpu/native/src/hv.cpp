@@ -1,0 +1,188 @@
+// Native exact hypervolume for deap_tpu.
+//
+// Counterpart of the reference's C extension (_hv.c / hv.cpp — the
+// Fonseca–Paquete–López-Ibáñez dimension-sweep implementation,
+// /root/reference/deap/tools/_hypervolume/_hv.c:59,1456). This is an
+// independent implementation of the WFG exclusive-hypervolume recursion
+// (While, Bradstreet & Barone 2012) with a 2-D staircase base case —
+// written for this framework, not a port of the reference's AVL-tree
+// sweep code. Exposed through a plain C ABI consumed via ctypes
+// (deap_tpu/native/hv_binding.py), mirroring the reference's
+// graceful-fallback import seam (deap/tools/indicator.py:3-8).
+//
+// Convention: MINIMISATION relative to `ref`; points not strictly below
+// the reference point in every objective contribute nothing.
+
+#include <algorithm>
+#include <cstddef>
+#include <vector>
+
+namespace {
+
+struct Front {
+    // Flat row-major [n, d] storage with index indirection to avoid
+    // copying rows during sorts.
+    std::vector<double> data;
+    int d = 0;
+
+    std::size_t size() const { return d ? data.size() / d : 0; }
+    const double* row(std::size_t i) const { return data.data() + i * d; }
+    void push(const double* p) { data.insert(data.end(), p, p + d); }
+};
+
+double hv2d(Front& f, const double* ref) {
+    // Staircase sweep: ascending f0, keep the running minimum of f1.
+    const std::size_t n = f.size();
+    std::vector<std::size_t> idx(n);
+    for (std::size_t i = 0; i < n; ++i) idx[i] = i;
+    std::sort(idx.begin(), idx.end(), [&](std::size_t a, std::size_t b) {
+        const double *pa = f.row(a), *pb = f.row(b);
+        return pa[0] < pb[0] || (pa[0] == pb[0] && pa[1] < pb[1]);
+    });
+    double vol = 0.0, ymin = ref[1];
+    for (std::size_t i : idx) {
+        const double* p = f.row(i);
+        if (p[1] < ymin) {
+            vol += (ref[0] - p[0]) * (ymin - p[1]);
+            ymin = p[1];
+        }
+    }
+    return vol;
+}
+
+double inclhv(const double* p, const double* ref, int d) {
+    double v = 1.0;
+    for (int k = 0; k < d; ++k) v *= ref[k] - p[k];
+    return v;
+}
+
+// b weakly dominates a (minimisation); `strict` excludes equality.
+inline bool dominates(const double* b, const double* a, int d) {
+    bool any_lt = false;
+    for (int k = 0; k < d; ++k) {
+        if (b[k] > a[k]) return false;
+        if (b[k] < a[k]) any_lt = true;
+    }
+    return any_lt;
+}
+
+inline bool equal_pt(const double* b, const double* a, int d) {
+    for (int k = 0; k < d; ++k)
+        if (b[k] != a[k]) return false;
+    return true;
+}
+
+// Non-dominated filter (keeps one copy of duplicates), O(m² d).
+Front nds(const Front& f) {
+    const std::size_t n = f.size();
+    Front out;
+    out.d = f.d;
+    std::vector<bool> keep(n, true);
+    for (std::size_t a = 0; a < n; ++a) {
+        if (!keep[a]) continue;
+        for (std::size_t b = 0; b < n; ++b) {
+            if (a == b || !keep[b]) continue;
+            if (dominates(f.row(b), f.row(a), f.d) ||
+                (b < a && equal_pt(f.row(b), f.row(a), f.d))) {
+                keep[a] = false;
+                break;
+            }
+        }
+    }
+    for (std::size_t a = 0; a < n; ++a)
+        if (keep[a]) out.push(f.row(a));
+    return out;
+}
+
+double wfg(Front& f, const double* ref);
+
+// Exclusive hypervolume of point i against the points after it.
+double exclhv(const Front& f, std::size_t i, const double* ref) {
+    const int d = f.d;
+    double v = inclhv(f.row(i), ref, d);
+    const std::size_t n = f.size();
+    if (i + 1 >= n) return v;
+    Front lim;
+    lim.d = d;
+    std::vector<double> q(d);
+    for (std::size_t j = i + 1; j < n; ++j) {
+        const double *pi = f.row(i), *pj = f.row(j);
+        for (int k = 0; k < d; ++k) q[k] = std::max(pi[k], pj[k]);
+        lim.push(q.data());
+    }
+    Front limited = nds(lim);
+    if (limited.size()) v -= wfg(limited, ref);
+    return v;
+}
+
+double wfg(Front& f, const double* ref) {
+    if (f.size() == 0) return 0.0;
+    if (f.d == 1) {
+        double m = ref[0];
+        for (std::size_t i = 0; i < f.size(); ++i)
+            m = std::min(m, f.row(i)[0]);
+        return ref[0] - m;
+    }
+    if (f.d == 2) return hv2d(f, ref);
+    // Sorting by the last objective descending shrinks limited sets
+    // fastest (the classic WFG heuristic).
+    const std::size_t n = f.size();
+    std::vector<std::size_t> idx(n);
+    for (std::size_t i = 0; i < n; ++i) idx[i] = i;
+    const int d = f.d;
+    std::sort(idx.begin(), idx.end(), [&](std::size_t a, std::size_t b) {
+        return f.row(a)[d - 1] > f.row(b)[d - 1];
+    });
+    Front sorted;
+    sorted.d = d;
+    for (std::size_t i : idx) sorted.push(f.row(i));
+    double total = 0.0;
+    for (std::size_t i = 0; i < n; ++i) total += exclhv(sorted, i, ref);
+    return total;
+}
+
+Front prepare(const double* data, int n, int d, const double* ref) {
+    Front f;
+    f.d = d;
+    for (int i = 0; i < n; ++i) {
+        const double* p = data + static_cast<std::size_t>(i) * d;
+        bool below = true;
+        for (int k = 0; k < d; ++k)
+            if (p[k] >= ref[k]) { below = false; break; }
+        if (below) f.push(p);
+    }
+    return nds(f);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Exact hypervolume of `data` ([n, d] row-major, minimisation) w.r.t. ref.
+double deap_tpu_hypervolume(const double* data, int n, int d,
+                            const double* ref) {
+    if (n <= 0 || d <= 0) return 0.0;
+    Front f = prepare(data, n, d, ref);
+    return wfg(f, ref);
+}
+
+// Leave-one-out exclusive contribution of every point (total minus the
+// hypervolume without that point) — the quantity behind the reference's
+// least-contributor indicator (deap/tools/indicator.py:10-31).
+void deap_tpu_hv_contributions(const double* data, int n, int d,
+                               const double* ref, double* out) {
+    const double total = deap_tpu_hypervolume(data, n, d, ref);
+    std::vector<double> rest(static_cast<std::size_t>(n - 1) * d);
+    for (int i = 0; i < n; ++i) {
+        double* w = rest.data();
+        for (int j = 0; j < n; ++j) {
+            if (j == i) continue;
+            const double* p = data + static_cast<std::size_t>(j) * d;
+            std::copy(p, p + d, w);
+            w += d;
+        }
+        out[i] = total - deap_tpu_hypervolume(rest.data(), n - 1, d, ref);
+    }
+}
+
+}  // extern "C"

@@ -19,6 +19,14 @@ from deap_tpu.ops.init import (
     randint_genome,
     uniform_genome,
 )
+from deap_tpu.ops.constraint import (
+    ClosestValidPenality,
+    ClosestValidPenalty,
+    DeltaPenality,
+    DeltaPenalty,
+    closest_valid_penalty,
+    delta_penalty,
+)
 from deap_tpu.ops.crossover import (
     cx_blend,
     cx_es_blend,

@@ -1,0 +1,117 @@
+"""Constraint handling — penalty decorators for evaluation functions.
+
+Counterpart of /root/reference/deap/tools/constraint.py: ``DeltaPenalty``
+(:10-64) and ``ClosestValidPenalty`` (:68-132) wrap an evaluate function
+so infeasible individuals receive a penalised fitness instead. Where the
+reference branches per individual in Python, these wrap *batched*
+evaluators: feasibility is a boolean mask and the penalty applies via
+``jnp.where``, so decorated evaluators stay jittable and fuse into the
+generation step.
+
+Toolbox usage mirrors the reference's tutorial
+(doc/tutorials/advanced/constraints.rst)::
+
+    tb.register("evaluate", my_eval)
+    tb.decorate("evaluate", delta_penalty(feasible_fn, 7.0, distance_fn,
+                                          spec=spec))
+"""
+
+from __future__ import annotations
+
+from functools import wraps
+from typing import Callable, Optional, Sequence, Union
+
+import jax.numpy as jnp
+
+from deap_tpu.core.fitness import FitnessSpec
+
+
+def _sign_weights(spec: FitnessSpec) -> jnp.ndarray:
+    """±1 per objective (the reference's ``1 if w >= 0 else -1``,
+    constraint.py:55)."""
+    return jnp.where(spec.warray >= 0, 1.0, -1.0)
+
+
+def _as_obj(values: jnp.ndarray, nobj: int) -> jnp.ndarray:
+    v = jnp.asarray(values, jnp.float32)
+    if v.ndim == 1:
+        v = v[:, None]
+    if v.shape[-1] == 1 and nobj > 1:
+        v = jnp.broadcast_to(v, v.shape[:-1] + (nobj,))
+    return v
+
+
+def delta_penalty(feasibility: Callable, delta: Union[float, Sequence[float]],
+                  distance: Optional[Callable] = None,
+                  spec: FitnessSpec = FitnessSpec((-1.0,))) -> Callable:
+    """Penalised fitness Δ_i − w_i·d_i(x) for infeasible rows
+    (constraint.py:10-64).
+
+    :param feasibility: batched ``genomes -> bool[n]``.
+    :param delta: scalar or per-objective constants, worse than any real
+        fitness.
+    :param distance: optional batched ``genomes -> f32[n] | f32[n, nobj]``
+        growing away from the feasible region.
+    """
+    nobj = spec.nobj
+    delta_arr = jnp.broadcast_to(
+        jnp.asarray(delta, jnp.float32).reshape(-1), (nobj,))
+    signs = _sign_weights(spec)
+
+    def decorator(func):
+        @wraps(func)
+        def wrapper(genomes, *args, **kwargs):
+            values = _as_obj(func(genomes, *args, **kwargs), nobj)
+            feas = feasibility(genomes)
+            if distance is not None:
+                dists = _as_obj(distance(genomes), nobj)
+            else:
+                dists = jnp.zeros_like(values)
+            penal = delta_arr[None, :] - signs[None, :] * dists
+            return jnp.where(feas[:, None], values, penal)
+
+        return wrapper
+
+    return decorator
+
+
+def closest_valid_penalty(feasibility: Callable, feasible: Callable,
+                          alpha: float,
+                          distance: Optional[Callable] = None,
+                          spec: FitnessSpec = FitnessSpec((-1.0,))) -> Callable:
+    """Penalised fitness f_i(valid(x)) − α·w_i·d_i(valid(x), x)
+    (constraint.py:68-132).
+
+    :param feasible: batched projection ``genomes -> genomes`` returning
+        the closest feasible individual per row.
+    :param distance: optional batched ``(valid_genomes, genomes) ->
+        f32[n] | f32[n, nobj]``.
+    """
+    nobj = spec.nobj
+    signs = _sign_weights(spec)
+
+    def decorator(func):
+        @wraps(func)
+        def wrapper(genomes, *args, **kwargs):
+            values = _as_obj(func(genomes, *args, **kwargs), nobj)
+            feas = feasibility(genomes)
+            projected = feasible(genomes)
+            f_fbl = _as_obj(func(projected, *args, **kwargs), nobj)
+            if distance is not None:
+                dists = _as_obj(distance(projected, genomes), nobj)
+            else:
+                dists = jnp.zeros_like(values)
+            penal = f_fbl - alpha * signs[None, :] * dists
+            return jnp.where(feas[:, None], values, penal)
+
+        return wrapper
+
+    return decorator
+
+
+# DEAP-style aliases, including the reference's kept misspellings
+# (constraint.py:66, :134).
+DeltaPenalty = delta_penalty
+DeltaPenality = delta_penalty
+ClosestValidPenalty = closest_valid_penalty
+ClosestValidPenality = closest_valid_penalty

@@ -1,0 +1,36 @@
+"""Strategy engines — ask-tell optimisers with pytree state.
+
+Counterpart of /root/reference/deap/cma.py (CMA-ES family) plus the
+reference's example-level strategies promoted to first-class citizens
+(DE, PSO, PBIL, EMNA — examples/de, examples/pso, examples/eda).
+"""
+
+from deap_tpu.strategies.cma import (
+    CMAState,
+    MOState,
+    OnePlusLambdaState,
+    Strategy,
+    StrategyMultiObjective,
+    StrategyOnePlusLambda,
+    hypervolume_contributions_2d,
+)
+from deap_tpu.strategies.de import DifferentialEvolution
+from deap_tpu.strategies.eda import EMNA, EMNAState, PBIL, PBILState
+from deap_tpu.strategies.pso import PSO, SwarmState
+
+__all__ = [
+    "CMAState",
+    "MOState",
+    "OnePlusLambdaState",
+    "Strategy",
+    "StrategyMultiObjective",
+    "StrategyOnePlusLambda",
+    "hypervolume_contributions_2d",
+    "DifferentialEvolution",
+    "EMNA",
+    "EMNAState",
+    "PBIL",
+    "PBILState",
+    "PSO",
+    "SwarmState",
+]

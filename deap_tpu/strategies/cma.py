@@ -1,0 +1,515 @@
+"""CMA-ES strategies — ask-tell engines with pytree state.
+
+TPU-native counterpart of /root/reference/deap/cma.py: ``Strategy``
+(Hansen's CMA-ES, cma.py:30-205), ``StrategyOnePlusLambda`` ((1+λ)
+Cholesky CMA, cma.py:208-325) and ``StrategyMultiObjective`` (MO-CMA-ES,
+Voss/Hansen/Igel 2010, cma.py:328-547).
+
+Where the reference mutates strategy attributes in place, each strategy
+here is a *static configuration object* whose ``generate(key, state)``
+and ``update(state, genomes, values)`` methods are pure functions over an
+immutable state pytree — so the whole generate → evaluate → update cycle
+jits into a single XLA program per generation (driven by
+``algorithms.ea_generate_update``, counterpart of eaGenerateUpdate,
+algorithms.py:440-503). Eigendecomposition / Cholesky factorisations run
+on device (`jnp.linalg.eigh` / analytic rank-one updates), and the
+O(dim²)–O(dim³) linear algebra of the update lands on the MXU.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+from jax import lax
+
+from deap_tpu.core.fitness import FitnessSpec, lex_sort_desc
+from deap_tpu.mo.emo import nd_rank
+
+
+# =============================================================== Strategy ====
+
+@struct.dataclass
+class CMAState:
+    """Mutable part of Hansen CMA-ES (the attributes the reference
+    updates in ``Strategy.update``, cma.py:123-171)."""
+
+    centroid: jnp.ndarray     # [dim]
+    sigma: jnp.ndarray        # scalar
+    C: jnp.ndarray            # [dim, dim] covariance
+    B: jnp.ndarray            # [dim, dim] eigenbasis
+    diagD: jnp.ndarray        # [dim] sqrt eigenvalues (ascending)
+    ps: jnp.ndarray           # [dim] step-size evolution path
+    pc: jnp.ndarray           # [dim] covariance evolution path
+    count: jnp.ndarray        # scalar int32 — update_count
+
+    @property
+    def BD(self) -> jnp.ndarray:
+        return self.B * self.diagD
+
+    @property
+    def cond(self) -> jnp.ndarray:
+        """Condition number of C (ratio of extreme axis lengths)."""
+        return self.diagD[-1] / self.diagD[0]
+
+
+class Strategy:
+    """Hansen CMA-ES (cma.py:30-205). Parameter defaults follow the
+    reference's table (cma.py:41-78): lambda_ = 4 + 3 ln N, mu = λ/2,
+    superlinear recombination weights, and the standard cs/damps/ccum/
+    ccov1/ccovmu learning rates.
+
+    Usage (ask-tell, like eaGenerateUpdate)::
+
+        strat = Strategy(centroid=[5.0]*N, sigma=0.5, lambda_=20)
+        state = strat.initial_state()
+        toolbox.register("generate", strat.generate)
+        toolbox.register("update", strat.update)
+    """
+
+    def __init__(self, centroid, sigma: float, lambda_: Optional[int] = None,
+                 mu: Optional[int] = None, weights: str = "superlinear",
+                 cmatrix=None, spec: FitnessSpec = FitnessSpec((-1.0,)),
+                 **params):
+        self._centroid0 = np.asarray(centroid, np.float32)
+        self.dim = int(self._centroid0.shape[0])
+        self._sigma0 = float(sigma)
+        self._cmatrix0 = (np.eye(self.dim, dtype=np.float32) if cmatrix is None
+                         else np.asarray(cmatrix, np.float32))
+        self.spec = spec
+        self.lambda_ = int(lambda_ if lambda_ is not None
+                           else 4 + 3 * math.log(self.dim))
+        self.chiN = math.sqrt(self.dim) * (
+            1 - 1.0 / (4.0 * self.dim) + 1.0 / (21.0 * self.dim ** 2))
+        self._compute_params(mu, weights, params)
+
+    def _compute_params(self, mu, rweights, params):
+        """λ-dependent parameters (cma.py:173-205)."""
+        self.mu = int(mu if mu is not None else self.lambda_ / 2)
+        if rweights == "superlinear":
+            w = math.log(self.mu + 0.5) - np.log(np.arange(1, self.mu + 1))
+        elif rweights == "linear":
+            w = self.mu + 0.5 - np.arange(1, self.mu + 1)
+        elif rweights == "equal":
+            w = np.ones(self.mu)
+        else:
+            raise RuntimeError("Unknown weights : %s" % rweights)
+        w = w / w.sum()
+        self.weights = jnp.asarray(w, jnp.float32)
+        self.mueff = float(1.0 / np.sum(w ** 2))
+
+        dim, mueff = self.dim, self.mueff
+        self.cc = params.get("ccum", 4.0 / (dim + 4.0))
+        self.cs = params.get("cs", (mueff + 2.0) / (dim + mueff + 3.0))
+        self.ccov1 = params.get("ccov1", 2.0 / ((dim + 1.3) ** 2 + mueff))
+        ccovmu = params.get(
+            "ccovmu", 2.0 * (mueff - 2.0 + 1.0 / mueff) / ((dim + 2.0) ** 2 + mueff))
+        self.ccovmu = min(1 - self.ccov1, ccovmu)
+        damps = 1.0 + 2.0 * max(0.0, math.sqrt((mueff - 1.0) / (dim + 1.0)) - 1.0) + self.cs
+        self.damps = params.get("damps", damps)
+
+    def initial_state(self) -> CMAState:
+        C = jnp.asarray(self._cmatrix0)
+        evals, B = jnp.linalg.eigh(C)
+        return CMAState(
+            centroid=jnp.asarray(self._centroid0),
+            sigma=jnp.float32(self._sigma0),
+            C=C, B=B, diagD=jnp.sqrt(evals),
+            ps=jnp.zeros(self.dim), pc=jnp.zeros(self.dim),
+            count=jnp.int32(0))
+
+    def generate(self, key: jax.Array, state: CMAState) -> jnp.ndarray:
+        """λ samples ~ centroid + σ · z · (B·D)ᵀ (cma.py:111-121)."""
+        arz = jax.random.normal(key, (self.lambda_, self.dim))
+        return state.centroid + state.sigma * arz @ state.BD.T
+
+    def update(self, state: CMAState, genomes: jnp.ndarray,
+               values: jnp.ndarray) -> CMAState:
+        """Covariance/step-size update from the evaluated offspring
+        (cma.py:123-171). ``values`` are raw objectives; ordering uses the
+        weighted (maximisation) convention like the reference's
+        ``population.sort(key=fitness, reverse=True)``."""
+        w = self.spec.wvalues(values if values.ndim == 2 else values[:, None])
+        order = lex_sort_desc(w)
+        sorted_pop = genomes[order][: self.mu]                     # [mu, dim]
+
+        old_centroid = state.centroid
+        centroid = self.weights @ sorted_pop
+        c_diff = centroid - old_centroid
+
+        # Step-size evolution path: ps ← (1-cs)ps + √(cs(2-cs)µeff)/σ · C^(-1/2)·Δ
+        invsqrtC_cdiff = state.B @ ((1.0 / state.diagD) * (state.B.T @ c_diff))
+        ps = (1 - self.cs) * state.ps + (
+            math.sqrt(self.cs * (2 - self.cs) * self.mueff) / state.sigma
+            * invsqrtC_cdiff)
+
+        count = state.count + 1
+        hsig = (jnp.linalg.norm(ps)
+                / jnp.sqrt(1.0 - (1.0 - self.cs) ** (2.0 * count.astype(jnp.float32)))
+                / self.chiN) < (1.4 + 2.0 / (self.dim + 1.0))
+        hsig = hsig.astype(jnp.float32)
+
+        pc = (1 - self.cc) * state.pc + hsig * (
+            math.sqrt(self.cc * (2 - self.cc) * self.mueff) / state.sigma * c_diff)
+
+        artmp = sorted_pop - old_centroid                          # [mu, dim]
+        C = ((1 - self.ccov1 - self.ccovmu
+              + (1 - hsig) * self.ccov1 * self.cc * (2 - self.cc)) * state.C
+             + self.ccov1 * jnp.outer(pc, pc)
+             + self.ccovmu * (self.weights * artmp.T) @ artmp / state.sigma ** 2)
+
+        sigma = state.sigma * jnp.exp(
+            (jnp.linalg.norm(ps) / self.chiN - 1.0) * self.cs / self.damps)
+
+        evals, B = jnp.linalg.eigh(C)
+        diagD = jnp.sqrt(jnp.maximum(evals, 1e-30))
+        return CMAState(centroid=centroid, sigma=sigma, C=C, B=B,
+                        diagD=diagD, ps=ps, pc=pc, count=count)
+
+
+# ==================================================== StrategyOnePlusLambda ==
+
+@struct.dataclass
+class OnePlusLambdaState:
+    """State of the (1+λ)-CMA-ES (cma.py:246-257)."""
+
+    parent: jnp.ndarray        # [dim]
+    parent_w: jnp.ndarray      # [nobj] weighted fitness of the parent
+    sigma: jnp.ndarray         # scalar
+    C: jnp.ndarray             # [dim, dim]
+    A: jnp.ndarray             # [dim, dim] lower Cholesky of C
+    pc: jnp.ndarray            # [dim]
+    psucc: jnp.ndarray         # scalar — smoothed success rate
+
+
+class StrategyOnePlusLambda:
+    """(1+λ) CMA-ES with success-rule step-size control (Igel/Hansen/Roth
+    2007; cma.py:208-325). The parent improves only when an offspring is
+    at least as good; covariance adapts by a rank-one update whose form
+    depends on the smoothed success rate vs. ``pthresh``."""
+
+    def __init__(self, parent, parent_fitness, sigma: float,
+                 spec: FitnessSpec = FitnessSpec((-1.0,)), **params):
+        self._parent0 = np.asarray(parent, np.float32)
+        self._parent_fitness0 = np.atleast_1d(
+            np.asarray(parent_fitness, np.float32))
+        self.dim = int(self._parent0.shape[0])
+        self._sigma0 = float(sigma)
+        self.spec = spec
+        # λ-dependent parameters (cma.py:259-276)
+        self.lambda_ = int(params.get("lambda_", 1))
+        self.d = params.get("d", 1.0 + self.dim / (2.0 * self.lambda_))
+        self.ptarg = params.get("ptarg", 1.0 / (5 + math.sqrt(self.lambda_) / 2.0))
+        self.cp = params.get("cp", self.ptarg * self.lambda_ / (2 + self.ptarg * self.lambda_))
+        self.cc = params.get("cc", 2.0 / (self.dim + 2.0))
+        self.ccov = params.get("ccov", 2.0 / (self.dim ** 2 + 6.0))
+        self.pthresh = params.get("pthresh", 0.44)
+
+    def initial_state(self) -> OnePlusLambdaState:
+        eye = jnp.eye(self.dim)
+        return OnePlusLambdaState(
+            parent=jnp.asarray(self._parent0),
+            parent_w=self.spec.wvalues(jnp.asarray(self._parent_fitness0)),
+            sigma=jnp.float32(self._sigma0),
+            C=eye, A=eye, pc=jnp.zeros(self.dim),
+            psucc=jnp.float32(self.ptarg))
+
+    def generate(self, key: jax.Array, state: OnePlusLambdaState) -> jnp.ndarray:
+        """λ samples ~ parent + σ · z·Aᵀ (cma.py:278-289)."""
+        arz = jax.random.normal(key, (self.lambda_, self.dim))
+        return state.parent + state.sigma * arz @ state.A.T
+
+    def update(self, state: OnePlusLambdaState, genomes: jnp.ndarray,
+               values: jnp.ndarray) -> OnePlusLambdaState:
+        """Success-rate + rank-one covariance update (cma.py:291-325)."""
+        w = self.spec.wvalues(values if values.ndim == 2 else values[:, None])
+        # lexicographic "child at least as good as parent" — single- and
+        # multi-objective weighted compare like Fitness.__le__
+        from deap_tpu.core.fitness import lex_ge
+        succ = lex_ge(w, state.parent_w[None, :])
+        p_succ = jnp.mean(succ.astype(jnp.float32))
+        psucc = (1 - self.cp) * state.psucc + self.cp * p_succ
+
+        order = lex_sort_desc(w)
+        best = genomes[order[0]]
+        best_w = w[order[0]]
+        improved = lex_ge(best_w, state.parent_w)
+
+        x_step = (best - state.parent) / state.sigma
+        below = psucc < self.pthresh
+        pc_lo = (1 - self.cc) * state.pc + math.sqrt(self.cc * (2 - self.cc)) * x_step
+        C_lo = (1 - self.ccov) * state.C + self.ccov * jnp.outer(pc_lo, pc_lo)
+        pc_hi = (1 - self.cc) * state.pc
+        C_hi = (1 - self.ccov) * state.C + self.ccov * (
+            jnp.outer(pc_hi, pc_hi) + self.cc * (2 - self.cc) * state.C)
+        pc_new = jnp.where(below, pc_lo, pc_hi)
+        C_new = jnp.where(below, C_lo, C_hi)
+
+        parent = jnp.where(improved, best, state.parent)
+        parent_w = jnp.where(improved, best_w, state.parent_w)
+        pc = jnp.where(improved, pc_new, state.pc)
+        C = jnp.where(improved, C_new, state.C)
+
+        sigma = state.sigma * jnp.exp(
+            (psucc - self.ptarg) / (self.d * (1.0 - self.ptarg)))
+        A = jnp.linalg.cholesky(C)
+        return OnePlusLambdaState(parent=parent, parent_w=parent_w,
+                                  sigma=sigma, C=C, A=A, pc=pc, psucc=psucc)
+
+
+# ===================================================== StrategyMultiObjective
+
+@struct.dataclass
+class MOState:
+    """Per-parent MO-CMA-ES state arrays (the reference's parallel lists,
+    cma.py:383-390)."""
+
+    x: jnp.ndarray            # [mu, dim] parent search points
+    w: jnp.ndarray            # [mu, nobj] parent weighted fitness
+    sigmas: jnp.ndarray       # [mu]
+    A: jnp.ndarray            # [mu, dim, dim] lower Cholesky factors
+    invA: jnp.ndarray         # [mu, dim, dim] inverse Cholesky factors
+    pc: jnp.ndarray           # [mu, dim]
+    psucc: jnp.ndarray        # [mu]
+
+
+def _rank_one_update(invA, A, alpha, beta, v):
+    """Incremental Cholesky factor update for C' = αC + β·vvᵀ
+    (cma.py:471-485), batched over a leading axis. Keeps both A and A⁻¹
+    in O(dim²) per member — no decomposition in the loop."""
+    w = jnp.einsum("...ij,...j->...i", invA, v)
+    norm_w2 = jnp.sum(w ** 2, axis=-1, keepdims=True)[..., None]   # [..,1,1]
+    a = math.sqrt(alpha)
+    root = jnp.sqrt(1.0 + beta / alpha * norm_w2)
+    b = jnp.where(norm_w2 > 0, a / jnp.maximum(norm_w2, 1e-30) * (root - 1.0), 0.0)
+    w_inv = jnp.einsum("...i,...ij->...j", w, invA)
+    A_new = a * A + b * v[..., :, None] * w[..., None, :]
+    invA_new = (1.0 / a) * invA - (
+        b / (a ** 2 + a * b * norm_w2)) * w[..., :, None] * w_inv[..., None, :]
+    # Below-threshold updates are mostly noise — skip (cma.py:475)
+    skip = (jnp.max(jnp.abs(w), axis=-1) <= 1e-20)[..., None, None]
+    return (jnp.where(skip, invA, invA_new), jnp.where(skip, A, A_new))
+
+
+def hypervolume_contributions_2d(w: jnp.ndarray, mask: jnp.ndarray,
+                                 ref: jnp.ndarray) -> jnp.ndarray:
+    """Exclusive hypervolume contribution of each masked point, 2-objective
+    exact, on device.
+
+    ``w`` is weighted (maximisation) values; ``ref`` the (smaller) reference
+    point. For a non-dominated 2-D set sorted by the first objective, each
+    point's exclusive contribution is the rectangle to its successor /
+    neighbour. Dominated points contribute 0.
+
+    Sorted by descending first objective, the non-dominated staircase has
+    strictly increasing second objective; the exclusive contribution of an
+    active point is ``(x_i − x_next_active) · (y_i − y_prev_active)`` with
+    the reference point closing both ends.
+    """
+    n = w.shape[0]
+    big = jnp.float32(3.4e38)
+    x = jnp.where(mask, w[:, 0], -big)
+    y = jnp.where(mask, w[:, 1], -big)
+    order = jnp.argsort(-x)            # descending x
+    xs, ys = x[order], y[order]
+    # y of the previous active point = running max of y before i
+    ymax_before = jnp.concatenate([
+        jnp.full((1,), -big), lax.cummax(ys, axis=0)[:-1]])
+    active = (ys > ymax_before) & (xs > -big)
+    # x of the next active point = max x among actives after i
+    ax_rev = jnp.where(active, xs, -big)[::-1]
+    next_active_x = lax.cummax(ax_rev, axis=0)[::-1]
+    next_active_x = jnp.concatenate([next_active_x[1:], jnp.full((1,), -big)])
+    x_low = jnp.where(next_active_x <= -big, ref[0], next_active_x)
+    y_low = jnp.maximum(ymax_before, ref[1])
+    contrib_sorted = jnp.where(active, (xs - x_low) * (ys - y_low), 0.0)
+    contrib_sorted = jnp.maximum(contrib_sorted, 0.0)
+    return jnp.zeros(n).at[order].set(contrib_sorted) * mask
+
+
+class StrategyMultiObjective:
+    """MO-CMA-ES (Voss/Hansen/Igel 2010; cma.py:328-547): µ independent
+    (1+1) strategies, indicator-based environmental selection.
+
+    ``generate`` returns a genome *pytree* ``{"x": [λ, dim], "parent":
+    int32[λ]}`` so that ``update`` knows each offspring's parent without
+    out-of-band state (the reference smuggles this through an ``_ps``
+    attribute on the individuals, cma.py:408-426). Evaluators should read
+    ``genomes["x"]``.
+
+    Selection keeps the best µ of parents+offspring by (nd-rank, then
+    leave-one-out hypervolume contribution on the boundary front —
+    exact 2-objective device kernel; crowding-style density for nobj>2).
+    """
+
+    def __init__(self, population, fitnesses, sigma: float,
+                 mu: Optional[int] = None, lambda_: int = 1,
+                 spec: FitnessSpec = FitnessSpec((-1.0, -1.0)), **params):
+        x0 = np.asarray(population, np.float32)
+        self.mu = int(mu if mu is not None else x0.shape[0])
+        self.lambda_ = int(lambda_)
+        self.dim = int(x0.shape[1])
+        self.spec = spec
+        self._x0 = x0
+        self._f0 = np.asarray(fitnesses, np.float32)
+        self._sigma0 = float(sigma)
+        # Step-size / covariance parameters (cma.py:374-381)
+        self.d = params.get("d", 1.0 + self.dim / 2.0)
+        self.ptarg = params.get("ptarg", 1.0 / (5.0 + 0.5))
+        self.cp = params.get("cp", self.ptarg / (2.0 + self.ptarg))
+        self.cc = params.get("cc", 2.0 / (self.dim + 2.0))
+        self.ccov = params.get("ccov", 2.0 / (self.dim ** 2 + 6.0))
+        self.pthresh = params.get("pthresh", 0.44)
+
+    def initial_state(self) -> MOState:
+        mu, dim = self.mu, self.dim
+        eye = jnp.broadcast_to(jnp.eye(dim), (mu, dim, dim))
+        return MOState(
+            x=jnp.asarray(self._x0[:mu]),
+            w=self.spec.wvalues(jnp.asarray(self._f0[:mu])),
+            sigmas=jnp.full((mu,), self._sigma0, jnp.float32),
+            A=eye, invA=eye,
+            pc=jnp.zeros((mu, dim)),
+            psucc=jnp.full((mu,), self.ptarg, jnp.float32))
+
+    def generate(self, key: jax.Array, state: MOState):
+        """λ offspring, each from a parent: its own index when λ == µ,
+        else a uniformly-random member of the parents' first front
+        (cma.py:394-428)."""
+        k_z, k_p = jax.random.split(key)
+        arz = jax.random.normal(k_z, (self.lambda_, self.dim))
+        if self.lambda_ == self.mu:
+            parent = jnp.arange(self.mu, dtype=jnp.int32)
+        else:
+            ranks = nd_rank(state.w)
+            front = ranks == 0
+            scores = jax.random.uniform(k_p, (self.lambda_, self.mu))
+            parent = jnp.argmax(
+                jnp.where(front[None, :], scores, -1.0), axis=1).astype(jnp.int32)
+        x = (state.x[parent] + state.sigmas[parent, None]
+             * jnp.einsum("pij,pj->pi", state.A[parent], arz))
+        return {"x": x, "parent": parent}
+
+    # ------------------------------------------------------------ update ----
+
+    def _select_mask(self, w_all: jnp.ndarray) -> jnp.ndarray:
+        """Boolean mask keeping µ of the λ+µ candidates: whole fronts in
+        rank order, boundary front trimmed by iterative least-hypervolume-
+        contributor removal (cma.py:430-469)."""
+        n = w_all.shape[0]
+        ranks = nd_rank(w_all)
+        sorted_ranks = jnp.sort(ranks)
+        cut = sorted_ranks[self.mu - 1]
+        ahead = ranks < cut
+        mid = ranks == cut
+        k_fill = self.mu - jnp.sum(ahead)
+
+        # Reference point: worst in each (weighted) dimension, minus 1
+        # (the reference computes it in minimisation space +1, cma.py:460-461).
+        ref = jnp.min(w_all, axis=0) - 1.0
+
+        nobj = w_all.shape[1]
+
+        def drop_one(state):
+            mask, remaining = state
+            if nobj == 2:
+                contrib = hypervolume_contributions_2d(w_all, mask, ref)
+            else:
+                # nobj > 2: density proxy (negated crowding) — documented
+                # deviation; exact HV for high dims runs via the native
+                # extension on host paths.
+                d2 = jnp.sum((w_all[:, None, :] - w_all[None, :, :]) ** 2, -1)
+                d2 = jnp.where(mask[None, :] & mask[:, None], d2, jnp.inf)
+                d2 = jnp.where(jnp.eye(n, dtype=bool), jnp.inf, d2)
+                contrib = jnp.min(d2, axis=1)
+            contrib = jnp.where(mask, contrib, jnp.inf)
+            drop = jnp.argmin(contrib)
+            return mask.at[drop].set(False), remaining - 1
+
+        def cond(state):
+            _, remaining = state
+            return remaining > k_fill
+
+        mid_kept, _ = lax.while_loop(cond, drop_one, (mid, jnp.sum(mid)))
+        return ahead | mid_kept
+
+    def update(self, state: MOState, genomes, values: jnp.ndarray) -> MOState:
+        """Environmental selection + per-member success/covariance updates
+        (cma.py:487-547). Candidate order is [offspring, parents], like
+        the reference's ``population + self.parents``."""
+        mu, lam, dim = self.mu, self.lambda_, self.dim
+        off_x, parent_idx = genomes["x"], genomes["parent"]
+        off_w = self.spec.wvalues(values)
+
+        w_all = jnp.concatenate([off_w, state.w], axis=0)       # [λ+µ, nobj]
+        chosen = self._select_mask(w_all)
+        is_off = jnp.arange(lam + mu) < lam
+
+        # --- parent-entry updates (scan preserves the reference's
+        # sequential in-place accumulation over candidates, cma.py:508-538)
+        def body(carry, i):
+            psucc, sigmas = carry
+            p = jnp.where(is_off[i], parent_idx[jnp.minimum(i, lam - 1)], 0)
+            off = is_off[i]
+            succ = chosen[i]
+            new_p = jnp.where(succ, (1 - self.cp) * psucc[p] + self.cp,
+                              (1 - self.cp) * psucc[p])
+            new_s = sigmas[p] * jnp.exp(
+                (new_p - self.ptarg) / (self.d * (1.0 - self.ptarg)))
+            psucc = jnp.where(off, psucc.at[p].set(new_p), psucc)
+            sigmas = jnp.where(off, sigmas.at[p].set(new_s), sigmas)
+            return (psucc, sigmas), None
+
+        (par_psucc, par_sigmas), _ = lax.scan(
+            body, (state.psucc, state.sigmas), jnp.arange(lam + mu))
+
+        # --- new entries for chosen offspring (copies of the parent set at
+        # update start, cma.py:499-525), fully vectorised over offspring
+        p = parent_idx
+        last_steps = state.sigmas[p]
+        o_psucc = (1 - self.cp) * state.psucc[p] + self.cp
+        o_sigmas = state.sigmas[p] * jnp.exp(
+            (o_psucc - self.ptarg) / (self.d * (1.0 - self.ptarg)))
+        x_step = (off_x - state.x[p]) / last_steps[:, None]
+        below = (o_psucc < self.pthresh)[:, None]
+        pc_lo = (1 - self.cc) * state.pc[p] + math.sqrt(self.cc * (2 - self.cc)) * x_step
+        pc_hi = (1 - self.cc) * state.pc[p]
+        o_pc = jnp.where(below, pc_lo, pc_hi)
+        alpha_lo, alpha_hi = 1 - self.ccov, 1 - self.ccov + self.cc * (2.0 - self.cc)
+        inv_lo, A_lo = _rank_one_update(
+            state.invA[p], state.A[p], alpha_lo, self.ccov, pc_lo)
+        inv_hi, A_hi = _rank_one_update(
+            state.invA[p], state.A[p], alpha_hi, self.ccov, pc_hi)
+        below3 = below[:, :, None]
+        o_A = jnp.where(below3, A_lo, A_hi)
+        o_invA = jnp.where(below3, inv_lo, inv_hi)
+
+        # --- assemble the next parent set: the µ chosen candidates; an
+        # offspring brings its new entry, a surviving parent its (updated)
+        # own entry (cma.py:540-547)
+        sel_idx = jnp.argsort(jnp.where(chosen, jnp.arange(lam + mu),
+                                        lam + mu))[:mu]
+        off_sel = sel_idx < lam                      # chosen slot is an offspring
+        oi = jnp.minimum(sel_idx, lam - 1)           # offspring index
+        pi = jnp.clip(sel_idx - lam, 0, mu - 1)      # parent index
+
+        def pick(off_arr, par_arr):
+            o = jnp.take(off_arr, oi, axis=0)
+            q = jnp.take(par_arr, pi, axis=0)
+            m = off_sel.reshape((-1,) + (1,) * (o.ndim - 1))
+            return jnp.where(m, o, q)
+
+        x_all = jnp.concatenate([off_x, state.x], axis=0)
+        new_x = x_all[sel_idx]
+        new_w = w_all[sel_idx]
+        return MOState(
+            x=new_x, w=new_w,
+            sigmas=pick(o_sigmas, par_sigmas),
+            A=pick(o_A, state.A),
+            invA=pick(o_invA, state.invA),
+            pc=pick(o_pc, state.pc),
+            psucc=pick(o_psucc, par_psucc))

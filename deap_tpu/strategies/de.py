@@ -1,0 +1,77 @@
+"""Differential Evolution — DE/rand/1/bin as a compiled per-generation step.
+
+The reference keeps DE as an example (per-agent Python loop,
+/root/reference/examples/de/basic.py:66-76: pick three random donors,
+binomial crossover with a guaranteed coordinate, greedy replacement); here
+it is a first-class strategy whose whole generation is one fused device
+step batched over the population, scannable over generations.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deap_tpu.core.fitness import FitnessSpec, lex_gt
+from deap_tpu.core.population import Population
+
+
+class DifferentialEvolution:
+    """DE/rand/1/bin (Storn & Price).
+
+    :param evaluate: batched objective ``genomes [n, d] -> values``.
+    :param F: differential weight (reference example uses 1).
+    :param CR: crossover probability (reference example uses 0.25).
+    :param spec: fitness weights (default single-objective minimisation).
+
+    Semantics match the reference example: donors a, b, c are sampled with
+    replacement from the population (selRandom, may include the agent), a
+    random coordinate always crosses over, and the trial replaces the
+    agent only if strictly better (``y.fitness > agent.fitness``,
+    basic.py:75-76).
+    """
+
+    def __init__(self, evaluate: Callable, F: float = 1.0, CR: float = 0.25,
+                 spec: FitnessSpec = FitnessSpec((-1.0,))):
+        self.evaluate = evaluate
+        self.F = F
+        self.CR = CR
+        self.spec = spec
+
+    def step(self, key: jax.Array, pop: Population) -> Population:
+        """One DE generation for every agent at once."""
+        n, d = pop.genomes.shape
+        k_abc, k_cr, k_idx = jax.random.split(key, 3)
+        abc = jax.random.randint(k_abc, (3, n), 0, n)
+        a, b, c = pop.genomes[abc[0]], pop.genomes[abc[1]], pop.genomes[abc[2]]
+        mutant = a + self.F * (b - c)
+
+        cross = jax.random.uniform(k_cr, (n, d)) < self.CR
+        forced = jax.random.randint(k_idx, (n,), 0, d)
+        cross = cross | (jnp.arange(d)[None, :] == forced[:, None])
+        trial = jnp.where(cross, mutant, pop.genomes)
+
+        values = self.evaluate(trial)
+        values = values[:, None] if values.ndim == 1 else values
+        w_new = self.spec.wvalues(values)
+        better = lex_gt(w_new, pop.wvalues)
+        genomes = jnp.where(better[:, None], trial, pop.genomes)
+        fitness = jnp.where(better[:, None], values, pop.fitness)
+        return pop.replace(genomes=genomes, fitness=fitness,
+                           valid=jnp.ones_like(pop.valid))
+
+    def run(self, key: jax.Array, pop: Population, ngen: int,
+            ) -> Tuple[Population, jnp.ndarray]:
+        """Scan ``ngen`` generations; returns the final population and the
+        per-generation best weighted fitness trajectory."""
+        values = self.evaluate(pop.genomes)
+        pop = pop.with_fitness(values if values.ndim == 2 else values[:, None])
+
+        def gen(pop, k):
+            pop = self.step(k, pop)
+            return pop, jnp.max(pop.wvalues[:, 0])
+
+        return lax.scan(gen, pop, jax.random.split(key, ngen))

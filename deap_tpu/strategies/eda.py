@@ -1,0 +1,106 @@
+"""Estimation-of-distribution strategies: PBIL and EMNA.
+
+The reference implements both inside examples driven by
+``eaGenerateUpdate`` (/root/reference/examples/eda/pbil.py:27-51,
+examples/eda/emna.py:33-64); here they are first-class ask-tell
+strategies with pytree state, compatible with
+``algorithms.ea_generate_update``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from deap_tpu.core.fitness import FitnessSpec, lex_sort_desc
+
+
+@struct.dataclass
+class PBILState:
+    prob_vector: jnp.ndarray   # [dim] Bernoulli parameters
+    key: jnp.ndarray           # PRNG key for the update-side mutation
+
+
+class PBIL:
+    """Population-Based Incremental Learning (pbil.py:27-51): sample λ
+    bitstrings from a probability vector; pull the vector toward the best
+    sample; mutate each component with probability ``mut_prob`` by
+    ``mut_shift`` toward a random bit."""
+
+    def __init__(self, ndim: int, learning_rate: float = 0.3,
+                 mut_prob: float = 0.1, mut_shift: float = 0.05,
+                 lambda_: int = 20,
+                 spec: FitnessSpec = FitnessSpec((1.0,))):
+        self.ndim = ndim
+        self.learning_rate = learning_rate
+        self.mut_prob = mut_prob
+        self.mut_shift = mut_shift
+        self.lambda_ = lambda_
+        self.spec = spec
+
+    def initial_state(self, key: Optional[jax.Array] = None) -> PBILState:
+        return PBILState(
+            prob_vector=jnp.full((self.ndim,), 0.5),
+            key=key if key is not None else jax.random.key(0))
+
+    def generate(self, key: jax.Array, state: PBILState) -> jnp.ndarray:
+        """λ Bernoulli samples of the probability vector (pbil.py:34-38)."""
+        return jax.random.bernoulli(
+            key, state.prob_vector, (self.lambda_, self.ndim)
+        ).astype(jnp.float32)
+
+    def update(self, state: PBILState, genomes: jnp.ndarray,
+               values: jnp.ndarray) -> PBILState:
+        """Learn toward the best sample, then mutate (pbil.py:40-51)."""
+        w = self.spec.wvalues(values if values.ndim == 2 else values[:, None])
+        best = genomes[lex_sort_desc(w)[0]]
+        p = state.prob_vector * (1.0 - self.learning_rate) \
+            + best * self.learning_rate
+        key, k_m, k_b = jax.random.split(state.key, 3)
+        do_mut = jax.random.bernoulli(k_m, self.mut_prob, (self.ndim,))
+        bits = jax.random.bernoulli(k_b, 0.5, (self.ndim,)).astype(jnp.float32)
+        p_mut = p * (1.0 - self.mut_shift) + bits * self.mut_shift
+        return PBILState(prob_vector=jnp.where(do_mut, p_mut, p), key=key)
+
+
+@struct.dataclass
+class EMNAState:
+    centroid: jnp.ndarray   # [dim]
+    sigma: jnp.ndarray      # scalar isotropic std
+
+
+class EMNA:
+    """Estimation of Multivariate Normal Algorithm, global variant
+    (Teytaud & Teytaud 2009; emna.py:33-64): fit an isotropic Gaussian to
+    the µ best of λ samples each generation."""
+
+    def __init__(self, centroid, sigma: float, mu: int, lambda_: int,
+                 spec: FitnessSpec = FitnessSpec((-1.0,))):
+        self._centroid0 = jnp.asarray(centroid, jnp.float32)
+        self._sigma0 = float(sigma)
+        self.dim = int(self._centroid0.shape[0])
+        self.mu = mu
+        self.lambda_ = lambda_
+        self.spec = spec
+
+    def initial_state(self) -> EMNAState:
+        return EMNAState(centroid=self._centroid0,
+                         sigma=jnp.float32(self._sigma0))
+
+    def generate(self, key: jax.Array, state: EMNAState) -> jnp.ndarray:
+        return state.centroid + state.sigma * jax.random.normal(
+            key, (self.lambda_, self.dim))
+
+    def update(self, state: EMNAState, genomes: jnp.ndarray,
+               values: jnp.ndarray) -> EMNAState:
+        """Mean/variance re-estimation from the µ best (emna.py:55-64)."""
+        w = self.spec.wvalues(values if values.ndim == 2 else values[:, None])
+        order = lex_sort_desc(w)
+        z = genomes[order[: self.mu]] - state.centroid
+        avg = jnp.mean(z, axis=0)
+        sigma = jnp.sqrt(jnp.sum((z - avg) ** 2) / (self.mu * self.dim))
+        return EMNAState(centroid=state.centroid + avg, sigma=sigma)

@@ -1,0 +1,105 @@
+"""Particle Swarm Optimisation — swarm state as a pytree, one fused step.
+
+The reference keeps PSO as examples: the canonical velocity update with
+per-particle bests and speed clamping
+(/root/reference/examples/pso/basic.py:38-48), and the constricted
+(chi/c) variant used by multiswarm PSO
+(/root/reference/examples/pso/multiswarm.py:80-95). Both are provided
+here as first-class strategies over tensor swarms.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+from deap_tpu.core.fitness import FitnessSpec, lex_gt
+
+
+@struct.dataclass
+class SwarmState:
+    x: jnp.ndarray          # [n, d] positions
+    v: jnp.ndarray          # [n, d] velocities
+    w: jnp.ndarray          # [n, nobj] current weighted fitness
+    pbest_x: jnp.ndarray    # [n, d] personal best positions
+    pbest_w: jnp.ndarray    # [n, nobj]
+    gbest_x: jnp.ndarray    # [d] global best position
+    gbest_w: jnp.ndarray    # [nobj]
+
+
+class PSO:
+    """Canonical PSO (basic.py): ``v += U(0,φ1)·(pbest−x) + U(0,φ2)·(gbest−x)``
+    with per-component speed clamping to [smin, smax] magnitude, or the
+    Clerc constriction variant (multiswarm.py) when ``chi`` is given:
+    ``v += χ·(ce1·(pbest−x) + ce2·(gbest−x)) − (1−χ)·v``.
+    """
+
+    def __init__(self, evaluate: Callable, phi1: float = 2.0,
+                 phi2: float = 2.0, smin: Optional[float] = None,
+                 smax: Optional[float] = None, chi: Optional[float] = None,
+                 spec: FitnessSpec = FitnessSpec((1.0,))):
+        self.evaluate = evaluate
+        self.phi1, self.phi2 = phi1, phi2
+        self.smin, self.smax = smin, smax
+        self.chi = chi
+        self.spec = spec
+
+    def init(self, key: jax.Array, n: int, dim: int, pmin: float,
+             pmax: float, smin: float, smax: float) -> SwarmState:
+        """Uniform positions in [pmin, pmax], speeds in [smin, smax]
+        (basic.py:31-36)."""
+        kx, kv = jax.random.split(key)
+        x = jax.random.uniform(kx, (n, dim), minval=pmin, maxval=pmax)
+        v = jax.random.uniform(kv, (n, dim), minval=smin, maxval=smax)
+        nobj = self.spec.nobj
+        neg = jnp.full((n, nobj), -jnp.inf)
+        return SwarmState(x=x, v=v, w=neg, pbest_x=x, pbest_w=neg,
+                          gbest_x=x[0], gbest_w=jnp.full((nobj,), -jnp.inf))
+
+    def _eval_and_update_bests(self, s: SwarmState) -> SwarmState:
+        values = self.evaluate(s.x)
+        values = values[:, None] if values.ndim == 1 else values
+        w = self.spec.wvalues(values)
+        improve_p = lex_gt(w, s.pbest_w)
+        pbest_x = jnp.where(improve_p[:, None], s.x, s.pbest_x)
+        pbest_w = jnp.where(improve_p[:, None], w, s.pbest_w)
+        ibest = jnp.argmax(pbest_w[:, 0])
+        improve_g = lex_gt(pbest_w[ibest], s.gbest_w)
+        gbest_x = jnp.where(improve_g, pbest_x[ibest], s.gbest_x)
+        gbest_w = jnp.where(improve_g, pbest_w[ibest], s.gbest_w)
+        return s.replace(w=w, pbest_x=pbest_x, pbest_w=pbest_w,
+                         gbest_x=gbest_x, gbest_w=gbest_w)
+
+    def _move(self, key: jax.Array, s: SwarmState) -> SwarmState:
+        n, d = s.x.shape
+        k1, k2 = jax.random.split(key)
+        u1 = jax.random.uniform(k1, (n, d), maxval=self.phi1)
+        u2 = jax.random.uniform(k2, (n, d), maxval=self.phi2)
+        pull = u1 * (s.pbest_x - s.x) + u2 * (s.gbest_x[None, :] - s.x)
+        if self.chi is not None:
+            v = s.v + self.chi * pull - (1.0 - self.chi) * s.v
+        else:
+            v = s.v + pull
+        if self.smin is not None and self.smax is not None:
+            mag = jnp.abs(v)
+            sign = jnp.sign(v) + (v == 0)  # copysign with 0 → positive
+            mag = jnp.clip(mag, self.smin, self.smax)
+            v = sign * mag
+        return s.replace(v=v, x=s.x + v)
+
+    def step(self, key: jax.Array, s: SwarmState) -> SwarmState:
+        """evaluate → update bests → move (basic.py main loop :72-83)."""
+        s = self._eval_and_update_bests(s)
+        return self._move(key, s)
+
+    def run(self, key: jax.Array, s: SwarmState, ngen: int,
+            ) -> Tuple[SwarmState, jnp.ndarray]:
+        def gen(s, k):
+            s = self.step(k, s)
+            return s, s.gbest_w[0]
+
+        return lax.scan(gen, s, jax.random.split(key, ngen))

@@ -48,17 +48,6 @@ def hof_init(maxsize: int, pop: Population) -> HallOfFame:
     )
 
 
-def _genome_eq_matrix(genomes) -> jnp.ndarray:
-    """[m, m] matrix of exact genome equality across a (small) pytree batch."""
-    leaves = jax.tree_util.tree_leaves(genomes)
-    m = leaves[0].shape[0]
-    eq = jnp.ones((m, m), bool)
-    for leaf in leaves:
-        flat = leaf.reshape(m, -1)
-        eq &= jnp.all(flat[:, None, :] == flat[None, :, :], axis=-1)
-    return eq
-
-
 def _genome_hash(genomes) -> jnp.ndarray:
     """Cheap order-independent-free int32 hash per row (wrapping int
     arithmetic). Equal genomes always hash equal; used only as a sort

@@ -1,0 +1,71 @@
+"""Penalty-decorator tests (reference: deap/tools/constraint.py,
+tutorial doc/tutorials/advanced/constraints.rst)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deap_tpu import benchmarks
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.toolbox import Toolbox
+from deap_tpu.ops.constraint import closest_valid_penalty, delta_penalty
+
+
+SPEC = FitnessSpec((-1.0,))
+
+
+def feasible(g):
+    # feasible region: all coordinates within [-1, 1]
+    return jnp.all(jnp.abs(g) <= 1.0, axis=-1)
+
+
+def project(g):
+    return jnp.clip(g, -1.0, 1.0)
+
+
+def distance(g):
+    return jnp.sum((g - project(g)) ** 2, axis=-1)
+
+
+def test_delta_penalty_valid_rows_untouched():
+    evaluate = delta_penalty(feasible, 1e4, spec=SPEC)(
+        jax.vmap(benchmarks.sphere))
+    g = jnp.array([[0.5, 0.5], [3.0, 0.0]])
+    vals = evaluate(g)
+    assert vals[0, 0] == pytest.approx(0.5)
+    assert vals[1, 0] == pytest.approx(1e4)
+
+
+def test_delta_penalty_distance_grows_with_violation():
+    evaluate = delta_penalty(feasible, 1e4, distance, spec=SPEC)(
+        jax.vmap(benchmarks.sphere))
+    g = jnp.array([[2.0, 0.0], [4.0, 0.0]])
+    vals = evaluate(g)
+    # minimisation: penalty = delta + distance (Δ_i − w_i·d, w = −1)
+    assert vals[0, 0] == pytest.approx(1e4 + 1.0)
+    assert vals[1, 0] == pytest.approx(1e4 + 9.0)
+    assert vals[1, 0] > vals[0, 0]
+
+
+def test_closest_valid_penalty():
+    evaluate = closest_valid_penalty(
+        feasible, project, alpha=2.0,
+        distance=lambda v, g: jnp.sum((v - g) ** 2, -1), spec=SPEC)(
+        jax.vmap(benchmarks.sphere))
+    g = jnp.array([[0.25, 0.25], [3.0, 0.0]])
+    vals = evaluate(g)
+    assert vals[0, 0] == pytest.approx(0.125)
+    # projected (1,0): f=1; + alpha*d = 2*(2^2) = 8 → 9
+    assert vals[1, 0] == pytest.approx(1.0 + 2.0 * 4.0)
+
+
+def test_decorate_seam_on_toolbox():
+    """The Toolbox.decorate composition seam (base.py:100-122) applies
+    penalties exactly like the reference tutorial."""
+    tb = Toolbox()
+    tb.register("evaluate", jax.vmap(benchmarks.sphere))
+    tb.decorate("evaluate", delta_penalty(feasible, 7.0, spec=SPEC))
+    vals = tb.evaluate(jnp.array([[0.1, 0.1], [5.0, 5.0]]))
+    assert vals[0, 0] == pytest.approx(0.02)
+    assert vals[1, 0] == pytest.approx(7.0)

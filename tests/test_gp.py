@@ -1,0 +1,171 @@
+"""GP subsystem tests: prefix-tree mechanics, batched interpreter,
+variation operators, and the canonical symbolic-regression convergence
+gate (reference: deap/gp.py + examples/gp/symbreg.py seed-318 run)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deap_tpu import algorithms, gp, ops
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import Population, init_population
+from deap_tpu.core.toolbox import Toolbox
+
+MAX_LEN = 64
+
+
+@pytest.fixture(scope="module")
+def pset():
+    return gp.math_set(n_args=1)
+
+
+def valid_prefix(genome, pset):
+    """A prefix array is well-formed iff the arity walk closes exactly at
+    ``length`` (searchSubtree invariant, gp.py:174-184)."""
+    arity = np.asarray(pset.arity_table())
+    nodes = np.asarray(genome["nodes"])
+    length = int(genome["length"])
+    need = 1
+    for t in range(length):
+        need += arity[nodes[t]] - 1
+    return need == 0 and length >= 1
+
+
+def test_generator_produces_valid_trees(pset):
+    gen = gp.gen_half_and_half(pset, MAX_LEN, 1, 4)
+    genomes = jax.vmap(gen)(jax.random.split(jax.random.key(0), 64))
+    for i in range(64):
+        g = jax.tree_util.tree_map(lambda a: a[i], genomes)
+        assert valid_prefix(g, pset)
+        assert int(gp.tree_height(g, pset)) <= 4
+
+
+def test_gen_full_hits_exact_depth(pset):
+    gen = gp.gen_full(pset, MAX_LEN, 3, 3)
+    for seed in range(8):
+        g = gen(jax.random.key(seed))
+        assert valid_prefix(g, pset)
+        assert int(gp.tree_height(g, pset)) == 3
+
+
+def test_interpreter_known_expression(pset):
+    # (x + 1) * x  →  prefix: mul, add, ARG0, 1.0, ARG0
+    from deap_tpu.gp.string import from_string, to_string
+
+    genome = from_string("mul(add(ARG0, 1.0), ARG0)", pset, MAX_LEN)
+    assert valid_prefix(genome, pset)
+    interp = gp.make_interpreter(pset, MAX_LEN)
+    X = jnp.linspace(-2, 2, 9)[:, None]
+    got = interp(genome, X)
+    want = (X[:, 0] + 1.0) * X[:, 0]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+    s = to_string(genome, pset)
+    assert "ARG0" in s or "x" in s
+
+
+def test_interpreter_protected_div(pset):
+    from deap_tpu.gp.string import from_string
+
+    genome = from_string("protectedDiv(1.0, ARG0)", pset, MAX_LEN)
+    interp = gp.make_interpreter(pset, MAX_LEN)
+    X = jnp.array([[0.0], [2.0]])
+    got = np.asarray(interp(genome, X))
+    assert got[0] == 1.0 and got[1] == 0.5
+
+
+def test_subtree_end_matches_python_walk(pset):
+    gen = gp.gen_half_and_half(pset, MAX_LEN, 2, 5)
+    arity = pset.arity_table()
+    arity_np = np.asarray(arity)
+    for seed in range(6):
+        g = gen(jax.random.key(seed + 10))
+        nodes = np.asarray(g["nodes"])
+        for i in range(int(g["length"])):
+            end, need = i, 1
+            while need:
+                need += arity_np[nodes[end]] - 1
+                end += 1
+            assert int(gp.subtree_end(g["nodes"], arity, i)) == end
+
+
+def test_cx_one_point_preserves_validity(pset):
+    gen = gp.gen_half_and_half(pset, MAX_LEN, 2, 5)
+    cx = gp.make_cx_one_point(pset)
+    keys = jax.random.split(jax.random.key(3), 32)
+    for i in range(0, 32, 2):
+        g1, g2 = gen(keys[i]), gen(keys[i + 1])
+        c1, c2 = cx(jax.random.fold_in(keys[i], 7), g1, g2)
+        assert valid_prefix(c1, pset)
+        assert valid_prefix(c2, pset)
+        # total node count is conserved by a swap
+        assert (int(c1["length"]) + int(c2["length"])
+                == int(g1["length"]) + int(g2["length"])) or (
+            int(c1["length"]) == int(g1["length"]))  # oversize → unchanged
+
+
+def test_mutations_preserve_validity(pset):
+    gen = gp.gen_half_and_half(pset, MAX_LEN, 2, 5)
+    muts = [
+        gp.make_mut_uniform(pset, gp.gen_full(pset, MAX_LEN, 0, 2)),
+        gp.make_mut_node_replacement(pset),
+        gp.make_mut_ephemeral(pset, "one"),
+        gp.make_mut_ephemeral(pset, "all"),
+        gp.make_mut_insert(pset),
+        gp.make_mut_shrink(pset),
+    ]
+    for seed in range(4):
+        g = gen(jax.random.key(seed + 20))
+        for m, mut in enumerate(muts):
+            out = mut(jax.random.key(100 * seed + m), g)
+            assert valid_prefix(out, pset), f"mutation {m} broke the tree"
+
+
+def test_mut_shrink_exempts_tiny_trees(pset):
+    from deap_tpu.gp.string import from_string
+
+    mut = gp.make_mut_shrink(pset)
+    g = from_string("add(ARG0, 1.0)", pset, MAX_LEN)  # len 3, height 1...
+    # reference exempts len < 3 — this is len 3 with the op AT the root,
+    # so no below-root operator exists and it must pass unchanged
+    out = mut(jax.random.key(0), g)
+    np.testing.assert_array_equal(np.asarray(out["nodes"]),
+                                  np.asarray(g["nodes"]))
+
+
+def test_static_limit_keeps_parent(pset):
+    gen_deep = gp.gen_full(pset, MAX_LEN, 5, 5)
+    mut = gp.make_mut_uniform(pset, gen_deep)
+    limited = gp.static_limit(
+        lambda g: gp.tree_height(g, pset), 3)(mut)
+    gen = gp.gen_full(pset, MAX_LEN, 2, 2)
+    g = gen(jax.random.key(1))
+    out = limited(jax.random.key(2), g)
+    assert int(gp.tree_height(out, pset)) <= 3
+
+
+def test_symbreg_quartic_converges(pset):
+    """The canonical GP loop: quartic regression x⁴+x³+x²+x over 20
+    points in [-1, 1) (examples/gp/symbreg.py:55-75). Quality gate: MSE
+    of the best individual < 0.05 after 40 generations."""
+    X = jnp.linspace(-1, 1, 20)[:, None]
+    y = X[:, 0] ** 4 + X[:, 0] ** 3 + X[:, 0] ** 2 + X[:, 0]
+    evaluate = gp.make_population_evaluator(
+        pset, MAX_LEN, lambda pred, y_: jnp.mean((pred - y_) ** 2))
+
+    tb = Toolbox()
+    tb.register("evaluate", lambda genomes: -evaluate(genomes, X, y))
+    height_limit = gp.static_limit(lambda g: gp.tree_height(g, pset), 17)
+    tb.register("mate", height_limit(gp.make_cx_one_point(pset)))
+    tb.register("mutate", height_limit(
+        gp.make_mut_uniform(pset, gp.gen_full(pset, MAX_LEN, 0, 2))))
+    tb.register("select", ops.sel_tournament, tournsize=3)
+
+    pop = init_population(
+        jax.random.key(318), 300, gp.gen_half_and_half(pset, MAX_LEN, 1, 2),
+        FitnessSpec((1.0,)))
+    pop, logbook, hof = algorithms.ea_simple(
+        jax.random.key(318), pop, tb, cxpb=0.5, mutpb=0.1, ngen=40,
+        halloffame_size=1)
+    best_mse = float(-hof.fitness[0, 0])
+    assert best_mse < 0.05
