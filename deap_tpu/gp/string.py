@@ -71,7 +71,20 @@ def from_string(expr: str, pset: PrimitiveSet, max_len: int):
             except ValueError:
                 raise TypeError(
                     f"unknown symbol {tok!r} in expression") from None
-            nodes[t] = pset.erc_id if pset.has_erc else pset.const_id
+            if pset.has_erc:
+                nodes[t] = pset.erc_id
+            else:
+                # no ERC pool: a literal is only representable if it is
+                # the value of a fixed terminal (otherwise the id would
+                # alias that terminal's name while evaluating differently)
+                matches = [i for i, v in enumerate(pset.const_values)
+                           if v == value]
+                if not matches:
+                    raise ValueError(
+                        f"literal {tok!r} is not a fixed terminal of "
+                        f"{pset.name!r} and the set has no ephemeral "
+                        f"constant to hold it")
+                nodes[t] = pset.const_id + matches[0]
             consts[t] = value
     import jax.numpy as jnp
 

@@ -17,9 +17,13 @@ import pathlib
 import numpy as np
 
 _LIB_PATH = pathlib.Path(__file__).resolve().parent / "_libhv.so"
+_SRC_PATH = pathlib.Path(__file__).resolve().parent / "src" / "hv.cpp"
 
-if not _LIB_PATH.exists():
-    # One cheap automatic build attempt, mirroring setup.py's optional
+if not _LIB_PATH.exists() or (
+    _SRC_PATH.exists() and _SRC_PATH.stat().st_mtime > _LIB_PATH.stat().st_mtime
+):
+    # One cheap automatic (re)build attempt — on first use or when the
+    # source is newer than the library — mirroring setup.py's optional
     # build with graceful failure (reference setup.py:93-108).
     from deap_tpu.native.build import build
 

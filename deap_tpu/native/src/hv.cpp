@@ -171,6 +171,7 @@ double deap_tpu_hypervolume(const double* data, int n, int d,
 // least-contributor indicator (deap/tools/indicator.py:10-31).
 void deap_tpu_hv_contributions(const double* data, int n, int d,
                                const double* ref, double* out) {
+    if (n <= 0 || d <= 0) return;
     const double total = deap_tpu_hypervolume(data, n, d, ref);
     std::vector<double> rest(static_cast<std::size_t>(n - 1) * d);
     for (int i = 0; i < n; ++i) {

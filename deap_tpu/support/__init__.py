@@ -2,6 +2,18 @@ from deap_tpu.support.stats import Statistics, MultiStatistics
 from deap_tpu.support.logbook import Logbook
 from deap_tpu.support.hof import HallOfFame, hof_init, hof_update, hof_best
 from deap_tpu.support.pareto import ParetoArchive, pareto_init, pareto_update
+from deap_tpu.support.history import (
+    History,
+    Lineage,
+    lineage_init,
+    lineage_step,
+    pair_parents,
+)
+from deap_tpu.support.checkpoint import (
+    Checkpointer,
+    restore_state,
+    save_state,
+)
 
 __all__ = [
     "Statistics",
@@ -14,4 +26,12 @@ __all__ = [
     "ParetoArchive",
     "pareto_init",
     "pareto_update",
+    "History",
+    "Lineage",
+    "lineage_init",
+    "lineage_step",
+    "pair_parents",
+    "Checkpointer",
+    "save_state",
+    "restore_state",
 ]

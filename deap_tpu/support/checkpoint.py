@@ -1,0 +1,123 @@
+"""Checkpoint / resume of full evolution state.
+
+The reference leaves checkpointing to the user: pickle a dict of
+{population, generation, halloffame, logbook, random.getstate()} every
+FREQ generations and restore it, RNG state included
+(/root/reference/doc/tutorials/advanced/checkpoint.rst:22-70). Here the
+entire evolution state — population pytree, strategy state, hall of
+fame, PRNG key — is one pytree, so a checkpoint is a faithful snapshot
+by construction and resuming is bit-exact (explicit `jax.random` keys
+make RNG restoration trivial, SURVEY.md §5.4).
+
+Implementation: a self-contained portable format — flattened pytree →
+numpy arrays + pickled treedef, written atomically. Typed PRNG key
+arrays are converted through ``jax.random.key_data``/``wrap_key_data``
+so they survive serialization. (Evolution state is tiny next to NN
+checkpoints; for multi-host sharded runs, swap :func:`save_state` for an
+orbax checkpointer behind the same :class:`Checkpointer` interface.)
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import re
+import shutil
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_PRNG_TAG = "__prng_key__"
+
+
+def _pack_leaf(leaf: Any) -> Any:
+    if isinstance(leaf, jax.Array) and jnp.issubdtype(leaf.dtype, jax.dtypes.prng_key):
+        impl = str(jax.random.key_impl(leaf))
+        return {_PRNG_TAG: impl, "data": np.asarray(jax.random.key_data(leaf))}
+    if isinstance(leaf, jax.Array):
+        return np.asarray(leaf)
+    return leaf
+
+
+def _unpack_leaf(leaf: Any) -> Any:
+    if isinstance(leaf, dict) and _PRNG_TAG in leaf:
+        m = re.search(r"'(\w+)'", leaf[_PRNG_TAG])
+        impl = m.group(1) if m else leaf[_PRNG_TAG]
+        return jax.random.wrap_key_data(jnp.asarray(leaf["data"]), impl=impl)
+    if isinstance(leaf, np.ndarray):
+        return jnp.asarray(leaf)
+    return leaf
+
+
+def save_state(path: str, state: Any) -> None:
+    """Serialize an arbitrary state pytree to ``path`` (atomic write)."""
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    payload = {"leaves": [_pack_leaf(l) for l in leaves], "treedef": treedef}
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+    os.replace(tmp, path)
+
+
+def restore_state(path: str) -> Any:
+    """Load a state pytree written by :func:`save_state`."""
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    leaves = [_unpack_leaf(l) for l in payload["leaves"]]
+    return jax.tree_util.tree_unflatten(payload["treedef"], leaves)
+
+
+class Checkpointer:
+    """Step-indexed checkpoint directory with rotation.
+
+    The tensor analog of the reference's every-FREQ-generations pickle
+    recipe (checkpoint.rst:22-70):
+
+    >>> ckpt = Checkpointer(dir, keep=3)
+    >>> if ckpt.latest_step() is not None:
+    ...     state = ckpt.restore()          # resume, RNG key included
+    >>> ckpt.save(gen, state)               # inside the outer loop
+    """
+
+    def __init__(self, directory: str, keep: int = 3, prefix: str = "ckpt"):
+        self.directory = directory
+        self.keep = keep
+        self.prefix = prefix
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, step: int) -> str:
+        return os.path.join(self.directory, f"{self.prefix}_{step:08d}.pkl")
+
+    def steps(self) -> List[int]:
+        pat = re.compile(rf"{re.escape(self.prefix)}_(\d+)\.pkl$")
+        out = []
+        for name in os.listdir(self.directory):
+            m = pat.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def save(self, step: int, state: Any) -> str:
+        path = self._path(step)
+        save_state(path, state)
+        if self.keep is not None:
+            for old in self.steps()[: -self.keep]:
+                os.remove(self._path(old))
+        return path
+
+    def restore(self, step: Optional[int] = None) -> Any:
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        return restore_state(self._path(step))
+
+    def clear(self) -> None:
+        shutil.rmtree(self.directory, ignore_errors=True)
+        os.makedirs(self.directory, exist_ok=True)

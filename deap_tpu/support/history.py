@@ -1,0 +1,149 @@
+"""Genealogy tracking — the lineage counterpart of ``tools.History``.
+
+The reference's ``History`` (/root/reference/deap/tools/support.py:21-152)
+works by decorating ``mate``/``mutate`` so every produced individual gets
+a fresh integer id and a record of its parents' ids
+(support.py:105-121), building a NetworkX-compatible genealogy dict
+replayable via ``getGenealogy`` (support.py:123-152).
+
+The tensor formulation keeps ids *on device* as a per-individual extra
+array (SURVEY.md §5.1: "a lineage array (parent indices per generation)
+kept on device"): each generation, selection produces an index vector
+into the previous population; :func:`lineage_step` turns that into fresh
+child ids plus a ``[n, max_parents]`` parent-id record, all as array ops
+inside the jit'd step. The host-side :class:`History` accumulates those
+records (one small transfer per generation, alongside the logbook) into
+the same genealogy-dict structure the reference exposes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+
+@struct.dataclass
+class Lineage:
+    """Device-resident lineage state.
+
+    - ``ids``: int32[n] — the current population's individual ids.
+    - ``next_id``: int32 — the next unassigned id (ids start at 1, like
+      the reference's ``index`` counter, support.py:100-103).
+    """
+
+    ids: jnp.ndarray
+    next_id: jnp.ndarray
+
+
+def lineage_init(n: int) -> Lineage:
+    """Assign ids 1..n to the founding population (the reference's
+    ``history.update(population)`` on generation 0, where founders get
+    themselves as their only 'parent', support.py:105-121)."""
+    return Lineage(
+        ids=jnp.arange(1, n + 1, dtype=jnp.int32),
+        next_id=jnp.int32(n + 1),
+    )
+
+
+def lineage_step(
+    lineage: Lineage, parent_idx: jnp.ndarray
+) -> Tuple[Lineage, jnp.ndarray]:
+    """Advance one generation.
+
+    ``parent_idx``: int32[n_children, max_parents] — rows of indices into
+    the *previous* population (e.g. for varAnd pairs, each child lists
+    both crossover parents; clones list one parent twice). Returns the
+    new lineage (fresh consecutive ids for every child) and the
+    ``int32[n_children, max_parents]`` parent-*id* record to hand to
+    :meth:`History.record`.
+    """
+    parent_idx = jnp.asarray(parent_idx, jnp.int32)
+    if parent_idx.ndim == 1:      # one parent per child (mutation/clone step)
+        parent_idx = parent_idx[:, None]
+    n_children = parent_idx.shape[0]
+    parent_ids = jnp.take(lineage.ids, parent_idx, axis=0)
+    child_ids = lineage.next_id + jnp.arange(n_children, dtype=jnp.int32)
+    return Lineage(ids=child_ids, next_id=lineage.next_id + n_children), parent_ids
+
+
+def pair_parents(sel_idx: jnp.ndarray, cx_mask: jnp.ndarray) -> jnp.ndarray:
+    """Build the varAnd parent-index matrix from a selection index vector.
+
+    Mirrors who-mates-with-whom in the reference's ``varAnd``
+    (/root/reference/deap/algorithms.py:68-76): consecutive pairs
+    (0,1), (2,3), ... cross with probability cxpb. ``cx_mask``:
+    bool[n//2] — which pairs actually crossed. Children that crossed get
+    both pair members as parents; others get their own slot twice.
+    """
+    sel_idx = jnp.asarray(sel_idx, jnp.int32)
+    n = sel_idx.shape[0]
+    partner = jnp.arange(n, dtype=jnp.int32) ^ 1  # 0<->1, 2<->3, ...
+    partner = jnp.where(partner < n, partner, jnp.arange(n, dtype=jnp.int32))
+    # an odd trailing individual has no pair, hence never crosses
+    crossed = jnp.zeros((n,), bool).at[: 2 * cx_mask.shape[0]].set(
+        jnp.repeat(cx_mask, 2)[:n])
+    other = jnp.where(crossed, sel_idx[partner], sel_idx)
+    return jnp.stack([sel_idx, other], axis=1)
+
+
+class History:
+    """Host-side genealogy accumulator (support.py:21-152 counterpart).
+
+    ``genealogy_tree`` maps child id → tuple of parent ids;
+    ``genealogy_history`` maps generation → array of child ids born that
+    generation. Feed it the per-generation ``parent_ids`` records emitted
+    by :func:`lineage_step` (scanned runs can hand over the whole stacked
+    ``[gens, n, p]`` array at once via :meth:`record_scan`).
+    """
+
+    def __init__(self) -> None:
+        self.genealogy_tree: Dict[int, Tuple[int, ...]] = {}
+        self.genealogy_history: Dict[int, np.ndarray] = {}
+        self._next_id = 1
+        self._gen = 0
+
+    def found(self, n: int) -> None:
+        """Register the founding population (ids 1..n, no parents)."""
+        ids = np.arange(self._next_id, self._next_id + n)
+        for i in ids:
+            self.genealogy_tree[int(i)] = ()
+        self.genealogy_history[self._gen] = ids
+        self._next_id += n
+
+    def record(self, parent_ids: np.ndarray) -> None:
+        """Record one generation: row i of ``parent_ids`` lists the parent
+        ids of that generation's i-th child."""
+        parent_ids = np.atleast_2d(np.asarray(parent_ids))
+        n = parent_ids.shape[0]
+        self._gen += 1
+        ids = np.arange(self._next_id, self._next_id + n)
+        for i, row in zip(ids, parent_ids):
+            uniq = tuple(dict.fromkeys(int(p) for p in row))
+            self.genealogy_tree[int(i)] = uniq
+        self.genealogy_history[self._gen] = ids
+        self._next_id += n
+
+    def record_scan(self, stacked_parent_ids: np.ndarray) -> None:
+        """Record a whole scanned run: ``[gens, n, max_parents]``."""
+        for gen_rec in np.asarray(stacked_parent_ids):
+            self.record(gen_rec)
+
+    def get_genealogy(self, ind_id: int, max_depth: float = float("inf")) -> Dict[int, Tuple[int, ...]]:
+        """Ancestor subtree of ``ind_id`` up to ``max_depth`` generations
+        (the reference's ``getGenealogy``, support.py:123-152)."""
+        out: Dict[int, Tuple[int, ...]] = {}
+        frontier = [int(ind_id)]
+        depth = 0
+        while frontier and depth < max_depth:
+            nxt: List[int] = []
+            for cid in frontier:
+                parents = self.genealogy_tree.get(cid, ())
+                if parents:
+                    out[cid] = parents
+                    nxt.extend(p for p in parents if p not in out)
+            frontier = list(dict.fromkeys(nxt))
+            depth += 1
+        return out

@@ -1,0 +1,155 @@
+"""Checkpoint round-trip + genealogy tracking tests.
+
+Counterpart of the reference's pickle-round-trip suite
+(deap/tests/test_pickle.py, the distributed proxy per SURVEY.md §4.3)
+and the History genealogy semantics (deap/tools/support.py:21-152) —
+extended with what the reference cannot test: bit-exact resume of a
+running evolution including its PRNG key.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deap_tpu import ops
+from deap_tpu.algorithms import evaluate_invalid, var_and
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import gather, init_population
+from deap_tpu.core.toolbox import Toolbox
+from deap_tpu.support import (
+    Checkpointer,
+    History,
+    lineage_init,
+    lineage_step,
+    pair_parents,
+    restore_state,
+    save_state,
+)
+
+
+def _onemax_pop(key, n=16, length=8):
+    pop = init_population(
+        key, n, ops.bernoulli_genome(length), FitnessSpec((1.0,)))
+    return evaluate_invalid(pop, lambda g: g.sum(-1).astype(jnp.float32))
+
+
+def test_save_restore_population_pytree(tmp_path):
+    pop = _onemax_pop(jax.random.key(0))
+    path = str(tmp_path / "state.pkl")
+    save_state(path, {"pop": pop, "gen": 7})
+    out = restore_state(path)
+    assert out["gen"] == 7
+    np.testing.assert_array_equal(np.asarray(out["pop"].genomes),
+                                  np.asarray(pop.genomes))
+    np.testing.assert_array_equal(np.asarray(out["pop"].fitness),
+                                  np.asarray(pop.fitness))
+    assert out["pop"].spec.weights == pop.spec.weights
+
+
+def test_save_restore_prng_key_bit_exact(tmp_path):
+    key = jax.random.key(42)
+    path = str(tmp_path / "key.pkl")
+    save_state(path, {"key": key, "split": jax.random.split(key, 3)})
+    out = restore_state(path)
+    a = jax.random.uniform(out["key"], (4,))
+    b = jax.random.uniform(key, (4,))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert out["split"].shape == (3,)
+
+
+def test_resume_is_bit_exact(tmp_path):
+    """Run 4 gens; checkpoint at gen 2; resume and verify gens 3-4 match."""
+    tb = Toolbox()
+    tb.register("evaluate", lambda g: g.sum(-1).astype(jnp.float32))
+    tb.register("mate", ops.cx_one_point)
+    tb.register("mutate", ops.mut_flip_bit, indpb=0.1)
+    tb.register("select", ops.sel_tournament, tournsize=2)
+
+    def gen_step(key, pop):
+        k_sel, k_var = jax.random.split(key)
+        idx = tb.select(k_sel, pop.wvalues, pop.size)
+        off = var_and(k_var, gather(pop, idx), tb, 0.6, 0.3)
+        return evaluate_invalid(off, tb.evaluate)
+
+    ckpt = Checkpointer(str(tmp_path / "ckpts"), keep=2)
+    pop = _onemax_pop(jax.random.key(1))
+    key = jax.random.key(2)
+    straight = None
+    for gen in range(4):
+        key, sub = jax.random.split(key)
+        pop = gen_step(sub, pop)
+        if gen == 1:
+            ckpt.save(gen, {"pop": pop, "key": key, "gen": gen})
+        if gen == 3:
+            straight = pop
+
+    state = ckpt.restore()
+    assert state["gen"] == 1
+    pop2, key2 = state["pop"], state["key"]
+    for gen in range(2, 4):
+        key2, sub = jax.random.split(key2)
+        pop2 = gen_step(sub, pop2)
+    np.testing.assert_array_equal(np.asarray(pop2.genomes),
+                                  np.asarray(straight.genomes))
+    np.testing.assert_array_equal(np.asarray(pop2.fitness),
+                                  np.asarray(straight.fitness))
+
+
+def test_checkpointer_rotation(tmp_path):
+    ckpt = Checkpointer(str(tmp_path / "c"), keep=2)
+    for s in range(5):
+        ckpt.save(s, {"s": s})
+    assert ckpt.steps() == [3, 4]
+    assert ckpt.latest_step() == 4
+    assert ckpt.restore()["s"] == 4
+    assert ckpt.restore(3)["s"] == 3
+
+
+def test_lineage_ids_and_history():
+    lin = lineage_init(4)                     # founders 1..4
+    hist = History()
+    hist.found(4)
+    # gen 1: children from parents (0,1), (1,0), (2,2), (3,3)
+    pidx = jnp.asarray([[0, 1], [1, 0], [2, 2], [3, 3]])
+    lin, parent_ids = lineage_step(lin, pidx)
+    np.testing.assert_array_equal(np.asarray(lin.ids), [5, 6, 7, 8])
+    hist.record(np.asarray(parent_ids))
+    assert hist.genealogy_tree[5] == (1, 2)
+    assert hist.genealogy_tree[7] == (3,)     # self-pair dedups to one
+    # gen 2: all children of individual id 5 (index 0)
+    lin, parent_ids = lineage_step(lin, jnp.zeros((4, 2), jnp.int32))
+    hist.record(np.asarray(parent_ids))
+    assert hist.genealogy_tree[9] == (5,)
+    gene = hist.get_genealogy(9)
+    assert gene[9] == (5,) and gene[5] == (1, 2)
+    # depth limit
+    assert 5 not in hist.get_genealogy(9, max_depth=1)
+
+
+def test_pair_parents_matches_varand_pairing():
+    sel = jnp.asarray([4, 2, 7, 1])
+    cx = jnp.asarray([True, False])
+    p = np.asarray(pair_parents(sel, cx))
+    np.testing.assert_array_equal(p[0], [4, 2])   # pair 0 crossed
+    np.testing.assert_array_equal(p[1], [2, 4])
+    np.testing.assert_array_equal(p[2], [7, 7])   # pair 1 didn't
+    np.testing.assert_array_equal(p[3], [1, 1])
+
+
+def test_lineage_inside_jit_scan():
+    """Lineage bookkeeping must be jit/scan-compatible (stays on device)."""
+    lin = lineage_init(4)
+
+    def step(carry, idx):
+        lin = carry
+        lin, parents = lineage_step(lin, idx)
+        return lin, parents
+
+    idxs = jnp.zeros((3, 4, 2), jnp.int32)
+    lin_out, recs = jax.jit(lambda l, i: jax.lax.scan(step, l, i))(lin, idxs)
+    assert int(lin_out.next_id) == 17
+    assert recs.shape == (3, 4, 2)
+    hist = History()
+    hist.found(4)
+    hist.record_scan(np.asarray(recs))
+    assert hist.genealogy_tree[9] == (5,)
