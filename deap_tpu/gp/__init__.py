@@ -33,8 +33,38 @@ from deap_tpu.gp.tree import (
     tree_height,
 )
 from deap_tpu.gp.string import to_string
+from deap_tpu.gp.typed import (
+    PrimitiveSetTyped,
+    make_cx_one_point_typed,
+    make_generator_typed,
+    make_mut_ephemeral_typed,
+    make_mut_insert_typed,
+    make_mut_node_replacement_typed,
+    make_mut_shrink_typed,
+    make_mut_uniform_typed,
+    spam_set,
+)
+from deap_tpu.gp.adf import (
+    branch_wise_cx,
+    branch_wise_mut,
+    make_adf_generator,
+    make_adf_interpreter,
+)
 
 __all__ = [
+    "PrimitiveSetTyped",
+    "make_generator_typed",
+    "make_cx_one_point_typed",
+    "make_mut_uniform_typed",
+    "make_mut_node_replacement_typed",
+    "make_mut_ephemeral_typed",
+    "make_mut_insert_typed",
+    "make_mut_shrink_typed",
+    "spam_set",
+    "make_adf_interpreter",
+    "make_adf_generator",
+    "branch_wise_cx",
+    "branch_wise_mut",
     "Genome",
     "PrimitiveSet",
     "bool_set",

@@ -1,0 +1,153 @@
+"""Automatically Defined Functions — multi-branch tensor programs.
+
+Counterpart of the reference's ADF machinery: ``PrimitiveSetTyped.addADF``
+(/root/reference/deap/gp.py:414-423) and ``compileADF`` (gp.py:490-513),
+where an individual is a *list* of trees — MAIN first, then the ADF
+branches — and each branch's primitive set may invoke later branches as
+ordinary primitives (examples/gp/adf_symbreg.py builds a 3-ADF ladder
+this way).
+
+Here an individual is a tuple of tensor genomes, one per branch. An ADF
+call node in branch *i*'s prefix array evaluates branch *j* (``j > i``,
+mirroring the reference's progressive-context compile order) on the
+operand vectors at the call site — a nested stack-machine scan. Cost is
+O(len_i · len_j) per call level, fully jit/vmap-safe, and — unlike the
+reference's eval-of-nested-lambdas — depth-bounded by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deap_tpu.gp.pset import PrimitiveSet
+from deap_tpu.gp.tree import Genome, make_generator
+
+
+Branches = Sequence[Tuple[PrimitiveSet, int]]   # [(pset, max_len), ...]
+
+
+def _build_branch(pset: PrimitiveSet, max_len: int, branch_idx: int,
+                  interps: dict) -> Callable:
+    """interp(genomes, X) for one branch; ADF nodes dispatch into
+    ``interps`` (already built for every branch index > branch_idx)."""
+    arity = pset.arity_table()
+    n_ops = pset.n_ops
+    max_ar = max(pset.max_arity, 1)
+    prims = list(pset.primitives)
+
+    def interpret(genomes, X):
+        genome = genomes[branch_idx]
+        nodes, consts, length = (genome["nodes"], genome["consts"],
+                                 genome["length"])
+        P = X.shape[0]
+        argsT = X.T.astype(jnp.float32)
+        stack0 = jnp.zeros((max_len + max_ar, P), jnp.float32)
+
+        def step(carry, t):
+            stack, sp = carry
+            rt = length - 1 - t
+            valid = rt >= 0
+            slot = jnp.maximum(rt, 0)
+            node = nodes[slot]
+            ops_in = [
+                lax.dynamic_index_in_dim(stack, sp - 1 - i, keepdims=False)
+                for i in range(max_ar)
+            ]
+            rows = []
+            for p in prims:
+                if p.adf is None:
+                    rows.append(p.fn(*ops_in[: p.arity]))
+                else:
+                    sub_X = jnp.stack(ops_in[: p.arity], axis=1)
+                    rows.append(interps[p.adf](genomes, sub_X))
+            rows.extend(argsT)
+            rows.append(jnp.broadcast_to(consts[slot], (P,)))
+            allv = jnp.stack(rows)
+            row = jnp.minimum(node, jnp.int32(n_ops + pset.n_args))
+            res = lax.dynamic_index_in_dim(allv, row, keepdims=False)
+            ar = arity[node]
+            new_sp = sp - ar + 1
+            new_stack = lax.dynamic_update_index_in_dim(
+                stack, res, new_sp - 1, axis=0)
+            stack = jnp.where(valid, new_stack, stack)
+            sp = jnp.where(valid, new_sp, sp)
+            return (stack, sp), None
+
+        (stack, _), _ = lax.scan(
+            step, (stack0, jnp.int32(0)), jnp.arange(max_len))
+        return stack[0]
+
+    return interpret
+
+
+def make_adf_interpreter(branches: Branches) -> Callable:
+    """Build ``evaluate(genomes, X) -> f32[points]`` over a multi-branch
+    individual. ``branches[0]`` is MAIN (compileADF's ``func``,
+    gp.py:508-513); branch *i* may contain ``add_adf(..., branch=j)``
+    nodes only for ``j > i``."""
+    for i, (pset, _) in enumerate(branches):
+        for p in pset.primitives:
+            if p.adf is None:
+                continue
+            if p.adf <= i:
+                raise ValueError(
+                    f"branch {i} calls branch {p.adf}; ADF calls must "
+                    "target later branches (no recursion, matching the "
+                    "reference's progressive compile order)")
+            if p.adf >= len(branches):
+                raise ValueError(
+                    f"branch {i} calls branch {p.adf}, but only "
+                    f"{len(branches)} branches were given")
+            callee = branches[p.adf][0]
+            if p.arity != callee.n_args:
+                raise ValueError(
+                    f"ADF call {p.name!r} passes {p.arity} operands but "
+                    f"branch {p.adf} ({callee.name!r}) takes "
+                    f"{callee.n_args} arguments")
+    interps: dict = {}
+    for i in reversed(range(len(branches))):
+        pset, max_len = branches[i]
+        interps[i] = _build_branch(pset, max_len, i, interps)
+    return interps[0]
+
+
+def make_adf_generator(branches: Branches, min_depth: int, max_depth: int,
+                       mode: str = "half_and_half") -> Callable:
+    """``gen(key) -> tuple of genomes`` — every branch generated with
+    its own vocabulary (the reference initialises each subtree with its
+    own pset's expr, examples/gp/adf_symbreg.py:44-56)."""
+    gens = [make_generator(pset, max_len, min_depth, max_depth, mode)
+            for pset, max_len in branches]
+
+    def gen(key: jax.Array):
+        keys = jax.random.split(key, len(gens))
+        return tuple(g(k) for g, k in zip(gens, keys))
+
+    return gen
+
+
+def branch_wise_cx(cx_ops: List[Callable]) -> Callable:
+    """Apply a crossover per branch pair — the ADF mating pattern
+    (examples/gp/adf_symbreg.py:77-83: ``for tree1, tree2 in zip(ind1,
+    ind2): toolbox.mate(tree1, tree2)``)."""
+
+    def cx(key, g1, g2):
+        keys = jax.random.split(key, len(cx_ops))
+        outs = [op(k, a, b) for op, k, a, b in zip(cx_ops, keys, g1, g2)]
+        return tuple(o[0] for o in outs), tuple(o[1] for o in outs)
+
+    return cx
+
+
+def branch_wise_mut(mut_ops: List[Callable]) -> Callable:
+    """Apply a mutation per branch (adf_symbreg.py:85-89)."""
+
+    def mut(key, g):
+        keys = jax.random.split(key, len(mut_ops))
+        return tuple(op(k, b) for op, k, b in zip(mut_ops, keys, g))
+
+    return mut

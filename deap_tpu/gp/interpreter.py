@@ -36,6 +36,10 @@ def make_interpreter(pset: PrimitiveSet, max_len: int) -> Callable:
     f32[max_len], "length": int32}``; ``X`` is ``f32[points, n_args]``.
     vmap over genomes for populations, over X for multiple datasets.
     """
+    if pset.has_adf:
+        raise ValueError(
+            "primitive set contains ADF calls; use "
+            "deap_tpu.gp.adf.make_adf_interpreter")
     arity = pset.arity_table()
     n_ops = pset.n_ops
     max_ar = max(pset.max_arity, 1)

@@ -36,9 +36,10 @@ import numpy as np
 @dataclasses.dataclass
 class _Primitive:
     name: str
-    fn: Callable            # (a, b, c ...) element-wise jnp function
+    fn: Optional[Callable]  # (a, b, c ...) element-wise jnp function
     arity: int
     fmt: Optional[str] = None  # e.g. "({0} + {1})" for pretty printing
+    adf: Optional[int] = None  # branch index when this is an ADF call
 
     def format(self, *args: str) -> str:
         if self.fmt:
@@ -75,6 +76,19 @@ class PrimitiveSet:
         assert arity >= 1, "arity should be >= 1"
         self.primitives.append(
             _Primitive(name or fn.__name__, fn, arity, fmt))
+
+    def add_adf(self, name: str, arity: int, branch: int) -> None:
+        """Register an Automatically Defined Function call (the tensor
+        counterpart of ``PrimitiveSetTyped.addADF``, gp.py:414-423):
+        node invokes branch ``branch`` of the same individual on its
+        ``arity`` operand vectors. Only :func:`deap_tpu.gp.adf.
+        make_adf_interpreter` understands these nodes."""
+        assert arity >= 1, "ADFs take at least one argument"
+        self.primitives.append(_Primitive(name, None, arity, None, branch))
+
+    @property
+    def has_adf(self) -> bool:
+        return any(p.adf is not None for p in self.primitives)
 
     def add_terminal(self, value: float, name: Optional[str] = None) -> None:
         """Register a constant terminal (gp.py:362-382). Stored in the

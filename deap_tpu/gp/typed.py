@@ -1,0 +1,571 @@
+"""Strongly-typed GP — type constraints as static tables + masked draws.
+
+Counterpart of the reference's ``PrimitiveSetTyped`` and the type-aware
+generator/operators (/root/reference/deap/gp.py:260-429 for the set;
+``generate`` type threading at gp.py:589-638; type-aware ``cxOnePoint``
+at gp.py:645-682; same-signature ``mutNodeReplacement`` at gp.py:760-783;
+typed ``mutInsert`` gp.py:814-851 and ``mutShrink`` gp.py:854-887).
+
+Types are interned to dense int ids. The set compiles to three static
+tables — ``arity_table`` (inherited), ``ret_type_table`` (int32[vocab])
+and ``arg_type_table`` (int32[n_ops, max_arity]) — and every stochastic
+draw becomes a masked uniform-score argmax over the eligible ids, which
+is exactly a uniform draw over the eligible set and jit/vmap-safe.
+
+Where the reference raises ``IndexError`` at generation time when a
+required type has no terminal (gp.py:603-608), the tensor generator
+validates the vocabulary once at build time.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deap_tpu.gp.pset import PrimitiveSet, _Primitive
+from deap_tpu.gp.tree import Genome, _splice, subtree_end
+
+
+class PrimitiveSetTyped(PrimitiveSet):
+    """A primitive set whose nodes carry return/argument types.
+
+    :param in_types: type names of the tree's input arguments.
+    :param ret_type: type name the whole tree must return.
+
+    All data still flows through one f32 stack row per slot (booleans are
+    {0.0, 1.0} floats); types only constrain *structure*, as in the
+    reference where the interpreter (Python eval) is also untyped and
+    types exist purely in the generation/variation machinery.
+    """
+
+    def __init__(self, name: str, in_types: Sequence[str], ret_type: str,
+                 prefix: str = "ARG"):
+        super().__init__(name, len(in_types), prefix)
+        self._types: dict = {}
+        self.ret = self.type_id(ret_type)
+        self.in_type_ids = [self.type_id(t) for t in in_types]
+        self.prim_rets: list = []
+        self.prim_args: list = []
+        self.const_types: list = []
+        self.erc_entries: list = []     # (name, sampler, type_id)
+
+    # ------------------------------------------------------------- builder ----
+
+    def type_id(self, name: str) -> int:
+        if name not in self._types:
+            self._types[name] = len(self._types)
+        return self._types[name]
+
+    @property
+    def n_types(self) -> int:
+        return len(self._types)
+
+    def add_primitive(self, fn: Callable, in_types: Sequence[str],
+                      ret_type: str, name: Optional[str] = None,
+                      fmt: Optional[str] = None) -> None:
+        """Register a typed operator (gp.py:325-346)."""
+        assert len(in_types) >= 1, "arity should be >= 1"
+        self.primitives.append(
+            _Primitive(name or fn.__name__, fn, len(in_types), fmt))
+        self.prim_rets.append(self.type_id(ret_type))
+        self.prim_args.append([self.type_id(t) for t in in_types])
+
+    def add_terminal(self, value: float, ret_type: str,
+                     name: Optional[str] = None) -> None:
+        """Register a typed constant terminal (gp.py:348-380)."""
+        super().add_terminal(value, name)
+        self.const_types.append(self.type_id(ret_type))
+
+    def add_ephemeral_constant(self, name: str, sampler: Callable,
+                               ret_type: str) -> None:
+        """Register a typed ERC (gp.py:382-412); unlike the untyped set,
+        a typed set may hold one ERC pool *per type*."""
+        self.erc_entries.append((name, sampler, self.type_id(ret_type)))
+
+    def add_adf(self, name: str, in_types: Sequence[str], ret_type: str,
+                branch: int = None) -> None:
+        """Typed ADF call (``PrimitiveSetTyped.addADF``, gp.py:414-423):
+        the call node carries the callee's argument/return types so the
+        typed tables stay aligned."""
+        if branch is None:
+            raise TypeError(
+                "PrimitiveSetTyped.add_adf(name, in_types, ret_type, "
+                "branch) — the branch index is required")
+        super().add_adf(name, len(in_types), branch)
+        self.prim_rets.append(self.type_id(ret_type))
+        self.prim_args.append([self.type_id(t) for t in in_types])
+
+    # -------------------------------------------------------------- layout ----
+
+    @property
+    def has_erc(self) -> bool:
+        return bool(self.erc_entries)
+
+    @property
+    def n_ercs(self) -> int:
+        return len(self.erc_entries)
+
+    @property
+    def vocab(self) -> int:
+        return self.n_ops + self.n_args + self.n_consts + self.n_ercs
+
+    @property
+    def n_terminal_choices(self) -> int:
+        return self.n_args + self.n_consts + self.n_ercs
+
+    def node_name(self, node_id: int, const: float = 0.0) -> str:
+        if node_id >= self.erc_id:
+            return repr(round(float(const), 6))
+        return super().node_name(node_id, const)
+
+    # -------------------------------------------------------- static tables ----
+
+    def ret_type_table(self) -> jnp.ndarray:
+        """int32[vocab] — return type of every node id."""
+        rets = (list(self.prim_rets) + list(self.in_type_ids)
+                + list(self.const_types)
+                + [t for (_, _, t) in self.erc_entries])
+        return jnp.asarray(rets, jnp.int32)
+
+    def arg_type_table(self) -> jnp.ndarray:
+        """int32[n_ops, max_arity] — argument types per operator
+        (padded with 0 past each arity)."""
+        m = max(self.max_arity, 1)
+        rows = [args + [0] * (m - len(args)) for args in self.prim_args]
+        if not rows:
+            rows = [[0] * m]
+        return jnp.asarray(rows, jnp.int32)
+
+    def _term_masks(self) -> np.ndarray:
+        """bool[n_types, n_terminal_choices]."""
+        n_t = max(self.n_terminal_choices, 1)
+        mask = np.zeros((max(self.n_types, 1), n_t), bool)
+        types = (list(self.in_type_ids) + list(self.const_types)
+                 + [t for (_, _, t) in self.erc_entries])
+        for j, t in enumerate(types):
+            mask[t, j] = True
+        return mask
+
+    def _op_masks(self) -> np.ndarray:
+        """bool[n_types, n_ops] — operators returning each type."""
+        mask = np.zeros((max(self.n_types, 1), max(self.n_ops, 1)), bool)
+        for j, t in enumerate(self.prim_rets):
+            mask[t, j] = True
+        return mask
+
+    def validate(self) -> None:
+        """Every type demanded anywhere (root, operator argument) must
+        have at least one terminal — the build-time analog of the
+        generator's IndexError (gp.py:603-608)."""
+        term = self._term_masks().any(axis=1)
+        demanded = {self.ret}
+        for args in self.prim_args:
+            demanded.update(args)
+        names = {v: k for k, v in self._types.items()}
+        for t in demanded:
+            if not term[t]:
+                raise ValueError(
+                    f"type {names.get(t, t)!r} has no terminal; generation "
+                    "would be unable to close a branch of this type")
+
+    # --------------------------------------------------------- typed draws ----
+
+    def sample_terminal_typed(self, key: jax.Array, type_: jnp.ndarray,
+                              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Uniform draw among terminals returning ``type_`` →
+        (node_id, const_value)."""
+        k_c, k_v = jax.random.split(key)
+        n_t = max(self.n_terminal_choices, 1)
+        mask = jnp.asarray(self._term_masks())[type_]
+        scores = jax.random.uniform(k_c, (n_t,))
+        choice = jnp.argmax(jnp.where(mask, scores, -1.0))
+        vals = jnp.zeros((n_t,), jnp.float32)
+        if self.n_consts:
+            vals = vals.at[self.n_args:self.n_args + self.n_consts].set(
+                jnp.asarray(self.const_values, jnp.float32))
+        for j, (_, sampler, _t) in enumerate(self.erc_entries):
+            vals = vals.at[self.n_args + self.n_consts + j].set(
+                sampler(jax.random.fold_in(k_v, j)))
+        node = (self.n_ops + choice).astype(jnp.int32)
+        return node, vals[choice]
+
+    def sample_op_typed(self, key: jax.Array, type_: jnp.ndarray,
+                        room: Optional[jnp.ndarray] = None,
+                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Uniform draw among operators returning ``type_`` (and fitting
+        ``room`` slots) → (op_id, found)."""
+        n_o = max(self.n_ops, 1)
+        mask = jnp.asarray(self._op_masks())[type_]
+        if room is not None:
+            mask = mask & (self.arity_table()[:n_o] <= room)
+        scores = jax.random.uniform(key, (n_o,))
+        op = jnp.argmax(jnp.where(mask, scores, -1.0)).astype(jnp.int32)
+        return op, mask.any()
+
+
+# ---------------------------------------------------------------- generator ----
+
+def make_generator_typed(pset: PrimitiveSetTyped, max_len: int,
+                         min_depth: int, max_depth: int,
+                         mode: str = "half_and_half") -> Callable:
+    """Typed tree generator: ``gen(key, ret_type=None) -> genome``.
+
+    Tensor counterpart of the type-threading ``generate``
+    (gp.py:589-638): the pending stack carries (depth, required type);
+    children are pushed rightmost-first so the LIFO pop order walks the
+    prefix left-to-right with each slot's required argument type.
+    """
+    if mode not in ("full", "grow", "half_and_half"):
+        raise ValueError(mode)
+    pset.validate()
+    t_ratio = pset.terminal_ratio
+    arity = pset.arity_table()
+    arg_types = pset.arg_type_table()
+    max_ar = max(pset.max_arity, 1)
+
+    def gen(key: jax.Array, ret_type=None) -> Genome:
+        root_t = jnp.int32(pset.ret if ret_type is None else ret_type)
+        k_h, k_mode, k_scan = jax.random.split(key, 3)
+        height = jax.random.randint(k_h, (), min_depth, max_depth + 1)
+        if mode == "full":
+            grow = jnp.bool_(False)
+        elif mode == "grow":
+            grow = jnp.bool_(True)
+        else:
+            grow = jax.random.bernoulli(k_mode, 0.5)
+
+        nodes0 = jnp.full((max_len,), pset.const_id, jnp.int32)
+        consts0 = jnp.zeros((max_len,), jnp.float32)
+        dstack0 = jnp.zeros((max_len + 1,), jnp.int32)
+        tstack0 = jnp.zeros((max_len + 1,), jnp.int32).at[0].set(root_t)
+
+        def step(carry, inp):
+            nodes, consts, dstack, tstack, sp, length = carry
+            t, k = inp
+            pending = sp > 0
+            top = jnp.maximum(sp - 1, 0)
+            d = dstack[top]
+            ty = tstack[top]
+            sp_pop = sp - 1
+
+            k_t, k_term, k_op = jax.random.split(k, 3)
+            room = max_len - t - sp_pop - 1
+            force_term = (d >= height) | (room < 1)
+            grow_term = grow & (d >= min_depth) & (
+                jax.random.uniform(k_t) < t_ratio)
+            op_node, has_op = pset.sample_op_typed(k_op, ty, room)
+            is_term = force_term | grow_term | ~has_op
+
+            term_node, term_val = pset.sample_terminal_typed(k_term, ty)
+            node = jnp.where(is_term, term_node, op_node)
+            val = jnp.where(is_term, term_val, 0.0)
+
+            nodes = jnp.where(pending, nodes.at[t].set(node), nodes)
+            consts = jnp.where(pending, consts.at[t].set(val), consts)
+            ar = jnp.where(is_term, 0, arity[op_node])
+            idx = jnp.arange(max_len + 1)
+            push = (idx >= sp_pop) & (idx < sp_pop + ar)
+            # slot sp_pop+j receives arg ar-1-j: leftmost arg on top
+            child_arg = jnp.clip(ar - 1 - (idx - sp_pop), 0, max_ar - 1)
+            child_t = arg_types[op_node][child_arg]
+            dstack = jnp.where(pending & push, d + 1, dstack)
+            tstack = jnp.where(pending & push, child_t, tstack)
+            sp = jnp.where(pending, sp_pop + ar, sp)
+            length = length + pending.astype(jnp.int32)
+            return (nodes, consts, dstack, tstack, sp, length), None
+
+        keys = jax.random.split(k_scan, max_len)
+        init = (nodes0, consts0, dstack0, tstack0, jnp.int32(1),
+                jnp.int32(0))
+        (nodes, consts, _, _, _, length), _ = lax.scan(
+            step, init, (jnp.arange(max_len), keys))
+        return {"nodes": nodes, "consts": consts, "length": length}
+
+    return gen
+
+
+# ---------------------------------------------------------------- crossover ----
+
+def make_cx_one_point_typed(pset: PrimitiveSetTyped) -> Callable:
+    """Type-aware one-point crossover (gp.py:645-682): the swap points
+    must have equal return types; when the parents share no common type
+    below the root, both pass through unchanged."""
+    arity = pset.arity_table()
+    rett = pset.ret_type_table()
+
+    def cx(key: jax.Array, g1: Genome, g2: Genome) -> Tuple[Genome, Genome]:
+        k1, k2 = jax.random.split(key)
+        L = g1["nodes"].shape[0]
+        idx = jnp.arange(L)
+        in1 = (idx >= 1) & (idx < g1["length"])
+        in2 = (idx >= 1) & (idx < g2["length"])
+        t1 = rett[g1["nodes"]]
+        t2 = rett[g2["nodes"]]
+        # eligible in g1: some node of the same type exists in g2
+        match = (t1[:, None] == t2[None, :]) & in2[None, :]
+        elig1 = in1 & match.any(axis=1)
+        ok = elig1.any()
+        s1 = jax.random.uniform(k1, (L,))
+        i1 = jnp.argmax(jnp.where(elig1, s1, -1.0))
+        elig2 = in2 & (t2 == t1[i1])
+        s2 = jax.random.uniform(k2, (L,))
+        i2 = jnp.argmax(jnp.where(elig2, s2, -1.0))
+        e1 = subtree_end(g1["nodes"], arity, i1)
+        e2 = subtree_end(g2["nodes"], arity, i2)
+        c1 = _splice(g1, i1, e1, g2["nodes"], g2["consts"], i2, e2 - i2)
+        c2 = _splice(g2, i2, e2, g1["nodes"], g1["consts"], i1, e1 - i1)
+
+        def pick(child, parent):
+            return jax.tree_util.tree_map(
+                lambda a, b: jnp.where(ok, a, b), child, parent)
+
+        return pick(c1, g1), pick(c2, g2)
+
+    return cx
+
+
+# ---------------------------------------------------------------- mutations ----
+
+def make_mut_uniform_typed(pset: PrimitiveSetTyped, expr: Callable) -> Callable:
+    """Typed subtree replacement (mutUniform, gp.py:743-757): the fresh
+    expression is generated with the replaced subtree's return type.
+    ``expr`` must accept ``(key, ret_type)`` — see
+    :func:`make_generator_typed`."""
+    arity = pset.arity_table()
+    rett = pset.ret_type_table()
+
+    def mut(key: jax.Array, g: Genome) -> Genome:
+        k_i, k_e = jax.random.split(key)
+        i = jax.random.randint(k_i, (), 0, jnp.maximum(g["length"], 1))
+        e = subtree_end(g["nodes"], arity, i)
+        new = expr(k_e, rett[g["nodes"][i]])
+        return _splice(g, i, e, new["nodes"], new["consts"], 0,
+                       new["length"])
+
+    return mut
+
+
+def make_mut_node_replacement_typed(pset: PrimitiveSetTyped) -> Callable:
+    """Same-signature node replacement (mutNodeReplacement,
+    gp.py:760-783): terminals are redrawn among terminals of the same
+    type; operators among operators with identical (ret, args)
+    signature."""
+    arity = pset.arity_table()
+    rett = pset.ret_type_table()
+    n_o = max(pset.n_ops, 1)
+    sig_groups: dict = {}
+    sig_mask = np.zeros((n_o, n_o), bool)
+    for j, (r, args) in enumerate(zip(pset.prim_rets, pset.prim_args)):
+        sig_groups.setdefault((r, tuple(args)), []).append(j)
+    for members in sig_groups.values():
+        for a in members:
+            for b in members:
+                sig_mask[a, b] = True
+    sig_mask_j = jnp.asarray(sig_mask)
+
+    def mut(key: jax.Array, g: Genome) -> Genome:
+        k_i, k_t, k_o = jax.random.split(key, 3)
+        i = jax.random.randint(k_i, (), 0, jnp.maximum(g["length"], 1))
+        node = g["nodes"][i]
+        is_term = arity[node] == 0
+        term_node, term_val = pset.sample_terminal_typed(k_t, rett[node])
+        scores = jax.random.uniform(k_o, (n_o,))
+        row = sig_mask_j[jnp.clip(node, 0, n_o - 1)]
+        op_node = jnp.argmax(jnp.where(row, scores, -1.0)).astype(jnp.int32)
+        new_node = jnp.where(is_term, term_node, op_node)
+        new_val = jnp.where(is_term, term_val, g["consts"][i])
+        return {
+            "nodes": g["nodes"].at[i].set(new_node),
+            "consts": g["consts"].at[i].set(new_val),
+            "length": g["length"],
+        }
+
+    return mut
+
+
+def make_mut_ephemeral_typed(pset: PrimitiveSetTyped,
+                             mode: str = "one") -> Callable:
+    """Typed ERC resampling (mutEphemeral, gp.py:786-811) over every ERC
+    pool; each node redraws from its own pool's sampler."""
+    if not pset.has_erc:
+        raise ValueError("primitive set has no ephemeral constant")
+    if mode not in ("one", "all"):
+        raise ValueError(mode)
+    first_erc = pset.erc_id
+
+    def mut(key: jax.Array, g: Genome) -> Genome:
+        L = g["nodes"].shape[0]
+        k_pick, k_val = jax.random.split(key)
+        is_erc = (g["nodes"] >= first_erc) & (jnp.arange(L) < g["length"])
+        new_vals = g["consts"]
+        for j, (_, sampler, _t) in enumerate(pset.erc_entries):
+            draws = jax.vmap(sampler)(
+                jax.random.split(jax.random.fold_in(k_val, j), L))
+            new_vals = jnp.where(g["nodes"] == first_erc + j, draws,
+                                 new_vals)
+        if mode == "one":
+            scores = jax.random.uniform(k_pick, (L,))
+            chosen = jnp.argmax(jnp.where(is_erc, scores, -1.0))
+            target = is_erc & (jnp.arange(L) == chosen)
+        else:
+            target = is_erc
+        return {
+            "nodes": g["nodes"],
+            "consts": jnp.where(target, new_vals, g["consts"]),
+            "length": g["length"],
+        }
+
+    return mut
+
+
+def make_mut_insert_typed(pset: PrimitiveSetTyped) -> Callable:
+    """Typed insertion (mutInsert, gp.py:814-851): the new operator must
+    return the chosen subtree's type and accept it among its arguments;
+    remaining arguments are fresh terminals of the operator's declared
+    argument types. No eligible operator → unchanged."""
+    arity = pset.arity_table()
+    rett = pset.ret_type_table()
+    arg_types = pset.arg_type_table()
+    max_ar = max(pset.max_arity, 1)
+    n_o = max(pset.n_ops, 1)
+    # accepts[j, t] — operator j has some argument of type t
+    n_ty = max(pset.n_types, 1)
+    accepts = np.zeros((n_o, n_ty), bool)
+    for j, args in enumerate(pset.prim_args):
+        for t in args:
+            accepts[j, t] = True
+    accepts_j = jnp.asarray(accepts)
+    op_ret = jnp.asarray(
+        (pset.prim_rets or [0]), jnp.int32)
+
+    def mut(key: jax.Array, g: Genome) -> Genome:
+        L = g["nodes"].shape[0]
+        k_i, k_op, k_slot, k_terms = jax.random.split(key, 4)
+        i = jax.random.randint(k_i, (), 0, jnp.maximum(g["length"], 1))
+        t = rett[g["nodes"][i]]
+        e = subtree_end(g["nodes"], arity, i)
+        seg = e - i
+        mask = (op_ret == t) & accepts_j[:, t]
+        found = mask.any()
+        scores = jax.random.uniform(k_op, (n_o,))
+        op = jnp.argmax(jnp.where(mask, scores, -1.0)).astype(jnp.int32)
+        ar = arity[op]
+        # choose the argument slot (of type t) receiving the old subtree
+        slot_ok = (arg_types[op] == t) & (jnp.arange(max_ar) < ar)
+        s = jax.random.uniform(k_slot, (max_ar,))
+        pos = jnp.argmax(jnp.where(slot_ok, s, -1.0))
+        t_draws = [pset.sample_terminal_typed(
+            jax.random.fold_in(k_terms, j), arg_types[op][j])
+            for j in range(max_ar)]
+        t_nodes = jnp.stack([n for n, _ in t_draws])
+        t_vals = jnp.stack([v for _, v in t_draws])
+
+        DL = 1 + max_ar + L
+        k = jnp.arange(DL)
+        donor_nodes = jnp.zeros((DL,), jnp.int32).at[0].set(op)
+        donor_consts = jnp.zeros((DL,), jnp.float32)
+        in_pre = (k >= 1) & (k < 1 + pos)
+        in_sub = (k >= 1 + pos) & (k < 1 + pos + seg)
+        in_post = (k >= 1 + pos + seg) & (k < 1 + seg + ar - 1)
+        src_term_pre = jnp.clip(k - 1, 0, max_ar - 1)
+        src_sub = jnp.clip(i + k - 1 - pos, 0, L - 1)
+        # arg index at post position k: pos pre-terminals + the subtree
+        # + offset past it = k - seg
+        src_term_post = jnp.clip(k - seg, 0, max_ar - 1)
+        donor_nodes = jnp.where(
+            in_pre, t_nodes[src_term_pre], jnp.where(
+                in_sub, g["nodes"][src_sub], jnp.where(
+                    in_post, t_nodes[src_term_post], donor_nodes)))
+        donor_consts = jnp.where(
+            in_pre, t_vals[src_term_pre], jnp.where(
+                in_sub, g["consts"][src_sub], jnp.where(
+                    in_post, t_vals[src_term_post], donor_consts)))
+        out = _splice(g, i, e, donor_nodes, donor_consts, 0,
+                      1 + (ar - 1) + seg)
+        return jax.tree_util.tree_map(
+            lambda a, b: jnp.where(found, a, b), out, g)
+
+    return mut
+
+
+def make_mut_shrink_typed(pset: PrimitiveSetTyped) -> Callable:
+    """Typed shrink (mutShrink, gp.py:854-887): collapse an operator
+    onto one of its argument subtrees *of the same return type*."""
+    arity = pset.arity_table()
+    rett = pset.ret_type_table()
+    arg_types = pset.arg_type_table()
+    max_ar = max(pset.max_arity, 1)
+    n_o = max(pset.n_ops, 1)
+    # shrinkable[j]: operator j returns a type it also accepts
+    shrinkable = np.zeros((n_o,), bool)
+    for j, (r, args) in enumerate(zip(pset.prim_rets, pset.prim_args)):
+        shrinkable[j] = r in args
+    shrinkable_j = jnp.asarray(shrinkable)
+
+    def mut(key: jax.Array, g: Genome) -> Genome:
+        L = g["nodes"].shape[0]
+        k_i, k_c = jax.random.split(key)
+        idx = jnp.arange(L)
+        in_tree = (idx >= 1) & (idx < g["length"])
+        node_ok = (arity[g["nodes"]] > 0) & in_tree & shrinkable_j[
+            jnp.clip(g["nodes"], 0, n_o - 1)]
+        has = node_ok.any() & (g["length"] >= 3)
+        scores = jax.random.uniform(k_i, (L,))
+        i = jnp.argmax(jnp.where(node_ok, scores, -1.0))
+        op = g["nodes"][i]
+        ar = arity[op]
+        t = rett[op]
+        ok_child = (arg_types[op] == t) & (jnp.arange(max_ar) < ar)
+        s = jax.random.uniform(k_c, (max_ar,))
+        child = jnp.argmax(jnp.where(ok_child, s, -1.0))
+
+        def walk(j, start):
+            return jnp.where(j < child,
+                             subtree_end(g["nodes"], arity, start), start)
+
+        c_begin = lax.fori_loop(0, max_ar, walk, i + 1)
+        c_end = subtree_end(g["nodes"], arity, c_begin)
+        e = subtree_end(g["nodes"], arity, i)
+        out = _splice(g, i, e, g["nodes"], g["consts"], c_begin,
+                      c_end - c_begin)
+        return jax.tree_util.tree_map(
+            lambda a, b: jnp.where(has, a, b), out, g)
+
+    return mut
+
+
+# ------------------------------------------------------------ stock vocab ----
+
+def spam_set(n_features: int = 2) -> PrimitiveSetTyped:
+    """A bool/float typed vocabulary in the mold of the reference's
+    spambase example (examples/gp/spambase.py:26-49): float comparisons
+    feed boolean logic feeding an if-then-else over floats."""
+    ps = PrimitiveSetTyped("SPAM", ["float"] * n_features, "bool")
+    ps.add_primitive(lambda a, b: (a * b), ["bool", "bool"], "bool", "and_",
+                     "({0} & {1})")
+    ps.add_primitive(lambda a, b: jnp.minimum(a + b, 1.0),
+                     ["bool", "bool"], "bool", "or_", "({0} | {1})")
+    ps.add_primitive(lambda a: 1.0 - a, ["bool"], "bool", "not_", "(~{0})")
+    ps.add_primitive(lambda a, b: (a < b).astype(jnp.float32),
+                     ["float", "float"], "bool", "lt", "({0} < {1})")
+    ps.add_primitive(lambda a, b: (a == b).astype(jnp.float32),
+                     ["float", "float"], "bool", "eq", "({0} == {1})")
+    ps.add_primitive(jnp.add, ["float", "float"], "float", "add",
+                     "({0} + {1})")
+    ps.add_primitive(jnp.subtract, ["float", "float"], "float", "sub",
+                     "({0} - {1})")
+    ps.add_primitive(jnp.multiply, ["float", "float"], "float", "mul",
+                     "({0} * {1})")
+    ps.add_primitive(lambda c, a, b: jnp.where(c > 0.5, a, b),
+                     ["bool", "float", "float"], "float", "if_then_else")
+    ps.add_terminal(0.0, "bool", "False")
+    ps.add_terminal(1.0, "bool", "True")
+    ps.add_ephemeral_constant(
+        "rand100",
+        lambda k: jax.random.uniform(k, (), minval=0.0, maxval=100.0),
+        "float")
+    return ps

@@ -115,8 +115,11 @@ class History:
 
     def record(self, parent_ids: np.ndarray) -> None:
         """Record one generation: row i of ``parent_ids`` lists the parent
-        ids of that generation's i-th child."""
-        parent_ids = np.atleast_2d(np.asarray(parent_ids))
+        ids of that generation's i-th child. A 1-D array means one parent
+        per child (the same convention as ``lineage_step``)."""
+        parent_ids = np.asarray(parent_ids)
+        if parent_ids.ndim == 1:
+            parent_ids = parent_ids[:, None]
         n = parent_ids.shape[0]
         self._gen += 1
         ids = np.arange(self._next_id, self._next_id + n)

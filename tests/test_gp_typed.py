@@ -1,0 +1,199 @@
+"""Typed-GP and ADF tests: well-typedness invariants under generation
+and every typed variation operator, and ADF interpreter semantics
+(reference: deap/gp.py:260-429 typed sets, :414-423/:490-513 ADFs,
+examples/gp/spambase.py, examples/gp/adf_symbreg.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deap_tpu import gp
+
+MAX_LEN = 48
+
+
+@pytest.fixture(scope="module")
+def tset():
+    return gp.spam_set(n_features=2)
+
+
+def well_typed(genome, pset):
+    """Independent numpy type-checker: walk the prefix with a stack of
+    required types and verify every node's return type matches."""
+    arity = np.asarray(pset.arity_table())
+    rett = np.asarray(pset.ret_type_table())
+    argt = np.asarray(pset.arg_type_table())
+    nodes = np.asarray(genome["nodes"])
+    length = int(genome["length"])
+    stack = [pset.ret]
+    for t in range(length):
+        if not stack:
+            return False
+        want = stack.pop()
+        node = int(nodes[t])
+        if int(rett[node]) != want:
+            return False
+        ar = int(arity[node])
+        if ar:
+            for j in reversed(range(ar)):
+                stack.append(int(argt[node][j]))
+    return not stack
+
+
+def _unstack(genomes, i):
+    return jax.tree_util.tree_map(lambda a: a[i], genomes)
+
+
+def test_typed_generator_well_typed(tset):
+    gen = gp.make_generator_typed(tset, MAX_LEN, 1, 5)
+    genomes = jax.vmap(lambda k: gen(k))(
+        jax.random.split(jax.random.key(0), 64))
+    for i in range(64):
+        assert well_typed(_unstack(genomes, i), tset)
+
+
+def test_typed_generator_root_type_override(tset):
+    gen = gp.make_generator_typed(tset, MAX_LEN, 1, 4)
+    rett = np.asarray(tset.ret_type_table())
+    float_id = tset.type_id("float")
+    for seed in range(8):
+        g = gen(jax.random.key(seed), ret_type=float_id)
+        assert int(rett[int(g["nodes"][0])]) == float_id
+
+
+def test_validate_rejects_terminal_free_type():
+    ps = gp.PrimitiveSetTyped("BAD", ["float"], "bool")
+    ps.add_primitive(lambda a, b: a * b, ["bool", "bool"], "bool", "and_")
+    # no bool terminal anywhere
+    with pytest.raises(ValueError, match="no terminal"):
+        gp.make_generator_typed(ps, 16, 1, 3)
+
+
+def test_typed_crossover_preserves_types(tset):
+    gen = gp.make_generator_typed(tset, MAX_LEN, 2, 5)
+    cx = gp.make_cx_one_point_typed(tset)
+    keys = jax.random.split(jax.random.key(1), 32)
+    g1 = jax.vmap(lambda k: gen(k))(keys)
+    g2 = jax.vmap(lambda k: gen(k))(jax.random.split(jax.random.key(2), 32))
+    c1, c2 = jax.vmap(cx)(jax.random.split(jax.random.key(3), 32), g1, g2)
+    for i in range(32):
+        assert well_typed(_unstack(c1, i), tset)
+        assert well_typed(_unstack(c2, i), tset)
+
+
+@pytest.mark.parametrize("op_name", [
+    "node_replacement", "uniform", "insert", "shrink", "ephemeral"])
+def test_typed_mutations_preserve_types(tset, op_name):
+    gen = gp.make_generator_typed(tset, MAX_LEN, 2, 5)
+    if op_name == "node_replacement":
+        mut = gp.make_mut_node_replacement_typed(tset)
+    elif op_name == "uniform":
+        expr = gp.make_generator_typed(tset, MAX_LEN, 0, 2, "grow")
+        mut = gp.make_mut_uniform_typed(tset, expr)
+    elif op_name == "insert":
+        mut = gp.make_mut_insert_typed(tset)
+    elif op_name == "shrink":
+        mut = gp.make_mut_shrink_typed(tset)
+    else:
+        mut = gp.make_mut_ephemeral_typed(tset, "all")
+    genomes = jax.vmap(lambda k: gen(k))(
+        jax.random.split(jax.random.key(4), 32))
+    out = jax.vmap(mut)(jax.random.split(jax.random.key(5), 32), genomes)
+    for i in range(32):
+        assert well_typed(_unstack(out, i), tset)
+
+
+def test_typed_interpreter_runs(tset):
+    gen = gp.make_generator_typed(tset, MAX_LEN, 1, 5)
+    interp = gp.make_interpreter(tset, MAX_LEN)
+    X = jax.random.uniform(jax.random.key(6), (16, 2)) * 100.0
+    genomes = jax.vmap(lambda k: gen(k))(
+        jax.random.split(jax.random.key(7), 16))
+    out = jax.vmap(lambda g: interp(g, X))(genomes)
+    assert out.shape == (16, 16)
+    # boolean root → outputs in {0, 1}
+    assert np.all((np.asarray(out) == 0.0) | (np.asarray(out) == 1.0))
+
+
+# -------------------------------------------------------------------- ADFs ----
+
+def _adf_branches():
+    """MAIN(x) may call ADF0(a); ADF0 is plain arithmetic."""
+    adf0 = gp.math_set(n_args=1, trig=False, erc=False, name="ADF0")
+    main = gp.math_set(n_args=1, trig=False, erc=False, name="MAIN")
+    main.add_adf("ADF0", 1, branch=1)
+    return [(main, 32), (adf0, 32)]
+
+
+def test_adf_interpreter_matches_manual_composition():
+    branches = _adf_branches()
+    main, adf0 = branches[0][0], branches[1][0]
+    interp = gp.make_adf_interpreter(branches)
+    from deap_tpu.gp.string import from_string
+
+    # ADF0(a) = a * a ; MAIN(x) = ADF0(x + 1)  →  (x+1)²
+    g_adf = from_string("mul(ARG0, ARG0)", adf0, 32)
+    adf_call = main.n_ops - 1   # add_adf appended last
+    g_main = {
+        "nodes": jnp.zeros((32,), jnp.int32)
+        .at[0].set(adf_call)
+        .at[1].set(0)                       # add
+        .at[2].set(main.n_ops)              # ARG0
+        .at[3].set(main.const_id),          # const 1.0
+        "consts": jnp.zeros((32,), jnp.float32).at[3].set(1.0),
+        "length": jnp.int32(4),
+    }
+    X = jnp.linspace(-2.0, 2.0, 9)[:, None]
+    got = interp((g_main, g_adf), X)
+    np.testing.assert_allclose(got, (X[:, 0] + 1.0) ** 2, rtol=1e-6)
+
+
+def test_adf_rejects_forward_recursion():
+    adf0 = gp.math_set(n_args=1, erc=False, name="ADF0")
+    adf0.add_adf("SELF", 1, branch=1)   # branch calling itself
+    with pytest.raises(ValueError, match="later branches"):
+        gp.make_adf_interpreter([(gp.math_set(1), 16), (adf0, 16)])
+
+
+def test_adf_generate_evolve_smoke():
+    """adf_symbreg-shaped loop: generation + branch-wise variation keeps
+    every branch a valid prefix program and fitness improves."""
+    branches = _adf_branches()
+    gen = gp.make_adf_generator(branches, 1, 3)
+    cx = gp.branch_wise_cx([
+        gp.make_cx_one_point(branches[0][0]),
+        gp.make_cx_one_point(branches[1][0]),
+    ])
+    mut = gp.branch_wise_mut([
+        gp.make_mut_node_replacement(branches[0][0]),
+        gp.make_mut_node_replacement(branches[1][0]),
+    ])
+    interp = gp.make_adf_interpreter(branches)
+    X = jnp.linspace(-1.0, 1.0, 20)[:, None]
+    y = X[:, 0] ** 2 + X[:, 0]
+
+    def fitness(genomes):
+        pred = interp(genomes, X)
+        return -jnp.mean((pred - y) ** 2)
+
+    pop = 64
+    keys = jax.random.split(jax.random.key(8), pop)
+    genomes = jax.vmap(gen)(keys)
+    fit0 = jax.vmap(fitness)(genomes)
+
+    def step(key, genomes, fits):
+        k_sel, k_cx, k_mut = jax.random.split(key, 3)
+        idx = jax.random.randint(k_sel, (pop, 3), 0, pop)
+        winner = idx[jnp.arange(pop), jnp.argmax(fits[idx], axis=1)]
+        parents = jax.tree_util.tree_map(lambda a: a[winner], genomes)
+        perm = jnp.roll(jnp.arange(pop), 1)
+        mates = jax.tree_util.tree_map(lambda a: a[perm], parents)
+        c1, _ = jax.vmap(cx)(jax.random.split(k_cx, pop), parents, mates)
+        c1 = jax.vmap(mut)(jax.random.split(k_mut, pop), c1)
+        return c1, jax.vmap(fitness)(c1)
+
+    fits = fit0
+    for g in range(10):
+        genomes, fits = step(jax.random.key(100 + g), genomes, fits)
+    assert float(fits.max()) >= float(fit0.max())
