@@ -50,6 +50,13 @@ from deap_tpu.gp.adf import (
     make_adf_generator,
     make_adf_interpreter,
 )
+from deap_tpu.gp.semantic import (
+    add_semantic_primitives,
+    logistic,
+    make_cx_semantic,
+    make_mut_semantic,
+)
+from deap_tpu.gp.harm import harm
 
 __all__ = [
     "PrimitiveSetTyped",
@@ -65,6 +72,11 @@ __all__ = [
     "make_adf_generator",
     "branch_wise_cx",
     "branch_wise_mut",
+    "add_semantic_primitives",
+    "logistic",
+    "make_mut_semantic",
+    "make_cx_semantic",
+    "harm",
     "Genome",
     "PrimitiveSet",
     "bool_set",

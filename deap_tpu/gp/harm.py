@@ -1,0 +1,160 @@
+"""HARM-GP bloat control (Gardner, Gagné & Parizeau 2015).
+
+Counterpart of the reference's ``gp.harm`` (/root/reference/deap/gp.py:
+938-1135): each generation (1) models the *natural* size distribution by
+generating a large trial offspring population, (2) smooths it with a
+small discrete kernel (weights 0.4/0.2/0.2/0.1/0.1 at offsets
+0/±1/±2, gp.py:1080-1089), (3) picks a cutoff size from the sizes of the
+fittest (1−rho) tail (gp.py:1091-1097), (4) shapes a target
+distribution that decays exponentially past the cutoff with half-life
+``alpha·size + beta`` (gp.py:1099-1107), and (5) produces the real
+offspring by accepting trial individuals with probability
+target/natural of their size (gp.py:1109-1117).
+
+The accept-reject stream of the reference (host Python, one aspirant at
+a time, gp.py:993-1043) is replaced by a batched formulation: the trial
+population *is* the acceptance pool, and the offspring are drawn by
+Gumbel top-k over acceptance-weighted scores — accepted sizes follow
+the same target distribution, with no per-individual host dispatch. The
+per-generation cutoff/histogram scalars are data-dependent, so the
+generation loop runs on host around jit-compiled kernels (SURVEY.md
+§7.3).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu.algorithms import evaluate_invalid
+from deap_tpu.core.population import Population, gather
+from deap_tpu.support.hof import hof_update
+from deap_tpu.support.logbook import Logbook
+
+
+def _sizes(pop: Population) -> jnp.ndarray:
+    return pop.genomes["length"]
+
+
+def _trial_offspring(key: jax.Array, pop: Population, toolbox, n: int,
+                     cxpb: float, mutpb: float) -> Population:
+    """Generate ``n`` trial children the way the reference's ``_genpop``
+    does (gp.py:993-1043): parents via ``toolbox.select``; each child is
+    a crossover child (prob cxpb), a mutant (prob mutpb) or a reproduced
+    copy that keeps its valid fitness."""
+    k_u, k_sel, k_cx, k_mut = jax.random.split(key, 4)
+    u = jax.random.uniform(k_u, (n,))
+    idx = toolbox.select(k_sel, pop.wvalues, 2 * n)
+    p1 = gather(pop, idx[:n])
+    p2 = gather(pop, idx[n:])
+    c1, _ = jax.vmap(toolbox.mate)(jax.random.split(k_cx, n),
+                                   p1.genomes, p2.genomes)
+    m1 = jax.vmap(toolbox.mutate)(jax.random.split(k_mut, n), p1.genomes)
+    is_cx = u < cxpb
+    is_mut = (u >= cxpb) & (u < cxpb + mutpb)
+
+    def mix(cx_leaf, mut_leaf, rep_leaf):
+        m = is_cx.reshape((-1,) + (1,) * (cx_leaf.ndim - 1))
+        mm = is_mut.reshape((-1,) + (1,) * (cx_leaf.ndim - 1))
+        return jnp.where(m, cx_leaf, jnp.where(mm, mut_leaf, rep_leaf))
+
+    genomes = jax.tree_util.tree_map(mix, c1, m1, p1.genomes)
+    touched = is_cx | is_mut
+    return p1.replace(genomes=genomes).invalidate(touched)
+
+
+@partial(jax.jit, static_argnames=("max_size",))
+def _kde_hist(sizes: jnp.ndarray, max_size: int) -> jnp.ndarray:
+    """Kernel-smoothed size histogram (gp.py:1080-1089): each size adds
+    0.4 at itself, 0.2 at ±1, 0.1 at ±2 (negative bins dropped)."""
+    hist = jnp.zeros((max_size + 3,), jnp.float32)
+    for off, w in ((0, 0.4), (-1, 0.2), (1, 0.2), (-2, 0.1), (2, 0.1)):
+        b = sizes + off
+        ok = b >= 0
+        hist = hist.at[jnp.where(ok, b, 0)].add(jnp.where(ok, w, 0.0))
+    return hist
+
+
+def harm(key: jax.Array, pop: Population, toolbox, cxpb: float,
+         mutpb: float, ngen: int, alpha: float = 0.05, beta: float = 10.0,
+         gamma: float = 0.25, rho: float = 0.9, nbrindsmodel: int = -1,
+         mincutoff: int = 20, stats=None, halloffame=None,
+         verbose: bool = False) -> Tuple[Population, Logbook, Optional[object]]:
+    """Run a HARM-GP evolution (gp.py:938-1135 semantics; recommended
+    parameters alpha=0.05, beta=10, gamma=0.25, rho=0.9 per the paper's
+    note at gp.py:978-984). Genomes must be tensor prefix trees (their
+    ``length`` field is the size measure the reference takes as
+    ``len(individual)``)."""
+    n = pop.size
+    if nbrindsmodel == -1:
+        nbrindsmodel = max(2000, n)
+    max_size = int(pop.genomes["nodes"].shape[-1])
+    # jit per harm() call, closing over the toolbox: a cross-call cache
+    # keyed on toolbox identity would replay stale operators after a
+    # re-register()
+    trial = jax.jit(lambda k, p: _trial_offspring(
+        k, p, toolbox, nbrindsmodel, cxpb, mutpb))
+
+    nevals0 = int(jnp.sum(~pop.valid))
+    pop = evaluate_invalid(pop, toolbox.evaluate)
+    hof = halloffame
+    if hof is not None:
+        hof = hof_update(hof, pop)
+    logbook = Logbook()
+    rec = stats.compile(pop) if stats else {}
+    logbook.record(gen=0, nevals=nevals0, **rec)
+    if verbose:
+        print(logbook.stream)
+
+    for gen in range(1, ngen + 1):
+        key, k_nat, k_acc, k_pick = jax.random.split(key, 4)
+
+        # 1) natural size distribution from a big trial batch
+        natural = trial(k_nat, pop)
+        sizes = _sizes(natural)
+        naturalhist = _kde_hist(sizes, max_size) * (n / nbrindsmodel)
+
+        # 2) cutoff from the fittest tail (gp.py:1091-1097): sort the
+        # trial pop ascending by fitness (invalid rows first, like the
+        # reference's empty-wvalues tuples) and take the sizes past
+        # index n*rho - 1.
+        fit_key = jnp.where(natural.valid, natural.wvalues.sum(-1), -jnp.inf)
+        order = jnp.argsort(fit_key)
+        tail = jnp.asarray(sizes)[order][int(n * rho - 1):]
+        cutoffsize = max(mincutoff, int(tail.min()))
+
+        # 3) target distribution with exponential decay past the cutoff
+        bins = jnp.arange(max_size + 3, dtype=jnp.float32)
+        halflife = bins * alpha + beta
+        targetfunc = (gamma * n * math.log(2) / halflife) * jnp.exp(
+            -math.log(2) * (bins - cutoffsize) / halflife)
+        targethist = jnp.where(bins <= cutoffsize, naturalhist, targetfunc)
+
+        # 4) acceptance probability per size
+        probhist = jnp.where(naturalhist > 0, targethist / naturalhist,
+                             targethist)
+        probs = jnp.clip(probhist[jnp.clip(sizes, 0, max_size + 2)], 0.0, 1.0)
+
+        # 5) offspring: accepted trial individuals first (Gumbel top-k
+        # over acceptance draws — the batched analog of the reference's
+        # accept-reject stream, gp.py:1109-1117)
+        accept = jax.random.bernoulli(k_acc, probs)
+        score = jax.random.uniform(k_pick, (nbrindsmodel,)) + accept * 2.0
+        take = jax.lax.top_k(score, n)[1]
+        offspring = gather(natural, take)
+        nevals = int(jnp.sum(~offspring.valid))
+        offspring = evaluate_invalid(offspring, toolbox.evaluate)
+        if hof is not None:
+            hof = hof_update(hof, offspring)
+        pop = offspring
+
+        rec = stats.compile(pop) if stats else {}
+        logbook.record(gen=gen, nevals=nevals, **rec)
+        if verbose:
+            print(logbook.stream)
+
+    return pop, logbook, hof

@@ -1,0 +1,193 @@
+"""Geometric semantic GP operators (Moraglio et al. 2012).
+
+Counterpart of the reference's ``mutSemantic``/``cxSemantic``
+(/root/reference/deap/gp.py:1215-1329): offspring are built *syntactically*
+as arithmetic combinations of the parents and fresh random trees —
+
+- mutation:  child = parent + ms · (lf(tr1) − lf(tr2))
+- crossover: child1 = ind1·lf(tr) + (1 − lf(tr))·ind2 (and symmetrically)
+
+— where ``lf`` is the logistic function squashing the random trees into
+(0, 1). Like the reference, the operators require ``add``/``sub``/``mul``
+/``lf`` primitives to exist in the set (gp.py:1244-1245, 1306-1307).
+
+On fixed-width prefix arrays the construction is a pure segment
+concatenation; when the composed program would exceed ``max_len`` the
+parent is returned unchanged (the array-width analog of the unbounded
+list growth that makes reference GSGP runs explode in memory).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu.gp.pset import PrimitiveSet
+from deap_tpu.gp.tree import Genome
+
+
+def logistic(x: jnp.ndarray) -> jnp.ndarray:
+    """lf(x) = 1 / (1 + e^{-x}) (the doctest helper at gp.py:1231)."""
+    return jax.nn.sigmoid(x)
+
+
+def add_semantic_primitives(pset: PrimitiveSet) -> PrimitiveSet:
+    """Ensure the add/sub/mul/lf vocabulary the semantic operators
+    require; missing ones are appended, plus a fixed literal terminal
+    for the injected ms / 1.0 constants.
+
+    Call this BEFORE generating any genomes: appending primitives or
+    terminals renumbers node ids, so genomes generated from the set's
+    earlier layout would silently decode wrongly afterwards."""
+    names = {p.name for p in pset.primitives}
+    if "add" not in names:
+        pset.add_primitive(jnp.add, 2, "add", "({0} + {1})")
+    if "sub" not in names:
+        pset.add_primitive(jnp.subtract, 2, "sub", "({0} - {1})")
+    if "mul" not in names:
+        pset.add_primitive(jnp.multiply, 2, "mul", "({0} * {1})")
+    if "lf" not in names:
+        pset.add_primitive(logistic, 1, "lf")
+    if pset.n_consts == 0:
+        # dedicated literal slot, distinct from any ERC id so
+        # mut_ephemeral never resamples injected constants
+        pset.add_terminal(1.0, "1.0")
+    return pset
+
+
+def _prim_id(pset: PrimitiveSet, name: str) -> int:
+    for i, p in enumerate(pset.primitives):
+        if p.name == name:
+            return i
+    raise ValueError(
+        f"a {name!r} function is required in order to perform semantic "
+        "variation (gp.py:1244-1245); call add_semantic_primitives(pset)")
+
+
+def _literal_id(pset: PrimitiveSet) -> int:
+    """A *fixed-terminal* node id usable as an inline literal (its value
+    lives in the parallel consts array). An ERC id would be resampled by
+    ``mut_ephemeral`` (tree.py targets ``nodes == erc_id``), silently
+    rewriting the injected ms / 1.0 constants — so a fixed terminal is
+    required; ``add_semantic_primitives`` provides one."""
+    if pset.n_consts == 0:
+        raise ValueError(
+            "semantic operators need a fixed terminal to host literal "
+            "constants; call add_semantic_primitives(pset) before "
+            "generating genomes")
+    return pset.const_id
+
+
+def _scalar(node_id, value=0.0):
+    return (jnp.asarray([node_id], jnp.int32),
+            jnp.asarray([value], jnp.float32), jnp.int32(1))
+
+
+def _seg(g: Genome):
+    return (g["nodes"], g["consts"], g["length"])
+
+
+def _concat(max_len: int, parts: List[Tuple]) -> Genome:
+    """Concatenate (nodes, consts, length) segments into one prefix
+    array of width ``max_len`` (slots past the total are padding)."""
+    k = jnp.arange(max_len)
+    nodes = jnp.zeros((max_len,), jnp.int32)
+    consts = jnp.zeros((max_len,), jnp.float32)
+    off = jnp.int32(0)
+    for n_src, c_src, ln in parts:
+        src = jnp.clip(k - off, 0, n_src.shape[0] - 1)
+        in_seg = (k >= off) & (k < off + ln)
+        nodes = jnp.where(in_seg, n_src[src], nodes)
+        consts = jnp.where(in_seg, c_src[src], consts)
+        off = off + ln
+    return {"nodes": nodes, "consts": consts, "length": off}
+
+
+def _pad_to(g: Genome, max_len: int) -> Genome:
+    """Widen a genome's arrays to ``max_len`` slots (semantic offspring
+    are wider than their parents by construction)."""
+    width = g["nodes"].shape[0]
+    if width > max_len:
+        raise ValueError(
+            f"parent width {width} exceeds operator max_len {max_len}")
+    if width == max_len:
+        return g
+    pad = max_len - width
+    return {
+        "nodes": jnp.pad(g["nodes"], (0, pad)),
+        "consts": jnp.pad(g["consts"], (0, pad)),
+        "length": g["length"],
+    }
+
+
+def _keep_if_fits(new: Genome, old: Genome, max_len: int) -> Genome:
+    ok = new["length"] <= max_len
+    old = _pad_to(old, max_len)
+    return jax.tree_util.tree_map(
+        lambda a, b: jnp.where(ok, a, b), new, old)
+
+
+def make_mut_semantic(pset: PrimitiveSet, expr: Callable, max_len: int,
+                      ms: Optional[float] = None) -> Callable:
+    """Semantic mutation (mutSemantic, gp.py:1215-1268):
+    ``child = add(parent, mul(ms, sub(lf(tr1), lf(tr2))))`` with ``tr1``,
+    ``tr2`` fresh trees from ``expr`` and ``ms`` the mutation step —
+    drawn uniformly from (0, 2) per application when not fixed, as in
+    the reference (gp.py:1252-1253)."""
+    add_i = _prim_id(pset, "add")
+    sub_i = _prim_id(pset, "sub")
+    mul_i = _prim_id(pset, "mul")
+    lf_i = _prim_id(pset, "lf")
+    lit = _literal_id(pset)
+
+    def mut(key: jax.Array, g: Genome) -> Genome:
+        k1, k2, k_ms = jax.random.split(key, 3)
+        tr1 = expr(k1)
+        tr2 = expr(k2)
+        ms_v = (jax.random.uniform(k_ms, (), minval=0.0, maxval=2.0)
+                if ms is None else jnp.float32(ms))
+        new = _concat(max_len, [
+            _scalar(add_i),
+            _seg(g),
+            _scalar(mul_i),
+            (jnp.asarray([lit], jnp.int32), ms_v[None], jnp.int32(1)),
+            _scalar(sub_i),
+            _scalar(lf_i), _seg(tr1),
+            _scalar(lf_i), _seg(tr2),
+        ])
+        return _keep_if_fits(new, g, max_len)
+
+    return mut
+
+
+def make_cx_semantic(pset: PrimitiveSet, expr: Callable,
+                     max_len: int) -> Callable:
+    """Semantic crossover (cxSemantic, gp.py:1270-1329):
+    ``child1 = add(mul(ind1, lf(tr)), mul(sub(1, lf(tr)), ind2))`` and
+    symmetrically for child2, with ONE shared random tree ``tr`` per
+    mating, as in the reference."""
+    add_i = _prim_id(pset, "add")
+    sub_i = _prim_id(pset, "sub")
+    mul_i = _prim_id(pset, "mul")
+    lf_i = _prim_id(pset, "lf")
+    lit = _literal_id(pset)
+
+    def one_child(a: Genome, b: Genome, tr: Genome) -> Genome:
+        return _concat(max_len, [
+            _scalar(add_i), _scalar(mul_i),
+            _seg(a),
+            _scalar(lf_i), _seg(tr),
+            _scalar(mul_i), _scalar(sub_i), _scalar(lit, 1.0),
+            _scalar(lf_i), _seg(tr),
+            _seg(b),
+        ])
+
+    def cx(key: jax.Array, g1: Genome, g2: Genome) -> Tuple[Genome, Genome]:
+        tr = expr(key)
+        c1 = _keep_if_fits(one_child(g1, g2, tr), g1, max_len)
+        c2 = _keep_if_fits(one_child(g2, g1, tr), g2, max_len)
+        return c1, c2
+
+    return cx

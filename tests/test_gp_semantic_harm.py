@@ -1,0 +1,127 @@
+"""Semantic GP and HARM-GP tests (reference: deap/gp.py:1215-1329
+mutSemantic/cxSemantic, gp.py:938-1135 harm)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deap_tpu import gp, ops
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import init_population
+from deap_tpu.core.toolbox import Toolbox
+
+MAX_LEN = 160
+
+
+@pytest.fixture(scope="module")
+def pset():
+    ps = gp.math_set(n_args=1, trig=False)
+    gp.add_semantic_primitives(ps)
+    return ps
+
+
+def valid_prefix(genome, pset):
+    arity = np.asarray(pset.arity_table())
+    nodes = np.asarray(genome["nodes"])
+    length = int(genome["length"])
+    need = 1
+    for t in range(length):
+        need += arity[nodes[t]] - 1
+    return need == 0 and length >= 1
+
+
+def test_mut_semantic_semantics(pset):
+    """child(x) == parent(x) + ms·(lf(tr1(x)) − lf(tr2(x))); with fixed
+    ms the mutated output must differ from the parent by a bounded
+    perturbation |delta| <= ms."""
+    expr = gp.make_generator(pset, 16, 1, 2, "grow")
+    mut = gp.make_mut_semantic(pset, expr, MAX_LEN, ms=0.5)
+    interp = gp.make_interpreter(pset, MAX_LEN)
+    gen = gp.make_generator(pset, MAX_LEN, 1, 3)
+    X = jnp.linspace(-1, 1, 16)[:, None]
+    for seed in range(6):
+        g = gen(jax.random.key(seed))
+        child = mut(jax.random.key(100 + seed), g)
+        assert valid_prefix(child, pset)
+        before = interp(g, X)
+        after = interp(child, X)
+        delta = np.asarray(after - before)
+        assert np.all(np.abs(delta) <= 0.5 + 1e-5)
+        # lf outputs are in (0,1) so the perturbation is rarely exactly 0
+        assert child["length"] > g["length"]
+
+
+def test_cx_semantic_convex_combination(pset):
+    """child1(x) = lf(tr)(x)·p1(x) + (1−lf(tr)(x))·p2(x) lies between
+    the parents pointwise."""
+    expr = gp.make_generator(pset, 16, 1, 2, "grow")
+    cx = gp.make_cx_semantic(pset, expr, MAX_LEN)
+    interp = gp.make_interpreter(pset, MAX_LEN)
+    gen = gp.make_generator(pset, 48, 1, 3)
+    X = jnp.linspace(-1, 1, 16)[:, None]
+    for seed in range(6):
+        g1 = gen(jax.random.key(seed))
+        g2 = gen(jax.random.key(50 + seed))
+        c1, c2 = cx(jax.random.key(200 + seed), g1, g2)
+        assert valid_prefix(c1, pset) and valid_prefix(c2, pset)
+        p1, p2 = interp(g1, X), interp(g2, X)
+        lo = np.minimum(np.asarray(p1), np.asarray(p2)) - 1e-4
+        hi = np.maximum(np.asarray(p1), np.asarray(p2)) + 1e-4
+        o1 = np.asarray(interp(c1, X))
+        o2 = np.asarray(interp(c2, X))
+        assert np.all((o1 >= lo) & (o1 <= hi))
+        assert np.all((o2 >= lo) & (o2 <= hi))
+
+
+def test_semantic_overflow_returns_parent(pset):
+    expr = gp.make_generator(pset, 16, 1, 2, "grow")
+    mut = gp.make_mut_semantic(pset, expr, 24, ms=0.5)   # tiny width
+    gen = gp.make_generator(pset, 24, 3, 4, "full")
+    g = gen(jax.random.key(0))
+    child = mut(jax.random.key(1), g)
+    # composed program cannot fit 24 slots → parent unchanged
+    np.testing.assert_array_equal(child["nodes"], g["nodes"])
+
+
+def test_requires_semantic_primitives():
+    bare = gp.PrimitiveSet("BARE", 1)
+    bare.add_primitive(jnp.add, 2, "add")
+    bare.add_terminal(1.0)
+    expr = gp.make_generator(bare, 8, 1, 2, "grow")
+    with pytest.raises(ValueError, match="required in order to perform"):
+        gp.make_mut_semantic(bare, expr, 32)
+
+
+def test_harm_controls_bloat(pset):
+    """symbreg_harm-shaped run: evolve x²+x with HARM and without; HARM's
+    mean tree size must stay well below the unconstrained run's."""
+    max_len = 64
+    gen = gp.make_generator(pset, max_len, 1, 3)
+    expr_small = gp.make_generator(pset, 16, 0, 2, "grow")
+    interp = gp.make_interpreter(pset, max_len)
+    X = jnp.linspace(-1, 1, 20)[:, None]
+    y = X[:, 0] ** 2 + X[:, 0]
+
+    def evaluate(genomes):
+        preds = jax.vmap(lambda g: interp(g, X))(genomes)
+        return -jnp.mean((preds - y) ** 2, axis=-1)
+
+    tb = Toolbox()
+    tb.register("evaluate", evaluate)
+    tb.register("mate", gp.make_cx_one_point(pset))
+    tb.register("mutate", gp.make_mut_uniform(pset, expr_small))
+    tb.register("select", ops.sel_tournament, tournsize=3)
+
+    pop = init_population(jax.random.key(0), 64,
+                          lambda k: gen(k), FitnessSpec((1.0,)))
+    out, logbook, _ = gp.harm(jax.random.key(1), pop, tb, 0.5, 0.2,
+                              ngen=8, nbrindsmodel=256, mincutoff=10)
+    sizes = np.asarray(out.genomes["length"])
+    assert len(logbook) == 9
+    assert logbook[0]["gen"] == 0 and logbook[-1]["gen"] == 8
+    # HARM must keep mean size bounded: cutoff floor is 10, decay beyond
+    assert sizes.mean() < 40.0
+    assert np.all(sizes >= 1)
+    # fitness should not collapse: best individual still evaluates
+    assert np.isfinite(np.asarray(out.wvalues).max())
