@@ -1,0 +1,120 @@
+"""Co-evolution — multiple interacting populations in one jit step.
+
+Counterpart of the reference's co-evolution examples (SURVEY.md §2.3 P7):
+
+- **Competitive** (host-parasite, /root/reference/examples/coev/hillis.py:
+  72-145): two populations with *opposite* fitness weights evaluated on
+  index-paired encounters — ``fit = evaluate(host_i, parasite_i)`` is
+  written to both, hosts minimising and parasites maximising
+  (hillis.py:131-134, both assigned the same values).
+- **Cooperative** (Potter & De Jong 2001, examples/coev/coop_base.py and
+  the coop_niche/gen/adapt/evol ladder): each species evolves one *part*
+  of a solution; an individual's fitness is computed by assembling it
+  with the current *representatives* (best member) of every other
+  species (coop_base.py:57-66 matchSetStrength over the assembled set).
+
+Both are expressed as pure functions over tuples of
+:class:`~deap_tpu.core.population.Population`; the species count is
+static so a whole co-evolution step jit-compiles into one XLA program —
+the tensor form of "multiple population tensors in one jit step,
+cross-eval as batched pairing" (SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu.algorithms import var_and
+from deap_tpu.core.population import Population, gather
+
+
+def _as2d(values: jnp.ndarray) -> jnp.ndarray:
+    return values[:, None] if values.ndim == 1 else values
+
+
+def _rep(pop: Population):
+    """Current representative = best member's genome
+    (``toolbox.get_best`` = selBest k=1, coop_base.py:104)."""
+    i = pop.best_index()
+    return jax.tree_util.tree_map(lambda a: a[i], pop.genomes)
+
+
+# ---------------------------------------------------------------- competitive ----
+
+def competitive_eval(hosts: Population, parasites: Population,
+                     eval_pair: Callable) -> Tuple[Population, Population]:
+    """Index-paired encounter evaluation (hillis.py:131-134): row i of
+    each population meet; the raw outcome is written to both sides, whose
+    opposite ``FitnessSpec`` weights make one minimise and the other
+    maximise. Every pair re-fights — the reference re-evaluates all
+    pairs each generation (hillis.py:147-149), since selection reshuffles
+    who faces whom."""
+    values = _as2d(jax.vmap(eval_pair)(hosts.genomes, parasites.genomes))
+    return hosts.with_fitness(values), parasites.with_fitness(values)
+
+
+def competitive_step(key: jax.Array, hosts: Population,
+                     parasites: Population, htoolbox, ptoolbox,
+                     eval_pair: Callable, h_cxpb: float = 0.5,
+                     h_mutpb: float = 0.3, p_cxpb: float = 0.5,
+                     p_mutpb: float = 0.3,
+                     ) -> Tuple[Population, Population]:
+    """One Hillis generation (hillis.py:139-152): select + varAnd each
+    side independently, then paired re-evaluation."""
+    k_hs, k_hv, k_ps, k_pv = jax.random.split(key, 4)
+    h_idx = htoolbox.select(k_hs, hosts.wvalues, hosts.size)
+    p_idx = ptoolbox.select(k_ps, parasites.wvalues, parasites.size)
+    hosts = var_and(k_hv, gather(hosts, h_idx), htoolbox, h_cxpb, h_mutpb)
+    parasites = var_and(k_pv, gather(parasites, p_idx), ptoolbox,
+                        p_cxpb, p_mutpb)
+    return competitive_eval(hosts, parasites, eval_pair)
+
+
+# --------------------------------------------------------------- cooperative ----
+
+def coop_representatives(species: Sequence[Population]) -> List:
+    """Representatives of every species (initially: their best members;
+    the reference seeds them with random members before gen 0,
+    coop_niche.py-style, then keeps the best)."""
+    return [_rep(s) for s in species]
+
+
+def coop_eval_species(i: int, pop: Population, reps: Sequence,
+                      evaluate: Callable) -> Population:
+    """Evaluate species ``i``: every member assembled with the other
+    species' representatives. ``evaluate(i, genomes, reps) -> f32[n]``
+    receives the *full* representative tuple; slot i is the member's own
+    slot to substitute. All rows re-evaluate — representatives change
+    between rounds, so cached fitness would be against stale partners
+    (the reference re-evaluates whole species per round,
+    coop_niche.py:80-81)."""
+    values = _as2d(evaluate(i, pop.genomes, tuple(reps)))
+    return pop.with_fitness(values)
+
+
+def coop_step(key: jax.Array, species: Sequence[Population],
+              reps: Sequence, toolboxes, evaluate: Callable,
+              cxpb: float = 0.6, mutpb: float = 1.0,
+              ) -> Tuple[List[Population], List]:
+    """One cooperative generation: every species does select + varAnd +
+    fitness against the *round-start* representative set; the new
+    representatives all swap in together after the full round, matching
+    the reference's two-phase loop (coop_niche.py:71-95 collects
+    ``next_repr`` and assigns ``representatives`` after iterating all
+    species). ``toolboxes`` is one shared toolbox or a per-species
+    list."""
+    species = list(species)
+    next_reps = []
+    for i in range(len(species)):
+        tb = toolboxes[i] if isinstance(toolboxes, (list, tuple)) else toolboxes
+        k_sel, k_var = jax.random.split(jax.random.fold_in(key, i))
+        s = species[i]
+        idx = tb.select(k_sel, s.wvalues, s.size)
+        off = var_and(k_var, gather(s, idx), tb, cxpb, mutpb)
+        off = coop_eval_species(i, off, reps, evaluate)
+        species[i] = off
+        next_reps.append(_rep(off))
+    return species, next_reps
