@@ -14,11 +14,25 @@ from deap_tpu.strategies.cma import (
     StrategyOnePlusLambda,
     hypervolume_contributions_2d,
 )
+from deap_tpu.strategies.bipop import bipop_cmaes
 from deap_tpu.strategies.de import DifferentialEvolution
 from deap_tpu.strategies.eda import EMNA, EMNAState, PBIL, PBILState
+from deap_tpu.strategies.multiswarm import (
+    MultiSwarmPSO,
+    MultiSwarmState,
+    SpeciationPSO,
+    SpeciationState,
+    species_seeds,
+)
 from deap_tpu.strategies.pso import PSO, SwarmState
 
 __all__ = [
+    "bipop_cmaes",
+    "MultiSwarmPSO",
+    "MultiSwarmState",
+    "SpeciationPSO",
+    "SpeciationState",
+    "species_seeds",
     "CMAState",
     "MOState",
     "OnePlusLambdaState",

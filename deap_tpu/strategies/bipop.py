@@ -1,0 +1,180 @@
+"""BIPOP-CMA-ES — bi-population restart regime with stopping criteria.
+
+Counterpart of the reference's BIPOP example
+(/root/reference/examples/es/cma_bipop.py:58-199), promoted to a
+first-class strategy: alternating large-population (IPOP doubling) and
+small-population restart regimes budgeted against each other
+(cma_bipop.py:62-76), each inner CMA-ES run terminated by the standard
+Hansen criteria — MaxIter, TolHistFun, EqualFunVals, TolX, TolUpSigma,
+Stagnation, ConditionCov, NoEffectAxis, NoEffectCoor
+(cma_bipop.py:106-190).
+
+The inner generate→evaluate→update loop is the jit-compiled
+:class:`~deap_tpu.strategies.cma.Strategy`; the restart/stopping logic is
+inherently data-dependent scalar control flow and runs on host, pulling
+a handful of scalars per generation.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.strategies.cma import Strategy
+from deap_tpu.support.logbook import Logbook
+
+
+def bipop_cmaes(key: jax.Array, evaluate: Callable, dim: int,
+                sigma0: float = 2.0, nrestarts: int = 10,
+                centroid_low: float = -4.0, centroid_high: float = 4.0,
+                spec: FitnessSpec = FitnessSpec((-1.0,)),
+                tolhistfun: float = 1e-12, tolx: float = 1e-12,
+                tolupsigma: float = 1e20, conditioncov: float = 1e14,
+                verbose: bool = False,
+                ) -> Tuple[np.ndarray, float, List[Logbook]]:
+    """Run BIPOP-CMA-ES; returns ``(best_x, best_f, logbooks)`` with one
+    logbook per restart (columns gen/evals/restart/regime/min/avg/max,
+    cma_bipop.py:104-106). ``evaluate`` is batched ``[λ, dim] -> [λ]``
+    raw objective values; minimisation by default via ``spec``."""
+    w0 = float(spec.warray[0])
+    lambda0 = 4 + int(3 * math.log(dim))
+    nsmallpopruns = 0
+    smallbudget: List[int] = []
+    largebudget: List[int] = []
+    logbooks: List[Logbook] = []
+    best_x: Optional[np.ndarray] = None
+    best_f = math.inf
+    i = 0
+
+    while i < (nrestarts + nsmallpopruns):
+        key, k_reg, k_c, k_run = jax.random.split(key, 4)
+        u = np.asarray(jax.random.uniform(k_reg, (2,)))
+        # regime choice (cma_bipop.py:64-76): first and last restart are
+        # always regime 1; regime 2 runs while its budget trails
+        if (0 < i < (nrestarts + nsmallpopruns) - 1
+                and sum(smallbudget) < sum(largebudget)):
+            lambda_ = int(lambda0 * (
+                0.5 * (2 ** (i - nsmallpopruns) * lambda0) / lambda0
+            ) ** (float(u[0]) ** 2))
+            sigma = 2 * 10 ** (-2 * float(u[1]))
+            nsmallpopruns += 1
+            regime = 2
+            smallbudget.append(0)
+        else:
+            lambda_ = 2 ** (i - nsmallpopruns) * lambda0
+            sigma = sigma0
+            regime = 1
+            largebudget.append(0)
+        lambda_ = max(lambda_, 2)
+
+        # termination constants (cma_bipop.py:80-93)
+        if regime == 1:
+            maxiter = 100 + 50 * (dim + 3) ** 2 / math.sqrt(lambda_)
+        else:
+            maxiter = 0.5 * largebudget[-1] / lambda_
+        tolhistfun_iter = 10 + int(math.ceil(30.0 * dim / lambda_))
+        equalfunvals_k = int(math.ceil(0.1 + lambda_ / 4.0))
+
+        centroid = jax.random.uniform(k_c, (dim,), minval=centroid_low,
+                                      maxval=centroid_high)
+        strat = Strategy(centroid=np.asarray(centroid), sigma=sigma,
+                         lambda_=lambda_, spec=spec)
+        state = strat.initial_state()
+
+        @jax.jit
+        def gen_step(k, st):
+            genomes = strat.generate(k, st)
+            values = evaluate(genomes)
+            return strat.update(st, genomes, values), genomes, values
+
+        logbook = Logbook()
+        logbooks.append(logbook)
+        conditions: Dict[str, bool] = {}
+        equalfunvalues: List[int] = []
+        bestvalues: List[float] = []
+        medianvalues: List[float] = []
+        mins: deque = deque(maxlen=tolhistfun_iter)
+        t = 0
+
+        while not conditions:
+            k_run, k_gen = jax.random.split(k_run)
+            state, genomes, values = gen_step(k_gen, state)
+            # ascending weighted values: vals[-1] best, vals[-k] k-th best
+            # (the reference's sorted population, cma_bipop.py:133-136)
+            raw_np = np.asarray(values)
+            vals = np.sort(raw_np * w0)
+            raw = np.sort(raw_np)
+            # best-so-far in the *weighted* direction so a maximisation
+            # spec tracks maxima, not minima
+            gen_best_i = int(np.argmax(raw_np * w0))
+            if best_x is None or raw_np[gen_best_i] * w0 > best_f * w0:
+                best_f = float(raw_np[gen_best_i])
+                best_x = np.asarray(genomes)[gen_best_i]
+            logbook.record(gen=t, evals=lambda_, restart=i, regime=regime,
+                           min=float(raw[0]), avg=float(raw.mean()),
+                           max=float(raw[-1]))
+            if verbose:
+                print(logbook.stream)
+
+            # bookkeeping mirrors cma_bipop.py:133-146, in weighted
+            # (maximisation) terms so any spec direction works
+            equalfunvalues.append(
+                int(vals[-1] == vals[-equalfunvals_k]))
+            bestvalues.append(float(vals[-1]))
+            medianvalues.append(float(vals[int(round(len(vals) / 2.0)) - 1]))
+            if regime == 1 and i > 0:
+                largebudget[-1] += lambda_
+            elif regime == 2:
+                smallbudget[-1] += lambda_
+            t += 1
+            stagnation_iter = int(math.ceil(0.2 * t + 120 + 30.0 * dim
+                                            / lambda_))
+            noeffectaxis_index = t % dim
+
+            # stopping criteria (cma_bipop.py:152-190)
+            st = jax.device_get(state)
+            if t >= maxiter:
+                conditions["MaxIter"] = True
+            mins.append(float(vals[-1]))
+            if (len(mins) == mins.maxlen
+                    and max(mins) - min(mins) < tolhistfun):
+                conditions["TolHistFun"] = True
+            if t > dim and sum(equalfunvalues[-dim:]) / float(dim) > 1.0 / 3:
+                conditions["EqualFunVals"] = True
+            if (np.all(st.pc < tolx)
+                    and np.all(np.sqrt(np.diag(st.C)) < tolx)):
+                conditions["TolX"] = True
+            if float(st.sigma) / sigma > float(st.diagD[-1] ** 2) * tolupsigma:
+                conditions["TolUpSigma"] = True
+            # weighted values grow on improvement, so stagnation is the
+            # recent medians NOT exceeding the older window (the
+            # reference's >= on raw minima, flipped into weighted terms)
+            if (len(bestvalues) > stagnation_iter
+                    and np.median(bestvalues[-20:]) <= np.median(
+                        bestvalues[-stagnation_iter:-stagnation_iter + 20])
+                    and np.median(medianvalues[-20:]) <= np.median(
+                        medianvalues[-stagnation_iter:-stagnation_iter + 20])):
+                conditions["Stagnation"] = True
+            if float(st.cond) > conditioncov:
+                conditions["ConditionCov"] = True
+            if np.all(st.centroid == st.centroid
+                      + 0.1 * st.sigma * st.diagD[-noeffectaxis_index]
+                      * st.B[-noeffectaxis_index]):
+                conditions["NoEffectAxis"] = True
+            if np.any(st.centroid == st.centroid
+                      + 0.2 * st.sigma * np.diag(st.C)):
+                conditions["NoEffectCoor"] = True
+
+        if verbose:
+            print("Stopped because of condition%s %s"
+                  % (":" if len(conditions) == 1 else "s:",
+                     ",".join(conditions)))
+        i += 1
+
+    return best_x, best_f, logbooks

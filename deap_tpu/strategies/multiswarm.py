@@ -1,0 +1,363 @@
+"""Multi-swarm and speciation PSO for dynamic optimisation.
+
+Counterparts of the reference's dynamic-landscape PSO examples:
+
+- **MultiSwarmPSO** — Blackwell, Branke & Li 2008 multi-swarm PSO
+  (/root/reference/examples/pso/multiswarm.py): several constricted
+  swarms with anti-convergence (spawn a fresh swarm when all converge,
+  kill the worst when too many roam, multiswarm.py:146-168),
+  change detection by re-evaluating each swarm best
+  (multiswarm.py:171-177), quantum-cloud re-diversification around the
+  best (convertQuantum, multiswarm.py:58-76), and exclusion re-init of
+  the worse of any two swarms closer than ``rexcl``
+  (multiswarm.py:203-215).
+- **SpeciationPSO** — speciation PSO (examples/pso/speciation.py):
+  particles sorted best-first greedily form species around seeds within
+  radius ``rs`` (speciation.py:133-146), species sizes capped at
+  ``pmax`` with overflow re-initialised (speciation.py:160-166), the
+  worst species replaced wholesale (speciation.py:175-177).
+
+The reference grows/shrinks Python lists of swarms; here the swarm axis
+has a static ``capacity`` and an ``active`` mask — add/remove become
+mask flips, so the whole dynamic algorithm is one jit-compiled step.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+CHI = 0.729843788       # Clerc constriction (multiswarm.py:100)
+C = 2.05
+
+
+@struct.dataclass
+class MultiSwarmState:
+    x: jnp.ndarray          # [S, P, D] positions
+    v: jnp.ndarray          # [S, P, D] velocities
+    pbest_x: jnp.ndarray    # [S, P, D]
+    pbest_f: jnp.ndarray    # [S, P] weighted fitness (-inf = no pbest yet)
+    sbest_x: jnp.ndarray    # [S, D]
+    sbest_f: jnp.ndarray    # [S]    (-inf = no swarm best yet)
+    active: jnp.ndarray     # [S] bool
+    nevals: jnp.ndarray     # scalar int32 running evaluation count
+
+
+def _quantum_cloud(key: jax.Array, n: int, dim: int, centre: jnp.ndarray,
+                   rcloud: float, dist: str) -> jnp.ndarray:
+    """Quantum particle cloud around ``centre`` (convertQuantum,
+    multiswarm.py:58-76): direction = normalised gaussian, radius scale
+    by distribution ``gaussian`` | ``uvd`` | ``nuvd``."""
+    k_pos, k_u = jax.random.split(key)
+    pos = jax.random.normal(k_pos, (n, dim))
+    norm = jnp.linalg.norm(pos, axis=-1, keepdims=True)
+    norm = jnp.where(norm == 0, 1.0, norm)
+    if dist == "gaussian":
+        u = jnp.abs(jax.random.normal(k_u, (n, 1)) / 3.0) ** (1.0 / dim)
+    elif dist == "uvd":
+        u = jax.random.uniform(k_u, (n, 1)) ** (1.0 / dim)
+    elif dist == "nuvd":
+        u = jnp.abs(jax.random.normal(k_u, (n, 1)) / 3.0)
+    else:
+        raise ValueError(dist)
+    return rcloud * pos * u / norm + centre
+
+
+class MultiSwarmPSO:
+    """Blackwell-Branke-Li multi-swarm PSO over a dynamic landscape.
+
+    :param evaluate: batched ``x [n, d] -> f [n]`` (maximised). For
+        MovingPeaks pass a closure over the current landscape state and
+        call :meth:`step` between peak changes.
+    """
+
+    def __init__(self, evaluate: Callable, pmin: float, pmax: float,
+                 rcloud: float = 0.5, nexcess: int = 3,
+                 dist: str = "nuvd", chi: float = CHI, c: float = C):
+        self.evaluate = evaluate
+        self.pmin, self.pmax = pmin, pmax
+        self.rcloud = rcloud
+        self.nexcess = nexcess
+        self.dist = dist
+        self.chi, self.c = chi, c
+
+    # ------------------------------------------------------------------ init ----
+
+    def _fresh_swarm(self, key: jax.Array, nparticles: int, dim: int):
+        kx, kv = jax.random.split(key)
+        half = (self.pmax - self.pmin) / 2.0
+        x = jax.random.uniform(kx, (nparticles, dim), minval=self.pmin,
+                               maxval=self.pmax)
+        v = jax.random.uniform(kv, (nparticles, dim), minval=-half,
+                               maxval=half)
+        return x, v
+
+    def init(self, key: jax.Array, nswarms: int, nparticles: int, dim: int,
+             capacity: Optional[int] = None) -> MultiSwarmState:
+        S = capacity if capacity is not None else nswarms * 4
+        keys = jax.random.split(key, S)
+        x, v = jax.vmap(lambda k: self._fresh_swarm(k, nparticles, dim))(keys)
+        neg = jnp.full((S, nparticles), -jnp.inf)
+        return MultiSwarmState(
+            x=x, v=v, pbest_x=x, pbest_f=neg,
+            sbest_x=x[:, 0], sbest_f=jnp.full((S,), -jnp.inf),
+            active=jnp.arange(S) < nswarms,
+            nevals=jnp.int32(0),
+        )
+
+    # ------------------------------------------------------------------ step ----
+
+    def _rexcl(self, s: MultiSwarmState) -> jnp.ndarray:
+        """Exclusion radius (multiswarm.py:146): domain range /
+        (2 · nswarms^(1/D))."""
+        n_act = jnp.maximum(s.active.sum(), 1)
+        dim = s.x.shape[-1]
+        return (self.pmax - self.pmin) / (
+            2.0 * n_act.astype(jnp.float32) ** (1.0 / dim))
+
+    def step(self, key: jax.Array, s: MultiSwarmState) -> MultiSwarmState:
+        S, P, D = s.x.shape
+        k_spawn, k_quant, k_move, k_excl = jax.random.split(key, 4)
+        rexcl = self._rexcl(s)
+
+        # --- anti-convergence (multiswarm.py:148-168) -----------------------
+        diff = s.x[:, :, None, :] - s.x[:, None, :, :]
+        diam = jnp.sqrt((diff ** 2).sum(-1)).max(axis=(1, 2))     # [S]
+        roaming = s.active & (diam > 2.0 * rexcl)
+        n_roaming = roaming.sum()
+        all_converged = n_roaming == 0
+        # spawn: first inactive slot becomes a fresh random swarm
+        can_spawn = ~s.active.all()
+        spawn_slot = jnp.argmax(~s.active)
+        fx, fv = self._fresh_swarm(k_spawn, P, D)
+        do_spawn = all_converged & can_spawn
+        sel_spawn = do_spawn & (jnp.arange(S) == spawn_slot)
+        x = jnp.where(sel_spawn[:, None, None], fx[None], s.x)
+        v = jnp.where(sel_spawn[:, None, None], fv[None], s.v)
+        pbest_x = jnp.where(sel_spawn[:, None, None], fx[None], s.pbest_x)
+        pbest_f = jnp.where(sel_spawn[:, None], -jnp.inf, s.pbest_f)
+        sbest_f = jnp.where(sel_spawn, -jnp.inf, s.sbest_f)
+        active = s.active | sel_spawn
+        # kill: worst roaming swarm by best fitness when too many roam
+        worst = jnp.argmin(jnp.where(roaming, sbest_f, jnp.inf))
+        do_kill = n_roaming > self.nexcess
+        active = active & ~(do_kill & (jnp.arange(S) == worst))
+        s = s.replace(x=x, v=v, pbest_x=pbest_x, pbest_f=pbest_f,
+                      sbest_f=sbest_f, active=active)
+
+        # --- change detection + quantum re-diversification ------------------
+        # re-evaluate each swarm best (multiswarm.py:171-177)
+        has_sbest = s.sbest_f > -jnp.inf
+        refit = self.evaluate(s.sbest_x)                            # [S]
+        changed = s.active & has_sbest & (refit != s.sbest_f)
+        nevals = s.nevals + (s.active & has_sbest).sum()
+        clouds = jax.vmap(
+            lambda k, c: _quantum_cloud(k, P, D, c, self.rcloud, self.dist)
+        )(jax.random.split(k_quant, S), s.sbest_x)
+        x = jnp.where(changed[:, None, None], clouds, s.x)
+        pbest_f = jnp.where(changed[:, None], -jnp.inf, s.pbest_f)
+        sbest_f = jnp.where(changed, -jnp.inf, s.sbest_f)
+        s = s.replace(x=x, pbest_f=pbest_f, sbest_f=sbest_f)
+
+        # --- constricted move (only particles with pbest AND swarm best,
+        # multiswarm.py:181-184) --------------------------------------------
+        has_p = s.pbest_f > -jnp.inf                                # [S, P]
+        has_s = (s.sbest_f > -jnp.inf)[:, None]                     # [S, 1]
+        k1, k2 = jax.random.split(k_move)
+        ce1 = self.c * jax.random.uniform(k1, (S, P, D))
+        ce2 = self.c * jax.random.uniform(k2, (S, P, D))
+        pull = (ce1 * (s.sbest_x[:, None, :] - s.x)
+                + ce2 * (s.pbest_x - s.x))
+        vnew = s.v + self.chi * pull - (1.0 - self.chi) * s.v
+        move = (has_p & has_s[:, :1])[:, :, None] * s.active[:, None, None]
+        v = jnp.where(move, vnew, s.v)
+        x = jnp.where(move, s.x + v, s.x)
+
+        # --- evaluate + update attractors -----------------------------------
+        f = self.evaluate(x.reshape(S * P, D)).reshape(S, P)
+        nevals = nevals + s.active.sum() * P
+        improve_p = f > s.pbest_f
+        pbest_x = jnp.where(improve_p[:, :, None], x, s.pbest_x)
+        pbest_f = jnp.where(improve_p, f, s.pbest_f)
+        ibest = jnp.argmax(pbest_f, axis=1)                        # [S]
+        cand_f = jnp.take_along_axis(pbest_f, ibest[:, None], 1)[:, 0]
+        cand_x = jnp.take_along_axis(pbest_x, ibest[:, None, None], 1)[:, 0]
+        improve_s = cand_f > s.sbest_f
+        sbest_x = jnp.where(improve_s[:, None], cand_x, s.sbest_x)
+        sbest_f = jnp.where(improve_s, cand_f, s.sbest_f)
+        s = s.replace(x=x, v=v, pbest_x=pbest_x, pbest_f=pbest_f,
+                      sbest_x=sbest_x, sbest_f=sbest_f, nevals=nevals)
+
+        # --- exclusion (multiswarm.py:203-215): the worse of any two
+        # close swarms re-initialises --------------------------------------
+        dists = jnp.linalg.norm(
+            s.sbest_x[:, None, :] - s.sbest_x[None, :, :], axis=-1)
+        has = (s.sbest_f > -jnp.inf) & s.active
+        close = (dists < rexcl) & has[:, None] & has[None, :] & (
+            ~jnp.eye(S, dtype=bool))
+        # i re-inits if some close j beats it; on ties the LOWER index
+        # loses, matching the reference's `bestfit[s1] <= bestfit[s2]`
+        # with s1 < s2 (multiswarm.py:209-212)
+        fi = s.sbest_f[:, None]
+        fj = s.sbest_f[None, :]
+        loses = close & ((fi < fj) | ((fi == fj) & (
+            jnp.arange(S)[:, None] < jnp.arange(S)[None, :])))
+        reinit = loses.any(axis=1)
+        rx, rv = jax.vmap(lambda k: self._fresh_swarm(k, P, D))(
+            jax.random.split(k_excl, S))
+        x = jnp.where(reinit[:, None, None], rx, s.x)
+        v = jnp.where(reinit[:, None, None], rv, s.v)
+        pbest_f = jnp.where(reinit[:, None], -jnp.inf, s.pbest_f)
+        sbest_f = jnp.where(reinit, -jnp.inf, s.sbest_f)
+        return s.replace(x=x, v=v, pbest_f=pbest_f, sbest_f=sbest_f)
+
+    def best(self, s: MultiSwarmState) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        i = jnp.argmax(jnp.where(s.active, s.sbest_f, -jnp.inf))
+        return s.sbest_x[i], s.sbest_f[i]
+
+
+# ------------------------------------------------------------- speciation ----
+
+def species_seeds(x: jnp.ndarray, f: jnp.ndarray, rs: float,
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Greedy best-first speciation (speciation.py:133-146): walking
+    particles in fitness order, one becomes a *seed* iff no better seed
+    lies within radius ``rs``; every particle joins the best seed within
+    ``rs`` (itself if it is a seed).
+
+    Returns ``(is_seed bool[n], species int32[n])`` where ``species[i]``
+    is the index of particle i's seed.
+    """
+    n = x.shape[0]
+    order = jnp.argsort(-f)                     # best first
+    xs = x[order]
+    d = jnp.linalg.norm(xs[:, None, :] - xs[None, :, :], axis=-1)
+
+    def step(seed_mask, i):
+        near_better_seed = (d[i] <= rs) & seed_mask & (jnp.arange(n) < i)
+        is_seed = ~near_better_seed.any()
+        return seed_mask.at[i].set(is_seed), is_seed
+
+    seed_sorted, _ = lax.scan(step, jnp.zeros((n,), bool), jnp.arange(n))
+    # species of sorted-particle i = first (best) seed within rs
+    within = (d <= rs) & seed_sorted[None, :]
+    first_seed_sorted = jnp.argmax(within, axis=1)  # seeds exist: i itself
+    # map back to original indices
+    inv = jnp.zeros((n,), jnp.int32).at[order].set(jnp.arange(n, dtype=jnp.int32))
+    is_seed = jnp.zeros((n,), bool).at[order].set(seed_sorted)
+    species = order[first_seed_sorted][inv]
+    return is_seed, species
+
+
+@struct.dataclass
+class SpeciationState:
+    x: jnp.ndarray          # [n, d]
+    v: jnp.ndarray          # [n, d]
+    pbest_x: jnp.ndarray    # [n, d]
+    pbest_f: jnp.ndarray    # [n]
+    nevals: jnp.ndarray
+
+
+class SpeciationPSO:
+    """Speciation PSO on a dynamic landscape (examples/pso/speciation.py):
+    species form around best-first seeds (radius ``rs``), each particle
+    is pulled toward its species seed's best position, species are
+    capped at ``pmax`` members (overflow re-initialised,
+    speciation.py:160-166) and the worst species is replaced by fresh
+    particles every generation (speciation.py:175-177). Change detection
+    re-evaluates seed bests and converts species to quantum clouds
+    (speciation.py:149-157)."""
+
+    def __init__(self, evaluate: Callable, pmin: float, pmax: float,
+                 rs: float, pmax_size: int = 10, rcloud: float = 1.0,
+                 chi: float = CHI, c: float = C):
+        self.evaluate = evaluate
+        self.pmin, self.pmax = pmin, pmax
+        self.rs = rs
+        self.pmax_size = pmax_size
+        self.rcloud = rcloud
+        self.chi, self.c = chi, c
+
+    def init(self, key: jax.Array, n: int, dim: int) -> SpeciationState:
+        kx, kv = jax.random.split(key)
+        half = (self.pmax - self.pmin) / 2.0
+        x = jax.random.uniform(kx, (n, dim), minval=self.pmin,
+                               maxval=self.pmax)
+        v = jax.random.uniform(kv, (n, dim), minval=-half, maxval=half)
+        return SpeciationState(x=x, v=v, pbest_x=x,
+                               pbest_f=jnp.full((n,), -jnp.inf),
+                               nevals=jnp.int32(0))
+
+    def step(self, key: jax.Array, s: SpeciationState) -> SpeciationState:
+        n, d = s.x.shape
+        k_q, k_move, k_over, k_worst = jax.random.split(key, 4)
+
+        # evaluate + personal bests (speciation.py:124-129)
+        f = self.evaluate(s.x)
+        improve = f > s.pbest_f
+        pbest_x = jnp.where(improve[:, None], s.x, s.pbest_x)
+        pbest_f = jnp.where(improve, f, s.pbest_f)
+        nevals = s.nevals + n
+
+        # species structure over personal bests
+        is_seed, species = species_seeds(pbest_x, pbest_f, self.rs)
+        seed_best_x = pbest_x[species]
+
+        # change detection: re-evaluate every seed best
+        seed_fit = self.evaluate(pbest_x)
+        nevals = nevals + is_seed.sum()
+        changed = (is_seed & (seed_fit != pbest_f))[species].any()
+
+        # quantum conversion of all species around their seeds
+        cloud = _quantum_cloud(k_q, n, d, jnp.zeros((d,)), self.rcloud,
+                               "nuvd") + seed_best_x
+        # rank within species: number of same-species particles with
+        # better pbest
+        better = (pbest_f[None, :] > pbest_f[:, None])
+        same = species[None, :] == species[:, None]
+        rank = (better & same).sum(axis=1)
+        overflow = rank >= self.pmax_size
+
+        # worst species = the last seed in fitness order
+        worst_seed = jnp.argmin(jnp.where(is_seed, pbest_f, jnp.inf))
+        in_worst = species == worst_seed
+
+        # constricted move toward the species seed best
+        k1, k2 = jax.random.split(k_move)
+        ce1 = self.c * jax.random.uniform(k1, (n, d))
+        ce2 = self.c * jax.random.uniform(k2, (n, d))
+        pull = ce1 * (seed_best_x - s.x) + ce2 * (pbest_x - s.x)
+        v = s.v + self.chi * pull - (1.0 - self.chi) * s.v
+        moved_x = s.x + v
+
+        half = (self.pmax - self.pmin) / 2.0
+        fresh_x = jax.random.uniform(k_over, (n, d), minval=self.pmin,
+                                     maxval=self.pmax)
+        fresh_v = jax.random.uniform(k_worst, (n, d), minval=-half,
+                                     maxval=half)
+
+        # the worst species is replaced by fresh particles EVERY
+        # generation, change or not (speciation.py:175-177 runs outside
+        # the if/else); the pmax overflow cap only applies on
+        # non-change generations (speciation.py:160-166 is in the else)
+        reinit = overflow | in_worst
+        x_changed = jnp.where(in_worst[:, None], fresh_x, cloud)
+        x_normal = jnp.where(reinit[:, None], fresh_x, moved_x)
+        x = jnp.where(changed, x_changed, x_normal)
+        fresh_mask = jnp.where(changed, in_worst, reinit)
+        v = jnp.where(fresh_mask[:, None], fresh_v, v)
+        # quantum conversion and re-initialisation both reset bests
+        # (speciation.py:155-157: del fitness/bestfit, best = None)
+        reset = changed | reinit
+        pbest_f = jnp.where(reset, -jnp.inf, pbest_f)
+        pbest_x = jnp.where(reset[:, None], x, pbest_x)
+        return s.replace(x=x, v=v, pbest_x=pbest_x, pbest_f=pbest_f,
+                         nevals=nevals)
+
+    def best(self, s: SpeciationState) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        i = jnp.argmax(s.pbest_f)
+        return s.pbest_x[i], s.pbest_f[i]

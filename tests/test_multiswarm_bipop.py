@@ -1,0 +1,133 @@
+"""Multi-swarm PSO, speciation PSO and BIPOP-CMA-ES tests (reference:
+examples/pso/multiswarm.py, examples/pso/speciation.py,
+examples/es/cma_bipop.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deap_tpu import strategies
+from deap_tpu.benchmarks import movingpeaks as mp
+
+
+def two_peaks(x):
+    """Static two-peak landscape: maxima 10 at -3·1 and 8 at +3·1."""
+    d1 = jnp.linalg.norm(x - (-3.0), axis=-1)
+    d2 = jnp.linalg.norm(x - 3.0, axis=-1)
+    return jnp.maximum(10.0 - d1, 8.0 - d2)
+
+
+def test_multiswarm_finds_peak_static():
+    ms = strategies.MultiSwarmPSO(two_peaks, pmin=-6.0, pmax=6.0,
+                                  rcloud=0.5)
+    s = ms.init(jax.random.key(0), nswarms=3, nparticles=8, dim=2,
+                capacity=8)
+    step = jax.jit(ms.step)
+    for g in range(40):
+        s = step(jax.random.key(g), s)
+    _, f = ms.best(s)
+    assert float(f) > 9.0
+    assert int(s.nevals) > 0
+
+
+def test_multiswarm_anti_convergence_spawns():
+    """Once all swarms converge, a new swarm must activate (the
+    anti-convergence rule, multiswarm.py:163-165)."""
+    ms = strategies.MultiSwarmPSO(two_peaks, pmin=-6.0, pmax=6.0)
+    s = ms.init(jax.random.key(1), nswarms=1, nparticles=4, dim=2,
+                capacity=4)
+    # collapse the single swarm onto one point → diameter 0 → converged
+    s = s.replace(x=jnp.zeros_like(s.x))
+    s2 = ms.step(jax.random.key(2), s)
+    assert int(s2.active.sum()) == 2
+
+
+def test_multiswarm_exclusion_reinits_worse():
+    """Two swarms whose bests are within rexcl: the worse one loses its
+    best (multiswarm.py:203-215)."""
+    ms = strategies.MultiSwarmPSO(two_peaks, pmin=-6.0, pmax=6.0)
+    s = ms.init(jax.random.key(3), nswarms=2, nparticles=4, dim=2,
+                capacity=4)
+    # both swarms sit on the same good peak, swarm 0 slightly better
+    near = jnp.full_like(s.x[0], -3.0)
+    x = s.x.at[0].set(near).at[1].set(near + 0.01)
+    s = s.replace(x=x)
+    s = ms.step(jax.random.key(4), s)          # establish bests
+    s2 = ms.step(jax.random.key(5), s)         # exclusion trips
+    f = np.asarray(s2.sbest_f[:2])
+    assert np.isinf(f).any() and not np.isinf(f).all()
+
+
+def test_multiswarm_on_movingpeaks_change_recovery():
+    """After the landscape moves, change detection must convert the
+    converged swarm to a quantum cloud (bests reset) instead of staying
+    stuck on the stale optimum."""
+    cfg = mp.MovingPeaksConfig(dim=2, **{
+        k: v for k, v in mp.SCENARIO_1.items()
+        if k not in ("pfunc", "bfunc")})
+    state = mp.mp_init(jax.random.key(10), cfg)
+
+    def make_eval(st):
+        return lambda x: mp.mp_evaluate(cfg, st, x)[1][:, 0]
+
+    ms = strategies.MultiSwarmPSO(make_eval(state), pmin=cfg.min_coord,
+                                  pmax=cfg.max_coord, rcloud=0.5)
+    s = ms.init(jax.random.key(11), nswarms=3, nparticles=6, dim=2,
+                capacity=8)
+    for g in range(15):
+        s = ms.step(jax.random.key(20 + g), s)
+    before = float(ms.best(s)[1])
+    assert np.isfinite(before)
+    # move the peaks, swap the closure, step again
+    state2 = mp.change_peaks(cfg, state)
+    ms.evaluate = make_eval(state2)
+    s = ms.step(jax.random.key(40), s)
+    s = ms.step(jax.random.key(41), s)
+    assert np.isfinite(float(ms.best(s)[1]))
+
+
+def test_species_seeds_structure():
+    """Two tight clusters → exactly two seeds; every particle joins the
+    seed of its own cluster (speciation.py:133-146)."""
+    kx = jax.random.key(6)
+    a = jax.random.normal(kx, (10, 2)) * 0.1 + jnp.asarray([3.0, 3.0])
+    b = jax.random.normal(jax.random.key(7), (10, 2)) * 0.1 - 3.0
+    x = jnp.concatenate([a, b])
+    f = jnp.arange(20, dtype=jnp.float32)
+    is_seed, species = strategies.species_seeds(x, f, rs=1.0)
+    assert int(is_seed.sum()) == 2
+    sp = np.asarray(species)
+    assert len(set(sp[:10])) == 1 and len(set(sp[10:])) == 1
+    assert sp[0] != sp[10]
+    # each seed is its own species
+    for i in np.flatnonzero(np.asarray(is_seed)):
+        assert sp[i] == i
+
+
+def test_speciation_pso_tracks_both_peaks():
+    sp = strategies.SpeciationPSO(two_peaks, pmin=-6.0, pmax=6.0, rs=3.0,
+                                  pmax_size=10)
+    s = sp.init(jax.random.key(8), n=60, dim=2)
+    step = jax.jit(sp.step)
+    for g in range(30):
+        s = step(jax.random.key(100 + g), s)
+    # global best near 10; and some particle near the second peak too
+    assert float(s.pbest_f.max()) > 9.0
+    d2 = np.linalg.norm(np.asarray(s.pbest_x) - 3.0, axis=-1)
+    assert d2.min() < 1.5
+
+
+def test_bipop_cmaes_sphere():
+    """BIPOP on sphere n=5 must reach < 1e-8 within few restarts (the
+    CMA quality gate of test_algorithms.py:53-66 under the restart
+    harness) and must exercise both regimes' bookkeeping."""
+    def sphere(x):
+        return jnp.sum(x ** 2, axis=-1)
+
+    best_x, best_f, logbooks = strategies.bipop_cmaes(
+        jax.random.key(12), sphere, dim=5, sigma0=2.0, nrestarts=2)
+    assert best_f < 1e-8
+    assert len(logbooks) >= 2
+    cols = logbooks[0][0]
+    assert {"gen", "evals", "restart", "regime", "min"} <= set(cols)
