@@ -57,6 +57,7 @@ from deap_tpu.gp.semantic import (
     make_mut_semantic,
 )
 from deap_tpu.gp.harm import harm
+from deap_tpu.gp import ant
 
 __all__ = [
     "PrimitiveSetTyped",

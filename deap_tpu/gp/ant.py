@@ -1,0 +1,216 @@
+"""The Koza artificial ant — batched toroidal-grid rollouts.
+
+Counterpart of the reference's ant example (/root/reference/examples/gp/
+ant.py:75-150 pure-Python ``AntSimulator``, and the C++ fast path
+``AntSimulatorFast.cpp`` whose native equivalent lives in
+``deap_tpu/native/src/ant.cpp``): a GP *action* tree over
+``if_food_ahead``/``prog2``/``prog3`` with ``move_forward``/
+``turn_left``/``turn_right`` terminals is executed repeatedly on a
+toroidal grid until ``max_moves`` (543) moves are spent; fitness is the
+food eaten (89 pieces on the Santa Fe trail, ant.py:26-46).
+
+Unlike the data-flow stack interpreter (interpreter.py), an action tree
+is executed for its *side effects*: the rollout walks the prefix array
+with an explicit program-counter stack inside ``lax.while_loop`` —
+``prog`` nodes push all children, ``if_food_ahead`` pushes only the
+branch selected by the food sensor, terminals mutate the ant state.
+``vmap`` over the population turns the whole evaluation into one XLA
+program (the idiomatic TPU path; the C++ simulator serves the
+host/native pattern the reference demonstrates with
+AntSimulatorFast.cpp).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deap_tpu.gp.pset import PrimitiveSet
+from deap_tpu.gp.tree import subtree_end
+
+# The Santa Fe trail (Koza 1992): 32×32 torus, 89 food cells, start at
+# the S corner facing east. Data layout matches the reference fixture
+# (examples/gp/ant/santafe_trail.txt; its row-25 stray space is read as
+# an empty cell, where the reference's parser silently drops the column).
+SANTA_FE_TRAIL = """\
+S###............................
+...#............................
+...#.....................###....
+...#....................#....#..
+...#....................#....#..
+...####.#####........##.........
+............#................#..
+............#.......#...........
+............#.......#........#..
+............#.......#...........
+....................#...........
+............#................#..
+............#...................
+............#.......#.....###...
+............#.......#..#........
+.................#..............
+................................
+............#...........#.......
+............#...#..........#....
+............#...#...............
+............#...#...............
+............#...#.........#.....
+............#..........#........
+............#...................
+...##..#####....#...............
+.#..............#...............
+.#..............#...............
+.#......#######.................
+.#.....#........................
+.......#........................
+..####..........................
+................................"""
+
+# op ids by registration order in ant_pset()
+IF_FOOD_AHEAD, PROG2, PROG3 = 0, 1, 2
+MOVE_FORWARD, TURN_LEFT, TURN_RIGHT = 0, 1, 2   # terminal action codes
+
+# direction vectors indexed north/east/south/west (ant.py:76-78)
+_DIR_ROW = jnp.asarray([1, 0, -1, 0], jnp.int32)
+_DIR_COL = jnp.asarray([0, 1, 0, -1], jnp.int32)
+
+
+def ant_pset() -> PrimitiveSet:
+    """The ant vocabulary (ant.py:150-160): if_food_ahead(2), prog2(2),
+    prog3(3); terminals move_forward / turn_left / turn_right. The
+    primitive fns are placeholders — ant trees are executed by
+    :func:`make_ant_evaluator`, never by the data-flow interpreter."""
+    ps = PrimitiveSet("ANT", 0)
+    dummy2 = lambda a, b: a
+    dummy3 = lambda a, b, c: a
+    ps.add_primitive(dummy2, 2, "if_food_ahead")
+    ps.add_primitive(dummy2, 2, "prog2")
+    ps.add_primitive(dummy3, 3, "prog3")
+    ps.add_terminal(float(MOVE_FORWARD), "move_forward")
+    ps.add_terminal(float(TURN_LEFT), "turn_left")
+    ps.add_terminal(float(TURN_RIGHT), "turn_right")
+    return ps
+
+
+def parse_trail(text: str = SANTA_FE_TRAIL,
+                ) -> Tuple[np.ndarray, Tuple[int, int]]:
+    """Trail text → (bool food grid [R, C], start (row, col)). ``#`` is
+    food, ``S`` the start cell (empty), anything else empty
+    (ant.py:128-146)."""
+    lines = text.splitlines()
+    rows, cols = len(lines), max(len(l) for l in lines)
+    grid = np.zeros((rows, cols), bool)
+    start = (0, 0)
+    for i, line in enumerate(lines):
+        for j, ch in enumerate(line):
+            if ch == "#":
+                grid[i, j] = True
+            elif ch == "S":
+                start = (i, j)
+    return grid, start
+
+
+def make_ant_evaluator(pset: PrimitiveSet, max_len: int,
+                       trail: np.ndarray, start: Tuple[int, int],
+                       max_moves: int = 600,
+                       start_dir: int = 1) -> Callable:
+    """Build ``evaluate(genome) -> eaten`` (vmap over genomes for the
+    population). Semantics follow AntSimulator: actions only spend a
+    move while ``moves < max_moves`` (ant.py:97-113); eaten cells are
+    cleared; the routine restarts from the root whenever it completes
+    (run(), ant.py:123-126)."""
+    arity = np.asarray(pset.arity_table())
+    n_ops = pset.n_ops
+    const_id = pset.const_id
+    arity_j = jnp.asarray(arity)
+    trail_j = jnp.asarray(trail)
+    R, C = trail.shape
+    r0, c0 = start
+    # safety bound on executed nodes: each routine pass executes >= 1
+    # action and costs <= max_len pops
+    max_steps = max_moves * max_len + max_len
+
+    def evaluate(genome) -> jnp.ndarray:
+        nodes = genome["nodes"]
+        L = nodes.shape[0]
+        # precompute every subtree end once — the while_loop body would
+        # otherwise redo the O(L) arity walk on loop-invariant data at
+        # every executed node
+        ends = jax.vmap(lambda i: subtree_end(nodes, arity_j, i))(
+            jnp.arange(L))
+
+        def ahead(row, col, d):
+            return ((row + _DIR_ROW[d]) % R, (col + _DIR_COL[d]) % C)
+
+        def body(state):
+            stack, sp, row, col, d, moves, eaten, grid, steps = state
+            # empty stack → restart the routine from the root
+            restart = sp == 0
+            stack = jnp.where(restart, stack.at[0].set(0), stack)
+            sp = jnp.where(restart, 1, sp)
+
+            node_idx = stack[sp - 1]
+            node = nodes[node_idx]
+            sp = sp - 1
+            is_op = node < n_ops
+            action = jnp.where(is_op, -1, node - const_id)
+
+            # --- operators: push children (reverse order → leftmost on
+            # top). child k+1 starts where child k's subtree closes
+            # (precomputed searchSubtree arity walk).
+            c1 = node_idx + 1
+            c2 = ends[jnp.minimum(c1, L - 1)]
+            c3 = ends[jnp.minimum(c2, L - 1)]
+
+            # if_food_ahead: sense and choose branch (ant.py:115-121)
+            ar_, ac = ahead(row, col, d)
+            food_ahead = grid[ar_, ac]
+            chosen = jnp.where(food_ahead, c1, c2)
+
+            push_if = is_op & (node == IF_FOOD_AHEAD)
+            push2 = is_op & (node == PROG2)
+            push3 = is_op & (node == PROG3)
+
+            # prog3: push c3, c2, c1; prog2: push c2, c1; if: push chosen
+            stack = jnp.where(push3, stack.at[sp].set(c3), stack)
+            sp3 = sp + push3.astype(jnp.int32)
+            stack = jnp.where(push2 | push3, stack.at[sp3].set(c2), stack)
+            sp2 = sp3 + (push2 | push3).astype(jnp.int32)
+            stack = jnp.where(push2 | push3, stack.at[sp2].set(c1),
+                              jnp.where(push_if, stack.at[sp2].set(chosen),
+                                        stack))
+            sp = sp2 + (push2 | push3 | push_if).astype(jnp.int32)
+
+            # --- terminal actions (ant.py:97-113): spend a move only
+            # while budget remains
+            can = (~is_op) & (moves < max_moves)
+            moves = jnp.where(can, moves + 1, moves)
+            d = jnp.where(can & (action == TURN_LEFT), (d - 1) % 4,
+                          jnp.where(can & (action == TURN_RIGHT),
+                                    (d + 1) % 4, d))
+            fwd = can & (action == MOVE_FORWARD)
+            nr = (row + _DIR_ROW[d]) % R
+            nc = (col + _DIR_COL[d]) % C
+            row = jnp.where(fwd, nr, row)
+            col = jnp.where(fwd, nc, col)
+            ate = fwd & grid[row, col]
+            eaten = eaten + ate.astype(jnp.int32)
+            grid = jnp.where(ate, grid.at[row, col].set(False), grid)
+
+            return (stack, sp, row, col, d, moves, eaten, grid, steps + 1)
+
+        def cond(state):
+            _, _, _, _, _, moves, _, _, steps = state
+            return (moves < max_moves) & (steps < max_steps)
+
+        init = (jnp.zeros((L + 3,), jnp.int32), jnp.int32(0),
+                jnp.int32(r0), jnp.int32(c0), jnp.int32(start_dir),
+                jnp.int32(0), jnp.int32(0), trail_j, jnp.int32(0))
+        out = lax.while_loop(cond, body, init)
+        return out[6].astype(jnp.float32)
+
+    return evaluate

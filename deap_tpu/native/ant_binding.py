@@ -1,0 +1,62 @@
+"""ctypes binding for the native ant simulator.
+
+The reference accelerates the artificial-ant fitness with a hand-written
+CPython extension (/root/reference/examples/gp/ant/AntSimulatorFast.cpp,
+built by buildAntSimFast.py); here the C++ simulator exports a plain C
+ABI over the framework's prefix-tree arrays and this module loads it
+with ctypes. Importing raises if the library is missing and cannot be
+built (callers fall back to the vmap'd JAX rollout in
+:mod:`deap_tpu.gp.ant`).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+
+import numpy as np
+
+_LIB_PATH = pathlib.Path(__file__).resolve().parent / "_libant.so"
+_SRC_PATH = pathlib.Path(__file__).resolve().parent / "src" / "ant.cpp"
+
+if not _LIB_PATH.exists() or (
+    _SRC_PATH.exists() and _SRC_PATH.stat().st_mtime > _LIB_PATH.stat().st_mtime
+):
+    from deap_tpu.native.build import build
+
+    build(verbose=False, target="ant.cpp")
+
+_lib = ctypes.CDLL(str(_LIB_PATH))
+
+_i32p = ctypes.POINTER(ctypes.c_int32)
+_lib.deap_tpu_ant_eval.restype = None
+_lib.deap_tpu_ant_eval.argtypes = [
+    _i32p, _i32p, ctypes.c_int, ctypes.c_int,
+    ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+    ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, _i32p]
+
+
+def ant_eval(nodes, lengths, trail, start, max_moves: int = 600,
+             start_dir: int = 1) -> np.ndarray:
+    """Evaluate a population of ant trees natively.
+
+    :param nodes: int32 [pop, max_len] prefix node arrays
+        (deap_tpu.gp.ant.ant_pset encoding).
+    :param lengths: int32 [pop].
+    :param trail: bool [rows, cols] food map.
+    :param start: (row, col) start cell.
+    :returns: int32 [pop] food eaten.
+    """
+    nodes = np.ascontiguousarray(nodes, np.int32)
+    lengths = np.ascontiguousarray(lengths, np.int32)
+    trail8 = np.ascontiguousarray(trail, np.uint8)
+    pop, max_len = nodes.shape
+    out = np.zeros((pop,), np.int32)
+    _lib.deap_tpu_ant_eval(
+        nodes.ctypes.data_as(_i32p), lengths.ctypes.data_as(_i32p),
+        pop, max_len,
+        trail8.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        trail8.shape[0], trail8.shape[1], max_moves,
+        int(start[0]), int(start[1]), start_dir,
+        out.ctypes.data_as(_i32p))
+    return out

@@ -16,15 +16,31 @@ import subprocess
 HERE = pathlib.Path(__file__).resolve().parent
 SRC = HERE / "src" / "hv.cpp"
 LIB = HERE / "_libhv.so"
+TARGETS = {
+    "hv.cpp": "_libhv.so",        # hypervolume (reference _hv.c/hv.cpp)
+    "ant.cpp": "_libant.so",      # ant simulator (AntSimulatorFast.cpp)
+}
 
 
-def build(verbose: bool = True) -> pathlib.Path:
+def _compile(src: pathlib.Path, lib: pathlib.Path, verbose: bool) -> None:
     cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
-           str(SRC), "-o", str(LIB)]
+           str(src), "-o", str(lib)]
     if verbose:
         print(" ".join(cmd))
     subprocess.run(cmd, check=True)
-    return LIB
+
+
+def build(verbose: bool = True, target: str | None = None) -> pathlib.Path:
+    """Compile the native sources. ``target`` names one source file
+    (e.g. ``"hv.cpp"``) so each binding's staleness auto-rebuild stays
+    independent of the other sources' health; default builds all."""
+    items = ([(target, TARGETS[target])] if target is not None
+             else list(TARGETS.items()))
+    for src_name, lib_name in items:
+        src = HERE / "src" / src_name
+        if src.exists():
+            _compile(src, HERE / lib_name, verbose)
+    return HERE / TARGETS.get(target, "_libhv.so") if target else LIB
 
 
 if __name__ == "__main__":

@@ -27,7 +27,7 @@ if not _LIB_PATH.exists() or (
     # build with graceful failure (reference setup.py:93-108).
     from deap_tpu.native.build import build
 
-    build(verbose=False)
+    build(verbose=False, target="hv.cpp")
 
 _lib = ctypes.CDLL(str(_LIB_PATH))
 

@@ -199,14 +199,21 @@ class MultiSwarmPSO:
         has = (s.sbest_f > -jnp.inf) & s.active
         close = (dists < rexcl) & has[:, None] & has[None, :] & (
             ~jnp.eye(S, dtype=bool))
-        # i re-inits if some close j beats it; on ties the LOWER index
-        # loses, matching the reference's `bestfit[s1] <= bestfit[s2]`
-        # with s1 < s2 (multiswarm.py:209-212)
+        # i re-inits iff some close *surviving* j beats it — the fixpoint
+        # of the reference's pair sweep with its "not already set to
+        # reinitialize" skip (multiswarm.py:205-212); on ties the LOWER
+        # index loses (`bestfit[s1] <= bestfit[s2]` with s1 < s2). The
+        # beats relation is a strict order, so S rounds reach the
+        # fixpoint.
         fi = s.sbest_f[:, None]
         fj = s.sbest_f[None, :]
-        loses = close & ((fi < fj) | ((fi == fj) & (
+        beaten_by = close & ((fi < fj) | ((fi == fj) & (
             jnp.arange(S)[:, None] < jnp.arange(S)[None, :])))
-        reinit = loses.any(axis=1)
+
+        def settle(_, loses):
+            return (beaten_by & ~loses[None, :]).any(axis=1)
+
+        reinit = lax.fori_loop(0, S, settle, jnp.zeros((S,), bool))
         rx, rv = jax.vmap(lambda k: self._fresh_swarm(k, P, D))(
             jax.random.split(k_excl, S))
         x = jnp.where(reinit[:, None, None], rx, s.x)
@@ -307,17 +314,23 @@ class SpeciationPSO:
         is_seed, species = species_seeds(pbest_x, pbest_f, self.rs)
         seed_best_x = pbest_x[species]
 
-        # change detection: re-evaluate every seed best
+        # change detection: re-evaluate seed bests. Static shapes force
+        # a full-batch evaluate (the reference evaluates just the seeds,
+        # speciation.py:149-150); nevals counts the real cost.
         seed_fit = self.evaluate(pbest_x)
-        nevals = nevals + is_seed.sum()
+        nevals = nevals + n
         changed = (is_seed & (seed_fit != pbest_f))[species].any()
 
         # quantum conversion of all species around their seeds
         cloud = _quantum_cloud(k_q, n, d, jnp.zeros((d,)), self.rcloud,
                                "nuvd") + seed_best_x
-        # rank within species: number of same-species particles with
-        # better pbest
-        better = (pbest_f[None, :] > pbest_f[:, None])
+        # rank within species: strict total order (fitness, then index)
+        # so ties still count toward the cap — the reference caps by
+        # list position, which is likewise tie-insensitive
+        # (speciation.py:160-166)
+        idx = jnp.arange(n)
+        better = (pbest_f[None, :] > pbest_f[:, None]) | (
+            (pbest_f[None, :] == pbest_f[:, None]) & (idx[None, :] < idx[:, None]))
         same = species[None, :] == species[:, None]
         rank = (better & same).sum(axis=1)
         overflow = rank >= self.pmax_size
