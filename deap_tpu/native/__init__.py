@@ -10,6 +10,7 @@ import warnings
 
 try:
     from deap_tpu.native.hv_binding import hypervolume as _hv_native
+    from deap_tpu.native.hv_binding import hv_contributions
     HAVE_NATIVE_HV = True
 
     def hypervolume(points, ref):
@@ -22,4 +23,14 @@ except Exception:  # pragma: no cover - exercised when the ext is absent
         "`python -m deap_tpu.native.build`.")
     from deap_tpu.native.pyhv import hypervolume
 
-__all__ = ["hypervolume", "HAVE_NATIVE_HV"]
+    def hv_contributions(points, ref):
+        """Leave-one-out contributions via the pure-Python hv."""
+        import numpy as np
+
+        pts = np.asarray(points, dtype=np.float64)
+        total = hypervolume(pts, ref)
+        return np.asarray([
+            total - hypervolume(np.delete(pts, i, axis=0), ref)
+            for i in range(pts.shape[0])])
+
+__all__ = ["hypervolume", "hv_contributions", "HAVE_NATIVE_HV"]

@@ -38,9 +38,11 @@ def build(verbose: bool = True, target: str | None = None) -> pathlib.Path:
              else list(TARGETS.items()))
     for src_name, lib_name in items:
         src = HERE / "src" / src_name
-        if src.exists():
-            _compile(src, HERE / lib_name, verbose)
-    return HERE / TARGETS.get(target, "_libhv.so") if target else LIB
+        if not src.exists():
+            raise FileNotFoundError(
+                f"native source {src} is missing; cannot build {lib_name}")
+        _compile(src, HERE / lib_name, verbose)
+    return HERE / TARGETS[target] if target else LIB
 
 
 if __name__ == "__main__":

@@ -199,21 +199,21 @@ class MultiSwarmPSO:
         has = (s.sbest_f > -jnp.inf) & s.active
         close = (dists < rexcl) & has[:, None] & has[None, :] & (
             ~jnp.eye(S, dtype=bool))
-        # i re-inits iff some close *surviving* j beats it — the fixpoint
-        # of the reference's pair sweep with its "not already set to
-        # reinitialize" skip (multiswarm.py:205-212); on ties the LOWER
-        # index loses (`bestfit[s1] <= bestfit[s2]` with s1 < s2). The
-        # beats relation is a strict order, so S rounds reach the
-        # fixpoint.
-        fi = s.sbest_f[:, None]
-        fj = s.sbest_f[None, :]
-        beaten_by = close & ((fi < fj) | ((fi == fj) & (
-            jnp.arange(S)[:, None] < jnp.arange(S)[None, :])))
+        # exact reference semantics (multiswarm.py:203-215): sweep pairs
+        # (s1 < s2) in index order, skip pairs with an already-marked
+        # member, mark s1 when bestfit[s1] <= bestfit[s2] else s2. The
+        # sweep is sequential by construction — a fori_loop over the
+        # S(S-1)/2 pairs (S is small, the body is scalar).
+        def pair_step(t, marked):
+            s1 = t // S
+            s2 = t % S
+            eligible = ((s2 > s1) & close[s1, s2]
+                        & ~marked[s1] & ~marked[s2])
+            worse = jnp.where(s.sbest_f[s1] <= s.sbest_f[s2], s1, s2)
+            return marked.at[worse].set(marked[worse] | eligible)
 
-        def settle(_, loses):
-            return (beaten_by & ~loses[None, :]).any(axis=1)
-
-        reinit = lax.fori_loop(0, S, settle, jnp.zeros((S,), bool))
+        reinit = lax.fori_loop(0, S * S, pair_step,
+                               jnp.zeros((S,), bool))
         rx, rv = jax.vmap(lambda k: self._fresh_swarm(k, P, D))(
             jax.random.split(k_excl, S))
         x = jnp.where(reinit[:, None, None], rx, s.x)

@@ -1,0 +1,96 @@
+"""Hillis-style host-parasite coevolution of sorting networks.
+
+Counterpart of /root/reference/examples/coev/hillis.py: hosts are
+sorting networks (minimising misses), parasites are sets of hard test
+sequences (maximising the misses they induce); both populations evolve
+against each other on index-paired encounters with shared outcome
+values (hillis.py:131-134).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import coev, ops
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import init_population
+from deap_tpu.core.toolbox import Toolbox
+
+from examples.ga.sortingnetwork import apply_network
+
+DIM = 6
+MAX_PAIRS = 20
+N_TESTS = 8
+
+
+def main(smoke: bool = False):
+    n = 100 if not smoke else 40
+    ngen = 30 if not smoke else 8
+
+    def init_host(key):
+        k1, k2 = jax.random.split(key)
+        a = jax.random.randint(k1, (MAX_PAIRS,), 0, DIM)
+        off = jax.random.randint(k2, (MAX_PAIRS,), 1, DIM)
+        b = (a + off) % DIM
+        return jnp.stack([jnp.minimum(a, b), jnp.maximum(a, b)], axis=-1)
+
+    def init_parasite(key):
+        return jax.random.bernoulli(key, 0.5, (N_TESTS, DIM)).astype(
+            jnp.int32)
+
+    def eval_pair(host, parasite):
+        """misses of the host network on the parasite's test set."""
+        out = apply_network(host, jnp.int32(MAX_PAIRS), parasite)
+        ref = jnp.sort(parasite, axis=1)
+        return (out != ref).any(axis=1).sum().astype(jnp.float32)
+
+    def mate_host(key, a, b):
+        cut = jax.random.randint(key, (), 1, MAX_PAIRS)
+        sel = (jnp.arange(MAX_PAIRS) < cut)[:, None]
+        return jnp.where(sel, a, b), jnp.where(sel, b, a)
+
+    def mut_host(key, a):
+        k1, k2, k3 = jax.random.split(key, 3)
+        i = jax.random.randint(k1, (), 0, MAX_PAIRS)
+        x = jax.random.randint(k2, (), 0, DIM)
+        off = jax.random.randint(k3, (), 1, DIM)
+        y = (x + off) % DIM
+        return a.at[i].set(jnp.stack([jnp.minimum(x, y),
+                                      jnp.maximum(x, y)]))
+
+    def mate_parasite(key, a, b):
+        sel = jax.random.bernoulli(key, 0.5, (N_TESTS, 1))
+        return jnp.where(sel, a, b), jnp.where(sel, b, a)
+
+    def mut_parasite(key, a):
+        flip = jax.random.bernoulli(key, 0.05, a.shape)
+        return jnp.where(flip, 1 - a, a)
+
+    htb = Toolbox()
+    htb.register("mate", mate_host)
+    htb.register("mutate", mut_host)
+    htb.register("select", ops.sel_tournament, tournsize=3)
+    ptb = Toolbox()
+    ptb.register("mate", mate_parasite)
+    ptb.register("mutate", mut_parasite)
+    ptb.register("select", ops.sel_tournament, tournsize=3)
+
+    hosts = init_population(jax.random.key(74), n, init_host,
+                            FitnessSpec((-1.0,)))
+    parasites = init_population(jax.random.key(75), n, init_parasite,
+                                FitnessSpec((1.0,)))
+    hosts, parasites = coev.competitive_eval(hosts, parasites, eval_pair)
+
+    step = jax.jit(lambda k, h, p: coev.competitive_step(
+        k, h, p, htb, ptb, eval_pair, 0.5, 0.3, 0.5, 0.3))
+    key = jax.random.key(76)
+    for g in range(ngen):
+        key, kg = jax.random.split(key)
+        hosts, parasites = step(kg, hosts, parasites)
+
+    best_misses = float(-hosts.wvalues.max())
+    print(f"Best host misses on its parasite suite: {best_misses}")
+    return best_misses
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""Differential evolution, basic rand/1/bin scheme.
+
+Counterpart of /root/reference/examples/de/basic.py: ``y = a + F(b - c)``
+with three distinct random donors per target (the reference draws them
+with ``selRandom(k=3)``, basic.py:36) and binomial crossover, on
+Griewank.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import benchmarks, strategies
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import init_population
+from deap_tpu.ops import uniform_genome
+
+
+def main(smoke: bool = False):
+    n, ndim = 300, 10
+    ngen = 200 if not smoke else 25
+
+    de = strategies.DifferentialEvolution(
+        evaluate=lambda g: jax.vmap(benchmarks.griewank)(g)[:, 0],
+        F=0.25, CR=0.25, spec=FitnessSpec((-1.0,)))
+    pop = init_population(jax.random.key(57), n,
+                          uniform_genome(ndim, -100.0, 100.0),
+                          FitnessSpec((-1.0,)))
+    pop, hist = de.run(jax.random.key(58), pop, ngen)
+    best = float(-pop.wvalues.max())
+    print(f"Best griewank value: {best:.6f}")
+    return best
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""Differential evolution on the sphere function.
+
+Counterpart of /root/reference/examples/de/sphere.py (a DE variant with
+per-generation best tracking on sphere).
+"""
+
+import jax
+
+from deap_tpu import benchmarks, strategies
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import init_population
+from deap_tpu.ops import uniform_genome
+
+
+def main(smoke: bool = False):
+    n, ndim = 300, 20
+    ngen = 200 if not smoke else 25
+
+    de = strategies.DifferentialEvolution(
+        evaluate=lambda g: jax.vmap(benchmarks.sphere)(g)[:, 0],
+        F=0.5, CR=0.9, spec=FitnessSpec((-1.0,)))
+    pop = init_population(jax.random.key(59), n,
+                          uniform_genome(ndim, -5.0, 5.0),
+                          FitnessSpec((-1.0,)))
+    pop, hist = de.run(jax.random.key(60), pop, ngen)
+    best = float(-pop.wvalues.max())
+    print(f"Best sphere value: {best:.3e}")
+    return best
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+"""Estimation of multivariate normal algorithm (EMNA_global).
+
+Counterpart of /root/reference/examples/eda/emna.py: sample a Gaussian,
+keep the best half, refit mean/covariance — the ask-tell protocol on a
+continuous sphere problem.
+"""
+
+import jax
+
+from deap_tpu import algorithms, benchmarks, strategies
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.toolbox import Toolbox
+
+N = 5
+
+
+def main(smoke: bool = False):
+    ngen = 150 if not smoke else 25
+    emna = strategies.EMNA(centroid=[5.0] * N, sigma=1.0, mu=30,
+                           lambda_=100)
+    toolbox = Toolbox()
+    toolbox.register("generate", emna.generate)
+    toolbox.register("update", emna.update)
+    toolbox.register("evaluate",
+                     lambda g: jax.vmap(benchmarks.sphere)(g)[:, 0])
+
+    state, logbook, _ = algorithms.ea_generate_update(
+        jax.random.key(65), emna.initial_state(), toolbox, ngen,
+        spec=FitnessSpec((-1.0,)))
+    best = float(benchmarks.sphere(state.centroid)[0])
+    print(f"Centroid sphere value: {best:.3e}")
+    return best
+
+
+if __name__ == "__main__":
+    main()

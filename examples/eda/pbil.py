@@ -1,0 +1,38 @@
+"""Population-based incremental learning on OneMax.
+
+Counterpart of /root/reference/examples/eda/pbil.py: a probability
+vector generates bitstring samples and learns toward the best
+(eaGenerateUpdate protocol, pbil.py:71-81).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import algorithms, strategies
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.toolbox import Toolbox
+
+
+def main(smoke: bool = False):
+    length = 50
+    ngen = 100 if not smoke else 20
+
+    pbil = strategies.PBIL(ndim=length, lambda_=20, learning_rate=0.3,
+                           mut_prob=0.1, mut_shift=0.05)
+    toolbox = Toolbox()
+    toolbox.register("generate", pbil.generate)
+    toolbox.register("update", pbil.update)
+    toolbox.register("evaluate",
+                     lambda g: g.sum(-1).astype(jnp.float32))
+
+    state, logbook, _ = algorithms.ea_generate_update(
+        jax.random.key(64), pbil.initial_state(), toolbox, ngen,
+        spec=FitnessSpec((1.0,)))
+    # a converged probability vector saturates toward 1.0
+    conv = float(state.prob_vector.mean())
+    print(f"Mean probability after {ngen} gens: {conv:.3f}")
+    return conv
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""BIPOP-CMA-ES restart regime on Rastrigin.
+
+Counterpart of /root/reference/examples/es/cma_bipop.py (199 LoC):
+alternating large- and small-population restarts with the full Hansen
+stopping-criteria set; promoted to the first-class
+:func:`deap_tpu.strategies.bipop_cmaes`.
+"""
+
+import jax
+
+from deap_tpu import benchmarks, strategies
+
+
+def main(smoke: bool = False):
+    dim = 30 if not smoke else 5
+    nrestarts = 10 if not smoke else 2
+
+    best_x, best_f, logbooks = strategies.bipop_cmaes(
+        jax.random.key(56),
+        lambda g: jax.vmap(benchmarks.rastrigin)(g)[:, 0],
+        dim=dim, sigma0=2.0, nrestarts=nrestarts, verbose=False)
+    total_gens = sum(len(lb) for lb in logbooks)
+    print(f"best rastrigin {best_f:.4f} after {len(logbooks)} restarts, "
+          f"{total_gens} total generations")
+    return best_f
+
+
+if __name__ == "__main__":
+    main()

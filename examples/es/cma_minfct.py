@@ -1,0 +1,42 @@
+"""CMA-ES minimisation via the ask-tell loop.
+
+Counterpart of /root/reference/examples/es/cma_minfct.py: ``cma.Strategy``
+driven by ``eaGenerateUpdate`` on Rastrigin. The whole
+generate → evaluate → update cycle is one scanned, compiled step.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import algorithms, benchmarks, strategies
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.toolbox import Toolbox
+from deap_tpu.support.stats import fitness_stats
+
+N = 20
+
+
+def main(smoke: bool = False):
+    ngen = 250 if not smoke else 30
+    strat = strategies.Strategy(centroid=[5.0] * N, sigma=5.0,
+                                lambda_=20 * N if not smoke else 40)
+    toolbox = Toolbox()
+    toolbox.register("generate", strat.generate)
+    toolbox.register("update", strat.update)
+    toolbox.register("evaluate", lambda g: jax.vmap(benchmarks.rastrigin)(
+        g)[:, 0])
+
+    state, logbook, hof = algorithms.ea_generate_update(
+        jax.random.key(51), strat.initial_state(), toolbox, ngen,
+        spec=FitnessSpec((-1.0,)), stats=fitness_stats(),
+        halloffame_size=1, verbose=not smoke)
+    from deap_tpu.support.hof import hof_best
+
+    _, values = hof_best(hof)          # raw objective values
+    best = float(values[0])
+    print(f"Best rastrigin value: {best:.6f}")
+    return best
+
+
+if __name__ == "__main__":
+    main()

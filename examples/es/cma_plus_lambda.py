@@ -1,0 +1,39 @@
+"""(1+λ)-CMA-ES.
+
+Counterpart of /root/reference/examples/es/cma_1+l_minfct.py:
+``cma.StrategyOnePlusLambda`` — Cholesky-based covariance adaptation
+with success-rate-driven step size — minimising a shifted sphere.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import algorithms, benchmarks, strategies
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.toolbox import Toolbox
+
+N = 5
+
+
+def main(smoke: bool = False):
+    ngen = 200 if not smoke else 40
+    parent = jnp.full((N,), 5.0)
+    strat = strategies.StrategyOnePlusLambda(
+        parent=parent, parent_fitness=benchmarks.sphere(parent),
+        sigma=5.0, lambda_=10)
+    toolbox = Toolbox()
+    toolbox.register("generate", strat.generate)
+    toolbox.register("update", strat.update)
+    toolbox.register("evaluate",
+                     lambda g: jax.vmap(benchmarks.sphere)(g)[:, 0])
+
+    state, logbook, _ = algorithms.ea_generate_update(
+        jax.random.key(52), strat.initial_state(), toolbox, ngen,
+        spec=FitnessSpec((-1.0,)))
+    best = float(benchmarks.sphere(state.parent)[0])
+    print(f"Parent sphere value: {best:.3e}")
+    return best
+
+
+if __name__ == "__main__":
+    main()

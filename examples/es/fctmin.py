@@ -1,0 +1,65 @@
+"""Evolution strategy with per-gene mutation strategies.
+
+Counterpart of /root/reference/examples/es/fctmin.py: individuals carry
+a ``strategy`` vector (self-adaptive step sizes), varied by
+``cxESBlend`` + ``mutESLogNormal`` under (μ, λ) selection. The strategy
+vector travels in the genome pytree so all machinery applies unchanged.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import algorithms, benchmarks, ops
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import init_population
+from deap_tpu.core.toolbox import Toolbox
+
+IND_SIZE = 30
+MIN_STRATEGY = 0.5
+
+
+def main(smoke: bool = False):
+    mu, lam = 10, 100
+    ngen = 100 if not smoke else 10
+
+    def init_es(key):
+        kx, ks = jax.random.split(key)
+        return {
+            "x": jax.random.uniform(kx, (IND_SIZE,), minval=-3.0,
+                                    maxval=3.0),
+            "strategy": jax.random.uniform(ks, (IND_SIZE,), minval=0.5,
+                                           maxval=3.0),
+        }
+
+    def mate(key, a, b):
+        (c1x, c1s), (c2x, c2s) = ops.cx_es_blend(
+            key, a["x"], a["strategy"], b["x"], b["strategy"], alpha=0.1)
+        return ({"x": c1x, "strategy": c1s},
+                {"x": c2x, "strategy": c2s})
+
+    def mutate(key, a):
+        x, s = ops.mut_es_log_normal(key, a["x"], a["strategy"],
+                                     c=1.0, indpb=0.03)
+        # the reference's checkStrategy decorator clamps the step sizes
+        # from below (fctmin.py:42-53)
+        return {"x": x, "strategy": jnp.maximum(s, MIN_STRATEGY)}
+
+    toolbox = Toolbox()
+    toolbox.register("evaluate", lambda g: jax.vmap(benchmarks.sphere)(
+        g["x"])[:, 0])
+    toolbox.register("mate", mate)
+    toolbox.register("mutate", mutate)
+    toolbox.register("select", ops.sel_tournament, tournsize=3)
+
+    pop = init_population(jax.random.key(48), mu, init_es,
+                          FitnessSpec((-1.0,)))
+    pop, logbook, _ = algorithms.ea_mu_comma_lambda(
+        jax.random.key(49), pop, toolbox, mu=mu, lambda_=lam,
+        cxpb=0.6, mutpb=0.3, ngen=ngen)
+    best = float(-pop.wvalues.max())
+    print(f"Best sphere value: {best:.6f}")
+    return best
+
+
+if __name__ == "__main__":
+    main()

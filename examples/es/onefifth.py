@@ -1,0 +1,44 @@
+"""(1+1)-ES with the one-fifth success rule.
+
+Counterpart of /root/reference/examples/es/onefifth.py: a single parent,
+one Gaussian offspring per iteration, sigma scaled up on success and
+down on failure to hold the 1/5 success rate. The whole run is a
+``lax.scan``.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deap_tpu import benchmarks
+
+IND_SIZE = 10
+
+
+def main(smoke: bool = False):
+    ngen = 1500 if not smoke else 200
+    c = 0.817        # the reference's decrease factor (onefifth.py)
+
+    def f(x):
+        return benchmarks.sphere(x)[0]
+
+    def step(carry, key):
+        x, sigma, fx = carry
+        child = x + sigma * jax.random.normal(key, x.shape)
+        fc = f(child)
+        success = fc < fx
+        x = jnp.where(success, child, x)
+        fx = jnp.where(success, fc, fx)
+        sigma = jnp.where(success, sigma / c, sigma * c ** 0.25)
+        return (x, sigma, fx), fx
+
+    x0 = jnp.full((IND_SIZE,), 5.0)
+    (x, sigma, fx), hist = lax.scan(
+        step, (x0, jnp.float32(1.0), f(x0)),
+        jax.random.split(jax.random.key(50), ngen))
+    print(f"Best after {ngen} iters: {float(fx):.3e} (sigma {float(sigma):.2e})")
+    return float(fx)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""Evolving sorting networks (miss-count + size objectives).
+
+Counterpart of /root/reference/examples/ga/evosn.py: evolve comparator
+sequences for an n-input sorting network, minimising (misses, size).
+Variable-length individuals become fixed-width pair arrays + length
+with length-aware crossover/mutation.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import algorithms, mo, ops
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import init_population
+from deap_tpu.core.toolbox import Toolbox
+
+from examples.ga.sortingnetwork import evaluate_network
+
+DIM = 6
+MAX_PAIRS = 24
+
+
+def main(smoke: bool = False):
+    n, ngen = (200, 40) if not smoke else (60, 8)
+
+    def init_net(key):
+        k1, k2, k3 = jax.random.split(key, 3)
+        a = jax.random.randint(k1, (MAX_PAIRS,), 0, DIM)
+        off = jax.random.randint(k2, (MAX_PAIRS,), 1, DIM)
+        b = (a + off) % DIM
+        pairs = jnp.stack([jnp.minimum(a, b), jnp.maximum(a, b)], axis=-1)
+        length = jax.random.randint(k3, (), MAX_PAIRS // 2, MAX_PAIRS + 1)
+        return {"pairs": pairs, "length": length}
+
+    def evaluate(genomes):
+        return jax.vmap(
+            lambda g: evaluate_network(g["pairs"], g["length"], DIM)
+        )(genomes)
+
+    def mate(key, g1, g2):
+        """One-point crossover on the comparator sequence."""
+        cut = jax.random.randint(key, (), 1, MAX_PAIRS)
+        sel = (jnp.arange(MAX_PAIRS) < cut)[:, None]
+        c1 = {"pairs": jnp.where(sel, g1["pairs"], g2["pairs"]),
+              "length": jnp.maximum(g1["length"], g2["length"])}
+        c2 = {"pairs": jnp.where(sel, g2["pairs"], g1["pairs"]),
+              "length": jnp.maximum(g1["length"], g2["length"])}
+        return c1, c2
+
+    def mutate(key, g):
+        """Replace a random comparator; small chance to grow/shrink."""
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        i = jax.random.randint(k1, (), 0, MAX_PAIRS)
+        a = jax.random.randint(k2, (), 0, DIM)
+        off = jax.random.randint(k3, (), 1, DIM)
+        b = (a + off) % DIM
+        pair = jnp.stack([jnp.minimum(a, b), jnp.maximum(a, b)])
+        delta = jax.random.randint(k4, (), -1, 2)
+        return {"pairs": g["pairs"].at[i].set(pair),
+                "length": jnp.clip(g["length"] + delta, 1, MAX_PAIRS)}
+
+    toolbox = Toolbox()
+    toolbox.register("evaluate", evaluate)
+    toolbox.register("mate", mate)
+    toolbox.register("mutate", mutate)
+    toolbox.register("select", mo.sel_nsga2)
+
+    pop = init_population(jax.random.key(25), n, init_net,
+                          FitnessSpec((-1.0, -1.0)))
+    pop, logbook, _ = algorithms.ea_mu_plus_lambda(
+        jax.random.key(26), pop, toolbox, mu=n, lambda_=n,
+        cxpb=0.6, mutpb=0.3, ngen=ngen)
+    misses = pop.fitness[:, 0]
+    best_misses = float(misses.min())
+    perfect = misses == 0
+    sizes = jnp.where(perfect, pop.fitness[:, 1], jnp.inf)
+    print(f"Best misses: {best_misses}; smallest perfect network: "
+          f"{float(sizes.min())} comparators")
+    return best_misses
+
+
+if __name__ == "__main__":
+    main()

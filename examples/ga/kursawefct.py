@@ -1,0 +1,39 @@
+"""Kursawe multi-objective function with NSGA-II.
+
+Counterpart of /root/reference/examples/ga/kursawefct.py: real-valued
+genomes on the Kursawe landscape (benchmarks/__init__.py:364+),
+Gaussian mutation + blend crossover, NSGA-II selection.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import algorithms, benchmarks, mo, ops
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import init_population
+from deap_tpu.core.toolbox import Toolbox
+
+
+def main(smoke: bool = False):
+    n, ngen = (100, 50) if not smoke else (40, 10)
+
+    toolbox = Toolbox()
+    toolbox.register("evaluate", lambda g: jax.vmap(benchmarks.kursawe)(g))
+    toolbox.register("mate", ops.cx_blend, alpha=1.5)
+    toolbox.register("mutate", ops.mut_gaussian, mu=0.0, sigma=3.0,
+                     indpb=0.3)
+    toolbox.register("select", mo.sel_nsga2)
+
+    pop = init_population(
+        jax.random.key(17), n, ops.uniform_genome(3, -5.0, 5.0),
+        FitnessSpec((-1.0, -1.0)))
+    pop, logbook, _ = algorithms.ea_mu_plus_lambda(
+        jax.random.key(18), pop, toolbox, mu=n, lambda_=n,
+        cxpb=0.5, mutpb=0.3, ngen=ngen)
+    nd = mo.nondominated_mask(pop.wvalues)
+    print(f"Non-dominated individuals in final pop: {int(nd.sum())}")
+    return int(nd.sum())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""OneMax islands sharded over the device mesh (one island per device).
+
+Counterpart of /root/reference/examples/ga/onemax_island_scoop.py, which
+ships whole islands to SCOOP network workers through ``toolbox.map``
+(onemax_island_scoop.py:49, :65) and migrates master-side with
+``migRing`` (:67). TPU-native (SURVEY.md §2.3 P4): islands live on the
+``island`` mesh axis, local evolution is per-device SPMD, and the ring
+migration is a ``lax.ppermute`` over ICI — no pickling, no master.
+Multi-host runs use the same program under ``jax.distributed``.
+
+Run with ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` to test
+on a CPU mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import ops
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.toolbox import Toolbox
+from deap_tpu.parallel import (
+    island_init,
+    make_island_step,
+    population_mesh,
+    shard_population,
+)
+
+
+def main(smoke: bool = False):
+    n_islands = jax.device_count()
+    deme_size = 60
+    epochs, freq = (8, 5) if not smoke else (3, 2)
+
+    toolbox = Toolbox()
+    toolbox.register("evaluate", lambda g: g.sum(-1).astype(jnp.float32))
+    toolbox.register("mate", ops.cx_two_point)
+    toolbox.register("mutate", ops.mut_flip_bit, indpb=0.05)
+    toolbox.register("select", ops.sel_tournament, tournsize=3)
+
+    mesh = population_mesh(n_islands, axis_names=("island",))
+    pops = island_init(jax.random.key(6), n_islands, deme_size,
+                       ops.bernoulli_genome(100), FitnessSpec((1.0,)))
+    pops = shard_population(pops, mesh, axis="island")
+    step = jax.jit(make_island_step(toolbox, cxpb=0.5, mutpb=0.2,
+                                    freq=freq, mig_k=5, mesh=mesh))
+
+    key = jax.random.key(7)
+    for e in range(epochs):
+        key, ke = jax.random.split(key)
+        pops = step(ke, pops)
+    best = float(pops.wvalues.max())
+    print(f"{n_islands} islands on mesh, best: {best}")
+    return best
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""OneMax with data-parallel fitness evaluation over local devices.
+
+Counterpart of /root/reference/examples/ga/onemax_mp.py, which registers
+``multiprocessing.Pool.map`` as ``toolbox.map`` (onemax_mp.py:58-59) to
+spread evaluation over CPU cores. The TPU-native equivalent (SURVEY.md
+§2.3 P2): shard the population axis over the local device mesh — the
+same jit program runs SPMD on every device and XLA inserts the
+collectives. Run under ``XLA_FLAGS=--xla_force_host_platform_device_count=8``
+to try multi-device on CPU.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import algorithms, ops
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import init_population
+from deap_tpu.core.toolbox import Toolbox
+from deap_tpu.parallel import population_mesh, shard_population
+
+
+def main(smoke: bool = False):
+    n, ngen = (1024, 40) if not smoke else (64, 8)
+
+    toolbox = Toolbox()
+    toolbox.register("evaluate", lambda g: g.sum(-1).astype(jnp.float32))
+    toolbox.register("mate", ops.cx_two_point)
+    toolbox.register("mutate", ops.mut_flip_bit, indpb=0.05)
+    toolbox.register("select", ops.sel_tournament, tournsize=3)
+
+    pop = init_population(jax.random.key(2), n,
+                          ops.bernoulli_genome(100), FitnessSpec((1.0,)))
+    mesh = population_mesh()
+    pop = shard_population(pop, mesh)
+    print(f"devices: {jax.device_count()}, population sharded over mesh "
+          f"{mesh.shape}")
+
+    pop, logbook, _ = algorithms.ea_simple(
+        jax.random.key(3), pop, toolbox, 0.5, 0.2, ngen)
+    best = float(pop.wvalues.max())
+    print("Best:", best)
+    return best
+
+
+if __name__ == "__main__":
+    main()

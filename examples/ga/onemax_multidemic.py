@@ -1,0 +1,56 @@
+"""OneMax, multi-demic evolution in one process.
+
+Counterpart of /root/reference/examples/ga/onemax_multidemic.py: a list
+of demes evolved in lockstep with ``migRing`` every generation. Here
+the demes are one stacked population and migration is
+:func:`deap_tpu.parallel.mig_ring` (SURVEY.md §2.3 P6).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import algorithms, ops
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import gather
+from deap_tpu.core.toolbox import Toolbox
+from deap_tpu.parallel import island_init, mig_ring
+
+
+def main(smoke: bool = False):
+    demes, deme_size = 3, 50
+    ngen, mig_freq = (40, 5) if not smoke else (10, 3)
+
+    toolbox = Toolbox()
+    toolbox.register("evaluate", lambda g: g.sum(-1).astype(jnp.float32))
+    toolbox.register("mate", ops.cx_two_point)
+    toolbox.register("mutate", ops.mut_flip_bit, indpb=0.05)
+    toolbox.register("select", ops.sel_tournament, tournsize=3)
+
+    pops = island_init(jax.random.key(8), demes, deme_size,
+                       ops.bernoulli_genome(100), FitnessSpec((1.0,)))
+
+    @jax.jit
+    def generation(key, pops):
+        def one(key, pop):
+            k_sel, k_var = jax.random.split(key)
+            pop = algorithms.evaluate_invalid(pop, toolbox.evaluate)
+            idx = toolbox.select(k_sel, pop.wvalues, pop.size)
+            off = algorithms.var_and(k_var, gather(pop, idx), toolbox,
+                                     0.5, 0.2)
+            return algorithms.evaluate_invalid(off, toolbox.evaluate)
+
+        return jax.vmap(one)(jax.random.split(key, demes), pops)
+
+    key = jax.random.key(9)
+    for g in range(ngen):
+        key, kg, km = jax.random.split(key, 3)
+        pops = generation(kg, pops)
+        if (g + 1) % mig_freq == 0:
+            pops = mig_ring(km, pops, k=5)
+    best = float(pops.wvalues.max())
+    print("Best:", best)
+    return best
+
+
+if __name__ == "__main__":
+    main()

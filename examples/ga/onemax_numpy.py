@@ -1,0 +1,42 @@
+"""OneMax over packed numeric genomes.
+
+Counterpart of /root/reference/examples/ga/onemax_numpy.py, whose point
+is ndarray individuals (with the cxTwoPointCopy view-aliasing fix,
+doc/tutorials/advanced/numpy.rst). In the tensor framework every
+population is already an array — this variant shows dtype control
+(int8 genomes instead of bool) and that the same operators apply
+unchanged, with no aliasing possible because variation is functional
+(SURVEY.md §5.2).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import algorithms, ops
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import init_population
+from deap_tpu.core.toolbox import Toolbox
+
+
+def main(smoke: bool = False):
+    n, ngen = (300, 40) if not smoke else (60, 10)
+
+    toolbox = Toolbox()
+    toolbox.register("evaluate", lambda g: g.sum(-1).astype(jnp.float32))
+    toolbox.register("mate", ops.cx_two_point)
+    toolbox.register("mutate", ops.mut_flip_bit, indpb=0.05)
+    toolbox.register("select", ops.sel_tournament, tournsize=3)
+
+    pop = init_population(
+        jax.random.key(0), n, ops.bernoulli_genome(100, dtype=jnp.int8),
+        FitnessSpec((1.0,)))
+    pop, logbook, _ = algorithms.ea_simple(
+        jax.random.key(1), pop, toolbox, 0.5, 0.2, ngen)
+    assert pop.genomes.dtype == jnp.int8
+    best = float(pop.wvalues.max())
+    print("Best:", best)
+    return best
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""OneMax, short form — one call to ea_simple.
+
+Counterpart of /root/reference/examples/ga/onemax_short.py (the
+README's canonical example, README.md:74-104): toolbox registration +
+``algorithms.eaSimple`` with stats and a hall of fame. Here the whole
+40-generation run is a single compiled scan.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import algorithms, ops
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import init_population
+from deap_tpu.core.toolbox import Toolbox
+from deap_tpu.support.hof import hof_best
+from deap_tpu.support.stats import fitness_stats
+
+
+def main(smoke: bool = False):
+    n, ngen = (300, 40) if not smoke else (60, 10)
+
+    toolbox = Toolbox()
+    toolbox.register("evaluate", lambda g: g.sum(-1).astype(jnp.float32))
+    toolbox.register("mate", ops.cx_two_point)
+    toolbox.register("mutate", ops.mut_flip_bit, indpb=0.05)
+    toolbox.register("select", ops.sel_tournament, tournsize=3)
+
+    pop = init_population(jax.random.key(64), n,
+                          ops.bernoulli_genome(100), FitnessSpec((1.0,)))
+    pop, logbook, hof = algorithms.ea_simple(
+        jax.random.key(65), pop, toolbox, cxpb=0.5, mutpb=0.2, ngen=ngen,
+        stats=fitness_stats(), halloffame_size=1, verbose=not smoke)
+    genome, w = hof_best(hof)
+    print("Best:", float(w[0]))
+    return float(w[0])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""Sorting-network representation and exhaustive evaluation.
+
+Counterpart of /root/reference/examples/ga/sortingnetwork.py: a network
+is a sequence of comparator pairs; correctness is checked by sorting
+every binary input (the zero-one principle). Networks are fixed-width
+comparator arrays ``[max_pairs, 2]`` with a length; evaluation applies
+all comparators to all 2^n binary vectors in one batched program.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def all_binary_inputs(dimension: int) -> jnp.ndarray:
+    n = 1 << dimension
+    return ((jnp.arange(n)[:, None] >> jnp.arange(dimension)[None, :]) & 1
+            ).astype(jnp.int32)
+
+
+def apply_network(pairs: jnp.ndarray, length: jnp.ndarray,
+                  inputs: jnp.ndarray) -> jnp.ndarray:
+    """Run the comparator sequence over a batch of vectors."""
+
+    def step(vecs, t):
+        i, j = pairs[t, 0], pairs[t, 1]
+        active = t < length
+        lo = jnp.minimum(vecs[:, i], vecs[:, j])
+        hi = jnp.maximum(vecs[:, i], vecs[:, j])
+        new = vecs.at[:, i].set(lo).at[:, j].set(hi)
+        return jnp.where(active, new, vecs), None
+
+    out, _ = lax.scan(step, inputs, jnp.arange(pairs.shape[0]))
+    return out
+
+
+def evaluate_network(pairs, length, dimension) -> jnp.ndarray:
+    """(errors, length) — the reference's (misses, size) objectives."""
+    inputs = all_binary_inputs(dimension)
+    out = apply_network(pairs, length, inputs)
+    sorted_ref = jnp.sort(inputs, axis=1)
+    errors = (out != sorted_ref).any(axis=1).sum()
+    return jnp.stack([errors.astype(jnp.float32),
+                      length.astype(jnp.float32)])
+
+
+def main(smoke: bool = False):
+    # the known optimal 4-input network: 5 comparators
+    pairs = jnp.asarray([[0, 1], [2, 3], [0, 2], [1, 3], [1, 2]] + [[0, 0]] * 3)
+    errs, size = evaluate_network(pairs, jnp.int32(5), 4)
+    print(f"4-input Batcher network: errors={int(errs)}, size={int(size)}")
+    assert int(errs) == 0
+    return int(errs)
+
+
+if __name__ == "__main__":
+    main()

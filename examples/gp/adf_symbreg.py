@@ -1,0 +1,66 @@
+"""Symbolic regression with automatically defined functions.
+
+Counterpart of /root/reference/examples/gp/adf_symbreg.py: a MAIN tree
+plus three ADF branches, each with its own primitive set; MAIN may call
+ADF0/ADF1/ADF2, ADF0 may call ADF1/ADF2, ADF1 may call ADF2 (the
+progressive compile order of compileADF, gp.py:490-513). Variation is
+branch-wise, as in the reference's per-subtree mate/mutate loops.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import gp, ops
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import init_population
+from deap_tpu.core.toolbox import Toolbox
+from deap_tpu import algorithms
+
+MAIN_LEN, ADF_LEN = 48, 24
+
+
+def build_branches():
+    adf2 = gp.math_set(n_args=2, trig=False, erc=False, name="ADF2")
+    adf1 = gp.math_set(n_args=2, trig=False, erc=False, name="ADF1")
+    adf1.add_adf("ADF2", 2, branch=3)
+    adf0 = gp.math_set(n_args=2, trig=False, erc=False, name="ADF0")
+    adf0.add_adf("ADF1", 2, branch=2)
+    adf0.add_adf("ADF2", 2, branch=3)
+    main = gp.math_set(n_args=1, trig=True, erc=True, name="MAIN")
+    main.add_adf("ADF0", 2, branch=1)
+    main.add_adf("ADF1", 2, branch=2)
+    main.add_adf("ADF2", 2, branch=3)
+    return [(main, MAIN_LEN), (adf0, ADF_LEN), (adf1, ADF_LEN),
+            (adf2, ADF_LEN)]
+
+
+def main(smoke: bool = False):
+    n, ngen = (200, 25) if not smoke else (50, 5)
+    branches = build_branches()
+    gen = gp.make_adf_generator(branches, 1, 2)
+    interp = gp.make_adf_interpreter(branches)
+    cx = gp.branch_wise_cx([gp.make_cx_one_point(ps) for ps, _ in branches])
+    mut = gp.branch_wise_mut([
+        gp.make_mut_uniform(ps, gp.make_generator(ps, 16, 0, 2, "full"))
+        for ps, _ in branches])
+
+    X = jnp.linspace(-1.0, 1.0, 20, endpoint=False)[:, None]
+    y = X[:, 0] ** 4 + X[:, 0] ** 3 + X[:, 0] ** 2 + X[:, 0]
+
+    toolbox = Toolbox()
+    toolbox.register("evaluate", lambda gs: -jax.vmap(
+        lambda g: jnp.mean((interp(g, X) - y) ** 2))(gs))
+    toolbox.register("mate", cx)
+    toolbox.register("mutate", mut)
+    toolbox.register("select", ops.sel_tournament, tournsize=3)
+
+    pop = init_population(jax.random.key(37), n, gen, FitnessSpec((1.0,)))
+    pop, logbook, _ = algorithms.ea_simple(
+        jax.random.key(38), pop, toolbox, cxpb=0.5, mutpb=0.2, ngen=ngen)
+    mse = float(-pop.wvalues.max())
+    print(f"Best MSE with ADFs: {mse:.6f}")
+    return mse
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Boolean 6-multiplexer (2 address + 4 data lines).
+
+Counterpart of /root/reference/examples/gp/multiplexer.py (MUX_SELECT_LINES
+= 3 → 11-mux in the reference; 2 → 6-mux here for speed, same
+machinery): find a boolean program computing
+``data[address]`` over the full truth table.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import algorithms, gp, ops
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import init_population
+from deap_tpu.core.toolbox import Toolbox
+
+MAX_LEN = 96
+
+
+def truth_table(select: int = 2):
+    data = 1 << select
+    fanin = select + data
+    n = 1 << fanin
+    X = ((jnp.arange(n)[:, None] >> jnp.arange(fanin)[None, :]) & 1
+         ).astype(jnp.float32)
+    addr = (X[:, :select] * (2 ** jnp.arange(select))).sum(-1).astype(jnp.int32)
+    y = X[jnp.arange(n), select + addr]
+    return X, y, fanin
+
+
+def main(smoke: bool = False):
+    n, ngen = (300, 40) if not smoke else (60, 8)
+    X, y, fanin = truth_table(2)
+    pset = gp.bool_set(fanin)
+    gen = gp.gen_half_and_half(pset, MAX_LEN, 2, 4)
+    expr_mut = gp.make_generator(pset, 32, 0, 2, "grow")
+    interp = gp.make_interpreter(pset, MAX_LEN)
+
+    toolbox = Toolbox()
+    toolbox.register("evaluate", lambda gs: jax.vmap(
+        lambda g: (interp(g, X) == y).sum().astype(jnp.float32))(gs))
+    toolbox.register("mate", gp.make_cx_one_point(pset))
+    toolbox.register("mutate", gp.make_mut_uniform(pset, expr_mut))
+    toolbox.register("select", ops.sel_tournament, tournsize=7)
+
+    pop = init_population(jax.random.key(41), n, gen, FitnessSpec((1.0,)))
+    pop, logbook, _ = algorithms.ea_simple(
+        jax.random.key(42), pop, toolbox, cxpb=0.8, mutpb=0.1, ngen=ngen)
+    best = float(pop.wvalues.max())
+    print(f"Best truth-table matches: {best} / {X.shape[0]}")
+    return best
+
+
+if __name__ == "__main__":
+    main()

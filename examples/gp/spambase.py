@@ -1,0 +1,55 @@
+"""Spam classification with strongly-typed GP.
+
+Counterpart of /root/reference/examples/gp/spambase.py: a typed
+vocabulary where float comparisons feed boolean logic feeding an
+if-then-else, evolved to classify feature vectors (the reference reads
+spambase.csv; a reproducible synthetic spam-like dataset stands in).
+Typed generation/variation guarantee well-typed trees by construction.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import algorithms, gp, ops
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import init_population
+from deap_tpu.core.toolbox import Toolbox
+
+N_FEATURES = 6
+MAX_LEN = 64
+
+
+def make_dataset(key, n: int = 200):
+    """Spam iff freq0 > 40 or (freq1 > 60 and freq2 < 20) — a rule the
+    typed vocabulary can express exactly."""
+    X = jax.random.uniform(key, (n, N_FEATURES)) * 100.0
+    y = ((X[:, 0] > 40.0) | ((X[:, 1] > 60.0) & (X[:, 2] < 20.0))
+         ).astype(jnp.float32)
+    return X, y
+
+
+def main(smoke: bool = False):
+    n, ngen = (200, 30) if not smoke else (50, 6)
+    X, y = make_dataset(jax.random.key(43))
+    pset = gp.spam_set(n_features=N_FEATURES)
+    gen = gp.make_generator_typed(pset, MAX_LEN, 1, 4)
+    interp = gp.make_interpreter(pset, MAX_LEN)
+
+    toolbox = Toolbox()
+    toolbox.register("evaluate", lambda gs: jax.vmap(
+        lambda g: (interp(g, X) == y).mean())(gs))
+    toolbox.register("mate", gp.make_cx_one_point_typed(pset))
+    toolbox.register("mutate", gp.make_mut_node_replacement_typed(pset))
+    toolbox.register("select", ops.sel_tournament, tournsize=3)
+
+    pop = init_population(jax.random.key(44), n, lambda k: gen(k),
+                          FitnessSpec((1.0,)))
+    pop, logbook, _ = algorithms.ea_simple(
+        jax.random.key(45), pop, toolbox, cxpb=0.5, mutpb=0.2, ngen=ngen)
+    acc = float(pop.wvalues.max())
+    print(f"Best classification accuracy: {acc:.3f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

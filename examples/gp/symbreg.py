@@ -1,0 +1,57 @@
+"""Symbolic regression of the quartic polynomial — the canonical GP.
+
+Counterpart of /root/reference/examples/gp/symbreg.py (92 LoC, seed 318
+at symbreg.py:73): evolve ``x⁴ + x³ + x² + x`` from 20 sample points in
+[-1, 1) with the add/sub/mul/protectedDiv/neg/cos/sin + ERC vocabulary.
+Evaluation of the whole population on all points is one batched stack
+-interpreter program instead of per-individual codegen + eval
+(SURVEY.md §3.3).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import algorithms, gp, ops
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import init_population
+from deap_tpu.core.toolbox import Toolbox
+
+MAX_LEN = 64
+
+
+def main(smoke: bool = False, seed: int = 318):
+    n, ngen = (300, 40) if not smoke else (60, 8)
+
+    pset = gp.math_set(n_args=1)
+    pset.rename_arguments(ARG0="x")
+    gen = gp.gen_half_and_half(pset, MAX_LEN, 1, 2)
+    expr_mut = gp.make_generator(pset, 32, 0, 2, "full")
+    interp = gp.make_interpreter(pset, MAX_LEN)
+
+    X = jnp.linspace(-1.0, 1.0, 20, endpoint=False)[:, None]
+    y = X[:, 0] ** 4 + X[:, 0] ** 3 + X[:, 0] ** 2 + X[:, 0]
+
+    limit = gp.static_limit(lambda g: gp.tree_height(g, pset), 17)
+
+    toolbox = Toolbox()
+    toolbox.register("evaluate", lambda gs: -jax.vmap(
+        lambda g: jnp.mean((interp(g, X) - y) ** 2))(gs))
+    toolbox.register("mate", limit(gp.make_cx_one_point(pset)))
+    toolbox.register("mutate", limit(gp.make_mut_uniform(pset, expr_mut)))
+    toolbox.register("select", ops.sel_tournament, tournsize=3)
+
+    pop = init_population(jax.random.key(seed), n, gen,
+                          FitnessSpec((1.0,)))
+    pop, logbook, hof = algorithms.ea_simple(
+        jax.random.key(seed + 1), pop, toolbox, cxpb=0.5, mutpb=0.1,
+        ngen=ngen, halloffame_size=1)
+    best_i = int(pop.best_index())
+    best = jax.tree_util.tree_map(lambda a: a[best_i], pop.genomes)
+    mse = float(-pop.wvalues.max())
+    print(f"Best MSE: {mse:.6f}")
+    print("Best expr:", gp.to_string(best, pset))
+    return mse
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Symbolic regression under HARM-GP bloat control.
+
+Counterpart of /root/reference/examples/gp/symbreg_harm.py: the same
+quartic target as symbreg.py but evolved with ``gp.harm``
+(gp.py:938-1135), which shapes the offspring size distribution to stop
+tree bloat.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import gp, ops
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import init_population
+from deap_tpu.core.toolbox import Toolbox
+from deap_tpu.support.stats import Statistics
+
+MAX_LEN = 64
+
+
+def main(smoke: bool = False):
+    n, ngen = (300, 25) if not smoke else (60, 5)
+    nbrinds = 600 if not smoke else 200
+
+    pset = gp.math_set(n_args=1)
+    gen = gp.gen_half_and_half(pset, MAX_LEN, 1, 2)
+    expr_mut = gp.make_generator(pset, 32, 0, 2, "full")
+    interp = gp.make_interpreter(pset, MAX_LEN)
+
+    X = jnp.linspace(-1.0, 1.0, 20, endpoint=False)[:, None]
+    y = X[:, 0] ** 4 + X[:, 0] ** 3 + X[:, 0] ** 2 + X[:, 0]
+
+    toolbox = Toolbox()
+    toolbox.register("evaluate", lambda gs: -jax.vmap(
+        lambda g: jnp.mean((interp(g, X) - y) ** 2))(gs))
+    toolbox.register("mate", gp.make_cx_one_point(pset))
+    toolbox.register("mutate", gp.make_mut_uniform(pset, expr_mut))
+    toolbox.register("select", ops.sel_tournament, tournsize=3)
+
+    size_stats = Statistics(lambda pop: pop.genomes["length"])
+    size_stats.register("avg", jnp.mean)
+    size_stats.register("max", jnp.max)
+
+    pop = init_population(jax.random.key(33), n, gen, FitnessSpec((1.0,)))
+    pop, logbook, _ = gp.harm(
+        jax.random.key(34), pop, toolbox, cxpb=0.5, mutpb=0.1, ngen=ngen,
+        alpha=0.05, beta=10, gamma=0.25, rho=0.9, nbrindsmodel=nbrinds,
+        stats=size_stats, verbose=not smoke)
+    mean_size = float(jnp.mean(pop.genomes["length"]))
+    mse = float(-pop.wvalues.max())
+    print(f"Best MSE {mse:.6f} with mean tree size {mean_size:.1f}")
+    return mean_size
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""Particle swarm optimisation, canonical form.
+
+Counterpart of /root/reference/examples/pso/basic.py: velocity update
+with personal/global attractors and speed clamping
+(updateParticle, basic.py:38-48), maximising the inverted h1 landscape
+— here minimising sphere for a crisp check, with the whole run scanned.
+"""
+
+import jax
+
+from deap_tpu import benchmarks, strategies
+from deap_tpu.core.fitness import FitnessSpec
+
+
+def main(smoke: bool = False):
+    ngen = 100 if not smoke else 20
+    pso = strategies.PSO(
+        evaluate=lambda x: -jax.vmap(benchmarks.sphere)(x)[:, 0],
+        phi1=2.0, phi2=2.0, smin=-3.0, smax=3.0)
+    state = pso.init(jax.random.key(66), n=100, dim=2,
+                     pmin=-100.0, pmax=100.0, smin=-3.0, smax=3.0)
+    state, hist = pso.run(jax.random.key(67), state, ngen)
+    best = float(-state.gbest_w[0])
+    print(f"Best sphere value: {best:.4f}")
+    return best
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+"""Speciation PSO on MovingPeaks.
+
+Counterpart of /root/reference/examples/pso/speciation.py: species form
+around best-first seeds within radius ``rs``; capped species, replaced
+worst species, quantum conversion on change detection.
+"""
+
+import jax
+
+from deap_tpu import strategies
+from deap_tpu.benchmarks import movingpeaks as mp
+
+
+def main(smoke: bool = False):
+    ndim = 5
+    steps = 60 if not smoke else 15
+
+    cfg = mp.MovingPeaksConfig(dim=ndim, **{
+        k: v for k, v in mp.SCENARIO_1.items()
+        if k not in ("pfunc", "bfunc")})
+    state = mp.mp_init(jax.random.key(71), cfg)
+    rs = (cfg.max_coord - cfg.min_coord) / (50 ** (1.0 / ndim))
+
+    sp = strategies.SpeciationPSO(
+        lambda x: mp.mp_evaluate(cfg, state, x)[1][:, 0],
+        pmin=cfg.min_coord, pmax=cfg.max_coord, rs=rs, pmax_size=10,
+        rcloud=1.0)
+    s = sp.init(jax.random.key(72), n=100, dim=ndim)
+    key = jax.random.key(73)
+    for g in range(steps):
+        key, kg = jax.random.split(key)
+        s = sp.step(kg, s)
+    _, best = sp.best(s)
+    seeds, _ = strategies.species_seeds(s.pbest_x, s.pbest_f, rs)
+    print(f"best {float(best):.2f} "
+          f"(optimum {float(mp.global_maximum(cfg, state)):.2f}); "
+          f"{int(seeds.sum())} species")
+    return float(best)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+"""Examples-as-system-tests: run every model-zoo program in smoke mode.
+
+The reference's de-facto integration suite is its 40 runnable examples
+(examples/speed.txt; SURVEY.md §4.5). Each example here exposes
+``main(smoke=True)`` with reduced sizes; this module asserts they all
+run and, where cheap, that they hit a sanity threshold.
+"""
+
+import importlib
+import pathlib
+import sys
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(ROOT) not in sys.path:
+    sys.path.insert(0, str(ROOT))
+
+EXAMPLES = [
+    "examples.ga.onemax",
+    "examples.ga.onemax_short",
+    "examples.ga.onemax_numpy",
+    "examples.ga.onemax_mp",
+    "examples.ga.onemax_island",
+    "examples.ga.onemax_island_sharded",
+    "examples.ga.onemax_multidemic",
+    "examples.ga.tsp",
+    "examples.ga.knapsack",
+    "examples.ga.nqueens",
+    "examples.ga.kursawefct",
+    "examples.ga.nsga2",
+    "examples.ga.nsga3",
+    "examples.ga.mo_rhv",
+    "examples.ga.sortingnetwork",
+    "examples.ga.evosn",
+    "examples.ga.knn",
+    "examples.ga.evoknn",
+    "examples.ga.xkcd",
+    "examples.gp.symbreg",
+    "examples.gp.symbreg_harm",
+    "examples.gp.symbreg_epsilon_lexicase",
+    "examples.gp.adf_symbreg",
+    "examples.gp.parity",
+    "examples.gp.multiplexer",
+    "examples.gp.spambase",
+    "examples.gp.ant",
+    "examples.es.fctmin",
+    "examples.es.onefifth",
+    "examples.es.cma_minfct",
+    "examples.es.cma_plus_lambda",
+    "examples.es.cma_plotting",
+    "examples.es.cma_mo",
+    "examples.es.cma_bipop",
+    "examples.de.basic",
+    "examples.de.sphere",
+    "examples.de.dynamic",
+    "examples.eda.pbil",
+    "examples.eda.emna",
+    "examples.pso.basic",
+    "examples.pso.multiswarm",
+    "examples.pso.speciation",
+    "examples.coev.coop",
+    "examples.coev.hillis",
+    "examples.coev.symbreg",
+    "examples.bbob",
+]
+
+
+@pytest.mark.parametrize("module_name", EXAMPLES)
+def test_example_smoke(module_name):
+    mod = importlib.import_module(module_name)
+    result = mod.main(smoke=True)
+    assert result is not None
+
+
+def test_gp_ant_native_smoke():
+    from examples.gp import ant
+
+    best = ant.main(smoke=True, native=True)
+    assert best >= 0.0
+
+
+def test_onemax_full_run_reaches_quality():
+    """The README config (onemax_short, pop 300 ngen 40) must come close
+    to the 100-bit optimum — the reference's canonical outcome."""
+    from examples.ga import onemax_short
+
+    best = onemax_short.main(smoke=False)
+    assert best >= 95.0
