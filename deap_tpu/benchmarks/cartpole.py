@@ -1,0 +1,104 @@
+"""CartPole — a jnp control benchmark for neuroevolution.
+
+The classic cart-pole balancing task (Barto, Sutton & Anderson 1983)
+with the standard Gym-era constants: state ``[x, ẋ, θ, θ̇]``, bang-bang
+force ±10 N, Euler integration at dt=0.02, failure when |x| > 2.4 m or
+|θ| > 12°, reward 1 per surviving step, capped at ``max_steps``.
+
+This is the environment for BASELINE.json config #5 ("evolve MLP weights
+for CartPole"): rollouts are pure ``lax.scan`` programs, so a whole
+population of policies runs as one vmapped XLA program — the TPU-native
+replacement for the per-individual simulator processes a CPU
+neuroevolution setup would use.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+GRAVITY = 9.8
+MASS_CART = 1.0
+MASS_POLE = 0.1
+TOTAL_MASS = MASS_CART + MASS_POLE
+HALF_LENGTH = 0.5
+POLEMASS_LENGTH = MASS_POLE * HALF_LENGTH
+FORCE_MAG = 10.0
+DT = 0.02
+X_LIMIT = 2.4
+THETA_LIMIT = 12.0 * jnp.pi / 180.0
+
+
+def cartpole_step(state: jnp.ndarray, action: jnp.ndarray
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One Euler step; ``action`` ∈ {0, 1} (left/right). Returns
+    (next_state, failed)."""
+    x, x_dot, theta, theta_dot = state
+    force = jnp.where(action > 0, FORCE_MAG, -FORCE_MAG)
+    cos_t = jnp.cos(theta)
+    sin_t = jnp.sin(theta)
+    temp = (force + POLEMASS_LENGTH * theta_dot ** 2 * sin_t) / TOTAL_MASS
+    theta_acc = (GRAVITY * sin_t - cos_t * temp) / (
+        HALF_LENGTH * (4.0 / 3.0 - MASS_POLE * cos_t ** 2 / TOTAL_MASS))
+    x_acc = temp - POLEMASS_LENGTH * theta_acc * cos_t / TOTAL_MASS
+    new = jnp.stack([
+        x + DT * x_dot,
+        x_dot + DT * x_acc,
+        theta + DT * theta_dot,
+        theta_dot + DT * theta_acc,
+    ])
+    failed = (jnp.abs(new[0]) > X_LIMIT) | (jnp.abs(new[2]) > THETA_LIMIT)
+    return new, failed
+
+
+def initial_state(key: jax.Array) -> jnp.ndarray:
+    """Uniform(-0.05, 0.05) start, the Gym convention."""
+    return jax.random.uniform(key, (4,), minval=-0.05, maxval=0.05)
+
+
+def rollout(policy: Callable, params, key: jax.Array,
+            max_steps: int = 500) -> jnp.ndarray:
+    """Total reward of ``policy(params, state) -> action logits [2]``
+    over one episode; a failed episode stops accumulating (mask, not
+    early exit — uniform control flow for the batch)."""
+    s0 = initial_state(key)
+
+    def step(carry, _):
+        state, alive = carry
+        logits = policy(params, state)
+        action = jnp.argmax(logits)
+        new, failed = cartpole_step(state, action)
+        reward = alive.astype(jnp.float32)
+        return (new, alive & ~failed), reward
+
+    (_, _), rewards = lax.scan(step, (s0, jnp.bool_(True)),
+                               None, length=max_steps)
+    return rewards.sum()
+
+
+def mlp_policy(sizes=(4, 16, 2)) -> Tuple[Callable, int]:
+    """A plain tanh MLP policy over a *flat* genome vector. Returns
+    ``(policy(params_vector, state) -> logits, n_params)`` — flat
+    genomes keep every GA operator (crossover, gaussian mutation)
+    applicable unchanged."""
+    shapes = []
+    n = 0
+    for a, b in zip(sizes[:-1], sizes[1:]):
+        shapes.append(((a, b), (b,)))
+        n += a * b + b
+
+    def policy(params: jnp.ndarray, state: jnp.ndarray) -> jnp.ndarray:
+        h = state
+        off = 0
+        for (in_d, out_d), _ in shapes:
+            W = params[off: off + in_d * out_d].reshape(in_d, out_d)
+            off += in_d * out_d
+            b = params[off: off + out_d]
+            off += out_d
+            h = jnp.tanh(h @ W + b)
+        return h
+
+    return policy, n

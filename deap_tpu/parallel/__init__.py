@@ -1,6 +1,11 @@
 from deap_tpu.parallel.mesh import population_mesh, shard_population
 from deap_tpu.parallel.migration import mig_ring, migRing
 from deap_tpu.parallel.island import IslandState, island_init, make_island_step
+from deap_tpu.parallel.genome_shard import (
+    genome_mesh,
+    make_sharded_evaluator,
+    shard_genomes,
+)
 
 __all__ = [
     "population_mesh",
@@ -9,5 +14,8 @@ __all__ = [
     "migRing",
     "IslandState",
     "island_init",
+    "genome_mesh",
+    "make_sharded_evaluator",
+    "shard_genomes",
     "make_island_step",
 ]

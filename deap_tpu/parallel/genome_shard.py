@@ -1,0 +1,82 @@
+"""Genome-axis sharding — the SP/CP-shaped parallelism axis.
+
+The reference has no sequence models; SURVEY.md §5.7 identifies the
+genuine analog of "scaling one individual beyond a single worker":
+genomes too large for one device's memory/FLOPs (neuroevolution weight
+vectors, very long feature strings). The TPU-native mechanism is the
+same as sequence/context parallelism for transformers: shard the
+*feature* axis of the population tensor over a mesh axis with
+``shard_map``, compute partial per-individual results locally, and
+reduce with a ``psum`` collective — fitness reductions ride ICI instead
+of materialising the full genome anywhere.
+
+This composes with population sharding: a 2-D ``("pop", "genome")``
+mesh shards both axes, the canonical DP×SP layout.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deap_tpu.parallel.mesh import population_mesh
+
+
+def genome_mesh(n_pop_shards: Optional[int] = None,
+                n_genome_shards: Optional[int] = None) -> Mesh:
+    """A 2-D ``("pop", "genome")`` mesh. Defaults: all devices on the
+    genome axis (pure SP)."""
+    n_dev = len(jax.devices())
+    if n_genome_shards is None:
+        n_genome_shards = n_dev if n_pop_shards is None else (
+            n_dev // n_pop_shards)
+    if n_pop_shards is None:
+        n_pop_shards = n_dev // n_genome_shards
+    if n_pop_shards < 1 or n_genome_shards < 1 or (
+            n_pop_shards * n_genome_shards > n_dev):
+        raise ValueError(
+            f"requested {n_pop_shards} pop x {n_genome_shards} genome "
+            f"shards but only {n_dev} devices are available")
+    return population_mesh(n_pop_shards * n_genome_shards,
+                           axis_names=("pop", "genome"),
+                           shape=(n_pop_shards, n_genome_shards))
+
+
+def shard_genomes(genomes: jnp.ndarray, mesh: Mesh) -> jnp.ndarray:
+    """Place a ``[n, L]`` genome matrix with rows over ``pop`` and the
+    feature axis over ``genome``."""
+    return jax.device_put(genomes, NamedSharding(mesh, P("pop", "genome")))
+
+
+def make_sharded_evaluator(partial_eval: Callable, mesh: Mesh,
+                           combine: str = "sum") -> Callable:
+    """Build ``evaluate(genomes [n, L]) -> f32[n]`` that runs
+    ``partial_eval`` on each device's genome *slice* and reduces across
+    the genome axis.
+
+    :param partial_eval: ``f32/bool[n_local, L_local] -> f32[n_local]``
+        computing the local partial fitness (e.g. a partial sum of
+        per-gene scores, a partial squared-error).
+    :param combine: ``"sum"`` | ``"mean"`` | ``"max"`` — the cross-shard
+        reduction (``psum``-family collectives over ICI).
+    """
+    if combine not in ("sum", "mean", "max"):
+        raise ValueError(combine)
+
+    def local(genomes):
+        part = partial_eval(genomes)
+        if combine == "sum":
+            return jax.lax.psum(part, "genome")
+        if combine == "mean":
+            return jax.lax.pmean(part, "genome")
+        return jax.lax.pmax(part, "genome")
+
+    mapped = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=P("pop", "genome"),
+        out_specs=P("pop"),
+        check_vma=False)
+    return jax.jit(mapped)

@@ -1,0 +1,59 @@
+"""Neuroevolution: evolve MLP weights for CartPole over the device mesh.
+
+BASELINE.json config #5: a GA over flat MLP weight vectors whose fitness
+is a batched CartPole rollout; the population is sharded over the local
+device mesh so rollouts run data-parallel — the TPU-native counterpart
+of farming per-individual simulator processes through ``toolbox.map``.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import algorithms, ops
+from deap_tpu.benchmarks.cartpole import mlp_policy, rollout
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import init_population
+from deap_tpu.core.toolbox import Toolbox
+from deap_tpu.parallel import population_mesh, shard_population
+
+
+def main(smoke: bool = False, pop_size: int = None):
+    n = pop_size or (2048 if not smoke else 128)
+    ngen = 30 if not smoke else 5
+    episodes = 3       # fitness = mean over episodes (noise reduction)
+    max_steps = 200 if smoke else 500
+
+    policy, n_params = mlp_policy((4, 16, 2))
+
+    def evaluate(genomes):
+        keys = jax.random.split(jax.random.key(123), episodes)
+
+        def fit_one(params):
+            return jax.vmap(
+                lambda k: rollout(policy, params, k, max_steps))(keys).mean()
+
+        return jax.vmap(fit_one)(genomes)
+
+    toolbox = Toolbox()
+    toolbox.register("evaluate", evaluate)
+    toolbox.register("mate", ops.cx_blend, alpha=0.1)
+    toolbox.register("mutate", ops.mut_gaussian, mu=0.0, sigma=0.3,
+                     indpb=0.1)
+    toolbox.register("select", ops.sel_tournament, tournsize=3)
+
+    pop = init_population(jax.random.key(90), n,
+                          ops.normal_genome(n_params, sigma=0.5),
+                          FitnessSpec((1.0,)))
+    mesh = population_mesh()
+    pop = shard_population(pop, mesh)
+
+    pop, logbook, _ = algorithms.ea_simple(
+        jax.random.key(91), pop, toolbox, cxpb=0.5, mutpb=0.5, ngen=ngen)
+    best = float(pop.wvalues.max())
+    print(f"Best mean episode length: {best:.1f} / {max_steps} "
+          f"({n} policies x {jax.device_count()} devices)")
+    return best
+
+
+if __name__ == "__main__":
+    main()
