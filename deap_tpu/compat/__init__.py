@@ -1,0 +1,25 @@
+"""DEAP-compatible CPU/list backend.
+
+The tensor framework cannot represent arbitrary Python-object
+individuals (dicts, sets, user classes — SURVEY.md §7.3); the reference
+serves them through ``creator`` + list-based operators behind the
+``Toolbox`` seam. This package is that seam's CPU side, written fresh
+for modern Python against the reference's *documented semantics*
+(weights/wvalues compare, clone=deepcopy, map as the distribution
+boundary):
+
+- :mod:`deap_tpu.compat.creator` — runtime type factory.
+- :mod:`deap_tpu.compat.base` — ``Fitness`` and ``Toolbox``.
+- :mod:`deap_tpu.compat.tools` — list operators + support objects.
+- :mod:`deap_tpu.compat.algorithms` — the four generational loops over
+  lists of individuals.
+- :func:`jax_map` — the bridge the north-star names: register a
+  jax-backed ``map`` so ``toolbox.map(toolbox.evaluate, invalids)``
+  dispatches ONE batched, jit-compiled evaluation over a device tensor
+  while individuals stay Python lists.
+"""
+
+from deap_tpu.compat import algorithms, base, creator, tools
+from deap_tpu.compat.bridge import jax_map
+
+__all__ = ["algorithms", "base", "creator", "tools", "jax_map"]

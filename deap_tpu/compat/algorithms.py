@@ -1,0 +1,146 @@
+"""Generational loops over list populations.
+
+Counterpart of /root/reference/deap/algorithms.py for the CPU backend:
+identical protocol — clone, vary, delete fitness of touched children,
+evaluate exactly the invalid ones through ``toolbox.map`` (the
+distribution seam where :func:`deap_tpu.compat.jax_map` plugs in).
+"""
+
+from __future__ import annotations
+
+import random
+
+from deap_tpu.compat.tools import Logbook
+
+
+def varAnd(population, toolbox, cxpb, mutpb):
+    """Clone → pairwise mate (prob cxpb) → mutate (prob mutpb),
+    invalidating touched fitnesses (algorithms.py:33-82)."""
+    offspring = [toolbox.clone(ind) for ind in population]
+    for i in range(1, len(offspring), 2):
+        if random.random() < cxpb:
+            offspring[i - 1], offspring[i] = toolbox.mate(
+                offspring[i - 1], offspring[i])
+            del offspring[i - 1].fitness.values, offspring[i].fitness.values
+    for i in range(len(offspring)):
+        if random.random() < mutpb:
+            offspring[i], = toolbox.mutate(offspring[i])
+            del offspring[i].fitness.values
+    return offspring
+
+
+def varOr(population, toolbox, lambda_, cxpb, mutpb):
+    """λ children, each by crossover | mutation | reproduction
+    (algorithms.py:192-245)."""
+    assert (cxpb + mutpb) <= 1.0, (
+        "The sum of the crossover and mutation probabilities must be "
+        "smaller or equal to 1.0.")
+    offspring = []
+    for _ in range(lambda_):
+        op_choice = random.random()
+        if op_choice < cxpb:
+            ind1, ind2 = [toolbox.clone(i)
+                          for i in random.sample(population, 2)]
+            ind1, ind2 = toolbox.mate(ind1, ind2)
+            del ind1.fitness.values
+            offspring.append(ind1)
+        elif op_choice < cxpb + mutpb:
+            ind = toolbox.clone(random.choice(population))
+            ind, = toolbox.mutate(ind)
+            del ind.fitness.values
+            offspring.append(ind)
+        else:
+            offspring.append(random.choice(population))
+    return offspring
+
+
+def _evaluate_invalid(population, toolbox):
+    invalid = [ind for ind in population if not ind.fitness.valid]
+    fitnesses = toolbox.map(toolbox.evaluate, invalid)
+    for ind, fit in zip(invalid, fitnesses):
+        ind.fitness.values = fit
+    return len(invalid)
+
+
+def _log(logbook, stats, population, gen, nevals, verbose):
+    record = stats.compile(population) if stats else {}
+    logbook.record(gen=gen, nevals=nevals, **record)
+    if verbose:
+        print(logbook.stream)
+
+
+def eaSimple(population, toolbox, cxpb, mutpb, ngen, stats=None,
+             halloffame=None, verbose=False):
+    """select → varAnd → evaluate → replace (algorithms.py:85-189)."""
+    logbook = Logbook()
+    logbook.header = ["gen", "nevals"] + (stats.fields if stats else [])
+    nevals = _evaluate_invalid(population, toolbox)
+    if halloffame is not None:
+        halloffame.update(population)
+    _log(logbook, stats, population, 0, nevals, verbose)
+    for gen in range(1, ngen + 1):
+        offspring = toolbox.select(population, len(population))
+        offspring = varAnd(offspring, toolbox, cxpb, mutpb)
+        nevals = _evaluate_invalid(offspring, toolbox)
+        if halloffame is not None:
+            halloffame.update(offspring)
+        population[:] = offspring
+        _log(logbook, stats, population, gen, nevals, verbose)
+    return population, logbook
+
+
+def eaMuPlusLambda(population, toolbox, mu, lambda_, cxpb, mutpb, ngen,
+                   stats=None, halloffame=None, verbose=False):
+    """(μ + λ): parents compete with offspring (algorithms.py:248-337)."""
+    logbook = Logbook()
+    logbook.header = ["gen", "nevals"] + (stats.fields if stats else [])
+    nevals = _evaluate_invalid(population, toolbox)
+    if halloffame is not None:
+        halloffame.update(population)
+    _log(logbook, stats, population, 0, nevals, verbose)
+    for gen in range(1, ngen + 1):
+        offspring = varOr(population, toolbox, lambda_, cxpb, mutpb)
+        nevals = _evaluate_invalid(offspring, toolbox)
+        if halloffame is not None:
+            halloffame.update(offspring)
+        population[:] = toolbox.select(population + offspring, mu)
+        _log(logbook, stats, population, gen, nevals, verbose)
+    return population, logbook
+
+
+def eaMuCommaLambda(population, toolbox, mu, lambda_, cxpb, mutpb, ngen,
+                    stats=None, halloffame=None, verbose=False):
+    """(μ, λ): only offspring survive (algorithms.py:340-437)."""
+    assert lambda_ >= mu, \
+        "lambda must be greater or equal to mu."
+    logbook = Logbook()
+    logbook.header = ["gen", "nevals"] + (stats.fields if stats else [])
+    nevals = _evaluate_invalid(population, toolbox)
+    if halloffame is not None:
+        halloffame.update(population)
+    _log(logbook, stats, population, 0, nevals, verbose)
+    for gen in range(1, ngen + 1):
+        offspring = varOr(population, toolbox, lambda_, cxpb, mutpb)
+        nevals = _evaluate_invalid(offspring, toolbox)
+        if halloffame is not None:
+            halloffame.update(offspring)
+        population[:] = toolbox.select(offspring, mu)
+        _log(logbook, stats, population, gen, nevals, verbose)
+    return population, logbook
+
+
+def eaGenerateUpdate(toolbox, ngen, halloffame=None, stats=None,
+                     verbose=False):
+    """ask-tell: generate → evaluate → update (algorithms.py:440-503)."""
+    logbook = Logbook()
+    logbook.header = ["gen", "nevals"] + (stats.fields if stats else [])
+    for gen in range(ngen):
+        population = toolbox.generate()
+        fitnesses = toolbox.map(toolbox.evaluate, population)
+        for ind, fit in zip(population, fitnesses):
+            ind.fitness.values = fit
+        if halloffame is not None:
+            halloffame.update(population)
+        toolbox.update(population)
+        _log(logbook, stats, population, gen, len(population), verbose)
+    return population, logbook

@@ -1,0 +1,125 @@
+"""``Fitness`` and ``Toolbox`` for list individuals.
+
+Counterpart of /root/reference/deap/base.py. Semantics reproduced:
+
+- ``Fitness.weights`` is a class tuple; assigned values are stored as
+  ``wvalues = values * weights`` (base.py:187-198); rich comparison is
+  lexicographic on wvalues (base.py:234-250); deleting ``values``
+  invalidates (base.py:200-207); ``dominates`` is weighted Pareto
+  dominance (base.py:209-224).
+- ``Toolbox.register(alias, fn, *args, **kwargs)`` stores a partial with
+  ``__name__``/``__doc__`` copied (base.py:81-91); ``decorate`` rebuilds
+  the partial with decorators applied (base.py:100-122); defaults
+  ``clone = deepcopy`` and ``map = builtin map`` (base.py:48-50) — the
+  map alias is the distribution seam.
+"""
+
+from __future__ import annotations
+
+import copy
+from functools import partial
+from operator import mul, truediv
+from typing import Sequence, Tuple
+
+
+class Fitness:
+    """Multi-objective fitness compared in weighted space."""
+
+    weights: Tuple[float, ...] = ()
+    wvalues: Tuple[float, ...] = ()
+
+    def __init__(self, values: Sequence[float] = ()):
+        if self.weights is None:
+            raise TypeError(
+                f"Can't instantiate abstract {self.__class__.__name__} "
+                "with abstract attribute weights.")
+        if values:
+            self.values = values
+
+    def getValues(self):
+        return tuple(map(truediv, self.wvalues, self.weights))
+
+    def setValues(self, values):
+        try:
+            self.wvalues = tuple(map(mul, values, self.weights))
+        except TypeError:
+            raise TypeError(
+                f"Both weights and assigned values must be a sequence "
+                f"of numbers when assigning to values of "
+                f"{self.__class__.__name__}.")
+
+    def delValues(self):
+        self.wvalues = ()
+
+    values = property(getValues, setValues, delValues)
+
+    def dominates(self, other: "Fitness", obj: slice = slice(None)) -> bool:
+        """Weighted Pareto dominance: at least as good everywhere,
+        strictly better somewhere."""
+        not_equal = False
+        for a, b in zip(self.wvalues[obj], other.wvalues[obj]):
+            if a > b:
+                not_equal = True
+            elif a < b:
+                return False
+        return not_equal
+
+    @property
+    def valid(self) -> bool:
+        return len(self.wvalues) != 0
+
+    def __hash__(self):
+        return hash(self.wvalues)
+
+    def __le__(self, other):
+        return self.wvalues <= other.wvalues
+
+    def __lt__(self, other):
+        return self.wvalues < other.wvalues
+
+    def __eq__(self, other):
+        return self.wvalues == other.wvalues
+
+    def __ne__(self, other):
+        return not self.__eq__(other)
+
+    def __gt__(self, other):
+        return other.__lt__(self)
+
+    def __ge__(self, other):
+        return other.__le__(self)
+
+    def __deepcopy__(self, memo):
+        copy_ = self.__class__()
+        copy_.wvalues = self.wvalues
+        return copy_
+
+    def __repr__(self):
+        return (f"{self.__class__.__name__}"
+                f"({self.values if self.valid else tuple()})")
+
+
+class Toolbox:
+    """Alias registry of partially-bound callables."""
+
+    def __init__(self):
+        self.register("clone", copy.deepcopy)
+        self.register("map", map)
+
+    def register(self, alias: str, function, *args, **kwargs) -> None:
+        pfunc = partial(function, *args, **kwargs)
+        pfunc.__name__ = alias
+        pfunc.__doc__ = function.__doc__
+        if hasattr(function, "__dict__") and not isinstance(function, type):
+            pfunc.__dict__.update(function.__dict__.copy())
+        setattr(self, alias, pfunc)
+
+    def unregister(self, alias: str) -> None:
+        delattr(self, alias)
+
+    def decorate(self, alias: str, *decorators) -> None:
+        pfunc = getattr(self, alias)
+        function, args, kwargs = pfunc.func, pfunc.args, pfunc.keywords
+        for decorator in decorators:
+            function = decorator(function)
+        self.register(alias, function, *args, **kwargs)

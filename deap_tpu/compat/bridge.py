@@ -1,0 +1,65 @@
+"""The jax-backed ``toolbox.map`` — CPU individuals, TPU evaluation.
+
+The north-star integration (BASELINE.json): keep DEAP-style list
+individuals and loops, but route the fitness hot loop through one
+batched, jit-compiled device evaluation by swapping the ``map`` alias —
+exactly how the reference swaps in ``multiprocessing.Pool.map`` or
+SCOOP's ``futures.map`` (doc/tutorials/basic/part4.rst), with the device
+replacing the worker pool.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def jax_map(batched_evaluate: Callable, dtype=jnp.float32,
+            to_array: Optional[Callable] = None) -> Callable:
+    """Build a ``map``-compatible callable around a batched evaluator.
+
+    :param batched_evaluate: ``genomes [n, L] -> values [n] | [n, nobj]``
+        (pure jnp; jit-compiled here once, reused every generation).
+    :param to_array: optional ``individuals -> [n, L] array`` converter
+        for custom individual containers; default stacks sequences.
+
+    Usage::
+
+        toolbox.register("map", jax_map(batched_onemax))
+        # algorithms' toolbox.map(toolbox.evaluate, invalid) now runs
+        # ONE device program; the per-individual evaluate is bypassed.
+
+    Returns a list of per-individual fitness tuples, so
+    ``ind.fitness.values = fit`` works unchanged.
+    """
+    compiled = jax.jit(batched_evaluate)
+
+    def convert(individuals):
+        if to_array is not None:
+            return to_array(individuals)
+        return jnp.asarray(np.asarray([list(ind) for ind in individuals]),
+                           dtype=dtype)
+
+    def map_(fn, individuals, *rest):
+        del fn  # the batched evaluator replaces the scalar one
+        individuals = list(individuals)
+        if not individuals:
+            return []
+        arr = convert(individuals)
+        n = arr.shape[0]
+        # pad the batch to a power of two: evolutionary loops produce a
+        # different invalid-count every generation, and each distinct n
+        # would otherwise trigger a fresh XLA compile
+        padded = 1 << max(n - 1, 1).bit_length()
+        if padded != n:
+            fill = jnp.zeros((padded - n,) + arr.shape[1:], arr.dtype)
+            arr = jnp.concatenate([arr, fill])
+        values = np.asarray(compiled(arr))[:n]
+        if values.ndim == 1:
+            values = values[:, None]
+        return [tuple(row) for row in values]
+
+    return map_

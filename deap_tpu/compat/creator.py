@@ -1,0 +1,86 @@
+"""Runtime type factory — the ``creator.create`` semantics.
+
+Counterpart of /root/reference/deap/creator.py:96-171: ``create(name,
+base, **attrs)`` manufactures a subclass of any container in this
+module's namespace; class-valued kwargs become *per-instance* attributes
+instantiated at construction time, plain values become class attributes.
+numpy arrays get ``__deepcopy__``/``__reduce__`` fixes so clone and
+pickle behave like values (creator.py:51-93).
+"""
+
+from __future__ import annotations
+
+import copy
+import warnings
+
+import numpy
+
+
+class _NumpyMixin:
+    """Deepcopy/pickle fixes for ndarray subclasses (creator.py:51-73)."""
+
+    @staticmethod
+    def _numpy_new(cls, iterable=()):
+        return numpy.asarray(iterable).view(cls)
+
+    def __deepcopy__(self, memo):
+        copy_ = numpy.copy(self).view(type(self))
+        copy_.__dict__.update(copy.deepcopy(self.__dict__, memo))
+        return copy_
+
+    def __reduce__(self):
+        return (type(self), (list(self),), self.__dict__)
+
+
+def create(name: str, base: type, **kwargs) -> type:
+    """Create class ``name`` deriving from ``base`` in this module.
+
+    ``create("Individual", list, fitness=FitnessMin)`` builds a list
+    subclass whose instances carry a fresh ``fitness`` object; plain
+    values (``speed=None``) become shared class attributes.
+    """
+    if name in globals():
+        warnings.warn(
+            f"A class named '{name}' has already been created and it "
+            "will be overwritten. Consider deleting previous creation "
+            "of that class or rename it.", RuntimeWarning)
+
+    instance_attrs = {}
+    class_attrs = {}
+    for key, value in kwargs.items():
+        if isinstance(value, type):
+            instance_attrs[key] = value
+        else:
+            class_attrs[key] = value
+
+    if issubclass(base, numpy.ndarray):
+        def __new__(cls, iterable=()):
+            return _NumpyMixin._numpy_new(cls, iterable)
+
+        def __init__(self, iterable=()):
+            for attr, klass in instance_attrs.items():
+                setattr(self, attr, klass())
+
+        body = dict(class_attrs)
+        body["__new__"] = __new__
+        body["__init__"] = __init__
+        body["__deepcopy__"] = _NumpyMixin.__deepcopy__
+        body["__reduce__"] = _NumpyMixin.__reduce__
+        cls = type(name, (base,), body)
+    else:
+        def __init__(self, *args, **kw):
+            base.__init__(self, *args, **kw)
+            for attr, klass in instance_attrs.items():
+                setattr(self, attr, klass())
+
+        # default pickling handles list/dict/set subclasses correctly
+        # (listitems/dictitems + __dict__ state); only ndarray needs the
+        # explicit __reduce__ fix above, matching the reference's scope
+        # (creator.py:51-93 patches only ndarray and array.array)
+        body = dict(class_attrs)
+        body["__init__"] = __init__
+        cls = type(name, (base,), body)
+
+    cls.__module__ = __name__
+    globals()[name] = cls
+    return cls

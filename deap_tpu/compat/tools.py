@@ -1,0 +1,224 @@
+"""List operators and support objects for the CPU backend.
+
+A representative set of the reference's ``deap/tools`` surface working on
+plain Python sequences (the full batched library lives in
+``deap_tpu.ops``/``mo``; this module exists for arbitrary-object
+individuals the tensor path cannot host). Behavior follows the
+reference's documented semantics; randomness uses the stdlib ``random``
+module like the reference, seedable with ``random.seed``.
+"""
+
+from __future__ import annotations
+
+import random
+from bisect import bisect_right
+from copy import deepcopy
+from operator import attrgetter
+
+
+# ---------------------------------------------------------------- init ----
+
+def initRepeat(container, func, n):
+    """container(func() for _ in range(n)) (init.py:3-25)."""
+    return container(func() for _ in range(n))
+
+
+def initIterate(container, generator):
+    """container(generator()) (init.py:27-52)."""
+    return container(generator())
+
+
+def initCycle(container, seq_of_funcs, n=1):
+    """container(f() for each func, cycled n times) (init.py:54-75)."""
+    return container(f() for _ in range(n) for f in seq_of_funcs)
+
+
+# ------------------------------------------------------------ crossover ----
+
+def cxOnePoint(ind1, ind2):
+    size = min(len(ind1), len(ind2))
+    cx = random.randint(1, size - 1)
+    ind1[cx:], ind2[cx:] = ind2[cx:], ind1[cx:]
+    return ind1, ind2
+
+
+def cxTwoPoint(ind1, ind2):
+    size = min(len(ind1), len(ind2))
+    a = random.randint(1, size)
+    b = random.randint(1, size - 1)
+    if b >= a:
+        b += 1
+    else:
+        a, b = b, a
+    ind1[a:b], ind2[a:b] = ind2[a:b], ind1[a:b]
+    return ind1, ind2
+
+
+def cxUniform(ind1, ind2, indpb):
+    for i in range(min(len(ind1), len(ind2))):
+        if random.random() < indpb:
+            ind1[i], ind2[i] = ind2[i], ind1[i]
+    return ind1, ind2
+
+
+def cxBlend(ind1, ind2, alpha):
+    for i, (x1, x2) in enumerate(zip(ind1, ind2)):
+        gamma = (1.0 + 2.0 * alpha) * random.random() - alpha
+        ind1[i] = (1.0 - gamma) * x1 + gamma * x2
+        ind2[i] = gamma * x1 + (1.0 - gamma) * x2
+    return ind1, ind2
+
+
+# ------------------------------------------------------------- mutation ----
+
+def mutGaussian(individual, mu, sigma, indpb):
+    for i in range(len(individual)):
+        if random.random() < indpb:
+            individual[i] += random.gauss(mu, sigma)
+    return (individual,)
+
+
+def mutFlipBit(individual, indpb):
+    for i in range(len(individual)):
+        if random.random() < indpb:
+            individual[i] = type(individual[i])(not individual[i])
+    return (individual,)
+
+
+def mutShuffleIndexes(individual, indpb):
+    size = len(individual)
+    for i in range(size):
+        if random.random() < indpb:
+            j = random.randint(0, size - 2)
+            if j >= i:
+                j += 1
+            individual[i], individual[j] = individual[j], individual[i]
+    return (individual,)
+
+
+def mutUniformInt(individual, low, up, indpb):
+    for i in range(len(individual)):
+        if random.random() < indpb:
+            individual[i] = random.randint(low, up)
+    return (individual,)
+
+
+# ------------------------------------------------------------ selection ----
+
+def selRandom(individuals, k):
+    return [random.choice(individuals) for _ in range(k)]
+
+
+def selBest(individuals, k, fit_attr="fitness"):
+    return sorted(individuals, key=attrgetter(fit_attr), reverse=True)[:k]
+
+
+def selWorst(individuals, k, fit_attr="fitness"):
+    return sorted(individuals, key=attrgetter(fit_attr))[:k]
+
+
+def selTournament(individuals, k, tournsize, fit_attr="fitness"):
+    chosen = []
+    for _ in range(k):
+        aspirants = selRandom(individuals, tournsize)
+        chosen.append(max(aspirants, key=attrgetter(fit_attr)))
+    return chosen
+
+
+def selRoulette(individuals, k, fit_attr="fitness"):
+    s_inds = sorted(individuals, key=attrgetter(fit_attr), reverse=True)
+    fits = [getattr(ind, fit_attr).values[0] for ind in s_inds]
+    total = sum(fits)
+    cums = []
+    acc = 0.0
+    for f in fits:
+        acc += f
+        cums.append(acc)
+    chosen = []
+    for _ in range(k):
+        u = random.random() * total
+        chosen.append(s_inds[min(bisect_right(cums, u), len(s_inds) - 1)])
+    return chosen
+
+
+# -------------------------------------------------------------- support ----
+
+class Statistics:
+    """key extractor + registered reducers (support.py:154-210)."""
+
+    def __init__(self, key=lambda obj: obj):
+        self.key = key
+        self.functions = {}
+        self.fields = []
+
+    def register(self, name, function, *args, **kwargs):
+        self.functions[name] = lambda data: function(data, *args, **kwargs)
+        self.fields.append(name)
+
+    def compile(self, data):
+        values = tuple(self.key(elem) for elem in data)
+        return {name: fn(values) for name, fn in self.functions.items()}
+
+
+class MultiStatistics(dict):
+    """Named Statistics compiled together (support.py:212-259)."""
+
+    @property
+    def fields(self):
+        return sorted(self.keys())
+
+    def register(self, name, function, *args, **kwargs):
+        for stats in self.values():
+            stats.register(name, function, *args, **kwargs)
+
+    def compile(self, data):
+        return {key: stats.compile(data) for key, stats in self.items()}
+
+
+class HallOfFame:
+    """Bounded best-ever archive with similarity dedup
+    (support.py:490-588)."""
+
+    def __init__(self, maxsize, similar=lambda a, b: a == b):
+        self.maxsize = maxsize
+        self.similar = similar
+        self.items = []
+
+    def update(self, population):
+        for ind in population:
+            if len(self.items) == 0 and self.maxsize != 0:
+                self.insert(population[0])
+                continue
+            if ind.fitness > self.items[-1].fitness \
+                    or len(self.items) < self.maxsize:
+                if not any(self.similar(ind, h) for h in self.items):
+                    if len(self.items) >= self.maxsize:
+                        self.remove(-1)
+                    self.insert(ind)
+
+    def insert(self, item):
+        item = deepcopy(item)
+        # full lexicographic order on weighted values, best first —
+        # negated tuples ascending == wvalues descending
+        keys = [tuple(-w for w in h.fitness.wvalues) for h in self.items]
+        i = bisect_right(keys, tuple(-w for w in item.fitness.wvalues))
+        self.items.insert(i, item)
+
+    def remove(self, index):
+        del self.items[index]
+
+    def clear(self):
+        del self.items[:]
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, i):
+        return self.items[i]
+
+    def __iter__(self):
+        return iter(self.items)
+
+
+# the tensor Logbook is already a plain list-of-dicts structure — shared
+from deap_tpu.support.logbook import Logbook  # noqa: E402,F401

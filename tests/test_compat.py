@@ -1,0 +1,170 @@
+"""CPU/list backend tests — the reference's own test semantics
+(test_creator.py slice-swap, Fitness compare, test_pickle.py round
+trips) plus the jax_map bridge (list individuals, one device
+evaluation)."""
+
+import pickle
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deap_tpu.compat import algorithms, base, creator, jax_map, tools
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    random.seed(64)
+
+
+@pytest.fixture(scope="module")
+def types():
+    creator.create("FitnessMax", base.Fitness, weights=(1.0,))
+    creator.create("FitnessMulti", base.Fitness, weights=(1.0, -1.0))
+    creator.create("Individual", list, fitness=creator.FitnessMax)
+    return creator
+
+
+def test_creator_list_individual(types):
+    ind = creator.Individual([1, 0, 1])
+    assert list(ind) == [1, 0, 1]
+    assert not ind.fitness.valid
+    ind.fitness.values = (2.0,)
+    assert ind.fitness.valid and ind.fitness.values == (2.0,)
+    del ind.fitness.values
+    assert not ind.fitness.valid
+
+
+def test_creator_slice_swap(types):
+    """The slice-swap semantics test_creator.py:33-60 checks."""
+    a = creator.Individual([1, 2, 3, 4])
+    b = creator.Individual([5, 6, 7, 8])
+    a[1:3], b[1:3] = b[1:3], a[1:3]
+    assert list(a) == [1, 6, 7, 4]
+    assert list(b) == [5, 2, 3, 8]
+
+
+def test_creator_numpy_deepcopy_no_aliasing(types):
+    import copy
+
+    creator.create("NpInd", np.ndarray, fitness=creator.FitnessMax)
+    x = creator.NpInd([1.0, 2.0, 3.0])
+    y = copy.deepcopy(x)
+    y[0] = 99.0
+    assert x[0] == 1.0   # the ndarray deepcopy fix (creator.py:51-73)
+
+
+def test_fitness_weighted_compare(types):
+    f1 = creator.FitnessMulti((2.0, 1.0))   # w = (2, -1)
+    f2 = creator.FitnessMulti((1.0, 2.0))   # w = (1, -2)
+    assert f1 > f2
+    assert f1.dominates(f2)
+    assert not f2.dominates(f1)
+    f3 = creator.FitnessMulti((2.0, 0.5))
+    assert f3.dominates(f1)
+
+
+def test_pickle_roundtrip(types):
+    """Picklability is the reference's distribution invariant
+    (test_pickle.py:38-154)."""
+    ind = creator.Individual([0, 1, 1, 0])
+    ind.fitness.values = (2.0,)
+    clone = pickle.loads(pickle.dumps(ind))
+    assert list(clone) == list(ind)
+    assert clone.fitness.values == ind.fitness.values
+    pop = [creator.Individual([i]) for i in range(4)]
+    assert [list(i) for i in pickle.loads(pickle.dumps(pop))] == [
+        [0], [1], [2], [3]]
+
+
+def test_toolbox_register_decorate(types):
+    tb = base.Toolbox()
+    tb.register("inc", lambda x, d: x + d, d=5)
+    assert tb.inc(1) == 6
+
+    def double_out(fn):
+        def wrapped(*a, **k):
+            return 2 * fn(*a, **k)
+        return wrapped
+
+    tb.decorate("inc", double_out)
+    assert tb.inc(1) == 12
+    tb.unregister("inc")
+    assert not hasattr(tb, "inc")
+
+
+def test_easimple_onemax_cpu(types):
+    tb = base.Toolbox()
+    tb.register("attr", random.randint, 0, 1)
+    tb.register("individual", tools.initRepeat, creator.Individual,
+                tb.attr, 30)
+    tb.register("population", tools.initRepeat, list, tb.individual)
+    tb.register("evaluate", lambda ind: (float(sum(ind)),))
+    tb.register("mate", tools.cxTwoPoint)
+    tb.register("mutate", tools.mutFlipBit, indpb=0.05)
+    tb.register("select", tools.selTournament, tournsize=3)
+
+    pop = tb.population(n=50)
+    hof = tools.HallOfFame(1)
+    stats = tools.Statistics(lambda ind: ind.fitness.values)
+    stats.register("max", np.max)
+    pop, logbook = algorithms.eaSimple(pop, tb, 0.5, 0.2, 20,
+                                       stats=stats, halloffame=hof)
+    assert hof[0].fitness.values[0] >= 25.0
+    assert logbook[0]["gen"] == 0 and logbook[-1]["gen"] == 20
+
+
+def test_jax_map_bridge(types):
+    """List individuals, device evaluation: the jax-backed map must
+    produce the same fitnesses as the serial map and count as the only
+    evaluation path."""
+    tb = base.Toolbox()
+    tb.register("evaluate", lambda ind: (float(sum(ind)),))
+    tb.register("map", jax_map(
+        lambda g: g.sum(-1).astype(jnp.float32)))
+
+    pop = [creator.Individual([random.randint(0, 1) for _ in range(16)])
+           for _ in range(32)]
+    fits = tb.map(tb.evaluate, pop)
+    assert fits == [(float(sum(ind)),) for ind in pop]
+    assert tb.map(tb.evaluate, []) == []
+
+
+def test_easimple_with_jax_map(types):
+    """Full eaSimple over list individuals with the device evaluating."""
+    tb = base.Toolbox()
+    tb.register("attr", random.randint, 0, 1)
+    tb.register("individual", tools.initRepeat, creator.Individual,
+                tb.attr, 30)
+    tb.register("population", tools.initRepeat, list, tb.individual)
+    tb.register("evaluate", lambda ind: (_ for _ in ()).throw(
+        AssertionError("scalar evaluate must be bypassed")))
+    tb.register("mate", tools.cxTwoPoint)
+    tb.register("mutate", tools.mutFlipBit, indpb=0.05)
+    tb.register("select", tools.selTournament, tournsize=3)
+    tb.register("map", jax_map(lambda g: g.sum(-1).astype(jnp.float32)))
+
+    pop = tb.population(n=50)
+    pop, logbook = algorithms.eaSimple(pop, tb, 0.5, 0.2, 15)
+    best = max(ind.fitness.values[0] for ind in pop)
+    assert best >= 24.0
+
+
+def test_multistatistics_and_varor(types):
+    tb = base.Toolbox()
+    tb.register("evaluate", lambda ind: (float(sum(ind)),))
+    tb.register("mate", tools.cxOnePoint)
+    tb.register("mutate", tools.mutFlipBit, indpb=0.1)
+    tb.register("select", tools.selBest)
+
+    pop = [creator.Individual([random.randint(0, 1) for _ in range(10)])
+           for _ in range(20)]
+    stats = tools.MultiStatistics(
+        fitness=tools.Statistics(lambda ind: ind.fitness.values),
+        size=tools.Statistics(len))
+    stats.register("avg", np.mean)
+    pop, logbook = algorithms.eaMuPlusLambda(
+        pop, tb, mu=20, lambda_=40, cxpb=0.4, mutpb=0.4, ngen=5,
+        stats=stats)
+    assert "fitness" in logbook.chapters and "size" in logbook.chapters
