@@ -32,7 +32,7 @@ from deap_tpu.gp.tree import (
     subtree_end,
     tree_height,
 )
-from deap_tpu.gp.string import to_string
+from deap_tpu.gp.string import from_string, to_graph, to_string
 from deap_tpu.gp.typed import (
     PrimitiveSetTyped,
     make_cx_one_point_typed,
@@ -100,6 +100,8 @@ __all__ = [
     "subtree_end",
     "tree_height",
     "to_string",
+    "to_graph",
+    "from_string",
 ]
 
 # DEAP-style aliases

@@ -40,6 +40,38 @@ def to_string(genome, pset: PrimitiveSet) -> str:
     return s
 
 
+def to_graph(genome, pset: PrimitiveSet):
+    """``(nodes, edges, labels)`` for graph libraries — counterpart of
+    the reference's ``gp.graph`` (/root/reference/deap/gp.py:1138-1208):
+    node ids are prefix positions, ``edges`` are (parent, child) pairs,
+    ``labels`` maps id → primitive/terminal name. Feed directly to
+    ``networkx.Graph`` / pygraphviz exactly as the reference documents.
+    """
+    nodes_arr = np.asarray(genome["nodes"])
+    consts = np.asarray(genome["consts"])
+    length = int(genome["length"])
+    arity = np.asarray(pset.arity_table())
+
+    nodes = list(range(length))
+    labels = {i: pset.node_name(int(nodes_arr[i]), consts[i])
+              for i in range(length)}
+    edges = []
+    # prefix walk: a stack of (parent, remaining-children) mirrors the
+    # reference's edge construction (gp.py:1199-1206)
+    stack: list = []
+    for i in range(length):
+        if stack:
+            parent = stack[-1][0]
+            edges.append((parent, i))
+            stack[-1][1] -= 1
+            if stack[-1][1] == 0:
+                stack.pop()
+        a = int(arity[int(nodes_arr[i])])
+        if a > 0:
+            stack.append([i, a])
+    return nodes, edges, labels
+
+
 def from_string(expr: str, pset: PrimitiveSet, max_len: int):
     """Parse ``name(arg, ...)`` prefix syntax into a genome dict
     (gp.py:106-153). Tokens must name primitives, arguments, fixed

@@ -52,6 +52,11 @@ from deap_tpu.ops.mutation import (
     mut_uniform_int,
     strategy_floor,
 )
+from deap_tpu.ops.kernels import (
+    dominated_counts,
+    fused_variation_eval,
+    nd_rank_tiled,
+)
 from deap_tpu.ops.selection import (
     sel_automatic_epsilon_lexicase,
     sel_best,

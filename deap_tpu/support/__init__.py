@@ -9,6 +9,7 @@ from deap_tpu.support.history import (
     lineage_step,
     pair_parents,
 )
+from deap_tpu.support.profiling import annotate, sync, timed_generations, trace
 from deap_tpu.support.checkpoint import (
     Checkpointer,
     restore_state,
@@ -28,6 +29,10 @@ __all__ = [
     "pareto_update",
     "History",
     "Lineage",
+    "trace",
+    "annotate",
+    "sync",
+    "timed_generations",
     "lineage_init",
     "lineage_step",
     "pair_parents",

@@ -1,0 +1,83 @@
+"""Profiling / tracing hooks.
+
+The reference has no profiler; its closest artifacts are the per-
+generation ``nevals`` column (deap/algorithms.py:158,185) and the
+historical ``examples/speed.txt`` timing harness. The TPU-native
+equivalent (SURVEY.md §5.1) is the JAX profiler: xplane traces viewable
+in TensorBoard/XProf, plus named-scope annotation of the evolutionary
+phases so selection / variation / evaluation show up as labelled spans
+on the device timeline.
+
+Usage::
+
+    from deap_tpu.support.profiling import trace, annotate, timed_generations
+
+    with trace("/tmp/ea-trace"):          # whole-run xplane capture
+        pop, logbook, hof = algorithms.ea_simple(...)
+
+    @annotate("variation")                # label a phase inside jit
+    def my_mate(key, g1, g2): ...
+
+    for gen, state, dt in timed_generations(run_one_gen, pop, ngen=100):
+        ...                               # host-side per-gen wall times
+
+All three are thin, dependency-free wrappers: profiling must never
+change the compiled program (annotations are metadata-only).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import time
+from typing import Any, Callable, Iterator, Tuple
+
+import jax
+
+__all__ = ["trace", "annotate", "timed_generations", "sync"]
+
+
+def trace(log_dir: str, **kwargs):
+    """Capture an xplane trace of everything run inside the context
+    (``jax.profiler.trace``); open ``log_dir`` with TensorBoard's
+    profile plugin / XProf. The TPU-native replacement for the
+    reference's external timing harness."""
+    return jax.profiler.trace(log_dir, **kwargs)
+
+
+def annotate(name: str) -> Callable:
+    """Decorator: wrap a function in a named trace span
+    (``jax.profiler.TraceAnnotation`` on host, ``jax.named_scope`` for
+    device code) so it appears as a labelled region in profiles."""
+    def deco(fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
+                return fn(*args, **kwargs)
+        return wrapped
+    return deco
+
+
+def sync(tree: Any) -> Any:
+    """Block until ``tree``'s arrays have materialised. On remote-
+    attached TPU runtimes ``jax.block_until_ready`` can return before
+    device execution finishes, so this additionally fetches one scalar
+    from the first array — cheap, and an actual completion barrier."""
+    jax.block_until_ready(tree)
+    leaves = jax.tree_util.tree_leaves(tree)
+    if leaves:
+        jax.device_get(jax.numpy.ravel(leaves[0])[:1])
+    return tree
+
+
+def timed_generations(step: Callable, state: Any, ngen: int,
+                      *step_args: Any) -> Iterator[Tuple[int, Any, float]]:
+    """Host-driven generation loop with honest per-generation wall
+    times: yields ``(gen, state, seconds)``. For profiling only — the
+    production path is one ``lax.scan`` with no host round trips; this
+    trades that fusion for visibility (the analog of reading the
+    reference's per-generation logbook timings)."""
+    for gen in range(ngen):
+        t0 = time.perf_counter()
+        state = sync(step(state, *step_args))
+        yield gen, state, time.perf_counter() - t0

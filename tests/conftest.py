@@ -19,5 +19,20 @@ if "xla_force_host_platform_device_count" not in flags:
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Drop compiled executables between test modules.
+
+    A full-suite run accumulates hundreds of CPU XLA executables in one
+    process; past a threshold that has produced segfaults during
+    *tracing* of later complex programs (observed in the multiswarm
+    change-recovery test). Clearing per module keeps peak state bounded
+    at the cost of a few re-traces within the suite.
+    """
+    yield
+    jax.clear_caches()

@@ -169,3 +169,24 @@ def test_symbreg_quartic_converges(pset):
         halloffame_size=1)
     best_mse = float(-hof.fitness[0, 0])
     assert best_mse < 0.05
+
+
+def test_to_graph_structure(pset):
+    # mul(add(ARG0, 1.0), ARG0): edges root->add, root->ARG0, add->leaves
+    from deap_tpu.gp.string import from_string, to_graph
+
+    genome = from_string("mul(add(ARG0, 1.0), ARG0)", pset, MAX_LEN)
+    nodes, edges, labels = to_graph(genome, pset)
+    assert nodes == [0, 1, 2, 3, 4]
+    assert set(edges) == {(0, 1), (0, 4), (1, 2), (1, 3)}
+    assert labels[0] == "mul" and labels[1] == "add"
+    assert labels[2] == "ARG0" and labels[4] == "ARG0"
+    assert "1.0" in labels[3]
+
+
+def test_to_graph_single_terminal(pset):
+    from deap_tpu.gp.string import from_string, to_graph
+
+    genome = from_string("ARG0", pset, MAX_LEN)
+    nodes, edges, labels = to_graph(genome, pset)
+    assert nodes == [0] and edges == [] and labels[0] == "ARG0"

@@ -1,0 +1,130 @@
+"""Pallas kernel tests (interpreter on the CPU test platform).
+
+The hardware-PRNG path of fused_variation_eval exists only on real TPU
+cores and is exercised by bench.py / the TPU smoke script; everything
+else — tiling, masking, pairing, the two-point/flip-bit semantics, and
+dominance counting — is validated here against the XLA formulations.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deap_tpu.core.fitness import dominates
+from deap_tpu.mo.emo import nd_rank
+from deap_tpu.ops.kernels import (
+    dominated_counts,
+    fused_variation_eval,
+    nd_rank_tiled,
+)
+
+
+# ---------------------------------------------------- dominance counting ----
+
+@pytest.mark.parametrize("n,m", [(37, 2), (300, 3), (513, 4)])
+def test_dominated_counts_matches_matrix(n, m):
+    w = jax.random.normal(jax.random.key(n), (n, m))
+    # duplicate some rows to exercise the equal-fitness (no-domination) case
+    w = w.at[: n // 4].set(w[n // 4 : 2 * (n // 4)])
+    rem = jax.random.bernoulli(jax.random.key(1), 0.7, (n,))
+    got = dominated_counts(w, rem, block_i=128, block_j=128)
+    dom = dominates(w[None, :, :], w[:, None, :])  # [i, j]: j dominates i
+    want = (dom & rem[None, :]).sum(1)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_nd_rank_tiled_matches_matrix_path():
+    w = jax.random.normal(jax.random.key(7), (257, 3))
+    np.testing.assert_array_equal(
+        np.asarray(nd_rank_tiled(w, block_i=128, block_j=128)),
+        np.asarray(nd_rank(w)))
+
+
+def test_nd_rank_tiled_known_fronts():
+    # three hand-made fronts on a 2-objective max problem
+    f0 = jnp.array([[3.0, 0.0], [2.0, 2.0], [0.0, 3.0]])
+    f1 = jnp.array([[2.0, 0.0], [1.0, 1.0], [0.0, 2.0]])
+    f2 = jnp.array([[0.5, 0.5]])
+    w = jnp.concatenate([f1, f2, f0])  # shuffled order
+    ranks = nd_rank_tiled(w, block_i=128, block_j=128)
+    np.testing.assert_array_equal(
+        np.asarray(ranks), [1, 1, 1, 2, 0, 0, 0])
+
+
+# ------------------------------------------------------- fused variation ----
+
+def _fused(key, g, cxpb, mutpb, indpb):
+    return fused_variation_eval(
+        key, g, cxpb=cxpb, mutpb=mutpb, indpb=indpb, prng="input",
+        block_i=64)
+
+
+def test_fused_identity_and_fitness():
+    g = jax.random.bernoulli(jax.random.key(5), 0.5, (130, 100))
+    c, f = _fused(jax.random.key(0), g, 0.0, 0.0, 0.05)
+    assert bool((c == g).all())
+    np.testing.assert_allclose(np.asarray(f), np.asarray(g.sum(1)))
+
+
+def test_fused_crossover_is_two_point_segment_swap():
+    g = jax.random.bernoulli(jax.random.key(6), 0.5, (128, 100))
+    c, f = _fused(jax.random.key(1), g, 1.0, 0.0, 0.0)
+    g_np, c_np = np.asarray(g), np.asarray(c)
+    some_swap = False
+    for p in range(64):
+        a, b = g_np[2 * p], g_np[2 * p + 1]
+        ca, cb = c_np[2 * p], c_np[2 * p + 1]
+        d = ca != a  # columns taken from the partner
+        assert (np.where(d, b, a) == ca).all()
+        assert (np.where(d, a, b) == cb).all()
+        # the swapped region is one contiguous segment of differing genes
+        diff_cols = np.flatnonzero((a != b) & d)
+        if diff_cols.size:
+            some_swap = True
+            lo, hi = diff_cols[0], diff_cols[-1]
+            inside = (a != b)[lo : hi + 1]
+            assert (d[lo : hi + 1] == inside).all()
+    assert some_swap
+    np.testing.assert_allclose(np.asarray(f), c_np.sum(1))
+
+
+def test_fused_full_flip():
+    g = jax.random.bernoulli(jax.random.key(8), 0.5, (64, 100))
+    c, _ = _fused(jax.random.key(2), g, 0.0, 1.0, 1.0)
+    assert bool((c == ~g).all())
+
+
+def test_fused_flip_rate():
+    g = jnp.zeros((2048, 128), jnp.bool_)
+    c, _ = _fused(jax.random.key(3), g, 0.0, 1.0, 0.05)
+    rate = float(c.mean())
+    assert 0.04 < rate < 0.06
+
+
+def test_fused_odd_last_row_never_mates():
+    g = jax.random.bernoulli(jax.random.key(9), 0.5, (129, 100))
+    c, _ = _fused(jax.random.key(4), g, 1.0, 0.0, 0.0)
+    assert bool((c[128] == g[128]).all())
+
+
+def test_fused_uint8_genomes_and_padding_tail():
+    # non-multiple population size and integer storage
+    g = jax.random.bernoulli(jax.random.key(10), 0.5, (70, 33)).astype(
+        jnp.uint8)
+    c, f = _fused(jax.random.key(5), g, 0.6, 0.3, 0.1)
+    assert c.shape == g.shape and c.dtype == g.dtype
+    assert set(np.unique(np.asarray(c))) <= {0, 1}
+    np.testing.assert_allclose(np.asarray(f),
+                               np.asarray(c.astype(jnp.float32).sum(1)))
+
+
+def test_dominated_counts_non_dividing_blocks():
+    # block sizes that do not divide each other must still cover all
+    # dominator columns (pad-to-lcm regression test)
+    n = 512
+    w = jax.random.normal(jax.random.key(11), (n, 3))
+    rem = jnp.ones(n, bool)
+    got = dominated_counts(w, rem, block_i=512, block_j=384)
+    dom = dominates(w[None, :, :], w[:, None, :])
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(dom.sum(1)))

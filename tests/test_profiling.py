@@ -1,0 +1,32 @@
+"""Profiler hooks (support.profiling): annotation transparency, sync
+barrier, and host-timed generation loop (SURVEY.md §5.1 parity)."""
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu.support.profiling import annotate, sync, timed_generations
+
+
+def test_annotate_is_transparent():
+    @annotate("variation")
+    def f(x):
+        return x * 2.0
+
+    assert float(f(jnp.float32(3.0))) == 6.0
+    assert float(jax.jit(f)(jnp.float32(3.0))) == 6.0
+
+
+def test_sync_returns_tree():
+    tree = {"a": jnp.arange(4), "b": (jnp.ones(2),)}
+    out = sync(tree)
+    assert out is tree
+
+
+def test_timed_generations_progresses_state():
+    def step(x):
+        return x + 1
+
+    states = list(timed_generations(step, jnp.int32(0), ngen=3))
+    assert [g for g, _, _ in states] == [0, 1, 2]
+    assert int(states[-1][1]) == 3
+    assert all(dt >= 0 for _, _, dt in states)
