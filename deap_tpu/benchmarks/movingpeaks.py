@@ -144,6 +144,15 @@ def global_maximum(cfg: MovingPeaksConfig, state: MovingPeaksState):
     return jnp.max(vals)
 
 
+def maximums(cfg: MovingPeaksConfig, state: MovingPeaksState):
+    """Per-peak ``(value, position)`` of the landscape at each peak
+    centre (movingpeaks.py:185-193's `maximums` property) — values
+    include basin/other-peak interference, hence landscape-evaluated
+    rather than read off ``state.height``."""
+    vals = jax.vmap(lambda p: _landscape(cfg, state, p))(state.position)
+    return vals, state.position
+
+
 def _bounce(new, old, delta, lo, hi):
     below = new < lo
     above = new > hi

@@ -67,6 +67,7 @@ from deap_tpu.ops.selection import (
     sel_roulette,
     sel_stochastic_universal_sampling,
     sel_tournament,
+    sel_tournament_sorted,
     sel_worst,
 )
 
@@ -95,6 +96,7 @@ selRandom = sel_random
 selBest = sel_best
 selWorst = sel_worst
 selTournament = sel_tournament
+selTournamentSorted = sel_tournament_sorted
 selRoulette = sel_roulette
 selDoubleTournament = sel_double_tournament
 selStochasticUniversalSampling = sel_stochastic_universal_sampling

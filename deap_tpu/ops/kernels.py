@@ -269,6 +269,12 @@ def fused_variation_eval(key: jax.Array, genomes: jnp.ndarray, *,
     interp = _auto_interpret(interpret)
     if prng == "auto":
         prng = "input" if interp else "hw"
+    elif prng == "hw" and interp:
+        # the interpreter stubs prng_random_bits to zeros — the GA would
+        # silently degenerate (fixed crossover points, all genes flipped)
+        raise ValueError(
+            "prng='hw' needs a real TPU core; use prng='input' (or "
+            "'auto') under the Pallas interpreter")
     g = jnp.pad(genomes, ((0, ni - n), (0, Lp - L)))
 
     common = dict(n=n, L=L, cxpb=cxpb, mutpb=mutpb, indpb=indpb)

@@ -67,6 +67,25 @@ def sel_tournament(key, w, k, tournsize):
     return _tournament_winners(w, aspirants)
 
 
+def sel_tournament_sorted(key, w, k, tournsize):
+    """Tournament selection via ranks — same winner distribution as
+    :func:`sel_tournament`, one lexsort instead of ``tournsize``
+    per-aspirant fitness gathers.
+
+    A tournament's winner is the lexicographically best of ``tournsize``
+    uniform draws; with ``order`` the best-first sort of the population,
+    that is exactly ``order[min(tournsize uniform ranks)]``. Identical
+    in distribution for distinct fitness values; ties are broken by
+    population index (stable sort) rather than by draw order as in the
+    reference's Python ``max`` (selection.py:51-69) — both are
+    fitness-indistinguishable. Preferable on large populations where the
+    aspirant gathers dominate the generation step.
+    """
+    order = lex_sort_desc(w)
+    ranks = jax.random.randint(key, (tournsize, k), 0, w.shape[0])
+    return jnp.take(order, jnp.min(ranks, axis=0))
+
+
 def sel_roulette(key, w, k, values: Optional[jnp.ndarray] = None):
     """Fitness-proportionate selection on the first objective
     (selection.py:71-103): individuals sorted best-first, k spins over the

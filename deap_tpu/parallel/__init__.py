@@ -1,6 +1,13 @@
 from deap_tpu.parallel.mesh import population_mesh, shard_population
 from deap_tpu.parallel.migration import mig_ring, migRing
 from deap_tpu.parallel.island import IslandState, island_init, make_island_step
+from deap_tpu.parallel.multihost import (
+    global_population_mesh,
+    initialize,
+    is_distributed,
+    process_count,
+    process_index,
+)
 from deap_tpu.parallel.genome_shard import (
     genome_mesh,
     make_sharded_evaluator,
@@ -8,6 +15,11 @@ from deap_tpu.parallel.genome_shard import (
 )
 
 __all__ = [
+    "initialize",
+    "is_distributed",
+    "global_population_mesh",
+    "process_count",
+    "process_index",
     "population_mesh",
     "shard_population",
     "mig_ring",

@@ -1,0 +1,97 @@
+"""Multi-host distribution — the SCOOP/network-futures analog.
+
+The reference scales past one machine by registering SCOOP's network
+``futures.map`` as ``toolbox.map`` (examples/ga/onemax_island_scoop.py:28,
+doc/tutorials/basic/part4.rst:14-44; SURVEY.md §2.3 P3, §5.8). Payloads
+are pickles over TCP and the programming model is master/worker.
+
+The TPU-native replacement is SPMD over DCN: every host runs the *same*
+compiled program, `jax.distributed` forms the runtime mesh, and XLA
+inserts cross-host collectives wherever the sharded program needs them
+— there is no master, no pickling, and population state never funnels
+through one process. Concretely, the single-host examples scale out by
+calling :func:`initialize` first and building meshes over
+``jax.devices()`` (global) instead of ``jax.local_devices()``; nothing
+else changes, which is this module's whole point.
+
+Run one process per host, e.g.::
+
+    # host 0                                 # host 1
+    initialize("10.0.0.1:8476", 2, 0)        initialize("10.0.0.1:8476", 2, 1)
+    mesh = global_population_mesh()          mesh = global_population_mesh()
+    ... identical program on both hosts ...
+
+On TPU pods the coordinator/process arguments are discovered from the
+environment and may be omitted entirely.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+
+from deap_tpu.parallel.mesh import population_mesh
+
+__all__ = [
+    "initialize",
+    "is_distributed",
+    "global_population_mesh",
+    "process_count",
+    "process_index",
+]
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None,
+               **kwargs) -> None:
+    """Join (or form) the multi-host runtime.
+
+    Thin wrapper over ``jax.distributed.initialize`` that is safe to
+    call unconditionally: a single-process run (all arguments None and
+    no cluster environment) is a no-op, so the same ``main()`` works on
+    a laptop, one TPU host, or a pod slice — the moral equivalent of
+    the reference's "works serially, add `-m scoop` to distribute".
+    """
+    # decide BEFORE touching any jax API: jax.distributed.initialize
+    # must run before the XLA backend initialises, and even
+    # jax.process_count() would initialise it
+    if (coordinator_address is None and num_processes is None
+            and process_id is None and not _cluster_env()):
+        return
+    jax.distributed.initialize(coordinator_address, num_processes,
+                               process_id, **kwargs)
+
+
+def _cluster_env() -> bool:
+    import os
+
+    return any(os.environ.get(k) for k in (
+        "COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+        "TPU_WORKER_ID", "CLOUD_TPU_TASK_ID"))
+
+
+def is_distributed() -> bool:
+    return jax.process_count() > 1
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def global_population_mesh(axis_names: Sequence[str] = ("pop",),
+                           shape: Optional[Sequence[int]] = None):
+    """Mesh over every device of every participating host.
+
+    Identical to :func:`deap_tpu.parallel.population_mesh` (which it
+    calls), spelled separately so multi-host intent is explicit in user
+    code; under `jax.distributed`, ``jax.devices()`` already enumerates
+    the global device set and collectives over the resulting mesh ride
+    ICI within a host/slice and DCN across hosts.
+    """
+    return population_mesh(None, axis_names, shape)

@@ -180,3 +180,13 @@ def test_movingpeaks_inside_jit():
     state, vals = step(state, g)
     assert int(state.nevals) == 12
     assert bool(jnp.isfinite(vals).all())
+
+
+def test_movingpeaks_maximums_contains_global():
+    cfg = mp.MovingPeaksConfig(**{**mp.SCENARIO_2, "dim": 3, "period": 0})
+    state = mp.mp_init(jax.random.key(3), cfg)
+    vals, pos = mp.maximums(cfg, state)
+    assert vals.shape == (cfg.npeaks,)
+    assert pos.shape == (cfg.npeaks, 3)
+    np.testing.assert_allclose(
+        float(vals.max()), float(mp.global_maximum(cfg, state)), rtol=1e-6)

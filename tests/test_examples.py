@@ -18,6 +18,7 @@ if str(ROOT) not in sys.path:
 
 EXAMPLES = [
     "examples.ga.onemax",
+    "examples.ga.onemax_fused",
     "examples.ga.onemax_short",
     "examples.ga.onemax_numpy",
     "examples.ga.onemax_mp",

@@ -128,3 +128,10 @@ def test_dominated_counts_non_dividing_blocks():
     got = dominated_counts(w, rem, block_i=512, block_j=384)
     dom = dominates(w[None, :, :], w[:, None, :])
     np.testing.assert_array_equal(np.asarray(got), np.asarray(dom.sum(1)))
+
+
+def test_fused_hw_prng_rejected_off_tpu():
+    g = jnp.zeros((8, 16), jnp.bool_)
+    with pytest.raises(ValueError, match="hw"):
+        fused_variation_eval(jax.random.key(0), g, cxpb=0.5, mutpb=0.2,
+                             indpb=0.05, prng="hw", interpret=True)

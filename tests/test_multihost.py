@@ -1,0 +1,31 @@
+"""Multi-host helpers (parallel.multihost): single-process no-op init,
+global mesh construction (SURVEY.md §2.3 P3 parity — the SCOOP analog)."""
+
+import jax
+
+from deap_tpu.parallel import (
+    global_population_mesh,
+    initialize,
+    is_distributed,
+    process_count,
+    process_index,
+)
+
+
+def test_initialize_single_process_noop():
+    initialize()  # must not raise or hang without a cluster env
+    assert process_count() == 1
+    assert process_index() == 0
+    assert not is_distributed()
+
+
+def test_global_mesh_covers_all_devices():
+    mesh = global_population_mesh(("pop",))
+    assert mesh.devices.size == len(jax.devices())
+    assert mesh.axis_names == ("pop",)
+
+
+def test_global_mesh_2d_layout():
+    n = len(jax.devices())
+    mesh = global_population_mesh(("island", "genome"), shape=(n, 1))
+    assert mesh.devices.shape == (n, 1)

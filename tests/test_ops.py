@@ -249,3 +249,38 @@ def test_batched_helpers():
     np.testing.assert_array_equal(np.asarray(c1 + c2), 1)
     out = ops.genome_vmap(ops.mut_flip_bit)(key, G1.astype(bool), indpb=0.3)
     assert out.shape == (6, 8)
+
+
+def test_sel_tournament_sorted_matches_distribution():
+    """Rank-based tournament must match the gather-based one in winner
+    distribution (chi-square-free check: empirical win counts over many
+    draws track the analytic rank distribution for both)."""
+    import numpy as np
+    from deap_tpu.ops.selection import sel_tournament, sel_tournament_sorted
+
+    n, k, t = 16, 4096, 3
+    w = jax.random.normal(jax.random.key(0), (n, 1))
+    a = np.asarray(sel_tournament(jax.random.key(1), w, k, tournsize=t))
+    b = np.asarray(sel_tournament_sorted(jax.random.key(2), w, k, tournsize=t))
+    order = np.asarray(jnp.argsort(-w[:, 0]))
+    # empirical selection frequency by fitness rank
+    rank_of = np.empty(n, int); rank_of[order] = np.arange(n)
+    fa = np.bincount(rank_of[a], minlength=n) / k
+    fb = np.bincount(rank_of[b], minlength=n) / k
+    # analytic: P(winner has rank r) = ((n-r)^t - (n-r-1)^t) / n^t
+    r = np.arange(n)
+    p = ((n - r) ** t - (n - r - 1) ** t) / n ** t
+    assert np.abs(fa - p).max() < 0.03
+    assert np.abs(fb - p).max() < 0.03
+
+
+def test_sel_tournament_sorted_minimisation():
+    from deap_tpu.ops.selection import sel_tournament_sorted
+
+    # weights applied upstream: maximisation of wvalues; all-best check
+    w = jnp.array([[0.0], [10.0], [1.0]])
+    idx = sel_tournament_sorted(jax.random.key(3), w, 8, tournsize=3)
+    assert set(np.asarray(idx).tolist()) <= {0, 1, 2}
+    # with tournsize == n*large, winner is almost always the best row
+    idx = sel_tournament_sorted(jax.random.key(4), w, 64, tournsize=16)
+    assert (np.asarray(idx) == 1).mean() > 0.9
