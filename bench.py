@@ -121,6 +121,29 @@ def make_run_fused():
     return run
 
 
+def make_run_packed():
+    """TPU path, bit-packed genomes: 32 genes/uint32 word cuts the
+    genome HBM stream 8× (see deap_tpu.ops.packed); rank-based
+    tournament avoids per-aspirant fitness gathers."""
+    def gen_step(carry, key):
+        packed, fit = carry
+        k_sel, k_var = jax.random.split(key)
+        idx = ops.sel_tournament_sorted(k_sel, fit[:, None], POP,
+                                        tournsize=3)
+        children, newfit = ops.fused_variation_eval_packed(
+            k_var, packed[idx], LENGTH, cxpb=0.5, mutpb=0.2, indpb=0.05,
+            prng="hw", block_i=1024, interpret=False)
+        return (children, newfit), None
+
+    @jax.jit
+    def run(key, packed, fit):
+        (_, f), _ = lax.scan(gen_step, (packed, fit),
+                             jax.random.split(key, NGEN))
+        return f
+
+    return run
+
+
 def _time(run, *args):
     """Best-of-REPS wall seconds of run(*args); sync() is the actual
     completion barrier (see support.profiling.sync)."""
@@ -141,7 +164,12 @@ def main():
     pop = evaluate_invalid(pop, tb.evaluate)
 
     if jax.default_backend() == "tpu":
-        dt = _time(make_run_fused(), pop.genomes, pop.wvalues[:, 0])
+        fit = pop.wvalues[:, 0]
+        packed = ops.pack_genomes(pop.genomes)
+        dt = min(
+            _time(make_run_fused(), pop.genomes, fit),
+            _time(make_run_packed(), packed, fit),
+        )
     else:
         dt = _time(make_run_xla(tb), pop)
 

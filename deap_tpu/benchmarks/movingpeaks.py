@@ -137,13 +137,6 @@ def _landscape(cfg: MovingPeaksConfig, state: MovingPeaksState, x):
     return best
 
 
-def global_maximum(cfg: MovingPeaksConfig, state: MovingPeaksState):
-    """Current optimum value: the best landscape value over all peak
-    centres (movingpeaks.py:182-193)."""
-    vals = jax.vmap(lambda p: _landscape(cfg, state, p))(state.position)
-    return jnp.max(vals)
-
-
 def maximums(cfg: MovingPeaksConfig, state: MovingPeaksState):
     """Per-peak ``(value, position)`` of the landscape at each peak
     centre (movingpeaks.py:185-193's `maximums` property) — values
@@ -151,6 +144,12 @@ def maximums(cfg: MovingPeaksConfig, state: MovingPeaksState):
     rather than read off ``state.height``."""
     vals = jax.vmap(lambda p: _landscape(cfg, state, p))(state.position)
     return vals, state.position
+
+
+def global_maximum(cfg: MovingPeaksConfig, state: MovingPeaksState):
+    """Current optimum value: the best landscape value over all peak
+    centres (movingpeaks.py:182-193)."""
+    return jnp.max(maximums(cfg, state)[0])
 
 
 def _bounce(new, old, delta, lo, hi):

@@ -57,6 +57,15 @@ from deap_tpu.ops.kernels import (
     fused_variation_eval,
     nd_rank_tiled,
 )
+from deap_tpu.ops.packed import (
+    cx_two_point_packed,
+    fused_variation_eval_packed,
+    mut_flip_bit_packed,
+    pack_genomes,
+    packed_fitness,
+    popcount,
+    unpack_genomes,
+)
 from deap_tpu.ops.selection import (
     sel_automatic_epsilon_lexicase,
     sel_best,

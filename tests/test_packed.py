@@ -1,0 +1,138 @@
+"""Bit-packed genome ops (ops.packed): pack/unpack round trip, word-mask
+crossover, per-bit-exact mutation, SWAR popcount, and the fused packed
+kernel's invariants (Pallas interpreter on the CPU test platform)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deap_tpu.ops import packed as pk
+
+
+def test_pack_unpack_roundtrip():
+    for L in (1, 31, 32, 33, 100, 256):
+        bits = jax.random.bernoulli(jax.random.key(L), 0.5, (7, L))
+        p = pk.pack_genomes(bits)
+        assert p.dtype == jnp.uint32 and p.shape == (7, -(-L // 32))
+        np.testing.assert_array_equal(
+            np.asarray(pk.unpack_genomes(p, L)), np.asarray(bits))
+
+
+def test_popcount_and_fitness():
+    bits = jax.random.bernoulli(jax.random.key(0), 0.3, (50, 100))
+    p = pk.pack_genomes(bits)
+    np.testing.assert_array_equal(
+        np.asarray(pk.packed_fitness(p)),
+        np.asarray(bits.sum(-1).astype(jnp.float32)))
+
+
+def test_segment_mask_words():
+    W, L = 4, 100
+    m = pk.segment_mask_words(jnp.int32(10), jnp.int32(70), W)
+    bits = np.asarray(pk.unpack_genomes(m[None, :], W * 32))[0]
+    want = (np.arange(W * 32) >= 10) & (np.arange(W * 32) < 70)
+    np.testing.assert_array_equal(bits, want)
+    # degenerate empty segment
+    m0 = pk.segment_mask_words(jnp.int32(5), jnp.int32(5), W)
+    assert not np.asarray(pk.unpack_genomes(m0[None, :], W * 32)).any()
+
+
+def test_cx_two_point_packed_matches_unpacked_structure():
+    L = 100
+    b1 = jax.random.bernoulli(jax.random.key(1), 0.5, (L,))
+    b2 = jax.random.bernoulli(jax.random.key(2), 0.5, (L,))
+    g1, g2 = pk.pack_genomes(b1[None])[0], pk.pack_genomes(b2[None])[0]
+    c1, c2 = pk.cx_two_point_packed(jax.random.key(3), g1, g2, L)
+    u1 = np.asarray(pk.unpack_genomes(c1[None], L))[0]
+    u2 = np.asarray(pk.unpack_genomes(c2[None], L))[0]
+    a, b = np.asarray(b1), np.asarray(b2)
+    d = u1 != a
+    assert (np.where(d, b, a) == u1).all()
+    assert (np.where(d, a, b) == u2).all()
+    # swapped genes form one contiguous segment among differing columns
+    diff = np.flatnonzero((a != b) & d)
+    if diff.size:
+        lo, hi = diff[0], diff[-1]
+        assert (d[lo : hi + 1] == (a != b)[lo : hi + 1]).all()
+
+
+def test_mut_flip_bit_packed_rate_and_tail():
+    L, n = 100, 2048
+    g = jnp.zeros((n, pk.words_for(L)), jnp.uint32)
+    flipped = jax.vmap(
+        lambda k, row: pk.mut_flip_bit_packed(k, row, 0.05, L)
+    )(jax.random.split(jax.random.key(4), n), g)
+    bits = np.asarray(pk.unpack_genomes(flipped, L))
+    rate = bits.mean()
+    assert 0.04 < rate < 0.06
+    # tail bits beyond L stay zero (pack invariant preserved)
+    full = np.asarray(flipped)
+    tail_mask = ~np.asarray(pk.pack_genomes(jnp.ones((1, L)))[0])
+    assert (full & tail_mask).sum() == 0
+
+
+def _fused(key, packed, L, cxpb, mutpb, indpb):
+    return pk.fused_variation_eval_packed(
+        key, packed, L, cxpb=cxpb, mutpb=mutpb, indpb=indpb,
+        prng="input", block_i=64)
+
+
+def test_fused_packed_identity_and_fitness():
+    L = 100
+    bits = jax.random.bernoulli(jax.random.key(5), 0.5, (130, L))
+    g = pk.pack_genomes(bits)
+    c, f = _fused(jax.random.key(0), g, L, 0.0, 0.0, 0.05)
+    np.testing.assert_array_equal(np.asarray(c), np.asarray(g))
+    np.testing.assert_allclose(np.asarray(f), np.asarray(bits.sum(-1)))
+
+
+def test_fused_packed_crossover_structure():
+    L = 100
+    bits = jax.random.bernoulli(jax.random.key(6), 0.5, (128, L))
+    g = pk.pack_genomes(bits)
+    c, f = _fused(jax.random.key(1), g, L, 1.0, 0.0, 0.0)
+    u = np.asarray(pk.unpack_genomes(c, L))
+    gb = np.asarray(bits)
+    some_swap = False
+    for p in range(64):
+        a, b = gb[2 * p], gb[2 * p + 1]
+        ca, cb = u[2 * p], u[2 * p + 1]
+        d = ca != a
+        assert (np.where(d, b, a) == ca).all()
+        assert (np.where(d, a, b) == cb).all()
+        diff = np.flatnonzero((a != b) & d)
+        if diff.size:
+            some_swap = True
+            lo, hi = diff[0], diff[-1]
+            assert (d[lo : hi + 1] == (a != b)[lo : hi + 1]).all()
+    assert some_swap
+    np.testing.assert_allclose(np.asarray(f), u.sum(-1))
+
+
+def test_fused_packed_full_flip_and_odd_row():
+    L = 100
+    bits = jax.random.bernoulli(jax.random.key(7), 0.5, (129, L))
+    g = pk.pack_genomes(bits)
+    c, _ = _fused(jax.random.key(2), g, L, 0.0, 1.0, 1.0)
+    np.testing.assert_array_equal(
+        np.asarray(pk.unpack_genomes(c, L)), ~np.asarray(bits))
+    # odd last row never mates
+    c2, _ = _fused(jax.random.key(3), g, L, 1.0, 0.0, 0.0)
+    np.testing.assert_array_equal(np.asarray(c2[128]), np.asarray(g[128]))
+
+
+def test_fused_packed_flip_rate():
+    L = 128
+    g = jnp.zeros((1024, pk.words_for(L)), jnp.uint32)
+    c, _ = _fused(jax.random.key(4), g, L, 0.0, 1.0, 0.05)
+    rate = np.asarray(pk.unpack_genomes(c, L)).mean()
+    assert 0.04 < rate < 0.06
+
+
+def test_fused_packed_hw_rejected_off_tpu():
+    g = jnp.zeros((8, 4), jnp.uint32)
+    with pytest.raises(ValueError, match="hw"):
+        pk.fused_variation_eval_packed(
+            jax.random.key(0), g, 100, cxpb=0.5, mutpb=0.2, indpb=0.05,
+            prng="hw", interpret=True)
