@@ -61,8 +61,13 @@ def nd_rank(w: jnp.ndarray, max_rank: Optional[int] = None,
     for small n), ``'tiled'`` streams it through VMEM with the Pallas
     kernel (ops.kernels.nd_rank_tiled; scales to n ≫ 50k), ``'auto'``
     picks by population size.
+
+    ``max_rank`` stops peeling after that many fronts (the reference's
+    sortNondominated ``k`` early-exit, emo.py:71-77); unpeeled rows keep
+    rank ``n``.
     """
     n = w.shape[0]
+    stop = n if max_rank is None else min(max_rank, n)
     if impl == "auto":
         # off-TPU the tiled kernel runs under the Pallas interpreter and
         # is slower than the matrix path, so 'auto' only switches on TPU
@@ -71,14 +76,14 @@ def nd_rank(w: jnp.ndarray, max_rank: Optional[int] = None,
     if impl == "tiled":
         from deap_tpu.ops.kernels import nd_rank_tiled
 
-        return nd_rank_tiled(w)
+        return nd_rank_tiled(w, max_rank)
     if impl != "matrix":
         raise ValueError(f"unknown nd_rank impl {impl!r}")
     dom = dominance_matrix(w)  # [n, n] j dominates i
 
     def cond(state):
         ranks, current, remaining = state
-        return remaining.any() & (current < n)
+        return remaining.any() & (current < stop)
 
     def body(state):
         ranks, current, remaining = state

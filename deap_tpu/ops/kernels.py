@@ -133,7 +133,8 @@ def dominated_counts(w: jnp.ndarray, remaining: jnp.ndarray, *,
     return out[:n, 0].astype(jnp.int32)
 
 
-def nd_rank_tiled(w: jnp.ndarray, *, block_i: int = 256, block_j: int = 512,
+def nd_rank_tiled(w: jnp.ndarray, max_fronts: Optional[int] = None, *,
+                  block_i: int = 256, block_j: int = 512,
                   interpret: Optional[bool] = None) -> jnp.ndarray:
     """Non-domination rank (0 = first front) by iterative front peeling,
     recomputing domination tile-wise each round instead of holding the
@@ -141,14 +142,18 @@ def nd_rank_tiled(w: jnp.ndarray, *, block_i: int = 256, block_j: int = 512,
 
     O(fronts · n²·m) VPU flops, O(n·m) memory — the XLA matrix path is
     O(n²) memory. Crossover point on one chip is around n ≈ 20-30k.
+
+    ``max_fronts`` stops peeling early (emo.nd_rank's ``max_rank``);
+    unpeeled rows keep rank ``n``.
     """
     n = w.shape[0]
+    stop = n if max_fronts is None else min(max_fronts, n)
     count = functools.partial(dominated_counts, block_i=block_i,
                               block_j=block_j, interpret=interpret)
 
     def cond(state):
         _, current, remaining = state
-        return remaining.any() & (current < n)
+        return remaining.any() & (current < stop)
 
     def body(state):
         ranks, current, remaining = state
@@ -243,6 +248,75 @@ def _fused_kernel_hw(seed_ref, g_ref, out_ref, fit_ref, *, n, L, cxpb,
     fit_ref[:] = fit
 
 
+def _resolve_prng(prng: str, interp: bool) -> str:
+    """'auto' → hw on real TPU, input elsewhere; reject hw+interpreter
+    (the interpreter stubs prng_random_bits to zeros — the GA would
+    silently degenerate: fixed crossover points, all genes flipped)."""
+    if prng == "auto":
+        return "input" if interp else "hw"
+    if prng == "hw" and interp:
+        raise ValueError(
+            "prng='hw' needs a real TPU core; use prng='input' (or "
+            "'auto') under the Pallas interpreter")
+    if prng not in ("hw", "input"):
+        raise ValueError(f"unknown prng mode {prng!r}")
+    return prng
+
+
+def run_fused_kernel(key: jax.Array, g: jnp.ndarray, *, kernel_hw,
+                     kernel_bits, prng: str, interp: bool, block_i: int,
+                     genebit_cols: int, out_dtype) -> Tuple[jnp.ndarray,
+                                                            jnp.ndarray]:
+    """Shared pallas_call plumbing for the fused variation kernels (this
+    module's byte-genome pair and ops.packed's word-genome pair).
+
+    ``g`` must already be padded to ``[ni, cols]`` with ``ni`` a
+    multiple of ``block_i``; returns the padded ``(children, fitness)``
+    for the caller to slice. ``kernel_hw(seed_ref, g_ref, out, fit)``
+    draws its randomness from the TPU hardware PRNG; ``kernel_bits
+    (g_ref, pairbits, rowbits, genebits, out, fit)`` receives uint32
+    streams (``genebit_cols`` columns of per-gene bits).
+    """
+    ni, cols = g.shape
+    gspec = pl.BlockSpec((block_i, cols), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)
+    out_specs = [
+        gspec,
+        pl.BlockSpec((block_i, 1), lambda i: (i, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((ni, cols), out_dtype),
+        jax.ShapeDtypeStruct((ni, 1), jnp.float32),
+    ]
+    grid = (ni // block_i,)
+
+    if prng == "hw":
+        seed = jax.random.randint(key, (1,), 0, 2**31 - 1, jnp.int32)
+        return pl.pallas_call(
+            kernel_hw,
+            grid=grid,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM), gspec],
+            out_specs=out_specs,
+            out_shape=out_shape,
+            interpret=interp,
+        )(seed, g)
+    k1, k2, k3 = jax.random.split(key, 3)
+    pairbits = jax.random.bits(k1, (ni, 4), jnp.uint32)
+    rowbits = jax.random.bits(k2, (ni, 1), jnp.uint32)
+    genebits = jax.random.bits(k3, (ni, genebit_cols), jnp.uint32)
+    bspec = lambda k: pl.BlockSpec((block_i, k), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        kernel_bits,
+        grid=grid,
+        in_specs=[gspec, bspec(4), bspec(1), bspec(genebit_cols)],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interp,
+    )(g, pairbits, rowbits, genebits)
+
+
 def fused_variation_eval(key: jax.Array, genomes: jnp.ndarray, *,
                          cxpb: float, mutpb: float, indpb: float,
                          prng: str = "auto", block_i: int = 256,
@@ -267,57 +341,14 @@ def fused_variation_eval(key: jax.Array, genomes: jnp.ndarray, *,
     Lp = _round_up(L, 128)
     ni = _round_up(n, block_i)
     interp = _auto_interpret(interpret)
-    if prng == "auto":
-        prng = "input" if interp else "hw"
-    elif prng == "hw" and interp:
-        # the interpreter stubs prng_random_bits to zeros — the GA would
-        # silently degenerate (fixed crossover points, all genes flipped)
-        raise ValueError(
-            "prng='hw' needs a real TPU core; use prng='input' (or "
-            "'auto') under the Pallas interpreter")
+    prng = _resolve_prng(prng, interp)
     g = jnp.pad(genomes, ((0, ni - n), (0, Lp - L)))
 
     common = dict(n=n, L=L, cxpb=cxpb, mutpb=mutpb, indpb=indpb)
-    gspec = pl.BlockSpec((block_i, Lp), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM)
-    out_specs = [
-        gspec,
-        pl.BlockSpec((block_i, 1), lambda i: (i, 0),
-                     memory_space=pltpu.VMEM),
-    ]
-    out_shape = [
-        jax.ShapeDtypeStruct((ni, Lp), genomes.dtype),
-        jax.ShapeDtypeStruct((ni, 1), jnp.float32),
-    ]
-
-    if prng == "hw":
-        seed = jax.random.randint(key, (1,), 0, 2**31 - 1, jnp.int32)
-        out, fit = pl.pallas_call(
-            functools.partial(_fused_kernel_hw, **common),
-            grid=(ni // block_i,),
-            in_specs=[
-                pl.BlockSpec(memory_space=pltpu.SMEM),
-                gspec,
-            ],
-            out_specs=out_specs,
-            out_shape=out_shape,
-            interpret=interp,
-        )(seed, g)
-    elif prng == "input":
-        k1, k2, k3 = jax.random.split(key, 3)
-        pairbits = jax.random.bits(k1, (ni, 4), jnp.uint32)
-        rowbits = jax.random.bits(k2, (ni, 1), jnp.uint32)
-        genebits = jax.random.bits(k3, (ni, Lp), jnp.uint32)
-        bspec = lambda k: pl.BlockSpec((block_i, k), lambda i: (i, 0),
-                                       memory_space=pltpu.VMEM)
-        out, fit = pl.pallas_call(
-            functools.partial(_fused_kernel_bits, **common),
-            grid=(ni // block_i,),
-            in_specs=[gspec, bspec(4), bspec(1), bspec(Lp)],
-            out_specs=out_specs,
-            out_shape=out_shape,
-            interpret=interp,
-        )(g, pairbits, rowbits, genebits)
-    else:
-        raise ValueError(f"unknown prng mode {prng!r}")
+    out, fit = run_fused_kernel(
+        key, g,
+        kernel_hw=functools.partial(_fused_kernel_hw, **common),
+        kernel_bits=functools.partial(_fused_kernel_bits, **common),
+        prng=prng, interp=interp, block_i=block_i, genebit_cols=Lp,
+        out_dtype=genomes.dtype)
     return out[:n, :L], fit[:n, 0]

@@ -241,68 +241,36 @@ def fused_variation_eval_packed(key: jax.Array, packed: jnp.ndarray,
                                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """One fused variation+evaluation pass on packed genomes — the
     packed twin of :func:`deap_tpu.ops.kernels.fused_variation_eval`
-    with identical semantics and an 8× smaller genome stream.
+    with identical semantics and an up-to-8× smaller genome stream.
+
+    The word axis is NOT padded to the 128-lane tile: a [TI, W] block
+    with W ≪ 128 wastes vector-register lanes (the kernel is memory-
+    bound, so that is cheap) but streams only the real ``4·W`` bytes per
+    row through HBM — padding to 128 lanes would stream 32× more than
+    the byte-genome kernel at W=4 and erase the packing win.
 
     :param packed: ``uint32[n, W]`` rows from :func:`pack_genomes`.
     :returns: ``(children uint32[n, W], fitness f32[n])``.
     """
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    from deap_tpu.ops.kernels import _auto_interpret, _round_up
+    from deap_tpu.ops.kernels import (
+        _auto_interpret,
+        _resolve_prng,
+        _round_up,
+        run_fused_kernel,
+    )
 
     n, W = packed.shape
     assert block_i % 2 == 0, "pairs must not straddle tiles"
-    Wp = _round_up(W, 128)
     ni = _round_up(n, block_i)
     interp = _auto_interpret(interpret)
-    if prng == "auto":
-        prng = "input" if interp else "hw"
-    elif prng == "hw" and interp:
-        raise ValueError(
-            "prng='hw' needs a real TPU core; use prng='input' (or "
-            "'auto') under the Pallas interpreter")
+    prng = _resolve_prng(prng, interp)
 
-    g = jnp.pad(packed, ((0, ni - n), (0, Wp - W)))
+    g = jnp.pad(packed, ((0, ni - n), (0, 0)))
     common = dict(n=n, L=length, W=W, cxpb=cxpb, mutpb=mutpb, indpb=indpb)
-    gspec = pl.BlockSpec((block_i, Wp), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM)
-    out_specs = [
-        gspec,
-        pl.BlockSpec((block_i, 1), lambda i: (i, 0),
-                     memory_space=pltpu.VMEM),
-    ]
-    out_shape = [
-        jax.ShapeDtypeStruct((ni, Wp), jnp.uint32),
-        jax.ShapeDtypeStruct((ni, 1), jnp.float32),
-    ]
-
-    if prng == "hw":
-        seed = jax.random.randint(key, (1,), 0, 2**31 - 1, jnp.int32)
-        out, fit = pl.pallas_call(
-            functools.partial(_packed_kernel_hw, **common),
-            grid=(ni // block_i,),
-            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM), gspec],
-            out_specs=out_specs,
-            out_shape=out_shape,
-            interpret=interp,
-        )(seed, g)
-    elif prng == "input":
-        k1, k2, k3 = jax.random.split(key, 3)
-        pairbits = jax.random.bits(k1, (ni, 4), jnp.uint32)
-        rowbits = jax.random.bits(k2, (ni, 1), jnp.uint32)
-        # bit-plane layout: columns [b*Wp, (b+1)*Wp) hold plane b
-        genebits = jax.random.bits(k3, (ni, WORD * Wp), jnp.uint32)
-        bspec = lambda k: pl.BlockSpec((block_i, k), lambda i: (i, 0),
-                                       memory_space=pltpu.VMEM)
-        out, fit = pl.pallas_call(
-            functools.partial(_packed_kernel_bits, **common),
-            grid=(ni // block_i,),
-            in_specs=[gspec, bspec(4), bspec(1), bspec(Wp * WORD)],
-            out_specs=out_specs,
-            out_shape=out_shape,
-            interpret=interp,
-        )(g, pairbits, rowbits, genebits)
-    else:
-        raise ValueError(f"unknown prng mode {prng!r}")
-    return out[:n, :W], fit[:n, 0]
+    out, fit = run_fused_kernel(
+        key, g,
+        kernel_hw=functools.partial(_packed_kernel_hw, **common),
+        kernel_bits=functools.partial(_packed_kernel_bits, **common),
+        prng=prng, interp=interp, block_i=block_i,
+        genebit_cols=W * WORD, out_dtype=jnp.uint32)
+    return out[:n], fit[:n, 0]

@@ -154,3 +154,12 @@ def test_nsga3_with_memory_runs():
     idx2 = sel(jax.random.key(5), -values, 8)
     assert idx1.shape == (8,) and idx2.shape == (8,)
     assert sel.memory is not None
+
+
+def test_nd_rank_max_rank_early_stop():
+    w = jax.random.normal(jax.random.key(42), (60, 2))
+    full = np.asarray(mo.emo.nd_rank(w, impl="matrix"))
+    capped = np.asarray(mo.emo.nd_rank(w, max_rank=2, impl="matrix"))
+    # first two fronts identical; everything deeper left at sentinel n
+    assert (capped[full < 2] == full[full < 2]).all()
+    assert (capped[full >= 2] == 60).all()
