@@ -416,6 +416,59 @@ def sel_spea2(key, w, k):
     return jnp.where(use_trunc | (n_nd == k), order, under_order)[:k]
 
 
+def spea2_fitness_stream(w: jnp.ndarray, **kernel_kwargs):
+    """SPEA2 strength + raw fitness without the [n, n] matrices
+    (emo.py:712-724), via the streaming dominance kernels: ``S(i)`` by
+    counting rows ``i`` dominates (sign-flip trick), ``R(i)`` as the
+    dominator-weighted sum of strengths. Returns ``(strength, raw)``,
+    both ``f32[n]``; ``raw < 1`` marks the non-dominated set. Matches
+    :func:`sel_spea2`'s dense formulation exactly while raw values stay
+    below 2²⁴ (f32 integer-exact range; raw is O(n²) in the worst case,
+    so expect rounding in the ranking beyond n ≈ 4k fully-sorted
+    populations — in practice raw stays far below the bound)."""
+    from deap_tpu.ops.kernels import dominated_weight_sums, strengths_tiled
+
+    strength = strengths_tiled(w, **kernel_kwargs)
+    raw = dominated_weight_sums(w, strength, **kernel_kwargs)
+    return strength, raw
+
+
+def sel_spea2_stream(key, w, k, candidates: Optional[int] = None,
+                     **kernel_kwargs):
+    """SPEA2 selection for populations far past the dense formulation's
+    memory wall (n ≫ 50k), built on :func:`spea2_fitness_stream`.
+
+    Strength/raw fitness are the exact published quantities, streaming.
+    The environmental step then ranks a bounded candidate set — the
+    ``candidates`` best rows by raw fitness (default ``max(2k, 4096)``)
+    — by ``raw + density`` with the k-NN density computed densely among
+    candidates only, and takes the top ``k``. Documented divergence from
+    :func:`sel_spea2` (and emo.py:726-834): the over-full archive is cut
+    by one kth-distance ranking instead of the iterative
+    minimum-distance removal loop, and density ignores points outside
+    the candidate set; both effects vanish as ``candidates`` grows.
+    """
+    del key
+    n, _ = w.shape
+    if candidates is None:
+        c = min(n, max(2 * k, 4096))
+    else:
+        c = min(candidates, n)
+    c = max(c, min(k, n))  # never hand back fewer than the k requested
+    _, raw = spea2_fitness_stream(w, **kernel_kwargs)
+    cand_idx = jnp.argsort(raw, stable=True)[:c]
+    wc = w[cand_idx]
+    d2 = jnp.sum((wc[:, None, :] - wc[None, :, :]) ** 2, axis=-1)
+    d2 = jnp.where(jnp.eye(c, dtype=bool), jnp.inf, d2)
+    # c-2: the last sorted column is the inf self-distance — selecting it
+    # would zero every density
+    kth = jnp.clip(jnp.int32(jnp.floor(jnp.sqrt(n))), 0, max(c - 2, 0))
+    sigma_k = jnp.sort(d2, axis=1)[:, kth]
+    density = 1.0 / (sigma_k + 2.0)
+    score = raw[cand_idx] + density
+    return cand_idx[jnp.argsort(score, stable=True)[:k]]
+
+
 # DEAP-style aliases
 selNSGA2 = sel_nsga2
 selNSGA3 = sel_nsga3

@@ -54,8 +54,10 @@ from deap_tpu.ops.mutation import (
 )
 from deap_tpu.ops.kernels import (
     dominated_counts,
+    dominated_weight_sums,
     fused_variation_eval,
     nd_rank_tiled,
+    strengths_tiled,
 )
 from deap_tpu.ops.packed import (
     cx_two_point_packed,

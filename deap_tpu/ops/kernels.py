@@ -43,8 +43,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = [
     "dominated_counts",
+    "dominated_weight_sums",
+    "strengths_tiled",
     "nd_rank_tiled",
     "fused_variation_eval",
+    "run_fused_kernel",
 ]
 
 _INV24 = 1.0 / (1 << 24)
@@ -96,15 +99,19 @@ def _dom_counts_kernel(wi_ref, wjt_ref, rem_ref, out_ref):
     out_ref[:] += counts
 
 
-def dominated_counts(w: jnp.ndarray, remaining: jnp.ndarray, *,
-                     block_i: int = 256, block_j: int = 512,
-                     interpret: Optional[bool] = None) -> jnp.ndarray:
-    """``counts[i] = #{j : remaining[j] and j dominates i}`` without ever
-    materialising the [n, n] matrix.
+def dominated_weight_sums(w: jnp.ndarray, weights: jnp.ndarray, *,
+                          block_i: int = 256, block_j: int = 512,
+                          interpret: Optional[bool] = None) -> jnp.ndarray:
+    """``out[i] = Σ_{j dominates i} weights[j]`` without ever
+    materialising the [n, n] dominance matrix.
+
+    With 0/1 weights this is a dominator count; with SPEA2 strengths it
+    is the raw fitness R(i) (emo.py:720-724) — both stream [TI, m] ×
+    [m, TJ] tiles through VMEM.
 
     :param w: ``f32[n, nobj]`` weighted fitness values (maximisation).
-    :param remaining: ``bool[n]`` — which columns (dominators) count.
-    :returns: ``int32[n]``.
+    :param weights: ``f32[n]`` per-dominator weights (bools accepted).
+    :returns: ``f32[n]``.
     """
     n, m = w.shape
     # the same padded array is viewed in block_i-rows (i side) and
@@ -113,7 +120,7 @@ def dominated_counts(w: jnp.ndarray, remaining: jnp.ndarray, *,
     npad = _round_up(n, math.lcm(block_i, block_j))
     wp = jnp.pad(w.astype(jnp.float32), ((0, npad - n), (0, 0)),
                  constant_values=-jnp.inf)  # padded rows dominate nothing
-    rem = jnp.pad(remaining.astype(jnp.float32), (0, npad - n))[None, :]
+    rem = jnp.pad(weights.astype(jnp.float32), (0, npad - n))[None, :]
     out = pl.pallas_call(
         _dom_counts_kernel,
         grid=(npad // block_i, npad // block_j),
@@ -130,7 +137,30 @@ def dominated_counts(w: jnp.ndarray, remaining: jnp.ndarray, *,
         out_shape=jax.ShapeDtypeStruct((npad, 1), jnp.float32),
         interpret=_auto_interpret(interpret),
     )(wp, wp.T, rem)
-    return out[:n, 0].astype(jnp.int32)
+    return out[:n, 0]
+
+
+def dominated_counts(w: jnp.ndarray, remaining: jnp.ndarray, *,
+                     block_i: int = 256, block_j: int = 512,
+                     interpret: Optional[bool] = None) -> jnp.ndarray:
+    """``counts[i] = #{j : remaining[j] and j dominates i}`` —
+    :func:`dominated_weight_sums` with 0/1 weights."""
+    return dominated_weight_sums(
+        w, remaining, block_i=block_i, block_j=block_j,
+        interpret=interpret).astype(jnp.int32)
+
+
+def strengths_tiled(w: jnp.ndarray, *, block_i: int = 256,
+                    block_j: int = 512,
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """SPEA2 strength ``S(i) = #{j : i dominates j}`` (emo.py:712-718),
+    streaming. Negating ``w`` flips the domination direction
+    (``dominates(-a, -b) == dominates(b, a)``), so the same kernel
+    counts dominated rows instead of dominators."""
+    n = w.shape[0]
+    return dominated_weight_sums(
+        -w, jnp.ones(n, jnp.float32), block_i=block_i, block_j=block_j,
+        interpret=interpret)
 
 
 def nd_rank_tiled(w: jnp.ndarray, max_fronts: Optional[int] = None, *,

@@ -135,3 +135,47 @@ def test_fused_hw_prng_rejected_off_tpu():
     with pytest.raises(ValueError, match="hw"):
         fused_variation_eval(jax.random.key(0), g, cxpb=0.5, mutpb=0.2,
                              indpb=0.05, prng="hw", interpret=True)
+
+
+def test_strengths_and_weighted_sums_match_dense_spea2():
+    # streaming strength/raw == the dense SPEA2 quantities
+    from deap_tpu.mo.emo import dominance_matrix, spea2_fitness_stream
+
+    w = jax.random.normal(jax.random.key(21), (157, 3))
+    strength, raw = spea2_fitness_stream(
+        w, block_i=128, block_j=128)
+    dom = dominance_matrix(w)                     # dom[i, j]: j dominates i
+    want_strength = dom.sum(axis=0).astype(jnp.float32)
+    want_raw = jnp.where(dom, want_strength[None, :], 0).sum(1)
+    np.testing.assert_allclose(np.asarray(strength),
+                               np.asarray(want_strength))
+    np.testing.assert_allclose(np.asarray(raw), np.asarray(want_raw))
+
+
+def test_sel_spea2_stream_prefers_nondominated():
+    from deap_tpu.mo.emo import sel_spea2_stream
+
+    # clear 2-objective fronts: the k chosen must all be non-dominated
+    front = jnp.stack([jnp.linspace(0, 1, 10),
+                       1.0 - jnp.linspace(0, 1, 10)], 1)
+    dominated = front * 0.5
+    w = jnp.concatenate([dominated, front])
+    idx = np.asarray(sel_spea2_stream(jax.random.key(0), w, 8,
+                                      block_i=128, block_j=128))
+    assert (idx >= 10).all()
+
+
+def test_sel_spea2_stream_small_candidate_set():
+    from deap_tpu.mo.emo import sel_spea2_stream
+
+    w = jax.random.normal(jax.random.key(22), (300, 2))
+    # candidates below k must still return k distinct indices
+    idx = np.asarray(sel_spea2_stream(jax.random.key(0), w, 40,
+                                      candidates=10,
+                                      block_i=128, block_j=128))
+    assert idx.shape == (40,) and len(set(idx.tolist())) == 40
+    # tiny candidate pools must not degenerate density to zero
+    idx2 = np.asarray(sel_spea2_stream(jax.random.key(0), w, 3,
+                                       candidates=3,
+                                       block_i=128, block_j=128))
+    assert idx2.shape == (3,)
