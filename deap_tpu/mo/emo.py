@@ -144,10 +144,20 @@ def crowding_distances(w: jnp.ndarray, ranks: jnp.ndarray) -> jnp.ndarray:
 
 def sel_nsga2(key, w, k, nd: str = "standard"):
     """NSGA-II selection (emo.py:15-50): whole fronts in rank order, the
-    last partial front by descending crowding distance. ``nd`` is
-    accepted for API parity; both values hit the same matrix kernel."""
-    del key, nd
-    ranks = nd_rank(w)
+    last partial front by descending crowding distance.
+
+    ``nd``: the reference's ``'standard'``/``'log'`` both map to
+    ``nd_rank(impl='auto')`` (the log variant exists to cut Python
+    constants the tensor kernels don't have); ``'matrix'``/``'tiled'``
+    force a specific nd-sort implementation."""
+    del key
+    if nd in ("matrix", "tiled"):
+        impl = nd
+    elif nd in ("standard", "log", "auto"):
+        impl = "auto"
+    else:
+        raise ValueError(f"unknown nd sort {nd!r}")
+    ranks = nd_rank(w, impl=impl)
     crowd = crowding_distances(w, ranks)
     order = jnp.lexsort((-crowd, ranks))
     return order[:k]
@@ -348,6 +358,17 @@ class SelNSGA3WithMemory:
 
 # ------------------------------------------------------------------ SPEA2 ----
 
+def _knn_density(d2: jnp.ndarray, kth: jnp.ndarray) -> jnp.ndarray:
+    """SPEA2 density ``1/(σ_k + 2)`` (emo.py:726-746) from a square
+    pairwise-distance matrix. The diagonal is excluded, and ``kth`` is
+    clamped below the last sorted column — which holds the excluded
+    (inf) self-distance and would otherwise zero every density."""
+    c = d2.shape[0]
+    d2 = jnp.where(jnp.eye(c, dtype=bool), jnp.inf, d2)
+    sigma_k = jnp.sort(d2, axis=1)[:, jnp.clip(kth, 0, max(c - 2, 0))]
+    return 1.0 / (sigma_k + 2.0)
+
+
 def sel_spea2(key, w, k):
     """SPEA2 environmental selection (Zitzler 2001; emo.py:692-842).
 
@@ -371,12 +392,9 @@ def sel_spea2(key, w, k):
     n_nd = jnp.sum(nd_mask)
 
     d2 = jnp.sum((w[:, None, :] - w[None, :, :]) ** 2, axis=-1)
-    kth = jnp.int32(jnp.floor(jnp.sqrt(n)))
 
     # ---- under-full: order all by (not-nd, raw + density) and take k
-    d_sorted = jnp.sort(jnp.where(jnp.eye(n, dtype=bool), jnp.inf, d2), axis=1)
-    sigma_k = d_sorted[:, jnp.clip(kth, 0, n - 1)]
-    density = 1.0 / (sigma_k + 2.0)
+    density = _knn_density(d2, kth=jnp.int32(jnp.floor(jnp.sqrt(n))))
     fill_score = raw + density
     under_order = jnp.lexsort((fill_score, ~nd_mask))
 
@@ -448,7 +466,6 @@ def sel_spea2_stream(key, w, k, candidates: Optional[int] = None,
     minimum-distance removal loop, and density ignores points outside
     the candidate set; both effects vanish as ``candidates`` grows.
     """
-    del key
     n, _ = w.shape
     if candidates is None:
         c = min(n, max(2 * k, 4096))
@@ -456,15 +473,14 @@ def sel_spea2_stream(key, w, k, candidates: Optional[int] = None,
         c = min(candidates, n)
     c = max(c, min(k, n))  # never hand back fewer than the k requested
     _, raw = spea2_fitness_stream(w, **kernel_kwargs)
-    cand_idx = jnp.argsort(raw, stable=True)[:c]
+    # random tie-break: the whole non-dominated set shares raw == 0, and
+    # a stable sort would keep only its lowest-index members — a
+    # systematic bias at exactly the large-n sizes this targets
+    u = jax.random.uniform(key, (n,))
+    cand_idx = jnp.lexsort((u, raw))[:c]
     wc = w[cand_idx]
     d2 = jnp.sum((wc[:, None, :] - wc[None, :, :]) ** 2, axis=-1)
-    d2 = jnp.where(jnp.eye(c, dtype=bool), jnp.inf, d2)
-    # c-2: the last sorted column is the inf self-distance — selecting it
-    # would zero every density
-    kth = jnp.clip(jnp.int32(jnp.floor(jnp.sqrt(n))), 0, max(c - 2, 0))
-    sigma_k = jnp.sort(d2, axis=1)[:, kth]
-    density = 1.0 / (sigma_k + 2.0)
+    density = _knn_density(d2, jnp.int32(jnp.floor(jnp.sqrt(n))))
     score = raw[cand_idx] + density
     return cand_idx[jnp.argsort(score, stable=True)[:k]]
 

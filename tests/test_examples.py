@@ -30,6 +30,7 @@ EXAMPLES = [
     "examples.ga.nqueens",
     "examples.ga.kursawefct",
     "examples.ga.nsga2",
+    "examples.ga.nsga2_large",
     "examples.ga.nsga3",
     "examples.ga.mo_rhv",
     "examples.ga.sortingnetwork",

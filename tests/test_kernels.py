@@ -179,3 +179,16 @@ def test_sel_spea2_stream_small_candidate_set():
                                        candidates=3,
                                        block_i=128, block_j=128))
     assert idx2.shape == (3,)
+
+
+def test_sel_spea2_stream_tie_break_unbiased():
+    from deap_tpu.mo.emo import sel_spea2_stream
+
+    # all rows mutually non-dominated (raw == 0 everywhere): candidate
+    # truncation must not systematically keep the lowest indices
+    t = jnp.linspace(0, 1, 400)
+    w = jnp.stack([t, 1.0 - t], 1)
+    idx = np.asarray(sel_spea2_stream(jax.random.key(3), w, 20,
+                                      candidates=50,
+                                      block_i=128, block_j=128))
+    assert idx.max() > 100  # stable-sort bias would cap indices at 49

@@ -163,3 +163,9 @@ def test_nd_rank_max_rank_early_stop():
     # first two fronts identical; everything deeper left at sentinel n
     assert (capped[full < 2] == full[full < 2]).all()
     assert (capped[full >= 2] == 60).all()
+
+
+def test_sel_nsga2_rejects_unknown_nd():
+    w = jax.random.normal(jax.random.key(0), (8, 2))
+    with pytest.raises(ValueError, match="nd"):
+        mo.sel_nsga2(jax.random.key(1), w, 4, nd="tilted")
