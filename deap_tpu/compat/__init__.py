@@ -13,13 +13,15 @@ boundary):
 - :mod:`deap_tpu.compat.tools` — list operators + support objects.
 - :mod:`deap_tpu.compat.algorithms` — the four generational loops over
   lists of individuals.
+- :mod:`deap_tpu.compat.gp` — list-based genetic programming
+  (PrimitiveTree/PrimitiveSet/compile without eval).
 - :func:`jax_map` — the bridge the north-star names: register a
   jax-backed ``map`` so ``toolbox.map(toolbox.evaluate, invalids)``
   dispatches ONE batched, jit-compiled evaluation over a device tensor
   while individuals stay Python lists.
 """
 
-from deap_tpu.compat import algorithms, base, creator, tools
+from deap_tpu.compat import algorithms, base, creator, gp, tools
 from deap_tpu.compat.bridge import jax_map
 
-__all__ = ["algorithms", "base", "creator", "tools", "jax_map"]
+__all__ = ["algorithms", "base", "creator", "gp", "tools", "jax_map"]

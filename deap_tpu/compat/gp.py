@@ -1,0 +1,472 @@
+"""Genetic programming over Python-object trees — the reference GP API.
+
+Counterpart of /root/reference/deap/gp.py for users porting list-based
+GP programs verbatim: ``PrimitiveTree`` (a list of node objects in
+prefix order, gp.py:63-184), ``PrimitiveSet`` with arbitrary Python
+callables (gp.py:260-456), the ``genFull/genGrow/genHalfAndHalf``
+generators (gp.py:519-638), subtree crossover and the mutation family
+(gp.py:645-886), and ``staticLimit`` (gp.py:890-931).
+
+One deliberate difference: the reference's ``compile`` builds a source
+string and ``eval``s it (gp.py:462-487, with its >90-depth failure mode
+and ``__builtins__`` hazard); here :func:`compile` walks the prefix
+array with an explicit stack — same results, no codegen, no depth
+limit, no eval.
+
+This is the host/CPU path for arbitrary Python primitives. Tensor GP —
+the TPU path with batched interpretation — lives in :mod:`deap_tpu.gp`;
+see docs/advanced/gp.md for when to use which.
+"""
+
+from __future__ import annotations
+
+import copy
+import random
+from functools import wraps
+from typing import Callable, List
+
+__all__ = [
+    "PrimitiveTree", "Primitive", "Terminal", "Ephemeral",
+    "PrimitiveSet", "PrimitiveSetTyped", "compile", "compileADF",
+    "genFull", "genGrow", "genHalfAndHalf",
+    "cxOnePoint", "mutUniform", "mutNodeReplacement", "mutEphemeral",
+    "mutInsert", "mutShrink", "staticLimit",
+]
+
+
+class Primitive:
+    """An operator node: name, argument types, return type
+    (gp.py:187-221)."""
+
+    __slots__ = ("name", "arity", "args", "ret", "fn")
+
+    def __init__(self, name, args, ret, fn):
+        self.name = name
+        self.arity = len(args)
+        self.args = list(args)
+        self.ret = ret
+        self.fn = fn
+
+    def __eq__(self, other):
+        return (type(self) is type(other) and self.name == other.name
+                and self.arity == other.arity)
+
+    def __hash__(self):
+        return hash((self.name, self.arity))
+
+
+class Terminal:
+    """A leaf node holding a value or input symbol (gp.py:224-244)."""
+
+    __slots__ = ("name", "value", "ret")
+
+    def __init__(self, name, value, ret):
+        self.name = name
+        self.value = value
+        self.ret = ret
+
+    arity = 0
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.name == other.name
+
+    def __hash__(self):
+        return hash(self.name)
+
+
+class Ephemeral(Terminal):
+    """A terminal whose value is drawn fresh per occurrence
+    (gp.py:247-257)."""
+
+    __slots__ = ("func",)
+
+    def __init__(self, name, func, ret):
+        self.func = func
+        super().__init__(name, func(), ret)
+
+    def regen(self):
+        return Ephemeral(self.name, self.func, self.ret)
+
+
+class PrimitiveTree(list):
+    """Prefix-ordered list of nodes (gp.py:63-184)."""
+
+    @property
+    def height(self):
+        stack = [0]
+        max_depth = 0
+        for node in self:
+            depth = stack.pop()
+            max_depth = max(max_depth, depth)
+            stack.extend([depth + 1] * node.arity)
+        return max_depth
+
+    @property
+    def root(self):
+        return self[0]
+
+    def search_subtree(self, begin):
+        """slice spanning the subtree rooted at ``begin``
+        (gp.py:174-184)."""
+        end = begin + 1
+        total = self[begin].arity
+        while total > 0:
+            total += self[end].arity - 1
+            end += 1
+        return slice(begin, end)
+
+    searchSubtree = search_subtree
+
+    def __str__(self):
+        """Infix rendering, same shape as the reference's printer
+        (gp.py:90-104)."""
+        string = ""
+        stack: list = []
+        for node in self:
+            stack.append((node, []))
+            while stack and len(stack[-1][1]) == stack[-1][0].arity:
+                node, args = stack.pop()
+                if node.arity:
+                    string = f"{node.name}({', '.join(args)})"
+                elif node.value is None:
+                    string = node.name      # input argument
+                else:
+                    string = str(node.value)
+                if not stack:
+                    break
+                stack[-1][1].append(string)
+        return string
+
+
+class PrimitiveSetTyped:
+    """Typed primitive registry (gp.py:260-429) holding real callables —
+    no string context, since compile never builds source."""
+
+    def __init__(self, name, in_types, ret_type, prefix="ARG"):
+        self.name = name
+        self.ret = ret_type
+        self.ins = list(in_types)
+        self.arguments: List[str] = []
+        self.primitives: dict = {}
+        self.terminals: dict = {}
+        self.mapping: dict = {}
+        for i, t in enumerate(self.ins):
+            arg = f"{prefix}{i}"
+            self.arguments.append(arg)
+            self._add_terminal(Terminal(arg, None, t))
+
+    # ------------------------------------------------------------ builders --
+
+    def _add_primitive(self, prim):
+        self.primitives.setdefault(prim.ret, []).append(prim)
+        self.mapping[prim.name] = prim
+
+    def _add_terminal(self, term):
+        self.terminals.setdefault(term.ret, []).append(term)
+        self.mapping[term.name] = term
+
+    def addPrimitive(self, fn, in_types, ret_type, name=None):
+        name = name or fn.__name__
+        self._add_primitive(Primitive(name, in_types, ret_type, fn))
+
+    def addTerminal(self, value, ret_type, name=None):
+        if name is None:
+            name = repr(value)
+        self._add_terminal(Terminal(name, value, ret_type))
+
+    def addEphemeralConstant(self, name, func, ret_type):
+        self._add_terminal(Ephemeral(name, func, ret_type))
+
+    def addADF(self, adfset: "PrimitiveSetTyped"):
+        """Register a callable slot for an automatically defined
+        function branch (gp.py:414-423): a primitive named after
+        ``adfset`` whose function is bound per-individual by
+        :func:`compileADF` (``fn`` stays None here so the shared
+        registry never carries one individual's compiled branch)."""
+        self._add_primitive(
+            Primitive(adfset.name, adfset.ins, adfset.ret, None))
+
+    def renameArguments(self, **kwargs):
+        for key, name in kwargs.items():
+            if key.startswith("ARG"):
+                i = int(key[3:])
+                old = self.arguments[i]
+                self.arguments[i] = name
+                for terms in self.terminals.values():
+                    for t in terms:
+                        if t.name == old:
+                            t.name = name
+                self.mapping[name] = self.mapping.pop(old)
+
+    @property
+    def terminalRatio(self):
+        n_t = sum(len(v) for v in self.terminals.values())
+        n_p = sum(len(v) for v in self.primitives.values())
+        return n_t / (n_t + n_p)
+
+
+class PrimitiveSet(PrimitiveSetTyped):
+    """Untyped set: every slot shares one type (gp.py:432-456)."""
+
+    def __init__(self, name, arity, prefix="ARG"):
+        super().__init__(name, [object] * arity, object, prefix)
+
+    def addPrimitive(self, fn, arity, name=None):
+        super().addPrimitive(fn, [object] * arity, object, name)
+
+    def addTerminal(self, value, name=None):
+        super().addTerminal(value, object, name)
+
+    def addEphemeralConstant(self, name, func):
+        super().addEphemeralConstant(name, func, object)
+
+
+# ----------------------------------------------------------------- compile --
+
+def compile(expr: PrimitiveTree, pset: PrimitiveSetTyped,
+            _adfs=None) -> Callable:
+    """Executable function from a tree — one iterative right-to-left
+    pass with a value stack instead of the reference's source-string
+    ``eval`` (gp.py:462-487): O(len(tree)) per call, no recursion, so
+    no depth limit beyond memory (the reference fails past depth ~90;
+    a recursive evaluator would merely move that to the interpreter's
+    recursion limit). Returns ``f(*args)`` when the set has inputs,
+    else the evaluated value. ``_adfs`` maps ADF names to callables
+    (bound by :func:`compileADF`)."""
+    arg_names = pset.arguments
+    nodes = list(expr)
+    adfs = _adfs or {}
+
+    def run(*args):
+        if len(args) != len(arg_names):
+            raise TypeError(
+                f"{pset.name} expects {len(arg_names)} arguments, "
+                f"got {len(args)}")
+        env = dict(zip(arg_names, args))
+        stack: list = []
+        for node in reversed(nodes):
+            if isinstance(node, Primitive):
+                vals = [stack.pop() for _ in range(node.arity)]
+                fn = node.fn if node.fn is not None else adfs[node.name]
+                stack.append(fn(*vals))
+            elif node.value is None and node.name in env:
+                stack.append(env[node.name])
+            else:
+                stack.append(node.value)
+        return stack[0]
+
+    if not arg_names:
+        return run()
+    return run
+
+
+def compileADF(expr, psets) -> Callable:
+    """Compile a multi-branch individual with automatically defined
+    functions (gp.py:490-513): branches are compiled last-first and
+    each earlier branch sees the later ones as callable primitives
+    (registered via ``addADF``) — bound per individual, never written
+    into the shared primitive set."""
+    adfdict: dict = {}
+    func = None
+    for subexpr, pset in reversed(list(zip(expr, psets))):
+        func = compile(subexpr, pset, _adfs=dict(adfdict))
+        adfdict[pset.name] = func
+    return func
+
+
+# -------------------------------------------------------------- generators --
+
+def _generate(pset, min_, max_, condition, type_=None):
+    if type_ is None:
+        type_ = pset.ret
+    expr = []
+    height = random.randint(min_, max_)
+    stack = [(0, type_)]
+    while stack:
+        depth, type_ = stack.pop()
+        if condition(height, depth):
+            terms = pset.terminals.get(type_, [])
+            if not terms:
+                raise IndexError(
+                    f"no terminal of type {type_} available")
+            term = random.choice(terms)
+            if isinstance(term, Ephemeral):
+                term = term.regen()
+            expr.append(term)
+        else:
+            prims = pset.primitives.get(type_, [])
+            if not prims:
+                # fall back to a terminal when no primitive fits (the
+                # reference raises; generators guard with condition)
+                terms = pset.terminals.get(type_, [])
+                if not terms:
+                    raise IndexError(
+                        f"no primitive or terminal of type {type_}")
+                term = random.choice(terms)
+                if isinstance(term, Ephemeral):
+                    term = term.regen()
+                expr.append(term)
+                continue
+            prim = random.choice(prims)
+            expr.append(prim)
+            for arg_t in reversed(prim.args):
+                stack.append((depth + 1, arg_t))
+    return expr
+
+
+def genFull(pset, min_, max_, type_=None):
+    """Leaves all at depth in [min, max] (gp.py:546-565)."""
+    return PrimitiveTree(_generate(
+        pset, min_, max_, lambda h, d: d == h, type_))
+
+
+def genGrow(pset, min_, max_, type_=None):
+    """Leaves at varying depths (gp.py:568-589)."""
+    def condition(height, depth):
+        return depth == height or (
+            depth >= min_ and random.random() < pset.terminalRatio)
+    return PrimitiveTree(_generate(pset, min_, max_, condition, type_))
+
+
+def genHalfAndHalf(pset, min_, max_, type_=None):
+    """Koza ramped half-and-half (gp.py:592-608)."""
+    return random.choice((genFull, genGrow))(pset, min_, max_, type_)
+
+
+# -------------------------------------------------------------- variation --
+
+def cxOnePoint(ind1, ind2):
+    """Type-aware subtree swap (gp.py:645-682)."""
+    if len(ind1) < 2 or len(ind2) < 2:
+        return ind1, ind2
+    types1: dict = {}
+    types2: dict = {}
+    for idx, node in enumerate(ind1[1:], 1):
+        types1.setdefault(node.ret, []).append(idx)
+    for idx, node in enumerate(ind2[1:], 1):
+        types2.setdefault(node.ret, []).append(idx)
+    common = set(types1) & set(types2)
+    if not common:
+        return ind1, ind2
+    type_ = random.choice(list(common))
+    i1 = random.choice(types1[type_])
+    i2 = random.choice(types2[type_])
+    s1, s2 = ind1.search_subtree(i1), ind2.search_subtree(i2)
+    ind1[s1], ind2[s2] = ind2[s2], ind1[s1]
+    return ind1, ind2
+
+
+def mutUniform(individual, expr, pset):
+    """Replace a random subtree with ``expr(pset=pset, type_=...)``
+    (gp.py:743-757)."""
+    index = random.randrange(len(individual))
+    slice_ = individual.search_subtree(index)
+    type_ = individual[index].ret
+    individual[slice_] = expr(pset=pset, type_=type_)
+    return (individual,)
+
+
+def mutNodeReplacement(individual, pset):
+    """Swap one node for another of the same arity/type
+    (gp.py:760-783)."""
+    index = random.randrange(len(individual))
+    node = individual[index]
+    if node.arity == 0:
+        terms = pset.terminals.get(node.ret, [])
+        if terms:
+            term = random.choice(terms)
+            if isinstance(term, Ephemeral):
+                term = term.regen()
+            individual[index] = term
+    else:
+        prims = [p for p in pset.primitives.get(node.ret, [])
+                 if p.args == node.args]
+        if prims:
+            individual[index] = random.choice(prims)
+    return (individual,)
+
+
+def mutEphemeral(individual, mode="one"):
+    """Redraw ephemeral constant values (gp.py:786-811)."""
+    if mode not in ("one", "all"):
+        raise ValueError("Mode must be one of 'one' or 'all'")
+    ephemerals = [i for i, node in enumerate(individual)
+                  if isinstance(node, Ephemeral)]
+    if ephemerals:
+        if mode == "one":
+            ephemerals = [random.choice(ephemerals)]
+        for i in ephemerals:
+            individual[i] = individual[i].regen()
+    return (individual,)
+
+
+def mutInsert(individual, pset):
+    """Insert a primitive above a random subtree; the old subtree
+    becomes one argument, the rest are fresh terminals
+    (gp.py:814-851)."""
+    index = random.randrange(len(individual))
+    node = individual[index]
+    slice_ = individual.search_subtree(index)
+    choices = [p for p in pset.primitives.get(node.ret, [])
+               if node.ret in p.args]
+    if not choices:
+        return (individual,)
+    new_node = random.choice(choices)
+    position = random.choice(
+        [i for i, t in enumerate(new_node.args) if t == node.ret])
+    new_subtree: list = []
+    for i, arg_type in enumerate(new_node.args):
+        if i == position:
+            new_subtree.extend(individual[slice_])
+        else:
+            terms = pset.terminals.get(arg_type, [])
+            if not terms:
+                return (individual,)
+            term = random.choice(terms)
+            if isinstance(term, Ephemeral):
+                term = term.regen()
+            new_subtree.append(term)
+    individual[slice_.start:slice_.stop] = [new_node] + new_subtree
+    return (individual,)
+
+
+def mutShrink(individual, *_):
+    """Replace a random primitive by one of its argument subtrees
+    (gp.py:854-886). The root is never shrunk and trees at/below one
+    level are returned unchanged (gp.py:862-863)."""
+    if len(individual) < 3 or individual.height <= 1:
+        return (individual,)
+    prims = [i for i, node in enumerate(individual)
+             if isinstance(node, Primitive) and i != 0]
+    if not prims:
+        return (individual,)
+    index = random.choice(prims)
+    node = individual[index]
+    # pick an argument subtree whose type matches the node's return
+    arg_idx = [i for i, t in enumerate(node.args) if t == node.ret]
+    if not arg_idx:
+        return (individual,)
+    chosen = random.choice(arg_idx)
+    j = index + 1
+    for _ in range(chosen):
+        j = individual.search_subtree(j).stop
+    sub = individual[individual.search_subtree(j)]
+    individual[individual.search_subtree(index)] = sub
+    return (individual,)
+
+
+def staticLimit(key: Callable, max_value):
+    """Reject-with-parent decorator (Koza limit; gp.py:890-931)."""
+    def decorator(func):
+        @wraps(func)
+        def wrapper(*args, **kwargs):
+            keep = [copy.deepcopy(ind) for ind in args
+                    if isinstance(ind, PrimitiveTree)]
+            new = func(*args, **kwargs)
+            out = list(new)
+            for i, ind in enumerate(out):
+                if isinstance(ind, PrimitiveTree) and key(ind) > max_value:
+                    out[i] = copy.deepcopy(random.choice(keep))
+            return tuple(out)
+        return wrapper
+    return decorator

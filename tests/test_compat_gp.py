@@ -1,0 +1,178 @@
+"""compat.gp: the reference's list-based GP API end-to-end — symbolic
+regression with creator/toolbox/eaSimple, stack-compile (no eval), and
+the variation operators' structural invariants."""
+
+import operator
+import random
+
+import pytest
+
+from deap_tpu.compat import algorithms, base, creator, gp, tools
+
+
+def protected_div(a, b):
+    return 1.0 if b == 0 else a / b
+
+
+@pytest.fixture
+def pset():
+    ps = gp.PrimitiveSet("MAIN", 1)
+    ps.addPrimitive(operator.add, 2)
+    ps.addPrimitive(operator.sub, 2)
+    ps.addPrimitive(operator.mul, 2)
+    ps.addPrimitive(protected_div, 2, name="div")
+    ps.addTerminal(1.0)
+    ps.addEphemeralConstant("rand101", lambda: random.uniform(-1, 1))
+    ps.renameArguments(ARG0="x")
+    return ps
+
+
+def valid_prefix(tree):
+    need = 1
+    for node in tree:
+        need += node.arity - 1
+    return need == 0
+
+
+def test_generate_compile_eval(pset):
+    random.seed(7)
+    for _ in range(20):
+        t = gp.genHalfAndHalf(pset, 1, 4)
+        assert valid_prefix(t)
+        f = gp.compile(t, pset)
+        v = f(1.5)
+        assert isinstance(v, float)
+    s = str(t)
+    assert s  # printable
+
+
+def test_compile_known_tree(pset):
+    add = pset.mapping["add"]
+    mul = pset.mapping["mul"]
+    x = pset.mapping["x"]
+    one = pset.mapping["1.0"]
+    # (x + 1) * x
+    t = gp.PrimitiveTree([mul, add, x, one, x])
+    f = gp.compile(t, pset)
+    assert f(3.0) == 12.0
+    assert "mul(add(x, 1.0), x)" == str(t)
+    assert t.height == 2
+    assert t.search_subtree(1) == slice(1, 4)
+
+
+def test_crossover_and_mutations_preserve_validity(pset):
+    random.seed(11)
+    for _ in range(30):
+        a = gp.genFull(pset, 2, 3)
+        b = gp.genGrow(pset, 2, 4)
+        c1, c2 = gp.cxOnePoint(gp.PrimitiveTree(a), gp.PrimitiveTree(b))
+        assert valid_prefix(c1) and valid_prefix(c2)
+        m1, = gp.mutUniform(gp.PrimitiveTree(a),
+                            lambda pset, type_: gp.genGrow(pset, 0, 2),
+                            pset)
+        assert valid_prefix(m1)
+        m2, = gp.mutNodeReplacement(gp.PrimitiveTree(a), pset)
+        assert valid_prefix(m2)
+        m3, = gp.mutEphemeral(gp.PrimitiveTree(a))
+        assert valid_prefix(m3)
+        m4, = gp.mutInsert(gp.PrimitiveTree(a), pset)
+        assert valid_prefix(m4) and len(m4) >= len(a)
+        m5, = gp.mutShrink(gp.PrimitiveTree(gp.genFull(pset, 2, 2)))
+        assert valid_prefix(m5)
+
+
+def test_static_limit(pset):
+    random.seed(3)
+    deep = gp.genFull(pset, 5, 5)
+    limited = gp.staticLimit(key=lambda t: t.height, max_value=3)(
+        lambda t: (t,))
+    out, = limited(gp.PrimitiveTree(deep))
+    assert out.height <= 5  # parent returned (height 5 parent kept)
+
+
+def test_symbreg_end_to_end(pset):
+    """Mini quartic regression via the full reference workflow
+    (examples/gp/symbreg.py shape)."""
+    random.seed(318)
+    creator.create("FitnessMinGP", base.Fitness, weights=(-1.0,))
+    creator.create("IndividualGP", gp.PrimitiveTree,
+                   fitness=creator.FitnessMinGP)
+
+    toolbox = base.Toolbox()
+    toolbox.register("expr", gp.genHalfAndHalf, pset=pset, min_=1, max_=2)
+    toolbox.register("individual", lambda: creator.IndividualGP(
+        toolbox.expr()))
+    toolbox.register("population", lambda n: [toolbox.individual()
+                                              for _ in range(n)])
+
+    points = [x / 10.0 for x in range(-10, 10)]
+
+    def evaluate(ind):
+        f = gp.compile(ind, pset)
+        err = 0.0
+        for x in points:
+            err += (f(x) - (x ** 4 + x ** 3 + x ** 2 + x)) ** 2
+        return (err / len(points),)
+
+    toolbox.register("evaluate", evaluate)
+    toolbox.register("select", tools.selTournament, tournsize=3)
+    toolbox.register("mate", gp.cxOnePoint)
+    toolbox.register("expr_mut", gp.genFull, min_=0, max_=2)
+    toolbox.register("mutate", gp.mutUniform, expr=lambda pset, type_:
+                     toolbox.expr_mut(pset=pset), pset=pset)
+    toolbox.decorate("mate", gp.staticLimit(
+        key=lambda t: t.height, max_value=17))
+    toolbox.decorate("mutate", gp.staticLimit(
+        key=lambda t: t.height, max_value=17))
+
+    pop = toolbox.population(60)
+    pop, logbook = algorithms.eaSimple(
+        pop, toolbox, cxpb=0.5, mutpb=0.2, ngen=8, verbose=False)
+    best = min(pop, key=lambda i: i.fitness.values[0])
+    assert best.fitness.values[0] < 5.0  # improved well past random
+
+
+def test_compile_iterative_no_depth_limit(pset):
+    # 3000-deep unary chain: the reference's eval dies past ~90; a
+    # recursive evaluator would die near the interpreter limit
+    pset2 = gp.PrimitiveSet("DEEP", 1)
+    pset2.addPrimitive(lambda a: a + 1.0, 1, name="inc")
+    inc = pset2.mapping["inc"]
+    x = pset2.mapping["ARG0"]
+    t = gp.PrimitiveTree([inc] * 3000 + [x])
+    f = gp.compile(t, pset2)
+    assert f(0.0) == 3000.0
+
+
+def test_compile_adf_with_arguments():
+    adf = gp.PrimitiveSet("ADF0", 1)
+    adf.addPrimitive(operator.mul, 2)
+    main = gp.PrimitiveSet("MAIN", 1)
+    main.addPrimitive(operator.add, 2)
+    main.addADF(adf)
+    # ADF0(x) = x * x; main = add(x, ADF0(x)) -> x + x^2
+    t_adf = gp.PrimitiveTree([adf.mapping["mul"], adf.mapping["ARG0"],
+                              adf.mapping["ARG0"]])
+    t_main = gp.PrimitiveTree([main.mapping["add"], main.mapping["ARG0"],
+                               main.mapping["ADF0"], main.mapping["ARG0"]])
+    f = gp.compileADF([t_main, t_adf], [main, adf])
+    assert f(3.0) == 12.0
+    # shared sets are not mutated: a second individual compiles cleanly
+    f2 = gp.compileADF([t_main, t_adf], [main, adf])
+    assert f2(2.0) == 6.0
+    assert main.mapping["ADF0"].fn is None
+
+
+def test_mut_ephemeral_rejects_bad_mode(pset):
+    t = gp.genFull(pset, 1, 2)
+    with pytest.raises(ValueError):
+        gp.mutEphemeral(gp.PrimitiveTree(t), mode="On")
+
+
+def test_mut_shrink_keeps_tiny_trees(pset):
+    add = pset.mapping["add"]
+    x = pset.mapping["x"]
+    one = pset.mapping["1.0"]
+    t = gp.PrimitiveTree([add, x, one])
+    out, = gp.mutShrink(gp.PrimitiveTree(t))
+    assert list(out) == list(t)  # height 1: never shrunk (gp.py:862-863)
