@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import copy
 import random
+import warnings
 from functools import wraps
 from typing import Callable, List
 
@@ -74,6 +75,30 @@ class Terminal:
         return hash(self.name)
 
 
+#: ephemeral templates by name — lets trees holding lambda-backed
+#: ephemerals pickle by *name* (the reference reaches the same end by
+#: caching a dynamically-created class per ephemeral in the gp module,
+#: gp.py:247-257 + MetaEphemeral registry)
+_EPHEMERAL_REGISTRY: dict = {}
+
+
+def _restore_ephemeral(name, value):
+    try:
+        template = _EPHEMERAL_REGISTRY[name]
+    except KeyError:
+        raise RuntimeError(
+            f"cannot restore ephemeral constant {name!r}: its primitive "
+            "set has not been built in this process — call "
+            "addEphemeralConstant (rebuild the pset) before unpickling "
+            "or copying individuals that use it") from None
+    e = Ephemeral.__new__(Ephemeral)
+    e.func = template.func
+    e.name = name
+    e.value = value
+    e.ret = template.ret
+    return e
+
+
 class Ephemeral(Terminal):
     """A terminal whose value is drawn fresh per occurrence
     (gp.py:247-257)."""
@@ -86,6 +111,11 @@ class Ephemeral(Terminal):
 
     def regen(self):
         return Ephemeral(self.name, self.func, self.ret)
+
+    def __reduce__(self):
+        # the generator function itself may be a lambda; pickle the
+        # (registered) name + drawn value instead
+        return (_restore_ephemeral, (self.name, self.value))
 
 
 class PrimitiveTree(list):
@@ -175,7 +205,20 @@ class PrimitiveSetTyped:
         self._add_terminal(Terminal(name, value, ret_type))
 
     def addEphemeralConstant(self, name, func, ret_type):
-        self._add_terminal(Ephemeral(name, func, ret_type))
+        existing = _EPHEMERAL_REGISTRY.get(name)
+        if existing is not None and existing.func is not func:
+            # the name is the pickle/copy identity (the reference raises
+            # here, gp.py:402-408; warn-and-overwrite keeps the common
+            # rebuild-the-pset-with-a-fresh-lambda workflow alive while
+            # still flagging genuine cross-pset collisions)
+            warnings.warn(
+                f"ephemeral constant {name!r} is being re-registered "
+                "with a different function; restored/copied individuals "
+                "will draw from the NEW generator. Name ephemerals "
+                "uniquely across primitive sets.", RuntimeWarning)
+        eph = Ephemeral(name, func, ret_type)
+        _EPHEMERAL_REGISTRY[name] = eph
+        self._add_terminal(eph)
 
     def addADF(self, adfset: "PrimitiveSetTyped"):
         """Register a callable slot for an automatically defined

@@ -34,6 +34,14 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental import pallas as pl  # noqa: F401 (kernel plumbing)
+from jax.experimental.pallas import tpu as pltpu
+
+from deap_tpu.ops.crossover import _two_points
+# shared with the byte-genome kernel: bits -> U[0,1) and the adjacent-
+# pair draw-consistency roll must stay identical across both kernels
+from deap_tpu.ops.kernels import _pair_consistent
+from deap_tpu.ops.kernels import _u01 as _u01_from_bits
 
 __all__ = [
     "pack_genomes",
@@ -104,18 +112,10 @@ def segment_mask_words(lo: jnp.ndarray, hi: jnp.ndarray, W: int) -> jnp.ndarray:
     return _bits_below(hi) & ~_bits_below(lo)
 
 
-def _two_points(key, L):
-    """The reference's two-point draw (tools/crossover.py:44-50)."""
-    k1, k2 = jax.random.split(key)
-    p1 = jax.random.randint(k1, (), 1, L + 1)
-    p2 = jax.random.randint(k2, (), 1, L)
-    p2 = jnp.where(p2 >= p1, p2 + 1, p2)
-    return jnp.minimum(p1, p2), jnp.maximum(p1, p2)
-
-
 def cx_two_point_packed(key, g1, g2, length: int):
     """Two-point crossover on packed rows ``uint32[W]`` — word-masked
-    segment swap, same ``(p1, p2)`` distribution as ``cx_two_point``."""
+    segment swap, the same ``(p1, p2)`` draw as ``cx_two_point``
+    (shared ``crossover._two_points``, tools/crossover.py:44-50)."""
     lo, hi = _two_points(key, length)
     m = segment_mask_words(lo, hi, g1.shape[-1])
     return (g1 & ~m) | (g2 & m), (g2 & ~m) | (g1 & m)
@@ -143,20 +143,12 @@ def mut_flip_bit_packed(key, g, indpb: float, length: int):
 
 # ------------------------------------------------- fused Pallas kernel ----
 
-# shared with the byte-genome kernel: bits -> U[0,1) and the adjacent-
-# pair draw-consistency roll must stay identical across both kernels
-from deap_tpu.ops.kernels import _pair_consistent  # noqa: E402
-from deap_tpu.ops.kernels import _u01 as _u01_from_bits  # noqa: E402
-
-
 def _packed_body(g, pairu, rowu, gene_u01, *, n, L, W, TI, Wp, cxpb, mutpb,
                  indpb, tile_idx):
     """Kernel body on a ``uint32[TI, Wp]`` tile. ``gene_u01(b)`` returns
     a fresh ``[TI, Wp]`` uniform draw for bit position ``b`` (kept 2-D so
     every op is a plain lane-aligned vector op); pair draws must already
     be pair-consistent."""
-    from jax.experimental.pallas import tpu as pltpu
-
     col = jax.lax.broadcasted_iota(jnp.int32, (TI, Wp), 1)
     row = jax.lax.broadcasted_iota(jnp.int32, (TI, Wp), 0)
     word_start = col * WORD
@@ -194,8 +186,6 @@ def _packed_body(g, pairu, rowu, gene_u01, *, n, L, W, TI, Wp, cxpb, mutpb,
 
 def _packed_kernel_bits(g_ref, pairbits_ref, rowbits_ref, genebits_ref,
                         out_ref, fit_ref, *, n, L, W, cxpb, mutpb, indpb):
-    from jax.experimental import pallas as pl
-
     TI, Wp = g_ref.shape
 
     def gene_u01(b):  # lane-aligned contiguous slice of the bit plane
@@ -212,9 +202,6 @@ def _packed_kernel_bits(g_ref, pairbits_ref, rowbits_ref, genebits_ref,
 
 def _packed_kernel_hw(seed_ref, g_ref, out_ref, fit_ref, *, n, L, W, cxpb,
                       mutpb, indpb):
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
     TI, Wp = g_ref.shape
     i = pl.program_id(0)
     pltpu.prng_seed(seed_ref[0] + i)

@@ -14,6 +14,12 @@ def protected_div(a, b):
     return 1.0 if b == 0 else a / b
 
 
+def _rand101():
+    # module-level so per-test pset rebuilds re-register the SAME
+    # generator (a fresh lambda per rebuild would warn — by design)
+    return random.uniform(-1, 1)
+
+
 @pytest.fixture
 def pset():
     ps = gp.PrimitiveSet("MAIN", 1)
@@ -22,7 +28,7 @@ def pset():
     ps.addPrimitive(operator.mul, 2)
     ps.addPrimitive(protected_div, 2, name="div")
     ps.addTerminal(1.0)
-    ps.addEphemeralConstant("rand101", lambda: random.uniform(-1, 1))
+    ps.addEphemeralConstant("rand101", _rand101)
     ps.renameArguments(ARG0="x")
     return ps
 
@@ -83,11 +89,20 @@ def test_crossover_and_mutations_preserve_validity(pset):
 
 def test_static_limit(pset):
     random.seed(3)
-    deep = gp.genFull(pset, 5, 5)
+    parent = gp.PrimitiveTree(gp.genFull(pset, 2, 2))
+    deep = gp.PrimitiveTree(gp.genFull(pset, 5, 5))
+    # operator returns an over-limit offspring: the decorator must hand
+    # back a copy of the *parent* instead (gp.py:890-931)
     limited = gp.staticLimit(key=lambda t: t.height, max_value=3)(
-        lambda t: (t,))
-    out, = limited(gp.PrimitiveTree(deep))
-    assert out.height <= 5  # parent returned (height 5 parent kept)
+        lambda t: (deep,))
+    out, = limited(parent)
+    assert out is not parent and list(out) == list(parent)
+    # under-limit offspring pass through untouched
+    ok = gp.PrimitiveTree(gp.genFull(pset, 1, 1))
+    passthrough = gp.staticLimit(key=lambda t: t.height, max_value=3)(
+        lambda t: (ok,))
+    out2, = passthrough(parent)
+    assert out2 is ok
 
 
 def test_symbreg_end_to_end(pset):
@@ -176,3 +191,36 @@ def test_mut_shrink_keeps_tiny_trees(pset):
     t = gp.PrimitiveTree([add, x, one])
     out, = gp.mutShrink(gp.PrimitiveTree(t))
     assert list(out) == list(t)  # height 1: never shrunk (gp.py:862-863)
+
+
+def test_gp_tree_pickle_roundtrip(pset):
+    """GP trees incl. ephemerals round-trip (test_pickle.py:109-131)."""
+    import pickle
+
+    random.seed(99)
+    creator.create("FMinP", base.Fitness, weights=(-1.0,))
+    creator.create("IndP", gp.PrimitiveTree, fitness=creator.FMinP)
+    ind = creator.IndP(gp.genFull(pset, 2, 3))
+    ind.fitness.values = (1.5,)
+    clone = pickle.loads(pickle.dumps(ind))
+    assert str(clone) == str(ind)
+    assert clone.fitness.values == (1.5,)
+    f1, f2 = gp.compile(ind, pset), gp.compile(clone, pset)
+    assert f1(0.7) == f2(0.7)
+
+
+def test_ephemeral_name_collision_warns():
+    a = gp.PrimitiveSet("EA", 1)
+    fn = lambda: 0.5
+    a.addEphemeralConstant("shared_eph", fn)
+    b = gp.PrimitiveSet("EB", 1)
+    b.addEphemeralConstant("shared_eph", fn)  # same function: silent
+    with pytest.warns(RuntimeWarning, match="re-registered"):
+        b.addEphemeralConstant("shared_eph", lambda: 999.0)
+
+
+def test_ephemeral_restore_unregistered_is_diagnosable():
+    from deap_tpu.compat.gp import _restore_ephemeral
+
+    with pytest.raises(RuntimeError, match="has not been built"):
+        _restore_ephemeral("never_registered_eph", 1.0)
