@@ -222,3 +222,229 @@ class HallOfFame:
 
 # the tensor Logbook is already a plain list-of-dicts structure — shared
 from deap_tpu.support.logbook import Logbook  # noqa: E402,F401
+
+
+# ------------------------------------------------ multi-objective (emo) ----
+# List-individual fronts for the reference's tools.emo surface: fitness
+# wvalues are gathered into one array and the tensor kernels in
+# deap_tpu.mo do the O(MN²) work (the bridge pattern of compat.jax_map:
+# individuals stay Python objects, math runs batched).
+
+def _wvalues(individuals):
+    import numpy as np
+
+    return np.asarray([ind.fitness.wvalues for ind in individuals],
+                      dtype=np.float32)
+
+
+def _mo():
+    import jax
+    import jax.numpy as jnp
+
+    from deap_tpu.mo import emo
+    return jax, jnp, emo
+
+
+def sortNondominated(individuals, k, first_front_only=False):
+    """List of non-dominated fronts covering at least ``k`` individuals
+    (emo.py:53-117); ``k == 0`` returns no fronts (emo.py:70)."""
+    import numpy as np
+
+    if k == 0 or not individuals:
+        return []
+    jax, jnp, emo = _mo()
+    ranks = np.asarray(emo.nd_rank(jnp.asarray(_wvalues(individuals)),
+                                   impl="matrix"))
+    fronts = []
+    total = 0
+    for r in range(int(ranks.max()) + 1 if len(ranks) else 0):
+        front = [individuals[i] for i in np.flatnonzero(ranks == r)]
+        fronts.append(front)
+        total += len(front)
+        if first_front_only or total >= k:
+            break
+    return fronts
+
+
+def assignCrowdingDist(individuals):
+    """Attach ``fitness.crowding_dist`` per individual (emo.py:119-143).
+    All individuals are treated as one front, matching the reference's
+    per-front calls."""
+    import numpy as np
+
+    if not individuals:
+        return
+    jax, jnp, emo = _mo()
+    w = jnp.asarray(_wvalues(individuals))
+    dists = np.asarray(emo.crowding_distances(
+        w, jnp.zeros(len(individuals), jnp.int32)))
+    for ind, d in zip(individuals, dists):
+        ind.fitness.crowding_dist = float(d)
+
+
+def selNSGA2(individuals, k, nd="standard"):
+    """NSGA-II environmental selection over list individuals
+    (emo.py:15-50)."""
+    import numpy as np
+
+    jax, jnp, emo = _mo()
+    idx = np.asarray(emo.sel_nsga2(
+        jax.random.key(0), jnp.asarray(_wvalues(individuals)), k, nd=nd))
+    return [individuals[i] for i in idx]
+
+
+def selSPEA2(individuals, k):
+    """SPEA2 environmental selection (emo.py:692-842)."""
+    import numpy as np
+
+    jax, jnp, emo = _mo()
+    idx = np.asarray(emo.sel_spea2(
+        jax.random.key(0), jnp.asarray(_wvalues(individuals)), k))
+    return [individuals[i] for i in idx]
+
+
+def selNSGA3(individuals, k, ref_points, nd="log"):
+    """NSGA-III reference-point selection (emo.py:479-561). Randomized
+    niching draws from the stdlib ``random`` stream like every other
+    compat operator; ``nd`` accepted for reference parity (both sort
+    variants hit the same kernel)."""
+    import numpy as np
+
+    del nd
+    jax, jnp, emo = _mo()
+    key = jax.random.key(random.getrandbits(32))
+    idx = np.asarray(emo.sel_nsga3(
+        key, jnp.asarray(_wvalues(individuals)), k,
+        jnp.asarray(ref_points)))
+    return [individuals[i] for i in idx]
+
+
+def selTournamentDCD(individuals, k):
+    """Dominance/crowding binary tournament (emo.py:145-195); requires
+    ``assignCrowdingDist`` semantics, which the kernel recomputes."""
+    import numpy as np
+
+    jax, jnp, emo = _mo()
+    key = jax.random.key(random.getrandbits(32))
+    idx = np.asarray(emo.sel_tournament_dcd(
+        key, jnp.asarray(_wvalues(individuals)), k))
+    return [individuals[i] for i in idx]
+
+
+def uniformReferencePoints(nobj, p=4, scaling=None):
+    """Das-Dennis reference points for selNSGA3 (emo.py:664-689)."""
+    import numpy as np
+
+    _, _, emo = _mo()
+    return np.asarray(emo.uniform_reference_points(nobj, p, scaling))
+
+
+#: reference name (emo.py:664) — programs call tools.uniform_reference_points
+uniform_reference_points = uniformReferencePoints
+
+
+# ----------------------------------------------------------- migration ----
+
+def migRing(populations, k, selection, replacement=None,
+            migarray=None):
+    """In-place ring migration between list demes (migration.py:4-51):
+    deme i's k selected emigrants replace deme (i+1)'s k
+    replacement-selected (default: same selection) individuals."""
+    nbr = len(populations)
+    if migarray is None:
+        migarray = [(i + 1) % nbr for i in range(nbr)]
+    immigrants = [selection(pop, k) for pop in populations]
+    if replacement is None:
+        replaced = immigrants
+    else:
+        replaced = [replacement(pop, k) for pop in populations]
+    for from_deme, to_deme in enumerate(migarray):
+        pop = populations[to_deme]
+        for out_ind, in_ind in zip(replaced[to_deme],
+                                   immigrants[from_deme]):
+            pop[pop.index(out_ind)] = deepcopy(in_ind)
+
+
+# -------------------------------------------------------- ParetoFront ----
+
+class ParetoFront(HallOfFame):
+    """Unbounded archive of the first non-dominated front
+    (support.py:591-640): inserts keep only mutually non-dominated,
+    non-duplicate individuals."""
+
+    def __init__(self, similar=None):
+        super().__init__(None, similar or (lambda a, b: list(a) == list(b)))
+
+    def update(self, population):
+        for ind in population:
+            dominated = False
+            to_remove = []
+            for i, hofer in enumerate(self.items):
+                if hofer.fitness.dominates(ind.fitness):
+                    dominated = True
+                    break
+                if ind.fitness.dominates(hofer.fitness):
+                    to_remove.append(i)
+                elif ind.fitness == hofer.fitness and self.similar(
+                        ind, hofer):
+                    dominated = True
+                    break
+            if not dominated:
+                for i in reversed(to_remove):
+                    self.remove(i)
+                self.insert(ind)  # insert deepcopies
+
+
+# ------------------------------------------------------------ History ----
+
+class History:
+    """Genealogy tracer (support.py:21-152): decorate variation
+    operators; every produced individual gets a ``history_index`` and a
+    parent-index record replayable with :meth:`getGenealogy`."""
+
+    def __init__(self):
+        self.genealogy_index = 0
+        self.genealogy_history: dict = {}
+        self.genealogy_tree: dict = {}
+
+    def update(self, individuals):
+        parents = [getattr(ind, "history_index", None)
+                   for ind in individuals]
+        parents = [p for p in parents if p is not None]
+        for ind in individuals:
+            self.genealogy_index += 1
+            ind.history_index = self.genealogy_index
+            self.genealogy_history[self.genealogy_index] = deepcopy(ind)
+            self.genealogy_tree[self.genealogy_index] = parents
+
+    @property
+    def decorator(self):
+        def wrap(func):
+            def wrapped(*args, **kwargs):
+                inds = func(*args, **kwargs)
+                self.update(list(inds))
+                return inds
+            return wrapped
+        return wrap
+
+    def getGenealogy(self, individual, max_depth=float("inf")):
+        """Parent-tree dict rooted at ``individual`` (support.py:123-152).
+        ``max_depth`` counts generations like the reference: 1 = the
+        individual's own entry only, 0 = empty."""
+        gtree = {}
+        visited = set()
+
+        def walk(index, depth):
+            if index not in self.genealogy_tree:
+                return
+            depth += 1
+            if depth > max_depth or index in visited:
+                return
+            visited.add(index)
+            parents = self.genealogy_tree[index]
+            gtree[index] = parents
+            for p in parents:
+                walk(p, depth)
+
+        walk(individual.history_index, 0)
+        return gtree

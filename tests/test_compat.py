@@ -168,3 +168,110 @@ def test_multistatistics_and_varor(types):
         pop, tb, mu=20, lambda_=40, cxpb=0.4, mutpb=0.4, ngen=5,
         stats=stats)
     assert "fitness" in logbook.chapters and "size" in logbook.chapters
+
+
+# -------------------------------------------- MO selectors / support ----
+
+def _mo_population(n=20, seed=5):
+    import random as _r
+
+    _r.seed(seed)
+    creator.create("FMin2", base.Fitness, weights=(-1.0, -1.0))
+    creator.create("IndMO", list, fitness=creator.FMin2)
+    pop = []
+    for _ in range(n):
+        ind = creator.IndMO([_r.random(), _r.random()])
+        ind.fitness.values = (ind[0], ind[1])
+        pop.append(ind)
+    return pop
+
+
+def test_sort_nondominated_fronts_are_nondominated():
+    pop = _mo_population()
+    fronts = tools.sortNondominated(pop, len(pop))
+    assert sum(len(f) for f in fronts) == len(pop)
+    first = fronts[0]
+    for a in first:
+        for b in first:
+            assert not a.fitness.dominates(b.fitness)
+
+
+def test_sel_nsga2_and_crowding():
+    pop = _mo_population(30)
+    chosen = tools.selNSGA2(pop, 10)
+    assert len(chosen) == 10
+    # every first-front member that fits must be selected (emo.py:15-50)
+    first = {id(i) for i in tools.sortNondominated(pop, 10)[0]}
+    chosen_ids = {id(c) for c in chosen}
+    if len(first) <= 10:
+        assert first <= chosen_ids
+    tools.assignCrowdingDist(pop)
+    assert all(hasattr(p.fitness, "crowding_dist") for p in pop)
+    assert tools.sortNondominated(pop, 0) == []
+    assert tools.sortNondominated([], 5) == []
+
+
+def test_sel_spea2_and_tournament_dcd():
+    pop = _mo_population(24)
+    assert len(tools.selSPEA2(pop, 8)) == 8
+    assert len(tools.selTournamentDCD(pop, 12)) == 12
+
+
+def test_sel_nsga3_with_reference_points():
+    pop = _mo_population(24)
+    ref = tools.uniformReferencePoints(2, p=6)
+    chosen = tools.selNSGA3(pop, 8, ref)
+    assert len(chosen) == 8
+
+
+def test_pareto_front_archive():
+    pop = _mo_population(40)
+    front = tools.ParetoFront()
+    front.update(pop)
+    for a in front:
+        for b in front:
+            assert not a.fitness.dominates(b.fitness)
+    # re-update with the same population: no duplicates
+    n = len(front)
+    front.update(pop)
+    assert len(front) == n
+
+
+def test_mig_ring_exchanges_best():
+    import random as _r
+
+    _r.seed(9)
+    creator.create("FMax2", base.Fitness, weights=(1.0,))
+    creator.create("IndM", list, fitness=creator.FMax2)
+    demes = []
+    for d in range(3):
+        deme = []
+        for i in range(5):
+            ind = creator.IndM([d * 10 + i])
+            ind.fitness.values = (float(d * 10 + i),)
+            deme.append(ind)
+        demes.append(deme)
+    tools.migRing(demes, 2, tools.selBest)
+    # deme 1 received deme 0's best (9 came from deme 0? deme0 best = 4)
+    vals1 = sorted(ind[0] for ind in demes[1])
+    assert 4 in vals1 and 3 in vals1
+
+
+def test_history_genealogy():
+    """Reference idiom (support.py:21-152): variation mutates its inputs
+    in place, so the produced individuals' OLD indices are the parent
+    record."""
+    creator.create("FMaxH", base.Fitness, weights=(1.0,))
+    creator.create("IndH", list, fitness=creator.FMaxH)
+    hist = tools.History()
+    a, b = creator.IndH([1]), creator.IndH([2])
+    hist.update([a, b])
+    pa, pb = a.history_index, b.history_index
+
+    def mate(x, y):
+        x[0], y[0] = y[0], x[0]  # in-place variation
+        return x, y
+
+    out1, out2 = hist.decorator(mate)(a, b)
+    g = hist.getGenealogy(out1)
+    assert set(g[out1.history_index]) == {pa, pb}
