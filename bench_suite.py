@@ -1,0 +1,148 @@
+"""Secondary benchmark suite (bench.py measures the onemax headline).
+
+Measures generations/sec on three configurations spanning the
+framework's main engines beyond the north-star GA, each against the
+reference CPU throughput measured on this machine (BASELINE.md
+"Secondary configs"):
+
+1. ``cmaes_n100_lam4096`` — full Hansen CMA-ES ask-tell on sphere
+   (reference deap/cma.py:84-171 driven by eaGenerateUpdate): generate,
+   batched evaluate, covariance/eigh update all in one scanned step.
+2. ``nsga2_zdt1_pop2000`` — the canonical NSGA-II generation
+   (examples/ga/nsga2.py shape: selTournamentDCD → SBX-bounded +
+   polynomial mutation → zdt1 → selNSGA2 over pop+offspring).
+3. ``rastrigin_n30_pop100k`` — real-valued eaSimple GA (cxBlend α=0.5 +
+   mutGaussian σ=0.3, selTournament 3) on rastrigin.
+
+Prints one JSON line per config:
+  {"metric": ..., "value": N, "unit": "gens/sec", "vs_baseline": N}
+
+Reference numbers were produced by the 2to3-converted reference run
+from /tmp scratch, timed generations after warmup — mean of 3 (mean of
+2 for the pop=100k GA), matching BASELINE.md's recipe.
+"""
+
+import json
+
+# reuse bench.py's axon-tunnel probe + platform forcing side effects
+import bench  # noqa: F401  (must precede jax import)
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deap_tpu import benchmarks, ops
+from deap_tpu.algorithms import evaluate_invalid, var_and
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import concat, gather, init_population
+from deap_tpu.core.toolbox import Toolbox
+from deap_tpu.mo.emo import sel_nsga2, sel_tournament_dcd
+from deap_tpu.strategies.cma import Strategy
+
+# CPU reference gens/sec, measured 2026-07-30 (BASELINE.md)
+REF = {
+    "cmaes_n100_lam4096": 6.6318,
+    "nsga2_zdt1_pop2000": 0.1662,
+    "rastrigin_n30_pop100k": 0.2693,
+}
+
+NGEN = 50
+REPS = 3
+
+
+def _time(run, *args):
+    """gens/sec via bench.py's warmup + best-of-REPS timing harness."""
+    bench.REPS = REPS
+    return NGEN / bench._time(run, *args)
+
+
+def bench_cmaes():
+    strat = Strategy(jnp.full(100, 5.0), sigma=0.5, lambda_=4096)
+    state = strat.initial_state()
+    ev = jax.vmap(benchmarks.sphere)
+
+    @jax.jit
+    def run(key, state):
+        def step(st, k):
+            pop = strat.generate(k, st)
+            return strat.update(st, pop, ev(pop)), 0
+
+        st, _ = lax.scan(step, state, jax.random.split(key, NGEN))
+        return st.centroid
+
+    return _time(run, state)
+
+
+def bench_nsga2():
+    NDIM, MU = 30, 2000
+    spec = FitnessSpec((-1.0, -1.0))
+    tb = Toolbox()
+    tb.register("evaluate", jax.vmap(benchmarks.zdt1))
+    tb.register("mate", ops.cx_simulated_binary_bounded,
+                eta=20.0, low=0.0, up=1.0)
+    tb.register("mutate", ops.mut_polynomial_bounded,
+                eta=20.0, low=0.0, up=1.0, indpb=1.0 / NDIM)
+    pop = init_population(jax.random.key(1), MU,
+                          ops.uniform_genome(NDIM, 0.0, 1.0), spec)
+    pop = evaluate_invalid(pop, tb.evaluate)
+
+    @jax.jit
+    def run(key, pop):
+        def step(p, k):
+            k1, k2 = jax.random.split(k)
+            idx = sel_tournament_dcd(k1, p.wvalues, MU)
+            off = var_and(k2, gather(p, idx), tb, 0.9, 1.0)
+            off = evaluate_invalid(off, tb.evaluate)
+            comb = concat([p, off])
+            return gather(comb, sel_nsga2(None, comb.wvalues, MU)), 0
+
+        p, _ = lax.scan(step, pop, jax.random.split(key, NGEN))
+        return p.wvalues
+
+    return _time(run, pop)
+
+
+def bench_rastrigin():
+    N, POP = 30, 100_000
+    tb = Toolbox()
+    tb.register("evaluate", jax.vmap(benchmarks.rastrigin))
+    tb.register("mate", ops.cx_blend, alpha=0.5)
+    tb.register("mutate", ops.mut_gaussian, mu=0.0, sigma=0.3, indpb=0.1)
+    tb.register("select", ops.sel_tournament, tournsize=3)
+    pop = init_population(jax.random.key(1), POP,
+                          ops.uniform_genome(N, -5.12, 5.12),
+                          FitnessSpec((-1.0,)))
+    pop = evaluate_invalid(pop, tb.evaluate)
+
+    @jax.jit
+    def run(key, pop):
+        def step(p, k):
+            k1, k2 = jax.random.split(k)
+            idx = tb.select(k1, p.wvalues, POP)
+            off = var_and(k2, gather(p, idx), tb, 0.5, 0.2)
+            return evaluate_invalid(off, tb.evaluate), 0
+
+        p, _ = lax.scan(step, pop, jax.random.split(key, NGEN))
+        return p.wvalues
+
+    return _time(run, pop)
+
+
+def main():
+    backend = jax.default_backend()
+    for name, fn in [
+        ("cmaes_n100_lam4096", bench_cmaes),
+        ("nsga2_zdt1_pop2000", bench_nsga2),
+        ("rastrigin_n30_pop100k", bench_rastrigin),
+    ]:
+        gps = fn()
+        print(json.dumps({
+            "metric": f"{name}_generations_per_sec",
+            "value": round(gps, 2),
+            "unit": "gens/sec",
+            "vs_baseline": round(gps / REF[name], 1),
+            "backend": backend,
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

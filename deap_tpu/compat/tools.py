@@ -253,8 +253,9 @@ def sortNondominated(individuals, k, first_front_only=False):
     if k == 0 or not individuals:
         return []
     jax, jnp, emo = _mo()
+    max_rank = 1 if first_front_only else None
     ranks = np.asarray(emo.nd_rank(jnp.asarray(_wvalues(individuals)),
-                                   impl="matrix"))
+                                   max_rank=max_rank, impl="auto"))
     fronts = []
     total = 0
     for r in range(int(ranks.max()) + 1 if len(ranks) else 0):
