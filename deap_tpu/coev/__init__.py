@@ -95,6 +95,47 @@ def coop_eval_species(i: int, pop: Population, reps: Sequence,
     return pop.with_fitness(values)
 
 
+def match_counts(genomes: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise match strengths: ``out[i, t] = #{positions where genome i
+    equals target t}`` — the batched form of ``matchStrength``
+    (coop_base.py:44-47), one comparison tensor instead of |set|×|targets|
+    Python loops."""
+    return (genomes[:, None, :] == targets[None, :, :]).sum(-1).astype(jnp.float32)
+
+
+def match_set_strength(i: int, genomes: jnp.ndarray, reps: Sequence,
+                       targets: jnp.ndarray) -> jnp.ndarray:
+    """Cooperative match-set fitness for species ``i`` (matchSetStrength,
+    coop_base.py:57-66): each member is assembled with the *other*
+    species' representatives; the set's strength on a target is the best
+    member's match, and fitness is the mean over targets.
+
+    ``genomes [n, L]``, ``reps`` = per-species representative genomes,
+    ``targets [T, L]`` → ``f32[n]``.
+    """
+    rep_m = match_counts(jnp.stack(list(reps)), targets)      # [R, T]
+    mask = jnp.arange(rep_m.shape[0])[:, None] != i
+    other_best = jnp.where(mask, rep_m, -jnp.inf).max(0)      # [T]
+    ind_m = match_counts(genomes, targets)                    # [n, T]
+    return jnp.maximum(ind_m, other_best[None, :]).mean(-1)
+
+
+def match_set_contributions(reps: Sequence, targets: jnp.ndarray) -> jnp.ndarray:
+    """Per-species credit (matchSetContribution, coop_base.py:84-98):
+    each target is claimed by the representative matching it best
+    (first index wins ties, like the reference's strict ``>`` scan);
+    a species' contribution is the mean claimed match strength. Used by
+    the evolving-species ladder to decide extinction
+    (coop_evol.py:130-140)."""
+    rep_m = match_counts(jnp.stack(list(reps)), targets)      # [R, T]
+    winner = jnp.argmax(rep_m, axis=0)                        # [T]
+    claimed = rep_m.max(0)
+    R = rep_m.shape[0]
+    per_species = jnp.where(winner[None, :] == jnp.arange(R)[:, None],
+                            claimed[None, :], 0.0)
+    return per_species.sum(-1) / targets.shape[0]
+
+
 def coop_step(key: jax.Array, species: Sequence[Population],
               reps: Sequence, toolboxes, evaluate: Callable,
               cxpb: float = 0.6, mutpb: float = 1.0,

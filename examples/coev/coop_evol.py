@@ -1,0 +1,153 @@
+"""Cooperative co-evolution with an evolving number of species.
+
+Counterpart of the reference's Potter & De Jong ladder
+(/root/reference/examples/coev/coop_niche.py, coop_gen.py,
+coop_adapt.py, coop_evol.py — sections 4.2.1-4.2.4 of *Cooperative
+Coevolution: An Architecture for Evolving Co-adapted Subcomponents*,
+2001): species of bitstrings cooperatively cover a noisy schemata-match
+problem; the match-set strength of an individual assembled with the
+other species' representatives is its fitness
+(coop_base.py:57-66), and in the full ladder stagnation triggers adding
+a fresh species while weak contributors go extinct
+(coop_evol.py:120-146).
+
+``mode`` selects the rung:
+
+- ``"niche"`` — fixed one-species-per-schema setup (coop_niche.py):
+  shows species settling into distinct niches.
+- ``"gen"``  — fixed species count chosen up front (coop_gen.py's
+  NUM_SPECIES study).
+- ``"adapt"`` — start with one species, *add* a species when the best
+  collaboration fitness stagnates (coop_adapt.py).
+- ``"evol"`` — additionally remove species whose contribution falls
+  below the extinction threshold (coop_evol.py:130-146).
+
+The per-round species step is the jit'd tensor program
+(`coev.coop_step`); only the add/remove decisions — data-dependent
+*structure* changes — run on the host, recompiling per species count
+(SURVEY.md §7.3 "data-dependent control flow ... keep on host around
+the jit'd inner loop").
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import coev, ops
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import init_population
+from deap_tpu.core.toolbox import Toolbox
+
+IND_SIZE = 64
+SPECIES_SIZE = 50
+TARGET_SIZE = 30
+IMPROVEMENT_THRESHOLD = 0.5
+IMPROVEMENT_LENGTH = 5
+EXTINCTION_THRESHOLD = 5.0
+
+
+def block_schematas(n_types: int, length: int) -> list:
+    """Structured schemata in the style of nicheSchematas
+    (coop_niche.py:36-42): each type fixes a contiguous '1' block over
+    its own stretch of the string, '#' (noise) elsewhere."""
+    rept = length // n_types
+    out = []
+    for i in range(n_types):
+        s = "#" * (i * rept) + "1" * rept
+        out.append(s + "#" * (length - len(s)))
+    return out
+
+
+def init_target_set(key, schemata: str, size: int) -> jnp.ndarray:
+    """[size, L] noisy targets from one schema (initTargetSet,
+    coop_base.py:29-42): fixed positions copy the schema, '#' positions
+    are uniform random bits per target."""
+    L = len(schemata)
+    rand = jax.random.bernoulli(key, 0.5, (size, L)).astype(jnp.int8)
+    fixed = jnp.array([c in "01" for c in schemata])
+    vals = jnp.array([1 if c == "1" else 0 for c in schemata], jnp.int8)
+    return jnp.where(fixed[None, :], vals[None, :], rand)
+
+
+def _new_species(key):
+    return init_population(key, SPECIES_SIZE,
+                           ops.bernoulli_genome(IND_SIZE, dtype=jnp.int8),
+                           FitnessSpec((1.0,)))
+
+
+def main(smoke: bool = False, mode: str = "evol", verbose: bool = True,
+         num_species: int = 1, seed: int = 0):
+    if mode not in ("niche", "gen", "adapt", "evol"):
+        raise ValueError(f"unknown mode {mode!r}")
+
+    n_types = 3
+    rounds = (40 if mode in ("adapt", "evol") else 30) if not smoke else 6
+    keys = iter(jax.random.split(jax.random.key(seed), 4096))
+
+    schematas = block_schematas(n_types, IND_SIZE)
+    per = TARGET_SIZE // n_types
+    targets = jnp.concatenate(
+        [init_target_set(next(keys), s, per) for s in schematas])
+
+    tb = Toolbox()
+    tb.register("mate", ops.cx_two_point)
+    tb.register("mutate", ops.mut_flip_bit, indpb=1.0 / IND_SIZE)
+    tb.register("select", ops.sel_tournament, tournsize=3)
+
+    def evaluate(i, genomes, reps):
+        return coev.match_set_strength(i, genomes, reps, targets)
+
+    if mode == "niche":
+        num_species = n_types
+    elif mode in ("adapt", "evol"):
+        num_species = 1
+    species = [_new_species(next(keys)) for _ in range(num_species)]
+    # random initial representatives (coop_evol.py:77)
+    reps = [jax.tree_util.tree_map(lambda a: a[0], s.genomes)
+            for s in species]
+    species = [coev.coop_eval_species(i, s, reps, evaluate)
+               for i, s in enumerate(species)]
+    reps = coev.coop_representatives(species)
+
+    # one jit'd program per species count; structure changes recompile
+    @jax.jit
+    def _round(key, sp, r):
+        return coev.coop_step(key, sp, r, tb, evaluate,
+                              cxpb=0.6, mutpb=1.0)
+
+    history = []
+    for rnd in range(rounds):
+        species, reps = _round(next(keys), tuple(species), tuple(reps))
+        best = float(max(float(s.wvalues.max()) for s in species))
+        history.append(best)
+        if verbose:
+            print(f"round {rnd:3d}  species {len(species)}  "
+                  f"best collaboration {best:.3f}")
+
+        if mode in ("adapt", "evol") and len(history) >= IMPROVEMENT_LENGTH:
+            diff = history[-1] - history[-IMPROVEMENT_LENGTH]
+            if diff < IMPROVEMENT_THRESHOLD:
+                if mode == "evol" and len(species) > 1:
+                    contribs = coev.match_set_contributions(reps, targets)
+                    keep = [i for i in range(len(species))
+                            if float(contribs[i]) >= EXTINCTION_THRESHOLD]
+                    if keep:  # never extinguish everything
+                        species = [species[i] for i in keep]
+                        reps = [reps[i] for i in keep]
+                s = _new_species(next(keys))
+                reps.append(jax.tree_util.tree_map(lambda a: a[0], s.genomes))
+                species.append(
+                    coev.coop_eval_species(len(species), s, reps, evaluate))
+                reps = coev.coop_representatives(species)
+                history = []
+                if verbose:
+                    print(f"  stagnation: now {len(species)} species")
+
+    final = float(max(float(s.wvalues.max()) for s in species))
+    if verbose:
+        print(f"final best collaboration: {final:.3f} "
+              f"({len(species)} species)")
+    return final
+
+
+if __name__ == "__main__":
+    main()

@@ -62,6 +62,7 @@ EXAMPLES = [
     "examples.pso.multiswarm",
     "examples.pso.speciation",
     "examples.coev.coop",
+    "examples.coev.coop_evol",
     "examples.coev.hillis",
     "examples.coev.symbreg",
     "examples.bbob",
