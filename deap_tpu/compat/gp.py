@@ -29,9 +29,10 @@ from typing import Callable, List
 __all__ = [
     "PrimitiveTree", "Primitive", "Terminal", "Ephemeral",
     "PrimitiveSet", "PrimitiveSetTyped", "compile", "compileADF",
-    "genFull", "genGrow", "genHalfAndHalf",
-    "cxOnePoint", "mutUniform", "mutNodeReplacement", "mutEphemeral",
-    "mutInsert", "mutShrink", "staticLimit",
+    "genFull", "genGrow", "genHalfAndHalf", "genRamped", "generate",
+    "cxOnePoint", "cxOnePointLeafBiased", "cxSemantic", "mutSemantic",
+    "mutUniform", "mutNodeReplacement", "mutEphemeral",
+    "mutInsert", "mutShrink", "staticLimit", "harm", "graph",
 ]
 
 
@@ -376,6 +377,20 @@ def genHalfAndHalf(pset, min_, max_, type_=None):
     return random.choice((genFull, genGrow))(pset, min_, max_, type_)
 
 
+def genRamped(pset, min_, max_, type_=None):
+    """Deprecated alias of :func:`genHalfAndHalf` (gp.py:611-616)."""
+    warnings.warn("gp.genRamped has been renamed. Use genHalfAndHalf "
+                  "instead.", FutureWarning)
+    return genHalfAndHalf(pset, min_, max_, type_)
+
+
+def generate(pset, min_, max_, condition, type_=None):
+    """Core tree builder (gp.py:611-638): grow node-by-node from a
+    type stack, placing a terminal wherever ``condition(height, depth)``
+    holds. Public like the reference, for custom generators."""
+    return PrimitiveTree(_generate(pset, min_, max_, condition, type_))
+
+
 # -------------------------------------------------------------- variation --
 
 def cxOnePoint(ind1, ind2):
@@ -396,6 +411,86 @@ def cxOnePoint(ind1, ind2):
     i2 = random.choice(types2[type_])
     s1, s2 = ind1.search_subtree(i1), ind2.search_subtree(i2)
     ind1[s1], ind2[s2] = ind2[s2], ind1[s1]
+    return ind1, ind2
+
+
+def cxOnePointLeafBiased(ind1, ind2, termpb):
+    """Subtree swap with Koza's 90/10 node-category bias
+    (gp.py:685-737): each parent independently restricts its crossover
+    points to terminals with probability ``termpb``, else to
+    primitives."""
+    if len(ind1) < 2 or len(ind2) < 2:
+        return ind1, ind2
+
+    def points(ind, want_terminals):
+        by_type: dict = {}
+        for idx, node in enumerate(ind[1:], 1):
+            if (node.arity == 0) == want_terminals:
+                by_type.setdefault(node.ret, []).append(idx)
+        return by_type
+
+    types1 = points(ind1, random.random() < termpb)
+    types2 = points(ind2, random.random() < termpb)
+    common = set(types1) & set(types2)
+    if common:
+        type_ = random.choice(sorted(common, key=str))
+        i1 = random.choice(types1[type_])
+        i2 = random.choice(types2[type_])
+        s1, s2 = ind1.search_subtree(i1), ind2.search_subtree(i2)
+        ind1[s1], ind2[s2] = ind2[s2], ind1[s1]
+    return ind1, ind2
+
+
+def _semantic_nodes(pset):
+    for p in ("lf", "mul", "add", "sub"):
+        if p not in pset.mapping:
+            raise AssertionError(
+                "A '%s' function is required in order to perform "
+                "semantic variation" % p)
+    return (pset.mapping["lf"], pset.mapping["mul"],
+            pset.mapping["add"], pset.mapping["sub"])
+
+
+def mutSemantic(individual, gen_func=genGrow, pset=None, ms=None,
+                min=2, max=6):
+    """Geometric semantic mutation (Moraglio et al. 2012;
+    gp.py:1215-1267): ``ind + ms · (lf(tr1) - lf(tr2))`` built
+    structurally, where ``lf`` is the pset's logistic wrapper."""
+    lf, mul, add, sub = _semantic_nodes(pset)
+    tr1 = gen_func(pset, min, max)
+    tr2 = gen_func(pset, min, max)
+    if ms is None:
+        ms = random.uniform(0, 2)
+    step = Terminal(repr(ms), ms, object)
+    new = individual
+    new.insert(0, add)
+    new.extend([mul, step, sub, lf])
+    new.extend(tr1)
+    new.append(lf)
+    new.extend(tr2)
+    return (new,)
+
+
+def cxSemantic(ind1, ind2, gen_func=genGrow, pset=None, min=2, max=6):
+    """Geometric semantic crossover (Moraglio et al. 2012;
+    gp.py:1270-1329): with one shared random tree ``tr``,
+    ``child1 = lf(tr)·ind1 + (1-lf(tr))·ind2`` and symmetrically for
+    ``child2``. Unlike the reference — whose in-place build lets
+    child2 absorb the already-rebuilt child1 (gp.py:1319-1327 extends
+    the mutated ``ind1``) — both children are built from the *original*
+    parents, matching the operator's published definition."""
+    lf, mul, add, sub = _semantic_nodes(pset)
+    tr = gen_func(pset, min, max)
+    one = Terminal("1.0", 1.0, object)
+    p1, p2 = list(ind1), list(ind2)
+
+    def build(a, b):
+        out = [add, mul] + a + [lf] + list(tr)
+        out += [mul, sub, one, lf] + list(tr) + b
+        return out
+
+    ind1[:] = build(p1, p2)
+    ind2[:] = build(p2, p1)
     return ind1, ind2
 
 
@@ -513,3 +608,138 @@ def staticLimit(key: Callable, max_value):
             return tuple(out)
         return wrapper
     return decorator
+
+
+def graph(expr):
+    """(nodes, edges, labels) for pygraphviz/networkx plotting
+    (gp.py:1138-1208): one arity-countdown stack pass over the prefix
+    array."""
+    nodes = list(range(len(expr)))
+    edges = []
+    labels = {}
+    stack = []
+    for i, node in enumerate(expr):
+        if stack:
+            edges.append((stack[-1][0], i))
+            stack[-1][1] -= 1
+        labels[i] = node.name if node.arity > 0 else node.value
+        stack.append([i, node.arity])
+        while stack and stack[-1][1] == 0:
+            stack.pop()
+    return nodes, edges, labels
+
+
+def harm(population, toolbox, cxpb, mutpb, ngen,
+         alpha, beta, gamma, rho, nbrindsmodel=-1, mincutoff=20,
+         stats=None, halloffame=None, verbose=True):
+    """HARM-GP bloat control (Gardner, Gagné & Parizeau 2015;
+    gp.py:938-1135) as an eaSimple-shaped loop over list populations.
+
+    Each generation: (1) sample ``nbrindsmodel`` offspring to estimate
+    the *natural* size distribution (kernel-smoothed histogram), (2) put
+    the cutoff at the size of the smallest individual among the top
+    (1-rho) fraction by fitness, floored at ``mincutoff``, (3) accept
+    offspring above the cutoff with exponentially decaying probability
+    (half-life ``alpha·size + beta``, mass ``gamma``), re-drawing until
+    the population refills. The tensor-path counterpart is
+    :mod:`deap_tpu.gp.harm`.
+    """
+    import math
+
+    from deap_tpu.compat.tools import Logbook
+
+    def halflife(x):
+        return x * float(alpha) + beta
+
+    def vary_pairs():
+        """Produce offspring one operator application at a time,
+        yielding 1-2 individuals (gp.py:1019-1042)."""
+        op = random.random()
+        if op < cxpb:
+            a1, a2 = toolbox.mate(*map(toolbox.clone,
+                                       toolbox.select(population, 2)))
+            del a1.fitness.values, a2.fitness.values
+            return [a1, a2]
+        aspirant = toolbox.clone(toolbox.select(population, 1)[0])
+        if op - cxpb < mutpb:
+            aspirant = toolbox.mutate(aspirant)[0]
+            del aspirant.fitness.values
+        return [aspirant]
+
+    def genpop(n, pickfrom=None, accept=lambda s: True,
+               producesizes=False):
+        produced, sizes = [], []
+        pickfrom = pickfrom if pickfrom is not None else []
+        while len(produced) < n:
+            candidates = [pickfrom.pop()] if pickfrom else vary_pairs()
+            for ind in candidates:
+                if len(produced) < n and accept(len(ind)):
+                    produced.append(ind)
+                    sizes.append(len(ind))
+        return (produced, sizes) if producesizes else produced
+
+    if nbrindsmodel == -1:
+        nbrindsmodel = max(2000, len(population))
+
+    logbook = Logbook()
+    logbook.header = ["gen", "nevals"] + (stats.fields if stats else [])
+
+    invalid = [ind for ind in population if not ind.fitness.valid]
+    for ind, fit in zip(invalid, toolbox.map(toolbox.evaluate, invalid)):
+        ind.fitness.values = fit
+    if halloffame is not None:
+        halloffame.update(population)
+    record = stats.compile(population) if stats else {}
+    logbook.record(gen=0, nevals=len(invalid), **record)
+    if verbose:
+        print(logbook.stream)
+
+    for gen in range(1, ngen + 1):
+        naturalpop, naturalsizes = genpop(nbrindsmodel, producesizes=True)
+
+        # kernel-smoothed size histogram (gp.py:1076-1087)
+        hist = [0.0] * (max(naturalsizes) + 3)
+        for s in naturalsizes:
+            hist[s] += 0.4
+            hist[s - 1] += 0.2
+            hist[s + 1] += 0.2
+            hist[s + 2] += 0.1
+            if s - 2 >= 0:
+                hist[s - 2] += 0.1
+        hist = [v * len(population) / nbrindsmodel for v in hist]
+
+        # cutoff: smallest size among the top (1-rho) by fitness
+        # (gp.py:1092-1096)
+        bytfit = sorted(naturalpop, key=lambda ind: ind.fitness)
+        candidates = bytfit[int(len(population) * rho - 1):]
+        cutoff = max(mincutoff, min(len(ind) for ind in candidates))
+
+        def target(x):
+            return (gamma * len(population) * math.log(2) / halflife(x)
+                    ) * math.exp(-math.log(2) * (x - cutoff) / halflife(x))
+
+        targethist = [hist[b] if b <= cutoff else target(b)
+                      for b in range(len(hist))]
+        probhist = [t / n if n > 0 else t
+                    for n, t in zip(hist, targethist)]
+
+        def accept(s):
+            p = probhist[s] if s < len(probhist) else target(s)
+            return random.random() <= p
+
+        offspring = genpop(len(population), pickfrom=naturalpop,
+                           accept=accept)
+
+        invalid = [ind for ind in offspring if not ind.fitness.valid]
+        for ind, fit in zip(invalid,
+                            toolbox.map(toolbox.evaluate, invalid)):
+            ind.fitness.values = fit
+        if halloffame is not None:
+            halloffame.update(offspring)
+        population[:] = offspring
+        record = stats.compile(population) if stats else {}
+        logbook.record(gen=gen, nevals=len(invalid), **record)
+        if verbose:
+            print(logbook.stream)
+
+    return population, logbook

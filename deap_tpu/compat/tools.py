@@ -13,6 +13,7 @@ from __future__ import annotations
 import random
 from bisect import bisect_right
 from copy import deepcopy
+from functools import partial
 from operator import attrgetter
 
 
@@ -69,6 +70,180 @@ def cxBlend(ind1, ind2, alpha):
     return ind1, ind2
 
 
+def _bounds(bound, size, name):
+    """Scalar → repeated; sequence → length-checked (crossover.py:315-323)."""
+    if isinstance(bound, (int, float)):
+        return [bound] * size
+    if len(bound) < size:
+        raise IndexError(
+            "%s must be at least the size of the shorter individual: "
+            "%d < %d" % (name, len(bound), size))
+    return bound
+
+
+def cxPartialyMatched(ind1, ind2):
+    """PMX on permutations (crossover.py:94-142): swap a segment, then
+    repair duplicates through the position maps so both children stay
+    permutations."""
+    size = min(len(ind1), len(ind2))
+    pos1 = [0] * size
+    pos2 = [0] * size
+    for i in range(size):
+        pos1[ind1[i]] = i
+        pos2[ind2[i]] = i
+    a = random.randint(0, size)
+    b = random.randint(0, size - 1)
+    if b >= a:
+        b += 1
+    else:
+        a, b = b, a
+    for i in range(a, b):
+        v1, v2 = ind1[i], ind2[i]
+        ind1[i], ind1[pos1[v2]] = v2, v1
+        ind2[i], ind2[pos2[v1]] = v1, v2
+        pos1[v1], pos1[v2] = pos1[v2], pos1[v1]
+        pos2[v1], pos2[v2] = pos2[v2], pos2[v1]
+    return ind1, ind2
+
+
+def cxUniformPartialyMatched(ind1, ind2, indpb):
+    """UPMX (crossover.py:144-186): PMX's matching swap applied per
+    position with probability ``indpb`` instead of over a segment."""
+    size = min(len(ind1), len(ind2))
+    pos1 = [0] * size
+    pos2 = [0] * size
+    for i in range(size):
+        pos1[ind1[i]] = i
+        pos2[ind2[i]] = i
+    for i in range(size):
+        if random.random() < indpb:
+            v1, v2 = ind1[i], ind2[i]
+            ind1[i], ind1[pos1[v2]] = v2, v1
+            ind2[i], ind2[pos2[v1]] = v1, v2
+            pos1[v1], pos1[v2] = pos1[v2], pos1[v1]
+            pos2[v1], pos2[v2] = pos2[v2], pos2[v1]
+    return ind1, ind2
+
+
+def cxOrdered(ind1, ind2):
+    """OX on permutations (crossover.py:188-239): keep the [a, b] slice,
+    fill the rest in the other parent's circular order starting after b."""
+    size = min(len(ind1), len(ind2))
+    a, b = random.sample(range(size), 2)
+    if a > b:
+        a, b = b, a
+    keep1 = [True] * size  # value v of ind2 outside the slice → hole in ind1
+    keep2 = [True] * size
+    for i in range(size):
+        if i < a or i > b:
+            keep1[ind2[i]] = False
+            keep2[ind1[i]] = False
+    orig1, orig2 = list(ind1), list(ind2)
+    k1 = k2 = b + 1
+    for i in range(size):
+        j = (b + 1 + i) % size
+        if not keep1[orig1[j]]:
+            ind1[k1 % size] = orig1[j]
+            k1 += 1
+        if not keep2[orig2[j]]:
+            ind2[k2 % size] = orig2[j]
+            k2 += 1
+    for i in range(a, b + 1):
+        ind1[i], ind2[i] = ind2[i], ind1[i]
+    return ind1, ind2
+
+
+def cxSimulatedBinary(ind1, ind2, eta):
+    """SBX (crossover.py:263-289): spread factor β from one U[0,1) draw
+    per gene."""
+    for i, (x1, x2) in enumerate(zip(ind1, ind2)):
+        rand = random.random()
+        beta = 2.0 * rand if rand <= 0.5 else 1.0 / (2.0 * (1.0 - rand))
+        beta **= 1.0 / (eta + 1.0)
+        ind1[i] = 0.5 * ((1 + beta) * x1 + (1 - beta) * x2)
+        ind2[i] = 0.5 * ((1 - beta) * x1 + (1 + beta) * x2)
+    return ind1, ind2
+
+
+def cxSimulatedBinaryBounded(ind1, ind2, eta, low, up):
+    """Bounded SBX, Deb's NSGA-II C formulation (crossover.py:291-364):
+    each gene crosses with prob ½; β_q is computed separately against
+    each bound, children are clipped and randomly swapped."""
+    size = min(len(ind1), len(ind2))
+    low = _bounds(low, size, "low")
+    up = _bounds(up, size, "up")
+
+    def _betaq(rand, beta, eta):
+        alpha = 2.0 - beta ** -(eta + 1.0)
+        if rand <= 1.0 / alpha:
+            return (rand * alpha) ** (1.0 / (eta + 1.0))
+        return (1.0 / (2.0 - rand * alpha)) ** (1.0 / (eta + 1.0))
+
+    for i in range(size):
+        if random.random() > 0.5:
+            continue
+        if abs(ind1[i] - ind2[i]) <= 1e-14:
+            continue
+        xl, xu = low[i], up[i]
+        x1, x2 = min(ind1[i], ind2[i]), max(ind1[i], ind2[i])
+        rand = random.random()
+        c1 = 0.5 * (x1 + x2 - _betaq(
+            rand, 1.0 + 2.0 * (x1 - xl) / (x2 - x1), eta) * (x2 - x1))
+        c2 = 0.5 * (x1 + x2 + _betaq(
+            rand, 1.0 + 2.0 * (xu - x2) / (x2 - x1), eta) * (x2 - x1))
+        c1 = min(max(c1, xl), xu)
+        c2 = min(max(c2, xl), xu)
+        if random.random() <= 0.5:
+            ind1[i], ind2[i] = c2, c1
+        else:
+            ind1[i], ind2[i] = c1, c2
+    return ind1, ind2
+
+
+def cxMessyOnePoint(ind1, ind2):
+    """Length-changing one-point crossover (crossover.py:367-383):
+    independent cut points in each parent, tails swapped."""
+    p1 = random.randint(0, len(ind1))
+    p2 = random.randint(0, len(ind2))
+    ind1[p1:], ind2[p2:] = ind2[p2:], ind1[p1:]
+    return ind1, ind2
+
+
+def cxESBlend(ind1, ind2, alpha):
+    """Blend crossover on values AND per-gene ``strategy`` vectors
+    (crossover.py:390-416), one fresh γ per value and per strategy."""
+    for i, (x1, s1, x2, s2) in enumerate(
+            zip(ind1, ind1.strategy, ind2, ind2.strategy)):
+        gamma = (1.0 + 2.0 * alpha) * random.random() - alpha
+        ind1[i] = (1.0 - gamma) * x1 + gamma * x2
+        ind2[i] = gamma * x1 + (1.0 - gamma) * x2
+        gamma = (1.0 + 2.0 * alpha) * random.random() - alpha
+        ind1.strategy[i] = (1.0 - gamma) * s1 + gamma * s2
+        ind2.strategy[i] = gamma * s1 + (1.0 - gamma) * s2
+    return ind1, ind2
+
+
+def cxESTwoPoint(ind1, ind2):
+    """Two-point crossover mirrored on value and strategy vectors with
+    the same cut points (crossover.py:419-445)."""
+    size = min(len(ind1), len(ind2))
+    a = random.randint(1, size)
+    b = random.randint(1, size - 1)
+    if b >= a:
+        b += 1
+    else:
+        a, b = b, a
+    ind1[a:b], ind2[a:b] = ind2[a:b], ind1[a:b]
+    ind1.strategy[a:b], ind2.strategy[a:b] = \
+        ind2.strategy[a:b], ind1.strategy[a:b]
+    return ind1, ind2
+
+
+# deprecated aliases kept by the reference (crossover.py:63, :448-451)
+cxTwoPoints = cxTwoPoint
+cxESTwoPoints = cxESTwoPoint
+
+
 # ------------------------------------------------------------- mutation ----
 
 def mutGaussian(individual, mu, sigma, indpb):
@@ -100,6 +275,47 @@ def mutUniformInt(individual, low, up, indpb):
     for i in range(len(individual)):
         if random.random() < indpb:
             individual[i] = random.randint(low, up)
+    return (individual,)
+
+
+def mutPolynomialBounded(individual, eta, low, up, indpb):
+    """Deb's polynomial bounded mutation (mutation.py:51-96)."""
+    size = len(individual)
+    low = _bounds(low, size, "low")
+    up = _bounds(up, size, "up")
+    for i in range(size):
+        if random.random() > indpb:
+            continue
+        x, xl, xu = individual[i], low[i], up[i]
+        rand = random.random()
+        mut_pow = 1.0 / (eta + 1.0)
+        if rand < 0.5:
+            xy = 1.0 - (x - xl) / (xu - xl)
+            val = 2.0 * rand + (1.0 - 2.0 * rand) * xy ** (eta + 1.0)
+            delta_q = val ** mut_pow - 1.0
+        else:
+            xy = 1.0 - (xu - x) / (xu - xl)
+            val = 2.0 * (1.0 - rand) + 2.0 * (rand - 0.5) * xy ** (eta + 1.0)
+            delta_q = 1.0 - val ** mut_pow
+        individual[i] = min(max(x + delta_q * (xu - xl), xl), xu)
+    return (individual,)
+
+
+def mutESLogNormal(individual, c, indpb):
+    """Self-adaptive ES mutation (mutation.py:180-215): one global
+    log-normal factor per call plus per-gene factors on ``strategy``,
+    then a gaussian step scaled by the new strategy."""
+    import math
+
+    size = len(individual)
+    t = c / math.sqrt(2.0 * math.sqrt(size))
+    t0 = c / math.sqrt(2.0 * size)
+    n = random.gauss(0, 1)
+    t0_n = t0 * n
+    for i in range(size):
+        if random.random() < indpb:
+            individual.strategy[i] *= math.exp(t0_n + t * random.gauss(0, 1))
+            individual[i] += individual.strategy[i] * random.gauss(0, 1)
     return (individual,)
 
 
@@ -139,6 +355,200 @@ def selRoulette(individuals, k, fit_attr="fitness"):
         u = random.random() * total
         chosen.append(s_inds[min(bisect_right(cums, u), len(s_inds) - 1)])
     return chosen
+
+
+def selStochasticUniversalSampling(individuals, k, fit_attr="fitness"):
+    """SUS (selection.py:182-212): k evenly spaced pointers over the
+    fitness-sorted cumulative distribution, one random phase."""
+    s_inds = sorted(individuals, key=attrgetter(fit_attr), reverse=True)
+    fits = [getattr(ind, fit_attr).values[0] for ind in s_inds]
+    spacing = sum(fits) / float(k)
+    start = random.uniform(0, spacing)
+    chosen = []
+    i, acc = 0, fits[0]
+    for j in range(k):
+        p = start + j * spacing
+        while acc < p:
+            i += 1
+            acc += fits[i]
+        chosen.append(s_inds[i])
+    return chosen
+
+
+def selDoubleTournament(individuals, k, fitness_size, parsimony_size,
+                        fitness_first, fit_attr="fitness"):
+    """Luke & Panait double tournament (selection.py:105-180): a fitness
+    tournament composed with a probabilistic size tournament
+    (``parsimony_size``/2 chance for the shorter of two) in either
+    order."""
+    assert 1 <= parsimony_size <= 2, \
+        "Parsimony tournament size has to be in the range [1, 2]."
+
+    def size_tournament(pool, k, select):
+        chosen = []
+        for _ in range(k):
+            prob = parsimony_size / 2.0
+            ind1, ind2 = select(pool, k=2)
+            if len(ind1) > len(ind2):
+                ind1, ind2 = ind2, ind1
+            elif len(ind1) == len(ind2):
+                prob = 0.5
+            chosen.append(ind1 if random.random() < prob else ind2)
+        return chosen
+
+    def fit_tournament(pool, k, select):
+        chosen = []
+        for _ in range(k):
+            aspirants = select(pool, k=fitness_size)
+            chosen.append(max(aspirants, key=attrgetter(fit_attr)))
+        return chosen
+
+    if fitness_first:
+        inner = partial(fit_tournament, select=selRandom)
+        return size_tournament(individuals, k, inner)
+    inner = partial(size_tournament, select=selRandom)
+    return fit_tournament(individuals, k, inner)
+
+
+def _lexicase(individuals, k, survivors):
+    """Shared lexicase loop (selection.py:214-326): shuffle cases; keep
+    ``survivors(candidates, case_values, maximizing)`` each round until
+    one candidate or no cases remain; pick uniformly among the rest."""
+    selected = []
+    weights = individuals[0].fitness.weights
+    ncases = len(individuals[0].fitness.values)
+    for _ in range(k):
+        candidates = individuals
+        cases = list(range(ncases))
+        random.shuffle(cases)
+        while cases and len(candidates) > 1:
+            c = cases.pop(0)
+            vals = [ind.fitness.values[c] for ind in candidates]
+            mask = survivors(vals, weights[c] > 0)
+            candidates = [ind for ind, m in zip(candidates, mask) if m]
+        selected.append(random.choice(candidates))
+    return selected
+
+
+def selLexicase(individuals, k):
+    """Exact-best lexicase (selection.py:214-245)."""
+    def survivors(vals, maximizing):
+        best = max(vals) if maximizing else min(vals)
+        return [v == best for v in vals]
+
+    return _lexicase(individuals, k, survivors)
+
+
+def selEpsilonLexicase(individuals, k, epsilon):
+    """ε_y lexicase (selection.py:247-281): survive within a fixed ε of
+    the round's best."""
+    def survivors(vals, maximizing):
+        if maximizing:
+            thresh = max(vals) - epsilon
+            return [v >= thresh for v in vals]
+        thresh = min(vals) + epsilon
+        return [v <= thresh for v in vals]
+
+    return _lexicase(individuals, k, survivors)
+
+
+def selAutomaticEpsilonLexicase(individuals, k):
+    """λ_ε_y lexicase (selection.py:283-321): ε = median absolute
+    deviation of the candidates' errors on the case."""
+    def survivors(vals, maximizing):
+        med = _median(vals)
+        mad = _median([abs(v - med) for v in vals])
+        if maximizing:
+            thresh = max(vals) - mad
+            return [v >= thresh for v in vals]
+        thresh = min(vals) + mad
+        return [v <= thresh for v in vals]
+
+    return _lexicase(individuals, k, survivors)
+
+
+def _median(vals):
+    s = sorted(vals)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+# ----------------------------------------------------------- constraint ----
+
+class DeltaPenalty:
+    """Evaluate-decorator returning ``Δ_i - w_i·d_i`` for infeasible
+    individuals (constraint.py:10-64); feasible ones evaluate normally.
+    ``delta`` may be a scalar (broadcast per objective) or a sequence;
+    ``distance(individual)`` likewise scalar or per-objective."""
+
+    def __init__(self, feasibility, delta, distance=None):
+        self.feasibility = feasibility
+        self.delta = delta
+        self.distance = distance
+
+    def __call__(self, func):
+        def wrapper(individual, *args, **kwargs):
+            if self.feasibility(individual):
+                return func(individual, *args, **kwargs)
+            weights = individual.fitness.weights
+            signs = [1.0 if w >= 0 else -1.0 for w in weights]
+            deltas = _per_objective(self.delta, len(weights))
+            dists = [0.0] * len(weights)
+            if self.distance is not None:
+                dists = _per_objective(self.distance(individual),
+                                       len(weights))
+            return tuple(d - s * dist
+                         for d, s, dist in zip(deltas, signs, dists))
+
+        wrapper.__name__ = getattr(func, "__name__", "evaluate")
+        wrapper.__doc__ = func.__doc__
+        return wrapper
+
+
+class ClosestValidPenalty:
+    """Evaluate-decorator scoring an infeasible individual by its
+    closest valid projection, penalised by ``α·w_i·d_i(valid, x)``
+    (constraint.py:68-132)."""
+
+    def __init__(self, feasibility, feasible, alpha, distance=None):
+        self.feasibility = feasibility
+        self.feasible = feasible
+        self.alpha = alpha
+        self.distance = distance
+
+    def __call__(self, func):
+        def wrapper(individual, *args, **kwargs):
+            if self.feasibility(individual):
+                return func(individual, *args, **kwargs)
+            f_ind = self.feasible(individual)
+            f_fbl = func(f_ind, *args, **kwargs)
+            weights = individual.fitness.weights
+            if len(weights) != len(f_fbl):
+                raise IndexError("Fitness weights and computed fitness "
+                                 "are of different size.")
+            signs = [1.0 if w >= 0 else -1.0 for w in weights]
+            dists = [0.0] * len(weights)
+            if self.distance is not None:
+                dists = _per_objective(self.distance(f_ind, individual),
+                                       len(weights))
+            return tuple(f - s * self.alpha * d
+                         for f, s, d in zip(f_fbl, signs, dists))
+
+        wrapper.__name__ = getattr(func, "__name__", "evaluate")
+        wrapper.__doc__ = func.__doc__
+        return wrapper
+
+
+def _per_objective(value, nobj):
+    if isinstance(value, (int, float)):
+        return [value] * nobj
+    return list(value)
+
+
+# misspelled aliases the reference keeps (constraint.py:66, :134)
+DeltaPenality = DeltaPenalty
+ClosestValidPenality = ClosestValidPenalty
 
 
 # -------------------------------------------------------------- support ----
@@ -338,6 +748,57 @@ def uniformReferencePoints(nobj, p=4, scaling=None):
 
     _, _, emo = _mo()
     return np.asarray(emo.uniform_reference_points(nobj, p, scaling))
+
+
+def selNSGA3WithMemory(ref_points, nd="log"):
+    """Stateful NSGA-III selector (emo.py:450-476): remembers
+    best/worst/extreme points between calls so intercept normalisation
+    keeps history. Returns a callable ``(individuals, k) → list``."""
+    import numpy as np
+
+    del nd
+    jax, jnp, emo = _mo()
+    state = emo.SelNSGA3WithMemory(jnp.asarray(ref_points))
+
+    def select(individuals, k):
+        key = jax.random.key(random.getrandbits(32))
+        idx = np.asarray(state(key, jnp.asarray(_wvalues(individuals)), k))
+        return [individuals[i] for i in idx]
+
+    return select
+
+
+def sortLogNondominated(individuals, k, first_front_only=False):
+    """Fortin-2013 log nd-sort (emo.py:234-441). The divide-and-conquer
+    recursion exists to cut Python-level constants the tensor kernels do
+    not have, so this maps to the same nd-rank kernels as
+    :func:`sortNondominated` — identical fronts, different cost model.
+
+    Return-shape parity quirk preserved from the reference: with
+    ``first_front_only`` this returns the bare first front
+    (emo.py:275-276), while ``sortNondominated`` returns a one-element
+    list of fronts (emo.py:103-117) — MO-CMA-ES indexes individuals out
+    of this variant's return directly (cma.py:421-424)."""
+    fronts = sortNondominated(individuals, k, first_front_only)
+    return fronts[0] if first_front_only else fronts
+
+
+def hypervolume(front, **kargs):
+    """Index of the least hypervolume contributor, leave-one-out
+    (tools/indicator.py:10-31); the MO-CMA-ES 'hypervolume' indicator.
+    Equivalent to the reference's argmax of leave-one-out hypervolumes:
+    the row whose removal costs least is the one with the smallest
+    contribution."""
+    import numpy as np
+
+    wobj = np.asarray(_wvalues(front)) * -1.0
+    ref = kargs.get("ref", None)
+    if ref is None:
+        ref = np.max(wobj, axis=0) + 1.0
+    from deap_tpu.native import hv_contributions
+
+    contribs = hv_contributions(wobj, ref)
+    return int(np.argmin(contribs))
 
 
 #: reference name (emo.py:664) — programs call tools.uniform_reference_points
