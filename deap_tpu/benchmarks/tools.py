@@ -24,6 +24,7 @@ from functools import wraps
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 import numpy as np
 
 from deap_tpu.native import hypervolume as _hv
@@ -183,6 +184,73 @@ def hypervolume(front, ref=None, weights=None):
     if ref is None:
         ref = np.max(wobj, axis=0) + 1
     return _hv(wobj, np.asarray(ref))
+
+
+def optimal_front(name: str, n: int = 100, nobj: int = 3):
+    """Analytic Pareto-optimal fronts for the ZDT/DTLZ families — the
+    counterpart of the reference's sampled JSON fixtures
+    (examples/ga/pareto_front/zdt*.json, dtlz*.json consumed by
+    convergence/diversity, benchmarks/tools.py:256-296), generated
+    exactly instead of shipped as data.
+
+    Returns ``f32[n, 2]`` for ZDT (``f32[m, nobj]`` for DTLZ with
+    ``m ≈ n`` lattice points). ZDT3's disconnected front is the
+    non-dominated subset of the dense curve.
+    """
+    name = name.lower()
+    if name in ("zdt1", "zdt4"):
+        f1 = jnp.linspace(0.0, 1.0, n)
+        return jnp.stack([f1, 1.0 - jnp.sqrt(f1)], axis=1)
+    if name == "zdt2":
+        f1 = jnp.linspace(0.0, 1.0, n)
+        return jnp.stack([f1, 1.0 - f1 ** 2], axis=1)
+    if name == "zdt3":
+        # dense curve has strictly increasing f1 = x, so a point is
+        # non-dominated iff its f2 beats every earlier f2: an O(N)
+        # exclusive running-min, no pairwise matrix
+        x = jnp.linspace(0.0, 1.0, 16 * n)
+        f2 = 1.0 - jnp.sqrt(x) - x * jnp.sin(10.0 * jnp.pi * x)
+        cummin_prev = jnp.concatenate(
+            [jnp.array([jnp.inf]), lax.associative_scan(jnp.minimum, f2)[:-1]])
+        keep = jnp.flatnonzero(f2 < cummin_prev)
+        # subsample evenly so all five disconnected segments survive
+        pick = jnp.linspace(0, keep.shape[0] - 1, n).astype(jnp.int32)
+        idx = keep[pick]
+        return jnp.stack([x[idx], f2[idx]], axis=1)
+    if name == "zdt6":
+        # f1 is non-monotone in x and hits 1.0 at every sin zero; the
+        # front is f2 = 1 - f1² over the attained f1 range, so sample
+        # the attained f1 values, sorted and deduplicated
+        x = jnp.linspace(0.0, 1.0, 16 * n)
+        f1 = 1.0 - jnp.exp(-4.0 * x) * jnp.sin(6.0 * jnp.pi * x) ** 6
+        u = jnp.unique(f1)
+        pick = jnp.linspace(0, u.shape[0] - 1, n).astype(jnp.int32)
+        f1s = u[pick]
+        return jnp.stack([f1s, 1.0 - f1s ** 2], axis=1)
+    if name == "dtlz1":
+        # simplex Σf_i = 0.5: Das-Dennis lattice scaled by 0.5
+        from deap_tpu.mo.emo import uniform_reference_points
+
+        return 0.5 * uniform_reference_points(nobj, _dd_partitions(n, nobj))
+    if name in ("dtlz2", "dtlz3", "dtlz4"):
+        # unit hypersphere ‖f‖₂ = 1, first orthant
+        from deap_tpu.mo.emo import uniform_reference_points
+
+        w = uniform_reference_points(nobj, _dd_partitions(n, nobj))
+        return w / jnp.linalg.norm(w, axis=1, keepdims=True)
+    raise ValueError(f"no analytic front for {name!r}")
+
+
+def _dd_partitions(n: int, nobj: int) -> int:
+    """Smallest Das-Dennis partition count whose lattice reaches ≥ n
+    points (the lattice has C(p+nobj-1, nobj-1) points, not
+    p^(nobj-1))."""
+    from math import comb
+
+    p = 1
+    while comb(p + nobj - 1, nobj - 1) < n:
+        p += 1
+    return p
 
 
 def igd(A, Z):

@@ -11,7 +11,12 @@ import jax
 import jax.numpy as jnp
 
 from deap_tpu import algorithms, benchmarks, mo, ops
-from deap_tpu.benchmarks.tools import hypervolume
+from deap_tpu.benchmarks.tools import (
+    convergence,
+    diversity,
+    hypervolume,
+    optimal_front,
+)
 from deap_tpu.core.fitness import FitnessSpec
 from deap_tpu.core.population import concat, gather, init_population
 from deap_tpu.core.toolbox import Toolbox
@@ -52,6 +57,15 @@ def main(smoke: bool = False, mu: int = 100):
     hv = hypervolume(pop.fitness, ref=jnp.asarray([11.0, 11.0]),
                      weights=(-1.0, -1.0))
     print(f"Final hypervolume: {float(hv):.3f} (optimum 120.777)")
+
+    # convergence/diversity vs the analytic optimal front — reference
+    # nsga2.py reads sampled zdt1.json fixtures for the same report
+    opt = optimal_front("zdt1", 1000)
+    ranks = mo.nd_rank(pop.wvalues)
+    ff = pop.fitness[jnp.asarray(ranks == 0)]
+    ff = ff[jnp.argsort(ff[:, 0])]
+    print(f"Convergence: {convergence(ff, opt):.5f}")
+    print(f"Diversity: {diversity(ff, opt[0], opt[-1]):.5f}")
     return float(hv)
 
 

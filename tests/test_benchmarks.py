@@ -190,3 +190,33 @@ def test_movingpeaks_maximums_contains_global():
     assert pos.shape == (cfg.npeaks, 3)
     np.testing.assert_allclose(
         float(vals.max()), float(mp.global_maximum(cfg, state)), rtol=1e-6)
+
+
+def test_optimal_fronts_are_nondominated_and_exact():
+    """Analytic ZDT/DTLZ optimal fronts (counterpart of the reference's
+    pareto_front/*.json fixtures)."""
+    import jax.numpy as jnp
+
+    from deap_tpu.benchmarks import tools as bt
+
+    for name in ("zdt1", "zdt2", "zdt3", "zdt4", "zdt6"):
+        f = bt.optimal_front(name, 80)
+        assert f.shape == (80, 2)
+        dom = ((f[None] <= f[:, None]).all(-1)
+               & (f[None] < f[:, None]).any(-1)).any(1)
+        assert not bool(dom.any()), name
+        assert bool((jnp.diff(f[:, 0]) >= -1e-7).all()), name  # f1-sorted
+    # zdt3 spans all five disconnected segments, not just the first
+    assert float(bt.optimal_front("zdt3", 80)[-1, 0]) > 0.8
+    # zdt6's attained f1 range with distinct extremes
+    f6 = bt.optimal_front("zdt6", 80)
+    assert abs(float(f6[0, 0]) - 0.2808) < 0.02
+    assert float(f6[-1, 0]) == 1.0
+    d1 = bt.optimal_front("dtlz1", 60, nobj=3)
+    assert d1.shape[0] >= 60 and jnp.allclose(d1.sum(1), 0.5, atol=1e-5)
+    d2 = bt.optimal_front("dtlz2", 60, nobj=3)
+    assert d2.shape[0] >= 60
+    assert jnp.allclose(jnp.linalg.norm(d2, axis=1), 1.0, atol=1e-5)
+    # convergence of the exact front to itself ≈ 0 (sampling residual)
+    assert bt.convergence(bt.optimal_front("zdt1", 50),
+                          bt.optimal_front("zdt1", 400)) < 0.01
