@@ -130,6 +130,31 @@ def bench_rastrigin():
                           FitnessSpec((-1.0,)))
     pop = evaluate_invalid(pop, tb.evaluate)
 
+    if jax.default_backend() == "tpu":
+        # fused Pallas path: blend + gaussian + rastrigin in one HBM
+        # pass, per-gene randomness from the hardware PRNG
+        genomes = pop.genomes
+        fit = pop.fitness[:, 0]
+
+        @jax.jit
+        def run_fused(key, genomes, fit):
+            def step(carry, k):
+                g, f = carry
+                k1, k2 = jax.random.split(k)
+                idx = ops.sel_tournament_sorted(k1, -f[:, None], POP,
+                                                tournsize=3)
+                g, f = ops.fused_variation_eval_real(
+                    k2, g[idx], cxpb=0.5, mutpb=0.2, indpb=0.1,
+                    alpha=0.5, sigma=0.3, evaluate="rastrigin",
+                    prng="hw", block_i=1024, interpret=False)
+                return (g, f), 0
+
+            (g, f), _ = lax.scan(step, (genomes, fit),
+                                 jax.random.split(key, NGEN))
+            return f
+
+        return _time(run_fused, genomes, fit)
+
     @jax.jit
     def run(key, pop):
         def step(p, k):

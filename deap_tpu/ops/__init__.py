@@ -59,6 +59,11 @@ from deap_tpu.ops.kernels import (
     nd_rank_tiled,
     strengths_tiled,
 )
+from deap_tpu.ops.kernels_real import (
+    eval_rastrigin,
+    eval_sphere,
+    fused_variation_eval_real,
+)
 from deap_tpu.ops.packed import (
     cx_two_point_packed,
     fused_variation_eval_packed,

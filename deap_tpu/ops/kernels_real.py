@@ -1,0 +1,183 @@
+"""Fused Pallas generation kernel for real-valued GAs.
+
+The real-genome twin of :func:`deap_tpu.ops.kernels.fused_variation_eval`
+(bitstrings) for the continuous eaSimple configuration — blend crossover
+(reference tools/crossover.py:241-260) + gaussian mutation
+(tools/mutation.py:17-48) + the fitness function — fused so each
+``[n, L]`` float32 genome tile crosses HBM↔VMEM once per generation.
+With ``prng='hw'`` every per-gene draw (blend γ, flip gates, Box-Muller
+normals) comes from the TPU core's hardware PRNG and never touches HBM;
+this removes the dominant random-tensor traffic of the XLA path (four
+``[n, L]`` uniforms per generation).
+
+Distributional semantics match the reference operators exactly:
+
+- blend: per-gene ``γ = (1+2α)·u - α``; both children of a pair use the
+  *same* γ draws, child = ``(1-γ)·self + γ·partner`` (the two reference
+  output formulas, crossover.py:256-258, are this one expression under
+  the self/partner naming).
+- gaussian: per-gene Bernoulli(indpb) gate, then ``x += N(μ, σ)``
+  (mutation.py:43-47), row-gated by var_and's mutpb
+  (algorithms.py:76-80).
+
+Evaluation is compiled into the kernel: pass ``evaluate="rastrigin"`` /
+``"sphere"`` or any ``fn(child_tile, valid_col_mask) -> [TI, 1]``
+traceable on the ``[TI, Lp]`` float32 tile.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deap_tpu.ops.kernels import (
+    _auto_interpret,
+    _pair_consistent,
+    _resolve_prng,
+    _round_up,
+    _u01,
+    run_fused_kernel,
+)
+
+__all__ = ["fused_variation_eval_real", "eval_rastrigin", "eval_sphere"]
+
+_TWO_PI = 6.283185307179586
+
+
+def eval_rastrigin(child: jnp.ndarray, valid_col: jnp.ndarray) -> jnp.ndarray:
+    """Rastrigin on a genome tile (benchmarks/__init__.py:87-91):
+    ``10·N + Σ x² - 10·cos(2πx)`` over the real (unpadded) columns."""
+    term = child * child - 10.0 * jnp.cos(_TWO_PI * child)
+    n_real = jnp.sum(valid_col[0:1, :].astype(jnp.float32))
+    return (10.0 * n_real
+            + jnp.sum(jnp.where(valid_col, term, 0.0), axis=1,
+                      keepdims=True))
+
+
+def eval_sphere(child: jnp.ndarray, valid_col: jnp.ndarray) -> jnp.ndarray:
+    """Σ x² (benchmarks/__init__.py:38-41)."""
+    return jnp.sum(jnp.where(valid_col, child * child, 0.0), axis=1,
+                   keepdims=True)
+
+
+_EVALS = {"rastrigin": eval_rastrigin, "sphere": eval_sphere}
+
+
+def _boxmuller(u1: jnp.ndarray, u2: jnp.ndarray) -> jnp.ndarray:
+    """Standard normals from two U[0,1) planes; ``1-u1 ∈ (0, 1]`` keeps
+    the log finite (24-bit uniforms never reach 1.0)."""
+    r = jnp.sqrt(-2.0 * jnp.log1p(-u1))
+    return r * jnp.cos(_TWO_PI * u2)
+
+
+def _real_body(g, pairu, gammau, rowu, flipu, nu1, nu2, *, n, L, TI, cxpb,
+               mutpb, indpb, alpha, mu, sigma, evaluate, tile_idx):
+    """One [TI, Lp] tile: blend cx over adjacent pairs + gaussian
+    mutation + in-kernel evaluation. ``pairu``/``gammau`` must already be
+    pair-consistent."""
+    Lp = g.shape[1]
+    col = jax.lax.broadcasted_iota(jnp.int32, (TI, Lp), 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, (TI, Lp), 0)
+    valid_col = col < L
+
+    # adjacent pairing via roll, exactly the bitstring kernel's scheme
+    up = pltpu.roll(g, TI - 1, 0)
+    dn = pltpu.roll(g, 1, 0)
+    partner = jnp.where((row % 2) == 0, up, dn)
+    grow = row + tile_idx * TI
+    has_partner = jnp.bitwise_or(grow, 1) < n
+
+    do_cx = (pairu[:, 0:1] < cxpb) & has_partner[:, 0:1]
+    gamma = (1.0 + 2.0 * alpha) * gammau - alpha
+    blended = (1.0 - gamma) * g + gamma * partner
+    child = jnp.where(do_cx & valid_col, blended, g)
+
+    do_mut = rowu < mutpb
+    z = _boxmuller(nu1, nu2)
+    step = jnp.where((flipu < indpb) & do_mut & valid_col,
+                     mu + sigma * z, 0.0)
+    child = child + step
+
+    return child, evaluate(child, valid_col)
+
+
+def _real_kernel_bits(g_ref, pairbits_ref, rowbits_ref, genebits_ref,
+                      out_ref, fit_ref, *, n, L, Lp, **kw):
+    TI = g_ref.shape[0]
+    gb = genebits_ref[:]
+    pairu = _u01(_pair_consistent(pairbits_ref[:]))
+    gammau = _u01(_pair_consistent(gb[:, 0:Lp]))
+    child, fit = _real_body(
+        g_ref[:], pairu, gammau, _u01(rowbits_ref[:][:, 0:1]),
+        _u01(gb[:, Lp:2 * Lp]), _u01(gb[:, 2 * Lp:3 * Lp]),
+        _u01(gb[:, 3 * Lp:4 * Lp]), n=n, L=L, TI=TI,
+        tile_idx=pl.program_id(0), **kw)
+    out_ref[:] = child
+    fit_ref[:] = fit
+
+
+def _real_kernel_hw(seed_ref, g_ref, out_ref, fit_ref, *, n, L, Lp, **kw):
+    TI = g_ref.shape[0]
+    i = pl.program_id(0)
+    pltpu.prng_seed(seed_ref[0] + i)
+    draw = lambda cols: pltpu.bitcast(
+        pltpu.prng_random_bits((TI, cols)), jnp.uint32)
+    pairu = _u01(_pair_consistent(draw(4)))
+    gammau = _u01(_pair_consistent(draw(Lp)))
+    child, fit = _real_body(
+        g_ref[:], pairu, gammau, _u01(draw(1)), _u01(draw(Lp)),
+        _u01(draw(Lp)), _u01(draw(Lp)), n=n, L=L, TI=TI, tile_idx=i, **kw)
+    out_ref[:] = child
+    fit_ref[:] = fit
+
+
+def fused_variation_eval_real(
+        key: jax.Array, genomes: jnp.ndarray, *, cxpb: float, mutpb: float,
+        indpb: float, alpha: float = 0.5, mu: float = 0.0,
+        sigma: float = 1.0,
+        evaluate: Union[str, Callable] = "rastrigin",
+        prng: str = "auto", block_i: int = 256,
+        interpret: Optional[bool] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One fused eaSimple variation+evaluation pass over f32 genomes.
+
+    Equivalent in distribution to ``var_and`` with ``cx_blend(alpha)`` +
+    ``mut_gaussian(mu, sigma, indpb)`` followed by a full evaluation —
+    the continuous-GA generation (BASELINE.md's rastrigin_n30_pop100k
+    config) in one HBM round trip.
+
+    :param genomes: ``f32[n, L]``.
+    :param evaluate: built-in name (``"rastrigin"``, ``"sphere"``) or a
+        traceable ``fn(child_tile [TI, Lp], valid_col bool[TI, Lp]) ->
+        f32[TI, 1]``.
+    :returns: ``(children f32[n, L], fitness f32[n])``.
+    """
+    n, L = genomes.shape
+    assert block_i % 2 == 0, "pairs must not straddle tiles"
+    if isinstance(evaluate, str):
+        if evaluate not in _EVALS:
+            raise ValueError(
+                f"unknown evaluate {evaluate!r}; built-ins are "
+                f"{sorted(_EVALS)} (or pass a callable)")
+        ev = _EVALS[evaluate]
+    else:
+        ev = evaluate
+    Lp = _round_up(L, 128)
+    ni = _round_up(n, block_i)
+    interp = _auto_interpret(interpret)
+    prng = _resolve_prng(prng, interp)
+    g = jnp.pad(genomes.astype(jnp.float32), ((0, ni - n), (0, Lp - L)))
+
+    common = dict(n=n, L=L, Lp=Lp, cxpb=cxpb, mutpb=mutpb, indpb=indpb,
+                  alpha=alpha, mu=mu, sigma=sigma, evaluate=ev)
+    out, fit = run_fused_kernel(
+        key, g,
+        kernel_hw=functools.partial(_real_kernel_hw, **common),
+        kernel_bits=functools.partial(_real_kernel_bits, **common),
+        prng=prng, interp=interp, block_i=block_i, genebit_cols=4 * Lp,
+        out_dtype=jnp.float32)
+    return out[:n, :L], fit[:n, 0]

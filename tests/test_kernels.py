@@ -192,3 +192,63 @@ def test_sel_spea2_stream_tie_break_unbiased():
                                       candidates=50,
                                       block_i=128, block_j=128))
     assert idx.max() > 100  # stable-sort bias would cap indices at 49
+
+
+# ---------------------------------------------------- real-valued kernel ----
+
+def test_real_fused_eval_exact_and_noop():
+    from deap_tpu import benchmarks
+    from deap_tpu.ops.kernels_real import fused_variation_eval_real
+
+    g = jax.random.uniform(jax.random.key(5), (96, 30),
+                           minval=-5.12, maxval=5.12)
+    ch, fit = fused_variation_eval_real(
+        jax.random.key(6), g, cxpb=0.0, mutpb=0.0, indpb=0.1,
+        sigma=0.3, evaluate="rastrigin", prng="input", interpret=True)
+    ref = jax.vmap(benchmarks.rastrigin)(g)[:, 0]
+    assert np.allclose(ch, g)
+    assert np.allclose(fit, ref, rtol=1e-5)
+
+
+def test_real_fused_blend_pair_sum_invariant():
+    from deap_tpu.ops.kernels_real import fused_variation_eval_real
+
+    g = jax.random.uniform(jax.random.key(7), (128, 16))
+    ch, _ = fused_variation_eval_real(
+        jax.random.key(8), g, cxpb=1.0, mutpb=0.0, indpb=0.0,
+        alpha=0.5, evaluate="sphere", prng="input", interpret=True)
+    # shared per-gene gammas: c1+c2 == p1+p2 exactly (crossover.py:256-258)
+    assert np.allclose(np.asarray(ch[0::2] + ch[1::2]),
+                       np.asarray(g[0::2] + g[1::2]), atol=1e-4)
+    assert not np.allclose(ch, g)
+
+
+def test_real_fused_gaussian_moments():
+    from deap_tpu.ops.kernels_real import fused_variation_eval_real
+
+    g = jnp.zeros((512, 32))
+    ch, _ = fused_variation_eval_real(
+        jax.random.key(9), g, cxpb=0.0, mutpb=1.0, indpb=0.3, mu=2.0,
+        sigma=0.5, evaluate="sphere", prng="input", interpret=True)
+    d = np.asarray(ch)
+    frac = (d != 0).mean()
+    steps = d[d != 0]
+    assert abs(frac - 0.3) < 0.03
+    assert abs(steps.mean() - 2.0) < 0.06
+    assert abs(steps.std() - 0.5) < 0.06
+
+
+def test_real_fused_odd_row_and_custom_eval():
+    from deap_tpu.ops.kernels_real import fused_variation_eval_real
+
+    g = jax.random.uniform(jax.random.key(10), (95, 8))
+
+    def neg_sum(child, valid_col):
+        return -jnp.sum(jnp.where(valid_col, child, 0.0), axis=1,
+                        keepdims=True)
+
+    ch, fit = fused_variation_eval_real(
+        jax.random.key(11), g, cxpb=1.0, mutpb=0.0, indpb=0.0,
+        evaluate=neg_sum, prng="input", interpret=True)
+    assert np.allclose(ch[-1], g[-1])  # odd last row never mates
+    assert np.allclose(fit, -np.asarray(ch).sum(1), atol=1e-4)
