@@ -21,7 +21,8 @@ boundary):
   while individuals stay Python lists.
 """
 
-from deap_tpu.compat import algorithms, base, creator, gp, tools
+from deap_tpu.compat import algorithms, base, cma, creator, gp, tools
 from deap_tpu.compat.bridge import jax_map
 
-__all__ = ["algorithms", "base", "creator", "gp", "tools", "jax_map"]
+__all__ = ["algorithms", "base", "cma", "creator", "gp", "tools",
+           "jax_map"]

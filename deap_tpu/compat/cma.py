@@ -1,0 +1,221 @@
+"""The reference's ``deap.cma`` ask-tell API over the tensor engines.
+
+Counterpart of /root/reference/deap/cma.py for list-individual programs:
+``Strategy`` (cma.py:30-205), ``StrategyOnePlusLambda`` (cma.py:208-325)
+and ``StrategyMultiObjective`` (cma.py:328-547) with the reference's
+protocol — ``generate(ind_init) -> list`` and ``update(population)``,
+driven by ``compat.algorithms.eaGenerateUpdate``. The math runs in
+:mod:`deap_tpu.strategies.cma` (device tensors, jit-able); these
+wrappers only materialise individuals and read fitnesses back.
+
+Minimisation/maximisation direction is taken from the individuals'
+``fitness.weights`` on first contact, exactly like the reference, which
+sorts by the weighted fitness (cma.py:130).
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+from deap_tpu.core.fitness import FitnessSpec
+
+__all__ = ["Strategy", "StrategyOnePlusLambda", "StrategyMultiObjective"]
+
+
+def _key():
+    import jax
+
+    return jax.random.key(random.getrandbits(32))
+
+
+def _values(population) -> np.ndarray:
+    return np.asarray([ind.fitness.values for ind in population],
+                      np.float32)
+
+
+def _genomes(population) -> np.ndarray:
+    return np.asarray([list(ind) for ind in population], np.float32)
+
+
+def _spec_of(ind) -> FitnessSpec:
+    return FitnessSpec(tuple(ind.fitness.weights))
+
+
+class Strategy:
+    """Hansen CMA-ES with the reference's constructor keywords
+    (``lambda_``, ``mu``, ``weights``, ``cmatrix``, and the learning
+    rates, cma.py:41-78)."""
+
+    def __init__(self, centroid, sigma, **params):
+        from deap_tpu.strategies.cma import Strategy as Impl
+
+        self._impl = Impl(centroid, sigma, **params)
+        self._state = self._impl.initial_state()
+        self._spec_set = "spec" in params
+        self.update_count = 0
+
+    # -- attribute surface used by the reference's examples (cma_plotting)
+    @property
+    def centroid(self):
+        return np.asarray(self._state.centroid)
+
+    @property
+    def sigma(self):
+        return float(self._state.sigma)
+
+    @property
+    def C(self):
+        return np.asarray(self._state.C)
+
+    @property
+    def B(self):
+        return np.asarray(self._state.B)
+
+    @property
+    def diagD(self):
+        return np.asarray(self._state.diagD)
+
+    @property
+    def ps(self):
+        return np.asarray(self._state.ps)
+
+    @property
+    def pc(self):
+        return np.asarray(self._state.pc)
+
+    @property
+    def lambda_(self):
+        return self._impl.lambda_
+
+    @property
+    def mu(self):
+        return self._impl.mu
+
+    def generate(self, ind_init):
+        """λ individuals around the centroid (cma.py:111-121)."""
+        x = np.asarray(self._impl.generate(_key(), self._state))
+        return [ind_init(row) for row in x]
+
+    def update(self, population):
+        """Paths/covariance/step-size update from the evaluated
+        offspring (cma.py:123-171)."""
+        if not self._spec_set:
+            self._impl.spec = _spec_of(population[0])
+            self._spec_set = True
+        import jax.numpy as jnp
+
+        self._state = self._impl.update(
+            self._state, jnp.asarray(_genomes(population)),
+            jnp.asarray(_values(population)))
+        self.update_count += 1
+
+
+class StrategyOnePlusLambda:
+    """(1+λ) CMA-ES (cma.py:208-325). ``parent`` must carry a valid
+    fitness, like the reference's constructor expects."""
+
+    def __init__(self, parent, sigma, **params):
+        from deap_tpu.strategies.cma import StrategyOnePlusLambda as Impl
+
+        params.setdefault("spec", _spec_of(parent))
+        self._impl = Impl(list(parent), parent.fitness.values, sigma,
+                          **params)
+        self._state = self._impl.initial_state()
+        self._make_parent = type(parent)
+
+    @property
+    def parent(self):
+        """The current parent *with* its fitness, like the reference
+        (update deepcopies the winning offspring incl. fitness,
+        cma.py:300-306); raw values are recovered from the stored
+        weighted fitness."""
+        p = self._make_parent(np.asarray(self._state.parent))
+        w = np.atleast_1d(np.asarray(self._state.parent_w))
+        weights = np.asarray(self._impl.spec.weights, np.float64)
+        p.fitness.values = tuple(w / weights)
+        return p
+
+    @property
+    def sigma(self):
+        return float(self._state.sigma)
+
+    @property
+    def lambda_(self):
+        return self._impl.lambda_
+
+    def generate(self, ind_init):
+        x = np.asarray(self._impl.generate(_key(), self._state))
+        return [ind_init(row) for row in x]
+
+    def update(self, population):
+        import jax.numpy as jnp
+
+        self._state = self._impl.update(
+            self._state, jnp.asarray(_genomes(population)),
+            jnp.asarray(_values(population)))
+
+
+class StrategyMultiObjective:
+    """MO-CMA-ES (cma.py:328-547): µ independent (1+1) strategies with
+    indicator-based selection. Offspring remember their parent index
+    internally (the reference smuggles it through ``ind._ps``,
+    cma.py:408-426 — also attached here for program compatibility)."""
+
+    def __init__(self, population, sigma, mu=None, lambda_=1, **params):
+        from deap_tpu.strategies.cma import StrategyMultiObjective as Impl
+
+        params.setdefault("spec", _spec_of(population[0]))
+        self._impl = Impl(_genomes(population), _values(population),
+                          sigma, mu=mu, lambda_=lambda_, **params)
+        self._state = self._impl.initial_state()
+        self._pending_parent = None
+
+    @property
+    def mu(self):
+        return self._impl.mu
+
+    @property
+    def lambda_(self):
+        return self._impl.lambda_
+
+    @property
+    def sigmas(self):
+        return np.asarray(self._state.sigmas)
+
+    @property
+    def parents(self):
+        return np.asarray(self._state.x)
+
+    def generate(self, ind_init):
+        out = self._impl.generate(_key(), self._state)
+        x = np.asarray(out["x"])
+        self._pending_parent = np.asarray(out["parent"])
+        individuals = [ind_init(row) for row in x]
+        for i, ind in enumerate(individuals):
+            ind._ps = ("o", int(self._pending_parent[i]))
+        return individuals
+
+    def update(self, population):
+        import jax.numpy as jnp
+
+        # parent indices travel on the individuals (the reference's
+        # ``_ps`` tag, cma.py:500-504), so reordering the offspring
+        # between generate() and update() stays correct
+        try:
+            parent = np.asarray([ind._ps[1] for ind in population],
+                                np.int32)
+        except AttributeError:
+            raise RuntimeError(
+                "update() expects individuals produced by generate() "
+                "(they carry the parent-index _ps tag)") from None
+        if len(population) != self._impl.lambda_:
+            raise RuntimeError(
+                f"update() needs exactly lambda_={self._impl.lambda_} "
+                f"offspring, got {len(population)}")
+        genomes = {"x": jnp.asarray(_genomes(population)),
+                   "parent": jnp.asarray(parent)}
+        self._state = self._impl.update(
+            self._state, genomes, jnp.asarray(_values(population)))
+        self._pending_parent = None

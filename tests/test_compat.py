@@ -275,3 +275,125 @@ def test_history_genealogy():
     out1, out2 = hist.decorator(mate)(a, b)
     g = hist.getGenealogy(out1)
     assert set(g[out1.history_index]) == {pa, pb}
+
+
+def test_compat_cma_sphere_gate():
+    """compat.cma.Strategy through eaGenerateUpdate hits the reference's
+    quality gate (best < 1e-8 on sphere; deap/tests/
+    test_algorithms.py:53-66)."""
+    import random
+
+    from deap_tpu.compat import algorithms, base, cma, creator, tools
+
+    creator.create("FitCMA", base.Fitness, weights=(-1.0,))
+    creator.create("IndCMA", list, fitness=creator.FitCMA)
+    random.seed(3)
+    strat = cma.Strategy(centroid=[5.0] * 5, sigma=5.0, lambda_=20)
+    tb = base.Toolbox()
+    tb.register("evaluate", lambda ind: (sum(x * x for x in ind),))
+    tb.register("generate", strat.generate, creator.IndCMA)
+    tb.register("update", strat.update)
+    hof = tools.HallOfFame(1)
+    algorithms.eaGenerateUpdate(tb, ngen=120, halloffame=hof,
+                                verbose=False)
+    assert hof[0].fitness.values[0] < 1e-8
+    assert strat.update_count == 120
+    assert strat.sigma < 1.0  # converged step size
+
+
+def test_compat_cma_one_plus_lambda():
+    import random
+
+    from deap_tpu.compat import algorithms, base, cma, creator, tools
+
+    creator.create("FitOPL", base.Fitness, weights=(-1.0,))
+    creator.create("IndOPL", list, fitness=creator.FitOPL)
+    random.seed(5)
+    parent = creator.IndOPL([3.0] * 5)
+    parent.fitness.values = (sum(x * x for x in parent),)
+    strat = cma.StrategyOnePlusLambda(parent, sigma=2.0, lambda_=8)
+    tb = base.Toolbox()
+    tb.register("evaluate", lambda ind: (sum(x * x for x in ind),))
+    tb.register("generate", strat.generate, creator.IndOPL)
+    tb.register("update", strat.update)
+    hof = tools.HallOfFame(1)
+    algorithms.eaGenerateUpdate(tb, ngen=150, halloffame=hof,
+                                verbose=False)
+    assert hof[0].fitness.values[0] < 1e-6
+
+
+def test_compat_mo_cma_improves_front():
+    import math
+    import random
+
+    import numpy as np
+
+    from deap_tpu.compat import base, cma, creator
+
+    creator.create("FitMOC", base.Fitness, weights=(-1.0, -1.0))
+    creator.create("IndMOC", list, fitness=creator.FitMOC)
+
+    def zdt1(ind):
+        x = [min(max(v, 0.0), 1.0) for v in ind]
+        g = 1.0 + 9.0 * sum(x[1:]) / (len(x) - 1)
+        return x[0], g * (1.0 - math.sqrt(x[0] / g))
+
+    random.seed(11)
+    MU, NDIM = 12, 8
+    pop = []
+    for _ in range(MU):
+        ind = creator.IndMOC(random.uniform(0, 1) for _ in range(NDIM))
+        ind.fitness.values = zdt1(ind)
+        pop.append(ind)
+    f0 = np.array([ind.fitness.values for ind in pop])
+    strat = cma.StrategyMultiObjective(pop, sigma=1.0, mu=MU, lambda_=MU)
+    for _ in range(50):
+        off = strat.generate(creator.IndMOC)
+        assert all(hasattr(ind, "_ps") for ind in off)  # reference tag
+        for ind in off:
+            ind.fitness.values = zdt1(ind)
+        strat.update(off)
+    f = np.array([zdt1(list(r)) for r in strat.parents])
+    assert f[:, 1].mean() < f0[:, 1].mean()  # front moved down
+
+
+def test_compat_mo_cma_survives_offspring_reordering():
+    """Parent indices travel on the ``_ps`` tags, so sorting offspring
+    between generate() and update() stays correct (reference
+    cma.py:500-504 reads _ps per individual)."""
+    import math
+    import random
+
+    from deap_tpu.compat import base, cma, creator
+
+    creator.create("FitMOR", base.Fitness, weights=(-1.0, -1.0))
+    creator.create("IndMOR", list, fitness=creator.FitMOR)
+
+    def f(ind):
+        return sum(ind), sum((x - 1) ** 2 for x in ind)
+
+    random.seed(2)
+    pop = []
+    for _ in range(8):
+        ind = creator.IndMOR(random.uniform(0, 1) for _ in range(4))
+        ind.fitness.values = f(ind)
+        pop.append(ind)
+    strat = cma.StrategyMultiObjective(pop, sigma=0.5, mu=8, lambda_=8)
+    off = strat.generate(creator.IndMOR)
+    for ind in off:
+        ind.fitness.values = f(ind)
+    random.shuffle(off)  # legal against the reference
+    strat.update(off)  # must not mis-assign parents or raise
+
+
+def test_compat_one_plus_lambda_parent_has_fitness():
+    from deap_tpu.compat import base, cma, creator
+
+    creator.create("FitOPF", base.Fitness, weights=(-1.0,))
+    creator.create("IndOPF", list, fitness=creator.FitOPF)
+    parent = creator.IndOPF([2.0, 2.0])
+    parent.fitness.values = (8.0,)
+    strat = cma.StrategyOnePlusLambda(parent, sigma=1.0, lambda_=4)
+    p = strat.parent
+    assert p.fitness.valid
+    assert abs(p.fitness.values[0] - 8.0) < 1e-6
