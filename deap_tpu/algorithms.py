@@ -78,10 +78,14 @@ def var_and(key: jax.Array, pop: Population, toolbox, cxpb: float,
         odd = _tree_where(do_cx, c2, odd)
 
         def interleave(e, o, orig):
-            out = orig
-            out = out.at[0 : 2 * npairs : 2].set(e)
-            out = out.at[1 : 2 * npairs : 2].set(o)
-            return out
+            # stack+reshape beats two strided scatters (XLA lowers the
+            # .at[::2] form to scatter; this is a plain transpose-copy)
+            pair = jnp.stack([e, o], axis=1).reshape(
+                (2 * npairs,) + e.shape[1:])
+            if orig.shape[0] == 2 * npairs:
+                return pair.astype(orig.dtype)
+            return jnp.concatenate(
+                [pair.astype(orig.dtype), orig[2 * npairs:]], axis=0)
 
         genomes = jax.tree_util.tree_map(interleave, even, odd, genomes)
         cx_touched = jnp.zeros(n, bool).at[: 2 * npairs].set(

@@ -134,7 +134,11 @@ class StrategyOnePlusLambda:
         p = self._make_parent(np.asarray(self._state.parent))
         w = np.atleast_1d(np.asarray(self._state.parent_w))
         weights = np.asarray(self._impl.spec.weights, np.float64)
-        p.fitness.values = tuple(w / weights)
+        # zero-weighted objectives are unrecoverable from wvalues (the
+        # state stores values·weights); report 0.0 for those components
+        vals = np.divide(w, weights, out=np.zeros_like(w, np.float64),
+                         where=weights != 0)
+        p.fitness.values = tuple(vals)
         return p
 
     @property
@@ -170,7 +174,6 @@ class StrategyMultiObjective:
         self._impl = Impl(_genomes(population), _values(population),
                           sigma, mu=mu, lambda_=lambda_, **params)
         self._state = self._impl.initial_state()
-        self._pending_parent = None
 
     @property
     def mu(self):
@@ -191,10 +194,10 @@ class StrategyMultiObjective:
     def generate(self, ind_init):
         out = self._impl.generate(_key(), self._state)
         x = np.asarray(out["x"])
-        self._pending_parent = np.asarray(out["parent"])
+        parent = np.asarray(out["parent"])
         individuals = [ind_init(row) for row in x]
         for i, ind in enumerate(individuals):
-            ind._ps = ("o", int(self._pending_parent[i]))
+            ind._ps = ("o", int(parent[i]))
         return individuals
 
     def update(self, population):
@@ -218,4 +221,3 @@ class StrategyMultiObjective:
                    "parent": jnp.asarray(parent)}
         self._state = self._impl.update(
             self._state, genomes, jnp.asarray(_values(population)))
-        self._pending_parent = None

@@ -122,6 +122,50 @@ class Ephemeral(Terminal):
 class PrimitiveTree(list):
     """Prefix-ordered list of nodes (gp.py:63-184)."""
 
+    @classmethod
+    def from_string(cls, string, pset):
+        """Parse an infix rendering back into a tree (gp.py:106-153):
+        split on whitespace/parens/commas; names resolve through
+        ``pset.mapping``, anything else must literal-eval to a constant.
+        Type expectations are tracked through a queue like the
+        reference, so typed sets reject mismatched strings."""
+        import ast
+        from collections import deque
+
+        import re
+
+        tokens = re.split(r"[ \t\n\r\f\v(),]", string)
+        expr = []
+        ret_types: deque = deque()
+        for token in tokens:
+            if token == "":
+                continue
+            type_ = ret_types.popleft() if ret_types else None
+            if token in pset.mapping:
+                node = pset.mapping[token]
+                if (type_ is not None and isinstance(node.ret, type)
+                        and isinstance(type_, type)
+                        and not issubclass(node.ret, type_)):
+                    raise TypeError(
+                        f"Primitive {token} return type {node.ret} does "
+                        f"not match the expected one: {type_}.")
+                expr.append(node)
+                if node.arity > 0:
+                    ret_types.extendleft(reversed(node.args))
+            else:
+                try:
+                    value = ast.literal_eval(token)
+                except (ValueError, SyntaxError):
+                    raise TypeError(
+                        f"Unable to evaluate terminal: {token}.") from None
+                if (isinstance(type_, type)
+                        and not issubclass(type(value), type_)):
+                    raise TypeError(
+                        f"Terminal {value} type {type(value)} does not "
+                        f"match the expected one: {type_}.")
+                expr.append(Terminal(token, value, type_ or type(value)))
+        return cls(expr)
+
     @property
     def height(self):
         stack = [0]

@@ -1,0 +1,71 @@
+"""The reference's OneMax program, unchanged except for the imports.
+
+This is /root/reference/examples/ga/onemax.py's main loop shape (also
+README.md:74-104) running verbatim on :mod:`deap_tpu.compat` — the
+drop-in route of docs/porting.md. Everything below the import block is
+written exactly as a DEAP user would write it: list individuals,
+``creator.create``, stdlib ``random``, in-place operators, fitness
+deletion.
+"""
+
+import random
+
+from deap_tpu.compat import base, creator, tools
+
+
+def main(smoke: bool = False, seed: int = 64):
+    random.seed(seed)
+
+    creator.create("FitnessMax", base.Fitness, weights=(1.0,))
+    creator.create("Individual", list, fitness=creator.FitnessMax)
+
+    toolbox = base.Toolbox()
+    toolbox.register("attr_bool", random.randint, 0, 1)
+    toolbox.register("individual", tools.initRepeat, creator.Individual,
+                     toolbox.attr_bool, 100)
+    toolbox.register("population", tools.initRepeat, list,
+                     toolbox.individual)
+
+    def evalOneMax(individual):
+        return sum(individual),
+
+    toolbox.register("evaluate", evalOneMax)
+    toolbox.register("mate", tools.cxTwoPoint)
+    toolbox.register("mutate", tools.mutFlipBit, indpb=0.05)
+    toolbox.register("select", tools.selTournament, tournsize=3)
+
+    pop = toolbox.population(n=300 if not smoke else 60)
+    CXPB, MUTPB, NGEN = 0.5, 0.2, 40 if not smoke else 10
+
+    fitnesses = map(toolbox.evaluate, pop)
+    for ind, fit in zip(pop, fitnesses):
+        ind.fitness.values = fit
+
+    for g in range(NGEN):
+        offspring = toolbox.select(pop, len(pop))
+        offspring = list(map(toolbox.clone, offspring))
+
+        for child1, child2 in zip(offspring[::2], offspring[1::2]):
+            if random.random() < CXPB:
+                toolbox.mate(child1, child2)
+                del child1.fitness.values
+                del child2.fitness.values
+        for mutant in offspring:
+            if random.random() < MUTPB:
+                toolbox.mutate(mutant)
+                del mutant.fitness.values
+
+        invalid_ind = [ind for ind in offspring if not ind.fitness.valid]
+        fitnesses = map(toolbox.evaluate, invalid_ind)
+        for ind, fit in zip(invalid_ind, fitnesses):
+            ind.fitness.values = fit
+
+        pop[:] = offspring
+
+    best = tools.selBest(pop, 1)[0]
+    print(f"Best individual has fitness {best.fitness.values[0]}")
+    return best.fitness.values[0]
+
+
+if __name__ == "__main__":
+    main()

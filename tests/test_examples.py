@@ -66,6 +66,7 @@ EXAMPLES = [
     "examples.coev.hillis",
     "examples.coev.symbreg",
     "examples.bbob",
+    "examples.compat_onemax",
 ]
 
 

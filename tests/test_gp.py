@@ -190,3 +190,90 @@ def test_to_graph_single_terminal(pset):
     genome = from_string("ARG0", pset, MAX_LEN)
     nodes, edges, labels = to_graph(genome, pset)
     assert nodes == [0] and edges == [] and labels[0] == "ARG0"
+
+
+def test_tensor_interpreter_agrees_with_compat_compile():
+    """The batched stack interpreter and the compat (Python-object)
+    evaluator compute identical values for the same trees — the tensor
+    node encoding converted to compat nodes by name."""
+    import math
+    import operator
+
+    import numpy as np
+
+    from deap_tpu import gp as tgp
+    from deap_tpu.compat import gp as cgp
+
+    tpset = tgp.math_set(n_args=1)
+    interp = tgp.make_interpreter(tpset, 48)
+    gen = tgp.gen_half_and_half(tpset, 48, 2, 4)
+    # even point count keeps x away from 0: near-singular protectedDiv
+    # denominators make f32 (tensor) and f64 (compat) trig argument
+    # reduction legitimately diverge
+    X = jnp.linspace(-1.0, 1.0, 8)[:, None]
+
+    cset = cgp.PrimitiveSet("MAIN", 1)
+    cset.addPrimitive(operator.add, 2)
+    cset.addPrimitive(operator.sub, 2)
+    cset.addPrimitive(operator.mul, 2)
+    # same protection rule as the tensor pset: 1.0 iff b == 0 exactly
+    cset.addPrimitive(lambda a, b: a / b if b != 0.0 else 1.0, 2,
+                      name="protectedDiv")
+    cset.addPrimitive(operator.neg, 1)
+    cset.addPrimitive(math.cos, 1)
+    cset.addPrimitive(math.sin, 1)
+
+    def to_compat(genome):
+        nodes = np.asarray(genome["nodes"])
+        consts = np.asarray(genome["consts"])
+        out = []
+        for i in range(int(genome["length"])):
+            nid = int(nodes[i])
+            if nid < tpset.n_ops:
+                out.append(cset.mapping[tpset.primitives[nid].name])
+            elif nid < tpset.n_ops + tpset.n_args:
+                out.append(cset.mapping[f"ARG{nid - tpset.n_ops}"])
+            else:
+                v = float(consts[i])
+                out.append(cgp.Terminal(repr(v), v, object))
+        return cgp.PrimitiveTree(out)
+
+    checked = 0
+    for i in range(25):
+        g = gen(jax.random.key(1000 + i))
+        f = cgp.compile(to_compat(g), cset)
+        tensor_out = np.asarray(interp(g, X))
+        compat_out = np.array([f(float(x)) for x in X[:, 0]],
+                              np.float32)
+        # protected division thresholds may legitimately differ at
+        # near-zero denominators; skip trees that hit that edge
+        if not np.isfinite(compat_out).all():
+            continue
+        assert np.allclose(tensor_out, compat_out, rtol=1e-4,
+                           atol=1e-5), tgp.to_string(g, tpset)
+        checked += 1
+    assert checked >= 15
+
+
+def test_compat_from_string_round_trip():
+    """PrimitiveTree.from_string (gp.py:106-153) inverts the prefix
+    printer for function-call-style expressions."""
+    import operator
+    import random
+
+    from deap_tpu.compat import gp as cgp
+
+    pset = cgp.PrimitiveSet("MAIN", 1)
+    pset.addPrimitive(operator.add, 2)
+    pset.addPrimitive(operator.mul, 2)
+    pset.addPrimitive(operator.neg, 1)
+    pset.addTerminal(3.0)
+    pset.renameArguments(ARG0="x")
+    random.seed(7)
+    for _ in range(20):
+        t = cgp.genGrow(pset, 2, 4)
+        t2 = cgp.PrimitiveTree.from_string(str(t), pset)
+        f1 = cgp.compile(t, pset)
+        f2 = cgp.compile(t2, pset)
+        for x in (-1.0, 0.25, 2.0):
+            assert abs(f1(x) - f2(x)) < 1e-9
