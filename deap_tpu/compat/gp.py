@@ -124,7 +124,9 @@ class PrimitiveTree(list):
 
     @classmethod
     def from_string(cls, string, pset):
-        """Parse an infix rendering back into a tree (gp.py:106-153):
+        """Parse the prefix/function-call rendering produced by
+        ``str(tree)`` — e.g. ``"add(x, 3.0)"`` — back into a tree
+        (gp.py:106-153):
         split on whitespace/parens/commas; names resolve through
         ``pset.mapping``, anything else must literal-eval to a constant.
         Type expectations are tracked through a queue like the

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from deap_tpu.gp.interpreter import child_table
 from deap_tpu.gp.pset import PrimitiveSet
 from deap_tpu.gp.tree import Genome, make_generator
 
@@ -39,22 +40,29 @@ def _build_branch(pset: PrimitiveSet, max_len: int, branch_idx: int,
     max_ar = max(pset.max_arity, 1)
     prims = list(pset.primitives)
 
+    const_row = n_ops + pset.n_args
+
     def interpret(genomes, X):
+        # same two-pass scheme as gp.interpreter.make_interpreter: an
+        # int-only child-table pre-pass so the data pass writes at
+        # batch-uniform slot indices (per-tree write positions would
+        # turn into whole-buffer scatter copies under vmap)
         genome = genomes[branch_idx]
         nodes, consts, length = (genome["nodes"], genome["consts"],
                                  genome["length"])
+        ML = min(nodes.shape[0], max_len)
+        nodes = nodes[:ML]
+        consts = consts[:ML]
         P = X.shape[0]
         argsT = X.T.astype(jnp.float32)
-        stack0 = jnp.zeros((max_len + max_ar, P), jnp.float32)
+        C = child_table(nodes, length, arity, max_ar)
 
-        def step(carry, t):
-            stack, sp = carry
-            rt = length - 1 - t
-            valid = rt >= 0
-            slot = jnp.maximum(rt, 0)
-            node = nodes[slot]
+        def step(out, t):
+            rt = ML - 1 - t
+            node = jnp.where(rt < length, nodes[rt], jnp.int32(const_row))
+            cr = C[rt]
             ops_in = [
-                lax.dynamic_index_in_dim(stack, sp - 1 - i, keepdims=False)
+                lax.dynamic_index_in_dim(out, cr[i], keepdims=False)
                 for i in range(max_ar)
             ]
             rows = []
@@ -65,21 +73,15 @@ def _build_branch(pset: PrimitiveSet, max_len: int, branch_idx: int,
                     sub_X = jnp.stack(ops_in[: p.arity], axis=1)
                     rows.append(interps[p.adf](genomes, sub_X))
             rows.extend(argsT)
-            rows.append(jnp.broadcast_to(consts[slot], (P,)))
+            rows.append(jnp.broadcast_to(consts[rt], (P,)))
             allv = jnp.stack(rows)
-            row = jnp.minimum(node, jnp.int32(n_ops + pset.n_args))
+            row = jnp.minimum(node, jnp.int32(const_row))
             res = lax.dynamic_index_in_dim(allv, row, keepdims=False)
-            ar = arity[node]
-            new_sp = sp - ar + 1
-            new_stack = lax.dynamic_update_index_in_dim(
-                stack, res, new_sp - 1, axis=0)
-            stack = jnp.where(valid, new_stack, stack)
-            sp = jnp.where(valid, new_sp, sp)
-            return (stack, sp), None
+            return lax.dynamic_update_index_in_dim(out, res, rt, axis=0), None
 
-        (stack, _), _ = lax.scan(
-            step, (stack0, jnp.int32(0)), jnp.arange(max_len))
-        return stack[0]
+        out, _ = lax.scan(step, jnp.zeros((ML, P), jnp.float32),
+                          jnp.arange(ML))
+        return out[0]
 
     return interpret
 

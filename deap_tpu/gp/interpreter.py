@@ -10,11 +10,26 @@ per-individual dispatch, and — unlike the reference, which hits a
 MemoryError past depth ~90 via nested lambda eval (gp.py:481-487) — cost
 is strictly O(max_len · vocab · points).
 
-Execution model: scan the prefix right-to-left; terminals push their
-value vector; an operator of arity k pops k operand vectors and pushes
-the result. Per slot, every primitive is evaluated on the stack top
-(vocab is small — the VPU eats the redundancy) and the node id selects
-the row; this is branch-free and fuses completely.
+Execution model — two passes over the prefix, both ``lax.scan``:
+
+1. **Child-table pre-pass (ints only).** Walk the prefix right-to-left
+   with a stack of *slot indices*: for each operator slot record which
+   slots hold its operands. This touches only ``int32[max_len]``
+   arrays, so its per-tree dynamic pushes cost nothing.
+2. **Data pass.** Walk slots right-to-left filling an output buffer
+   ``out[max_len, points]``: every primitive is evaluated on the
+   slots' operand rows (vocab is small — the VPU eats the redundancy),
+   the node id selects the row, and the result lands at ``out[slot]``.
+
+The pre-pass exists so the data pass writes at a **batch-uniform**
+index (the scan's own slot counter): under ``vmap`` a per-tree write
+position turns ``dynamic_update_slice`` into a scatter, which forces
+XLA to copy the whole data buffer every step — measured ~250× slower
+than the arithmetic itself. With uniform write positions the buffer
+updates alias in place and only the (read-only) operand *gathers* are
+per-tree. In prefix order children always sit at higher slots than
+their parent, so right-to-left slot order evaluates children first for
+every tree regardless of its length.
 """
 
 from __future__ import annotations
@@ -27,6 +42,39 @@ import jax.numpy as jnp
 from jax import lax
 
 from deap_tpu.gp.pset import PrimitiveSet
+
+
+def child_table(nodes: jnp.ndarray, length, arity: jnp.ndarray,
+                max_ar: int) -> jnp.ndarray:
+    """Child-slot table for a prefix genome — the int-only pre-pass
+    shared by this module's interpreter and the ADF branch interpreter
+    (gp/adf.py).
+
+    Walks the prefix right-to-left with a stack of slot indices; entry
+    ``[slot, i]`` of the returned ``int32[ML, max_ar]`` is the slot
+    holding operand *i* of the node at ``slot`` (garbage, never
+    referenced, for terminals and padding).
+    """
+    ML = nodes.shape[0]
+    ar_all = jnp.where(jnp.arange(ML) < length, arity[nodes], 0)
+
+    def pre(carry, t):
+        stack, sp = carry
+        rt = ML - 1 - t
+        valid = rt < length
+        children = jnp.stack([
+            lax.dynamic_index_in_dim(stack, sp - 1 - i, keepdims=False)
+            for i in range(max_ar)])
+        new_sp = jnp.where(valid, sp - ar_all[rt] + 1, sp)
+        pushed = lax.dynamic_update_index_in_dim(
+            stack, rt, new_sp - 1, axis=0)
+        stack = jnp.where(valid, pushed, stack)
+        return (stack, new_sp), children
+
+    _, ch = lax.scan(
+        pre, (jnp.zeros(ML + max_ar, jnp.int32), jnp.int32(0)),
+        jnp.arange(ML))
+    return ch[::-1]
 
 
 def make_interpreter(pset: PrimitiveSet, max_len: int) -> Callable:
@@ -45,45 +93,48 @@ def make_interpreter(pset: PrimitiveSet, max_len: int) -> Callable:
     max_ar = max(pset.max_arity, 1)
     prims = list(pset.primitives)
 
+    const_row = n_ops + pset.n_args
+
     def interpret(genome, X):
         nodes, consts, length = (genome["nodes"], genome["consts"],
                                  genome["length"])
+        # genome arrays may be wider than this interpreter's max_len
+        # (semantic operators build wide offspring but cap ``length``,
+        # gp/semantic.py _keep_if_fits) or narrower; only the first
+        # min(width, max_len) slots can hold real nodes
+        ML = min(nodes.shape[0], max_len)
+        nodes = nodes[:ML]
+        consts = consts[:ML]
         P = X.shape[0]
         argsT = X.T.astype(jnp.float32)            # [n_args, P]
-        stack0 = jnp.zeros((max_len + max_ar, P), jnp.float32)
+        C = child_table(nodes, length, arity, max_ar)  # [ML, max_ar]
 
-        def step(carry, t):
-            stack, sp = carry
-            rt = length - 1 - t                    # walk the prefix backwards
-            valid = rt >= 0
-            slot = jnp.maximum(rt, 0)
-            node = nodes[slot]
-            # operand vectors from the stack top
+        # ---- pass 2: fill the output buffer, children before parents ----
+        def step(out, t):
+            rt = ML - 1 - t                   # batch-uniform index
+            # padded slots act as inert constants (never referenced by
+            # any real parent's child table)
+            node = jnp.where(rt < length, nodes[rt], jnp.int32(const_row))
+            cr = C[rt]
             ops_in = [
-                lax.dynamic_index_in_dim(stack, sp - 1 - i, keepdims=False)
+                lax.dynamic_index_in_dim(out, cr[i], keepdims=False)
                 for i in range(max_ar)
             ]
             rows = []
             for p in prims:
                 rows.append(p.fn(*ops_in[: p.arity]))
             rows.extend(argsT)                      # argument terminals
-            rows.append(jnp.broadcast_to(consts[slot], (P,)))  # constant
+            rows.append(jnp.broadcast_to(consts[rt], (P,)))  # constant
             allv = jnp.stack(rows)                  # [n_ops + n_args + 1, P]
             # every constant-family id (fixed terminal or ERC) shares the
             # one constant row
-            row = jnp.minimum(node, jnp.int32(n_ops + pset.n_args))
+            row = jnp.minimum(node, jnp.int32(const_row))
             res = lax.dynamic_index_in_dim(allv, row, keepdims=False)
-            ar = arity[node]
-            new_sp = sp - ar + 1
-            new_stack = lax.dynamic_update_index_in_dim(
-                stack, res, new_sp - 1, axis=0)
-            stack = jnp.where(valid, new_stack, stack)
-            sp = jnp.where(valid, new_sp, sp)
-            return (stack, sp), None
+            return lax.dynamic_update_index_in_dim(out, res, rt, axis=0), None
 
-        (stack, sp), _ = lax.scan(
-            step, (stack0, jnp.int32(0)), jnp.arange(max_len))
-        return stack[0]
+        out, _ = lax.scan(step, jnp.zeros((ML, P), jnp.float32),
+                          jnp.arange(ML))
+        return out[0]
 
     return interpret
 
