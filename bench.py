@@ -30,22 +30,41 @@ import time
 def _axon_tunnel_reachable() -> bool:
     """When the TPU is attached through the axon loopback relay, a wedged
     or dead relay makes the first jax call hang forever rather than
-    fail. Probe the relay's fixed port list before initialising jax so a
-    dead tunnel degrades to the CPU path instead of hanging the bench."""
+    fail. Probe before initialising jax so a bad tunnel degrades to the
+    CPU path instead of hanging the bench: first the relay's fixed port
+    list (dead relay: connection refused), then — since a wedged relay
+    can accept TCP yet hang device init — a throwaway subprocess that
+    must enumerate devices within a timeout."""
     if not os.environ.get("PALLAS_AXON_POOL_IPS"):
         return True  # not tunnel-attached; nothing to probe
+    port_open = False
     for port in (8082, 8083, 8087, 8092, 8093, 8097,
                  8102, 8103, 8107, 8112, 8113, 8117):
         s = socket.socket()
         s.settimeout(1)
         try:
             s.connect(("127.0.0.1", port))
-            return True
+            port_open = True
+            break
         except OSError:
             pass
         finally:
             s.close()
-    return False
+    if not port_open:
+        return False
+    if os.environ.get("DEAP_TPU_SKIP_PROBE"):
+        return True  # trust the port check; skip the slow device probe
+    import subprocess
+    import sys
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(len(jax.devices()))"],
+            capture_output=True, timeout=180)
+        return out.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
 
 
 _TUNNEL_OK = _axon_tunnel_reachable()

@@ -21,9 +21,8 @@ from typing import Callable, List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
-from deap_tpu.gp.interpreter import child_table
+from deap_tpu.gp.interpreter import run_data_pass
 from deap_tpu.gp.pset import PrimitiveSet
 from deap_tpu.gp.tree import Genome, make_generator
 
@@ -35,36 +34,13 @@ def _build_branch(pset: PrimitiveSet, max_len: int, branch_idx: int,
                   interps: dict) -> Callable:
     """interp(genomes, X) for one branch; ADF nodes dispatch into
     ``interps`` (already built for every branch index > branch_idx)."""
-    arity = pset.arity_table()
-    n_ops = pset.n_ops
-    max_ar = max(pset.max_arity, 1)
     prims = list(pset.primitives)
 
-    const_row = n_ops + pset.n_args
-
     def interpret(genomes, X):
-        # same two-pass scheme as gp.interpreter.make_interpreter: an
-        # int-only child-table pre-pass so the data pass writes at
-        # batch-uniform slot indices (per-tree write positions would
-        # turn into whole-buffer scatter copies under vmap)
-        genome = genomes[branch_idx]
-        nodes, consts, length = (genome["nodes"], genome["consts"],
-                                 genome["length"])
-        ML = min(nodes.shape[0], max_len)
-        nodes = nodes[:ML]
-        consts = consts[:ML]
-        P = X.shape[0]
-        argsT = X.T.astype(jnp.float32)
-        C = child_table(nodes, length, arity, max_ar)
-
-        def step(out, t):
-            rt = ML - 1 - t
-            node = jnp.where(rt < length, nodes[rt], jnp.int32(const_row))
-            cr = C[rt]
-            ops_in = [
-                lax.dynamic_index_in_dim(out, cr[i], keepdims=False)
-                for i in range(max_ar)
-            ]
+        # the shared two-pass core (gp/interpreter.py run_data_pass);
+        # only the primitive evaluation differs — ADF call nodes
+        # dispatch into the callee branch's interpreter
+        def prim_rows(ops_in):
             rows = []
             for p in prims:
                 if p.adf is None:
@@ -72,16 +48,10 @@ def _build_branch(pset: PrimitiveSet, max_len: int, branch_idx: int,
                 else:
                     sub_X = jnp.stack(ops_in[: p.arity], axis=1)
                     rows.append(interps[p.adf](genomes, sub_X))
-            rows.extend(argsT)
-            rows.append(jnp.broadcast_to(consts[rt], (P,)))
-            allv = jnp.stack(rows)
-            row = jnp.minimum(node, jnp.int32(const_row))
-            res = lax.dynamic_index_in_dim(allv, row, keepdims=False)
-            return lax.dynamic_update_index_in_dim(out, res, rt, axis=0), None
+            return rows
 
-        out, _ = lax.scan(step, jnp.zeros((ML, P), jnp.float32),
-                          jnp.arange(ML))
-        return out[0]
+        return run_data_pass(pset, max_len, genomes[branch_idx], X,
+                             prim_rows)
 
     return interpret
 

@@ -77,6 +77,60 @@ def child_table(nodes: jnp.ndarray, length, arity: jnp.ndarray,
     return ch[::-1]
 
 
+def run_data_pass(pset: PrimitiveSet, max_len: int, genome, X,
+                  prim_rows: Callable) -> jnp.ndarray:
+    """Shared two-pass evaluation core (this module's interpreter and
+    the ADF branch interpreter in gp/adf.py).
+
+    ``prim_rows(ops_in) -> [rows]`` evaluates every primitive on the
+    operand vectors (the ADF interpreter dispatches call nodes into
+    other branches here); everything else — child table, output buffer,
+    row selection, padding semantics — is identical across both.
+    Returns the root's value vector ``f32[points]``.
+    """
+    arity = pset.arity_table()
+    max_ar = max(pset.max_arity, 1)
+    const_row = pset.n_ops + pset.n_args
+
+    nodes, consts, length = (genome["nodes"], genome["consts"],
+                             genome["length"])
+    # genome arrays may be wider than this interpreter's max_len
+    # (semantic operators build wide offspring but cap ``length``,
+    # gp/semantic.py _keep_if_fits) or narrower; only the first
+    # min(width, max_len) slots can hold real nodes
+    ML = min(nodes.shape[0], max_len)
+    nodes = nodes[:ML]
+    consts = consts[:ML]
+    P = X.shape[0]
+    argsT = X.T.astype(jnp.float32)                # [n_args, P]
+    C = child_table(nodes, length, arity, max_ar)  # [ML, max_ar]
+
+    # pass 2: fill the output buffer, children before parents
+    def step(out, t):
+        rt = ML - 1 - t                       # batch-uniform index
+        # padded slots act as inert constants (never referenced by
+        # any real parent's child table)
+        node = jnp.where(rt < length, nodes[rt], jnp.int32(const_row))
+        cr = C[rt]
+        ops_in = [
+            lax.dynamic_index_in_dim(out, cr[i], keepdims=False)
+            for i in range(max_ar)
+        ]
+        rows = prim_rows(ops_in)
+        rows.extend(argsT)                          # argument terminals
+        rows.append(jnp.broadcast_to(consts[rt], (P,)))  # constant
+        allv = jnp.stack(rows)                  # [n_ops + n_args + 1, P]
+        # every constant-family id (fixed terminal or ERC) shares the
+        # one constant row
+        row = jnp.minimum(node, jnp.int32(const_row))
+        res = lax.dynamic_index_in_dim(allv, row, keepdims=False)
+        return lax.dynamic_update_index_in_dim(out, res, rt, axis=0), None
+
+    out, _ = lax.scan(step, jnp.zeros((ML, P), jnp.float32),
+                      jnp.arange(ML))
+    return out[0]
+
+
 def make_interpreter(pset: PrimitiveSet, max_len: int) -> Callable:
     """Build ``evaluate(genome, X) -> f32[points]`` for one tree.
 
@@ -88,53 +142,13 @@ def make_interpreter(pset: PrimitiveSet, max_len: int) -> Callable:
         raise ValueError(
             "primitive set contains ADF calls; use "
             "deap_tpu.gp.adf.make_adf_interpreter")
-    arity = pset.arity_table()
-    n_ops = pset.n_ops
-    max_ar = max(pset.max_arity, 1)
     prims = list(pset.primitives)
 
-    const_row = n_ops + pset.n_args
-
     def interpret(genome, X):
-        nodes, consts, length = (genome["nodes"], genome["consts"],
-                                 genome["length"])
-        # genome arrays may be wider than this interpreter's max_len
-        # (semantic operators build wide offspring but cap ``length``,
-        # gp/semantic.py _keep_if_fits) or narrower; only the first
-        # min(width, max_len) slots can hold real nodes
-        ML = min(nodes.shape[0], max_len)
-        nodes = nodes[:ML]
-        consts = consts[:ML]
-        P = X.shape[0]
-        argsT = X.T.astype(jnp.float32)            # [n_args, P]
-        C = child_table(nodes, length, arity, max_ar)  # [ML, max_ar]
+        def prim_rows(ops_in):
+            return [p.fn(*ops_in[: p.arity]) for p in prims]
 
-        # ---- pass 2: fill the output buffer, children before parents ----
-        def step(out, t):
-            rt = ML - 1 - t                   # batch-uniform index
-            # padded slots act as inert constants (never referenced by
-            # any real parent's child table)
-            node = jnp.where(rt < length, nodes[rt], jnp.int32(const_row))
-            cr = C[rt]
-            ops_in = [
-                lax.dynamic_index_in_dim(out, cr[i], keepdims=False)
-                for i in range(max_ar)
-            ]
-            rows = []
-            for p in prims:
-                rows.append(p.fn(*ops_in[: p.arity]))
-            rows.extend(argsT)                      # argument terminals
-            rows.append(jnp.broadcast_to(consts[rt], (P,)))  # constant
-            allv = jnp.stack(rows)                  # [n_ops + n_args + 1, P]
-            # every constant-family id (fixed terminal or ERC) shares the
-            # one constant row
-            row = jnp.minimum(node, jnp.int32(const_row))
-            res = lax.dynamic_index_in_dim(allv, row, keepdims=False)
-            return lax.dynamic_update_index_in_dim(out, res, rt, axis=0), None
-
-        out, _ = lax.scan(step, jnp.zeros((ML, P), jnp.float32),
-                          jnp.arange(ML))
-        return out[0]
+        return run_data_pass(pset, max_len, genome, X, prim_rows)
 
     return interpret
 

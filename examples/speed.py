@@ -1,0 +1,70 @@
+"""Time every example program — counterpart of the reference's
+historical timing harness (examples/speed.txt is its program list;
+SURVEY.md §4.5).
+
+Runs each program's ``main(smoke=True)`` and prints one JSON line per
+program: ``{"example": ..., "seconds": ..., "ok": ...}``. Pass
+``--full`` for the real (non-smoke) configurations.
+
+Usage::
+
+    python examples/speed.py [--full] [--cpu] [pattern]
+
+``--cpu`` forces the CPU backend (the environment's TPU plugin pins
+``jax_platforms``, and a wedged tunnel hangs jax init — see bench.py's
+probe; this flag is the manual override).
+"""
+
+import importlib
+import json
+import pathlib
+import sys
+import time
+
+
+def discover():
+    root = pathlib.Path(__file__).resolve().parent
+    out = []
+    for p in sorted(root.rglob("*.py")):
+        if p.name.startswith("_") or p.name == "speed.py":
+            continue
+        rel = p.relative_to(root.parent).with_suffix("")
+        out.append(".".join(rel.parts))
+    return out
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    full = "--full" in argv
+    if full:
+        argv.remove("--full")
+    if "--cpu" in argv:
+        argv.remove("--cpu")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    pattern = argv[0] if argv else ""
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    if str(root) not in sys.path:
+        sys.path.insert(0, str(root))
+
+    for name in discover():
+        if pattern and pattern not in name:
+            continue
+        t0 = time.perf_counter()
+        ok = True
+        try:
+            mod = importlib.import_module(name)
+            mod.main(smoke=not full)
+        except Exception as e:  # keep timing the rest
+            ok = f"{type(e).__name__}: {e}"
+        print(json.dumps({
+            "example": name,
+            "seconds": round(time.perf_counter() - t0, 2),
+            "ok": ok,
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
