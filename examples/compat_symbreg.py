@@ -1,0 +1,91 @@
+"""The reference's symbolic-regression program, unchanged except imports.
+
+/root/reference/examples/gp/symbreg.py's program shape (seed 318 at
+symbreg.py:73) running verbatim on :mod:`deap_tpu.compat` — the GP half
+of docs/porting.md's drop-in route: ``PrimitiveSet`` with Python
+callables, an ephemeral constant, ``staticLimit`` decorators,
+``MultiStatistics`` and ``eaSimple``. The only semantic upgrade is that
+``compile`` interprets the tree instead of ``eval``-ing generated
+source.
+"""
+
+import math
+import operator
+import random
+
+from deap_tpu.compat import algorithms, base, creator, gp, tools
+
+
+def protectedDiv(left, right):
+    try:
+        return left / right
+    except ZeroDivisionError:
+        return 1
+
+
+def main(smoke: bool = False, seed: int = 318):
+    random.seed(seed)
+
+    pset = gp.PrimitiveSet("MAIN", 1)
+    pset.addPrimitive(operator.add, 2)
+    pset.addPrimitive(operator.sub, 2)
+    pset.addPrimitive(operator.mul, 2)
+    pset.addPrimitive(protectedDiv, 2)
+    pset.addPrimitive(operator.neg, 1)
+    pset.addPrimitive(math.cos, 1)
+    pset.addPrimitive(math.sin, 1)
+    pset.addEphemeralConstant("rand101", lambda: random.randint(-1, 1))
+    pset.renameArguments(ARG0="x")
+
+    creator.create("FitnessMin", base.Fitness, weights=(-1.0,))
+    creator.create("Individual", gp.PrimitiveTree,
+                   fitness=creator.FitnessMin)
+
+    toolbox = base.Toolbox()
+    toolbox.register("expr", gp.genHalfAndHalf, pset=pset, min_=1, max_=2)
+    toolbox.register("individual", tools.initIterate, creator.Individual,
+                     toolbox.expr)
+    toolbox.register("population", tools.initRepeat, list,
+                     toolbox.individual)
+    toolbox.register("compile", gp.compile, pset=pset)
+
+    def evalSymbReg(individual, points):
+        func = toolbox.compile(expr=individual)
+        sqerrors = ((func(x) - x ** 4 - x ** 3 - x ** 2 - x) ** 2
+                    for x in points)
+        return math.fsum(sqerrors) / len(points),
+
+    toolbox.register("evaluate", evalSymbReg,
+                     points=[x / 10.0 for x in range(-10, 10)])
+    toolbox.register("select", tools.selTournament, tournsize=3)
+    toolbox.register("mate", gp.cxOnePoint)
+    toolbox.register("expr_mut", gp.genFull, min_=0, max_=2)
+    toolbox.register("mutate", gp.mutUniform, expr=toolbox.expr_mut,
+                     pset=pset)
+
+    toolbox.decorate("mate", gp.staticLimit(
+        key=operator.attrgetter("height"), max_value=17))
+    toolbox.decorate("mutate", gp.staticLimit(
+        key=operator.attrgetter("height"), max_value=17))
+
+    pop = toolbox.population(n=300 if not smoke else 60)
+    hof = tools.HallOfFame(1)
+
+    stats_fit = tools.Statistics(lambda ind: ind.fitness.values)
+    stats_size = tools.Statistics(len)
+    mstats = tools.MultiStatistics(fitness=stats_fit, size=stats_size)
+    import numpy
+
+    mstats.register("avg", numpy.mean)
+    mstats.register("min", numpy.min)
+
+    pop, log = algorithms.eaSimple(
+        pop, toolbox, 0.5, 0.1, 40 if not smoke else 8,
+        stats=mstats, halloffame=hof, verbose=False)
+    best_mse = hof[0].fitness.values[0]
+    print(f"Best MSE: {best_mse:.6f}  ({hof[0]})")
+    return best_mse
+
+
+if __name__ == "__main__":
+    main()

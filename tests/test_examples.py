@@ -67,6 +67,7 @@ EXAMPLES = [
     "examples.coev.symbreg",
     "examples.bbob",
     "examples.compat_onemax",
+    "examples.compat_symbreg",
 ]
 
 
