@@ -1,0 +1,51 @@
+"""Axon-relay health probe, shared by ``bench.py`` and
+``__graft_entry__.py``.
+
+This box attaches its single TPU through the axon loopback relay
+(``PALLAS_AXON_POOL_IPS``). A dead relay refuses TCP; a *wedged* relay
+accepts TCP but hangs the first backend-initialising jax call forever.
+Hence two stages: a 1s port scan over the relay's fixed port list, then
+a throwaway subprocess that must enumerate devices within a timeout
+(``DEAP_TPU_SKIP_PROBE=1`` trusts the port scan and skips the slow
+stage). Deliberately jax-free so callers can probe before deciding
+which backend to let jax initialise.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+RELAY_PORTS = (8082, 8083, 8087, 8092, 8093, 8097,
+               8102, 8103, 8107, 8112, 8113, 8117)
+
+
+def axon_tunnel_reachable(probe_timeout: int = 180) -> bool:
+    """True when TPU work is safe: not tunnel-attached, or the relay
+    answers and a throwaway subprocess can enumerate devices."""
+    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return True  # not tunnel-attached; nothing to probe
+    port_open = False
+    for port in RELAY_PORTS:
+        s = socket.socket()
+        s.settimeout(1)
+        try:
+            s.connect(("127.0.0.1", port))
+            port_open = True
+            break
+        except OSError:
+            pass
+        finally:
+            s.close()
+    if not port_open:
+        return False
+    if os.environ.get("DEAP_TPU_SKIP_PROBE"):
+        return True  # trust the port check; skip the slow device probe
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(len(jax.devices()))"],
+            capture_output=True, timeout=probe_timeout)
+        return out.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False

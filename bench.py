@@ -23,51 +23,13 @@ The scalar fetch's fixed round-trip latency is amortised over NGEN.
 
 import json
 import os
-import socket
+import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _axon_probe import axon_tunnel_reachable
 
-def _axon_tunnel_reachable() -> bool:
-    """When the TPU is attached through the axon loopback relay, a wedged
-    or dead relay makes the first jax call hang forever rather than
-    fail. Probe before initialising jax so a bad tunnel degrades to the
-    CPU path instead of hanging the bench: first the relay's fixed port
-    list (dead relay: connection refused), then — since a wedged relay
-    can accept TCP yet hang device init — a throwaway subprocess that
-    must enumerate devices within a timeout."""
-    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
-        return True  # not tunnel-attached; nothing to probe
-    port_open = False
-    for port in (8082, 8083, 8087, 8092, 8093, 8097,
-                 8102, 8103, 8107, 8112, 8113, 8117):
-        s = socket.socket()
-        s.settimeout(1)
-        try:
-            s.connect(("127.0.0.1", port))
-            port_open = True
-            break
-        except OSError:
-            pass
-        finally:
-            s.close()
-    if not port_open:
-        return False
-    if os.environ.get("DEAP_TPU_SKIP_PROBE"):
-        return True  # trust the port check; skip the slow device probe
-    import subprocess
-    import sys
-
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(len(jax.devices()))"],
-            capture_output=True, timeout=180)
-        return out.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
-
-
-_TUNNEL_OK = _axon_tunnel_reachable()
+_TUNNEL_OK = axon_tunnel_reachable()
 if not _TUNNEL_OK:
     os.environ["JAX_PLATFORMS"] = "cpu"
 
@@ -193,13 +155,18 @@ def main():
         dt = _time(make_run_xla(tb), pop)
 
     gens_per_sec = NGEN / dt
-    print(json.dumps({
+    line = {
         "metric": "onemax_pop100k_generations_per_sec",
         "value": round(gens_per_sec, 2),
         "unit": "gens/sec",
         "vs_baseline": round(gens_per_sec / REFERENCE_GENS_PER_SEC, 1),
         "backend": jax.default_backend(),
-    }))
+    }
+    if not _TUNNEL_OK:
+        # self-describing CPU fallback: the axon relay was down at
+        # measurement time — this line is not a TPU regression signal
+        line["tunnel_down"] = True
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -111,6 +111,9 @@ def var_or(key: jax.Array, pop: Population, toolbox, lambda_: int,
     prob mutpb a mutant of a random parent; else an unchanged copy that
     *keeps* its parent's (valid) fitness, exactly like the reference.
     """
+    assert cxpb + mutpb <= 1.0, (
+        "The sum of the crossover and mutation probabilities must be "
+        "smaller or equal to 1.0.")
     n = pop.size
     k_u, k_p1, k_p2, k_pm, k_cx, k_mut = jax.random.split(key, 6)
     u = jax.random.uniform(k_u, (lambda_,))

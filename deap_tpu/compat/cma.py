@@ -201,23 +201,50 @@ class StrategyMultiObjective:
         return individuals
 
     def update(self, population):
+        """Select the next parents from ``population`` + the current
+        parents and update the per-parent (1+1) strategies.
+
+        Like the reference (cma.py:489-504), individuals tagged
+        ``('p', idx)`` are accepted: the current parents are *always*
+        candidates inside the tensor engine, so re-passing them is
+        simply ignored here (the reference would count them twice —
+        a quirk of its ``population + self.parents`` concatenation).
+        The remaining ``('o', idx)`` offspring must number exactly
+        ``lambda_``: the engine's selection kernel is compiled for
+        fixed shapes. Drop-in programs that feed a *subset* of the
+        offspring back must re-generate instead (see docs/porting.md,
+        "Differences you may notice").
+
+        Consumed offspring are re-tagged ``('p', -1)`` on the way out —
+        the moral equivalent of the reference's next-``generate()``
+        parent re-tagging (cma.py:408-410) done eagerly, since this
+        wrapper keeps parents as state arrays, not live objects. So
+        survivors from a previous generation re-passed alongside fresh
+        offspring are recognised as parents (ignored), and re-calling
+        update() on an already-consumed list raises instead of
+        corrupting the per-parent strategies with stale indices.
+        """
         import jax.numpy as jnp
 
         # parent indices travel on the individuals (the reference's
         # ``_ps`` tag, cma.py:500-504), so reordering the offspring
         # between generate() and update() stays correct
         try:
-            parent = np.asarray([ind._ps[1] for ind in population],
-                                np.int32)
+            offspring = [ind for ind in population if ind._ps[0] == "o"]
         except AttributeError:
             raise RuntimeError(
                 "update() expects individuals produced by generate() "
                 "(they carry the parent-index _ps tag)") from None
-        if len(population) != self._impl.lambda_:
+        if len(offspring) != self._impl.lambda_:
             raise RuntimeError(
                 f"update() needs exactly lambda_={self._impl.lambda_} "
-                f"offspring, got {len(population)}")
-        genomes = {"x": jnp.asarray(_genomes(population)),
+                f"('o', idx)-tagged offspring, got {len(offspring)} "
+                "(current parents are implicit candidates and may be "
+                "passed or omitted freely)")
+        parent = np.asarray([ind._ps[1] for ind in offspring], np.int32)
+        genomes = {"x": jnp.asarray(_genomes(offspring)),
                    "parent": jnp.asarray(parent)}
         self._state = self._impl.update(
-            self._state, genomes, jnp.asarray(_values(population)))
+            self._state, genomes, jnp.asarray(_values(offspring)))
+        for ind in offspring:
+            ind._ps = ("p", -1)

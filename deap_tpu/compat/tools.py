@@ -786,9 +786,16 @@ def sortLogNondominated(individuals, k, first_front_only=False):
 def hypervolume(front, **kargs):
     """Index of the least hypervolume contributor, leave-one-out
     (tools/indicator.py:10-31); the MO-CMA-ES 'hypervolume' indicator.
-    Equivalent to the reference's argmax of leave-one-out hypervolumes:
-    the row whose removal costs least is the one with the smallest
-    contribution."""
+    Equivalent to the reference's *intended* argmax of leave-one-out
+    hypervolumes: the row whose removal costs least is the one with the
+    smallest contribution.
+
+    Note: the Python-3-converted reference is buggy here — after 2to3,
+    ``numpy.argmax`` is applied to an unconsumed ``map`` object and
+    always returns 0. This implementation returns the correct index, so
+    drop-in MO-CMA-ES runs can follow different (better) trajectories
+    than the converted reference they were ported from (see
+    docs/porting.md, "Differences you may notice")."""
     import numpy as np
 
     wobj = np.asarray(_wvalues(front)) * -1.0

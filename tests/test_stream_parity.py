@@ -1,0 +1,277 @@
+"""Exact-stream parity: compat operators vs the 2to3-converted reference.
+
+PARITY.md claims the compat list operators were validated call-for-call
+against the reference on identical stdlib-``random`` streams. This is
+that harness, committed so the claim stays reproducible: it converts
+``/root/reference/deap`` with 2to3 into a scratch directory (cached),
+imports both sides, replays each operator on identical inputs and seeds,
+and asserts byte-identical outputs.
+
+Skipped automatically when the reference tree or the ``2to3`` tool is
+absent (e.g. on a user machine) — everything else in the suite is
+self-contained; this module exists purely to keep the parity claim
+honest where the reference is available.
+"""
+
+import pathlib
+import random
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REF = pathlib.Path("/root/reference/deap")
+SCRATCH = pathlib.Path("/tmp/refdeap_parity")
+TOOL = shutil.which("2to3")
+
+pytestmark = pytest.mark.skipif(
+    not REF.exists() or TOOL is None,
+    reason="reference tree or 2to3 not available")
+
+
+@pytest.fixture(scope="module")
+def ref():
+    """Import the 2to3-converted reference's base/tools modules."""
+    marker = SCRATCH / ".converted"
+    if not marker.exists():
+        if SCRATCH.exists():
+            shutil.rmtree(SCRATCH)
+        SCRATCH.mkdir(parents=True)
+        shutil.copytree(REF, SCRATCH / "deap")
+        subprocess.run(
+            [TOOL, "-w", "-n", "--no-diffs", str(SCRATCH / "deap")],
+            check=True, capture_output=True, timeout=300)
+        marker.touch()
+    sys.path.insert(0, str(SCRATCH))
+    try:
+        import deap.base as ref_base
+        import deap.tools as ref_tools
+
+        yield ref_base, ref_tools
+    finally:
+        sys.path.remove(str(SCRATCH))
+
+
+@pytest.fixture(scope="module")
+def ours():
+    from deap_tpu.compat import base, tools
+
+    return base, tools
+
+
+SEEDS = (11, 4242, 999331)
+
+
+def _replay(seed, fn, make_args):
+    """Run fn on freshly built args under a fixed random stream."""
+    random.seed(seed)
+    args = make_args()
+    out = fn(*args)
+    return args, out, random.getstate()
+
+
+def _pair(seed, ref_fn, our_fn, make_args):
+    """Replay both sides; assert identical outputs AND identical stream
+    consumption (same random.getstate afterward)."""
+    ref_args, ref_out, ref_state = _replay(seed, ref_fn, make_args)
+    our_args, our_out, our_state = _replay(seed, our_fn, make_args)
+    assert our_args == ref_args, "in-place results differ"
+    assert our_state == ref_state, "random-stream consumption differs"
+    return ref_out, our_out
+
+
+# ---------------------------------------------------------- variation ----
+
+def _perm_pair():
+    # two random permutations, built AFTER seeding so both sides agree
+    return ([*random.sample(range(8), 8)], [*random.sample(range(8), 8)])
+
+
+def _real_pair():
+    return ([random.uniform(-5, 5) for _ in range(6)],
+            [random.uniform(-5, 5) for _ in range(6)])
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+@pytest.mark.parametrize("name,make", [
+    ("cxPartialyMatched", _perm_pair),
+    ("cxUniformPartialyMatched", None),  # needs indpb
+    ("cxOrdered", _perm_pair),
+    ("cxTwoPoint", _perm_pair),
+    ("cxOnePoint", _perm_pair),
+    ("cxMessyOnePoint", _perm_pair),
+])
+def test_crossover_streams(ref, ours, name, make, seed):
+    ref_base, ref_tools = ref
+    _, tools = ours
+    if name == "cxUniformPartialyMatched":
+        fn_r = lambda a, b: ref_tools.cxUniformPartialyMatched(a, b, 0.3)
+        fn_o = lambda a, b: tools.cxUniformPartialyMatched(a, b, 0.3)
+        _pair(seed, fn_r, fn_o, _perm_pair)
+    else:
+        _pair(seed, getattr(ref_tools, name), getattr(tools, name), make)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_sbx_and_bounded_streams(ref, ours, seed):
+    _, ref_tools = ref
+    _, tools = ours
+    _pair(seed,
+          lambda a, b: ref_tools.cxSimulatedBinary(a, b, 15.0),
+          lambda a, b: tools.cxSimulatedBinary(a, b, 15.0),
+          _real_pair)
+    _pair(seed,
+          lambda a, b: ref_tools.cxSimulatedBinaryBounded(
+              a, b, 20.0, -5.0, 5.0),
+          lambda a, b: tools.cxSimulatedBinaryBounded(
+              a, b, 20.0, -5.0, 5.0),
+          _real_pair)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_mutation_streams(ref, ours, seed):
+    _, ref_tools = ref
+    _, tools = ours
+    mk = lambda: ([random.uniform(-5, 5) for _ in range(6)],)
+    _pair(seed,
+          lambda i: ref_tools.mutPolynomialBounded(i, 20.0, -5.0, 5.0, 0.4),
+          lambda i: tools.mutPolynomialBounded(i, 20.0, -5.0, 5.0, 0.4),
+          mk)
+    _pair(seed,
+          lambda i: ref_tools.mutGaussian(i, 0.0, 1.0, 0.4),
+          lambda i: tools.mutGaussian(i, 0.0, 1.0, 0.4),
+          mk)
+    mk_bits = lambda: ([random.randint(0, 1) for _ in range(12)],)
+    _pair(seed,
+          lambda i: ref_tools.mutFlipBit(i, 0.3),
+          lambda i: tools.mutFlipBit(i, 0.3),
+          mk_bits)
+    _pair(seed,
+          lambda i: ref_tools.mutShuffleIndexes(i, 0.3),
+          lambda i: tools.mutShuffleIndexes(i, 0.3),
+          mk_bits)
+
+
+class _ESList(list):
+    """Minimal ES individual: a list with a .strategy vector."""
+
+    def __eq__(self, other):  # compare values AND strategy
+        return (list.__eq__(self, other)
+                and getattr(self, "strategy", None)
+                == getattr(other, "strategy", None))
+
+    __hash__ = None
+
+
+def _es_pair():
+    a = _ESList(random.uniform(-5, 5) for _ in range(6))
+    a.strategy = [random.uniform(0.1, 1.0) for _ in range(6)]
+    b = _ESList(random.uniform(-5, 5) for _ in range(6))
+    b.strategy = [random.uniform(0.1, 1.0) for _ in range(6)]
+    return (a, b)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_es_operator_streams(ref, ours, seed):
+    _, ref_tools = ref
+    _, tools = ours
+    _pair(seed,
+          lambda a, b: ref_tools.cxESBlend(a, b, 0.5),
+          lambda a, b: tools.cxESBlend(a, b, 0.5),
+          _es_pair)
+    _pair(seed, ref_tools.cxESTwoPoint, tools.cxESTwoPoint, _es_pair)
+    mk = lambda: (_es_pair()[0],)
+    _pair(seed,
+          lambda i: ref_tools.mutESLogNormal(i, 1.0, 0.4),
+          lambda i: tools.mutESLogNormal(i, 1.0, 0.4),
+          mk)
+
+
+# ---------------------------------------------------------- selection ----
+
+
+def _make_scored(base_mod, n=16, nobj=1, varlen=False):
+    """n list individuals with fitness + an .idx marker."""
+
+    class F(base_mod.Fitness):
+        weights = (1.0,) * nobj
+
+    out = []
+    for i in range(n):
+        length = random.randint(3, 9) if varlen else 5
+        ind = [random.random() for _ in range(length)]
+        ind = type("I", (list,), {})(ind)
+        ind.fitness = F()
+        ind.fitness.values = tuple(random.uniform(0, 10)
+                                   for _ in range(nobj))
+        ind.idx = i
+        out.append(ind)
+    return out
+
+
+def _sel_streams(ref, ours, ref_call, our_call, nobj=1, varlen=False):
+    ref_base, _ = ref
+    our_base, _ = ours
+    for seed in SEEDS:
+        random.seed(seed)
+        pop_r = _make_scored(ref_base, nobj=nobj, varlen=varlen)
+        mid = random.getstate()
+        picked_r = [ind.idx for ind in ref_call(pop_r)]
+        state_r = random.getstate()
+
+        random.seed(seed)
+        pop_o = _make_scored(our_base, nobj=nobj, varlen=varlen)
+        assert random.getstate() == mid  # identical inputs
+        picked_o = [ind.idx for ind in our_call(pop_o)]
+        state_o = random.getstate()
+
+        assert picked_o == picked_r
+        assert state_o == state_r
+
+
+def test_sus_stream(ref, ours):
+    _, ref_tools = ref
+    _, tools = ours
+    _sel_streams(
+        ref, ours,
+        lambda p: ref_tools.selStochasticUniversalSampling(p, 6),
+        lambda p: tools.selStochasticUniversalSampling(p, 6))
+
+
+def test_double_tournament_stream(ref, ours):
+    _, ref_tools = ref
+    _, tools = ours
+    for fitness_first in (True, False):
+        _sel_streams(
+            ref, ours,
+            lambda p: ref_tools.selDoubleTournament(
+                p, 8, 3, 1.4, fitness_first),
+            lambda p: tools.selDoubleTournament(
+                p, 8, 3, 1.4, fitness_first),
+            varlen=True)
+
+
+def test_lexicase_family_streams(ref, ours):
+    _, ref_tools = ref
+    _, tools = ours
+    _sel_streams(ref, ours,
+                 lambda p: ref_tools.selLexicase(p, 5),
+                 lambda p: tools.selLexicase(p, 5), nobj=4)
+    _sel_streams(ref, ours,
+                 lambda p: ref_tools.selEpsilonLexicase(p, 5, 0.5),
+                 lambda p: tools.selEpsilonLexicase(p, 5, 0.5), nobj=4)
+    _sel_streams(ref, ours,
+                 lambda p: ref_tools.selAutomaticEpsilonLexicase(p, 5),
+                 lambda p: tools.selAutomaticEpsilonLexicase(p, 5), nobj=4)
+
+
+def test_tournament_and_roulette_streams(ref, ours):
+    _, ref_tools = ref
+    _, tools = ours
+    _sel_streams(ref, ours,
+                 lambda p: ref_tools.selTournament(p, 8, 3),
+                 lambda p: tools.selTournament(p, 8, 3))
+    _sel_streams(ref, ours,
+                 lambda p: ref_tools.selRoulette(p, 6),
+                 lambda p: tools.selRoulette(p, 6))
