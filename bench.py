@@ -102,15 +102,23 @@ def make_run_fused():
     return run
 
 
-def make_run_packed():
+def make_run_packed(select="sorted"):
     """TPU path, bit-packed genomes: 32 genes/uint32 word cuts the
     genome HBM stream 8× (see deap_tpu.ops.packed); rank-based
-    tournament avoids per-aspirant fitness gathers."""
+    tournament avoids per-aspirant fitness gathers. ``select="binned"``
+    swaps the full lexsort for the counting-sort rank path (bit-exact
+    winners — OneMax fitness is integer in [0, LENGTH])."""
+    if select == "binned":
+        sel = lambda k, w, n: ops.sel_tournament_binned(
+            k, w, n, tournsize=3, low=0, high=LENGTH)
+    else:
+        sel = lambda k, w, n: ops.sel_tournament_sorted(
+            k, w, n, tournsize=3)
+
     def gen_step(carry, key):
         packed, fit = carry
         k_sel, k_var = jax.random.split(key)
-        idx = ops.sel_tournament_sorted(k_sel, fit[:, None], POP,
-                                        tournsize=3)
+        idx = sel(k_sel, fit[:, None], POP)
         children, newfit = ops.fused_variation_eval_packed(
             k_var, packed[idx], LENGTH, cxpb=0.5, mutpb=0.2, indpb=0.05,
             prng="hw", block_i=1024, interpret=False)
@@ -149,7 +157,8 @@ def main():
         packed = ops.pack_genomes(pop.genomes)
         dt = min(
             _time(make_run_fused(), pop.genomes, fit),
-            _time(make_run_packed(), packed, fit),
+            _time(make_run_packed("sorted"), packed, fit),
+            _time(make_run_packed("binned"), packed, fit),
         )
     else:
         dt = _time(make_run_xla(tb), pop)

@@ -1,0 +1,138 @@
+"""Per-component attribution of the headline generation step.
+
+VERDICT r1 asked where the ~2.7 ms/gen of the packed OneMax path goes
+(selection sort vs parent gather vs fused kernel). This script times
+each component in isolation (scanned NGEN times inside one jit, honest
+`sync` barrier — same methodology as bench.py) and the full step, then
+prints a JSON breakdown. Optionally captures an xplane trace of the
+full step with ``--trace DIR`` (view in TensorBoard/Perfetto).
+
+Run on TPU (falls back to CPU with a tunnel_down marker like bench.py).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _axon_probe import axon_tunnel_reachable
+
+_TUNNEL_OK = axon_tunnel_reachable()
+if not _TUNNEL_OK:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+if not _TUNNEL_OK:
+    jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from jax import lax
+
+from deap_tpu import ops
+from deap_tpu.support.profiling import sync, trace
+
+POP = 100_000
+LENGTH = 100
+NGEN = 200
+
+
+def timed(run, *args):
+    sync(run(jax.random.key(0), *args))  # compile + warm
+    best = float("inf")
+    for r in range(3):
+        t0 = time.perf_counter()
+        sync(run(jax.random.key(1 + r), *args))
+        best = min(best, time.perf_counter() - t0)
+    return best / NGEN
+
+
+def scanned(step):
+    """jit(scan(step)) over NGEN keys; step: (carry, key) -> carry."""
+    @jax.jit
+    def run(key, *carry):
+        out, _ = lax.scan(lambda c, k: (step(c, k), None), carry,
+                          jax.random.split(key, NGEN))
+        return out
+    return run
+
+
+def main():
+    interpret = jax.default_backend() != "tpu"
+    kw = dict(cxpb=0.5, mutpb=0.2, indpb=0.05,
+              prng="hw" if not interpret else "input",
+              block_i=1024, interpret=interpret)
+
+    genomes = jax.random.bernoulli(jax.random.key(9), 0.5, (POP, LENGTH))
+    packed = ops.pack_genomes(genomes)
+    fit = ops.packed_fitness(packed)
+
+    # 1. selection alone (sorted vs binned), fitness fed back unchanged
+    sel_sorted = scanned(lambda c, k: (
+        c[0], c[1] + 0 * ops.sel_tournament_sorted(
+            k, c[1][:, None], POP, tournsize=3).astype(jnp.float32)))
+    sel_binned = scanned(lambda c, k: (
+        c[0], c[1] + 0 * ops.sel_tournament_binned(
+            k, c[1][:, None], POP, tournsize=3, low=0,
+            high=LENGTH).astype(jnp.float32)))
+
+    # 2. gather alone: random idx (uniform — same access pattern class)
+    def gather_step(c, k):
+        packed, fit = c
+        idx = jax.random.randint(k, (POP,), 0, POP)
+        return (packed[idx], fit)
+    gather_only = scanned(gather_step)
+
+    # 3. kernel alone: variation+eval on the unshuffled rows
+    def kernel_step(c, k):
+        packed, fit = c
+        children, newfit = ops.fused_variation_eval_packed(
+            k, packed, LENGTH, **kw)
+        return (children, newfit)
+    kernel_only = scanned(kernel_step)
+
+    # 4. full steps
+    def full(select):
+        if select == "binned":
+            sel = lambda k, w, n: ops.sel_tournament_binned(
+                k, w, n, tournsize=3, low=0, high=LENGTH)
+        else:
+            sel = lambda k, w, n: ops.sel_tournament_sorted(
+                k, w, n, tournsize=3)
+
+        def step(c, k):
+            packed, fit = c
+            ks, kv = jax.random.split(k)
+            idx = sel(ks, fit[:, None], POP)
+            return ops.fused_variation_eval_packed(
+                kv, packed[idx], LENGTH, **kw)
+        return scanned(step)
+
+    out = {
+        "backend": jax.default_backend(),
+        "pop": POP, "length": LENGTH, "ngen": NGEN,
+        "ms_per_gen": {
+            "select_sorted": round(timed(sel_sorted, packed, fit) * 1e3, 4),
+            "select_binned": round(timed(sel_binned, packed, fit) * 1e3, 4),
+            "gather_random": round(timed(gather_only, packed, fit) * 1e3, 4),
+            "kernel_fused_packed": round(
+                timed(kernel_only, packed, fit) * 1e3, 4),
+            "full_sorted": round(timed(full("sorted"), packed, fit) * 1e3, 4),
+            "full_binned": round(timed(full("binned"), packed, fit) * 1e3, 4),
+        },
+    }
+    if not _TUNNEL_OK:
+        out["tunnel_down"] = True
+    print(json.dumps(out))
+
+    if "--trace" in sys.argv:
+        tdir = sys.argv[sys.argv.index("--trace") + 1]
+        run = full("binned")
+        sync(run(jax.random.key(0), packed, fit))
+        with trace(tdir):
+            sync(run(jax.random.key(1), packed, fit))
+        print(f"xplane trace written to {tdir}")
+
+
+if __name__ == "__main__":
+    main()

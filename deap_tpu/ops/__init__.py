@@ -74,6 +74,7 @@ from deap_tpu.ops.packed import (
     unpack_genomes,
 )
 from deap_tpu.ops.selection import (
+    counting_order_desc,
     sel_automatic_epsilon_lexicase,
     sel_best,
     sel_double_tournament,
@@ -83,6 +84,7 @@ from deap_tpu.ops.selection import (
     sel_roulette,
     sel_stochastic_universal_sampling,
     sel_tournament,
+    sel_tournament_binned,
     sel_tournament_sorted,
     sel_worst,
 )

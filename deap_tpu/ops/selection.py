@@ -86,6 +86,46 @@ def sel_tournament_sorted(key, w, k, tournsize):
     return jnp.take(order, jnp.min(ranks, axis=0))
 
 
+def counting_order_desc(values: jnp.ndarray, low: int, high: int) -> jnp.ndarray:
+    """Best-first permutation of integer-valued fitnesses WITHOUT a
+    comparison sort — a counting sort over ``high - low + 1`` buckets.
+
+    Bit-exact with :func:`deap_tpu.core.fitness.lex_sort_desc` on a
+    single integer-valued objective (both are stable: ties keep
+    ascending population index), but O(n·B) streaming instead of XLA's
+    O(n log² n) sorting network — the difference is most of a
+    generation at pop ≈ 100k, where the full sort dominates the fused
+    variation kernel (BASELINE.md). Valid whenever fitness takes
+    integer values in ``[low, high]`` — OneMax-style bit counts, match
+    counts, error counts.
+    """
+    n = values.shape[0]
+    nbins = int(high) - int(low) + 1
+    b = (jnp.round(values).astype(jnp.int32) - low).clip(0, nbins - 1)
+    onehot = b[:, None] == jnp.arange(nbins, dtype=jnp.int32)[None, :]
+    # occurrence number of each row within its bucket (0-based, stable)
+    within = jnp.take_along_axis(
+        jnp.cumsum(onehot, axis=0), b[:, None], axis=1)[:, 0] - 1
+    counts = onehot.sum(0)
+    # descending buckets: bucket b starts after all strictly-better ones
+    starts_desc = jnp.cumsum(counts[::-1])[::-1] - counts
+    pos = jnp.take(starts_desc, b) + within
+    return jnp.zeros(n, jnp.int32).at[pos].set(
+        jnp.arange(n, dtype=jnp.int32), unique_indices=True,
+        indices_are_sorted=False)
+
+
+def sel_tournament_binned(key, w, k, tournsize, low: int, high: int):
+    """:func:`sel_tournament_sorted` for integer-valued single-objective
+    fitness: identical winners for the same key (the rank→index
+    permutation is bit-identical), with the full lexsort replaced by
+    :func:`counting_order_desc`. ``w`` is ``[n, 1]`` weighted values
+    taking integer values in ``[low, high]``."""
+    order = counting_order_desc(w[:, 0], low, high)
+    ranks = jax.random.randint(key, (tournsize, k), 0, w.shape[0])
+    return jnp.take(order, jnp.min(ranks, axis=0))
+
+
 def sel_roulette(key, w, k, values: Optional[jnp.ndarray] = None):
     """Fitness-proportionate selection on the first objective
     (selection.py:71-103): individuals sorted best-first, k spins over the

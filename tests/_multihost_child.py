@@ -1,0 +1,92 @@
+"""Child process for the 2-process multi-host test.
+
+Usage: ``python _multihost_child.py <coordinator> <num_procs> <rank>``.
+Each process exposes 4 virtual CPU devices, joins the distributed
+runtime via :func:`deap_tpu.parallel.initialize`, and runs the same
+SPMD program over the 8-device global mesh: one island epoch with a
+cross-process ``ppermute`` migration ring, then one genome-axis-sharded
+evaluation with a cross-process ``psum``. Prints ``MULTIHOST_CHILD_OK``
+on success; any assertion or hang fails the parent test.
+"""
+
+import os
+import sys
+
+coordinator, num_procs, rank = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# multi-process CPU collectives need the gloo backend, selected before
+# backend initialisation
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+from deap_tpu import FitnessSpec, Toolbox, ops  # noqa: E402
+from deap_tpu.algorithms import evaluate_invalid  # noqa: E402
+from deap_tpu.parallel import (  # noqa: E402
+    genome_mesh,
+    global_population_mesh,
+    initialize,
+    is_distributed,
+    island_init,
+    make_island_step,
+    make_sharded_evaluator,
+    process_count,
+    process_index,
+    shard_genomes,
+    shard_population,
+)
+
+initialize(coordinator, num_procs, rank)
+assert process_count() == num_procs, process_count()
+assert process_index() == rank
+assert is_distributed()
+assert jax.local_device_count() == 4
+assert jax.device_count() == 4 * num_procs
+
+LENGTH = 16
+tb = Toolbox()
+tb.register("evaluate", lambda g: g.sum(-1).astype(jnp.float32))
+tb.register("mate", ops.cx_two_point)
+tb.register("mutate", ops.mut_flip_bit, indpb=0.05)
+tb.register("select", ops.sel_tournament, tournsize=3)
+spec = FitnessSpec((1.0,))
+
+# --- island epoch over the global mesh: the migration ring's boundary
+# hop crosses the process boundary (DCN analog) ---------------------------
+n_islands = jax.device_count()
+mesh = global_population_mesh(("island",))
+pops = island_init(jax.random.key(0), n_islands, 8,
+                   ops.bernoulli_genome(LENGTH), spec)
+pops = jax.vmap(lambda p: evaluate_invalid(p, tb.evaluate))(pops)
+pops = shard_population(pops, mesh, "island")
+step = make_island_step(tb, cxpb=0.5, mutpb=0.2, freq=2, mig_k=2,
+                        mesh=mesh)
+out = step(jax.random.key(1), pops)
+# replicated scalars are readable on every process and force the
+# cross-process program to actually execute
+all_valid = bool(jax.jit(lambda p: p.valid.all())(out))
+best = float(jax.jit(lambda p: p.fitness.max())(out))
+assert all_valid
+assert 0.0 <= best <= LENGTH
+
+# --- genome-axis (SP) sharded evaluation: per-shard partial fitness
+# combined with a psum that crosses the process boundary ------------------
+gmesh = genome_mesh(n_pop_shards=jax.device_count() // 2,
+                    n_genome_shards=2)
+genomes = jax.random.bernoulli(
+    jax.random.key(2), 0.5, (16, 32)).astype(jnp.float32)
+evaluate = make_sharded_evaluator(lambda g: g.sum(-1), gmesh,
+                                  combine="sum")
+vals = evaluate(shard_genomes(genomes, gmesh))
+total = float(jax.jit(jnp.sum)(vals))
+expect = float(genomes.sum())
+assert abs(total - expect) < 1e-3, (total, expect)
+
+print(f"MULTIHOST_CHILD_OK rank={rank} best={best}")

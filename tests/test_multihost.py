@@ -1,7 +1,16 @@
-"""Multi-host helpers (parallel.multihost): single-process no-op init,
-global mesh construction (SURVEY.md §2.3 P3 parity — the SCOOP analog)."""
+"""Multi-host (parallel.multihost): single-process no-op init, global
+mesh construction, and a REAL 2-process `jax.distributed` run on CPU
+(SURVEY.md §2.3 P3 parity — the SCOOP analog; the reference's stand-in
+is the pickle round-trip suite, deap/tests/test_pickle.py:38-154)."""
+
+import os
+import pathlib
+import socket
+import subprocess
+import sys
 
 import jax
+import pytest
 
 from deap_tpu.parallel import (
     global_population_mesh,
@@ -29,3 +38,40 @@ def test_global_mesh_2d_layout():
     n = len(jax.devices())
     mesh = global_population_mesh(("island", "genome"), shape=(n, 1))
     assert mesh.devices.shape == (n, 1)
+
+
+@pytest.mark.slow
+def test_two_process_distributed_epoch():
+    """Two local processes form a jax.distributed runtime over a port,
+    build one 8-device global CPU mesh (4 virtual devices each), run an
+    island epoch whose migration ring crosses the process boundary, and
+    a genome-sharded evaluation whose psum does too."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coordinator = f"127.0.0.1:{port}"
+
+    child = pathlib.Path(__file__).parent / "_multihost_child.py"
+    env = dict(os.environ)
+    # the child pins its own XLA flags/platform; drop the suite's
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(child), coordinator, "2", str(rank)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env,
+            cwd=str(pathlib.Path(__file__).parent.parent))
+        for rank in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert f"MULTIHOST_CHILD_OK rank={rank}" in out, out

@@ -284,3 +284,24 @@ def test_sel_tournament_sorted_minimisation():
     # with tournsize == n*large, winner is almost always the best row
     idx = sel_tournament_sorted(jax.random.key(4), w, 64, tournsize=16)
     assert (np.asarray(idx) == 1).mean() > 0.9
+
+
+def test_sel_tournament_binned_matches_sorted_exactly():
+    """counting_order_desc must be bit-identical to lex_sort_desc on
+    integer-valued single-objective fitness (stable ties), so the
+    binned tournament returns the same winners for the same key."""
+    from deap_tpu.core.fitness import lex_sort_desc
+    from deap_tpu.ops.selection import (
+        counting_order_desc,
+        sel_tournament_binned,
+        sel_tournament_sorted,
+    )
+
+    f = jax.random.randint(jax.random.key(11), (500,), 0, 101)
+    w = f.astype(jnp.float32)[:, None]
+    assert (counting_order_desc(w[:, 0], 0, 100) == lex_sort_desc(w)).all()
+
+    ksel = jax.random.key(12)
+    a = sel_tournament_sorted(ksel, w, 300, tournsize=3)
+    b = sel_tournament_binned(ksel, w, 300, tournsize=3, low=0, high=100)
+    assert (np.asarray(a) == np.asarray(b)).all()

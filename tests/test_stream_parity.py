@@ -25,16 +25,31 @@ REF = pathlib.Path("/root/reference/deap")
 SCRATCH = pathlib.Path("/tmp/refdeap_parity")
 TOOL = shutil.which("2to3")
 
-pytestmark = pytest.mark.skipif(
-    not REF.exists() or TOOL is None,
-    reason="reference tree or 2to3 not available")
+pytestmark = [
+    pytest.mark.slow,  # copies + 2to3-converts the reference tree
+    pytest.mark.skipif(not REF.exists() or TOOL is None,
+                       reason="reference tree or 2to3 not available"),
+]
+
+
+def _ref_fingerprint() -> str:
+    """Cheap change detector for the reference tree: per-file sizes +
+    mtimes. Invalidates the 2to3 scratch when the reference updates."""
+    parts = []
+    for p in sorted(REF.rglob("*.py")):
+        st = p.stat()
+        parts.append(f"{p.relative_to(REF)}:{st.st_size}:{st.st_mtime_ns}")
+    import hashlib
+
+    return hashlib.sha256("\n".join(parts).encode()).hexdigest()
 
 
 @pytest.fixture(scope="module")
 def ref():
     """Import the 2to3-converted reference's base/tools modules."""
     marker = SCRATCH / ".converted"
-    if not marker.exists():
+    fingerprint = _ref_fingerprint()
+    if not (marker.exists() and marker.read_text() == fingerprint):
         if SCRATCH.exists():
             shutil.rmtree(SCRATCH)
         SCRATCH.mkdir(parents=True)
@@ -42,7 +57,7 @@ def ref():
         subprocess.run(
             [TOOL, "-w", "-n", "--no-diffs", str(SCRATCH / "deap")],
             check=True, capture_output=True, timeout=300)
-        marker.touch()
+        marker.write_text(fingerprint)
     sys.path.insert(0, str(SCRATCH))
     try:
         import deap.base as ref_base
