@@ -102,18 +102,23 @@ def make_run_fused():
     return run
 
 
+def packed_selector(select="sorted"):
+    """The headline config's tournament (tournsize 3) as an index
+    selector. ``"binned"`` swaps the full lexsort for the counting-sort
+    rank path (bit-exact winners — OneMax fitness is integer in
+    [0, LENGTH]). Shared with bench_profile.py so the profiled
+    configuration can never drift from the measured one."""
+    if select == "binned":
+        return lambda k, w, n: ops.sel_tournament_binned(
+            k, w, n, tournsize=3, low=0, high=LENGTH)
+    return lambda k, w, n: ops.sel_tournament_sorted(k, w, n, tournsize=3)
+
+
 def make_run_packed(select="sorted"):
     """TPU path, bit-packed genomes: 32 genes/uint32 word cuts the
     genome HBM stream 8× (see deap_tpu.ops.packed); rank-based
-    tournament avoids per-aspirant fitness gathers. ``select="binned"``
-    swaps the full lexsort for the counting-sort rank path (bit-exact
-    winners — OneMax fitness is integer in [0, LENGTH])."""
-    if select == "binned":
-        sel = lambda k, w, n: ops.sel_tournament_binned(
-            k, w, n, tournsize=3, low=0, high=LENGTH)
-    else:
-        sel = lambda k, w, n: ops.sel_tournament_sorted(
-            k, w, n, tournsize=3)
+    tournament avoids per-aspirant fitness gathers."""
+    sel = packed_selector(select)
 
     def gen_step(carry, key):
         packed, fit = carry

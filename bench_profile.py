@@ -11,26 +11,20 @@ Run on TPU (falls back to CPU with a tunnel_down marker like bench.py).
 """
 
 import json
-import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from _axon_probe import axon_tunnel_reachable
-
-_TUNNEL_OK = axon_tunnel_reachable()
-if not _TUNNEL_OK:
-    os.environ["JAX_PLATFORMS"] = "cpu"
-
+# reuse bench.py's axon-tunnel probe + platform forcing side effects
+# (and its packed_selector, so we profile exactly the measured config)
+import bench  # noqa: F401  (must precede jax import)
 import jax
-
-if not _TUNNEL_OK:
-    jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
 from jax import lax
 
 from deap_tpu import ops
 from deap_tpu.support.profiling import sync, trace
+
+_TUNNEL_OK = bench._TUNNEL_OK
 
 POP = 100_000
 LENGTH = 100
@@ -58,6 +52,13 @@ def scanned(step):
 
 
 def main():
+    tdir = None
+    if "--trace" in sys.argv:
+        i = sys.argv.index("--trace")
+        if i + 1 >= len(sys.argv):
+            sys.exit("usage: bench_profile.py [--trace TRACE_DIR]")
+        tdir = sys.argv[i + 1]
+
     interpret = jax.default_backend() != "tpu"
     kw = dict(cxpb=0.5, mutpb=0.2, indpb=0.05,
               prng="hw" if not interpret else "input",
@@ -69,12 +70,11 @@ def main():
 
     # 1. selection alone (sorted vs binned), fitness fed back unchanged
     sel_sorted = scanned(lambda c, k: (
-        c[0], c[1] + 0 * ops.sel_tournament_sorted(
-            k, c[1][:, None], POP, tournsize=3).astype(jnp.float32)))
+        c[0], c[1] + 0 * bench.packed_selector("sorted")(
+            k, c[1][:, None], POP).astype(jnp.float32)))
     sel_binned = scanned(lambda c, k: (
-        c[0], c[1] + 0 * ops.sel_tournament_binned(
-            k, c[1][:, None], POP, tournsize=3, low=0,
-            high=LENGTH).astype(jnp.float32)))
+        c[0], c[1] + 0 * bench.packed_selector("binned")(
+            k, c[1][:, None], POP).astype(jnp.float32)))
 
     # 2. gather alone: random idx (uniform — same access pattern class)
     def gather_step(c, k):
@@ -93,12 +93,7 @@ def main():
 
     # 4. full steps
     def full(select):
-        if select == "binned":
-            sel = lambda k, w, n: ops.sel_tournament_binned(
-                k, w, n, tournsize=3, low=0, high=LENGTH)
-        else:
-            sel = lambda k, w, n: ops.sel_tournament_sorted(
-                k, w, n, tournsize=3)
+        sel = bench.packed_selector(select)
 
         def step(c, k):
             packed, fit = c
@@ -125,8 +120,7 @@ def main():
         out["tunnel_down"] = True
     print(json.dumps(out))
 
-    if "--trace" in sys.argv:
-        tdir = sys.argv[sys.argv.index("--trace") + 1]
+    if tdir is not None:
         run = full("binned")
         sync(run(jax.random.key(0), packed, fit))
         with trace(tdir):

@@ -19,6 +19,19 @@ reference CPU throughput measured on this machine (BASELINE.md
    string-codegen ``eval`` (deap/gp.py:462-487). The reference number
    is generous to the reference — measured at generation ~4, before
    bloat grows the trees.
+5. ``nsga2_zdt1_pop50k`` — the BASELINE.json pop=50k NSGA-II config:
+   100k-candidate non-dominated selection per generation through the
+   tiled streaming kernels (mo.emo past ND_TILED_THRESHOLD). The
+   reference denominator is EXTRAPOLATED (its O(MN²) Python sort makes
+   pop=50k infeasible to sample — BASELINE.md): 0.1662 gens/s at 4k
+   candidates × (4k/100k)² on the dominating sort term.
+6. ``cartpole_neuro_pop10k`` — BASELINE.json config #5: GA over flat
+   MLP(4,16,2) weight vectors, fitness = 3-episode mean CartPole
+   rollout (500 steps, lax.scan), population sharded over the mesh.
+   Reference denominator: pending — to be measured with the same GA +
+   a pure-Python CartPole rollout on the 2to3-converted reference;
+   until then the JSON line reports ``vs_baseline: null``
+   (methodology + result land in BASELINE.md when measured).
 
 Prints one JSON line per config:
   {"metric": ..., "value": N, "unit": "gens/sec", "vs_baseline": N}
@@ -44,19 +57,25 @@ from deap_tpu.core.toolbox import Toolbox
 from deap_tpu.mo.emo import sel_nsga2, sel_tournament_dcd
 from deap_tpu.strategies.cma import Strategy
 
-# CPU reference gens/sec, measured 2026-07-30 (BASELINE.md)
+# CPU reference gens/sec, measured 2026-07-30 (BASELINE.md).
+# nsga2_zdt1_pop50k is EXTRAPOLATED (quadratic sort term from the
+# measured 4k-candidate run; direct measurement infeasible — see
+# BASELINE.md); cartpole is measured with a pure-Python rollout.
 REF = {
     "cmaes_n100_lam4096": 6.6318,
     "nsga2_zdt1_pop2000": 0.1662,
     "rastrigin_n30_pop100k": 0.2693,
     "gp_symbreg_pop4096_pts256": 3.0766,
+    "nsga2_zdt1_pop50k": 0.1662 * (4_000 / 100_000) ** 2,
+    "cartpole_neuro_pop10k": None,  # measured ref pending (BASELINE.md)
 }
+EXTRAPOLATED = {"nsga2_zdt1_pop50k"}
 
 NGEN = 50
 REPS = 3
 
 
-def _time(run, *args):
+def _time(run, *args, ngen=None):
     """gens/sec, mean of REPS after a warmup/compile run.
 
     Deliberately mean-of-REPS rather than bench.py's best-of-REPS: the
@@ -65,11 +84,12 @@ def _time(run, *args):
     """
     import time
 
+    ngen = ngen or NGEN
     bench.sync(run(jax.random.key(100), *args))  # compile + warm
     t0 = time.perf_counter()
     for r in range(REPS):
         bench.sync(run(jax.random.key(101 + r), *args))
-    return NGEN / ((time.perf_counter() - t0) / REPS)
+    return ngen / ((time.perf_counter() - t0) / REPS)
 
 
 def bench_cmaes():
@@ -169,6 +189,80 @@ def bench_rastrigin():
     return _time(run, pop)
 
 
+def bench_nsga2_50k():
+    """The pop=50k promise: selection over 100k candidates per
+    generation through the tiled nd-rank kernels."""
+    NDIM, MU, ngen = 30, 50_000, 10
+    spec = FitnessSpec((-1.0, -1.0))
+    tb = Toolbox()
+    tb.register("evaluate", jax.vmap(benchmarks.zdt1))
+    tb.register("mate", ops.cx_simulated_binary_bounded,
+                eta=20.0, low=0.0, up=1.0)
+    tb.register("mutate", ops.mut_polynomial_bounded,
+                eta=20.0, low=0.0, up=1.0, indpb=1.0 / NDIM)
+    pop = init_population(jax.random.key(1), MU,
+                          ops.uniform_genome(NDIM, 0.0, 1.0), spec)
+    pop = evaluate_invalid(pop, tb.evaluate)
+
+    @jax.jit
+    def run(key, pop):
+        def step(p, k):
+            k1, k2 = jax.random.split(k)
+            idx = sel_tournament_dcd(k1, p.wvalues, MU)
+            off = var_and(k2, gather(p, idx), tb, 0.9, 1.0)
+            off = evaluate_invalid(off, tb.evaluate)
+            comb = concat([p, off])
+            return gather(comb, sel_nsga2(None, comb.wvalues, MU)), 0
+
+        p, _ = lax.scan(step, pop, jax.random.split(key, ngen))
+        return p.wvalues
+
+    return _time(run, pop, ngen=ngen)
+
+
+def bench_cartpole():
+    """BASELINE.json config #5: pop=10k MLP policies, 3-episode mean
+    CartPole rollout fitness, population sharded over the mesh."""
+    from deap_tpu.benchmarks.cartpole import mlp_policy, rollout
+    from deap_tpu.parallel import population_mesh, shard_population
+
+    POP, ngen, episodes, max_steps = 10_000, 20, 3, 500
+    policy, n_params = mlp_policy((4, 16, 2))
+    ep_keys = jax.random.split(jax.random.key(123), episodes)
+
+    def evaluate(genomes):
+        def fit_one(params):
+            return jax.vmap(
+                lambda k: rollout(policy, params, k, max_steps))(
+                    ep_keys).mean()
+        return jax.vmap(fit_one)(genomes)
+
+    tb = Toolbox()
+    tb.register("evaluate", evaluate)
+    tb.register("mate", ops.cx_blend, alpha=0.1)
+    tb.register("mutate", ops.mut_gaussian, mu=0.0, sigma=0.3, indpb=0.1)
+    tb.register("select", ops.sel_tournament, tournsize=3)
+
+    pop = init_population(jax.random.key(90), POP,
+                          ops.normal_genome(n_params, sigma=0.5),
+                          FitnessSpec((1.0,)))
+    pop = evaluate_invalid(pop, tb.evaluate)
+    pop = shard_population(pop, population_mesh())
+
+    @jax.jit
+    def run(key, pop):
+        def step(p, k):
+            k1, k2 = jax.random.split(k)
+            idx = tb.select(k1, p.wvalues, POP)
+            off = var_and(k2, gather(p, idx), tb, 0.5, 0.5)
+            return evaluate_invalid(off, tb.evaluate), 0
+
+        p, _ = lax.scan(step, pop, jax.random.split(key, ngen))
+        return p.wvalues
+
+    return _time(run, pop, ngen=ngen)
+
+
 def bench_gp_symbreg():
     from deap_tpu import gp
 
@@ -212,15 +306,21 @@ def main():
         ("nsga2_zdt1_pop2000", bench_nsga2),
         ("rastrigin_n30_pop100k", bench_rastrigin),
         ("gp_symbreg_pop4096_pts256", bench_gp_symbreg),
+        ("nsga2_zdt1_pop50k", bench_nsga2_50k),
+        ("cartpole_neuro_pop10k", bench_cartpole),
     ]:
         gps = fn()
-        print(json.dumps({
+        ref = REF[name]
+        line = {
             "metric": f"{name}_generations_per_sec",
             "value": round(gps, 2),
             "unit": "gens/sec",
-            "vs_baseline": round(gps / REF[name], 1),
+            "vs_baseline": round(gps / ref, 1) if ref else None,
             "backend": backend,
-        }), flush=True)
+        }
+        if name in EXTRAPOLATED:
+            line["ref_extrapolated"] = True
+        print(json.dumps(line), flush=True)
 
 
 if __name__ == "__main__":
