@@ -290,3 +290,64 @@ def test_tournament_and_roulette_streams(ref, ours):
     _sel_streams(ref, ours,
                  lambda p: ref_tools.selRoulette(p, 6),
                  lambda p: tools.selRoulette(p, 6))
+
+
+# ------------------------------------------------- MovingPeaks errors ----
+
+
+def test_movingpeaks_offline_error_matches_reference(ref):
+    """On a frozen landscape (period=0) the batch-granularity
+    divergence (PARITY.md) vanishes, so our running current/offline
+    error bookkeeping must match the reference's per-evaluation
+    bookkeeping exactly — same peaks, same evaluation sequence."""
+    del ref  # fixture only ensures the converted tree exists on path
+    import numpy as np
+    from deap.benchmarks import movingpeaks as rmp
+
+    import jax
+    import jax.numpy as jnp
+    from deap_tpu.benchmarks.movingpeaks import (
+        MovingPeaksConfig,
+        cone,
+        mp_evaluate,
+        mp_init,
+        offline_error,
+    )
+
+    dim, npeaks = 2, 4
+    cfg = MovingPeaksConfig(dim=dim, npeaks=npeaks, pfunc=cone,
+                            uniform_height=0.0, uniform_width=0.0,
+                            min_width=1.0, max_width=12.0, period=0)
+    state = mp_init(jax.random.key(5), cfg)
+
+    # reference instance with IDENTICAL peaks, changes disabled
+    rng = random.Random(99)
+    mp = rmp.MovingPeaks(dim=dim, random=rng, npeaks=npeaks,
+                         pfunc=rmp.cone, period=0,
+                         min_height=30.0, max_height=70.0,
+                         uniform_height=0, min_width=1.0, max_width=12.0,
+                         uniform_width=0)
+    mp.peaks_position = [np.asarray(p) for p in np.asarray(state.position)]
+    mp.peaks_height = [float(h) for h in np.asarray(state.height)]
+    mp.peaks_width = [float(w) for w in np.asarray(state.width)]
+    mp._optimum = None
+
+    pts = np.asarray(jax.random.uniform(
+        jax.random.key(6), (3, 7, dim), minval=0.0, maxval=100.0))
+
+    ref_vals = []
+    for batch in pts:
+        for x in batch:
+            ref_vals.append(mp(list(x))[0])
+
+    our_vals = []
+    for batch in pts:
+        state, v = mp_evaluate(cfg, state, jnp.asarray(batch))
+        our_vals.extend(np.asarray(v)[:, 0].tolist())
+
+    np.testing.assert_allclose(our_vals, ref_vals, rtol=1e-5)
+    assert mp.nevals == int(state.nevals)
+    np.testing.assert_allclose(float(offline_error(state)),
+                               mp.offlineError(), rtol=1e-5)
+    np.testing.assert_allclose(float(state.current_error),
+                               mp.currentError(), rtol=1e-5)
