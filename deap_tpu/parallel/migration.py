@@ -20,7 +20,7 @@ migration.py:23-27).
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -36,10 +36,15 @@ def _emigrant_idx(key, pop, k, selection):
 
 def mig_ring(key: jax.Array, pops: Population, k: int,
              selection: Callable = sel_best,
-             replacement: Optional[Callable] = None) -> Population:
+             replacement: Optional[Callable] = None,
+             migarray: Optional[jnp.ndarray] = None) -> Population:
     """Ring migration over stacked demes ``[n_demes, deme_size, ...]``.
 
-    Deme i's emigrants overwrite the replaced rows of deme i+1 (mod n).
+    Deme i's emigrants overwrite the replaced rows of deme
+    ``migarray[i]`` (default: ``i+1 mod n`` — the serial ring).
+    ``migarray`` follows the reference contract (migration.py:29-30):
+    each deme index appears exactly once (a permutation), so every deme
+    sends and receives one emigrant block.
     """
     n_demes = pops.valid.shape[0]
     keys = jax.random.split(key, 2 * n_demes)
@@ -63,15 +68,31 @@ def mig_ring(key: jax.Array, pops: Population, k: int,
     def put_rows(a, rows):
         return jax.vmap(lambda x, i, r: x.at[i].set(r))(a, rep_idx, rows)
 
-    roll = lambda r: jnp.roll(r, shift=1, axis=0)  # deme i → deme i+1
+    if migarray is None:
+        # deme i → deme i+1: destination j receives from j-1
+        route = lambda r: jnp.roll(r, shift=1, axis=0)
+    else:
+        import numpy as np
+
+        dest_host = np.asarray(migarray, np.int32)
+        if sorted(dest_host.tolist()) != list(range(n_demes)):
+            raise ValueError(
+                "migarray must be a permutation of deme indices "
+                f"0..{n_demes - 1} (each exactly once, the reference's "
+                f"contract, migration.py:29-30); got {dest_host.tolist()}")
+        dest = jnp.asarray(dest_host)
+        # incoming[j] = emigrants[inv[j]] where dest[inv[j]] == j
+        inv = jnp.zeros(n_demes, jnp.int32).at[dest].set(
+            jnp.arange(n_demes, dtype=jnp.int32), unique_indices=True)
+        route = lambda r: jnp.take(r, inv, axis=0)
 
     genomes = jax.tree_util.tree_map(
-        lambda a: put_rows(a, roll(take_rows(a))), pops.genomes)
+        lambda a: put_rows(a, route(take_rows(a))), pops.genomes)
     extras = jax.tree_util.tree_map(
-        lambda a: put_rows(a, roll(take_rows(a))), pops.extras)
-    fitness = put_rows(pops.fitness, roll(take_rows(pops.fitness)))
+        lambda a: put_rows(a, route(take_rows(a))), pops.extras)
+    fitness = put_rows(pops.fitness, route(take_rows(pops.fitness)))
     valid_rows = jax.vmap(lambda v, i: jnp.take(v, i))(pops.valid, emi_idx)
-    valid = put_rows(pops.valid, roll(valid_rows))
+    valid = put_rows(pops.valid, route(valid_rows))
     return pops.replace(genomes=genomes, extras=extras, fitness=fitness,
                         valid=valid)
 
@@ -79,11 +100,15 @@ def mig_ring(key: jax.Array, pops: Population, k: int,
 def mig_ring_collective(key: jax.Array, pop: Population, k: int,
                         axis_name: str,
                         selection: Callable = sel_best,
-                        replacement: Optional[Callable] = None) -> Population:
+                        replacement: Optional[Callable] = None,
+                        migarray: Optional[Sequence[int]] = None
+                        ) -> Population:
     """Ring migration across mesh slices, for use inside ``shard_map``.
 
-    ``pop`` is the device-local deme; emigrants travel one hop along
-    ``axis_name`` via ``lax.ppermute`` (P4/P5 over ICI).
+    ``pop`` is the device-local deme; emigrants travel along
+    ``axis_name`` via ``lax.ppermute`` (P4/P5 over ICI) — one hop by
+    default, or to ``migarray[i]`` per source slice ``i`` (a static
+    permutation, the reference's migarray contract).
     """
     ksel, krep = jax.random.split(jax.random.fold_in(key, lax.axis_index(axis_name)))
     w = pop.wvalues
@@ -92,7 +117,10 @@ def mig_ring_collective(key: jax.Array, pop: Population, k: int,
 
     emigrants = gather(pop, emi_idx)
     n = lax.axis_size(axis_name)
-    perm = [(i, (i + 1) % n) for i in range(n)]
+    if migarray is None:
+        perm = [(i, (i + 1) % n) for i in range(n)]
+    else:
+        perm = [(i, int(d)) for i, d in enumerate(migarray)]
     incoming = jax.tree_util.tree_map(
         lambda x: lax.ppermute(x, axis_name, perm), emigrants)
 
