@@ -15,14 +15,25 @@ boundary):
   lists of individuals.
 - :mod:`deap_tpu.compat.gp` — list-based genetic programming
   (PrimitiveTree/PrimitiveSet/compile without eval).
+- :mod:`deap_tpu.compat.benchmarks` — the problem library with list
+  individuals in / fitness tuples out (+ ``.binary``, ``.gp``,
+  ``.tools``, and a per-evaluation ``.movingpeaks.MovingPeaks``).
 - :func:`jax_map` — the bridge the north-star names: register a
   jax-backed ``map`` so ``toolbox.map(toolbox.evaluate, invalids)``
   dispatches ONE batched, jit-compiled evaluation over a device tensor
   while individuals stay Python lists.
 """
 
-from deap_tpu.compat import algorithms, base, cma, creator, gp, tools
+from deap_tpu.compat import (
+    algorithms,
+    base,
+    benchmarks,
+    cma,
+    creator,
+    gp,
+    tools,
+)
 from deap_tpu.compat.bridge import jax_map
 
-__all__ = ["algorithms", "base", "cma", "creator", "gp", "tools",
-           "jax_map"]
+__all__ = ["algorithms", "base", "benchmarks", "cma", "creator", "gp",
+           "tools", "jax_map"]

@@ -1,0 +1,91 @@
+"""List-individual surface of the benchmark library — the drop-in
+``from deap import benchmarks`` replacement.
+
+The tensor functions in :mod:`deap_tpu.benchmarks` take ``[dim]``
+arrays and return ``[nobj]`` arrays; reference programs
+(benchmarks/__init__.py:26-688) call them with list individuals and
+assign the result to ``fitness.values``. Each wrapper here accepts any
+numeric sequence and returns a plain tuple of floats, so
+``toolbox.register("evaluate", benchmarks.rastrigin)`` ports verbatim.
+
+Submodules mirror the reference layout: :mod:`.binary`, :mod:`.gp`,
+:mod:`.movingpeaks` (a per-evaluation ``MovingPeaks`` class — unlike
+the tensor ``mp_evaluate``, peak changes here fire on the exact
+evaluation count, reference movingpeaks.py:241-242), :mod:`.tools`.
+"""
+
+import random as _random
+from functools import wraps as _wraps
+
+import jax.numpy as _jnp
+
+from deap_tpu import benchmarks as _t
+
+from . import binary, gp, movingpeaks, tools  # noqa: F401
+
+__all__ = [
+    "rand", "plane", "sphere", "cigar", "rosenbrock", "h1", "ackley",
+    "bohachevsky", "griewank", "rastrigin", "rastrigin_scaled",
+    "rastrigin_skew", "schaffer", "schwefel", "himmelblau", "shekel",
+    "kursawe", "schaffer_mo", "zdt1", "zdt2", "zdt3", "zdt4", "zdt6",
+    "dtlz1", "dtlz2", "dtlz3", "dtlz4", "dtlz5", "dtlz6", "dtlz7",
+    "fonseca", "poloni", "dent",
+    "binary", "gp", "movingpeaks", "tools",
+]
+
+
+def _listwrap(fn):
+    @_wraps(fn)
+    def wrapper(individual, *args, **kwargs):
+        out = fn(_jnp.asarray(individual, _jnp.float32), *args, **kwargs)
+        return tuple(float(v) for v in out)
+    return wrapper
+
+
+def rand(individual):
+    """Random-fitness "function" (benchmarks/__init__.py:26-42): like
+    the reference, draws from the stdlib global ``random`` stream."""
+    return (_random.random(),)
+
+
+plane = _listwrap(_t.plane)
+sphere = _listwrap(_t.sphere)
+cigar = _listwrap(_t.cigar)
+rosenbrock = _listwrap(_t.rosenbrock)
+h1 = _listwrap(_t.h1)
+ackley = _listwrap(_t.ackley)
+bohachevsky = _listwrap(_t.bohachevsky)
+griewank = _listwrap(_t.griewank)
+rastrigin = _listwrap(_t.rastrigin)
+rastrigin_scaled = _listwrap(_t.rastrigin_scaled)
+rastrigin_skew = _listwrap(_t.rastrigin_skew)
+schaffer = _listwrap(_t.schaffer)
+schwefel = _listwrap(_t.schwefel)
+himmelblau = _listwrap(_t.himmelblau)
+
+kursawe = _listwrap(_t.kursawe)
+schaffer_mo = _listwrap(_t.schaffer_mo)
+zdt1 = _listwrap(_t.zdt1)
+zdt2 = _listwrap(_t.zdt2)
+zdt3 = _listwrap(_t.zdt3)
+zdt4 = _listwrap(_t.zdt4)
+zdt6 = _listwrap(_t.zdt6)
+dtlz1 = _listwrap(_t.dtlz1)
+dtlz2 = _listwrap(_t.dtlz2)
+dtlz3 = _listwrap(_t.dtlz3)
+dtlz4 = _listwrap(_t.dtlz4)
+dtlz5 = _listwrap(_t.dtlz5)
+dtlz6 = _listwrap(_t.dtlz6)
+dtlz7 = _listwrap(_t.dtlz7)
+fonseca = _listwrap(_t.fonseca)
+poloni = _listwrap(_t.poloni)
+dent = _listwrap(_t.dent)
+
+
+def shekel(individual, a, c):
+    """Shekel foxholes (benchmarks/__init__.py:341-361); ``a``/``c``
+    may be nested lists exactly as reference programs build them."""
+    out = _t.shekel(_jnp.asarray(individual, _jnp.float32),
+                    _jnp.asarray(a, _jnp.float32),
+                    _jnp.asarray(c, _jnp.float32))
+    return tuple(float(v) for v in out)

@@ -1,0 +1,72 @@
+"""List-individual binary benchmarks (reference benchmarks/binary.py).
+
+``bin2float`` decodes bit-list individuals in pure Python with the
+reference's grouping semantics (binary.py:20-41); the building-block
+functions delegate to the tensor implementations and return plain
+numbers (the reference returns bare ints here, not fitness tuples —
+they are meant to be summed by windowed evaluators).
+"""
+
+from functools import wraps
+
+import jax.numpy as jnp
+
+from deap_tpu.benchmarks import binary as _t
+
+__all__ = ["bin2float", "trap", "inv_trap", "chuang_f1", "chuang_f2",
+           "chuang_f3", "royal_road1", "royal_road2"]
+
+
+def bin2float(min_, max_, nbits):
+    """Decorator: decode groups of ``nbits`` bits into floats in
+    ``[min_, max_]`` and call the wrapped evaluate on the decoded list
+    (binary.py:20-41). Python 3 semantics: true division, so the
+    decoded values are continuous (the reference's Py2 floor-division
+    quirk on malformed input is not reproduced)."""
+    def wrap(function):
+        @wraps(function)
+        def wrapped(individual, *args, **kwargs):
+            nelem = len(individual) // nbits
+            div = 2 ** nbits - 1
+            decoded = []
+            for i in range(nelem):
+                gene = 0
+                for bit in individual[i * nbits:(i + 1) * nbits]:
+                    gene = (gene << 1) | int(bit)
+                decoded.append(min_ + gene / div * (max_ - min_))
+            return function(decoded, *args, **kwargs)
+        return wrapped
+    return wrap
+
+
+def _scalar(fn, individual, *args):
+    return float(jnp.squeeze(
+        fn(jnp.asarray(individual, jnp.float32), *args)))
+
+
+def trap(individual):
+    return _scalar(_t.trap, individual)
+
+
+def inv_trap(individual):
+    return _scalar(_t.inv_trap, individual)
+
+
+def chuang_f1(individual):
+    return (_scalar(_t.chuang_f1, individual),)
+
+
+def chuang_f2(individual):
+    return (_scalar(_t.chuang_f2, individual),)
+
+
+def chuang_f3(individual):
+    return (_scalar(_t.chuang_f3, individual),)
+
+
+def royal_road1(individual, order):
+    return (_scalar(_t.royal_road1, individual, order),)
+
+
+def royal_road2(individual, order):
+    return (_scalar(_t.royal_road2, individual, order),)

@@ -1,0 +1,29 @@
+"""Symbolic-regression target functions on plain sequences (reference
+benchmarks/gp.py:18-128). These are the functions GP tries to *fit*;
+like the reference they take a data point and return a bare float."""
+
+import jax.numpy as jnp
+
+from deap_tpu.benchmarks import gp as _t
+
+__all__ = ["kotanchek", "salustowicz_1d", "salustowicz_2d",
+           "unwrapped_ball", "rational_polynomial",
+           "rational_polynomial2", "sin_cos", "ripple"]
+
+
+def _wrap(fn):
+    def wrapper(data):
+        return float(jnp.squeeze(fn(jnp.asarray(data, jnp.float32))))
+    wrapper.__name__ = fn.__name__
+    wrapper.__doc__ = fn.__doc__
+    return wrapper
+
+
+kotanchek = _wrap(_t.kotanchek)
+salustowicz_1d = _wrap(_t.salustowicz_1d)
+salustowicz_2d = _wrap(_t.salustowicz_2d)
+unwrapped_ball = _wrap(_t.unwrapped_ball)
+rational_polynomial = _wrap(_t.rational_polynomial)
+rational_polynomial2 = _wrap(_t.rational_polynomial2)
+sin_cos = _wrap(_t.sin_cos)
+ripple = _wrap(_t.ripple)

@@ -1,0 +1,114 @@
+"""A per-evaluation ``MovingPeaks`` class for drop-in programs.
+
+The tensor path (:mod:`deap_tpu.benchmarks.movingpeaks`) evaluates
+populations in batches and fires peak changes at batch boundaries — a
+documented divergence from the reference's per-evaluation counter
+(PARITY.md). This class closes that gap for ported list-individual
+programs: it wraps the same config/state machinery but evaluates one
+individual per call, so ``nevals`` and the change trigger advance
+exactly like the reference (movingpeaks.py:209-252). Error bookkeeping
+is shared with the tensor path and proven identical to the reference on
+frozen landscapes (tests/test_stream_parity.py).
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu.benchmarks.movingpeaks import (
+    SCENARIO_1,
+    SCENARIO_2,
+    SCENARIO_3,
+    MovingPeaksConfig,
+    change_peaks,
+    cone,
+    function1,
+    maximums as _maximums,
+    mp_evaluate,
+    mp_init,
+    offline_error,
+    sphere_peak,
+)
+
+__all__ = ["MovingPeaks", "cone", "function1", "sphere_peak",
+           "SCENARIO_1", "SCENARIO_2", "SCENARIO_3"]
+
+
+class MovingPeaks:
+    """Drop-in dynamic landscape: ``mp = MovingPeaks(dim=5,
+    **SCENARIO_2); toolbox.register("evaluate", mp)``.
+
+    Accepts the reference's scenario keywords (npeaks, pfunc, bfunc,
+    min/max_coord, min/max/uniform_height, min/max/uniform_width,
+    lambda_, move/height/width_severity, period). ``pfunc`` must be one
+    of this module's peak functions (``cone``, ``sphere_peak``,
+    ``function1`` — the set the reference scenarios use); arbitrary
+    Python peak callables are not supported on the tensor state.
+    Randomness comes from an explicit ``seed`` instead of the
+    reference's ``random`` module argument.
+    """
+
+    def __init__(self, dim: int, seed: int = 0, random=None, **kwargs):
+        del random  # reference API compat; explicit keys instead
+        kwargs.setdefault("pfunc", function1)
+        self.config = MovingPeaksConfig(dim=dim, **kwargs)
+        key, self._key = jax.random.split(jax.random.key(seed))
+        self.state = mp_init(key, self.config)
+
+    # -- reference surface (movingpeaks.py:182-252) --------------------
+    @property
+    def nevals(self) -> int:
+        return int(self.state.nevals)
+
+    def globalMaximum(self):
+        """(value, position) of the highest peak — the peak's *own*
+        value like the reference (movingpeaks.py:182-191), which
+        ignores basis/neighbour interference here."""
+        import numpy as np
+
+        h = np.asarray(self.state.height)
+        i = int(h.argmax())
+        pos = np.asarray(self.state.position)[i]
+        return float(h[i]), [float(v) for v in pos]
+
+    def maximums(self):
+        """All *visible* peaks as (own value, position), global maximum
+        first (movingpeaks.py:193-207): a peak swallowed by a higher
+        neighbour (or the basis function) is dropped, and entries are
+        sorted descending."""
+        import numpy as np
+
+        land, poss = _maximums(self.config, self.state)
+        land = np.asarray(land)
+        h = np.asarray(self.state.height)
+        poss = np.asarray(poss)
+        out = [(float(h[i]), [float(v) for v in poss[i]])
+               for i in range(len(h)) if h[i] >= land[i] - 1e-5]
+        return sorted(out, reverse=True)
+
+    def __call__(self, individual, count: bool = True):
+        """Evaluate one individual; when ``count``, advance ``nevals``,
+        the error bookkeeping, and — every ``period`` evaluations —
+        the landscape, exactly like movingpeaks.py:209-244."""
+        x = jnp.asarray(individual, jnp.float32)[None, :]
+        if count:
+            self.state, vals = mp_evaluate(self.config, self.state, x)
+            return (float(vals[0, 0]),)
+        # no-count path: evaluate and discard all state updates
+        import dataclasses
+
+        _, vals = mp_evaluate(dataclasses.replace(self.config, period=0),
+                              self.state, x)
+        return (float(vals[0, 0]),)
+
+    def changePeaks(self) -> None:
+        """Force a landscape change now (movingpeaks.py:252)."""
+        self.state = change_peaks(self.config, self.state).replace(
+            current_error=jnp.asarray(jnp.inf))
+
+    def currentError(self) -> float:
+        return float(self.state.current_error)
+
+    def offlineError(self) -> float:
+        return float(offline_error(self.state))

@@ -1,0 +1,152 @@
+"""Evaluation-transform decorators and MO metrics for list-individual
+programs (reference benchmarks/tools.py).
+
+Decorators are re-implemented in plain Python with the reference's
+exact semantics (the tensor versions in
+:mod:`deap_tpu.benchmarks.tools` transform jnp arrays and, for noise,
+take explicit PRNG keys — both wrong shapes for ported programs).
+Metrics convert individuals' fitness values and delegate to the tensor
+implementations.
+"""
+
+from functools import wraps
+from itertools import repeat
+
+import numpy as np
+
+from deap_tpu.benchmarks import tools as _t
+
+__all__ = ["translate", "rotate", "scale", "noise", "bound",
+           "diversity", "convergence", "hypervolume", "igd"]
+
+
+class translate:
+    """Shift the objective function by ``vector``: the inverse
+    translation is applied to the individual (tools.py:25-62).
+    Adds a ``translate`` method to the decorated function."""
+
+    def __init__(self, vector):
+        self.vector = list(vector)
+
+    def __call__(self, func):
+        @wraps(func)
+        def wrapper(individual, *args, **kwargs):
+            return func([v - t for v, t in zip(individual, self.vector)],
+                        *args, **kwargs)
+        wrapper.translate = self.translate
+        return wrapper
+
+    def translate(self, vector):
+        self.vector = list(vector)
+
+
+class rotate:
+    """Rotate the objective function by orthogonal ``matrix``: the
+    inverse rotation is applied to the individual (tools.py:64-115)."""
+
+    def __init__(self, matrix):
+        self.matrix = np.linalg.inv(np.asarray(matrix))
+
+    def __call__(self, func):
+        @wraps(func)
+        def wrapper(individual, *args, **kwargs):
+            return func(list(self.matrix @ np.asarray(individual)),
+                        *args, **kwargs)
+        wrapper.rotate = self.rotate
+        return wrapper
+
+    def rotate(self, matrix):
+        self.matrix = np.linalg.inv(np.asarray(matrix))
+
+
+class scale:
+    """Scale the objective function by ``factor``: the inverse factors
+    are applied to the individual (tools.py:171-210)."""
+
+    def __init__(self, factor):
+        self.factor = tuple(1.0 / f for f in factor)
+
+    def __call__(self, func):
+        @wraps(func)
+        def wrapper(individual, *args, **kwargs):
+            return func([v * f for v, f in zip(individual, self.factor)],
+                        *args, **kwargs)
+        wrapper.scale = self.scale
+        return wrapper
+
+    def scale(self, factor):
+        self.factor = tuple(1.0 / f for f in factor)
+
+
+class noise:
+    """Add noise drawn from argument-less ``noise`` function(s) to each
+    objective of the wrapped evaluation (tools.py:117-168); ``None``
+    leaves an objective noiseless."""
+
+    def __init__(self, noise):
+        try:
+            self.rand_funcs = tuple(noise)
+        except TypeError:
+            self.rand_funcs = repeat(noise)
+
+    def __call__(self, func):
+        @wraps(func)
+        def wrapper(individual, *args, **kwargs):
+            result = func(individual, *args, **kwargs)
+            return tuple(r if f is None else r + f()
+                         for r, f in zip(result, self.rand_funcs))
+        wrapper.noise = self.noise
+        return wrapper
+
+    def noise(self, noise):
+        try:
+            self.rand_funcs = tuple(noise)
+        except TypeError:
+            self.rand_funcs = repeat(noise)
+
+
+def bound(bounds, type_):
+    """Clamp-decorator stub matching the reference's surface
+    (tools.py:212-254): returns the evaluation unchanged ('clip' is the
+    only behaviour the reference actually implements for individuals,
+    and it documents the decorator as experimental)."""
+    def wrap(func):
+        @wraps(func)
+        def wrapper(individual, *args, **kwargs):
+            return func(individual, *args, **kwargs)
+        return wrapper
+    return wrap
+
+
+def _front_values(front):
+    return np.asarray([ind.fitness.values for ind in front], np.float64)
+
+
+def diversity(first_front, first, last):
+    """Deb's NSGA-II spread Δ over a front of individuals
+    (tools.py:256-276)."""
+    return float(_t.diversity(_front_values(front=first_front)[:, :2],
+                              first, last))
+
+
+def convergence(first_front, optimal_front):
+    """Mean distance from each front individual to the optimal front
+    (tools.py:278-296)."""
+    return float(_t.convergence(_front_values(first_front),
+                                np.asarray(optimal_front, np.float64)))
+
+
+def hypervolume(front, ref=None):
+    """Hypervolume of a front of individuals, minimisation via
+    ``-wvalues`` like the reference (tools.py:299-311); the flip and
+    default-reference logic live in the tensor metric."""
+    wv = np.asarray([ind.fitness.wvalues for ind in front], np.float64)
+    return float(_t.hypervolume(wv, ref=ref,
+                                weights=np.ones(wv.shape[-1])))
+
+
+def igd(A, Z):
+    """Inverse generational distance between value arrays
+    (tools.py:314-320)."""
+    return float(_t.igd(np.asarray(A, np.float64),
+                        np.asarray(Z, np.float64)))

@@ -1,5 +1,5 @@
 from deap_tpu.parallel.mesh import population_mesh, shard_population
-from deap_tpu.parallel.migration import mig_ring, migRing
+from deap_tpu.parallel.migration import mig_ring, mig_ring_collective, migRing
 from deap_tpu.parallel.island import IslandState, island_init, make_island_step
 from deap_tpu.parallel.multihost import (
     global_population_mesh,
@@ -23,6 +23,7 @@ __all__ = [
     "population_mesh",
     "shard_population",
     "mig_ring",
+    "mig_ring_collective",
     "migRing",
     "IslandState",
     "island_init",

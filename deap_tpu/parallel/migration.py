@@ -120,7 +120,14 @@ def mig_ring_collective(key: jax.Array, pop: Population, k: int,
     if migarray is None:
         perm = [(i, (i + 1) % n) for i in range(n)]
     else:
-        perm = [(i, int(d)) for i, d in enumerate(migarray)]
+        dests = [int(d) for d in migarray]
+        if sorted(dests) != list(range(n)):
+            # fail loudly: a slice with no sender would silently
+            # receive zeros from ppermute, corrupting its deme
+            raise ValueError(
+                "migarray must be a permutation of slice indices "
+                f"0..{n - 1} (each exactly once); got {dests}")
+        perm = list(enumerate(dests))
     incoming = jax.tree_util.tree_map(
         lambda x: lax.ppermute(x, axis_name, perm), emigrants)
 

@@ -1,0 +1,137 @@
+"""compat.benchmarks: the drop-in `from deap import benchmarks` surface.
+
+List individuals in, fitness tuples out (reference
+benchmarks/__init__.py), pure-Python decorators with the reference's
+update-method protocol (benchmarks/tools.py), reference-grouping
+bin2float (binary.py:20-41), and a per-evaluation MovingPeaks whose
+change trigger advances on the exact eval count (movingpeaks.py:241) —
+the granularity the tensor batch path deliberately trades away.
+"""
+
+import math
+import random
+
+import numpy as np
+import pytest
+
+from deap_tpu.compat import base, benchmarks, creator, tools
+
+
+def test_functions_take_lists_and_return_tuples():
+    assert benchmarks.sphere([1.0, 2.0]) == (5.0,)
+    assert benchmarks.rastrigin([0.0, 0.0]) == (0.0,)
+    out = benchmarks.zdt1([0.5] * 6)
+    assert isinstance(out, tuple) and len(out) == 2
+    assert all(isinstance(v, float) for v in out)
+    out = benchmarks.dtlz3([0.5] * 7, 3)
+    assert len(out) == 3
+    out = benchmarks.kursawe([0.1, 0.2, 0.3])
+    assert len(out) == 2
+    v = benchmarks.shekel([5.0, 5.0], [[5.0, 5.0], [2.0, 2.0]],
+                          [0.1, 0.2])
+    assert len(v) == 1 and v[0] > 0
+
+    random.seed(42)
+    r1 = benchmarks.rand([0, 0])
+    random.seed(42)
+    assert r1 == (random.random(),)
+
+
+def test_registers_as_toolbox_evaluate():
+    creator.create("CBFit", base.Fitness, weights=(-1.0,))
+    creator.create("CBInd", list, fitness=creator.CBFit)
+    tb = base.Toolbox()
+    tb.register("evaluate", benchmarks.ackley)
+    ind = creator.CBInd([0.0, 0.0, 0.0])
+    ind.fitness.values = tb.evaluate(ind)
+    assert ind.fitness.values[0] == pytest.approx(0.0, abs=1e-5)
+
+
+def test_binary_bin2float_and_building_blocks():
+    dec = benchmarks.binary.bin2float(0.0, 1.0, 4)(lambda d: (sum(d),))
+    assert dec([1, 1, 1, 1, 0, 0, 0, 0]) == (1.0,)
+    # half-scale group: 0b1000 / 15
+    assert dec([1, 0, 0, 0, 1, 1, 1, 1])[0] == pytest.approx(8 / 15 + 1.0)
+    assert benchmarks.binary.trap([1, 1, 1, 1]) == 4.0
+    assert benchmarks.binary.trap([0, 1, 0, 0]) == 2.0
+    assert benchmarks.binary.inv_trap([0, 0, 0, 0]) == 4.0
+    assert benchmarks.binary.chuang_f1([1] * 41) == (40.0,)
+    assert benchmarks.binary.royal_road1([1] * 16, 4) == (16.0,)
+    # R2 = R1(order 4) + R1(order 8) = 16 + 16 (reference-verified)
+    assert benchmarks.binary.royal_road2([1] * 16, 4) == (32.0,)
+
+
+def test_gp_targets_return_floats():
+    v = benchmarks.gp.kotanchek([1.0, 2.0])
+    assert isinstance(v, float)
+    assert benchmarks.gp.salustowicz_1d([0.0]) == pytest.approx(0.0)
+
+
+def test_tools_decorators_reference_semantics():
+    evaluate = lambda ind: (sum(ind),)
+
+    ev = benchmarks.tools.translate([1.0, 2.0])(evaluate)
+    assert ev([1.0, 2.0]) == (0.0,)
+    ev.translate([0.0, 0.0])
+    assert ev([1.0, 2.0]) == (3.0,)
+
+    ev = benchmarks.tools.scale([2.0, 4.0])(evaluate)
+    assert ev([2.0, 4.0]) == (2.0,)
+
+    rot = [[0.0, -1.0], [1.0, 0.0]]  # 90 degrees
+    ev = benchmarks.tools.rotate(rot)(lambda ind: (ind[0],))
+    assert ev([3.0, 7.0])[0] == pytest.approx(7.0)
+
+    ev = benchmarks.tools.noise(lambda: 0.25)(evaluate)
+    assert ev([1.0]) == (1.25,)
+    ev.noise(None)
+    assert ev([1.0]) == (1.0,)
+
+
+def test_tools_metrics_on_individuals():
+    creator.create("CBFit2", base.Fitness, weights=(-1.0, -1.0))
+    creator.create("CBInd2", list, fitness=creator.CBFit2)
+    pop = []
+    for vals in [(1.0, 3.0), (2.0, 2.0), (3.0, 1.0)]:
+        ind = creator.CBInd2([0.0])
+        ind.fitness.values = vals
+        pop.append(ind)
+    assert benchmarks.tools.hypervolume(pop, ref=[4.0, 4.0]) == \
+        pytest.approx(6.0)
+    d = benchmarks.tools.diversity(pop, (0.0, 4.0), (4.0, 0.0))
+    assert 0.0 <= d <= 1.0
+    c = benchmarks.tools.convergence(pop, [[1.0, 3.0], [3.0, 1.0]])
+    assert c == pytest.approx(math.sqrt(2) / 3)
+    assert benchmarks.tools.igd([[1, 1]], [[0, 0], [2, 2]]) == \
+        pytest.approx(math.sqrt(2))
+
+
+def test_movingpeaks_per_eval_granularity():
+    mp = benchmarks.movingpeaks.MovingPeaks(
+        dim=2, seed=3, period=5,
+        **{k: v for k, v in benchmarks.movingpeaks.SCENARIO_1.items()
+           if k != "period"})
+    h0 = np.asarray(mp.state.height).copy()
+    for _ in range(4):
+        mp([50.0, 50.0])
+    # 4 evals: no change yet — per-eval counter, not batch granularity
+    np.testing.assert_allclose(np.asarray(mp.state.height), h0)
+    mp([50.0, 50.0])
+    assert mp.nevals == 5
+    assert not np.allclose(np.asarray(mp.state.height), h0)
+
+    gm_val, gm_pos = mp.globalMaximum()
+    maxima = mp.maximums()
+    # sorted descending, global maximum first (ref movingpeaks.py:193)
+    vals = [v for v, _ in maxima]
+    assert vals == sorted(vals, reverse=True)
+    assert gm_val == pytest.approx(vals[0], rel=1e-6)
+    assert len(gm_pos) == 2
+    assert mp.offlineError() > 0
+
+    n = mp.nevals
+    out = mp([50.0, 50.0], count=False)
+    assert isinstance(out, tuple) and mp.nevals == n  # state untouched
+
+    mp.changePeaks()
+    assert mp.currentError() == float("inf")
