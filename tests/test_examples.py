@@ -93,6 +93,7 @@ EXAMPLES = [
     "examples.bbob",
     "examples.compat_onemax",
     "examples.compat_symbreg",
+    "examples.compat_nsga2",
 ]
 
 
