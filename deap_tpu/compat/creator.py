@@ -10,10 +10,17 @@ pickle behave like values (creator.py:51-93).
 
 from __future__ import annotations
 
+import array
 import copy
 import warnings
 
 import numpy
+
+#: base class → fixed-up stand-in, consulted by :func:`create` exactly
+#: like the reference's ``class_replacers`` (creator.py:44-93). Users
+#: can register their own replacers for containers whose deepcopy or
+#: pickling needs patching.
+class_replacers = {}
 
 
 class _NumpyMixin:
@@ -30,6 +37,29 @@ class _NumpyMixin:
 
     def __reduce__(self):
         return (type(self), (list(self),), self.__dict__)
+
+
+class _FixedArray(array.array):
+    """array.array stand-in (creator.py:76-93): the typecode comes from
+    the created class, so ``Individual([1, 0, 1])`` works, and
+    deepcopy/pickle carry the instance ``__dict__`` (the fitness)."""
+
+    @staticmethod
+    def __new__(cls, seq=()):
+        return super().__new__(cls, cls.typecode, seq)
+
+    def __deepcopy__(self, memo):
+        cls = self.__class__
+        copy_ = cls.__new__(cls, self)
+        memo[id(self)] = copy_
+        copy_.__dict__.update(copy.deepcopy(self.__dict__, memo))
+        return copy_
+
+    def __reduce__(self):
+        return (self.__class__, (list(self),), self.__dict__)
+
+
+class_replacers[array.array] = _FixedArray
 
 
 def create(name: str, base: type, **kwargs) -> type:
@@ -53,7 +83,10 @@ def create(name: str, base: type, **kwargs) -> type:
         else:
             class_attrs[key] = value
 
-    if issubclass(base, numpy.ndarray):
+    if base not in class_replacers and issubclass(base, numpy.ndarray):
+        # built-in ndarray handling; a user-registered replacer for
+        # numpy.ndarray takes precedence via the branch below, exactly
+        # like the reference's class_replacers lookup (creator.py:145)
         def __new__(cls, iterable=()):
             return _NumpyMixin._numpy_new(cls, iterable)
 
@@ -68,15 +101,20 @@ def create(name: str, base: type, **kwargs) -> type:
         body["__reduce__"] = _NumpyMixin.__reduce__
         cls = type(name, (base,), body)
     else:
+        # swap bases whose deepcopy/pickling needs patching — e.g.
+        # array.array, whose __new__ needs the class typecode threaded
+        base = class_replacers.get(base, base)
+
         def __init__(self, *args, **kw):
-            base.__init__(self, *args, **kw)
+            if base.__init__ is not object.__init__:
+                base.__init__(self, *args, **kw)
             for attr, klass in instance_attrs.items():
                 setattr(self, attr, klass())
 
         # default pickling handles list/dict/set subclasses correctly
-        # (listitems/dictitems + __dict__ state); only ndarray needs the
-        # explicit __reduce__ fix above, matching the reference's scope
-        # (creator.py:51-93 patches only ndarray and array.array)
+        # (listitems/dictitems + __dict__ state); only ndarray and
+        # array.array need explicit fixes, matching the reference's
+        # scope (creator.py:51-93)
         body = dict(class_attrs)
         body["__init__"] = __init__
         cls = type(name, (base,), body)

@@ -463,3 +463,40 @@ def test_compat_nsga2_zdt1_hypervolume_gate():
     value = hv(front, np.array([11.0, 11.0]))
     assert value > 116.0, value  # optimum 120.777
     assert bool((front >= 0).all() and (front <= 11).all())
+
+
+def test_creator_array_individuals_roundtrip():
+    """array.array individuals via class_replacers (creator.py:76-93):
+    typecode threading, deepcopy/pickle with fitness, slice swap —
+    the reference's test_creator array coverage."""
+    import array
+    import copy
+    import pickle
+
+    from deap_tpu.compat import base, creator, tools
+
+    creator.create("ArrFitT", base.Fitness, weights=(1.0,))
+    creator.create("ArrIndT", array.array, typecode="b",
+                   fitness=creator.ArrFitT)
+
+    ind = creator.ArrIndT([1, 0, 1, 1])
+    assert list(ind) == [1, 0, 1, 1] and ind.typecode == "b"
+    ind.fitness.values = (3.0,)
+
+    c = copy.deepcopy(ind)
+    c.fitness.values = (9.0,)
+    assert list(c) == list(ind)
+    assert ind.fitness.values == (3.0,)
+
+    p = pickle.loads(pickle.dumps(ind))
+    assert list(p) == [1, 0, 1, 1] and p.fitness.values == (3.0,)
+
+    d, e = creator.ArrIndT([0, 1, 2, 3]), creator.ArrIndT([4, 5, 6, 7])
+    d[1:3], e[1:3] = e[1:3], d[1:3]
+    assert list(d) == [0, 5, 6, 3] and list(e) == [4, 1, 2, 7]
+
+    a, b = creator.ArrIndT([1, 1, 1, 1]), creator.ArrIndT([0, 0, 0, 0])
+    tools.cxTwoPoint(a, b)
+    assert sorted(list(a) + list(b)) == [0] * 4 + [1] * 4
+
+    assert array.array in creator.class_replacers  # extension point
