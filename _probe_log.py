@@ -1,0 +1,46 @@
+"""Append a timestamped axon-relay probe result to TPU_PROBE_LOG.jsonl.
+
+VERDICT r2 item 1 asks for a committed probe log when the relay stays
+dead, so the driver can distinguish "unproven" from "unprovable this
+round". One JSON line per probe: {ts, port_open, reachable}.
+"""
+
+import datetime
+import json
+import os
+import socket
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _axon_probe import RELAY_PORTS, axon_tunnel_reachable
+
+LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "TPU_PROBE_LOG.jsonl")
+
+
+def probe_once() -> dict:
+    port_open = False
+    for port in RELAY_PORTS:
+        s = socket.socket()
+        s.settimeout(1)
+        try:
+            s.connect(("127.0.0.1", port))
+            port_open = True
+            break
+        except OSError:
+            pass
+        finally:
+            s.close()
+    reachable = axon_tunnel_reachable() if port_open else False
+    rec = {
+        "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "port_open": port_open,
+        "reachable": reachable,
+    }
+    with open(LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    return rec
+
+
+if __name__ == "__main__":
+    print(json.dumps(probe_once()))

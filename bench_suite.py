@@ -272,14 +272,14 @@ def bench_gp_symbreg():
     pset = gp.math_set(n_args=1)
     gen = gp.gen_half_and_half(pset, MAX_LEN, 1, 2)
     expr_mut = gp.make_generator(pset, 32, 0, 2, "full")
-    interp = gp.make_interpreter(pset, MAX_LEN)
+    evaluate = gp.make_population_evaluator(
+        pset, MAX_LEN, lambda pred, y: jnp.mean((pred - y) ** 2))
     X = jnp.linspace(-1.0, 1.0, 256, endpoint=False)[:, None]
     y = X[:, 0] ** 4 + X[:, 0] ** 3 + X[:, 0] ** 2 + X[:, 0]
     limit = gp.static_limit(lambda g: gp.tree_height(g, pset), 17)
 
     tb = Toolbox()
-    tb.register("evaluate", lambda gs: -jax.vmap(
-        lambda g: jnp.mean((interp(g, X) - y) ** 2))(gs))
+    tb.register("evaluate", lambda gs: -evaluate(gs, X, y))
     tb.register("mate", limit(gp.make_cx_one_point(pset)))
     tb.register("mutate", limit(gp.make_mut_uniform(pset, expr_mut)))
     tb.register("select", ops.sel_tournament, tournsize=3)

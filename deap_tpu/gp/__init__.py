@@ -8,7 +8,9 @@ and the generators / crossovers / mutations are pure index arithmetic
 usable inside jit (SURVEY.md §7.2 item 8).
 """
 
-from deap_tpu.gp.interpreter import make_interpreter, make_population_evaluator
+from deap_tpu.gp.interpreter import (make_batch_interpreter,
+                                     make_interpreter,
+                                     make_population_evaluator)
 from deap_tpu.gp.pset import (
     PrimitiveSet,
     bool_set,
@@ -83,6 +85,7 @@ __all__ = [
     "bool_set",
     "math_set",
     "protected_div",
+    "make_batch_interpreter",
     "make_interpreter",
     "make_population_evaluator",
     "make_generator",

@@ -3,14 +3,18 @@
 This replaces the reference's per-individual string codegen + Python
 ``eval`` (/root/reference/deap/gp.py:462-487, the most TPU-hostile stack
 in the reference per SURVEY.md §3.3) with a vectorised prefix-tree
-interpreter: one ``lax.scan`` over node slots, operating on a stack of
-*data vectors*, ``vmap``-batched over the population. Evaluating a
-population of trees on all datapoints is a single XLA program with no
-per-individual dispatch, and — unlike the reference, which hits a
-MemoryError past depth ~90 via nested lambda eval (gp.py:481-487) — cost
-is strictly O(max_len · vocab · points).
+interpreter: one pass over node slots (a ``lax.scan``, or a
+``fori_loop`` with a dynamic trip count on the batch path), operating
+on a stack of *data vectors*, ``vmap``-batched over the population.
+Evaluating a population of trees on all datapoints is a single XLA
+program with no per-individual dispatch, and — unlike the reference,
+which hits a MemoryError past depth ~90 via nested lambda eval
+(gp.py:481-487) — cost is O(max_len · vocab · points) worst case, or
+O(max_active · vocab · points) via :func:`make_batch_interpreter`,
+which bounds both passes to the population's largest live prefix
+``T = max(length)``.
 
-Execution model — two passes over the prefix, both ``lax.scan``:
+Execution model — two passes over the prefix:
 
 1. **Child-table pre-pass (ints only).** Walk the prefix right-to-left
    with a stack of *slot indices*: for each operator slot record which
@@ -45,7 +49,7 @@ from deap_tpu.gp.pset import PrimitiveSet
 
 
 def child_table(nodes: jnp.ndarray, length, arity: jnp.ndarray,
-                max_ar: int) -> jnp.ndarray:
+                max_ar: int, max_active=None) -> jnp.ndarray:
     """Child-slot table for a prefix genome — the int-only pre-pass
     shared by this module's interpreter and the ADF branch interpreter
     (gp/adf.py).
@@ -54,13 +58,16 @@ def child_table(nodes: jnp.ndarray, length, arity: jnp.ndarray,
     ``[slot, i]`` of the returned ``int32[ML, max_ar]`` is the slot
     holding operand *i* of the node at ``slot`` (garbage, never
     referenced, for terminals and padding).
+
+    ``max_active`` (a traced scalar ≥ every tree's ``length``) bounds
+    the walk to the population's live prefix instead of the full genome
+    width — see :func:`run_data_pass` for the batching contract.
     """
     ML = nodes.shape[0]
     ar_all = jnp.where(jnp.arange(ML) < length, arity[nodes], 0)
 
-    def pre(carry, t):
+    def pre(carry, rt):
         stack, sp = carry
-        rt = ML - 1 - t
         valid = rt < length
         children = jnp.stack([
             lax.dynamic_index_in_dim(stack, sp - 1 - i, keepdims=False)
@@ -71,14 +78,34 @@ def child_table(nodes: jnp.ndarray, length, arity: jnp.ndarray,
         stack = jnp.where(valid, pushed, stack)
         return (stack, new_sp), children
 
-    _, ch = lax.scan(
-        pre, (jnp.zeros(ML + max_ar, jnp.int32), jnp.int32(0)),
-        jnp.arange(ML))
-    return ch[::-1]
+    if max_active is None:
+        _, ch = lax.scan(
+            pre, (jnp.zeros(ML + max_ar, jnp.int32), jnp.int32(0)),
+            jnp.arange(ML - 1, -1, -1))
+        return ch[::-1]
+
+    # dynamic trip count: only slots < max_active can be live, so the
+    # right-to-left walk may start at max_active-1.  The write position
+    # rt stays batch-uniform as long as max_active is unbatched under
+    # vmap (a population-level reduction closed over per-tree calls).
+    T = max_active
+
+    def body(t, carry):
+        stack, sp, ch = carry
+        rt = T - 1 - t
+        (stack, sp), children = pre((stack, sp), rt)
+        ch = lax.dynamic_update_index_in_dim(ch, children, rt, axis=0)
+        return stack, sp, ch
+
+    _, _, ch = lax.fori_loop(
+        0, T, body,
+        (jnp.zeros(ML + max_ar, jnp.int32), jnp.int32(0),
+         jnp.zeros((ML, max_ar), jnp.int32)))
+    return ch
 
 
 def run_data_pass(pset: PrimitiveSet, max_len: int, genome, X,
-                  prim_rows: Callable) -> jnp.ndarray:
+                  prim_rows: Callable, max_active=None) -> jnp.ndarray:
     """Shared two-pass evaluation core (this module's interpreter and
     the ADF branch interpreter in gp/adf.py).
 
@@ -87,6 +114,19 @@ def run_data_pass(pset: PrimitiveSet, max_len: int, genome, X,
     other branches here); everything else — child table, output buffer,
     row selection, padding semantics — is identical across both.
     Returns the root's value vector ``f32[points]``.
+
+    ``max_active`` bounds both passes to the live prefix: a traced
+    int32 ≥ every tree's ``length``.  With it the cost drops from
+    O(max_len·vocab·points) to O(max_active·vocab·points) — early GP
+    generations hold trees of 3-15 nodes in 64-slot genomes, so this
+    is the difference between paying for the genome *width* and paying
+    for the population's actual *size* (the reference's direct ``eval``
+    of small trees, gp.py:462-487, only ever pays the latter).
+    Batching contract: ``max_active`` must be UNBATCHED under ``vmap``
+    (a population-level ``max``, closed over or passed with
+    ``in_axes=None``) so every write index stays batch-uniform; a
+    per-tree value would turn the output-buffer update into a scatter
+    (see module docstring).
     """
     arity = pset.arity_table()
     max_ar = max(pset.max_arity, 1)
@@ -103,11 +143,11 @@ def run_data_pass(pset: PrimitiveSet, max_len: int, genome, X,
     consts = consts[:ML]
     P = X.shape[0]
     argsT = X.T.astype(jnp.float32)                # [n_args, P]
-    C = child_table(nodes, length, arity, max_ar)  # [ML, max_ar]
+    C = child_table(nodes, length, arity, max_ar,
+                    max_active=max_active)         # [ML, max_ar]
 
     # pass 2: fill the output buffer, children before parents
-    def step(out, t):
-        rt = ML - 1 - t                       # batch-uniform index
+    def step(out, rt):
         # padded slots act as inert constants (never referenced by
         # any real parent's child table)
         node = jnp.where(rt < length, nodes[rt], jnp.int32(const_row))
@@ -118,17 +158,41 @@ def run_data_pass(pset: PrimitiveSet, max_len: int, genome, X,
         ]
         rows = prim_rows(ops_in)
         rows.extend(argsT)                          # argument terminals
-        rows.append(jnp.broadcast_to(consts[rt], (P,)))  # constant
-        allv = jnp.stack(rows)                  # [n_ops + n_args + 1, P]
         # every constant-family id (fixed terminal or ERC) shares the
         # one constant row
         row = jnp.minimum(node, jnp.int32(const_row))
-        res = lax.dynamic_index_in_dim(allv, row, keepdims=False)
-        return lax.dynamic_update_index_in_dim(out, res, rt, axis=0), None
+        # select-chain instead of stack+gather: XLA fuses the whole
+        # chain into one elementwise pass over P, where stacking would
+        # materialise a [vocab, P] buffer per tree per step (measured
+        # ~2× slower on CPU at pop=4096, pts=256)
+        res = jnp.broadcast_to(consts[rt], (P,))    # constant default
+        for i, r in enumerate(rows):
+            res = jnp.where(row == i, r, res)
+        return lax.dynamic_update_index_in_dim(out, res, rt, axis=0)
 
-    out, _ = lax.scan(step, jnp.zeros((ML, P), jnp.float32),
-                      jnp.arange(ML))
+    out0 = jnp.zeros((ML, P), jnp.float32)
+    if max_active is None:
+        out, _ = lax.scan(lambda o, rt: (step(o, rt), None), out0,
+                          jnp.arange(ML - 1, -1, -1))
+    else:
+        T = max_active
+        out = lax.fori_loop(0, T, lambda t, o: step(o, T - 1 - t), out0)
     return out[0]
+
+
+def _prim_rows_builder(pset: PrimitiveSet) -> Callable:
+    """The plain-primitive dispatch shared by both interpreter
+    factories (the ADF interpreter substitutes its own, gp/adf.py)."""
+    if pset.has_adf:
+        raise ValueError(
+            "primitive set contains ADF calls; use "
+            "deap_tpu.gp.adf.make_adf_interpreter")
+    prims = list(pset.primitives)
+
+    def prim_rows(ops_in):
+        return [p.fn(*ops_in[: p.arity]) for p in prims]
+
+    return prim_rows
 
 
 def make_interpreter(pset: PrimitiveSet, max_len: int) -> Callable:
@@ -138,19 +202,42 @@ def make_interpreter(pset: PrimitiveSet, max_len: int) -> Callable:
     f32[max_len], "length": int32}``; ``X`` is ``f32[points, n_args]``.
     vmap over genomes for populations, over X for multiple datasets.
     """
-    if pset.has_adf:
-        raise ValueError(
-            "primitive set contains ADF calls; use "
-            "deap_tpu.gp.adf.make_adf_interpreter")
-    prims = list(pset.primitives)
+    prim_rows = _prim_rows_builder(pset)
 
     def interpret(genome, X):
-        def prim_rows(ops_in):
-            return [p.fn(*ops_in[: p.arity]) for p in prims]
-
         return run_data_pass(pset, max_len, genome, X, prim_rows)
 
     return interpret
+
+
+def make_batch_interpreter(pset: PrimitiveSet, max_len: int) -> Callable:
+    """Build ``interpret(genomes, X) -> f32[pop, points]`` over a whole
+    population — the fast path for fitness evaluation.
+
+    Unlike ``vmap(make_interpreter(...))``, this computes the
+    population's active length ``T = max(length)`` and bounds both
+    interpreter passes to ``T`` slots instead of the full ``max_len``
+    genome width.  ``T`` is closed over the vmapped per-tree call, so
+    vmap keeps it unbatched and every buffer write stays batch-uniform
+    (the contract in :func:`run_data_pass`).  Early generations (trees
+    of 3-15 nodes in 64-slot genomes) evaluate ~4-20× less work; cost
+    tracks bloat exactly like the reference's direct ``eval`` of the
+    current trees (gp.py:462-487) rather than the genome width.
+    """
+    prim_rows = _prim_rows_builder(pset)
+    ML_cap = max_len
+
+    def interpret_batch(genomes, X):
+        ML = min(genomes["nodes"].shape[-1], ML_cap)
+        T = jnp.clip(jnp.max(genomes["length"]), 1, ML).astype(jnp.int32)
+
+        def one(g):
+            return run_data_pass(pset, max_len, g, X, prim_rows,
+                                 max_active=T)
+
+        return jax.vmap(one)(genomes)
+
+    return interpret_batch
 
 
 def make_population_evaluator(pset: PrimitiveSet, max_len: int,
@@ -161,10 +248,10 @@ def make_population_evaluator(pset: PrimitiveSet, max_len: int,
     over the sample points, examples/gp/symbreg.py:55-61) is
     ``loss=lambda pred, y: jnp.mean((pred - y) ** 2)``.
     """
-    interp = make_interpreter(pset, max_len)
+    interp = make_batch_interpreter(pset, max_len)
 
     def evaluate(genomes, X, y):
-        preds = jax.vmap(lambda g: interp(g, X))(genomes)   # [pop, points]
+        preds = interp(genomes, X)                          # [pop, points]
         return jax.vmap(lambda p: loss(p, y))(preds)
 
     return evaluate

@@ -32,12 +32,12 @@ def main(smoke: bool = False, fanin: int = 4):
     pset = gp.bool_set(fanin)
     gen = gp.gen_half_and_half(pset, MAX_LEN, 1, 3)
     expr_mut = gp.make_generator(pset, 32, 0, 2, "grow")
-    interp = gp.make_interpreter(pset, MAX_LEN)
+    interp = gp.make_batch_interpreter(pset, MAX_LEN)
     X, y = truth_table(fanin)
 
     toolbox = Toolbox()
-    toolbox.register("evaluate", lambda gs: jax.vmap(
-        lambda g: (interp(g, X) == y).sum().astype(jnp.float32))(gs))
+    toolbox.register("evaluate", lambda gs: (
+        interp(gs, X) == y).sum(-1).astype(jnp.float32))
     toolbox.register("mate", gp.make_cx_one_point(pset))
     toolbox.register("mutate", gp.make_mut_uniform(pset, expr_mut))
     toolbox.register("select", ops.sel_tournament, tournsize=3)

@@ -33,11 +33,11 @@ def main(smoke: bool = False):
     X, y = make_dataset(jax.random.key(43))
     pset = gp.spam_set(n_features=N_FEATURES)
     gen = gp.make_generator_typed(pset, MAX_LEN, 1, 4)
-    interp = gp.make_interpreter(pset, MAX_LEN)
+    interp = gp.make_batch_interpreter(pset, MAX_LEN)
 
     toolbox = Toolbox()
-    toolbox.register("evaluate", lambda gs: jax.vmap(
-        lambda g: (interp(g, X) == y).mean())(gs))
+    toolbox.register("evaluate",
+                     lambda gs: (interp(gs, X) == y).mean(-1))
     toolbox.register("mate", gp.make_cx_one_point_typed(pset))
     toolbox.register("mutate", gp.make_mut_node_replacement_typed(pset))
     toolbox.register("select", ops.sel_tournament, tournsize=3)

@@ -26,7 +26,7 @@ def main(smoke: bool = False, seed: int = 318):
     pset.rename_arguments(ARG0="x")
     gen = gp.gen_half_and_half(pset, MAX_LEN, 1, 2)
     expr_mut = gp.make_generator(pset, 32, 0, 2, "full")
-    interp = gp.make_interpreter(pset, MAX_LEN)
+    interp = gp.make_batch_interpreter(pset, MAX_LEN)
 
     X = jnp.linspace(-1.0, 1.0, 20, endpoint=False)[:, None]
     y = X[:, 0] ** 4 + X[:, 0] ** 3 + X[:, 0] ** 2 + X[:, 0]
@@ -34,8 +34,8 @@ def main(smoke: bool = False, seed: int = 318):
     limit = gp.static_limit(lambda g: gp.tree_height(g, pset), 17)
 
     toolbox = Toolbox()
-    toolbox.register("evaluate", lambda gs: -jax.vmap(
-        lambda g: jnp.mean((interp(g, X) - y) ** 2))(gs))
+    toolbox.register("evaluate",
+                     lambda gs: -jnp.mean((interp(gs, X) - y) ** 2, -1))
     toolbox.register("mate", limit(gp.make_cx_one_point(pset)))
     toolbox.register("mutate", limit(gp.make_mut_uniform(pset, expr_mut)))
     toolbox.register("select", ops.sel_tournament, tournsize=3)

@@ -23,14 +23,14 @@ def main(smoke: bool = False):
     pset = gp.math_set(n_args=1)
     gen = gp.gen_half_and_half(pset, MAX_LEN, 1, 2)
     expr_mut = gp.make_generator(pset, 32, 0, 2, "full")
-    interp = gp.make_interpreter(pset, MAX_LEN)
+    interp = gp.make_batch_interpreter(pset, MAX_LEN)
 
     X = jnp.linspace(-1.0, 1.0, n_cases, endpoint=False)[:, None]
     y = X[:, 0] ** 4 + X[:, 0] ** 3 + X[:, 0] ** 2 + X[:, 0]
     case_weights = (-1.0,) * n_cases       # minimise every case error
 
     def case_errors(gs):
-        preds = jax.vmap(lambda g: interp(g, X))(gs)
+        preds = interp(gs, X)
         return jnp.abs(preds - y)          # [pop, cases]
 
     toolbox = Toolbox()

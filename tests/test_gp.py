@@ -74,6 +74,28 @@ def test_interpreter_protected_div(pset):
     assert got[0] == 1.0 and got[1] == 0.5
 
 
+def test_batch_interpreter_matches_single_tree(pset):
+    """The active-length-bounded batch path must agree exactly with the
+    full-width per-tree interpreter on a mixed-size population (the
+    dynamic trip count T=max(length) only skips padding slots)."""
+    gen = gp.gen_half_and_half(pset, MAX_LEN, 1, 4)
+    pop = [gen(jax.random.key(s)) for s in range(32)]
+    genomes = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *pop)
+    X = jnp.linspace(-2, 2, 17)[:, None]
+    single = gp.make_interpreter(pset, MAX_LEN)
+    batch = gp.make_batch_interpreter(pset, MAX_LEN)
+    want = jax.vmap(lambda g: single(g, X))(genomes)
+    got = batch(genomes, X)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6)
+    # and under jit with a different (smaller) max population length
+    tiny = jax.tree_util.tree_map(lambda a: a[:4], genomes)
+    got2 = jax.jit(batch)(tiny, X)
+    want2 = jax.vmap(lambda g: single(g, X))(tiny)
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(want2),
+                               rtol=1e-6)
+
+
 def test_subtree_end_matches_python_walk(pset):
     gen = gp.gen_half_and_half(pset, MAX_LEN, 2, 5)
     arity = pset.arity_table()
