@@ -49,7 +49,9 @@ ND_TILED_THRESHOLD = 8192
 
 
 def nd_rank(w: jnp.ndarray, max_rank: Optional[int] = None,
-            impl: str = "auto") -> jnp.ndarray:
+            impl: str = "auto", cover_k: Optional[int] = None,
+            fallback: str = "none",
+            return_peels: bool = False) -> jnp.ndarray:
     """Non-domination rank per row (0 = first front).
 
     Deb's fast non-dominated sort (emo.py:53-117) re-expressed as
@@ -65,9 +67,32 @@ def nd_rank(w: jnp.ndarray, max_rank: Optional[int] = None,
     ``max_rank`` stops peeling after that many fronts (the reference's
     sortNondominated ``k`` early-exit, emo.py:71-77); unpeeled rows keep
     rank ``n``.
+
+    Worst-case bounds — per-front peeling is O(fronts · n²) and front
+    count is data-dependent (a near-totally-ordered population
+    approaches n fronts, i.e. O(n³)); two escape hatches:
+
+    - ``cover_k``: stop peeling once at least ``cover_k`` rows are
+      ranked. EXACT for any top-k selection: unpeeled rows keep rank
+      ``n``, worse than every peeled rank, so a rank-then-crowding cut
+      at ``k ≤ cover_k`` never reaches them (sel_nsga2 passes its own
+      ``k``). Bounds work by the fronts needed to cover k.
+    - ``fallback='count'``: rows still unpeeled when the loop stops get
+      rank ``stop + (#dominators among the unpeeled)`` — Fonseca-Fleming
+      dominance-count ranking (MOGA), exact when the remainder is
+      totally ordered and order-consistent with true ranks otherwise
+      (a dominator's count is strictly smaller within any set). With
+      ``max_rank=B`` this caps total work at O(B · n²) while still
+      returning a full, well-ordered ranking.
+
+    ``return_peels=True`` additionally returns the number of fronts the
+    loop actually peeled (the data-dependent trip count) as an int32
+    scalar — the front-count statistic for profiling peel behaviour at
+    scale.
     """
     n = w.shape[0]
     stop = n if max_rank is None else min(max_rank, n)
+    covered_stop = n if cover_k is None else min(cover_k, n)
     if impl == "auto":
         # off-TPU the tiled kernel runs under the Pallas interpreter and
         # is slower than the matrix path, so 'auto' only switches on TPU
@@ -76,14 +101,20 @@ def nd_rank(w: jnp.ndarray, max_rank: Optional[int] = None,
     if impl == "tiled":
         from deap_tpu.ops.kernels import nd_rank_tiled
 
-        return nd_rank_tiled(w, max_rank)
+        return nd_rank_tiled(w, max_rank, cover_k=cover_k,
+                             fallback=fallback,
+                             return_peels=return_peels)
     if impl != "matrix":
         raise ValueError(f"unknown nd_rank impl {impl!r}")
+    if fallback not in ("none", "count"):
+        raise ValueError(f"unknown nd_rank fallback {fallback!r}")
     dom = dominance_matrix(w)  # [n, n] j dominates i
 
     def cond(state):
         ranks, current, remaining = state
-        return remaining.any() & (current < stop)
+        covered = n - jnp.sum(remaining)
+        return (remaining.any() & (current < stop)
+                & (covered < covered_stop))
 
     def body(state):
         ranks, current, remaining = state
@@ -92,10 +123,13 @@ def nd_rank(w: jnp.ndarray, max_rank: Optional[int] = None,
         ranks = jnp.where(front, current, ranks)
         return ranks, current + 1, remaining & ~front
 
-    ranks, _, _ = lax.while_loop(
+    ranks, current, remaining = lax.while_loop(
         cond, body,
         (jnp.full(n, n, jnp.int32), jnp.int32(0), jnp.ones(n, bool)))
-    return ranks
+    if fallback == "count":
+        ndom = jnp.sum(dom & remaining[None, :], axis=1).astype(jnp.int32)
+        ranks = jnp.where(remaining, current + ndom, ranks)
+    return (ranks, current) if return_peels else ranks
 
 
 def sort_nondominated(w: jnp.ndarray, k: int, first_front_only: bool = False):
@@ -142,14 +176,24 @@ def crowding_distances(w: jnp.ndarray, ranks: jnp.ndarray) -> jnp.ndarray:
 
 # ---------------------------------------------------------------- NSGA-II ----
 
-def sel_nsga2(key, w, k, nd: str = "standard"):
+def sel_nsga2(key, w, k, nd: str = "standard",
+              peel_budget: Optional[int] = None):
     """NSGA-II selection (emo.py:15-50): whole fronts in rank order, the
     last partial front by descending crowding distance.
 
     ``nd``: the reference's ``'standard'``/``'log'`` both map to
     ``nd_rank(impl='auto')`` (the log variant exists to cut Python
     constants the tensor kernels don't have); ``'matrix'``/``'tiled'``
-    force a specific nd-sort implementation."""
+    force a specific nd-sort implementation.
+
+    ``peel_budget`` caps the peel loop at that many fronts, ranking any
+    remainder by Fonseca-Fleming dominance counts (``nd_rank``'s
+    ``fallback='count'``). Default ``None`` is exact — already bounded
+    by the fronts needed to cover ``k`` rows (``cover_k``) — but a
+    pathological near-totally-ordered population can still need ~k
+    peels; the budget turns that O(k·n²) tail into O(budget·n²) at the
+    documented cost that a cut landing past the budget uses
+    count-ranks (dominance-consistent, not front-exact)."""
     del key
     if nd in ("matrix", "tiled"):
         impl = nd
@@ -157,19 +201,36 @@ def sel_nsga2(key, w, k, nd: str = "standard"):
         impl = "auto"
     else:
         raise ValueError(f"unknown nd sort {nd!r}")
-    ranks = nd_rank(w, impl=impl)
-    crowd = crowding_distances(w, ranks)
+    # cover_k bounds the peel loop by the fronts needed to cover k rows
+    # — exact: unpeeled rows keep rank n, and the cut never reaches them
+    ranks = nd_rank(w, impl=impl, cover_k=k, max_rank=peel_budget,
+                    fallback="none" if peel_budget is None else "count")
+    crowd = crowding_distances(w, jnp.minimum(ranks, w.shape[0]))
     order = jnp.lexsort((-crowd, ranks))
     return order[:k]
 
 
-def sel_tournament_dcd(key, w, k):
+def sel_tournament_dcd(key, w, k, peel_budget: Optional[int] = None):
     """Dominance/crowding binary tournament (emo.py:145-195): two random
     permutations supply pairs; dominance decides, then crowding, then a
     coin flip. Returns exactly ``k`` winners (the reference returns
-    ceil(k/4)*4)."""
+    ceil(k/4)*4).
+
+    Ranks are only consumed by the crowding computation (dominance is
+    compared directly per pair), so ``peel_budget`` — cap the nd-sort
+    at that many fronts — leaves winners on dominated pairs unaffected.
+    All rows still unpeeled at the budget are merged into ONE crowding
+    segment (rather than count-ranked fragments, which would make most
+    of them boundary rows with infinite crowding): crowding among the
+    tail is then a genuine density measure over the whole remainder,
+    and only the per-objective extremes get the boundary infinity."""
     n = w.shape[0]
-    ranks = nd_rank(w)
+    if peel_budget is None:
+        ranks = nd_rank(w)
+    else:
+        ranks, peels = nd_rank(w, max_rank=peel_budget,
+                               return_peels=True)
+        ranks = jnp.where(ranks >= peels, n, ranks)
     crowd = crowding_distances(w, ranks)
     k1, k2, kc = jax.random.split(key, 3)
     # ceil(k/2) pairs from each permutation stream, interleaved in the

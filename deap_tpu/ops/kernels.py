@@ -165,7 +165,10 @@ def strengths_tiled(w: jnp.ndarray, *, block_i: int = 256,
 
 def nd_rank_tiled(w: jnp.ndarray, max_fronts: Optional[int] = None, *,
                   block_i: int = 256, block_j: int = 512,
-                  interpret: Optional[bool] = None) -> jnp.ndarray:
+                  interpret: Optional[bool] = None,
+                  cover_k: Optional[int] = None,
+                  fallback: str = "none",
+                  return_peels: bool = False) -> jnp.ndarray:
     """Non-domination rank (0 = first front) by iterative front peeling,
     recomputing domination tile-wise each round instead of holding the
     [n, n] matrix resident (cf. emo.nd_rank, reference emo.py:53-117).
@@ -174,16 +177,25 @@ def nd_rank_tiled(w: jnp.ndarray, max_fronts: Optional[int] = None, *,
     O(n²) memory. Crossover point on one chip is around n ≈ 20-30k.
 
     ``max_fronts`` stops peeling early (emo.nd_rank's ``max_rank``);
-    unpeeled rows keep rank ``n``.
+    unpeeled rows keep rank ``n``.  ``cover_k`` / ``fallback='count'``
+    bound the data-dependent front count exactly as in emo.nd_rank:
+    stop once ``cover_k`` rows are ranked (exact for top-k selection),
+    and/or assign the unpeeled remainder Fonseca-Fleming
+    dominance-count ranks in one extra tile sweep.
     """
     n = w.shape[0]
     stop = n if max_fronts is None else min(max_fronts, n)
+    covered_stop = n if cover_k is None else min(cover_k, n)
+    if fallback not in ("none", "count"):
+        raise ValueError(f"unknown nd_rank fallback {fallback!r}")
     count = functools.partial(dominated_counts, block_i=block_i,
                               block_j=block_j, interpret=interpret)
 
     def cond(state):
         _, current, remaining = state
-        return remaining.any() & (current < stop)
+        covered = n - jnp.sum(remaining)
+        return (remaining.any() & (current < stop)
+                & (covered < covered_stop))
 
     def body(state):
         ranks, current, remaining = state
@@ -192,10 +204,13 @@ def nd_rank_tiled(w: jnp.ndarray, max_fronts: Optional[int] = None, *,
         ranks = jnp.where(front, current, ranks)
         return ranks, current + 1, remaining & ~front
 
-    ranks, _, _ = jax.lax.while_loop(
+    ranks, current, remaining = jax.lax.while_loop(
         cond, body,
         (jnp.full(n, n, jnp.int32), jnp.int32(0), jnp.ones(n, bool)))
-    return ranks
+    if fallback == "count":
+        ndom = count(w, remaining).astype(jnp.int32)
+        ranks = jnp.where(remaining, current + ndom, ranks)
+    return (ranks, current) if return_peels else ranks
 
 
 # ------------------------------------------------- fused bitstring varAnd ----

