@@ -61,16 +61,30 @@ class MovingPeaks:
     def nevals(self) -> int:
         return int(self.state.nevals)
 
+    def _peak_own_values(self):
+        """Each peak's value at its own position against itself only —
+        ``pfunc(pos, pos, h, w)`` like the reference (movingpeaks.py:
+        190, 204). Equal to the raw height for height-valued peak
+        functions (cone, function1) but NOT for sphere_peak, whose own
+        value is 0."""
+        import numpy as np
+
+        cfg, st = self.config, self.state
+        own = jax.vmap(lambda p, h, w: cfg.pfunc(
+            p, p[None, :], h[None], w[None])[0])(
+            st.position, st.height, st.width)
+        return np.asarray(own)
+
     def globalMaximum(self):
-        """(value, position) of the highest peak — the peak's *own*
-        value like the reference (movingpeaks.py:182-191), which
+        """(value, position) of the best peak by its *own* value
+        ``pfunc(pos, pos, h, w)`` (movingpeaks.py:182-191), which
         ignores basis/neighbour interference here."""
         import numpy as np
 
-        h = np.asarray(self.state.height)
-        i = int(h.argmax())
+        own = self._peak_own_values()
+        i = int(own.argmax())
         pos = np.asarray(self.state.position)[i]
-        return float(h[i]), [float(v) for v in pos]
+        return float(own[i]), [float(v) for v in pos]
 
     def maximums(self):
         """All *visible* peaks as (own value, position), global maximum
@@ -81,10 +95,10 @@ class MovingPeaks:
 
         land, poss = _maximums(self.config, self.state)
         land = np.asarray(land)
-        h = np.asarray(self.state.height)
+        own = self._peak_own_values()
         poss = np.asarray(poss)
-        out = [(float(h[i]), [float(v) for v in poss[i]])
-               for i in range(len(h)) if h[i] >= land[i] - 1e-5]
+        out = [(float(own[i]), [float(v) for v in poss[i]])
+               for i in range(len(own)) if own[i] >= land[i] - 1e-5]
         return sorted(out, reverse=True)
 
     def __call__(self, individual, count: bool = True):

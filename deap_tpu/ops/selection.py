@@ -121,6 +121,23 @@ def sel_tournament_binned(key, w, k, tournsize, low: int, high: int):
     permutation is bit-identical), with the full lexsort replaced by
     :func:`counting_order_desc`. ``w`` is ``[n, 1]`` weighted values
     taking integer values in ``[low, high]``."""
+    if not isinstance(w, jax.core.Tracer) and w.shape[0]:
+        # counting_order_desc silently clips out-of-range values and
+        # rounds non-integers into edge buckets — a misranking with no
+        # signal. When called outside jit (values concrete), validate
+        # loudly; the reductions run on device and only three scalars
+        # cross to the host (an eager caller is sync-bound anyway).
+        v = w[:, 0]
+        mn, mx = float(v.min()), float(v.max())
+        if mn < low or mx > high:
+            raise ValueError(
+                f"sel_tournament_binned: fitness values span "
+                f"[{mn}, {mx}], outside the declared integer "
+                f"range [{low}, {high}]")
+        if not bool(jnp.all(jnp.abs(v - jnp.round(v)) <= 1e-6)):
+            raise ValueError(
+                "sel_tournament_binned: fitness values are not "
+                "integer-valued; the counting sort would misrank them")
     order = counting_order_desc(w[:, 0], low, high)
     ranks = jax.random.randint(key, (tournsize, k), 0, w.shape[0])
     return jnp.take(order, jnp.min(ranks, axis=0))

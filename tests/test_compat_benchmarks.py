@@ -135,3 +135,23 @@ def test_movingpeaks_per_eval_granularity():
 
     mp.changePeaks()
     assert mp.currentError() == float("inf")
+
+
+def test_movingpeaks_global_maximum_uses_peak_own_value():
+    """globalMaximum/maximums must report pfunc(pos, pos, h, w) — the
+    peak's own value (ref movingpeaks.py:190, 204) — not the raw
+    height. sphere_peak's own value is 0 regardless of height, the
+    case a height shortcut gets wrong."""
+    mp = benchmarks.movingpeaks.MovingPeaks(
+        dim=2, seed=1, npeaks=4,
+        pfunc=benchmarks.movingpeaks.sphere_peak)
+    val, pos = mp.globalMaximum()
+    assert val == pytest.approx(0.0, abs=1e-6)
+    assert all(v == pytest.approx(0.0, abs=1e-6)
+               for v, _ in mp.maximums())
+    # cone: own value == height, so the shortcut and the real thing
+    # agree — pin that the value still matches the raw height there
+    mp2 = benchmarks.movingpeaks.MovingPeaks(
+        dim=2, seed=1, npeaks=4, pfunc=benchmarks.movingpeaks.cone)
+    val2, _ = mp2.globalMaximum()
+    assert val2 == pytest.approx(float(np.asarray(mp2.state.height).max()))

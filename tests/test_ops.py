@@ -305,3 +305,11 @@ def test_sel_tournament_binned_matches_sorted_exactly():
     a = sel_tournament_sorted(ksel, w, 300, tournsize=3)
     b = sel_tournament_binned(ksel, w, 300, tournsize=3, low=0, high=100)
     assert (np.asarray(a) == np.asarray(b)).all()
+
+    # contract violations fail loudly when values are concrete
+    # (inside jit they would be silently clipped into edge buckets)
+    with pytest.raises(ValueError, match="outside the declared"):
+        sel_tournament_binned(ksel, w, 300, tournsize=3, low=0, high=50)
+    with pytest.raises(ValueError, match="not integer-valued"):
+        sel_tournament_binned(ksel, w + 0.5, 300, tournsize=3,
+                              low=0, high=101)

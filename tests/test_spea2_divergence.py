@@ -50,6 +50,7 @@ def ref_tools():
     as test_stream_parity)."""
     import test_stream_parity as tsp
 
+    tsp.require_vetted_reference()
     marker = SCRATCH / ".converted"
     fingerprint = tsp._ref_fingerprint()
     if not (marker.exists() and marker.read_text() == fingerprint):

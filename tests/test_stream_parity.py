@@ -44,9 +44,43 @@ def _ref_fingerprint() -> str:
     return hashlib.sha256("\n".join(parts).encode()).hexdigest()
 
 
+#: content-hash allowlist: importing the reference executes its
+#: module-level code inside the test process, so only a vetted tree may
+#: run. Regenerate with ``python -c "import test_stream_parity as t;
+#: print(t._ref_content_hash())"`` after reviewing the new tree.
+ALLOWLIST = pathlib.Path(__file__).parent / "ref_fingerprint.txt"
+
+
+def _ref_content_hash() -> str:
+    """Order-stable sha256 over the reference tree's .py contents
+    (mtime-free, unlike :func:`_ref_fingerprint`, so it survives
+    re-checkouts)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for p in sorted(REF.rglob("*.py")):
+        h.update(str(p.relative_to(REF)).encode())
+        h.update(b"\0")
+        h.update(p.read_bytes())
+    return h.hexdigest()
+
+
+def require_vetted_reference():
+    """Skip (refuse to execute) unless the reference tree's content
+    hash matches the committed allowlist."""
+    if not ALLOWLIST.exists():
+        pytest.skip("tests/ref_fingerprint.txt missing — vet the "
+                    "reference tree, then commit its content hash")
+    if _ref_content_hash() != ALLOWLIST.read_text().strip():
+        pytest.skip("reference tree content changed since it was "
+                    "vetted; refusing to import/execute it. Review "
+                    "the tree and update tests/ref_fingerprint.txt")
+
+
 @pytest.fixture(scope="module")
 def ref():
     """Import the 2to3-converted reference's base/tools modules."""
+    require_vetted_reference()
     marker = SCRATCH / ".converted"
     fingerprint = _ref_fingerprint()
     if not (marker.exists() and marker.read_text() == fingerprint):
