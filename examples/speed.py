@@ -3,18 +3,24 @@ historical timing harness (examples/speed.txt is its program list;
 SURVEY.md §4.5).
 
 Runs each program's ``main(smoke=True)`` and prints one JSON line per
-program: ``{"example": ..., "seconds": ..., "ok": ...}``. Pass
-``--full`` for the real (non-smoke) configurations.
+program: ``{"example": ..., "seconds": ..., "quality": ..., "ok":
+...}`` — ``quality`` is whatever scalar the program's ``main``
+returns (MSE, front size, best fitness...; see each program's
+docstring for its meaning). Pass ``--full`` for the real (non-smoke)
+configurations.
 
 Usage::
 
-    python examples/speed.py [--full] [--cpu] [pattern]
+    python examples/speed.py [--full] [--cpu] [--report PATH] [pattern]
 
 ``--cpu`` forces the CPU backend (the environment's TPU plugin pins
 ``jax_platforms``, and a wedged tunnel hangs jax init — see bench.py's
-probe; this flag is the manual override).
+probe; this flag is the manual override). ``--report PATH`` writes the
+aggregate run as one JSON document — ``examples/ZOO_REPORT.json`` is
+the committed artifact of the latest full-zoo validation.
 """
 
+import datetime
 import importlib
 import json
 import pathlib
@@ -43,27 +49,59 @@ def main(argv=None):
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    report_path = None
+    if "--report" in argv:
+        i = argv.index("--report")
+        if i + 1 >= len(argv):
+            sys.exit("usage: speed.py [--full] [--cpu] "
+                     "[--report PATH] [pattern] — --report needs a path")
+        report_path = pathlib.Path(argv[i + 1])
+        del argv[i:i + 2]
     pattern = argv[0] if argv else ""
 
     root = pathlib.Path(__file__).resolve().parent.parent
     if str(root) not in sys.path:
         sys.path.insert(0, str(root))
 
+    results = []
     for name in discover():
         if pattern and pattern not in name:
             continue
         t0 = time.perf_counter()
         ok = True
+        quality = None
         try:
             mod = importlib.import_module(name)
-            mod.main(smoke=not full)
+            out = mod.main(smoke=not full)
+            if isinstance(out, (int, float)):
+                quality = round(float(out), 6)
         except Exception as e:  # keep timing the rest
             ok = f"{type(e).__name__}: {e}"
-        print(json.dumps({
+        rec = {
             "example": name,
+            "config": "full" if full else "smoke",
             "seconds": round(time.perf_counter() - t0, 2),
+            "quality": quality,
             "ok": ok,
-        }), flush=True)
+        }
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    if report_path is not None:
+        import jax
+
+        n_ok = sum(1 for r in results if r["ok"] is True)
+        report = {
+            "date": datetime.date.today().isoformat(),
+            "mode": "full" if full else "smoke",
+            "backend": jax.default_backend(),
+            "passed": n_ok,
+            "total": len(results),
+            "results": results,
+        }
+        report_path.write_text(json.dumps(report, indent=1) + "\n")
+        print(f"report: {report_path} ({n_ok}/{len(results)} ok)",
+              flush=True)
 
 
 if __name__ == "__main__":
