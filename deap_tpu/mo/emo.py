@@ -77,13 +77,16 @@ def nd_rank(w: jnp.ndarray, max_rank: Optional[int] = None,
       ``n``, worse than every peeled rank, so a rank-then-crowding cut
       at ``k ≤ cover_k`` never reaches them (sel_nsga2 passes its own
       ``k``). Bounds work by the fronts needed to cover k.
-    - ``fallback='count'``: rows still unpeeled when the loop stops get
-      rank ``stop + (#dominators among the unpeeled)`` — Fonseca-Fleming
-      dominance-count ranking (MOGA), exact when the remainder is
-      totally ordered and order-consistent with true ranks otherwise
-      (a dominator's count is strictly smaller within any set). With
-      ``max_rank=B`` this caps total work at O(B · n²) while still
-      returning a full, well-ordered ranking.
+    - ``fallback='count'``: rows still unpeeled when the loop stops ON
+      THE ``max_rank`` BUDGET get rank ``stop + (#dominators among the
+      unpeeled)`` — Fonseca-Fleming dominance-count ranking (MOGA),
+      exact when the remainder is totally ordered and order-consistent
+      with true ranks otherwise (a dominator's count is strictly
+      smaller within any set). With ``max_rank=B`` this caps total
+      work at O(B · n²) while still returning a full, well-ordered
+      ranking. After a ``cover_k`` stop or a complete peel the sweep
+      is skipped (its result could never be consumed) and unpeeled
+      rows keep the rank-``n`` sentinel.
 
     ``return_peels=True`` additionally returns the number of fronts the
     loop actually peeled (the data-dependent trip count) as an int32
@@ -127,8 +130,16 @@ def nd_rank(w: jnp.ndarray, max_rank: Optional[int] = None,
         cond, body,
         (jnp.full(n, n, jnp.int32), jnp.int32(0), jnp.ones(n, bool)))
     if fallback == "count":
-        ndom = jnp.sum(dom & remaining[None, :], axis=1).astype(jnp.int32)
-        ranks = jnp.where(remaining, current + ndom, ranks)
+        # only when the loop stopped on the peel budget with rows left
+        # — a cover_k stop or a complete peel never consumes the
+        # count-ranks, so skip the extra O(n²) sweep there
+        def count_rank(ranks):
+            ndom = jnp.sum(dom & remaining[None, :],
+                           axis=1).astype(jnp.int32)
+            return jnp.where(remaining, current + ndom, ranks)
+
+        ranks = lax.cond(remaining.any() & (current >= stop),
+                         count_rank, lambda r: r, ranks)
     return (ranks, current) if return_peels else ranks
 
 
@@ -219,18 +230,14 @@ def sel_tournament_dcd(key, w, k, peel_budget: Optional[int] = None):
     Ranks are only consumed by the crowding computation (dominance is
     compared directly per pair), so ``peel_budget`` — cap the nd-sort
     at that many fronts — leaves winners on dominated pairs unaffected.
-    All rows still unpeeled at the budget are merged into ONE crowding
-    segment (rather than count-ranked fragments, which would make most
-    of them boundary rows with infinite crowding): crowding among the
-    tail is then a genuine density measure over the whole remainder,
-    and only the per-objective extremes get the boundary infinity."""
+    All rows still unpeeled at the budget share the rank-``n`` sentinel
+    and therefore form ONE crowding segment: crowding among the tail
+    stays a genuine density measure over the whole remainder, with
+    only the per-objective extremes getting the boundary infinity."""
     n = w.shape[0]
-    if peel_budget is None:
-        ranks = nd_rank(w)
-    else:
-        ranks, peels = nd_rank(w, max_rank=peel_budget,
-                               return_peels=True)
-        ranks = jnp.where(ranks >= peels, n, ranks)
+    # past-budget rows keep the rank-n sentinel, i.e. they form one
+    # crowding segment
+    ranks = nd_rank(w, max_rank=peel_budget)
     crowd = crowding_distances(w, ranks)
     k1, k2, kc = jax.random.split(key, 3)
     # ceil(k/2) pairs from each permutation stream, interleaved in the

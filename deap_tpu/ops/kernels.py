@@ -208,8 +208,16 @@ def nd_rank_tiled(w: jnp.ndarray, max_fronts: Optional[int] = None, *,
         cond, body,
         (jnp.full(n, n, jnp.int32), jnp.int32(0), jnp.ones(n, bool)))
     if fallback == "count":
-        ndom = count(w, remaining).astype(jnp.int32)
-        ranks = jnp.where(remaining, current + ndom, ranks)
+        # only on a genuine budget stop (see emo.nd_rank): a cover_k
+        # stop or complete peel never consumes the count-ranks, and
+        # this sweep is a full O(n²·m) pass at the sizes this kernel
+        # targets
+        def count_rank(ranks):
+            ndom = count(w, remaining).astype(jnp.int32)
+            return jnp.where(remaining, current + ndom, ranks)
+
+        ranks = jax.lax.cond(remaining.any() & (current >= stop),
+                             count_rank, lambda r: r, ranks)
     return (ranks, current) if return_peels else ranks
 
 
