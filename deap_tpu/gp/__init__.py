@@ -50,6 +50,7 @@ from deap_tpu.gp.adf import (
     branch_wise_cx,
     branch_wise_mut,
     make_adf_generator,
+    make_adf_batch_interpreter,
     make_adf_interpreter,
 )
 from deap_tpu.gp.semantic import (
@@ -71,6 +72,7 @@ __all__ = [
     "make_mut_insert_typed",
     "make_mut_shrink_typed",
     "spam_set",
+    "make_adf_batch_interpreter",
     "make_adf_interpreter",
     "make_adf_generator",
     "branch_wise_cx",

@@ -31,10 +31,13 @@ Branches = Sequence[Tuple[PrimitiveSet, int]]   # [(pset, max_len), ...]
 
 
 def _build_branch(pset: PrimitiveSet, max_len: int, branch_idx: int,
-                  interps: dict) -> Callable:
+                  interps: dict, max_actives=None) -> Callable:
     """interp(genomes, X) for one branch; ADF nodes dispatch into
-    ``interps`` (already built for every branch index > branch_idx)."""
+    ``interps`` (already built for every branch index > branch_idx).
+    ``max_actives[i]`` optionally bounds branch *i*'s passes to its
+    population's largest live prefix (gp/interpreter.py contract)."""
     prims = list(pset.primitives)
+    ma = None if max_actives is None else max_actives[branch_idx]
 
     def interpret(genomes, X):
         # the shared two-pass core (gp/interpreter.py run_data_pass);
@@ -51,16 +54,12 @@ def _build_branch(pset: PrimitiveSet, max_len: int, branch_idx: int,
             return rows
 
         return run_data_pass(pset, max_len, genomes[branch_idx], X,
-                             prim_rows)
+                             prim_rows, max_active=ma)
 
     return interpret
 
 
-def make_adf_interpreter(branches: Branches) -> Callable:
-    """Build ``evaluate(genomes, X) -> f32[points]`` over a multi-branch
-    individual. ``branches[0]`` is MAIN (compileADF's ``func``,
-    gp.py:508-513); branch *i* may contain ``add_adf(..., branch=j)``
-    nodes only for ``j > i``."""
+def _validate_branches(branches: Branches) -> None:
     for i, (pset, _) in enumerate(branches):
         for p in pset.primitives:
             if p.adf is None:
@@ -80,11 +79,43 @@ def make_adf_interpreter(branches: Branches) -> Callable:
                     f"ADF call {p.name!r} passes {p.arity} operands but "
                     f"branch {p.adf} ({callee.name!r}) takes "
                     f"{callee.n_args} arguments")
+
+
+def _link_branches(branches: Branches, max_actives=None) -> Callable:
     interps: dict = {}
     for i in reversed(range(len(branches))):
         pset, max_len = branches[i]
-        interps[i] = _build_branch(pset, max_len, i, interps)
+        interps[i] = _build_branch(pset, max_len, i, interps, max_actives)
     return interps[0]
+
+
+def make_adf_interpreter(branches: Branches) -> Callable:
+    """Build ``evaluate(genomes, X) -> f32[points]`` over a multi-branch
+    individual. ``branches[0]`` is MAIN (compileADF's ``func``,
+    gp.py:508-513); branch *i* may contain ``add_adf(..., branch=j)``
+    nodes only for ``j > i``."""
+    _validate_branches(branches)
+    return _link_branches(branches)
+
+
+def make_adf_batch_interpreter(branches: Branches) -> Callable:
+    """``interpret(genomes, X) -> f32[pop, points]`` over a population
+    of multi-branch individuals (a tuple of stacked branch genomes) —
+    the ADF analog of ``gp.make_batch_interpreter``: every branch's
+    passes are bounded to that branch's population-max live prefix
+    ``T_i = max(length_i)``, closed over the vmapped call so the
+    bounds stay unbatched (batch-uniform writes)."""
+    _validate_branches(branches)
+
+    def interpret_batch(genomes, X):
+        Ts = tuple(
+            jnp.clip(jnp.max(g["length"]), 1,
+                     min(g["nodes"].shape[-1], ml)).astype(jnp.int32)
+            for g, (_, ml) in zip(genomes, branches))
+        main = _link_branches(branches, Ts)
+        return jax.vmap(lambda gt: main(gt, X))(genomes)
+
+    return interpret_batch
 
 
 def make_adf_generator(branches: Branches, min_depth: int, max_depth: int,

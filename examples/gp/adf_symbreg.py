@@ -38,7 +38,7 @@ def main(smoke: bool = False):
     n, ngen = (200, 25) if not smoke else (50, 5)
     branches = build_branches()
     gen = gp.make_adf_generator(branches, 1, 2)
-    interp = gp.make_adf_interpreter(branches)
+    interp = gp.make_adf_batch_interpreter(branches)
     cx = gp.branch_wise_cx([gp.make_cx_one_point(ps) for ps, _ in branches])
     mut = gp.branch_wise_mut([
         gp.make_mut_uniform(ps, gp.make_generator(ps, 16, 0, 2, "full"))
@@ -48,8 +48,8 @@ def main(smoke: bool = False):
     y = X[:, 0] ** 4 + X[:, 0] ** 3 + X[:, 0] ** 2 + X[:, 0]
 
     toolbox = Toolbox()
-    toolbox.register("evaluate", lambda gs: -jax.vmap(
-        lambda g: jnp.mean((interp(g, X) - y) ** 2))(gs))
+    toolbox.register("evaluate",
+                     lambda gs: -jnp.mean((interp(gs, X) - y) ** 2, -1))
     toolbox.register("mate", cx)
     toolbox.register("mutate", mut)
     toolbox.register("select", ops.sel_tournament, tournsize=3)
