@@ -4,14 +4,24 @@ Counterpart of /root/reference/examples/ga/tsp.py (PMX crossover +
 index-shuffle mutation over permutation individuals; the reference
 loads a gr17/gr24 TSPLIB distance matrix from examples/ga/tsp/*.json).
 
-Instead of vendoring TSPLIB data, the instance here is synthetic with a
-*provable* optimum: cities in convex position (a circle with jittered
-angles). For points in convex position the optimal tour is exactly the
-cyclic hull order, so the optimal length is computable in closed form —
-which makes solution quality measurable (gap-to-optimum) the way the
-reference's known gr17 optimum (2085) did, with zero licensing
-questions. See examples/README.md "Datasets".
+Instead of vendoring TSPLIB data, the default instance is synthetic
+with a *provable* optimum: cities in convex position (a circle with
+jittered angles). For points in convex position the optimal tour is
+exactly the cyclic hull order, so the optimal length is computable in
+closed form — which makes solution quality measurable (gap-to-optimum)
+the way the reference's known gr17 optimum (2085) did, with zero
+licensing questions. See examples/README.md "Datasets".
+
+For a *direct* quality comparison against the reference, point
+``main(instance=...)`` (or ``DEAP_TPU_TSP_INSTANCE``) at a
+reference-format instance file — a JSON dict with ``DistanceMatrix``
+and optionally ``OptDistance``/``TourSize``, the exact schema of the
+reference's ``examples/ga/tsp/gr*.json`` — and the run reports the
+gap against that instance's known optimum instead.
 """
+
+import json
+import os
 
 import jax
 import jax.numpy as jnp
@@ -35,9 +45,28 @@ def convex_instance(n_cities: int, seed: int = 42):
     return cities, dist, optimum
 
 
-def main(smoke: bool = False, n_cities: int = 24):
+def load_instance(path: str):
+    """A reference-format TSP instance (gr17/gr24 JSON schema): returns
+    (dist, optimum_or_None). The matrix is used as-is; ``OptDistance``
+    (2085 for gr17) becomes the quality anchor when present."""
+    with open(path) as f:
+        data = json.load(f)
+    dist = jnp.asarray(data["DistanceMatrix"], jnp.float32)
+    opt = data.get("OptDistance")
+    return dist, None if opt is None else float(opt)
+
+
+def main(smoke: bool = False, n_cities: int = 24,
+         instance: str | None = None):
     n, ngen = (300, 120) if not smoke else (60, 15)
-    _, dist, optimum = convex_instance(n_cities)
+    instance = instance or os.environ.get("DEAP_TPU_TSP_INSTANCE")
+    if instance:
+        dist, optimum = load_instance(instance)
+        n_cities = dist.shape[0]
+        if optimum is None:
+            optimum = float("nan")
+    else:
+        _, dist, optimum = convex_instance(n_cities)
 
     def tour_length(perm):
         return dist[perm, jnp.roll(perm, -1)].sum()

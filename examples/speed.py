@@ -44,18 +44,31 @@ def main(argv=None):
     full = "--full" in argv
     if full:
         argv.remove("--full")
-    if "--cpu" in argv:
+    force_cpu = "--cpu" in argv
+    if force_cpu:
         argv.remove("--cpu")
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    isolate = "--isolate" in argv
+    if isolate:
+        argv.remove("--isolate")
     report_path = None
     if "--report" in argv:
         i = argv.index("--report")
         if i + 1 >= len(argv):
-            sys.exit("usage: speed.py [--full] [--cpu] "
+            sys.exit("usage: speed.py [--full] [--cpu] [--isolate] "
                      "[--report PATH] [pattern] — --report needs a path")
         report_path = pathlib.Path(argv[i + 1])
+        del argv[i:i + 2]
+    only = None
+    if "--only" in argv:                  # exact-match (subprocess mode)
+        i = argv.index("--only")
+        if i + 1 >= len(argv):
+            sys.exit("usage: speed.py [--full] [--cpu] [--isolate] "
+                     "[--report PATH] [--only NAME] [pattern] — "
+                     "--only needs a module name")
+        only = argv[i + 1]
         del argv[i:i + 2]
     pattern = argv[0] if argv else ""
 
@@ -63,45 +76,112 @@ def main(argv=None):
     if str(root) not in sys.path:
         sys.path.insert(0, str(root))
 
-    results = []
-    for name in discover():
-        if pattern and pattern not in name:
-            continue
-        t0 = time.perf_counter()
-        ok = True
-        quality = None
-        try:
-            mod = importlib.import_module(name)
-            out = mod.main(smoke=not full)
-            if isinstance(out, (int, float)):
-                quality = round(float(out), 6)
-        except Exception as e:  # keep timing the rest
-            ok = f"{type(e).__name__}: {e}"
-        rec = {
-            "example": name,
-            "config": "full" if full else "smoke",
-            "seconds": round(time.perf_counter() - t0, 2),
-            "quality": quality,
-            "ok": ok,
-        }
-        results.append(rec)
-        print(json.dumps(rec), flush=True)
-
-    if report_path is not None:
-        import jax
-
+    def write_report(results):
+        # rewritten after every program: a crash partway (one process
+        # accumulating 50+ XLA programs can exhaust compile memory)
+        # still leaves a valid partial artifact. The backend comes
+        # from the per-program records — the driver must NOT import
+        # jax in --isolate mode (initialising a backend in the parent
+        # would contend with the children on a single-client TPU).
         n_ok = sum(1 for r in results if r["ok"] is True)
+        backends = sorted({r["backend"] for r in results
+                           if r.get("backend")})
         report = {
             "date": datetime.date.today().isoformat(),
             "mode": "full" if full else "smoke",
-            "backend": jax.default_backend(),
+            "backend": backends[0] if len(backends) == 1 else backends,
             "passed": n_ok,
             "total": len(results),
             "results": results,
         }
         report_path.write_text(json.dumps(report, indent=1) + "\n")
+        return n_ok
+
+    results = []
+    for name in discover():
+        if only is not None and name != only:
+            continue
+        if pattern and pattern not in name:
+            continue
+        if isolate:
+            rec = _run_isolated(name, full, force_cpu)
+        else:
+            t0 = time.perf_counter()
+            ok = True
+            quality = None
+            try:
+                mod = importlib.import_module(name)
+                out = mod.main(smoke=not full)
+                if isinstance(out, (int, float)):
+                    quality = round(float(out), 6)
+            except Exception as e:  # keep timing the rest
+                ok = f"{type(e).__name__}: {e}"
+            import jax
+
+            rec = {
+                "example": name,
+                "config": "full" if full else "smoke",
+                "seconds": round(time.perf_counter() - t0, 2),
+                "quality": quality,
+                "ok": ok,
+                "backend": jax.default_backend(),
+            }
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+        if report_path is not None:
+            write_report(results)
+
+    if report_path is not None:
+        n_ok = write_report(results)
         print(f"report: {report_path} ({n_ok}/{len(results)} ok)",
               flush=True)
+
+
+def _run_isolated(name: str, full: bool, force_cpu: bool) -> dict:
+    """Run one program in a fresh subprocess (own jax/XLA arena) and
+    parse the single JSON line it prints — process isolation for long
+    sweeps where one resident process would accumulate every example's
+    compiled programs."""
+    import subprocess
+
+    args = [sys.executable, str(pathlib.Path(__file__).resolve())]
+    if full:
+        args.append("--full")
+    if force_cpu:
+        args.append("--cpu")
+    args += ["--only", name]
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(args, capture_output=True, text=True,
+                              timeout=3600)
+    except subprocess.TimeoutExpired:
+        # record the hang and keep sweeping — the whole point of
+        # isolation is that one stuck program can't kill the report
+        return {
+            "example": name,
+            "config": "full" if full else "smoke",
+            "seconds": round(time.perf_counter() - t0, 2),
+            "quality": None,
+            "ok": "subprocess timeout (3600s)",
+            "backend": None,
+        }
+    for line in proc.stdout.splitlines():
+        try:
+            rec = json.loads(line)
+            if rec.get("example") == name:
+                return rec
+        except (ValueError, AttributeError):
+            continue
+    err_lines = proc.stderr.strip().splitlines()
+    last_err = err_lines[-1] if err_lines else "no output"
+    return {
+        "example": name,
+        "config": "full" if full else "smoke",
+        "seconds": round(time.perf_counter() - t0, 2),
+        "quality": None,
+        "ok": f"subprocess rc={proc.returncode}: {last_err}",
+        "backend": None,
+    }
 
 
 if __name__ == "__main__":
