@@ -136,29 +136,41 @@ def subtree_end(nodes: jnp.ndarray, arity: jnp.ndarray,
     return jnp.argmax(closed) + 1
 
 
+def prefix_depths(nodes: jnp.ndarray, length, arity: jnp.ndarray
+                  ) -> jnp.ndarray:
+    """Depth of every slot (root 0; garbage past ``length``) in closed
+    form — no serial walk.
+
+    In prefix order, the ancestors of slot ``j`` are exactly the slots
+    ``i ≤ j`` whose subtree interval ``[i, end_i)`` contains ``j``, so
+    ``depth[j] = #{i ≤ j : end_i > j} − 1`` (the −1 removes ``j``'s own
+    interval). All ``end_i`` share one arity cumsum (the
+    :func:`subtree_end` walk): ``end_i`` is the first ``j ≥ i`` where
+    ``cs[j] == cs[i−1] − 1``. One [L, L] mask instead of an L-step
+    scan — the VPU-shaped formulation of the reference's depth stack
+    (gp.py:155-166)."""
+    L = nodes.shape[0]
+    deficit = arity[nodes] - 1
+    cs = jnp.cumsum(jnp.where(jnp.arange(L) < length, deficit, 0))
+    prev = jnp.concatenate([jnp.zeros(1, cs.dtype), cs[:-1]])  # cs[i-1]
+    j = jnp.arange(L)
+    # closed[i, j]: subtree rooted at i has closed by slot j (inclusive)
+    closed = (cs[None, :] == (prev[:, None] - 1)) & (j[None, :] >= j[:, None])
+    ends = jnp.argmax(closed, axis=1) + 1            # end_i, exclusive
+    ancestors = (j[:, None] <= j[None, :]) & (ends[:, None] > j[None, :])
+    return jnp.sum(ancestors, axis=0).astype(jnp.int32) - 1
+
+
 def tree_height(genome: Genome, pset: PrimitiveSet) -> jnp.ndarray:
     """Tree height (root at 0), the measure of staticLimit/height
-    (gp.py:155-166). Prefix-walk with a depth stack."""
+    (gp.py:155-166) — max over :func:`prefix_depths` of the live
+    prefix (one [L, L] mask op; the depth-stack walk it replaces cost
+    an L-step serial scan per tree)."""
     arity = pset.arity_table()
     nodes, length = genome["nodes"], genome["length"]
-    L = nodes.shape[0]
-
-    def step(carry, t):
-        stack, sp, height = carry
-        pending = t < length
-        d = stack[jnp.maximum(sp - 1, 0)]
-        sp_pop = sp - 1
-        ar = arity[nodes[t]]
-        idx = jnp.arange(L + 1)
-        push = (idx >= sp_pop) & (idx < sp_pop + ar)
-        stack = jnp.where(pending & push, d + 1, stack)
-        sp = jnp.where(pending, sp_pop + ar, sp)
-        height = jnp.where(pending, jnp.maximum(height, d), height)
-        return (stack, sp, height), None
-
-    init = (jnp.zeros((L + 1,), jnp.int32), jnp.int32(1), jnp.int32(0))
-    (_, _, height), _ = lax.scan(step, init, jnp.arange(L))
-    return height
+    depths = prefix_depths(nodes, length, arity)
+    live = jnp.arange(nodes.shape[0]) < length
+    return jnp.max(jnp.where(live, depths, 0)).astype(jnp.int32)
 
 
 def _splice(g: Genome, begin, end, donor_nodes, donor_consts, donor_begin,

@@ -74,6 +74,49 @@ def test_interpreter_protected_div(pset):
     assert got[0] == 1.0 and got[1] == 0.5
 
 
+def test_prefix_depths_match_python_walk(pset):
+    """The closed-form ancestor-count depths (gp.tree.prefix_depths,
+    which tree_height now reduces over) must match a direct recursive
+    walk of the prefix."""
+    from deap_tpu.gp.tree import prefix_depths
+
+    arity_np = np.asarray(pset.arity_table())
+    gen = gp.gen_half_and_half(pset, MAX_LEN, 1, 5)
+    for seed in range(12):
+        g = gen(jax.random.key(seed))
+        nodes = np.asarray(g["nodes"])
+        length = int(g["length"])
+
+        depths = np.zeros(length, np.int32)
+
+        def walk(i, d):
+            depths[i] = d
+            j = i + 1
+            for _ in range(arity_np[nodes[i]]):
+                j = walk(j, d + 1)
+            return j
+
+        end = walk(0, 0)
+        assert end == length
+        got = np.asarray(prefix_depths(
+            g["nodes"], g["length"], pset.arity_table()))[:length]
+        np.testing.assert_array_equal(got, depths)
+        assert int(gp.tree_height(g, pset)) == int(depths.max())
+
+
+def test_sweep_interpreter_matches_scan(pset):
+    """mode='sweep' (level-synchronous evaluation) must agree exactly
+    with the serial scan path on a mixed-size population."""
+    gen = gp.gen_half_and_half(pset, MAX_LEN, 1, 4)
+    pop = [gen(jax.random.key(s)) for s in range(24)]
+    genomes = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *pop)
+    X = jnp.linspace(-2, 2, 13)[:, None]
+    scan = gp.make_batch_interpreter(pset, MAX_LEN, mode="scan")
+    sweep = gp.make_batch_interpreter(pset, MAX_LEN, mode="sweep")
+    np.testing.assert_allclose(np.asarray(sweep(genomes, X)),
+                               np.asarray(scan(genomes, X)), rtol=1e-6)
+
+
 def test_batch_interpreter_matches_single_tree(pset):
     """The active-length-bounded batch path must agree exactly with the
     full-width per-tree interpreter on a mixed-size population (the
