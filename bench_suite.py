@@ -44,6 +44,8 @@ from /tmp scratch, timed generations after warmup — mean of 3 (mean of
 """
 
 import json
+import os
+import sys
 
 # reuse bench.py's axon-tunnel probe + platform forcing side effects
 import bench  # noqa: F401  (must precede jax import)
@@ -301,29 +303,99 @@ def bench_gp_symbreg():
     return _time(run, pop)
 
 
-def main():
-    backend = jax.default_backend()
-    for name, fn in [
-        ("cmaes_n100_lam4096", bench_cmaes),
-        ("nsga2_zdt1_pop2000", bench_nsga2),
-        ("rastrigin_n30_pop100k", bench_rastrigin),
-        ("gp_symbreg_pop4096_pts256", bench_gp_symbreg),
-        ("nsga2_zdt1_pop50k", bench_nsga2_50k),
-        ("cartpole_neuro_pop10k", bench_cartpole),
-    ]:
-        gps = fn()
-        ref = REF[name]
-        line = {
-            "metric": f"{name}_generations_per_sec",
-            "value": round(gps, 2),
-            "unit": "gens/sec",
-            "vs_baseline": round(gps / ref, 1) if ref else None,
-            "backend": backend,
-        }
-        if name in EXTRAPOLATED:
-            line["ref_extrapolated"] = True
+# cmaes runs LAST: its scan-of-eigh is the largest compile shipped
+# through the axon tunnel and the prime suspect for the 2026-07-31
+# relay wedge (the suite froze inside bench_cmaes with the relay ports
+# still accepting TCP) — everything cheaper must land first.
+CONFIGS = [
+    ("nsga2_zdt1_pop2000", bench_nsga2),
+    ("rastrigin_n30_pop100k", bench_rastrigin),
+    ("gp_symbreg_pop4096_pts256", bench_gp_symbreg),
+    ("nsga2_zdt1_pop50k", bench_nsga2_50k),
+    ("cartpole_neuro_pop10k", bench_cartpole),
+    ("cmaes_n100_lam4096", bench_cmaes),
+]
+
+
+def run_one(name: str) -> dict:
+    fn = dict(CONFIGS)[name]
+    gps = fn()
+    ref = REF[name]
+    line = {
+        "metric": f"{name}_generations_per_sec",
+        "value": round(gps, 2),
+        "unit": "gens/sec",
+        "vs_baseline": round(gps / ref, 1) if ref else None,
+        "backend": jax.default_backend(),
+    }
+    if name in EXTRAPOLATED:
+        line["ref_extrapolated"] = True
+    return line
+
+
+def main_isolated(out_path, timeout_s):
+    """Each config in its own subprocess with a hard timeout, results
+    appended to ``out_path`` as they land — a wedged relay (or one
+    poison compile) costs that config only, not the suite. The relay is
+    re-probed between configs; a dead probe stops the sweep early with
+    an explanatory line rather than a hang."""
+    import subprocess
+
+    from _axon_probe import axon_tunnel_reachable
+
+    def emit(line):
         print(json.dumps(line), flush=True)
+        with open(out_path, "a") as f:
+            f.write(json.dumps(line) + "\n")
+
+    me = os.path.abspath(__file__)
+    env = dict(os.environ, DEAP_TPU_SKIP_PROBE="1")  # supervisor probes
+    for i, (name, _) in enumerate(CONFIGS):
+        metric = f"{name}_generations_per_sec"
+        if not axon_tunnel_reachable():
+            emit({"metric": metric, "skipped": "relay unreachable"})
+            for later, _ in CONFIGS[i + 1:]:
+                emit({"metric": f"{later}_generations_per_sec",
+                      "skipped": "relay unreachable"})
+            break
+        try:
+            r = subprocess.run(
+                [sys.executable, me, "--config", name], env=env,
+                capture_output=True, text=True, timeout=timeout_s)
+            out = [ln for ln in r.stdout.splitlines()
+                   if ln.startswith("{")]
+            try:
+                line = json.loads(out[-1]) if out else {
+                    "metric": metric, "error": (r.stderr or "")[-400:]}
+            except json.JSONDecodeError:
+                line = {"metric": metric,
+                        "error": f"unparseable child output: {out[-1][-200:]}"}
+        except subprocess.TimeoutExpired:
+            line = {"metric": metric, "error": f"timeout after {timeout_s}s"}
+        emit(line)
+
+
+def main():
+    for name, _ in CONFIGS:
+        print(json.dumps(run_one(name)), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", choices=[n for n, _ in CONFIGS],
+                    help="run exactly one configuration")
+    ap.add_argument("--isolated", action="store_true",
+                    help="run every config in its own subprocess")
+    ap.add_argument("--out", default="BENCH_SUITE_PARTIAL.jsonl",
+                    help="append-as-they-land artifact (with --isolated)")
+    ap.add_argument("--timeout", type=int, default=1500,
+                    help="per-config subprocess timeout (with --isolated)")
+    a = ap.parse_args()
+    if a.config:
+        print(json.dumps(run_one(a.config)), flush=True)
+    elif a.isolated:
+        main_isolated(a.out, a.timeout)
+    else:
+        main()
