@@ -300,6 +300,11 @@ def test_sel_tournament_binned_matches_sorted_exactly():
     f = jax.random.randint(jax.random.key(11), (500,), 0, 101)
     w = f.astype(jnp.float32)[:, None]
     assert (counting_order_desc(w[:, 0], 0, 100) == lex_sort_desc(w)).all()
+    # both prefix formulations (full-length cumsum / MXU-tiled matmul)
+    # are bit-identical to the lexsort, including at non-tile-multiple n
+    for mode in ("scan", "mxu"):
+        assert (counting_order_desc(w[:, 0], 0, 100, mode=mode)
+                == lex_sort_desc(w)).all(), mode
 
     ksel = jax.random.key(12)
     a = sel_tournament_sorted(ksel, w, 300, tournsize=3)
