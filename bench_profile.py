@@ -76,6 +76,19 @@ def main():
         c[0], c[1] + 0 * bench.packed_selector("binned")(
             k, c[1][:, None], POP).astype(jnp.float32)))
 
+    # 1b. the two counting-sort prefix formulations in isolation (the
+    # scan mode's log-pass cumsum was the diagnosed dominant term; the
+    # mxu mode replaces it with a tiled tril-matmul — same bit-exact
+    # permutation, see ops.selection.counting_order_desc)
+    from deap_tpu.ops.selection import counting_order_desc
+
+    def sel_mode(mode):
+        def step(c, k):
+            order = counting_order_desc(c[1], 0, LENGTH, mode=mode)
+            m = jnp.min(jax.random.randint(k, (3, POP), 0, POP), axis=0)
+            return (c[0], c[1] + 0 * jnp.take(order, m).astype(jnp.float32))
+        return scanned(step)
+
     # 2. gather alone: random idx (uniform — same access pattern class)
     def gather_step(c, k):
         packed, fit = c
@@ -109,6 +122,10 @@ def main():
         "ms_per_gen": {
             "select_sorted": round(timed(sel_sorted, packed, fit) * 1e3, 4),
             "select_binned": round(timed(sel_binned, packed, fit) * 1e3, 4),
+            "counting_scan": round(
+                timed(sel_mode("scan"), packed, fit) * 1e3, 4),
+            "counting_mxu": round(
+                timed(sel_mode("mxu"), packed, fit) * 1e3, 4),
             "gather_random": round(timed(gather_only, packed, fit) * 1e3, 4),
             "kernel_fused_packed": round(
                 timed(kernel_only, packed, fit) * 1e3, 4),
