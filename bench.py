@@ -150,22 +150,105 @@ def _time(run, *args):
     return best
 
 
-def main():
+CANDIDATES = ("fused", "packed_sorted", "packed_binned")
+
+
+def _setup():
+    """The benchmark population — shared by every candidate and the CPU
+    baseline so they can never desynchronise."""
     tb = _toolbox()
     pop = init_population(
         jax.random.key(1), POP, ops.bernoulli_genome(LENGTH),
         FitnessSpec((1.0,)))
-    pop = evaluate_invalid(pop, tb.evaluate)
+    return tb, evaluate_invalid(pop, tb.evaluate)
 
-    if jax.default_backend() == "tpu":
-        fit = pop.wvalues[:, 0]
-        packed = ops.pack_genomes(pop.genomes)
-        dt = min(
-            _time(make_run_fused(), pop.genomes, fit),
-            _time(make_run_packed("sorted"), packed, fit),
-            _time(make_run_packed("binned"), packed, fit),
-        )
+
+def _run_candidate(name: str) -> float:
+    """Best-of-REPS seconds for one TPU candidate path."""
+    _, pop = _setup()
+    fit = pop.wvalues[:, 0]
+    if name == "fused":
+        return _time(make_run_fused(), pop.genomes, fit)
+    packed = ops.pack_genomes(pop.genomes)
+    return _time(make_run_packed(name.split("_", 1)[1]), packed, fit)
+
+
+def _race_isolated(timeout_s: int = 900) -> float:
+    """Race the TPU candidates in subprocesses so a relay wedge during
+    one compile (observed 2026-07-31, mid-eigh) costs that candidate
+    only; returns the best seconds, or +inf if every candidate died."""
+    import subprocess
+
+    me = os.path.abspath(__file__)
+    env = dict(os.environ, DEAP_TPU_SKIP_PROBE="1")
+    # mid-race liveness checks must be the 1 s port scan only — the
+    # slow stage would re-attach the single-client TPU between
+    # candidates (and burn its 180 s timeout on a wedged relay)
+    os.environ["DEAP_TPU_SKIP_PROBE"] = "1"
+    best = float("inf")
+    for name in CANDIDATES:
+        if not axon_tunnel_reachable():
+            print(f"bench: relay port closed before {name}; stopping "
+                  "race", file=sys.stderr)
+            break  # relay died mid-race; keep what we have
+        try:
+            r = subprocess.run(
+                [sys.executable, me, "--candidate", name], env=env,
+                capture_output=True, text=True, timeout=timeout_s)
+            got = None
+            for ln in r.stdout.splitlines():
+                if ln.startswith("{"):
+                    got = json.loads(ln)["seconds"]
+                    best = min(best, got)
+            if got is None:
+                print(f"bench: candidate {name} produced no result; "
+                      f"stderr tail: {(r.stderr or '')[-400:]}",
+                      file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print(f"bench: candidate {name} timed out after "
+                  f"{timeout_s}s", file=sys.stderr)
+        except (json.JSONDecodeError, KeyError) as e:
+            print(f"bench: candidate {name} output unparseable: {e}",
+                  file=sys.stderr)
+    return best
+
+
+def _probe_backend(timeout_s: int = 240) -> str:
+    """Which backend jax resolves to — asked in a THROWAWAY subprocess.
+    The accelerator is single-client (tunnel relay and libtpu alike):
+    if the orchestrating parent initialised it, every candidate child
+    would block on attach. The probe child exits immediately, releasing
+    the client before the race starts."""
+    import subprocess
+
+    env = dict(os.environ, DEAP_TPU_SKIP_PROBE="1")
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            env=env, capture_output=True, text=True, timeout=timeout_s)
+        lines = r.stdout.strip().splitlines()
+        return lines[-1] if lines else "none"
+    except subprocess.TimeoutExpired:
+        return "none"
+
+
+def main():
+    backend = _probe_backend() if _TUNNEL_OK else "cpu"
+    if backend == "tpu":
+        dt = _race_isolated()
+        if dt == float("inf"):
+            # every isolated candidate died (relay wedged under us):
+            # report an honest failure line rather than hanging
+            print(json.dumps({
+                "metric": "onemax_pop100k_generations_per_sec",
+                "value": 0.0, "unit": "gens/sec", "vs_baseline": 0.0,
+                "backend": "tpu", "error": "all candidates failed"}))
+            return
     else:
+        backend = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+        tb, pop = _setup()
         dt = _time(make_run_xla(tb), pop)
 
     gens_per_sec = NGEN / dt
@@ -174,7 +257,7 @@ def main():
         "value": round(gens_per_sec, 2),
         "unit": "gens/sec",
         "vs_baseline": round(gens_per_sec / REFERENCE_GENS_PER_SEC, 1),
-        "backend": jax.default_backend(),
+        "backend": backend,
     }
     if not _TUNNEL_OK:
         # self-describing CPU fallback: the axon relay was down at
@@ -184,4 +267,9 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--candidate" in sys.argv:
+        name = sys.argv[sys.argv.index("--candidate") + 1]
+        print(json.dumps({"candidate": name,
+                          "seconds": _run_candidate(name)}))
+    else:
+        main()
