@@ -52,9 +52,16 @@ def test_two_process_distributed_epoch():
     coordinator = f"127.0.0.1:{port}"
 
     child = pathlib.Path(__file__).parent / "_multihost_child.py"
+    repo_root = pathlib.Path(__file__).parent.parent
     env = dict(os.environ)
     # the child pins its own XLA flags/platform; drop the suite's
     env.pop("XLA_FLAGS", None)
+    # the child script's sys.path[0] is tests/, not the repo root, so
+    # deap_tpu must come via PYTHONPATH — do not rely on an editable
+    # install being present (container resets drop it)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [str(repo_root)] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH")
+                            else []))
     procs = [
         subprocess.Popen(
             [sys.executable, str(child), coordinator, "2", str(rank)],
