@@ -318,14 +318,19 @@ def make_batch_interpreter(pset: PrimitiveSet, max_len: int,
 
 
 def make_population_evaluator(pset: PrimitiveSet, max_len: int,
-                              loss: Callable) -> Callable:
+                              loss: Callable,
+                              mode: str = "scan") -> Callable:
     """``evaluate(genomes, X, y) -> f32[pop]``-style batched evaluator:
     interpret every tree on every datapoint and reduce with ``loss(pred,
     X, ...)``. The usual symbolic-regression fitness (mean squared error
     over the sample points, examples/gp/symbreg.py:55-61) is
     ``loss=lambda pred, y: jnp.mean((pred - y) ** 2)``.
+
+    ``mode`` is forwarded to :func:`make_batch_interpreter` — keep the
+    default ``"scan"`` on CPU; ``"sweep"`` is the level-synchronous
+    variant for accelerator measurement.
     """
-    interp = make_batch_interpreter(pset, max_len)
+    interp = make_batch_interpreter(pset, max_len, mode=mode)
 
     def evaluate(genomes, X, y):
         preds = interp(genomes, X)                          # [pop, points]
