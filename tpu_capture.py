@@ -1,0 +1,97 @@
+"""Unattended TPU evidence capture for relay uptime windows.
+
+The axon relay has been up for ~15 minutes total across rounds 2-3;
+when it answers, every driver-parseable artifact must be captured
+before it wedges again. This orchestrator runs the whole measurement
+queue with per-step subprocess isolation (a wedge costs one step, not
+the window), appends each result to ``TPU_EVIDENCE_r03.jsonl`` the
+moment it lands, and git-commits after every step so evidence survives
+anything.
+
+Queue order is cheapest-first / highest-value-first:
+
+1. ``bench.py`` — the headline three-candidate race (north star).
+2. ``bench_profile.py`` — component attribution incl. the two
+   counting-sort modes (the roofline evidence VERDICT r1/r2 asked for).
+3. ``bench_suite.py --isolated`` — the five secondary configs, each in
+   its own subprocess, cmaes (the wedge suspect) last.
+4. ``bench_profile.py --trace traces/r03`` — xplane capture, last:
+   it adds nothing numeric and profiling has its own wedge risk.
+
+Usage: ``python tpu_capture.py`` (checks the relay first, exits 0 with
+a message if it is down; safe to re-run — steps append, never clobber).
+"""
+
+import datetime
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, HERE)
+from _axon_probe import axon_tunnel_reachable  # noqa: E402
+
+EVIDENCE = os.path.join(HERE, "TPU_EVIDENCE_r03.jsonl")
+
+STEPS = [
+    ("bench.py", [sys.executable, "bench.py"], 2400),
+    ("bench_profile.py", [sys.executable, "bench_profile.py"], 2400),
+    ("bench_suite.py", [sys.executable, "bench_suite.py", "--isolated",
+                        "--out", "TPU_SUITE_r03.jsonl"], 9000),
+    ("bench_profile.py --trace", [sys.executable, "bench_profile.py",
+                                  "--trace", "traces/r03"], 2400),
+]
+
+
+def log(step, payload):
+    line = {"ts": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+            "script": step, **payload}
+    with open(EVIDENCE, "a") as f:
+        f.write(json.dumps(line) + "\n")
+    print(json.dumps(line), flush=True)
+
+
+def commit(step):
+    paths = [p for p in ("TPU_EVIDENCE_r03.jsonl", "TPU_SUITE_r03.jsonl",
+                         "TPU_PROBE_LOG.jsonl", "traces")
+             if os.path.exists(os.path.join(HERE, p))]
+    subprocess.run(["git", "add", "-A"] + paths,
+                   cwd=HERE, capture_output=True)
+    subprocess.run(["git", "commit", "-q", "-m",
+                    f"TPU evidence: {step} captured\n\n"
+                    "No-Verification-Needed: measurement artifacts only"],
+                   cwd=HERE, capture_output=True)
+
+
+def main():
+    if not axon_tunnel_reachable():
+        print("relay unreachable; nothing captured")
+        return
+    for step, cmd, timeout_s in STEPS:
+        if not axon_tunnel_reachable():
+            log(step, {"skipped": "relay died mid-window"})
+            commit(step)
+            break
+        try:
+            r = subprocess.run(cmd, cwd=HERE, capture_output=True,
+                               text=True, timeout=timeout_s)
+            results = []
+            for ln in r.stdout.splitlines():
+                if ln.startswith("{"):
+                    try:
+                        results.append(json.loads(ln))
+                    except json.JSONDecodeError:
+                        results.append({"unparseable": ln[-200:]})
+            if results:
+                log(step, {"results": results})
+            else:
+                log(step, {"error": f"rc={r.returncode}, no JSON; "
+                                    f"stderr tail: {(r.stderr or '')[-300:]}"})
+        except subprocess.TimeoutExpired:
+            log(step, {"error": f"timeout after {timeout_s}s"})
+        commit(step)
+
+
+if __name__ == "__main__":
+    main()
