@@ -141,6 +141,23 @@ def test_tsp_gr17_reaches_reference_optimum():
     assert best == 2085.0
 
 
+@pytest.mark.slow
+def test_tsp_gr24_quality_vs_reference_optimum():
+    """Same comparability gate on the larger gr24 instance (published
+    optimum 1272): the seeded full-config run measures 1347 — a 5.9%
+    gap — so the gate pins <= 7%. Skipped where the reference tree is
+    absent."""
+    import pathlib
+
+    gr24 = pathlib.Path("/root/reference/examples/ga/tsp/gr24.json")
+    if not gr24.exists():
+        pytest.skip("reference gr24 instance not available")
+    from examples.ga import tsp
+
+    best = tsp.main(smoke=False, instance=str(gr24))
+    assert best <= 1272.0 * 1.07, best
+
+
 def test_zoo_report_artifact_green():
     """The committed full-configuration validation artifact
     (examples/ZOO_REPORT.json, VERDICT r2 item 7) must cover the whole
