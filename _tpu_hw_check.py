@@ -1,0 +1,100 @@
+"""On-chip validation of the hardware-PRNG kernel paths.
+
+The test suite pins the CPU backend (tests/conftest.py), so the
+``prng='hw'`` kernels — TPU-only by nature — have no pytest coverage on
+the real chip. This script runs the distributional and semantic checks
+on the device and prints one JSON verdict line; ``tpu_capture.py`` runs
+it before any benchmark so a broken hw kernel can never produce a
+plausible-looking throughput artifact.
+
+Checks (packed and byte-genome kernels):
+- cxpb=0, mutpb=0: children identical to parents, fitness == popcount
+- mutpb=1: per-gene flip rate within 4 sigma of indpb
+- cxpb=1 from (all-zeros, all-ones) pairs: every child gene count in
+  [0, L] and pair gene totals conserved (two-point swap preserves the
+  pair's multiset per position)
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _axon_probe import axon_tunnel_reachable
+
+if not axon_tunnel_reachable():
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"check": "hw_kernels", "skipped": "no tpu"}))
+        return 0
+
+    from deap_tpu import ops
+    from deap_tpu.ops import packed as pk
+
+    failures = []
+    N, L = 2048, 100
+    W = pk.words_for(L)
+
+    def expect(name, ok):
+        if not bool(ok):
+            failures.append(name)
+
+    # --- packed kernel -----------------------------------------------------
+    g = jax.random.bernoulli(jax.random.key(0), 0.5, (N, L))
+    p = pk.pack_genomes(g)
+
+    c, fit = pk.fused_variation_eval_packed(
+        jax.random.key(1), p, L, cxpb=0.0, mutpb=0.0, indpb=0.05,
+        prng="hw", interpret=False)
+    expect("packed_identity", (np.asarray(c) == np.asarray(p)).all())
+    expect("packed_fitness_popcount",
+           (np.asarray(fit) == np.asarray(g.sum(-1))).all())
+
+    z = jnp.zeros((N, W), jnp.uint32)
+    c, fit = pk.fused_variation_eval_packed(
+        jax.random.key(2), z, L, cxpb=0.0, mutpb=1.0, indpb=0.05,
+        prng="hw", interpret=False)
+    rate = float(np.asarray(fit).sum()) / (N * L)
+    sigma = (0.05 * 0.95 / (N * L)) ** 0.5
+    expect("packed_flip_rate", abs(rate - 0.05) < 4 * sigma)
+    # no flips past the genome length (pack invariant)
+    expect("packed_tail_clean",
+           (np.asarray(pk.unpack_genomes(c, W * 32))[:, L:] == 0).all())
+
+    ones_row = pk.pack_genomes(jnp.ones((1, L)))[0]  # uint32[W]
+    half = jnp.where((jnp.arange(N) % 2 == 0)[:, None],
+                     jnp.zeros((W,), jnp.uint32), ones_row)
+    c, fit = pk.fused_variation_eval_packed(
+        jax.random.key(3), half, L, cxpb=1.0, mutpb=0.0, indpb=0.05,
+        prng="hw", interpret=False)
+    f = np.asarray(fit)
+    expect("packed_cx_range", ((f >= 0) & (f <= L)).all())
+    pair_tot = f[0::2] + f[1::2]
+    expect("packed_cx_conserved", (pair_tot == float(L)).all())
+
+    # --- byte-genome kernel ------------------------------------------------
+    c, fit = ops.fused_variation_eval(
+        jax.random.key(4), jnp.zeros((N, L)), cxpb=0.0, mutpb=1.0,
+        indpb=0.05, prng="hw", interpret=False)
+    rate = float(np.asarray(fit).sum()) / (N * L)
+    expect("bytes_flip_rate", abs(rate - 0.05) < 4 * sigma)
+
+    verdict = {"check": "hw_kernels", "ok": not failures}
+    if failures:
+        verdict["failed"] = failures
+    print(json.dumps(verdict))
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

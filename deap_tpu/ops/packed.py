@@ -207,10 +207,17 @@ def _packed_kernel_hw(seed_ref, g_ref, out_ref, fit_ref, *, n, L, W, cxpb,
     pltpu.prng_seed(seed_ref[0] + i)
     pairbits = pltpu.bitcast(pltpu.prng_random_bits((TI, 4)), jnp.uint32)
     rowbits = pltpu.bitcast(pltpu.prng_random_bits((TI, 1)), jnp.uint32)
+    # ONE full-width draw for all 32 bit planes: a per-plane
+    # prng_random_bits((TI, Wp)) touches Wp (= 4 at L=100) of the 128
+    # vector lanes and costs a full vreg generation each — 32 calls per
+    # tile wasting ~97% of the PRNG's vector width. The consolidated
+    # (TI, WORD*Wp) block is the exact same bit budget in full-lane
+    # strides, sliced per plane just like the bits-input path.
+    genebits = pltpu.bitcast(
+        pltpu.prng_random_bits((TI, WORD * Wp)), jnp.uint32)
 
-    def gene_u01(b):  # fresh hardware draw per bit plane, always 2-D
-        return _u01_from_bits(
-            pltpu.bitcast(pltpu.prng_random_bits((TI, Wp)), jnp.uint32))
+    def gene_u01(b):  # lane-aligned contiguous slice of the bit plane
+        return _u01_from_bits(genebits[:, b * Wp:(b + 1) * Wp])
 
     child, fit = _packed_body(
         g_ref[:], _u01_from_bits(_pair_consistent(pairbits)),

@@ -35,6 +35,9 @@ from _axon_probe import axon_tunnel_reachable  # noqa: E402
 EVIDENCE = os.path.join(HERE, "TPU_EVIDENCE_r03.jsonl")
 
 STEPS = [
+    # hw-kernel semantics validated on-chip BEFORE any throughput
+    # number is recorded (the pytest suite pins CPU and cannot)
+    ("_tpu_hw_check.py", [sys.executable, "_tpu_hw_check.py"], 1200),
     ("bench.py", [sys.executable, "bench.py"], 2400),
     ("bench_profile.py", [sys.executable, "bench_profile.py"], 2400),
     ("bench_suite.py", [sys.executable, "bench_suite.py", "--isolated",
