@@ -143,12 +143,51 @@ def mut_flip_bit_packed(key, g, indpb: float, length: int):
 
 # ------------------------------------------------- fused Pallas kernel ----
 
-def _packed_body(g, pairu, rowu, gene_u01, *, n, L, W, TI, Wp, cxpb, mutpb,
+def _flip_words_matmul(geneu, indpb, Wp):
+    """Bernoulli(indpb) flip words from the ``[TI, 32·Wp]`` per-bit
+    uniform block, packed via two small MXU matmuls instead of a
+    32-iteration shift-or loop.
+
+    The loop formulation compared and or-ed ``(TI, Wp)`` slices — at
+    W = 4 words that is 4 of 128 vector lanes doing work, ~96 narrow
+    VPU ops per tile. Here the whole block is compared against
+    ``indpb`` once at full lane width, then bit-plane columns are
+    folded into word values by multiplying with a constant
+    ``(32·Wp, Wp)`` matrix whose ``(b·Wp + j, j)`` entry is ``2^b``
+    (column layout matching the bits-path genebit stream: plane ``b``
+    occupies columns ``[b·Wp, (b+1)·Wp)``). Sums of distinct powers of
+    two stay exact in f32 only below 2^24, so the fold splits into
+    bits 0-15 and 16-31 (each word sum < 2^16, exact) and recombines
+    bitwise — bit-identical to the loop it replaces.
+    """
+    cols = WORD * Wp
+    mask = (geneu < indpb).astype(jnp.float32)
+    # fold matrices built in-kernel from iota arithmetic (pallas_call
+    # rejects captured array constants): row r = b*Wp + j carries 2^b
+    # at column j. (1 << b) in int32 then int32->f32 is exact (< 2^16).
+    r = jax.lax.broadcasted_iota(jnp.int32, (cols, Wp), 0)
+    j = jax.lax.broadcasted_iota(jnp.int32, (cols, Wp), 1)
+    b = r // Wp
+    sel = (r % Wp) == j
+
+    def fold(half_sel, shift):
+        m = jnp.where(sel & half_sel,
+                      jnp.left_shift(1, b - shift), 0).astype(jnp.float32)
+        s = jax.lax.dot_general(mask, m, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        # f32 -> int32 (exact: values < 2^16) -> uint32 bit view
+        return jax.lax.bitcast_convert_type(s.astype(jnp.int32),
+                                            jnp.uint32)
+
+    return fold(b < 16, 0) | (fold(b >= 16, 16) << np.uint32(16))
+
+
+def _packed_body(g, pairu, rowu, geneu, *, n, L, W, TI, Wp, cxpb, mutpb,
                  indpb, tile_idx):
-    """Kernel body on a ``uint32[TI, Wp]`` tile. ``gene_u01(b)`` returns
-    a fresh ``[TI, Wp]`` uniform draw for bit position ``b`` (kept 2-D so
-    every op is a plain lane-aligned vector op); pair draws must already
-    be pair-consistent."""
+    """Kernel body on a ``uint32[TI, Wp]`` tile. ``geneu`` is the full
+    ``[TI, 32·Wp]`` per-bit uniform block (plane ``b`` in columns
+    ``[b·Wp, (b+1)·Wp)``); pair draws must already be
+    pair-consistent."""
     col = jax.lax.broadcasted_iota(jnp.int32, (TI, Wp), 1)
     row = jax.lax.broadcasted_iota(jnp.int32, (TI, Wp), 0)
     word_start = col * WORD
@@ -170,9 +209,7 @@ def _packed_body(g, pairu, rowu, gene_u01, *, n, L, W, TI, Wp, cxpb, mutpb,
     child = (g & ~seg) | (partner & seg)
 
     do_mut = rowu < mutpb
-    flip = jnp.zeros_like(child)
-    for b in range(WORD):
-        flip |= (gene_u01(b) < indpb).astype(jnp.uint32) << np.uint32(b)
+    flip = _flip_words_matmul(geneu, indpb, Wp)
     flip &= _bits_below(L - word_start)          # tail + padded words
     flip = jnp.where(do_mut, flip, np.uint32(0))
     child = child ^ flip
@@ -187,13 +224,10 @@ def _packed_body(g, pairu, rowu, gene_u01, *, n, L, W, TI, Wp, cxpb, mutpb,
 def _packed_kernel_bits(g_ref, pairbits_ref, rowbits_ref, genebits_ref,
                         out_ref, fit_ref, *, n, L, W, cxpb, mutpb, indpb):
     TI, Wp = g_ref.shape
-
-    def gene_u01(b):  # lane-aligned contiguous slice of the bit plane
-        return _u01_from_bits(genebits_ref[:, b * Wp : (b + 1) * Wp])
-
     child, fit = _packed_body(
         g_ref[:], _u01_from_bits(_pair_consistent(pairbits_ref[:])),
-        _u01_from_bits(rowbits_ref[:][:, 0:1]), gene_u01, n=n, L=L, W=W,
+        _u01_from_bits(rowbits_ref[:][:, 0:1]),
+        _u01_from_bits(genebits_ref[:]), n=n, L=L, W=W,
         TI=TI, Wp=Wp, cxpb=cxpb, mutpb=mutpb, indpb=indpb,
         tile_idx=pl.program_id(0))
     out_ref[:] = child
@@ -212,16 +246,12 @@ def _packed_kernel_hw(seed_ref, g_ref, out_ref, fit_ref, *, n, L, W, cxpb,
     # vector lanes and costs a full vreg generation each — 32 calls per
     # tile wasting ~97% of the PRNG's vector width. The consolidated
     # (TI, WORD*Wp) block is the exact same bit budget in full-lane
-    # strides, sliced per plane just like the bits-input path.
+    # strides, laid out exactly like the bits-input stream.
     genebits = pltpu.bitcast(
         pltpu.prng_random_bits((TI, WORD * Wp)), jnp.uint32)
-
-    def gene_u01(b):  # lane-aligned contiguous slice of the bit plane
-        return _u01_from_bits(genebits[:, b * Wp:(b + 1) * Wp])
-
     child, fit = _packed_body(
         g_ref[:], _u01_from_bits(_pair_consistent(pairbits)),
-        _u01_from_bits(rowbits), gene_u01, n=n, L=L, W=W,
+        _u01_from_bits(rowbits), _u01_from_bits(genebits), n=n, L=L, W=W,
         TI=TI, Wp=Wp, cxpb=cxpb, mutpb=mutpb, indpb=indpb, tile_idx=i)
     out_ref[:] = child
     fit_ref[:] = fit
