@@ -289,8 +289,10 @@ def _fused_kernel_hw(seed_ref, g_ref, out_ref, fit_ref, *, n, L, cxpb,
     TI, Lp = g_ref.shape
     i = pl.program_id(0)
     pltpu.prng_seed(seed_ref[0] + i)
-    pairbits = pltpu.bitcast(pltpu.prng_random_bits((TI, 4)), jnp.uint32)
-    rowbits = pltpu.bitcast(pltpu.prng_random_bits((TI, 1)), jnp.uint32)
+    # pair (4) + row (1) draws share one block: separate calls each
+    # cost a full vreg generation per 8 sublanes at <4% lane use
+    prbits = pltpu.bitcast(pltpu.prng_random_bits((TI, 8)), jnp.uint32)
+    pairbits, rowbits = prbits[:, 0:4], prbits[:, 4:5]
     genebits = pltpu.bitcast(pltpu.prng_random_bits((TI, Lp)), jnp.uint32)
     pairu = _u01(_pair_consistent(pairbits))
     child, fit = _variation_body(

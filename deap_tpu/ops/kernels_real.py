@@ -127,10 +127,13 @@ def _real_kernel_hw(seed_ref, g_ref, out_ref, fit_ref, *, n, L, Lp, **kw):
     pltpu.prng_seed(seed_ref[0] + i)
     draw = lambda cols: pltpu.bitcast(
         pltpu.prng_random_bits((TI, cols)), jnp.uint32)
-    pairu = _u01(_pair_consistent(draw(4)))
+    # pair (4) + row (1) draws share one block: separate calls each
+    # cost a full vreg generation per 8 sublanes at <4% lane use
+    prbits = draw(8)
+    pairu = _u01(_pair_consistent(prbits[:, 0:4]))
     gammau = _u01(_pair_consistent(draw(Lp)))
     child, fit = _real_body(
-        g_ref[:], pairu, gammau, _u01(draw(1)), _u01(draw(Lp)),
+        g_ref[:], pairu, gammau, _u01(prbits[:, 4:5]), _u01(draw(Lp)),
         _u01(draw(Lp)), _u01(draw(Lp)), n=n, L=L, TI=TI, tile_idx=i, **kw)
     out_ref[:] = child
     fit_ref[:] = fit

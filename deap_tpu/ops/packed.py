@@ -239,8 +239,10 @@ def _packed_kernel_hw(seed_ref, g_ref, out_ref, fit_ref, *, n, L, W, cxpb,
     TI, Wp = g_ref.shape
     i = pl.program_id(0)
     pltpu.prng_seed(seed_ref[0] + i)
-    pairbits = pltpu.bitcast(pltpu.prng_random_bits((TI, 4)), jnp.uint32)
-    rowbits = pltpu.bitcast(pltpu.prng_random_bits((TI, 1)), jnp.uint32)
+    # pair (4) + row (1) draws share one block: separate calls each
+    # cost a full vreg generation per 8 sublanes at <4% lane use
+    prbits = pltpu.bitcast(pltpu.prng_random_bits((TI, 8)), jnp.uint32)
+    pairbits, rowbits = prbits[:, 0:4], prbits[:, 4:5]
     # ONE full-width draw for all 32 bit planes: a per-plane
     # prng_random_bits((TI, Wp)) touches Wp (= 4 at L=100) of the 128
     # vector lanes and costs a full vreg generation each — 32 calls per
