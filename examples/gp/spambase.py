@@ -5,7 +5,16 @@ vocabulary where float comparisons feed boolean logic feeding an
 if-then-else, evolved to classify feature vectors (the reference reads
 spambase.csv; a reproducible synthetic spam-like dataset stands in).
 Typed generation/variation guarantee well-typed trees by construction.
+
+For direct comparability, ``main(csv_path=...)`` (or
+``DEAP_TPU_SPAMBASE``) accepts the reference's UCI ``spambase.csv``
+(57 features + 0/1 label per row); fitness is then accuracy on a
+fixed 400-row subset, the reference example's per-evaluation sample
+size (examples/gp/spambase.py's ``random.sample(spam, 400)``) made
+deterministic for a stable quality gate.
 """
+
+import os
 
 import jax
 import jax.numpy as jnp
@@ -28,10 +37,26 @@ def make_dataset(key, n: int = 200):
     return X, y
 
 
-def main(smoke: bool = False):
+def load_csv(path: str, n_rows: int = 400, seed: int = 7):
+    """The reference-format spambase CSV (comma-separated floats, label
+    last) reduced to a fixed ``n_rows`` subset."""
+    import numpy as np
+
+    data = jnp.asarray(np.loadtxt(path, delimiter=","), jnp.float32)
+    idx = jax.random.choice(jax.random.key(seed), data.shape[0],
+                            (min(n_rows, data.shape[0]),), replace=False)
+    rows = data[idx]
+    return rows[:, :-1], rows[:, -1]
+
+
+def main(smoke: bool = False, csv_path: str | None = None):
     n, ngen = (200, 30) if not smoke else (50, 6)
-    X, y = make_dataset(jax.random.key(43))
-    pset = gp.spam_set(n_features=N_FEATURES)
+    csv_path = csv_path or os.environ.get("DEAP_TPU_SPAMBASE")
+    if csv_path:
+        X, y = load_csv(csv_path)
+    else:
+        X, y = make_dataset(jax.random.key(43))
+    pset = gp.spam_set(n_features=X.shape[1])
     gen = gp.make_generator_typed(pset, MAX_LEN, 1, 4)
     interp = gp.make_batch_interpreter(pset, MAX_LEN)
 

@@ -158,6 +158,24 @@ def test_tsp_gr24_quality_vs_reference_optimum():
     assert best <= 1272.0 * 1.07, best
 
 
+@pytest.mark.slow
+def test_spambase_quality_on_reference_csv():
+    """Typed-GP spam classification on the reference's real UCI
+    spambase.csv (57 features; fixed 400-row subset — the reference
+    example's per-evaluation sample size): the seeded full-config run
+    measures 0.902 accuracy vs the ~0.61 majority-class baseline;
+    pinned at >= 0.85. Skipped where the reference tree is absent."""
+    import pathlib
+
+    csv = pathlib.Path("/root/reference/examples/gp/spambase.csv")
+    if not csv.exists():
+        pytest.skip("reference spambase.csv not available")
+    from examples.gp import spambase
+
+    acc = spambase.main(smoke=False, csv_path=str(csv))
+    assert acc >= 0.85, acc
+
+
 def test_zoo_report_artifact_green():
     """The committed full-configuration validation artifact
     (examples/ZOO_REPORT.json, VERDICT r2 item 7) must cover the whole
