@@ -14,12 +14,21 @@ from deap_tpu.core.fitness import FitnessSpec
 from deap_tpu.core.population import init_population
 from deap_tpu.core.toolbox import Toolbox
 
-from examples.ga.knn import N_FEATURES, knn_accuracy, make_dataset
+from examples.ga.knn import N_FEATURES, knn_accuracy, load_csv, make_dataset
 
 
-def main(smoke: bool = False):
+def main(smoke: bool = False, csv_path: str | None = None):
+    """``csv_path`` (or ``DEAP_TPU_HEART_SCALE``) points at the
+    reference's heart_scale.csv for direct comparability; default is
+    the synthetic known-informative-features dataset."""
+    import os
+
     n, ngen = (80, 30) if not smoke else (30, 6)
-    X, y = make_dataset(jax.random.key(28))
+    csv_path = csv_path or os.environ.get("DEAP_TPU_HEART_SCALE")
+    if csv_path:
+        X, y = load_csv(csv_path)
+    else:
+        X, y = make_dataset(jax.random.key(28))
 
     def evaluate(masks):
         acc = jax.vmap(lambda m: knn_accuracy(m.astype(jnp.float32), X, y)
@@ -27,14 +36,15 @@ def main(smoke: bool = False):
         nsel = masks.sum(-1).astype(jnp.float32)
         return jnp.stack([acc, nsel], axis=-1)
 
+    n_features = X.shape[1]  # 13 both for heart_scale and synthetic
     toolbox = Toolbox()
     toolbox.register("evaluate", evaluate)
     toolbox.register("mate", ops.cx_uniform, indpb=0.3)
-    toolbox.register("mutate", ops.mut_flip_bit, indpb=1.0 / N_FEATURES)
+    toolbox.register("mutate", ops.mut_flip_bit, indpb=1.0 / n_features)
     toolbox.register("select", mo.sel_nsga2)
 
     pop = init_population(jax.random.key(29), n,
-                          ops.bernoulli_genome(N_FEATURES),
+                          ops.bernoulli_genome(n_features),
                           FitnessSpec((1.0, -1.0)))
     pop, logbook, _ = algorithms.ea_mu_plus_lambda(
         jax.random.key(30), pop, toolbox, mu=n, lambda_=n,

@@ -27,6 +27,16 @@ def make_dataset(key, n: int = 160, informative: int = 5):
     return X[perm], y[perm]
 
 
+def load_csv(path: str):
+    """The reference's ``heart_scale.csv`` layout (examples/ga/knn.py
+    reads it with the label in the first column, ±1): returns
+    ``(X f32[n, d], y f32[n] in {0, 1})``."""
+    import numpy as np
+
+    data = jnp.asarray(np.loadtxt(path, delimiter=","), jnp.float32)
+    return data[:, 1:], (data[:, 0] > 0).astype(jnp.float32)
+
+
 def knn_accuracy(mask, X, y, k: int = 5) -> jnp.ndarray:
     """Leave-one-out accuracy of kNN restricted to masked features."""
     Xm = X * mask[None, :]
