@@ -116,26 +116,40 @@ def main():
                 kv, packed[idx], LENGTH, **kw)
         return scanned(step)
 
+    # Most-valuable-first: each component is timed, printed, and
+    # appended to --out the moment it lands. The 2026-07-31 relay
+    # window taught the lesson — the old all-components-then-print
+    # shape lost 40 minutes of tunnel compiles to a single timeout.
+    components = [
+        ("full_binned", lambda: full("binned")),
+        ("kernel_fused_packed", lambda: kernel_only),
+        ("select_binned", lambda: sel_binned),
+        ("gather_random", lambda: gather_only),
+        ("full_sorted", lambda: full("sorted")),
+        ("select_sorted", lambda: sel_sorted),
+        ("counting_mxu", lambda: sel_mode("mxu")),
+        ("counting_scan", lambda: sel_mode("scan")),
+    ]
     out = {
         "backend": jax.default_backend(),
         "pop": POP, "length": LENGTH, "ngen": NGEN,
-        "ms_per_gen": {
-            "select_sorted": round(timed(sel_sorted, packed, fit) * 1e3, 4),
-            "select_binned": round(timed(sel_binned, packed, fit) * 1e3, 4),
-            "counting_scan": round(
-                timed(sel_mode("scan"), packed, fit) * 1e3, 4),
-            "counting_mxu": round(
-                timed(sel_mode("mxu"), packed, fit) * 1e3, 4),
-            "gather_random": round(timed(gather_only, packed, fit) * 1e3, 4),
-            "kernel_fused_packed": round(
-                timed(kernel_only, packed, fit) * 1e3, 4),
-            "full_sorted": round(timed(full("sorted"), packed, fit) * 1e3, 4),
-            "full_binned": round(timed(full("binned"), packed, fit) * 1e3, 4),
-        },
+        "ms_per_gen": {},
     }
     if not _TUNNEL_OK:
         out["tunnel_down"] = True
-    print(json.dumps(out))
+    out_path = None
+    if "--out" in sys.argv:
+        out_path = sys.argv[sys.argv.index("--out") + 1]
+    for name, build in components:
+        ms = round(timed(build(), packed, fit) * 1e3, 4)
+        out["ms_per_gen"][name] = ms
+        line = {"component": name, "ms_per_gen": ms,
+                "backend": out["backend"]}
+        print(json.dumps(line), flush=True)
+        if out_path:
+            with open(out_path, "a") as f:
+                f.write(json.dumps(line) + "\n")
+    print(json.dumps(out), flush=True)
 
     if tdir is not None:
         run = full("binned")

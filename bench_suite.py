@@ -362,8 +362,23 @@ def main_isolated(out_path, timeout_s):
 
     me = os.path.abspath(__file__)
     env = dict(os.environ, DEAP_TPU_SKIP_PROBE="1")  # supervisor probes
+    # resume support: a config whose TPU value already landed in
+    # out_path (from an earlier uptime window) is not re-run — windows
+    # are scarce and a captured row is a captured row
+    done = set()
+    if os.path.exists(out_path):
+        for ln in open(out_path):
+            try:
+                d = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if "value" in d and d.get("backend") == "tpu":
+                done.add(d["metric"])
     for i, (name, _) in enumerate(CONFIGS):
         metric = f"{name}_generations_per_sec"
+        if metric in done:
+            print(f"{metric}: already captured, skipping", flush=True)
+            continue
         if not axon_tunnel_reachable():
             emit({"metric": metric, "skipped": "relay unreachable"})
             for later, _ in CONFIGS[i + 1:]:

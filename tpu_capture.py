@@ -36,15 +36,39 @@ EVIDENCE = os.path.join(HERE, "TPU_EVIDENCE_r03.jsonl")
 
 STEPS = [
     # hw-kernel semantics validated on-chip BEFORE any throughput
-    # number is recorded (the pytest suite pins CPU and cannot)
+    # number is recorded (the pytest suite pins CPU and cannot).
+    # Ordering lesson from the 2026-07-31 03:18-04:02 window: the
+    # five-config suite must precede the profile — the profile's eight
+    # tunnel compiles ate the whole window and its timeout lost them
+    # all (profile is now incremental via --out, but the suite rows
+    # are the higher-value artifact).
     ("_tpu_hw_check.py", [sys.executable, "_tpu_hw_check.py"], 1200),
     ("bench.py", [sys.executable, "bench.py"], 2400),
-    ("bench_profile.py", [sys.executable, "bench_profile.py"], 2400),
     ("bench_suite.py", [sys.executable, "bench_suite.py", "--isolated",
                         "--out", "TPU_SUITE_r03.jsonl"], 9000),
+    ("bench_profile.py", [sys.executable, "bench_profile.py",
+                          "--out", "TPU_PROFILE_r03.jsonl"], 3600),
     ("bench_profile.py --trace", [sys.executable, "bench_profile.py",
                                   "--trace", "traces/r03"], 2400),
 ]
+
+# steps whose single successful capture this round makes a re-run
+# pointless (validation, not measurement) — skipped when the evidence
+# file already records them ok
+ONE_SHOT = {"_tpu_hw_check.py"}
+
+
+def already_captured(step):
+    if step not in ONE_SHOT or not os.path.exists(EVIDENCE):
+        return False
+    for line in open(EVIDENCE):
+        try:
+            d = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if d.get("script") == step and "results" in d:
+            return True
+    return False
 
 
 def log(step, payload):
@@ -72,6 +96,10 @@ def main():
         print("relay unreachable; nothing captured")
         return
     for step, cmd, timeout_s in STEPS:
+        if already_captured(step):
+            print(f"{step}: already captured this round, skipping",
+                  flush=True)
+            continue
         if not axon_tunnel_reachable():
             log(step, {"skipped": "relay died mid-window"})
             commit(step)
