@@ -17,24 +17,35 @@ Usage: ``nohup python _relay_watch.py > relay_watch.log 2>&1 &``
 """
 
 import json
+import os
 import subprocess
 import sys
 import time
 
-from _probe_log import probe_once
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, HERE)
+
+from _probe_log import probe_once  # noqa: E402
+from tpu_capture import queue_complete  # noqa: E402
 
 INTERVAL_S = 120
 
 
 def main() -> None:
     while True:
+        if queue_complete():
+            print("every queue artifact captured with TPU backing — "
+                  "watcher done", flush=True)
+            return
         rec = probe_once()
         print(json.dumps(rec), flush=True)
         if rec["reachable"]:
             print("relay up — launching tpu_capture.py", flush=True)
-            subprocess.run([sys.executable, "tpu_capture.py"])
-            print("tpu_capture.py returned — resuming probe loop",
-                  flush=True)
+            r = subprocess.run(
+                [sys.executable, os.path.join(HERE, "tpu_capture.py")],
+                cwd=HERE)
+            print(f"tpu_capture.py returned rc={r.returncode} — "
+                  "resuming probe loop", flush=True)
         time.sleep(INTERVAL_S)
 
 

@@ -11,6 +11,7 @@ Run on TPU (falls back to CPU with a tunnel_down marker like bench.py).
 """
 
 import json
+import os
 import sys
 import time
 
@@ -29,6 +30,12 @@ _TUNNEL_OK = bench._TUNNEL_OK
 POP = 100_000
 LENGTH = 100
 NGEN = 200
+
+# canonical component order (most-valuable-first) lives in
+# tpu_capture.py (whose queue-completion check must not import this
+# module — our `import bench` side effect probes the relay); main()
+# asserts its component list against it so the two cannot drift
+from tpu_capture import COMPONENT_NAMES
 
 
 def timed(run, *args):
@@ -130,6 +137,9 @@ def main():
         ("counting_mxu", lambda: sel_mode("mxu")),
         ("counting_scan", lambda: sel_mode("scan")),
     ]
+    if [n for n, _ in components] != list(COMPONENT_NAMES):
+        raise SystemExit("component list drifted from "
+                         "tpu_capture.COMPONENT_NAMES")
     out = {
         "backend": jax.default_backend(),
         "pop": POP, "length": LENGTH, "ngen": NGEN,
@@ -139,8 +149,24 @@ def main():
         out["tunnel_down"] = True
     out_path = None
     if "--out" in sys.argv:
-        out_path = sys.argv[sys.argv.index("--out") + 1]
+        i = sys.argv.index("--out")
+        if i + 1 >= len(sys.argv):
+            sys.exit("usage: bench_profile.py [--out OUT_JSONL]")
+        out_path = sys.argv[i + 1]
+    # resume: rows already captured for this backend in an earlier
+    # window are not re-paid (each costs a multi-minute tunnel compile)
+    done = set()
+    if out_path:
+        from tpu_capture import _jsonl_rows
+        for d in _jsonl_rows(out_path):
+            if d.get("backend") == out["backend"] and "ms_per_gen" in d:
+                done.add(d.get("component"))
+                out["ms_per_gen"][d["component"]] = d["ms_per_gen"]
     for name, build in components:
+        if name in done:
+            print(f'{{"component": "{name}", "skipped": "captured"}}',
+                  flush=True)
+            continue
         ms = round(timed(build(), packed, fit) * 1e3, 4)
         out["ms_per_gen"][name] = ms
         line = {"component": name, "ms_per_gen": ms,
@@ -152,6 +178,13 @@ def main():
     print(json.dumps(out), flush=True)
 
     if tdir is not None:
+        if out["backend"] != "tpu":
+            # a CPU xplane under the TPU trace dir would satisfy
+            # tpu_capture's _have_trace forever and stop the watcher
+            # with the wrong artifact
+            print(f"backend is {out['backend']}, not tpu — "
+                  f"skipping trace capture")
+            return
         run = full("binned")
         sync(run(jax.random.key(0), packed, fit))
         with trace(tdir):

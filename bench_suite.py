@@ -328,6 +328,15 @@ CONFIGS = [
     ("cmaes_n100_lam4096", bench_cmaes),
 ]
 
+# tpu_capture.queue_complete() keeps its own copy of this list (it
+# cannot import us — our `import bench` side effect probes the relay);
+# fail loudly here if the two ever drift
+from tpu_capture import SUITE_CONFIG_NAMES  # noqa: E402
+
+if tuple(n for n, _ in CONFIGS) != SUITE_CONFIG_NAMES:
+    raise SystemExit("CONFIGS drifted from "
+                     "tpu_capture.SUITE_CONFIG_NAMES")
+
 
 def run_one(name: str) -> dict:
     fn = dict(CONFIGS)[name]
@@ -365,15 +374,9 @@ def main_isolated(out_path, timeout_s):
     # resume support: a config whose TPU value already landed in
     # out_path (from an earlier uptime window) is not re-run — windows
     # are scarce and a captured row is a captured row
-    done = set()
-    if os.path.exists(out_path):
-        for ln in open(out_path):
-            try:
-                d = json.loads(ln)
-            except json.JSONDecodeError:
-                continue
-            if "value" in d and d.get("backend") == "tpu":
-                done.add(d["metric"])
+    from tpu_capture import _jsonl_rows
+    done = {d["metric"] for d in _jsonl_rows(out_path)
+            if "value" in d and d.get("backend") == "tpu"}
     for i, (name, _) in enumerate(CONFIGS):
         metric = f"{name}_generations_per_sec"
         if metric in done:
