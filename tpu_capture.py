@@ -32,7 +32,14 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, HERE)
 from _axon_probe import axon_tunnel_reachable  # noqa: E402
 
-EVIDENCE = os.path.join(HERE, "TPU_EVIDENCE_r03.jsonl")
+# single source for every round-stamped artifact name — STEPS and the
+# _have_* predicates both derive from these, so a round bump cannot
+# leave queue_complete() reading stale files
+ROUND = "r03"
+EVIDENCE = os.path.join(HERE, f"TPU_EVIDENCE_{ROUND}.jsonl")
+SUITE_OUT = f"TPU_SUITE_{ROUND}.jsonl"
+PROFILE_OUT = f"TPU_PROFILE_{ROUND}.jsonl"
+TRACE_DIR = os.path.join("traces", ROUND)
 
 STEPS = [
     # hw-kernel semantics validated on-chip BEFORE any throughput
@@ -45,14 +52,14 @@ STEPS = [
     ("_tpu_hw_check.py", [sys.executable, "_tpu_hw_check.py"], 1200),
     ("bench.py", [sys.executable, "bench.py"], 2400),
     ("bench_suite.py", [sys.executable, "bench_suite.py", "--isolated",
-                        "--out", "TPU_SUITE_r03.jsonl"], 9000),
+                        "--out", SUITE_OUT], 9000),
     ("bench_profile.py", [sys.executable, "bench_profile.py",
-                          "--out", "TPU_PROFILE_r03.jsonl"], 3600),
+                          "--out", PROFILE_OUT], 3600),
     # --out here too: resume skips the already-captured component
     # timings so a short window spends its minutes on the trace itself
     ("bench_profile.py --trace", [sys.executable, "bench_profile.py",
-                                  "--trace", "traces/r03",
-                                  "--out", "TPU_PROFILE_r03.jsonl"], 2400),
+                                  "--trace", TRACE_DIR,
+                                  "--out", PROFILE_OUT], 2400),
 ]
 
 # canonical artifact inventories for queue_complete(). Kept HERE (not
@@ -67,7 +74,7 @@ SUITE_CONFIG_NAMES = (
 )
 COMPONENT_NAMES = (
     "full_binned", "kernel_fused_packed", "select_binned",
-    "gather_random", "full_sorted", "select_sorted",
+    "gather_random", "gather_sorted", "full_sorted", "select_sorted",
     "counting_mxu", "counting_scan",
 )
 
@@ -106,7 +113,7 @@ def _have_headline():
 
 def _have_suite():
     suite = {r["metric"] for r in
-             _jsonl_rows(os.path.join(HERE, "TPU_SUITE_r03.jsonl"))
+             _jsonl_rows(os.path.join(HERE, SUITE_OUT))
              if r.get("backend") == "tpu" and "value" in r}
     return all(f"{n}_generations_per_sec" in suite
                for n in SUITE_CONFIG_NAMES)
@@ -114,7 +121,7 @@ def _have_suite():
 
 def _have_profile():
     prof = {r.get("component") for r in
-            _jsonl_rows(os.path.join(HERE, "TPU_PROFILE_r03.jsonl"))
+            _jsonl_rows(os.path.join(HERE, PROFILE_OUT))
             if r.get("backend") == "tpu"}
     return prof.issuperset(COMPONENT_NAMES)
 
@@ -124,7 +131,7 @@ def _have_trace():
     trace run killed mid-write leaves plugins/... scaffolding that
     must not satisfy the watcher's stop condition."""
     import glob
-    return bool(glob.glob(os.path.join(HERE, "traces", "r03", "**",
+    return bool(glob.glob(os.path.join(HERE, TRACE_DIR, "**",
                                        "*.xplane.pb"), recursive=True))
 
 
@@ -139,6 +146,11 @@ CAPTURED = {
     "bench_profile.py": _have_profile,
     "bench_profile.py --trace": _have_trace,
 }
+
+
+if {s for s, _, _ in STEPS} != set(CAPTURED):
+    raise SystemExit("STEPS and CAPTURED drifted — every queue step "
+                     "needs a captured-predicate and vice versa")
 
 
 def already_captured(step):
@@ -162,8 +174,8 @@ def log(step, payload):
 
 
 def commit(step):
-    paths = [p for p in ("TPU_EVIDENCE_r03.jsonl", "TPU_SUITE_r03.jsonl",
-                         "TPU_PROFILE_r03.jsonl",
+    paths = [p for p in (os.path.basename(EVIDENCE), SUITE_OUT,
+                         PROFILE_OUT,
                          "TPU_PROBE_LOG.jsonl", "traces")
              if os.path.exists(os.path.join(HERE, p))]
     subprocess.run(["git", "add", "-A"] + paths,
