@@ -103,18 +103,22 @@ def main():
         return (packed[idx], fit)
     gather_only = scanned(gather_step)
 
-    # 2b. the same row gather with MONOTONE indices — if XLA's TPU
-    # gather rewards coherent access, a counting-sort-the-parent-ranks
+    # 2b. the same row gather with NEAR-COHERENT indices (monotone ramp
+    # + bounded jitter, so duplicates and small back-steps occur but
+    # accesses stay block-local) — built WITHOUT a sort so the row
+    # isolates the pure access-pattern effect. If this beats
+    # gather_random decisively, a counting-sort-the-parent-ranks
     # restructuring of the generation step becomes the next roofline
-    # move; if the two rows tie, the gather is index-order-insensitive
-    # and the attack should aim elsewhere. The sort itself is excluded
-    # from what this row is *for*, but it is included in the timing, so
-    # read it as "sorted gather + sort overhead" vs gather_random.
-    def gather_sorted_step(c, k):
+    # move (its counting sort costs extra, but that trade can then be
+    # sized from the select_binned row); a tie means XLA's gather is
+    # index-order-insensitive and the attack should aim elsewhere.
+    def gather_coherent_step(c, k):
         packed, fit = c
-        idx = jnp.sort(jax.random.randint(k, (POP,), 0, POP))
+        idx = jnp.clip(jnp.arange(POP) +
+                       jax.random.randint(k, (POP,), -512, 512),
+                       0, POP - 1)
         return (packed[idx], fit)
-    gather_sorted = scanned(gather_sorted_step)
+    gather_coherent = scanned(gather_coherent_step)
 
     # 3. kernel alone: variation+eval on the unshuffled rows
     def kernel_step(c, k):
@@ -145,7 +149,7 @@ def main():
         ("kernel_fused_packed", lambda: kernel_only),
         ("select_binned", lambda: sel_binned),
         ("gather_random", lambda: gather_only),
-        ("gather_sorted", lambda: gather_sorted),
+        ("gather_coherent", lambda: gather_coherent),
         ("full_sorted", lambda: full("sorted")),
         ("select_sorted", lambda: sel_sorted),
         ("counting_mxu", lambda: sel_mode("mxu")),

@@ -1,0 +1,129 @@
+"""Unit tests for the TPU-evidence capture machinery (tpu_capture.py).
+
+The relay watcher's stop condition and per-step skip predicates decide
+what gets measured during scarce relay uptime windows; a regression
+here silently discards evidence (see the 2026-07-31 03:18 window,
+where 40 of 44 minutes were spent re-proving captured artifacts).
+These tests pin the predicate semantics against synthetic artifacts —
+no jax, no relay, no subprocesses.
+"""
+
+import json
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.fast
+
+
+@pytest.fixture
+def capture(tmp_path, monkeypatch):
+    import tpu_capture as t
+
+    monkeypatch.setattr(t, "HERE", str(tmp_path))
+    monkeypatch.setattr(t, "EVIDENCE",
+                        str(tmp_path / "TPU_EVIDENCE_test.jsonl"))
+    return t
+
+
+def _write(path, rows):
+    with open(path, "a") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def _evidence(t, script, results):
+    _write(t.EVIDENCE, [{"ts": "x", "script": script, "results": results}])
+
+
+def test_steps_and_predicates_cannot_drift(capture):
+    assert {s for s, _, _ in capture.STEPS} == set(capture.CAPTURED)
+
+
+def test_empty_state_nothing_captured(capture):
+    for step in capture.CAPTURED:
+        assert not capture.already_captured(step)
+    assert not capture.queue_complete()
+
+
+def test_hw_check_requires_passing_row(capture):
+    # a failed or fallback row must not suppress re-validation
+    _evidence(capture, "_tpu_hw_check.py", [{"check": "hw", "ok": False}])
+    _evidence(capture, "_tpu_hw_check.py", [{"skipped": "no tpu"}])
+    assert not capture.already_captured("_tpu_hw_check.py")
+    _evidence(capture, "_tpu_hw_check.py", [{"check": "hw", "ok": True}])
+    assert capture.already_captured("_tpu_hw_check.py")
+
+
+def test_headline_rejects_cpu_error_and_zero_rows(capture):
+    for bad in ({"value": 3.5, "backend": "cpu", "tunnel_down": True},
+                {"value": 0.0, "backend": "tpu",
+                 "error": "all candidates failed"},
+                {"value": 0.0, "backend": "tpu"}):
+        _evidence(capture, "bench.py", [bad])
+    assert not capture.already_captured("bench.py")
+    _evidence(capture, "bench.py", [{"value": 449.42, "backend": "tpu"}])
+    assert capture.already_captured("bench.py")
+
+
+def test_suite_needs_every_config_with_tpu_backing(capture, tmp_path):
+    suite = tmp_path / capture.SUITE_OUT
+    rows = [{"metric": f"{n}_generations_per_sec", "value": 1.0,
+             "backend": "tpu"} for n in capture.SUITE_CONFIG_NAMES[:-1]]
+    # the last config: error row only
+    rows.append({"metric":
+                 f"{capture.SUITE_CONFIG_NAMES[-1]}_generations_per_sec",
+                 "error": "timeout"})
+    _write(suite, rows)
+    assert not capture.already_captured("bench_suite.py")
+    _write(suite, [{"metric":
+                    f"{capture.SUITE_CONFIG_NAMES[-1]}_generations_per_sec",
+                    "value": 2.0, "backend": "tpu"}])
+    assert capture.already_captured("bench_suite.py")
+
+
+def test_profile_needs_every_component(capture, tmp_path):
+    prof = tmp_path / capture.PROFILE_OUT
+    _write(prof, [{"component": c, "ms_per_gen": 1.0, "backend": "tpu"}
+                  for c in capture.COMPONENT_NAMES[:-1]])
+    assert not capture.already_captured("bench_profile.py")
+    # CPU rows for the missing component don't count
+    _write(prof, [{"component": capture.COMPONENT_NAMES[-1],
+                   "ms_per_gen": 1.0, "backend": "cpu"}])
+    assert not capture.already_captured("bench_profile.py")
+    _write(prof, [{"component": capture.COMPONENT_NAMES[-1],
+                   "ms_per_gen": 1.0, "backend": "tpu"}])
+    assert capture.already_captured("bench_profile.py")
+
+
+def test_trace_needs_finalised_xplane(capture, tmp_path):
+    tdir = tmp_path / capture.TRACE_DIR / "plugins" / "profile" / "run1"
+    tdir.mkdir(parents=True)
+    # scaffolding without a finalised xplane file must not satisfy
+    (tdir / "partial.tmp").write_text("x")
+    assert not capture.already_captured("bench_profile.py --trace")
+    (tdir / "host.xplane.pb").write_bytes(b"\x00")
+    assert capture.already_captured("bench_profile.py --trace")
+
+
+def test_queue_complete_only_when_everything_landed(capture, tmp_path):
+    _evidence(capture, "_tpu_hw_check.py", [{"check": "hw", "ok": True}])
+    _evidence(capture, "bench.py", [{"value": 449.4, "backend": "tpu"}])
+    _write(tmp_path / capture.SUITE_OUT,
+           [{"metric": f"{n}_generations_per_sec", "value": 1.0,
+             "backend": "tpu"} for n in capture.SUITE_CONFIG_NAMES])
+    _write(tmp_path / capture.PROFILE_OUT,
+           [{"component": c, "ms_per_gen": 1.0, "backend": "tpu"}
+            for c in capture.COMPONENT_NAMES])
+    assert not capture.queue_complete()  # trace still missing
+    tdir = tmp_path / capture.TRACE_DIR
+    tdir.mkdir(parents=True)
+    (tdir / "host.xplane.pb").write_bytes(b"\x00")
+    assert capture.queue_complete()
+
+
+def test_tolerant_jsonl_reader(capture, tmp_path):
+    p = tmp_path / "rows.jsonl"
+    p.write_text('{"a": 1}\nnot json — a writer died here\n{"b": 2}\n')
+    assert capture._jsonl_rows(str(p)) == [{"a": 1}, {"b": 2}]
+    assert capture._jsonl_rows(str(tmp_path / "missing.jsonl")) == []

@@ -4,8 +4,9 @@ The axon relay has been up for ~15 minutes total across rounds 2-3;
 when it answers, every driver-parseable artifact must be captured
 before it wedges again. This orchestrator runs the whole measurement
 queue with per-step subprocess isolation (a wedge costs one step, not
-the window), appends each result to ``TPU_EVIDENCE_r03.jsonl`` the
-moment it lands, and git-commits after every step so evidence survives
+the window), appends each result to the round's evidence file
+(``TPU_EVIDENCE_{ROUND}.jsonl`` — see the ROUND constant) the moment
+it lands, and git-commits after every step so evidence survives
 anything.
 
 Queue order is cheapest-first / highest-value-first:
@@ -15,8 +16,9 @@ Queue order is cheapest-first / highest-value-first:
    counting-sort modes (the roofline evidence VERDICT r1/r2 asked for).
 3. ``bench_suite.py --isolated`` — the five secondary configs, each in
    its own subprocess, cmaes (the wedge suspect) last.
-4. ``bench_profile.py --trace traces/r03`` — xplane capture, last:
-   it adds nothing numeric and profiling has its own wedge risk.
+4. ``bench_profile.py --trace`` into the round's trace dir — xplane
+   capture, last: it adds nothing numeric and profiling has its own
+   wedge risk.
 
 Usage: ``python tpu_capture.py`` (checks the relay first, exits 0 with
 a message if it is down; safe to re-run — steps append, never clobber).
@@ -74,7 +76,7 @@ SUITE_CONFIG_NAMES = (
 )
 COMPONENT_NAMES = (
     "full_binned", "kernel_fused_packed", "select_binned",
-    "gather_random", "gather_sorted", "full_sorted", "select_sorted",
+    "gather_random", "gather_coherent", "full_sorted", "select_sorted",
     "counting_mxu", "counting_scan",
 )
 
