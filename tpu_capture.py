@@ -107,9 +107,10 @@ def _have_hw_check():
 
 def _have_headline():
     """A real TPU headline row ("error" rows — the all-candidates-
-    failed sentinel carries value=0.0 — don't count)."""
+    failed sentinel carries value=0.0 — don't count; neither do
+    "cached" rows, which are bench.py replays of earlier captures)."""
     return any(r.get("backend") == "tpu" and r.get("value")
-               and "error" not in r
+               and "error" not in r and not r.get("cached")
                for r in _evidence_results("bench.py"))
 
 
