@@ -114,10 +114,13 @@ def packed_selector(select="sorted"):
     return lambda k, w, n: ops.sel_tournament_sorted(k, w, n, tournsize=3)
 
 
-def make_run_packed(select="sorted"):
+def make_run_packed(select="sorted", block_i=1024):
     """TPU path, bit-packed genomes: 32 genes/uint32 word cuts the
     genome HBM stream 8× (see deap_tpu.ops.packed); rank-based
-    tournament avoids per-aspirant fitness gathers."""
+    tournament avoids per-aspirant fitness gathers. ``block_i`` is the
+    kernel's rows-per-grid-program tile — raced because the per-program
+    footprint is tiny (16 B/row) and fewer, larger programs may beat
+    the 1024-row default at this kernel's scale."""
     sel = packed_selector(select)
 
     def gen_step(carry, key):
@@ -126,7 +129,7 @@ def make_run_packed(select="sorted"):
         idx = sel(k_sel, fit[:, None], POP)
         children, newfit = ops.fused_variation_eval_packed(
             k_var, packed[idx], LENGTH, cxpb=0.5, mutpb=0.2, indpb=0.05,
-            prng="hw", block_i=1024, interpret=False)
+            prng="hw", block_i=block_i, interpret=False)
         return (children, newfit), None
 
     @jax.jit
@@ -150,7 +153,16 @@ def _time(run, *args):
     return best
 
 
-CANDIDATES = ("fused", "packed_sorted", "packed_binned")
+CANDIDATES = ("fused", "packed_sorted", "packed_binned",
+              "packed_binned_b4096", "packed_binned_b8192")
+
+# tpu_capture's re-race predicate needs the roster size without
+# importing this module (our import probes the relay); fail loudly on
+# drift, like SUITE_CONFIG_NAMES/COMPONENT_NAMES
+from tpu_capture import N_CANDIDATES  # noqa: E402
+
+if len(CANDIDATES) != N_CANDIDATES:
+    raise SystemExit("CANDIDATES drifted from tpu_capture.N_CANDIDATES")
 
 
 def _setup():
@@ -164,19 +176,28 @@ def _setup():
 
 
 def _run_candidate(name: str) -> float:
-    """Best-of-REPS seconds for one TPU candidate path."""
+    """Best-of-REPS seconds for one TPU candidate path. Packed names
+    are ``packed_<select>[_b<block_i>]``."""
     _, pop = _setup()
     fit = pop.wvalues[:, 0]
     if name == "fused":
         return _time(make_run_fused(), pop.genomes, fit)
+    parts = name.split("_")
+    block_i = 1024
+    if parts[-1].startswith("b") and parts[-1][1:].isdigit():
+        block_i = int(parts.pop()[1:])
+    select = "_".join(parts[1:])
     packed = ops.pack_genomes(pop.genomes)
-    return _time(make_run_packed(name.split("_", 1)[1]), packed, fit)
+    return _time(make_run_packed(select, block_i), packed, fit)
 
 
-def _race_isolated(timeout_s: int = 900) -> float:
+def _race_isolated(timeout_s: int = 900):
     """Race the TPU candidates in subprocesses so a relay wedge during
     one compile (observed 2026-07-31, mid-eigh) costs that candidate
-    only; returns the best seconds, or +inf if every candidate died."""
+    only. Returns ``(best_seconds, n_completed)`` — +inf if every
+    candidate died; ``n_completed`` counts candidates that actually
+    produced a timing, so a partial race is never mistaken for a full
+    one (tpu_capture's re-race predicate)."""
     import subprocess
 
     me = os.path.abspath(__file__)
@@ -186,6 +207,7 @@ def _race_isolated(timeout_s: int = 900) -> float:
     # candidates (and burn its 180 s timeout on a wedged relay)
     os.environ["DEAP_TPU_SKIP_PROBE"] = "1"
     best = float("inf")
+    n_completed = 0
     for name in CANDIDATES:
         if not axon_tunnel_reachable():
             print(f"bench: relay port closed before {name}; stopping "
@@ -200,6 +222,8 @@ def _race_isolated(timeout_s: int = 900) -> float:
                 if ln.startswith("{"):
                     got = json.loads(ln)["seconds"]
                     best = min(best, got)
+            if got is not None:
+                n_completed += 1
             if got is None:
                 print(f"bench: candidate {name} produced no result; "
                       f"stderr tail: {(r.stderr or '')[-400:]}",
@@ -210,7 +234,7 @@ def _race_isolated(timeout_s: int = 900) -> float:
         except (json.JSONDecodeError, KeyError) as e:
             print(f"bench: candidate {name} output unparseable: {e}",
                   file=sys.stderr)
-    return best
+    return best, n_completed
 
 
 def _probe_backend(timeout_s: int = 240) -> str:
@@ -240,13 +264,9 @@ def _cached_tpu_row():
     measurement time: a timestamped on-chip measurement is strictly
     more informative than a live CPU-fallback number, and the relay
     has been reachable for well under an hour per round."""
-    from tpu_capture import EVIDENCE, _jsonl_rows
+    from tpu_capture import headline_rows
 
-    rows = [dict(r, measured_at=d.get("ts"))
-            for d in _jsonl_rows(EVIDENCE) if d.get("script") == "bench.py"
-            for r in d.get("results", [])
-            if r.get("backend") == "tpu" and r.get("value")
-            and "error" not in r and not r.get("cached")]
+    rows = headline_rows()
     # most-recent, not best-ever: the replay must report what the code
     # currently does, not cherry-pick a superseded peak
     return (max(rows, key=lambda r: r["measured_at"] or "")
@@ -269,8 +289,9 @@ def main():
                 "TPU_PROBE_LOG.jsonl)")
             print(json.dumps(cached))
             return
+    n_completed = 0
     if backend == "tpu":
-        dt = _race_isolated()
+        dt, n_completed = _race_isolated()
         if dt == float("inf"):
             # every isolated candidate died (relay wedged under us):
             # report an honest failure line rather than hanging
@@ -292,6 +313,10 @@ def main():
         "unit": "gens/sec",
         "vs_baseline": round(gens_per_sec / REFERENCE_GENS_PER_SEC, 1),
         "backend": backend,
+        # how many candidates actually finished — a partial race (relay
+        # died mid-window) must not satisfy tpu_capture's full-roster
+        # re-race predicate
+        "n_candidates": n_completed,
     }
     if not _TUNNEL_OK:
         # self-describing CPU fallback: the axon relay was down at

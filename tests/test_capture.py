@@ -120,6 +120,12 @@ def test_queue_complete_only_when_everything_landed(capture, tmp_path):
     tdir = tmp_path / capture.TRACE_DIR
     tdir.mkdir(parents=True)
     (tdir / "host.xplane.pb").write_bytes(b"\x00")
+    # still incomplete: the headline race predates the full candidate
+    # roster (no n_candidates stamp)
+    assert not capture.queue_complete()
+    _evidence(capture, "bench.py#rerace",
+              [{"value": 460.0, "backend": "tpu",
+                "n_candidates": capture.N_CANDIDATES}])
     assert capture.queue_complete()
 
 

@@ -52,7 +52,7 @@ STEPS = [
     # all (profile is now incremental via --out, but the suite rows
     # are the higher-value artifact).
     ("_tpu_hw_check.py", [sys.executable, "_tpu_hw_check.py"], 1200),
-    ("bench.py", [sys.executable, "bench.py"], 2400),
+    ("bench.py", [sys.executable, "bench.py"], 5400),
     ("bench_suite.py", [sys.executable, "bench_suite.py", "--isolated",
                         "--out", SUITE_OUT], 9000),
     ("bench_profile.py", [sys.executable, "bench_profile.py",
@@ -62,6 +62,10 @@ STEPS = [
     ("bench_profile.py --trace", [sys.executable, "bench_profile.py",
                                   "--trace", TRACE_DIR,
                                   "--out", PROFILE_OUT], 2400),
+    # LAST: re-race the headline once everything else is captured —
+    # candidates added after the first capture (block-size variants)
+    # are otherwise only measured at the driver's round-end run
+    ("bench.py#rerace", [sys.executable, "bench.py"], 5400),
 ]
 
 # canonical artifact inventories for queue_complete(). Kept HERE (not
@@ -79,6 +83,9 @@ COMPONENT_NAMES = (
     "gather_random", "gather_coherent", "full_sorted", "select_sorted",
     "counting_mxu", "counting_scan",
 )
+# bench.py cross-checks its CANDIDATES length against this (same
+# cannot-import-the-bench-script reason as the lists above)
+N_CANDIDATES = 5
 
 
 def _jsonl_rows(path):
@@ -98,6 +105,24 @@ def _evidence_results(step):
             for r in d.get("results", [])]
 
 
+BENCH_SCRIPTS = ("bench.py", "bench.py#rerace")
+
+
+def headline_rows():
+    """Every VALID TPU headline row, any bench script, with the
+    envelope timestamp attached as ``measured_at``. The single source
+    of what counts as a headline measurement — the capture predicates
+    and bench.py's cached replay must never disagree on this: "error"
+    rows (the all-candidates-failed sentinel carries value=0.0) and
+    "cached" rows (replays of earlier captures) don't count."""
+    return [dict(r, measured_at=d.get("ts"))
+            for d in _jsonl_rows(EVIDENCE)
+            if d.get("script") in BENCH_SCRIPTS
+            for r in d.get("results", [])
+            if r.get("backend") == "tpu" and r.get("value")
+            and "error" not in r and not r.get("cached")]
+
+
 def _have_hw_check():
     """A *passing* on-chip validation — a failed or CPU-fallback row
     must not suppress re-validation in a later window."""
@@ -106,12 +131,7 @@ def _have_hw_check():
 
 
 def _have_headline():
-    """A real TPU headline row ("error" rows — the all-candidates-
-    failed sentinel carries value=0.0 — don't count; neither do
-    "cached" rows, which are bench.py replays of earlier captures)."""
-    return any(r.get("backend") == "tpu" and r.get("value")
-               and "error" not in r and not r.get("cached")
-               for r in _evidence_results("bench.py"))
+    return bool(headline_rows())
 
 
 def _have_suite():
@@ -138,6 +158,15 @@ def _have_trace():
                                        "*.xplane.pb"), recursive=True))
 
 
+def _have_full_race():
+    """A headline row produced by a COMPLETE race of the current
+    candidate roster — bench.py stamps n_candidates with how many
+    candidates actually finished, so partial races (relay died or a
+    candidate timed out mid-race) don't satisfy the re-race step."""
+    return any(r.get("n_candidates", 0) >= N_CANDIDATES
+               for r in headline_rows())
+
+
 # step → "this artifact is already captured with TPU backing". Applied
 # on queue entry so a later window spends its scarce minutes only on
 # what is still missing (the 03:18 window burned 40 of its 44 minutes
@@ -148,6 +177,7 @@ CAPTURED = {
     "bench_suite.py": _have_suite,
     "bench_profile.py": _have_profile,
     "bench_profile.py --trace": _have_trace,
+    "bench.py#rerace": _have_full_race,
 }
 
 
