@@ -1,0 +1,80 @@
+"""Render the round's captured TPU evidence as markdown tables.
+
+Reads the artifacts the capture queue produces (headline rows in
+``TPU_EVIDENCE_{ROUND}.jsonl``, suite rows in ``TPU_SUITE_{ROUND}.jsonl``,
+profile rows in ``TPU_PROFILE_{ROUND}.jsonl``) and prints BASELINE.md-
+ready tables, so summarising a relay window costs seconds, not window
+minutes. Pure file reading — no jax, safe to run any time.
+
+Usage: ``python bench_report.py``
+"""
+
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, HERE)
+from tpu_capture import (  # noqa: E402
+    COMPONENT_NAMES,
+    PROFILE_OUT,
+    SUITE_CONFIG_NAMES,
+    SUITE_EXTRAPOLATED,
+    SUITE_OUT,
+    SUITE_REF,
+    _jsonl_rows,
+    headline_rows,
+)
+
+
+def main() -> None:
+    rows = headline_rows()
+    print("## Headline (OneMax pop=100k)\n")
+    if rows:
+        print("| measured at | gens/sec | vs CPU reference | candidates |")
+        print("|---|---|---|---|")
+        for r in sorted(rows, key=lambda r: r["measured_at"] or ""):
+            print(f"| {r['measured_at']} | **{r['value']}** | "
+                  f"{r.get('vs_baseline', '?')}× | "
+                  f"{r.get('n_candidates', '?')} |")
+    else:
+        print("*(no TPU headline captured yet)*")
+
+    print("\n## Suite configs\n")
+    suite = {r["metric"]: r for r in _jsonl_rows(os.path.join(HERE, SUITE_OUT))
+             if r.get("backend") == "tpu" and "value" in r}
+    print("| config | TPU gens/sec | reference CPU | speedup |")
+    print("|---|---|---|---|")
+    for name in SUITE_CONFIG_NAMES:
+        r = suite.get(f"{name}_generations_per_sec")
+        ref = SUITE_REF[name]
+        # extrapolation is a static property of the reference number,
+        # not of the captured row — mark it on pending rows too
+        extra = " (ref extrapolated)" if name in SUITE_EXTRAPOLATED else ""
+        if r:
+            print(f"| {name} | **{r['value']}** | {ref:.4g}{extra} | "
+                  f"{r.get('vs_baseline', '?')}× |")
+        else:
+            print(f"| {name} | *(pending)* | {ref:.4g}{extra} | |")
+
+    print("\n## Generation-step profile (ms/gen, pop=100k)\n")
+    prof = {}
+    for r in _jsonl_rows(os.path.join(HERE, PROFILE_OUT)):
+        if r.get("backend") == "tpu" and "ms_per_gen" in r:
+            prof[r["component"]] = r["ms_per_gen"]
+    print("| component | ms/gen |")
+    print("|---|---|")
+    for name in COMPONENT_NAMES:
+        v = prof.get(name)
+        print(f"| {name} | {v if v is not None else '*(pending)*'} |")
+    if prof.get("full_binned"):
+        parts = {k: v for k, v in prof.items()
+                 if k in ("select_binned", "gather_random",
+                          "kernel_fused_packed")}
+        if len(parts) == 3:
+            gap = prof["full_binned"] - sum(parts.values())
+            print(f"\nfull_binned − (select + gather + kernel) = "
+                  f"{gap:.4f} ms/gen of fusion/overhead delta.")
+
+
+if __name__ == "__main__":
+    main()

@@ -65,15 +65,12 @@ from deap_tpu.strategies.cma import Strategy
 # nsga2_zdt1_pop50k is EXTRAPOLATED (quadratic sort term from the
 # measured 4k-candidate run; direct measurement infeasible — see
 # BASELINE.md); cartpole is measured with a pure-Python rollout.
-REF = {
-    "cmaes_n100_lam4096": 6.6318,
-    "nsga2_zdt1_pop2000": 0.1662,
-    "rastrigin_n30_pop100k": 0.2693,
-    "gp_symbreg_pop4096_pts256": 3.0766,
-    "nsga2_zdt1_pop50k": 0.1662 * (4_000 / 100_000) ** 2,
-    "cartpole_neuro_pop10k": 0.2398,  # initial-pop (generous); 0.0121 converged
-}
-EXTRAPOLATED = {"nsga2_zdt1_pop50k"}
+# Values live in tpu_capture (the import-light canonical home shared
+# with bench_report.py).
+from tpu_capture import SUITE_EXTRAPOLATED, SUITE_REF  # noqa: E402
+
+REF = SUITE_REF
+EXTRAPOLATED = SUITE_EXTRAPOLATED
 
 NGEN = 50
 REPS = 3

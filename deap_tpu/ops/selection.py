@@ -189,6 +189,20 @@ def sel_tournament_binned(key, w, k, tournsize, low: int, high: int):
     return jnp.take(order, jnp.min(ranks, axis=0))
 
 
+def _validate_positive_mass(values, name):
+    """Roulette-family contract: positive fitness mass. Like
+    ``sel_tournament_binned``'s range check, validated loudly when the
+    values are concrete (an eager caller is sync-bound anyway); under
+    jit the contract is the caller's responsibility — the reference
+    makes the same silent assumption (selection.py:71-103)."""
+    if not isinstance(values, jax.core.Tracer) and values.shape[0]:
+        if float(values.min()) < 0 or float(values.sum()) <= 0:
+            raise ValueError(
+                f"{name}: fitness-proportionate selection needs "
+                f"non-negative values with positive total mass; got "
+                f"min={float(values.min())}, sum={float(values.sum())}")
+
+
 def sel_roulette(key, w, k, values: Optional[jnp.ndarray] = None):
     """Fitness-proportionate selection on the first objective
     (selection.py:71-103): individuals sorted best-first, k spins over the
@@ -198,6 +212,7 @@ def sel_roulette(key, w, k, values: Optional[jnp.ndarray] = None):
     """
     if values is None:
         values = w[..., 0]
+    _validate_positive_mass(values, "sel_roulette")
     order = lex_sort_desc(w)
     sorted_vals = jnp.take(values, order)
     cs = jnp.cumsum(sorted_vals)
@@ -213,6 +228,7 @@ def sel_stochastic_universal_sampling(key, w, k, values: Optional[jnp.ndarray] =
     from one random start over the best-first cumulative distribution."""
     if values is None:
         values = w[..., 0]
+    _validate_positive_mass(values, "sel_stochastic_universal_sampling")
     order = lex_sort_desc(w)
     sorted_vals = jnp.take(values, order)
     cs = jnp.cumsum(sorted_vals)

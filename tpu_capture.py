@@ -87,6 +87,20 @@ COMPONENT_NAMES = (
 # cannot-import-the-bench-script reason as the lists above)
 N_CANDIDATES = 5
 
+# reference CPU gens/sec per suite config, and which references are
+# extrapolated rather than measured (BASELINE.md records the recipes).
+# Canonical HERE for the same import-weight reason; bench_suite
+# imports and uses these directly so values cannot drift.
+SUITE_REF = {
+    "cmaes_n100_lam4096": 6.6318,
+    "nsga2_zdt1_pop2000": 0.1662,
+    "rastrigin_n30_pop100k": 0.2693,
+    "gp_symbreg_pop4096_pts256": 3.0766,
+    "nsga2_zdt1_pop50k": 0.1662 * (4_000 / 100_000) ** 2,
+    "cartpole_neuro_pop10k": 0.2398,  # initial-pop (generous); 0.0121 converged
+}
+SUITE_EXTRAPOLATED = {"nsga2_zdt1_pop50k"}
+
 
 def _jsonl_rows(path):
     rows = []
