@@ -141,6 +141,31 @@ def make_run_packed(select="sorted", block_i=1024):
     return run
 
 
+def make_run_selgather():
+    """TPU path, VMEM-resident selection: tournament + parent gather in
+    ONE single-program Pallas kernel (the packed population and fitness
+    fit in VMEM whole at this scale — see
+    ops.packed.sel_tournament_gather_packed), then the tiled fused
+    variation kernel. No sort, no rank permutation, no XLA gather."""
+    def gen_step(carry, key):
+        packed, fit = carry
+        k_sel, k_var = jax.random.split(key)
+        parents = ops.sel_tournament_gather_packed(
+            k_sel, packed, fit, tournsize=3, prng="hw", interpret=False)
+        children, newfit = ops.fused_variation_eval_packed(
+            k_var, parents, LENGTH, cxpb=0.5, mutpb=0.2, indpb=0.05,
+            prng="hw", block_i=1024, interpret=False)
+        return (children, newfit), None
+
+    @jax.jit
+    def run(key, packed, fit):
+        (_, f), _ = lax.scan(gen_step, (packed, fit),
+                             jax.random.split(key, NGEN))
+        return f
+
+    return run
+
+
 def _time(run, *args):
     """Best-of-REPS wall seconds of run(*args); sync() is the actual
     completion barrier (see support.profiling.sync)."""
@@ -154,7 +179,8 @@ def _time(run, *args):
 
 
 CANDIDATES = ("fused", "packed_sorted", "packed_binned",
-              "packed_binned_b4096", "packed_binned_b8192")
+              "packed_binned_b4096", "packed_binned_b8192",
+              "packed_selgather")
 
 # tpu_capture's re-race predicate needs the roster size without
 # importing this module (our import probes the relay); fail loudly on
@@ -182,6 +208,9 @@ def _run_candidate(name: str) -> float:
     fit = pop.wvalues[:, 0]
     if name == "fused":
         return _time(make_run_fused(), pop.genomes, fit)
+    if name == "packed_selgather":
+        packed = ops.pack_genomes(pop.genomes)
+        return _time(make_run_selgather(), packed, fit)
     parts = name.split("_")
     block_i = 1024
     if parts[-1].startswith("b") and parts[-1][1:].isdigit():

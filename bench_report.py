@@ -16,13 +16,12 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, HERE)
 from tpu_capture import (  # noqa: E402
     COMPONENT_NAMES,
-    PROFILE_OUT,
     SUITE_CONFIG_NAMES,
     SUITE_EXTRAPOLATED,
-    SUITE_OUT,
     SUITE_REF,
-    _jsonl_rows,
     headline_rows,
+    profile_rows,
+    suite_rows,
 )
 
 
@@ -40,8 +39,7 @@ def main() -> None:
         print("*(no TPU headline captured yet)*")
 
     print("\n## Suite configs\n")
-    suite = {r["metric"]: r for r in _jsonl_rows(os.path.join(HERE, SUITE_OUT))
-             if r.get("backend") == "tpu" and "value" in r}
+    suite = suite_rows()
     print("| config | TPU gens/sec | reference CPU | speedup |")
     print("|---|---|---|---|")
     for name in SUITE_CONFIG_NAMES:
@@ -57,10 +55,7 @@ def main() -> None:
             print(f"| {name} | *(pending)* | {ref:.4g}{extra} | |")
 
     print("\n## Generation-step profile (ms/gen, pop=100k)\n")
-    prof = {}
-    for r in _jsonl_rows(os.path.join(HERE, PROFILE_OUT)):
-        if r.get("backend") == "tpu" and "ms_per_gen" in r:
-            prof[r["component"]] = r["ms_per_gen"]
+    prof = {c: r["ms_per_gen"] for c, r in profile_rows().items()}
     print("| component | ms/gen |")
     print("|---|---|")
     for name in COMPONENT_NAMES:

@@ -71,6 +71,7 @@ from deap_tpu.ops.packed import (
     pack_genomes,
     packed_fitness,
     popcount,
+    sel_tournament_gather_packed,
     unpack_genomes,
 )
 from deap_tpu.ops.selection import (

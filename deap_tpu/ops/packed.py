@@ -51,6 +51,7 @@ __all__ = [
     "cx_two_point_packed",
     "mut_flip_bit_packed",
     "fused_variation_eval_packed",
+    "sel_tournament_gather_packed",
 ]
 
 WORD = 32
@@ -300,3 +301,124 @@ def fused_variation_eval_packed(key: jax.Array, packed: jnp.ndarray,
         prng=prng, interp=interp, block_i=block_i,
         genebit_cols=W * WORD, out_dtype=jnp.uint32)
     return out[:n], fit[:n, 0]
+
+
+# ======================================================= select + gather ==
+
+def _selgather_body(gT, fitT, draws, *, n, tournsize):
+    """Tournament winners and their gathered columns, all in VMEM.
+
+    Everything is LANE-MAJOR: the population axis runs along the 128
+    vector lanes, because VMEM tiles the minor axis to 128 lanes — a
+    row-major ``[n, W]`` resident table at W=4 would silently allocate
+    32× its logical size (51 MB at n=100k) and blow the ~16 MB VMEM
+    budget, while ``[W, n]`` is dense (~3.2 MB).
+
+    ``draws`` is ``uint32[tournsize, N]``; aspirant ``t`` of child
+    ``j`` is ``draws[t, j] % n`` (modulo bias < n/2**32). The best-
+    fitness aspirant wins; strict ``>`` keeps the first-drawn on ties,
+    matching the reference's ``max()`` (selection.py:63-69). The
+    fitness lookups and the final column gather are lane-axis
+    ``take_along_axis`` ops, which Mosaic lowers to the native
+    ``tpu.dynamic_gather`` — the point of this kernel: no serial XLA
+    gather ever touches HBM.
+    """
+    best_idx = (draws[0:1, :] % np.uint32(n)).astype(jnp.int32)
+    best_fit = jnp.take_along_axis(fitT, best_idx, axis=1,
+                                   mode="promise_in_bounds")
+    for t in range(1, tournsize):
+        idx = (draws[t:t + 1, :] % np.uint32(n)).astype(jnp.int32)
+        f = jnp.take_along_axis(fitT, idx, axis=1,
+                                mode="promise_in_bounds")
+        better = f > best_fit
+        best_idx = jnp.where(better, idx, best_idx)
+        best_fit = jnp.where(better, f, best_fit)
+    W, N = gT.shape
+    idx_w = jnp.broadcast_to(best_idx, (W, N))
+    return jnp.take_along_axis(gT, idx_w, axis=1,
+                               mode="promise_in_bounds")
+
+
+def _selgather_kernel_hw(seed_ref, gT_ref, fitT_ref, out_ref, *, n,
+                         tournsize):
+    pltpu.prng_seed(seed_ref[0])
+    N = gT_ref.shape[1]
+    # one (1, N) draw per stage: full lane width each, nothing wasted
+    draws = jnp.concatenate(
+        [pltpu.bitcast(pltpu.prng_random_bits((1, N)), jnp.uint32)
+         for _ in range(tournsize)], axis=0)
+    out_ref[:] = _selgather_body(gT_ref[:], fitT_ref[:], draws,
+                                 n=n, tournsize=tournsize)
+
+
+def _selgather_kernel_bits(gT_ref, fitT_ref, draws_ref, out_ref, *, n,
+                           tournsize):
+    out_ref[:] = _selgather_body(gT_ref[:], fitT_ref[:], draws_ref[:],
+                                 n=n, tournsize=tournsize)
+
+
+def sel_tournament_gather_packed(key: jax.Array, packed: jnp.ndarray,
+                                 fit: jnp.ndarray, tournsize: int = 3,
+                                 prng: str = "auto",
+                                 interpret: Optional[bool] = None,
+                                 ) -> jnp.ndarray:
+    """Tournament-select ``n`` parents AND gather their rows in one
+    single-program Pallas kernel — the population-resident-in-VMEM
+    formulation of ``sel_tournament`` + ``packed[idx]``.
+
+    At pop = 100k the packed population is ``n·W`` words — lane-major
+    (transposed to ``[W, n]``, population along the 128 lanes) that is
+    ~3.2 MB resident in VMEM incl. sublane padding, leaving room for
+    the fitness row and the parent output inside the ~16 MB budget;
+    selection then needs no sort, no rank permutation, and no XLA
+    gather — each child draws ``tournsize`` aspirant indices, looks
+    their fitness up with the lane-axis ``dynamic_gather``, and copies
+    the winning column, all inside the chip. One HBM read of the
+    population and one write of the parents replace the counting-sort
+    + double-gather chain of the binned path (reference hot loop being
+    replaced: examples/ga/onemax.py:72-157 select step; semantics:
+    selTournament, tools/selection.py:32-46). The XLA transposes at
+    the boundary are dense-layout copies (~1.6 MB each way at 100k).
+
+    :param packed: ``uint32[n, W]`` rows from :func:`pack_genomes`.
+    :param fit: ``f32[n]`` fitness (weighted first objective).
+    :returns: ``uint32[n, W]`` parent rows, one per child slot.
+    """
+    from deap_tpu.ops.kernels import (
+        _auto_interpret,
+        _resolve_prng,
+        _round_up,
+    )
+
+    n, W = packed.shape
+    interp = _auto_interpret(interpret)
+    prng = _resolve_prng(prng, interp)
+    ni = _round_up(n, 128)
+    gT = jnp.pad(packed.T, ((0, 0), (0, ni - n)))
+    # -inf pad: unreachable anyway (draws are % n), belt and braces
+    fT = jnp.pad(fit.astype(jnp.float32), (0, ni - n),
+                 constant_values=-jnp.inf)[None, :]
+    vmem = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
+    out_shape = jax.ShapeDtypeStruct((W, ni), jnp.uint32)
+    if prng == "hw":
+        seed = jax.random.randint(key, (1,), 0, 2**31 - 1, jnp.int32)
+        outT = pl.pallas_call(
+            functools.partial(_selgather_kernel_hw, n=n,
+                              tournsize=tournsize),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM), vmem(),
+                      vmem()],
+            out_specs=vmem(),
+            out_shape=out_shape,
+            interpret=interp,
+        )(seed, gT, fT)
+    else:
+        draws = jax.random.bits(key, (tournsize, ni), jnp.uint32)
+        outT = pl.pallas_call(
+            functools.partial(_selgather_kernel_bits, n=n,
+                              tournsize=tournsize),
+            in_specs=[vmem(), vmem(), vmem()],
+            out_specs=vmem(),
+            out_shape=out_shape,
+            interpret=interp,
+        )(gT, fT, draws)
+    return outT.T[:n]

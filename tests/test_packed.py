@@ -136,3 +136,53 @@ def test_fused_packed_hw_rejected_off_tpu():
         pk.fused_variation_eval_packed(
             jax.random.key(0), g, 100, cxpb=0.5, mutpb=0.2, indpb=0.05,
             prng="hw", interpret=True)
+
+
+def test_selgather_exact_vs_numpy():
+    """Bits-path selection+gather reproduces the tournament exactly:
+    explicit draw stream, winners recomputed in numpy (first-drawn wins
+    ties, like the reference's max())."""
+    n, L = 37, 70
+    bits = jax.random.bernoulli(jax.random.key(11), 0.5, (n, L))
+    g = pk.pack_genomes(bits)
+    fit = pk.packed_fitness(g)
+    key = jax.random.key(5)
+    parents = pk.sel_tournament_gather_packed(
+        key, g, fit, tournsize=3, prng="input", interpret=True)
+    assert parents.shape == g.shape and parents.dtype == jnp.uint32
+
+    ni = -(-n // 128) * 128
+    draws = np.asarray(jax.random.bits(key, (3, ni), jnp.uint32))
+    fit_np = np.asarray(fit)
+    g_np = np.asarray(g)
+    for i in range(n):
+        aspirants = (draws[:, i] % np.uint32(n)).astype(np.int64)
+        best = aspirants[0]
+        for a in aspirants[1:]:
+            if fit_np[a] > fit_np[best]:
+                best = a
+        np.testing.assert_array_equal(np.asarray(parents[i]), g_np[best],
+                                      err_msg=f"row {i}")
+
+
+def test_selgather_selection_pressure_and_membership():
+    n, L = 300, 100
+    bits = jax.random.bernoulli(jax.random.key(3), 0.5, (n, L))
+    g = pk.pack_genomes(bits)
+    fit = pk.packed_fitness(g)
+    parents = pk.sel_tournament_gather_packed(
+        jax.random.key(9), g, fit, tournsize=3, prng="input",
+        interpret=True)
+    # every output row is a population member
+    pop_set = {bytes(np.asarray(r).tobytes()) for r in np.asarray(g)}
+    for r in np.asarray(parents):
+        assert bytes(r.tobytes()) in pop_set
+    # min-of-3 tournament raises mean fitness
+    assert float(pk.packed_fitness(parents).mean()) > float(fit.mean())
+
+
+def test_selgather_hw_rejected_off_tpu():
+    g = jnp.zeros((8, 4), jnp.uint32)
+    with pytest.raises(ValueError, match="hw"):
+        pk.sel_tournament_gather_packed(
+            jax.random.key(0), g, jnp.zeros(8), prng="hw", interpret=True)

@@ -85,7 +85,7 @@ COMPONENT_NAMES = (
 )
 # bench.py cross-checks its CANDIDATES length against this (same
 # cannot-import-the-bench-script reason as the lists above)
-N_CANDIDATES = 5
+N_CANDIDATES = 6
 
 # reference CPU gens/sec per suite config, and which references are
 # extrapolated rather than measured (BASELINE.md records the recipes).
@@ -148,19 +148,30 @@ def _have_headline():
     return bool(headline_rows())
 
 
+def suite_rows():
+    """Valid TPU suite rows, keyed by metric — shared by the capture
+    predicate and bench_report so they can never disagree."""
+    return {r["metric"]: r for r in
+            _jsonl_rows(os.path.join(HERE, SUITE_OUT))
+            if r.get("backend") == "tpu" and "value" in r}
+
+
+def profile_rows():
+    """Valid TPU profile rows, keyed by component — shared by the
+    capture predicate and bench_report."""
+    return {r["component"]: r for r in
+            _jsonl_rows(os.path.join(HERE, PROFILE_OUT))
+            if r.get("backend") == "tpu" and "ms_per_gen" in r}
+
+
 def _have_suite():
-    suite = {r["metric"] for r in
-             _jsonl_rows(os.path.join(HERE, SUITE_OUT))
-             if r.get("backend") == "tpu" and "value" in r}
+    suite = suite_rows()
     return all(f"{n}_generations_per_sec" in suite
                for n in SUITE_CONFIG_NAMES)
 
 
 def _have_profile():
-    prof = {r.get("component") for r in
-            _jsonl_rows(os.path.join(HERE, PROFILE_OUT))
-            if r.get("backend") == "tpu"}
-    return prof.issuperset(COMPONENT_NAMES)
+    return set(profile_rows()).issuperset(COMPONENT_NAMES)
 
 
 def _have_trace():
