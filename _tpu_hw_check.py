@@ -89,33 +89,62 @@ def main():
     rate = float(np.asarray(fit).sum()) / (N * L)
     expect("bytes_flip_rate", abs(rate - 0.05) < 4 * sigma)
 
+    # core verdict printed (and flushed) BEFORE the experimental
+    # selgather block: a compile wedge or process abort in there must
+    # not discard the core checks that already passed on-chip
+    from tpu_capture import HW_CHECK_VERSION
+
+    verdict = {"check": "hw_kernels", "ok": not failures,
+               "version": HW_CHECK_VERSION}
+    if failures:
+        verdict["failed"] = failures
+    print(json.dumps(verdict), flush=True)
+
     # --- selection+gather kernel (VMEM-resident dynamic_gather) ------------
     # CPU pytest covers the bits path exactly; here the hw-PRNG path and
     # the Mosaic dynamic_gather lowering are validated on the real chip.
-    g = jax.random.bernoulli(jax.random.key(5), 0.5, (N, L))
-    p = pk.pack_genomes(g)
-    fit = pk.packed_fitness(p)
-    par = pk.sel_tournament_gather_packed(
-        jax.random.key(6), p, fit, tournsize=3, prng="hw",
-        interpret=False)
-    par2 = pk.sel_tournament_gather_packed(
-        jax.random.key(6), p, fit, tournsize=3, prng="hw",
-        interpret=False)
-    expect("selgather_deterministic",
-           (np.asarray(par) == np.asarray(par2)).all())
-    pop_set = {r.tobytes() for r in np.asarray(p)}
-    expect("selgather_membership",
-           all(r.tobytes() in pop_set for r in np.asarray(par)))
-    # min-of-3 rank tournament: E[winner fitness] strictly above the
-    # population mean; at N=2048, L=100 the uplift is ~4 bits — require
-    # at least 1 (way outside noise) without overfitting a constant
-    expect("selgather_pressure",
-           float(pk.packed_fitness(par).mean()) > float(fit.mean()) + 1.0)
+    # Separate verdict row: selgather is an experimental CANDIDATE (it
+    # self-validates again inside bench.py before being timed) — an
+    # unsupported lowering must not block the core kernels' verdict or
+    # the capture queue's stop condition.
+    selgather_failures = []
+    core_expect = expect
 
-    verdict = {"check": "hw_kernels", "ok": not failures}
-    if failures:
-        verdict["failed"] = failures
-    print(json.dumps(verdict))
+    def expect(name, ok):  # noqa: F811 — selgather block only
+        if not bool(ok):
+            selgather_failures.append(name)
+
+    try:
+        g = jax.random.bernoulli(jax.random.key(5), 0.5, (N, L))
+        p = pk.pack_genomes(g)
+        fit = pk.packed_fitness(p)
+        par = pk.sel_tournament_gather_packed(
+            jax.random.key(6), p, fit, tournsize=3, prng="hw",
+            interpret=False)
+        par2 = pk.sel_tournament_gather_packed(
+            jax.random.key(6), p, fit, tournsize=3, prng="hw",
+            interpret=False)
+        expect("selgather_deterministic",
+               (np.asarray(par) == np.asarray(par2)).all())
+        pop_set = {r.tobytes() for r in np.asarray(p)}
+        expect("selgather_membership",
+               all(r.tobytes() in pop_set for r in np.asarray(par)))
+        # min-of-3 rank tournament: E[winner fitness] strictly above
+        # the population mean; at N=2048, L=100 the uplift is ~4 bits —
+        # require at least 1 (way outside noise)
+        expect("selgather_pressure",
+               float(pk.packed_fitness(par).mean())
+               > float(fit.mean()) + 1.0)
+    except Exception as e:  # Mosaic NotImplementedError, VMEM OOM, ...
+        selgather_failures.append(f"crashed: {type(e).__name__}: "
+                                  f"{str(e)[:200]}")
+    expect = core_expect  # noqa: F841
+
+    sg = {"check": "selgather", "ok": not selgather_failures,
+          "version": HW_CHECK_VERSION}
+    if selgather_failures:
+        sg["failed"] = selgather_failures
+    print(json.dumps(sg))
     return 0 if not failures else 1
 
 

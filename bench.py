@@ -210,6 +210,7 @@ def _run_candidate(name: str) -> float:
         return _time(make_run_fused(), pop.genomes, fit)
     if name == "packed_selgather":
         packed = ops.pack_genomes(pop.genomes)
+        _validate_selgather(packed, fit)
         return _time(make_run_selgather(), packed, fit)
     parts = name.split("_")
     block_i = 1024
@@ -218,6 +219,27 @@ def _run_candidate(name: str) -> float:
     select = "_".join(parts[1:])
     packed = ops.pack_genomes(pop.genomes)
     return _time(make_run_packed(select, block_i), packed, fit)
+
+
+def _validate_selgather(packed, fit):
+    """Semantic gate run BEFORE the selgather candidate is timed: the
+    kernel leans on Mosaic's dynamic_gather lowering at a lane extent
+    no test exercises on real hardware, and a miscompiled-but-fast
+    gather must never win the race. Raises on failure — the candidate
+    subprocess then produces no timing and the race continues."""
+    import numpy as np
+
+    par = ops.sel_tournament_gather_packed(
+        jax.random.key(7), packed, fit, tournsize=3, prng="hw",
+        interpret=False)
+    par_np = np.asarray(par[:2048])
+    pop_set = {r.tobytes() for r in np.asarray(packed)}
+    if not all(r.tobytes() in pop_set for r in par_np):
+        raise AssertionError("selgather: non-member parent rows")
+    uplift = float(ops.packed_fitness(par).mean()) - float(fit.mean())
+    if uplift <= 0.5:
+        raise AssertionError(
+            f"selgather: no selection pressure (uplift {uplift:.3f})")
 
 
 def _race_isolated(timeout_s: int = 900):

@@ -42,12 +42,20 @@ def test_empty_state_nothing_captured(capture):
     assert not capture.queue_complete()
 
 
-def test_hw_check_requires_passing_row(capture):
-    # a failed or fallback row must not suppress re-validation
-    _evidence(capture, "_tpu_hw_check.py", [{"check": "hw", "ok": False}])
+def test_hw_check_requires_passing_current_version_row(capture):
+    V = capture.HW_CHECK_VERSION
+    # failed, fallback, outdated-version, and non-core rows must not
+    # suppress re-validation
+    _evidence(capture, "_tpu_hw_check.py",
+              [{"check": "hw_kernels", "ok": False, "version": V}])
     _evidence(capture, "_tpu_hw_check.py", [{"skipped": "no tpu"}])
+    _evidence(capture, "_tpu_hw_check.py",
+              [{"check": "hw_kernels", "ok": True}])  # pre-version row
+    _evidence(capture, "_tpu_hw_check.py",
+              [{"check": "selgather", "ok": True, "version": V}])
     assert not capture.already_captured("_tpu_hw_check.py")
-    _evidence(capture, "_tpu_hw_check.py", [{"check": "hw", "ok": True}])
+    _evidence(capture, "_tpu_hw_check.py",
+              [{"check": "hw_kernels", "ok": True, "version": V}])
     assert capture.already_captured("_tpu_hw_check.py")
 
 
@@ -108,7 +116,9 @@ def test_trace_needs_finalised_xplane(capture, tmp_path):
 
 
 def test_queue_complete_only_when_everything_landed(capture, tmp_path):
-    _evidence(capture, "_tpu_hw_check.py", [{"check": "hw", "ok": True}])
+    _evidence(capture, "_tpu_hw_check.py",
+              [{"check": "hw_kernels", "ok": True,
+                "version": capture.HW_CHECK_VERSION}])
     _evidence(capture, "bench.py", [{"value": 449.4, "backend": "tpu"}])
     _write(tmp_path / capture.SUITE_OUT,
            [{"metric": f"{n}_generations_per_sec", "value": 1.0,

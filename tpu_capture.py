@@ -52,7 +52,7 @@ STEPS = [
     # all (profile is now incremental via --out, but the suite rows
     # are the higher-value artifact).
     ("_tpu_hw_check.py", [sys.executable, "_tpu_hw_check.py"], 1200),
-    ("bench.py", [sys.executable, "bench.py"], 5400),
+    ("bench.py", [sys.executable, "bench.py"], 6600),
     ("bench_suite.py", [sys.executable, "bench_suite.py", "--isolated",
                         "--out", SUITE_OUT], 9000),
     ("bench_profile.py", [sys.executable, "bench_profile.py",
@@ -65,7 +65,7 @@ STEPS = [
     # LAST: re-race the headline once everything else is captured —
     # candidates added after the first capture (block-size variants)
     # are otherwise only measured at the driver's round-end run
-    ("bench.py#rerace", [sys.executable, "bench.py"], 5400),
+    ("bench.py#rerace", [sys.executable, "bench.py"], 6600),
 ]
 
 # canonical artifact inventories for queue_complete(). Kept HERE (not
@@ -86,6 +86,11 @@ COMPONENT_NAMES = (
 # bench.py cross-checks its CANDIDATES length against this (same
 # cannot-import-the-bench-script reason as the lists above)
 N_CANDIDATES = 6
+
+# bump when _tpu_hw_check gains checks: an ok verdict from an older
+# version must not skip the step, or kernels added since (e.g. the
+# selgather dynamic_gather path) get raced without on-chip validation
+HW_CHECK_VERSION = 2
 
 # reference CPU gens/sec per suite config, and which references are
 # extrapolated rather than measured (BASELINE.md records the recipes).
@@ -138,9 +143,11 @@ def headline_rows():
 
 
 def _have_hw_check():
-    """A *passing* on-chip validation — a failed or CPU-fallback row
-    must not suppress re-validation in a later window."""
-    return any(r.get("ok") is True
+    """A *passing* on-chip validation at the CURRENT check version — a
+    failed, CPU-fallback, or outdated row must not suppress
+    re-validation in a later window."""
+    return any(r.get("check") == "hw_kernels" and r.get("ok") is True
+               and r.get("version", 1) >= HW_CHECK_VERSION
                for r in _evidence_results("_tpu_hw_check.py"))
 
 
