@@ -56,6 +56,38 @@ def test_hw_check_requires_passing_current_version_row(capture):
     assert not capture.already_captured("_tpu_hw_check.py")
     _evidence(capture, "_tpu_hw_check.py",
               [{"check": "hw_kernels", "ok": True, "version": V}])
+    # core passed, but the tiled-dominance row hasn't landed yet
+    assert not capture.already_captured("_tpu_hw_check.py")
+    # a RESOLVED tiled row suffices even if it failed (deterministic
+    # Mosaic gap must not re-run the step every window)
+    _evidence(capture, "_tpu_hw_check.py",
+              [{"check": "tiled_dominance", "ok": False, "version": V,
+                "failed": ["crashed: NotImplementedError"]}])
+    assert capture.already_captured("_tpu_hw_check.py")
+
+
+def test_hw_check_tiled_process_abort_resolves_after_two_attempts(capture):
+    V = capture.HW_CHECK_VERSION
+
+    def _attempt(relay_up):
+        _write(capture.EVIDENCE, [{
+            "ts": "x", "script": "_tpu_hw_check.py",
+            "relay_up_after": relay_up,
+            "results": [{"check": "hw_kernels", "ok": True,
+                         "version": V}]}])
+
+    # aborts where the relay died with the step are the RELAY's fault —
+    # they must never count toward the deterministic-abort threshold
+    _attempt(relay_up=False)
+    _attempt(relay_up=False)
+    assert not capture.already_captured("_tpu_hw_check.py")
+    # a fatal (process-level) abort in the tiled block with the relay
+    # still up flushes the core row but never prints a tiled one; one
+    # such attempt re-runs, two resolve — the step must not eat 1200 s
+    # of every future window
+    _attempt(relay_up=True)
+    assert not capture.already_captured("_tpu_hw_check.py")
+    _attempt(relay_up=True)
     assert capture.already_captured("_tpu_hw_check.py")
 
 
@@ -118,6 +150,8 @@ def test_trace_needs_finalised_xplane(capture, tmp_path):
 def test_queue_complete_only_when_everything_landed(capture, tmp_path):
     _evidence(capture, "_tpu_hw_check.py",
               [{"check": "hw_kernels", "ok": True,
+                "version": capture.HW_CHECK_VERSION},
+               {"check": "tiled_dominance", "ok": True,
                 "version": capture.HW_CHECK_VERSION}])
     _evidence(capture, "bench.py", [{"value": 449.4, "backend": "tpu"}])
     _write(tmp_path / capture.SUITE_OUT,
@@ -137,6 +171,28 @@ def test_queue_complete_only_when_everything_landed(capture, tmp_path):
               [{"value": 460.0, "backend": "tpu",
                 "n_candidates": capture.N_CANDIDATES}])
     assert capture.queue_complete()
+
+
+def test_full_race_accepts_deterministic_failures(capture):
+    # a roster where one candidate deterministically failed (e.g. the
+    # selgather gate raising on an unsupported Mosaic lowering) is
+    # RESOLVED — without this, one failing candidate would make the
+    # re-race predicate permanently false and the watcher would re-run
+    # the race every uptime window forever (advisor r3)
+    _evidence(capture, "bench.py#rerace",
+              [{"value": 460.0, "backend": "tpu",
+                "n_candidates": capture.N_CANDIDATES - 1,
+                "n_resolved": capture.N_CANDIDATES}])
+    assert capture.already_captured("bench.py#rerace")
+
+
+def test_full_race_rejects_partial_race(capture):
+    # timeout/unreached candidates are NOT resolved: the race was cut
+    # short by the window, and a later window must retry it
+    _evidence(capture, "bench.py#rerace",
+              [{"value": 460.0, "backend": "tpu",
+                "n_candidates": 3, "n_resolved": 4}])
+    assert not capture.already_captured("bench.py#rerace")
 
 
 def test_tolerant_jsonl_reader(capture, tmp_path):

@@ -37,7 +37,18 @@ from _axon_probe import axon_tunnel_reachable  # noqa: E402
 # single source for every round-stamped artifact name — STEPS and the
 # _have_* predicates both derive from these, so a round bump cannot
 # leave queue_complete() reading stale files
-ROUND = "r03"
+ROUND = "r04"
+
+# persistent XLA compilation cache shared across window attempts: the
+# 03:18 r3 window lost ~40 of its 44 minutes to tunnel compiles that a
+# prior attempt had already paid for. Threaded into every captured
+# subprocess via CACHE_ENV.
+CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache")
+CACHE_ENV = {
+    "JAX_COMPILATION_CACHE_DIR": CACHE_DIR,
+    "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "1",
+}
 EVIDENCE = os.path.join(HERE, f"TPU_EVIDENCE_{ROUND}.jsonl")
 SUITE_OUT = f"TPU_SUITE_{ROUND}.jsonl"
 PROFILE_OUT = f"TPU_PROFILE_{ROUND}.jsonl"
@@ -89,8 +100,10 @@ N_CANDIDATES = 6
 
 # bump when _tpu_hw_check gains checks: an ok verdict from an older
 # version must not skip the step, or kernels added since (e.g. the
-# selgather dynamic_gather path) get raced without on-chip validation
-HW_CHECK_VERSION = 2
+# selgather dynamic_gather path) get raced without on-chip validation.
+# v3: tiled dominance kernels (nd_rank_tiled/strengths_tiled vs the
+# matrix oracle at n=16k) — their first execution on a real TPU core.
+HW_CHECK_VERSION = 3
 
 # reference CPU gens/sec per suite config, and which references are
 # extrapolated rather than measured (BASELINE.md records the recipes).
@@ -127,15 +140,16 @@ def _evidence_results(step):
 BENCH_SCRIPTS = ("bench.py", "bench.py#rerace")
 
 
-def headline_rows():
+def headline_rows(path=None):
     """Every VALID TPU headline row, any bench script, with the
     envelope timestamp attached as ``measured_at``. The single source
     of what counts as a headline measurement — the capture predicates
-    and bench.py's cached replay must never disagree on this: "error"
-    rows (the all-candidates-failed sentinel carries value=0.0) and
-    "cached" rows (replays of earlier captures) don't count."""
+    and bench.py's cached replay (which passes prior rounds' evidence
+    files as ``path``) must never disagree on this: "error" rows (the
+    all-candidates-failed sentinel carries value=0.0) and "cached" rows
+    (replays of earlier captures) don't count."""
     return [dict(r, measured_at=d.get("ts"))
-            for d in _jsonl_rows(EVIDENCE)
+            for d in _jsonl_rows(EVIDENCE if path is None else path)
             if d.get("script") in BENCH_SCRIPTS
             for r in d.get("results", [])
             if r.get("backend") == "tpu" and r.get("value")
@@ -143,12 +157,42 @@ def headline_rows():
 
 
 def _have_hw_check():
-    """A *passing* on-chip validation at the CURRENT check version — a
-    failed, CPU-fallback, or outdated row must not suppress
-    re-validation in a later window."""
-    return any(r.get("check") == "hw_kernels" and r.get("ok") is True
-               and r.get("version", 1) >= HW_CHECK_VERSION
-               for r in _evidence_results("_tpu_hw_check.py"))
+    """A *passing* core on-chip validation at the CURRENT check
+    version — a failed, CPU-fallback, or outdated row must not
+    suppress re-validation in a later window — AND a tiled-dominance
+    row at the current version. The tiled row needs only to be
+    RESOLVED (ok true or false): a deterministic Mosaic failure there
+    is recorded evidence that must not re-run the step every window
+    (the suite's nsga2 configs surface it independently)."""
+    rows = _evidence_results("_tpu_hw_check.py")
+    core_ok = any(r.get("check") == "hw_kernels" and r.get("ok") is True
+                  and r.get("version", 1) >= HW_CHECK_VERSION
+                  for r in rows)
+    tiled_resolved = any(r.get("check") == "tiled_dominance"
+                         and r.get("version", 1) >= HW_CHECK_VERSION
+                         for r in rows)
+    if core_ok and not tiled_resolved:
+        # a PROCESS-level abort in the tiled block (fatal Mosaic error,
+        # not a Python exception) flushes the core row but never prints
+        # a tiled one. Two attempts ending that way WITH THE RELAY
+        # STILL UP afterwards is a deterministic abort on record —
+        # treat as resolved rather than burning 1200 s of every future
+        # window re-proving it (the suite's nsga2 configs surface the
+        # breakage independently). Attempts where the relay was down
+        # after the step (or envelopes predating the liveness stamp)
+        # don't count: the death was plausibly the relay's.
+        aborted = sum(
+            1 for d in _jsonl_rows(EVIDENCE)
+            if d.get("script") == "_tpu_hw_check.py"
+            and d.get("relay_up_after") is True
+            and any(r.get("check") == "hw_kernels"
+                    and r.get("ok") is True
+                    and r.get("version", 1) >= HW_CHECK_VERSION
+                    for r in d.get("results", []))
+            and not any(r.get("check") == "tiled_dominance"
+                        for r in d.get("results", [])))
+        tiled_resolved = aborted >= 2
+    return core_ok and tiled_resolved
 
 
 def _have_headline():
@@ -191,12 +235,16 @@ def _have_trace():
 
 
 def _have_full_race():
-    """A headline row produced by a COMPLETE race of the current
-    candidate roster — bench.py stamps n_candidates with how many
-    candidates actually finished, so partial races (relay died or a
-    candidate timed out mid-race) don't satisfy the re-race step."""
-    return any(r.get("n_candidates", 0) >= N_CANDIDATES
-               for r in headline_rows())
+    """A headline row produced by a race in which every candidate on
+    the current roster RESOLVED — timed, or deterministically failed
+    (e.g. the selgather semantic gate raising on an unsupported Mosaic
+    lowering). A deterministic failure must count as resolution, or a
+    roster with one unsupported kernel would make this predicate
+    permanently false and _relay_watch would re-run the full race every
+    uptime window forever (advisor r3). Partial races ("timeout",
+    "unreached": relay died mid-window) still don't satisfy it."""
+    return any(r.get("n_resolved", r.get("n_candidates", 0))
+               >= N_CANDIDATES for r in headline_rows())
 
 
 # step → "this artifact is already captured with TPU backing". Applied
@@ -266,7 +314,8 @@ def main():
             break
         try:
             r = subprocess.run(cmd, cwd=HERE, capture_output=True,
-                               text=True, timeout=timeout_s)
+                               text=True, timeout=timeout_s,
+                               env={**os.environ, **CACHE_ENV})
             results = []
             for ln in r.stdout.splitlines():
                 if ln.startswith("{"):
@@ -275,7 +324,11 @@ def main():
                     except json.JSONDecodeError:
                         results.append({"unparseable": ln[-200:]})
             if results:
-                log(step, {"results": results})
+                # relay liveness right after the step: lets the
+                # predicates tell "step genuinely resolved" from "step
+                # died with the relay" (_have_hw_check's abort counter)
+                log(step, {"results": results,
+                           "relay_up_after": axon_tunnel_reachable()})
             else:
                 log(step, {"error": f"rc={r.returncode}, no JSON; "
                                     f"stderr tail: {(r.stderr or '')[-300:]}"})
