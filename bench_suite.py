@@ -224,7 +224,8 @@ def bench_nsga2_50k():
 def bench_cartpole():
     """BASELINE.json config #5: pop=10k MLP policies, 3-episode mean
     CartPole rollout fitness, population sharded over the mesh."""
-    from deap_tpu.benchmarks.cartpole import mlp_policy, rollout
+    from deap_tpu.benchmarks.cartpole import (mlp_policy,
+                                              rollout_population)
     from deap_tpu.parallel import population_mesh, shard_population
 
     POP, ngen, episodes, max_steps = 10_000, 20, 3, 500
@@ -232,11 +233,13 @@ def bench_cartpole():
     ep_keys = jax.random.split(jax.random.key(123), episodes)
 
     def evaluate(genomes):
-        def fit_one(params):
-            return jax.vmap(
-                lambda k: rollout(policy, params, k, max_steps))(
-                    ep_keys).mean()
-        return jax.vmap(fit_one)(genomes)
+        # compaction cascade (rollout_population): cost tracks the
+        # survivor-curve integral (alive episodes get compacted into
+        # halving buffers) instead of always paying max_steps per
+        # episode — the reference's per-individual while-loop
+        # advantage, recovered in batch form
+        return rollout_population(policy, genomes, ep_keys,
+                                  max_steps).mean(axis=1)
 
     tb = Toolbox()
     tb.register("evaluate", evaluate)

@@ -79,6 +79,106 @@ def rollout(policy: Callable, params, key: jax.Array,
     return rewards.sum()
 
 
+def rollout_population(policy: Callable, genomes: jnp.ndarray,
+                       keys: jax.Array, max_steps: int = 500,
+                       chunk: int = 10, min_size: int = 512
+                       ) -> jnp.ndarray:
+    """Episode returns for a whole population at once — ``[P, E]`` for
+    ``P`` policies × ``E`` shared episode keys — with active-episode
+    compaction, so cost tracks the population's survivor curve instead
+    of always paying ``max_steps`` per episode.
+
+    This removes :func:`rollout`'s structural tax: a vmapped
+    per-episode scan pays ``max_steps`` iterations for every episode,
+    but random policies fail in ~20 steps, so ~96% of that work steps
+    dead episodes; a single batch-wide early exit barely helps because
+    a 0.1%-tail of episodes reaches the cap and pins the loop open
+    (measured: mean length 17.8, p99.9 = cap). Structure here — a
+    cascade of halving levels:
+
+    1. at the current level size, run ``chunk``-step scans inside a
+       ``while_loop`` until the alive count drops to half the level
+       (or the step cap hits);
+    2. scatter this level's rewards into the full-batch result, then
+       compact the alive episodes (stable argsort on the dead mask) to
+       a half-size buffer and recurse, down to ``min_size``.
+
+    Total stepping work is ≤ 2× the survivor-curve integral (each
+    episode is stepped in a buffer at most 2× the concurrent alive
+    count) — ≈ ``B·2·mean(len)`` vs the scan path's ``B·max_steps``.
+    The level ladder is static (python loop over halvings), so the
+    whole thing stays one jittable program, usable inside a generation
+    ``lax.scan``; the cap-hit case degrades gracefully (later levels'
+    loops exit immediately).
+
+    Fitness matches ``rollout`` exactly: reward 1 per step entered
+    alive; dead episodes hold their state frozen (``where`` mask) so
+    late-failure physics can't overflow while a level finishes.
+
+    Sharding note: the per-chunk alive count and the per-level
+    argsort/gather are GLOBAL over the flattened episode axis — on a
+    population sharded across a multi-device mesh they induce
+    collectives (an all-reduce per chunk, an all-to-all per level).
+    Single-device runs (the one-chip benchmark target) are unaffected;
+    for a large mesh, wrap a per-shard instance in ``shard_map`` so
+    compaction stays device-local."""
+    if max_steps % chunk:
+        # the loop advances whole chunks; an overshoot past the cap
+        # would keep accruing reward beyond max_steps
+        raise ValueError(f"max_steps ({max_steps}) must be a multiple "
+                         f"of chunk ({chunk})")
+    P, E = genomes.shape[0], keys.shape[0]
+    B = P * E
+    s0 = jax.vmap(initial_state)(keys)                    # [E, 4]
+    state = jnp.broadcast_to(s0, (P, E, 4)).reshape(B, 4)
+    params = jnp.repeat(genomes, E, axis=0)               # [B, n]
+    step_policy = jax.vmap(policy)                        # [b,n],[b,4]→[b,2]
+
+    alive = jnp.ones(B, jnp.bool_)
+    reward = jnp.zeros(B, jnp.float32)
+    orig = jnp.arange(B)
+    total = jnp.zeros(B, jnp.float32)
+    t = jnp.int32(0)
+    size = B
+
+    while True:
+        last = size <= min_size
+        target = size // 2
+
+        def chunk_step(carry, _, params=params):
+            st, al, rw = carry
+            action = jnp.argmax(step_policy(params, st), axis=-1)
+            new, failed = jax.vmap(cartpole_step)(st, action)
+            rw = rw + al.astype(jnp.float32)
+            st = jnp.where(al[:, None], new, st)
+            return (st, al & ~failed, rw), None
+
+        def body(carry):
+            st, al, rw, tt = carry
+            (st, al, rw), _ = lax.scan(chunk_step, (st, al, rw), None,
+                                       length=chunk)
+            return st, al, rw, tt + chunk
+
+        def cond(carry, last=last, target=target):
+            _, al, _, tt = carry
+            more = al.any() if last else jnp.sum(al) > target
+            return more & (tt < max_steps)
+
+        state, alive, reward, t = lax.while_loop(
+            cond, body, (state, alive, reward, t))
+        # scatter this level's rewards; alive rows are re-scattered
+        # with their final values at a later (smaller) level
+        total = total.at[orig].set(reward)
+        if last:
+            break
+        keep = jnp.argsort(~alive)[:target]   # stable: alive first
+        state, alive, reward, orig = (state[keep], alive[keep],
+                                      reward[keep], orig[keep])
+        params = params[keep]
+        size = target
+    return total.reshape(P, E)
+
+
 def mlp_policy(sizes=(4, 16, 2)) -> Tuple[Callable, int]:
     """A plain tanh MLP policy over a *flat* genome vector. Returns
     ``(policy(params_vector, state) -> logits, n_params)`` — flat

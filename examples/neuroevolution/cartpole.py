@@ -10,7 +10,7 @@ import jax
 import jax.numpy as jnp
 
 from deap_tpu import algorithms, ops
-from deap_tpu.benchmarks.cartpole import mlp_policy, rollout
+from deap_tpu.benchmarks.cartpole import mlp_policy, rollout_population
 from deap_tpu.core.fitness import FitnessSpec
 from deap_tpu.core.population import init_population
 from deap_tpu.core.toolbox import Toolbox
@@ -27,12 +27,11 @@ def main(smoke: bool = False, pop_size: int = None):
 
     def evaluate(genomes):
         keys = jax.random.split(jax.random.key(123), episodes)
-
-        def fit_one(params):
-            return jax.vmap(
-                lambda k: rollout(policy, params, k, max_steps))(keys).mean()
-
-        return jax.vmap(fit_one)(genomes)
+        # compaction cascade: alive episodes are compacted into
+        # halving buffers as the population dies off, so cost tracks
+        # the survivor curve instead of paying max_steps per episode
+        return rollout_population(policy, genomes, keys,
+                                  max_steps).mean(axis=1)
 
     toolbox = Toolbox()
     toolbox.register("evaluate", evaluate)

@@ -9,6 +9,7 @@ from deap_tpu.benchmarks.cartpole import (
     initial_state,
     mlp_policy,
     rollout,
+    rollout_population,
 )
 from deap_tpu.parallel.genome_shard import (
     genome_mesh,
@@ -42,6 +43,45 @@ def test_rollout_rewards_bounded_and_policy_matters():
     genomes = jax.random.normal(jax.random.key(1), (32, n_params))
     rs = jax.vmap(lambda p: rollout(policy, p, key, 200))(genomes)
     assert float(rs.max()) > float(rs.min())
+
+
+def test_rollout_population_matches_per_episode_scan():
+    """The early-exit batch rollout must reproduce the per-episode scan
+    path's returns exactly — same physics, same reward-per-step-entered
+    -alive accounting — while stopping early once the batch is dead."""
+    policy, n_params = mlp_policy((4, 8, 2))
+    genomes = jax.random.normal(jax.random.key(3), (16, n_params)) * 0.5
+    keys = jax.random.split(jax.random.key(4), 3)
+    batch = rollout_population(policy, genomes, keys, max_steps=200,
+                               chunk=25)
+    ref = jax.vmap(lambda p: jax.vmap(
+        lambda k: rollout(policy, p, k, 200))(keys))(genomes)
+    np.testing.assert_allclose(np.asarray(batch), np.asarray(ref))
+
+
+def test_rollout_population_compaction_levels_match():
+    """Force the compaction cascade through several halving levels and
+    check exact agreement with the per-episode scan path — including
+    episodes that reach the step cap while levels are still draining."""
+    policy, n_params = mlp_policy((4, 8, 2))
+    genomes = jax.random.normal(jax.random.key(9), (400, n_params)) * 0.5
+    keys = jax.random.split(jax.random.key(10), 3)   # B = 1200
+    batch = rollout_population(policy, genomes, keys, max_steps=200,
+                               chunk=10, min_size=64)
+    ref = jax.vmap(lambda p: jax.vmap(
+        lambda k: rollout(policy, p, k, 200))(keys))(genomes)
+    np.testing.assert_allclose(np.asarray(batch), np.asarray(ref))
+
+
+def test_rollout_population_rejects_nondivisible_chunk():
+    policy, n_params = mlp_policy((4, 8, 2))
+    genomes = jnp.zeros((2, n_params))
+    keys = jax.random.split(jax.random.key(0), 2)
+    import pytest
+
+    with pytest.raises(ValueError):
+        rollout_population(policy, genomes, keys, max_steps=100,
+                           chunk=33)
 
 
 def test_neuroevolution_example_improves():
