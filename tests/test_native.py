@@ -33,6 +33,37 @@ def test_known_2d_value():
     assert native.hypervolume(pts, ref) == pytest.approx(expected)
 
 
+@pytest.mark.parametrize("d", [3, 4, 5])
+def test_degenerate_fronts_match_python(d):
+    """Duplicates, dominated rows, and tied coordinates exercise every
+    equality branch of the staircase sweeps and the slicing recursion
+    (the d<=3 paths skip the non-domination prefilter entirely)."""
+    rng = np.random.default_rng(d + 100)
+    base = rng.uniform(0.0, 1.0, size=(12, d))
+    quant = np.round(base * 4) / 4          # heavy coordinate ties
+    pts = np.concatenate([base, base[:5], quant])  # + exact duplicates
+    ref = np.full(d, 1.1)
+    assert native.hypervolume(pts, ref) == pytest.approx(
+        py_hv(pts, ref), rel=1e-12)
+
+
+@pytest.mark.parametrize("d", [3, 4])
+def test_contributions_match_leave_one_out(d):
+    """The direct clipped-box contribution formula must agree with
+    literal leave-one-out recomputation, including zero rows for
+    dominated and duplicated points."""
+    rng = np.random.default_rng(d)
+    pts = rng.uniform(0.0, 1.0, size=(20, d))
+    pts = np.concatenate([pts, pts[:3]])    # duplicates -> 0 contrib
+    ref = np.full(d, 1.1)
+    contrib = native.hv_contributions(pts, ref)
+    total = native.hypervolume(pts, ref)
+    for i in range(len(pts)):
+        excl = total - native.hypervolume(np.delete(pts, i, 0), ref)
+        assert contrib[i] == pytest.approx(excl, rel=1e-9, abs=1e-12)
+    assert np.allclose(contrib[20:], 0.0)
+
+
 def test_contributions_sum_and_positivity():
     rng = np.random.default_rng(0)
     x = np.sort(rng.uniform(0, 1, 10))
