@@ -1,0 +1,238 @@
+"""Divide-and-conquer non-dominated sorting for the compat list path.
+
+Independent implementation of the Jensen (2003) / Fortin-Grenier-
+Parizeau (2013) divide-and-conquer non-dominated sort — the algorithm
+class behind the reference's ``sortLogNondominated``
+(emo.py:234-441) — written from the published recursion, not ported.
+O(n log^(m-1) n) versus the O(m n²) pairwise matrix, which is the
+asymptotic win the tensor kernels deliberately forgo on device (the
+dominance matrix IS the TPU fast path, mo/emo.py) but which a large
+CPU-side *list* population has no other way to recover.
+
+Structure (minimisation internally; callers pass maximisation wvalues):
+
+- points are de-duplicated (dominance is a function of the fitness
+  vector, so duplicates share a rank — the reference groups unique
+  fitnesses the same way) and lex-sorted once;
+- ``_helper_a(S, m)`` assigns front indices within ``S`` considering
+  objectives ``0..m``: 2-objective base case is a staircase sweep, the
+  general case median-splits on objective ``m`` into L = {<= pivot} /
+  H = {> pivot} — H cannot touch L, L's effect on H needs only
+  objectives ``0..m-1`` (obj m is strictly ordered across the split);
+- ``_helper_b(L, H, m)`` propagates "every l componentwise-<= h on
+  objectives 0..m bumps h's front past l's" — the INCLUSIVE contract:
+  strictness was established by the split that created the call, so
+  pairs equal on all of ``0..m`` genuinely dominate. Its own base case
+  is a one-directional sweep (L inserts, H queries).
+
+The 2-D sweeps share a "staircase of fronts": entries ``(y, f)`` with
+both coordinates ascending after pruning, so "max front among inserted
+points with obj1 <= Y" is one bisect.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import List, Sequence
+
+import numpy as np
+
+# pairwise fallback below this size — recursion overhead beats the
+# quadratic scan on tiny sets
+_SMALL = 8
+
+
+class _Stairs:
+    """Monotone (y ascending, front ascending) staircase supporting
+    ``add(y, front)`` and ``query(Y) -> max front with y <= Y``."""
+
+    __slots__ = ("ys", "fs")
+
+    def __init__(self):
+        self.ys: List[float] = []
+        self.fs: List[int] = []
+
+    def add(self, y: float, f: int) -> None:
+        i = bisect.bisect_right(self.ys, y)
+        if i and self.fs[i - 1] >= f:
+            return  # an entry at y' <= y already promises f' >= f
+        # drop entries this one supersedes (y' >= y with f' <= f)
+        j = i
+        while j < len(self.ys) and self.fs[j] <= f:
+            j += 1
+        self.ys[i:j] = [y]
+        self.fs[i:j] = [f]
+
+    def query(self, y: float) -> int:
+        """Max front among added entries with y' <= y; -1 if none."""
+        i = bisect.bisect_right(self.ys, y)
+        return self.fs[i - 1] if i else -1
+
+
+def _dominates_leq(a: np.ndarray, b: np.ndarray, m: int) -> bool:
+    """a componentwise-<= b on objectives 0..m (inclusive contract)."""
+    return bool((a[: m + 1] <= b[: m + 1]).all())
+
+
+def _sweep_a(pts: np.ndarray, fronts: np.ndarray, S: Sequence[int]) -> None:
+    """2-objective front assignment within lex-sorted ``S``. For
+    distinct (obj0, obj1) pairs, an earlier point dominates a later one
+    iff its obj1 is <= — pairs EQUAL on both coordinates don't
+    interact at this level (their ordering, if any, belongs to the
+    split on the higher objective that separated them), so each
+    equal-key group queries before any of it is inserted."""
+    st = _Stairs()
+    i = 0
+    while i < len(S):
+        j = i
+        key = (pts[S[i], 0], pts[S[i], 1])
+        while j < len(S) and (pts[S[j], 0], pts[S[j], 1]) == key:
+            j += 1
+        for k in range(i, j):
+            p = S[k]
+            fronts[p] = max(fronts[p], st.query(pts[p, 1]) + 1)
+        for k in range(i, j):
+            st.add(pts[S[k], 1], fronts[S[k]])
+        i = j
+
+
+def _sweep_b(pts: np.ndarray, fronts: np.ndarray,
+             L: Sequence[int], H: Sequence[int]) -> None:
+    """2-objective one-directional propagation: every l with
+    (obj0, obj1) componentwise-<= h bumps h past l. Inclusive, so at
+    equal obj0 the L side inserts before H queries."""
+    st = _Stairs()
+    li = hi = 0
+    while hi < len(H):
+        h = H[hi]
+        while li < len(L) and pts[L[li], 0] <= pts[h, 0]:
+            st.add(pts[L[li], 1], fronts[L[li]])
+            li += 1
+        fronts[h] = max(fronts[h], st.query(pts[h, 1]) + 1)
+        hi += 1
+
+
+def _split_pivot(vals: np.ndarray):
+    """A pivot such that {v <= pivot} and {v > pivot} are both
+    non-empty, or None if all values are equal."""
+    lo, hi = vals.min(), vals.max()
+    if lo == hi:
+        return None
+    med = np.median(vals)
+    if med < hi:
+        return med
+    # median == max (top-heavy ties): largest value strictly below it
+    return vals[vals < hi].max()
+
+
+def _helper_b(pts: np.ndarray, fronts: np.ndarray,
+              L: List[int], H: List[int], m: int) -> None:
+    if not L or not H:
+        return
+    if len(L) * len(H) <= _SMALL * _SMALL or (len(L) == 1 or len(H) == 1):
+        for h in H:
+            best = fronts[h]
+            for l in L:
+                if fronts[l] >= best and _dominates_leq(pts[l], pts[h], m):
+                    best = fronts[l] + 1
+            fronts[h] = best
+        return
+    if m == 1:
+        _sweep_b(pts, fronts, L, H)
+        return
+    allv = pts[L + H, m]
+    if pts[L, m].max() <= pts[H, m].min():
+        _helper_b(pts, fronts, L, H, m - 1)
+        return
+    piv = _split_pivot(allv)
+    L1 = [i for i in L if pts[i, m] <= piv]
+    L2 = [i for i in L if pts[i, m] > piv]
+    H1 = [i for i in H if pts[i, m] <= piv]
+    H2 = [i for i in H if pts[i, m] > piv]
+    _helper_b(pts, fronts, L1, H1, m)      # both low: still open on m
+    _helper_b(pts, fronts, L1, H2, m - 1)  # obj m resolved: l <= piv < h
+    _helper_b(pts, fronts, L2, H2, m)      # both high: still open on m
+    # L2 -> H1 impossible: l > piv >= h on objective m
+
+
+def _helper_a(pts: np.ndarray, fronts: np.ndarray,
+              S: List[int], m: int) -> None:
+    if len(S) < 2:
+        return
+    if len(S) == 2 or len(S) <= _SMALL:
+        # pairwise on 0..m; lex order makes domination one-directional
+        for bi in range(1, len(S)):
+            b = S[bi]
+            best = fronts[b]
+            for ai in range(bi):
+                a = S[ai]
+                if (fronts[a] >= best
+                        and _dominates_leq(pts[a], pts[b], m)
+                        and not (pts[a, : m + 1]
+                                 == pts[b, : m + 1]).all()):
+                    best = fronts[a] + 1
+            fronts[b] = best
+        return
+    if m == 1:
+        _sweep_a(pts, fronts, S)
+        return
+    piv = _split_pivot(pts[S, m])
+    if piv is None:  # objective m constant across S: drop it
+        _helper_a(pts, fronts, S, m - 1)
+        return
+    L = [i for i in S if pts[i, m] <= piv]
+    H = [i for i in S if pts[i, m] > piv]
+    _helper_a(pts, fronts, L, m)
+    _helper_b(pts, fronts, L, H, m - 1)  # strict on m across the split
+    _helper_a(pts, fronts, H, m)
+
+
+def nd_rank_log(wvalues: np.ndarray) -> np.ndarray:
+    """Non-domination rank per row (0 = first front) of MAXIMISATION
+    ``wvalues`` ([n, m]) by divide-and-conquer — same ranks as the
+    dominance-matrix peel (``mo.emo.nd_rank``), different cost model."""
+    w = np.asarray(wvalues, dtype=np.float64)
+    if w.ndim != 2:
+        raise ValueError("wvalues must be [n, m]")
+    n, m = w.shape
+    if n == 0:
+        return np.zeros(0, dtype=np.int64)
+    pts = -w  # minimisation internally
+    uniq, inv = np.unique(pts, axis=0, return_inverse=True)
+    # np.unique returns rows lex-sorted on ALL objectives — exactly the
+    # processing order every sweep and base case relies on
+    fronts = np.zeros(len(uniq), dtype=np.int64)
+    if m == 1:
+        # single objective: rank = index among the distinct values
+        # (uniq is ascending in the minimised objective)
+        return inv.astype(np.int64)
+    _helper_a(uniq, fronts, list(range(len(uniq))), m - 1)
+    return fronts[inv]
+
+
+def sort_log_nondominated(individuals, k, first_front_only=False):
+    """Fronts-of-lists shim over :func:`nd_rank_log` matching the
+    reference's return contract (emo.py:234-441): fronts covering at
+    least ``k`` individuals; bare first front when
+    ``first_front_only`` (emo.py:275-276)."""
+    if k == 0 or not individuals:
+        return []
+    # float32, like every other compat MO entry point (_wvalues):
+    # ranking at a higher precision than sortNondominated would let
+    # sub-float32 differences split fronts the matrix path merges
+    w = np.asarray([ind.fitness.wvalues for ind in individuals],
+                   dtype=np.float32)
+    ranks = nd_rank_log(w)
+    fronts: List[list] = [[] for _ in range(int(ranks.max()) + 1)]
+    for ind, r in zip(individuals, ranks):
+        fronts[int(r)].append(ind)
+    if first_front_only:
+        return fronts[0]
+    out = []
+    total = 0
+    for fr in fronts:
+        out.append(fr)
+        total += len(fr)
+        if total >= k:
+            break
+    return out

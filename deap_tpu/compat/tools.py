@@ -769,18 +769,22 @@ def selNSGA3WithMemory(ref_points, nd="log"):
 
 
 def sortLogNondominated(individuals, k, first_front_only=False):
-    """Fortin-2013 log nd-sort (emo.py:234-441). The divide-and-conquer
-    recursion exists to cut Python-level constants the tensor kernels do
-    not have, so this maps to the same nd-rank kernels as
-    :func:`sortNondominated` — identical fronts, different cost model.
+    """Fortin-2013 divide-and-conquer nd-sort (emo.py:234-441), the
+    real O(n log^(m-1) n) algorithm (compat.ndsort_log) — identical
+    fronts to :func:`sortNondominated`, asymptotically cheaper than its
+    O(m n²) dominance matrix for large list populations. The tensor
+    path keeps the matrix/tiled kernels on device (mo/emo.py docstring:
+    the matrix IS the fast path there); this variant is where the
+    Python-side asymptotic win lives.
 
     Return-shape parity quirk preserved from the reference: with
     ``first_front_only`` this returns the bare first front
     (emo.py:275-276), while ``sortNondominated`` returns a one-element
     list of fronts (emo.py:103-117) — MO-CMA-ES indexes individuals out
     of this variant's return directly (cma.py:421-424)."""
-    fronts = sortNondominated(individuals, k, first_front_only)
-    return fronts[0] if first_front_only else fronts
+    from deap_tpu.compat.ndsort_log import sort_log_nondominated
+
+    return sort_log_nondominated(individuals, k, first_front_only)
 
 
 def hypervolume(front, **kargs):

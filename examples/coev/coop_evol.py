@@ -17,10 +17,13 @@ a fresh species while weak contributors go extinct
   shows species settling into distinct niches.
 - ``"gen"``  — fixed species count chosen up front (coop_gen.py's
   NUM_SPECIES study).
-- ``"adapt"`` — start with one species, *add* a species when the best
-  collaboration fitness stagnates (coop_adapt.py).
-- ``"evol"`` — additionally remove species whose contribution falls
-  below the extinction threshold (coop_evol.py:130-146).
+- ``"adapt"`` — start with one species and *add* one on a FIXED
+  schedule, every ``ADAPT_LENGTH`` rounds (coop_adapt.py:18 "A species
+  is added each 100 generations"; its g counts per-species generations,
+  ours counts whole rounds — same ladder shape, scaled).
+- ``"evol"`` — stagnation of the best collaboration triggers an
+  addition, and species whose contribution falls below the extinction
+  threshold are removed first (coop_evol.py:130-146).
 
 The per-round species step is the jit'd tensor program
 (`coev.coop_step`); only the add/remove decisions — data-dependent
@@ -43,6 +46,7 @@ TARGET_SIZE = 30
 IMPROVEMENT_THRESHOLD = 0.5
 IMPROVEMENT_LENGTH = 5
 EXTINCTION_THRESHOLD = 5.0
+ADAPT_LENGTH = 12  # rounds between scheduled additions (adapt mode)
 
 
 def block_schematas(n_types: int, length: int) -> list:
@@ -75,12 +79,15 @@ def _new_species(key):
 
 
 def main(smoke: bool = False, mode: str = "evol", verbose: bool = True,
-         num_species: int = 1, seed: int = 0):
+         num_species: int = 1, seed: int = 0,
+         return_trace: bool = False):
     if mode not in ("niche", "gen", "adapt", "evol"):
         raise ValueError(f"unknown mode {mode!r}")
 
     n_types = 3
     rounds = (40 if mode in ("adapt", "evol") else 30) if not smoke else 6
+    # smoke must still exercise the adapt rung's addition path
+    adapt_length = ADAPT_LENGTH if not smoke else max(2, rounds // 2)
     keys = iter(jax.random.split(jax.random.key(seed), 4096))
 
     schematas = block_schematas(n_types, IND_SIZE)
@@ -115,37 +122,48 @@ def main(smoke: bool = False, mode: str = "evol", verbose: bool = True,
                               cxpb=0.6, mutpb=1.0)
 
     history = []
+    trace = []  # (round, n_species, best) — the rung's observable shape
     for rnd in range(rounds):
         species, reps = _round(next(keys), tuple(species), tuple(reps))
         best = float(max(float(s.wvalues.max()) for s in species))
         history.append(best)
+        trace.append((rnd, len(species), best))
         if verbose:
             print(f"round {rnd:3d}  species {len(species)}  "
                   f"best collaboration {best:.3f}")
 
-        if mode in ("adapt", "evol") and len(history) >= IMPROVEMENT_LENGTH:
-            diff = history[-1] - history[-IMPROVEMENT_LENGTH]
-            if diff < IMPROVEMENT_THRESHOLD:
-                if mode == "evol" and len(species) > 1:
-                    contribs = coev.match_set_contributions(reps, targets)
-                    keep = [i for i in range(len(species))
-                            if float(contribs[i]) >= EXTINCTION_THRESHOLD]
-                    if keep:  # never extinguish everything
-                        species = [species[i] for i in keep]
-                        reps = [reps[i] for i in keep]
-                s = _new_species(next(keys))
-                reps.append(jax.tree_util.tree_map(lambda a: a[0], s.genomes))
-                species.append(
-                    coev.coop_eval_species(len(species), s, reps, evaluate))
-                reps = coev.coop_representatives(species)
-                history = []
-                if verbose:
-                    print(f"  stagnation: now {len(species)} species")
+        add = False
+        if mode == "adapt":
+            # fixed schedule, like coop_adapt.py's add-every-100-gens
+            add = (rnd + 1) % adapt_length == 0 and rnd + 1 < rounds
+        elif mode == "evol" and len(history) >= IMPROVEMENT_LENGTH:
+            add = (history[-1] - history[-IMPROVEMENT_LENGTH]
+                   < IMPROVEMENT_THRESHOLD)
+        if add:
+            if mode == "evol" and len(species) > 1:
+                contribs = coev.match_set_contributions(reps, targets)
+                keep = [i for i in range(len(species))
+                        if float(contribs[i]) >= EXTINCTION_THRESHOLD]
+                if keep:  # never extinguish everything
+                    species = [species[i] for i in keep]
+                    reps = [reps[i] for i in keep]
+            s = _new_species(next(keys))
+            reps.append(jax.tree_util.tree_map(lambda a: a[0], s.genomes))
+            species.append(
+                coev.coop_eval_species(len(species), s, reps, evaluate))
+            reps = coev.coop_representatives(species)
+            history = []
+            if verbose:
+                print(f"  {'schedule' if mode == 'adapt' else 'stagnation'}:"
+                      f" now {len(species)} species")
 
     final = float(max(float(s.wvalues.max()) for s in species))
     if verbose:
         print(f"final best collaboration: {final:.3f} "
               f"({len(species)} species)")
+    if return_trace:
+        return {"final": final, "trace": trace, "reps": reps,
+                "schematas": schematas, "targets": targets}
     return final
 
 

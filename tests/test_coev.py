@@ -148,6 +148,70 @@ def test_match_set_strength_and_contributions():
     assert contribs.tolist() == [2.0, 1.5]
 
 
+def test_coop_rung_gen_fixed_species_count():
+    """coop_gen.py's rung: NUM_SPECIES chosen up front and CONSTANT —
+    no additions, no extinctions, whatever the fitness does."""
+    import examples.coev.coop_evol as ce
+
+    out = ce.main(smoke=True, mode="gen", num_species=3, verbose=False,
+                  return_trace=True)
+    assert [c for _, c, _ in out["trace"]] == [3] * len(out["trace"])
+
+
+def test_coop_rung_adapt_fixed_schedule():
+    """coop_adapt.py's rung (section 4.2.3: 'A species is added each
+    100 generations'): additions follow the FIXED round schedule, not
+    stagnation — count is exactly 1 + rounds_elapsed // ADAPT_LENGTH."""
+    import examples.coev.coop_evol as ce
+
+    out = ce.main(smoke=False, mode="adapt", verbose=False,
+                  return_trace=True)
+    for rnd, count, _ in out["trace"]:
+        assert count == 1 + rnd // ce.ADAPT_LENGTH, (rnd, count)
+
+
+def test_coop_rung_evol_stagnation_dynamics():
+    """coop_evol.py's rung: species arrive only through stagnation
+    (count never jumps by more than +1 per round; extinctions may make
+    it shrink at an addition), at least one stagnation fires in a full
+    run, and the population never goes extinct."""
+    import examples.coev.coop_evol as ce
+
+    out = ce.main(smoke=False, mode="evol", verbose=False,
+                  return_trace=True)
+    counts = [c for _, c, _ in out["trace"]]
+    assert all(c >= 1 for c in counts)
+    deltas = [b - a for a, b in zip(counts, counts[1:])]
+    assert all(d <= 1 for d in deltas)
+    assert any(d != 0 for d in deltas), "no stagnation event in 40 rounds"
+
+
+def test_coop_rung_niche_species_separate():
+    """coop_niche.py's rung: with one species per schema, the final
+    representatives settle into DISTINCT niches (the reference's
+    observable is the printed representatives matching different
+    schemata). Each schema's fixed block must be claimed by some
+    representative with high match density, and representatives must
+    not all pile onto one block."""
+    import examples.coev.coop_evol as ce
+    import numpy as np
+
+    out = ce.main(smoke=False, mode="niche", verbose=False,
+                  return_trace=True)
+    reps = [np.asarray(r) for r in out["reps"]]
+    schematas = out["schematas"]
+    n_types = len(schematas)
+    L = len(schematas[0])
+    block = L // n_types
+    # density of 1s each rep has inside each schema's fixed block
+    dens = np.array([[r[i * block:(i + 1) * block].mean()
+                      for i in range(n_types)] for r in reps])
+    claimed = set(dens.argmax(axis=1).tolist())
+    assert len(claimed) >= 2, dens
+    # every block is matched well by its best-claiming representative
+    assert (dens.max(axis=0) > 0.75).all(), dens
+
+
 def test_coop_evol_ladder_smoke():
     """The evolving-species ladder runs every rung and improves the
     collaboration (counterpart of coop_niche/gen/adapt/evol).
