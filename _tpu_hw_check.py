@@ -191,7 +191,40 @@ def main():
           "version": HW_CHECK_VERSION}
     if selgather_failures:
         sg["failed"] = selgather_failures
-    print(json.dumps(sg))
+    print(json.dumps(sg), flush=True)
+
+    # --- whole-GA mega-kernel (r4 candidate) -------------------------------
+    # Informational row, same stance as selgather: an experimental
+    # candidate that self-validates again inside bench.py before any
+    # timing counts; a crash here must not block the core verdict.
+    evolve_failures = []
+    try:
+        g = jax.random.bernoulli(jax.random.key(9), 0.5, (N, L))
+        p = pk.pack_genomes(g)
+        fit = pk.packed_fitness(p)
+        pop2, fit2 = pk.evolve_packed(
+            jax.random.key(10), p, fit, L, 3, cxpb=0.0, mutpb=0.0,
+            indpb=0.05, prng="hw", interpret=False)
+        pop_set = {r.tobytes() for r in np.asarray(p)}
+        if not all(r.tobytes() in pop_set for r in np.asarray(pop2)):
+            evolve_failures.append("non-member rows (selection-only)")
+        if not (np.asarray(pk.packed_fitness(pop2))
+                == np.asarray(fit2)).all():
+            evolve_failures.append("fitness/popcount mismatch")
+        _, f5 = pk.evolve_packed(
+            jax.random.key(11), p, fit, L, 5, cxpb=0.5, mutpb=0.2,
+            indpb=0.05, prng="hw", interpret=False)
+        uplift = float(f5.mean()) - float(fit.mean())
+        if uplift <= 1.0:
+            evolve_failures.append(f"no OneMax climb (uplift {uplift:.2f})")
+    except Exception as e:
+        evolve_failures.append(f"crashed: {type(e).__name__}: "
+                               f"{str(e)[:200]}")
+    ev = {"check": "evolve", "ok": not evolve_failures,
+          "version": HW_CHECK_VERSION}
+    if evolve_failures:
+        ev["failed"] = evolve_failures
+    print(json.dumps(ev))
     return 0 if not failures else 1
 
 

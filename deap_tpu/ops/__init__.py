@@ -66,6 +66,7 @@ from deap_tpu.ops.kernels_real import (
 )
 from deap_tpu.ops.packed import (
     cx_two_point_packed,
+    evolve_packed,
     fused_variation_eval_packed,
     mut_flip_bit_packed,
     pack_genomes,

@@ -263,12 +263,13 @@ def _variation_body(g, pairu, rowu, geneu, *, n, L, TI, cxpb, mutpb, indpb,
     return child, fit
 
 
-def _pair_consistent(u):
-    """[TI, k] per-row draws → both rows of each adjacent pair carry the
-    even row's draw."""
-    TI = u.shape[0]
-    down = pltpu.roll(u, 1, 0)
-    even = (jax.lax.broadcasted_iota(jnp.int32, u.shape, 0) % 2) == 0
+def _pair_consistent(u, axis: int = 0):
+    """Per-individual draws → both members of each adjacent pair along
+    ``axis`` carry the even member's draw. ``axis=0`` for row-major
+    tiles ([TI, k]), ``axis=1`` for lane-major layouts ([k, N]) — one
+    home for the even-member-wins convention, whatever the layout."""
+    down = pltpu.roll(u, 1, axis)
+    even = (jax.lax.broadcasted_iota(jnp.int32, u.shape, axis) % 2) == 0
     return jnp.where(even, u, down)
 
 

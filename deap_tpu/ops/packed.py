@@ -52,6 +52,7 @@ __all__ = [
     "mut_flip_bit_packed",
     "fused_variation_eval_packed",
     "sel_tournament_gather_packed",
+    "evolve_packed",
 ]
 
 WORD = 32
@@ -314,25 +315,13 @@ def _selgather_body(gT, fitT, draws, *, n, tournsize):
     32× its logical size (51 MB at n=100k) and blow the ~16 MB VMEM
     budget, while ``[W, n]`` is dense (~3.2 MB).
 
-    ``draws`` is ``uint32[tournsize, N]``; aspirant ``t`` of child
-    ``j`` is ``draws[t, j] % n`` (modulo bias < n/2**32). The best-
-    fitness aspirant wins; strict ``>`` keeps the first-drawn on ties,
-    matching the reference's ``max()`` (selection.py:63-69). The
-    fitness lookups and the final column gather are lane-axis
-    ``take_along_axis`` ops, which Mosaic lowers to the native
-    ``tpu.dynamic_gather`` — the point of this kernel: no serial XLA
-    gather ever touches HBM.
+    Tournament rule lives in :func:`_tournament_idx` (shared with the
+    whole-GA mega-kernel). The fitness lookups and the final column
+    gather are lane-axis ``take_along_axis`` ops, which Mosaic lowers
+    to the native ``tpu.dynamic_gather`` — the point of this kernel:
+    no serial XLA gather ever touches HBM.
     """
-    best_idx = (draws[0:1, :] % np.uint32(n)).astype(jnp.int32)
-    best_fit = jnp.take_along_axis(fitT, best_idx, axis=1,
-                                   mode="promise_in_bounds")
-    for t in range(1, tournsize):
-        idx = (draws[t:t + 1, :] % np.uint32(n)).astype(jnp.int32)
-        f = jnp.take_along_axis(fitT, idx, axis=1,
-                                mode="promise_in_bounds")
-        better = f > best_fit
-        best_idx = jnp.where(better, idx, best_idx)
-        best_fit = jnp.where(better, f, best_fit)
+    best_idx = _tournament_idx(fitT, draws, n=n, tournsize=tournsize)
     W, N = gT.shape
     idx_w = jnp.broadcast_to(best_idx, (W, N))
     return jnp.take_along_axis(gT, idx_w, axis=1,
@@ -355,6 +344,243 @@ def _selgather_kernel_bits(gT_ref, fitT_ref, draws_ref, out_ref, *, n,
                            tournsize):
     out_ref[:] = _selgather_body(gT_ref[:], fitT_ref[:], draws_ref[:],
                                  n=n, tournsize=tournsize)
+
+
+def _tournament_idx(fitT, draws, *, n, tournsize):
+    """Lane-major tournament: winning population index per lane.
+    ``fitT`` is ``f32[1, N]``, ``draws`` ``uint32[tournsize, N]``;
+    aspirant ``t`` of lane ``j`` is ``draws[t, j] % n`` (modulo bias
+    < n/2**32). Strict ``>`` keeps the first-drawn on ties, matching
+    the reference's ``max()`` (selection.py:63-69). The single home of
+    the tournament rule for both the selgather kernel and the
+    whole-GA mega-kernel."""
+    best_idx = (draws[0:1, :] % np.uint32(n)).astype(jnp.int32)
+    best_fit = jnp.take_along_axis(fitT, best_idx, axis=1,
+                                   mode="promise_in_bounds")
+    for t in range(1, tournsize):
+        idx = (draws[t:t + 1, :] % np.uint32(n)).astype(jnp.int32)
+        f = jnp.take_along_axis(fitT, idx, axis=1,
+                                mode="promise_in_bounds")
+        better = f > best_fit
+        best_idx = jnp.where(better, idx, best_idx)
+        best_fit = jnp.where(better, f, best_fit)
+    return best_idx
+
+
+def _fold_bitplanes_lanes(mask_f32, W):
+    """[32·W, C] per-bit 0/1 mask (plane-major rows: plane ``b`` of
+    word ``w`` at row ``b·W + w``) → ``uint32[W, C]`` flip words, via
+    two MXU matmuls with a constant [W, 32·W] fold matrix — the
+    lane-major mirror of :func:`_flip_words_matmul`, with the fold on
+    the LEFT because the population axis runs along lanes here. Exact:
+    the 16/16 bit-plane split keeps each f32 sum below 2^16."""
+    rows = WORD * W
+    w = jax.lax.broadcasted_iota(jnp.int32, (W, rows), 0)
+    r = jax.lax.broadcasted_iota(jnp.int32, (W, rows), 1)
+    b = r // W
+    sel = (r % W) == w
+
+    def fold(half, shift):
+        m = jnp.where(sel & half,
+                      jnp.left_shift(1, b - shift), 0).astype(jnp.float32)
+        s = jax.lax.dot_general(m, mask_f32, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        return jax.lax.bitcast_convert_type(s.astype(jnp.int32),
+                                            jnp.uint32)
+
+    return fold(b < 16, 0) | (fold(b >= 16, 16) << np.uint32(16))
+
+
+def _evolve_body(pop_ref, fit_ref, tmp_ref, *, n, N, L, W, G, tournsize,
+                 cxpb, mutpb, indpb, chunk, draw_sel, draw_pair,
+                 draw_row, draw_gene):
+    """G whole generations — tournament selection, two-point
+    crossover, flip-bit mutation, popcount fitness — over the
+    VMEM-resident lane-major population ``pop_ref`` (uint32[W, N]) and
+    fitness ``fit_ref`` (f32[1, N]). ``tmp_ref`` is the double buffer.
+    The draw_* callbacks supply uint32 randomness (hardware PRNG on
+    chip, preloaded refs under the interpreter) in a fixed consumption
+    order. Padding lanes (>= n) are never selected (draws are % n) and
+    their junk fitness is inert."""
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
+    even = (lane % 2) == 0
+    has_partner = jnp.bitwise_or(lane, 1) < n
+    word_start = jax.lax.broadcasted_iota(jnp.int32, (W, 1), 0) * WORD
+    tailmask = _bits_below(L - word_start)          # [W, 1]
+
+    def gen(g_idx, _):
+        # --- selection + parent gather, all lane-axis dynamic_gather —
+        best_idx = _tournament_idx(fit_ref[:], draw_sel(g_idx),
+                                   n=n, tournsize=tournsize)
+        parents = jnp.take_along_axis(
+            pop_ref[:], jnp.broadcast_to(best_idx, (W, N)), axis=1,
+            mode="promise_in_bounds")
+
+        # --- two-point crossover on adjacent-lane pairs (children
+        # 2j/2j+1 are adjacent LANES here: axis=1 pair consistency) ---
+        pairu = _u01_from_bits(_pair_consistent(draw_pair(g_idx),
+                                                axis=1))
+        do_cx = pairu[0:1] < cxpb
+        p1 = 1 + (pairu[1:2] * L).astype(jnp.int32)
+        p2 = 1 + (pairu[2:3] * (L - 1)).astype(jnp.int32)
+        p2 = jnp.where(p2 >= p1, p2 + 1, p2)
+        lo = jnp.minimum(p1, p2)
+        hi = jnp.maximum(p1, p2)
+        fwd = pltpu.roll(parents, N - 1, 1)         # lane j <- j+1
+        bwd = pltpu.roll(parents, 1, 1)             # lane j <- j-1
+        partner = jnp.where(even, fwd, bwd)
+        seg = _bits_below(hi - word_start) & ~_bits_below(lo - word_start)
+        seg = jnp.where(do_cx & has_partner, seg, np.uint32(0))
+        tmp_ref[:] = (parents & ~seg) | (partner & seg)
+
+        # --- mutation + fitness, chunked over lanes -----------------
+        # the per-gene uniform block is [32W, chunk] f32 — full
+        # population width at once would be ~50 MB of VMEM at n=100k
+        do_mut = _u01_from_bits(draw_row(g_idx)) < mutpb   # [1, N]
+        def mchunk(c, _):
+            sl = pl.ds(c * chunk, chunk)
+            mask = (_u01_from_bits(draw_gene(g_idx, c))
+                    < indpb).astype(jnp.float32)
+            flip = _fold_bitplanes_lanes(mask, W) & tailmask
+            dm = jax.lax.dynamic_slice(do_mut, (0, c * chunk),
+                                       (1, chunk))
+            newc = tmp_ref[:, sl] ^ jnp.where(dm, flip, np.uint32(0))
+            tmp_ref[:, sl] = newc
+            counts = jax.lax.bitcast_convert_type(popcount(newc),
+                                                  jnp.int32)
+            fit_ref[:, sl] = counts.astype(jnp.float32).sum(
+                axis=0, keepdims=True)
+            return 0
+
+        jax.lax.fori_loop(0, N // chunk, mchunk, 0)
+        pop_ref[:] = tmp_ref[:]
+        return 0
+
+    jax.lax.fori_loop(0, G, gen, 0)
+
+
+def _evolve_kernel_hw(seed_ref, gT_ref, fT_ref, outpop_ref, outfit_ref,
+                      tmp_ref, *, n, N, L, W, G, tournsize, cxpb, mutpb,
+                      indpb, chunk):
+    pltpu.prng_seed(seed_ref[0])
+    outpop_ref[:] = gT_ref[:]
+    outfit_ref[:] = fT_ref[:]
+    bits = lambda shape: pltpu.bitcast(pltpu.prng_random_bits(shape),
+                                       jnp.uint32)
+    _evolve_body(
+        outpop_ref, outfit_ref, tmp_ref, n=n, N=N, L=L, W=W, G=G,
+        tournsize=tournsize, cxpb=cxpb, mutpb=mutpb, indpb=indpb,
+        chunk=chunk,
+        draw_sel=lambda g: bits((tournsize, N)),
+        draw_pair=lambda g: bits((3, N)),
+        draw_row=lambda g: bits((1, N)),
+        draw_gene=lambda g, c: bits((WORD * W, chunk)))
+
+
+def _evolve_kernel_bits(gT_ref, fT_ref, sel_ref, pair_ref, row_ref,
+                        gene_ref, outpop_ref, outfit_ref, tmp_ref, *,
+                        n, N, L, W, G, tournsize, cxpb, mutpb, indpb,
+                        chunk):
+    outpop_ref[:] = gT_ref[:]
+    outfit_ref[:] = fT_ref[:]
+    _evolve_body(
+        outpop_ref, outfit_ref, tmp_ref, n=n, N=N, L=L, W=W, G=G,
+        tournsize=tournsize, cxpb=cxpb, mutpb=mutpb, indpb=indpb,
+        chunk=chunk,
+        draw_sel=lambda g: sel_ref[g],
+        draw_pair=lambda g: pair_ref[g],
+        draw_row=lambda g: row_ref[g],
+        draw_gene=lambda g, c: gene_ref[g, :, pl.ds(c * chunk, chunk)])
+
+
+def evolve_packed(key: jax.Array, packed: jnp.ndarray, fit: jnp.ndarray,
+                  length: int, ngen: int, *, tournsize: int = 3,
+                  cxpb: float, mutpb: float, indpb: float,
+                  prng: str = "auto", chunk: int = 4096,
+                  interpret: Optional[bool] = None,
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Run ``ngen`` WHOLE generations of the OneMax eaSimple loop —
+    tournament selection, two-point crossover, flip-bit mutation,
+    popcount evaluation — inside ONE single-program Pallas kernel with
+    the population resident in VMEM.
+
+    Motivation (r4): at 449 gens/s the measured per-generation time is
+    ~2.2 ms against an ~9 µs HBM floor for the ~7 MB a generation
+    actually moves — the chip is >99% idle and the cost must be
+    per-generation launch/dispatch overheads of the multi-op XLA step.
+    This kernel removes them wholesale: HBM sees one population read
+    and one write per ``ngen`` generations; selection needs no sort,
+    rank permutation, or XLA gather (lane-axis ``dynamic_gather``
+    against the resident population, as in
+    :func:`sel_tournament_gather_packed`); variation and popcount run
+    on the same resident buffers (double-buffered via one scratch).
+
+    Semantics per generation match the raced XLA/kernel composition —
+    ``sel_tournament`` (+gather) then ``fused_variation_eval_packed``
+    (reference loop being replaced: ``eaSimple``,
+    deap/algorithms.py:85-189) — with the same tournament tie rule
+    (first-drawn wins), pair-consistent crossover draws, exact per-bit
+    Bernoulli(indpb) flips, and OneMax-specific popcount fitness.
+    Draw streams differ from the other candidates (one hardware PRNG
+    stream per kernel), so runs are distribution-equivalent, not
+    bit-identical.
+
+    :param packed: ``uint32[n, W]`` rows from :func:`pack_genomes`.
+    :param fit: ``f32[n]`` current fitness (e.g. ``packed_fitness``).
+    :param ngen: static generation count baked into the program.
+    :param chunk: lanes per mutation sub-block (bounds the [32W, chunk]
+        per-gene uniform block's VMEM footprint); population is padded
+        to a multiple.
+    :returns: ``(population uint32[n, W], fitness f32[n])`` after
+        ``ngen`` generations.
+    """
+    from deap_tpu.ops.kernels import (
+        _auto_interpret,
+        _resolve_prng,
+        _round_up,
+    )
+
+    n, W = packed.shape
+    if ngen == 0:
+        return packed, fit.astype(jnp.float32)
+    interp = _auto_interpret(interpret)
+    prng = _resolve_prng(prng, interp)
+    N = _round_up(n, chunk)
+    gT = jnp.pad(packed.T, ((0, 0), (0, N - n)))
+    fT = jnp.pad(fit.astype(jnp.float32), (0, N - n),
+                 constant_values=-jnp.inf)[None, :]
+    vmem = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
+    out_shapes = (jax.ShapeDtypeStruct((W, N), jnp.uint32),
+                  jax.ShapeDtypeStruct((1, N), jnp.float32))
+    scratch = [pltpu.VMEM((W, N), jnp.uint32)]
+    common = dict(n=n, N=N, L=length, W=W, G=ngen, tournsize=tournsize,
+                  cxpb=cxpb, mutpb=mutpb, indpb=indpb, chunk=chunk)
+    if prng == "hw":
+        seed = jax.random.randint(key, (1,), 0, 2**31 - 1, jnp.int32)
+        outT, outfit = pl.pallas_call(
+            functools.partial(_evolve_kernel_hw, **common),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM), vmem(),
+                      vmem()],
+            out_specs=(vmem(), vmem()),
+            out_shape=out_shapes,
+            scratch_shapes=scratch,
+            interpret=interp,
+        )(seed, gT, fT)
+    else:
+        ks, kp, kr, kg = jax.random.split(key, 4)
+        sel = jax.random.bits(ks, (ngen, tournsize, N), jnp.uint32)
+        pair = jax.random.bits(kp, (ngen, 3, N), jnp.uint32)
+        row = jax.random.bits(kr, (ngen, 1, N), jnp.uint32)
+        gene = jax.random.bits(kg, (ngen, WORD * W, N), jnp.uint32)
+        outT, outfit = pl.pallas_call(
+            functools.partial(_evolve_kernel_bits, **common),
+            in_specs=[vmem()] * 6,
+            out_specs=(vmem(), vmem()),
+            out_shape=out_shapes,
+            scratch_shapes=scratch,
+            interpret=interp,
+        )(gT, fT, sel, pair, row, gene)
+    return outT.T[:n], outfit[0, :n]
 
 
 def sel_tournament_gather_packed(key: jax.Array, packed: jnp.ndarray,

@@ -186,3 +186,101 @@ def test_selgather_hw_rejected_off_tpu():
     with pytest.raises(ValueError, match="hw"):
         pk.sel_tournament_gather_packed(
             jax.random.key(0), g, jnp.zeros(8), prng="hw", interpret=True)
+
+
+# ------------------------------------------------ whole-GA mega-kernel ----
+
+def test_evolve_packed_selection_only_membership():
+    """cxpb=0, mutpb=0: each generation's children are EXACT copies of
+    tournament winners, so after G generations every row is a member of
+    the original population and mean fitness is non-decreasing."""
+    n, L = 256, 100
+    bits = jax.random.bernoulli(jax.random.key(0), 0.5, (n, L))
+    g = pk.pack_genomes(bits)
+    fit = pk.packed_fitness(g)
+    pop2, fit2 = pk.evolve_packed(
+        jax.random.key(1), g, fit, L, 3, cxpb=0.0, mutpb=0.0,
+        indpb=0.05, prng="input", chunk=128, interpret=True)
+    pop_set = {bytes(np.asarray(r).tobytes()) for r in np.asarray(g)}
+    for r in np.asarray(pop2):
+        assert bytes(r.tobytes()) in pop_set
+    np.testing.assert_array_equal(np.asarray(pk.packed_fitness(pop2)),
+                                  np.asarray(fit2))
+    assert float(fit2.mean()) >= float(fit.mean())
+
+
+def test_evolve_packed_crossover_conserves_pair_totals():
+    """cxpb=1, mutpb=0, tournsize=1 from a half-zeros/half-ones
+    population: two-point swap conserves each pair's total gene count
+    (every pair mixes one all-zeros with one all-ones parent only when
+    the tournament draws them; totals must stay in [0, 2L] and equal
+    the parents' sum per pair)."""
+    n, L = 256, 100
+    W = pk.words_for(L)
+    ones_row = pk.pack_genomes(jnp.ones((1, L)))[0]
+    g = jnp.where((jnp.arange(n) % 2 == 0)[:, None],
+                  jnp.zeros((W,), jnp.uint32), ones_row)
+    fit = pk.packed_fitness(g)
+    pop2, fit2 = pk.evolve_packed(
+        jax.random.key(2), g, fit, L, 1, tournsize=1, cxpb=1.0,
+        mutpb=0.0, indpb=0.05, prng="input", chunk=128, interpret=True)
+    f = np.asarray(fit2)
+    assert ((f >= 0) & (f <= L)).all()
+    # two-point swap conserves each adjacent pair's combined popcount;
+    # with tournsize=1 parents are uniform draws of 0- or L-rows, so
+    # every pair total must be 0, L, or 2L
+    tot = f[0::2] + f[1::2]
+    assert set(np.unique(tot)).issubset({0.0, float(L), float(2 * L)})
+
+
+def test_evolve_packed_flip_rate():
+    """cxpb=0, mutpb=1, tournsize=1 over an all-zeros population: the
+    per-gene flip rate over one generation is Bernoulli(indpb)."""
+    n, L, indpb = 512, 100, 0.05
+    W = pk.words_for(L)
+    g = jnp.zeros((n, W), jnp.uint32)
+    fit = pk.packed_fitness(g)
+    _, fit2 = pk.evolve_packed(
+        jax.random.key(3), g, fit, L, 1, tournsize=1, cxpb=0.0,
+        mutpb=1.0, indpb=indpb, prng="input", chunk=128, interpret=True)
+    rate = float(np.asarray(fit2).sum()) / (n * L)
+    sigma = (indpb * (1 - indpb) / (n * L)) ** 0.5
+    assert abs(rate - indpb) < 4 * sigma, rate
+
+
+def test_evolve_packed_improves_onemax():
+    """Full GA config over several generations climbs OneMax and the
+    returned fitness column matches the returned population."""
+    n, L = 512, 100
+    bits = jax.random.bernoulli(jax.random.key(4), 0.5, (n, L))
+    g = pk.pack_genomes(bits)
+    fit = pk.packed_fitness(g)
+    pop2, fit2 = pk.evolve_packed(
+        jax.random.key(5), g, fit, L, 6, cxpb=0.5, mutpb=0.2,
+        indpb=0.05, prng="input", chunk=128, interpret=True)
+    assert float(fit2.mean()) > float(fit.mean()) + 3.0
+    np.testing.assert_array_equal(np.asarray(pk.packed_fitness(pop2)),
+                                  np.asarray(fit2))
+
+
+def test_evolve_packed_pad_lanes_inert():
+    """n not a multiple of the lane chunk: padding lanes must never be
+    selected into the real population (draws are % n)."""
+    n, L = 200, 64  # pads to 256 with chunk=128
+    bits = jax.random.bernoulli(jax.random.key(6), 0.5, (n, L))
+    g = pk.pack_genomes(bits)
+    fit = pk.packed_fitness(g)
+    pop2, fit2 = pk.evolve_packed(
+        jax.random.key(7), g, fit, L, 2, cxpb=0.0, mutpb=0.0,
+        indpb=0.05, prng="input", chunk=128, interpret=True)
+    pop_set = {bytes(np.asarray(r).tobytes()) for r in np.asarray(g)}
+    for r in np.asarray(pop2):
+        assert bytes(r.tobytes()) in pop_set
+
+
+def test_evolve_packed_hw_rejected_off_tpu():
+    g = jnp.zeros((8, 4), jnp.uint32)
+    with pytest.raises(ValueError, match="hw"):
+        pk.evolve_packed(jax.random.key(0), g, jnp.zeros(8), 100, 1,
+                         cxpb=0.5, mutpb=0.2, indpb=0.05, prng="hw",
+                         interpret=True)

@@ -95,15 +95,17 @@ COMPONENT_NAMES = (
     "counting_mxu", "counting_scan",
 )
 # bench.py cross-checks its CANDIDATES length against this (same
-# cannot-import-the-bench-script reason as the lists above)
-N_CANDIDATES = 6
+# cannot-import-the-bench-script reason as the lists above).
+# 7 = + packed_evolve, the r4 whole-GA-in-VMEM mega-kernel
+N_CANDIDATES = 7
 
 # bump when _tpu_hw_check gains checks: an ok verdict from an older
 # version must not skip the step, or kernels added since (e.g. the
 # selgather dynamic_gather path) get raced without on-chip validation.
 # v3: tiled dominance kernels (nd_rank_tiled/strengths_tiled vs the
 # matrix oracle at n=16k) — their first execution on a real TPU core.
-HW_CHECK_VERSION = 3
+# v4: the evolve_packed whole-GA mega-kernel's on-chip checks.
+HW_CHECK_VERSION = 4
 
 # reference CPU gens/sec per suite config, and which references are
 # extrapolated rather than measured (BASELINE.md records the recipes).
