@@ -144,8 +144,23 @@ def main():
     # appended to --out the moment it lands. The 2026-07-31 relay
     # window taught the lesson — the old all-components-then-print
     # shape lost 40 minutes of tunnel compiles to a single timeout.
+    def evolve_run():
+        if jax.default_backend() != "tpu":
+            # the interpreter at pop=100k x NGEN=200 would take hours;
+            # the error row below records the resolution
+            raise RuntimeError("full_evolve profiles on TPU only")
+
+        @jax.jit
+        def run(key, packed, fit):
+            _, f = ops.evolve_packed(
+                key, packed, fit, LENGTH, NGEN, tournsize=3, cxpb=0.5,
+                mutpb=0.2, indpb=0.05, prng="hw", interpret=False)
+            return f
+        return run
+
     components = [
         ("full_binned", lambda: full("binned")),
+        ("full_evolve", evolve_run),
         ("kernel_fused_packed", lambda: kernel_only),
         ("select_binned", lambda: sel_binned),
         ("gather_random", lambda: gather_only),
@@ -177,18 +192,43 @@ def main():
     if out_path:
         from tpu_capture import _jsonl_rows
         for d in _jsonl_rows(out_path):
-            if d.get("backend") == out["backend"] and "ms_per_gen" in d:
+            if d.get("backend") != out["backend"]:
+                continue
+            # error rows are resolutions too: a deterministically
+            # failing component must not re-pay its tunnel compile on
+            # every later run (incl. the --trace queue step)
+            if "ms_per_gen" in d or "error" in d:
                 done.add(d.get("component"))
+            if "ms_per_gen" in d:
                 out["ms_per_gen"][d["component"]] = d["ms_per_gen"]
     for name, build in components:
         if name in done:
             print(f'{{"component": "{name}", "skipped": "captured"}}',
                   flush=True)
             continue
-        ms = round(timed(build(), packed, fit) * 1e3, 4)
-        out["ms_per_gen"][name] = ms
-        line = {"component": name, "ms_per_gen": ms,
-                "backend": out["backend"]}
+        try:
+            ms = round(timed(build(), packed, fit) * 1e3, 4)
+            line = {"component": name, "ms_per_gen": ms,
+                    "backend": out["backend"]}
+            out["ms_per_gen"][name] = ms
+        except Exception as e:
+            from _axon_probe import axon_tunnel_reachable
+            if (out["backend"] == "tpu"
+                    and not axon_tunnel_reachable()):
+                # the exception arrived WITH the relay dying: transient,
+                # not a component verdict — abort with NO error row so
+                # a later window re-attempts (mirrors _tpu_hw_check's
+                # relay-liveness guard)
+                print(f"bench_profile: {name} failed with the relay "
+                      f"down ({type(e).__name__}); aborting sweep",
+                      file=sys.stderr)
+                sys.exit(1)
+            # a deterministically failing component (e.g. a Mosaic
+            # lowering gap in the mega-kernel) must resolve with an
+            # error row, not block the remaining components or make
+            # the capture predicate re-run this script every window
+            line = {"component": name, "backend": out["backend"],
+                    "error": f"{type(e).__name__}: {str(e)[:200]}"}
         print(json.dumps(line), flush=True)
         if out_path:
             with open(out_path, "a") as f:

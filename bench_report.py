@@ -20,6 +20,7 @@ from tpu_capture import (  # noqa: E402
     SUITE_EXTRAPOLATED,
     SUITE_REF,
     headline_rows,
+    profile_resolved,
     profile_rows,
     suite_rows,
 )
@@ -56,10 +57,14 @@ def main() -> None:
 
     print("\n## Generation-step profile (ms/gen, pop=100k)\n")
     prof = {c: r["ms_per_gen"] for c, r in profile_rows().items()}
+    resolved = profile_resolved()
     print("| component | ms/gen |")
     print("|---|---|")
     for name in COMPONENT_NAMES:
         v = prof.get(name)
+        if v is None and name in resolved:
+            # errored on-chip: surface the verdict, don't show pending
+            v = f"failed: {resolved[name]['error'][:80]}"
         print(f"| {name} | {v if v is not None else '*(pending)*'} |")
     if prof.get("full_binned"):
         parts = {k: v for k, v in prof.items()

@@ -126,14 +126,19 @@ def test_suite_needs_every_config_with_tpu_backing(capture, tmp_path):
 def test_profile_needs_every_component(capture, tmp_path):
     prof = tmp_path / capture.PROFILE_OUT
     _write(prof, [{"component": c, "ms_per_gen": 1.0, "backend": "tpu"}
-                  for c in capture.COMPONENT_NAMES[:-1]])
+                  for c in capture.COMPONENT_NAMES[:-2]])
     assert not capture.already_captured("bench_profile.py")
-    # CPU rows for the missing component don't count
+    # CPU rows for the missing components don't count
     _write(prof, [{"component": capture.COMPONENT_NAMES[-1],
                    "ms_per_gen": 1.0, "backend": "cpu"}])
     assert not capture.already_captured("bench_profile.py")
     _write(prof, [{"component": capture.COMPONENT_NAMES[-1],
                    "ms_per_gen": 1.0, "backend": "tpu"}])
+    assert not capture.already_captured("bench_profile.py")
+    # an error row IS a resolution (deterministic failure on record)
+    _write(prof, [{"component": capture.COMPONENT_NAMES[-2],
+                   "error": "NotImplementedError: ...",
+                   "backend": "tpu"}])
     assert capture.already_captured("bench_profile.py")
 
 

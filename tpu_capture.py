@@ -90,9 +90,9 @@ SUITE_CONFIG_NAMES = (
     "cartpole_neuro_pop10k", "cmaes_n100_lam4096",
 )
 COMPONENT_NAMES = (
-    "full_binned", "kernel_fused_packed", "select_binned",
-    "gather_random", "gather_coherent", "full_sorted", "select_sorted",
-    "counting_mxu", "counting_scan",
+    "full_binned", "full_evolve", "kernel_fused_packed",
+    "select_binned", "gather_random", "gather_coherent", "full_sorted",
+    "select_sorted", "counting_mxu", "counting_scan",
 )
 # bench.py cross-checks its CANDIDATES length against this (same
 # cannot-import-the-bench-script reason as the lists above).
@@ -210,11 +210,24 @@ def suite_rows():
 
 
 def profile_rows():
-    """Valid TPU profile rows, keyed by component — shared by the
-    capture predicate and bench_report."""
+    """Valid TPU profile timing rows, keyed by component — shared by
+    the capture predicate and bench_report."""
     return {r["component"]: r for r in
             _jsonl_rows(os.path.join(HERE, PROFILE_OUT))
             if r.get("backend") == "tpu" and "ms_per_gen" in r}
+
+
+def profile_resolved():
+    """Every component RESOLVED with TPU backing, keyed by component:
+    a timing row, or an error row (a deterministic on-chip failure is
+    a resolution — e.g. a Mosaic lowering gap — and its text is worth
+    surfacing). Superset of :func:`profile_rows`; the single source
+    for both the capture predicate and bench_report, so the watcher
+    and the report can never disagree on capture status."""
+    return {r["component"]: r for r in
+            _jsonl_rows(os.path.join(HERE, PROFILE_OUT))
+            if r.get("backend") == "tpu" and r.get("component")
+            and ("ms_per_gen" in r or "error" in r)}
 
 
 def _have_suite():
@@ -224,7 +237,13 @@ def _have_suite():
 
 
 def _have_profile():
-    return set(profile_rows()).issuperset(COMPONENT_NAMES)
+    """Every component RESOLVED with TPU backing — a timing row, or an
+    error row (a deterministic failure, e.g. a Mosaic lowering gap, is
+    a resolution; re-paying the component's tunnel compile every
+    window is not). bench_profile itself aborts rather than writing an
+    error row when the relay died under a component, so transient
+    failures never masquerade as resolutions here."""
+    return set(profile_resolved()).issuperset(COMPONENT_NAMES)
 
 
 def _have_trace():
