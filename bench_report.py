@@ -64,7 +64,10 @@ def main() -> None:
         v = prof.get(name)
         if v is None and name in resolved:
             # errored on-chip: surface the verdict, don't show pending
-            v = f"failed: {resolved[name]['error'][:80]}"
+            # (sanitised — Mosaic errors carry newlines and pipes that
+            # would break the markdown row)
+            err = resolved[name]["error"].replace("\n", " ")
+            v = "failed: " + err.replace("|", "\\|")[:80]
         print(f"| {name} | {v if v is not None else '*(pending)*'} |")
     if prof.get("full_binned"):
         parts = {k: v for k, v in prof.items()

@@ -11,7 +11,11 @@ configurations.
 
 Usage::
 
-    python examples/speed.py [--full] [--cpu] [--report PATH] [pattern]
+    python examples/speed.py [--full] [--cpu] [--isolate] [--flagship]
+                             [--report PATH] [pattern]
+
+``--flagship`` restricts the sweep to one TPU-salient program per
+family (the short-relay-window zoo subset, see ``FLAGSHIP``).
 
 ``--cpu`` forces the CPU backend (the environment's TPU plugin pins
 ``jax_platforms``, and a wedged tunnel hangs jax init — see bench.py's
@@ -26,6 +30,19 @@ import json
 import pathlib
 import sys
 import time
+
+
+# One TPU-salient program per family — the short-relay-window zoo
+# subset (``--flagship``): enough to show the examples run on the
+# hardware they're named for without spending a window on all 53.
+FLAGSHIP = (
+    "examples.ga.onemax_fused",
+    "examples.ga.nsga2_large",
+    "examples.gp.symbreg",
+    "examples.es.cma_minfct",
+    "examples.ga.onemax_island_sharded",
+    "examples.neuroevolution.cartpole",
+)
 
 
 def discover():
@@ -53,6 +70,12 @@ def main(argv=None):
     isolate = "--isolate" in argv
     if isolate:
         argv.remove("--isolate")
+    flagship = "--flagship" in argv
+    if flagship:
+        argv.remove("--flagship")
+    resume = "--resume" in argv
+    if resume:
+        argv.remove("--resume")
     report_path = None
     if "--report" in argv:
         i = argv.index("--report")
@@ -76,6 +99,15 @@ def main(argv=None):
     if str(root) not in sys.path:
         sys.path.insert(0, str(root))
 
+    # the capture queue's completion predicate keeps its own copy of
+    # the flagship list (it cannot import us); fail loudly on drift
+    try:
+        from tpu_capture import ZOO_FLAGSHIP
+        if FLAGSHIP != ZOO_FLAGSHIP:
+            sys.exit("FLAGSHIP drifted from tpu_capture.ZOO_FLAGSHIP")
+    except ImportError:
+        pass  # running from an installed copy without the harness
+
     def write_report(results):
         # rewritten after every program: a crash partway (one process
         # accumulating 50+ XLA programs can exhaust compile memory)
@@ -98,10 +130,31 @@ def main(argv=None):
         return n_ok
 
     results = []
+    done = set()
+    if resume and report_path is not None and report_path.exists():
+        # cross-window resume (relay windows are scarce): keep prior
+        # rows that resolved ON TPU and only re-run the rest — without
+        # this, a window that died mid-sweep discards every earlier
+        # window's on-chip evidence at the first write_report
+        try:
+            prior = json.loads(report_path.read_text())
+        except (ValueError, OSError):
+            prior = {}
+        for r in prior.get("results", []):
+            if (r.get("backend") == "tpu"
+                    and r.get("config") == ("full" if full else "smoke")):
+                results.append(r)
+                done.add(r["example"])
     for name in discover():
         if only is not None and name != only:
             continue
+        if flagship and name not in FLAGSHIP:
+            continue
         if pattern and pattern not in name:
+            continue
+        if name in done:
+            print(f'{{"example": "{name}", "skipped": "captured"}}',
+                  flush=True)
             continue
         if isolate:
             rec = _run_isolated(name, full, force_cpu)

@@ -169,6 +169,12 @@ def test_queue_complete_only_when_everything_landed(capture, tmp_path):
     tdir = tmp_path / capture.TRACE_DIR
     tdir.mkdir(parents=True)
     (tdir / "host.xplane.pb").write_bytes(b"\x00")
+    assert not capture.queue_complete()  # zoo still missing
+    import json as _json
+    (tmp_path / capture.ZOO_OUT).write_text(_json.dumps({
+        "results": [{"example": n, "ok": True, "backend": "tpu",
+                     "config": "full"}
+                    for n in capture.ZOO_FLAGSHIP]}))
     # still incomplete: the headline race predates the full candidate
     # roster (no n_candidates stamp)
     assert not capture.queue_complete()
@@ -176,6 +182,31 @@ def test_queue_complete_only_when_everything_landed(capture, tmp_path):
               [{"value": 460.0, "backend": "tpu",
                 "n_candidates": capture.N_CANDIDATES}])
     assert capture.queue_complete()
+
+
+def test_zoo_needs_every_flagship_on_tpu(capture, tmp_path):
+    import json as _json
+
+    zoo = tmp_path / capture.ZOO_OUT
+    rows = [{"example": n, "ok": True, "backend": "tpu",
+             "config": "full"} for n in capture.ZOO_FLAGSHIP[:-1]]
+    # timeout row (no backend) must not count as resolved
+    rows.append({"example": capture.ZOO_FLAGSHIP[-1],
+                 "ok": "subprocess timeout (3600s)", "backend": None})
+    zoo.write_text(_json.dumps({"results": rows}))
+    assert not capture.already_captured("speed.py#flagship")
+    # a FAILING on-chip row is still a resolution (recorded evidence)
+    # smoke-config TPU rows must not satisfy the full-config step
+    rows[-1] = {"example": capture.ZOO_FLAGSHIP[-1],
+                "ok": True, "backend": "tpu", "config": "smoke"}
+    zoo.write_text(_json.dumps({"results": rows}))
+    assert not capture.already_captured("speed.py#flagship")
+    # a FAILING full-config on-chip row is still a resolution
+    rows[-1] = {"example": capture.ZOO_FLAGSHIP[-1],
+                "ok": "ValueError: boom", "backend": "tpu",
+                "config": "full"}
+    zoo.write_text(_json.dumps({"results": rows}))
+    assert capture.already_captured("speed.py#flagship")
 
 
 def test_full_race_accepts_deterministic_failures(capture):

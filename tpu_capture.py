@@ -38,6 +38,7 @@ from _axon_probe import axon_tunnel_reachable  # noqa: E402
 # _have_* predicates both derive from these, so a round bump cannot
 # leave queue_complete() reading stale files
 ROUND = "r04"
+ZOO_OUT = f"TPU_ZOO_{ROUND}.json"
 
 # persistent XLA compilation cache shared across window attempts: the
 # 03:18 r3 window lost ~40 of its 44 minutes to tunnel compiles that a
@@ -73,6 +74,13 @@ STEPS = [
     ("bench_profile.py --trace", [sys.executable, "bench_profile.py",
                                   "--trace", TRACE_DIR,
                                   "--out", PROFILE_OUT], 2400),
+    # the examples are the de-facto integration suite and have never
+    # touched the hardware they're named for (VERDICT r3 #9): one
+    # TPU-salient program per family, full configs, process-isolated
+    ("speed.py#flagship", [sys.executable,
+                           os.path.join("examples", "speed.py"),
+                           "--flagship", "--full", "--isolate",
+                           "--resume", "--report", ZOO_OUT], 5400),
     # LAST: re-race the headline once everything else is captured —
     # candidates added after the first capture (block-size variants)
     # are otherwise only measured at the driver's round-end run
@@ -120,6 +128,17 @@ SUITE_REF = {
     "cartpole_neuro_pop10k": 0.2398,  # initial-pop (generous); 0.0121 converged
 }
 SUITE_EXTRAPOLATED = {"nsga2_zdt1_pop50k"}
+
+# canonical flagship list (examples/speed.py asserts against this —
+# same cannot-import-the-heavy-module reason as the lists above)
+ZOO_FLAGSHIP = (
+    "examples.ga.onemax_fused",
+    "examples.ga.nsga2_large",
+    "examples.gp.symbreg",
+    "examples.es.cma_minfct",
+    "examples.ga.onemax_island_sharded",
+    "examples.neuroevolution.cartpole",
+)
 
 
 def _jsonl_rows(path):
@@ -246,6 +265,26 @@ def _have_profile():
     return set(profile_resolved()).issuperset(COMPONENT_NAMES)
 
 
+def _have_zoo():
+    """Every flagship example RESOLVED on TPU in the zoo report: a row
+    with backend "tpu", passing or not (a recorded on-chip failure is
+    evidence; a timeout/no-backend row is not — the window died and a
+    later one must retry)."""
+    path = os.path.join(HERE, ZOO_OUT)
+    if not os.path.exists(path):
+        return False
+    try:
+        report = json.load(open(path))
+    except (json.JSONDecodeError, OSError):
+        return False
+    # full-config TPU rows only: a smoke run on-chip must not satisfy
+    # the full-config step (same stance as _have_trace's CPU guard)
+    resolved = {r.get("example") for r in report.get("results", [])
+                if r.get("backend") == "tpu"
+                and r.get("config") == "full"}
+    return resolved.issuperset(ZOO_FLAGSHIP)
+
+
 def _have_trace():
     """A *finalised* xplane file, not just a non-empty directory — a
     trace run killed mid-write leaves plugins/... scaffolding that
@@ -278,6 +317,7 @@ CAPTURED = {
     "bench_suite.py": _have_suite,
     "bench_profile.py": _have_profile,
     "bench_profile.py --trace": _have_trace,
+    "speed.py#flagship": _have_zoo,
     "bench.py#rerace": _have_full_race,
 }
 
@@ -309,7 +349,7 @@ def log(step, payload):
 
 def commit(step):
     paths = [p for p in (os.path.basename(EVIDENCE), SUITE_OUT,
-                         PROFILE_OUT,
+                         PROFILE_OUT, ZOO_OUT,
                          "TPU_PROBE_LOG.jsonl", "traces")
              if os.path.exists(os.path.join(HERE, p))]
     subprocess.run(["git", "add", "-A"] + paths,
@@ -318,6 +358,31 @@ def commit(step):
                     f"TPU evidence: {step} captured\n\n"
                     "No-Verification-Needed: measurement artifacts only"],
                    cwd=HERE, capture_output=True)
+
+
+def _run_step(cmd, timeout_s):
+    """Run one queue step in its OWN process group and, on timeout,
+    kill the whole group. ``subprocess.run``'s timeout kills only the
+    direct child: a step like speed.py --isolate (or bench.py's
+    candidate race) spawns grandchildren that would survive, keep
+    holding the single-client TPU, and wedge every later step in the
+    window."""
+    import signal
+
+    proc = subprocess.Popen(
+        cmd, cwd=HERE, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, env={**os.environ, **CACHE_ENV},
+        start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        proc.communicate()
+        raise
+    return subprocess.CompletedProcess(cmd, proc.returncode, out, err)
 
 
 def main():
@@ -334,9 +399,7 @@ def main():
             commit(step)
             break
         try:
-            r = subprocess.run(cmd, cwd=HERE, capture_output=True,
-                               text=True, timeout=timeout_s,
-                               env={**os.environ, **CACHE_ENV})
+            r = _run_step(cmd, timeout_s)
             results = []
             for ln in r.stdout.splitlines():
                 if ln.startswith("{"):
