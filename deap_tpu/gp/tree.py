@@ -48,6 +48,14 @@ def make_generator(pset: PrimitiveSet, max_len: int, min_depth: int,
         raise ValueError(mode)
     t_ratio = pset.terminal_ratio
     arity = pset.arity_table()
+    # a depth-bounded tree can never need more slots than the full
+    # a-ary tree of that depth, so the scan stops there — mutUniform's
+    # genFull(0, 2) donor (7 slots at arity 2) was paying a 32-step
+    # scan per individual before this bound
+    a = max(int(pset.max_arity), 1)
+    depth_cap = (max_depth + 1 if a == 1
+                 else (a ** (max_depth + 1) - 1) // (a - 1))
+    scan_len = min(max_len, depth_cap)
 
     def gen(key: jax.Array) -> Genome:
         k_h, k_mode, k_scan = jax.random.split(key, 3)
@@ -97,11 +105,11 @@ def make_generator(pset: PrimitiveSet, max_len: int, min_depth: int,
             length = length + pending.astype(jnp.int32)
             return (nodes, consts, stack, sp, length), None
 
-        keys = jax.random.split(k_scan, max_len)
+        keys = jax.random.split(k_scan, scan_len)
         init = (nodes0, consts0, depth_stack0.at[0].set(0), jnp.int32(1),
                 jnp.int32(0))
         (nodes, consts, _, _, length), _ = lax.scan(
-            step, init, (jnp.arange(max_len), keys))
+            step, init, (jnp.arange(scan_len), keys))
         return {"nodes": nodes, "consts": consts, "length": length}
 
     return gen
@@ -136,36 +144,73 @@ def subtree_end(nodes: jnp.ndarray, arity: jnp.ndarray,
     return jnp.argmax(closed) + 1
 
 
+def subtree_ends_all(nodes: jnp.ndarray, length, arity: jnp.ndarray
+                     ) -> jnp.ndarray:
+    """Exclusive subtree end for EVERY slot at once — ``end_i`` is the
+    first ``j ≥ i`` where the pending count ``1 + cs[j] − cs[i−1]``
+    hits zero, i.e. the first ``j`` with ``cs[j] ≤ cs[i−1] − 1``. That
+    is a next-smaller-element query, answered for all ``i`` together
+    by a vectorised binary search over a sparse range-min table of the
+    arity cumsum: O(L log L) vector work (the r3 formulation built an
+    [L, L] mask per tree, which dominated the GP variation pipeline —
+    60+ of 130 ms/gen at pop=4096 went to staticLimit's height
+    measure). Slots at/past ``length`` return garbage; mask downstream."""
+    L = nodes.shape[0]
+    deficit = jnp.where(jnp.arange(L) < length, arity[nodes] - 1, 0)
+    cs = jnp.cumsum(deficit)
+    prev = jnp.concatenate([jnp.zeros(1, cs.dtype), cs[:-1]])  # cs[i-1]
+    NEG = jnp.asarray(-(2 ** 30), cs.dtype)
+
+    # levels[k][p] = min cs over [p, p+2^k), windows truncated at L
+    # behaving as NEG (so the search can never skip past the end)
+    levels = [cs]
+    k = 1
+    while k < L:
+        m = levels[-1]
+        shifted = jnp.concatenate([m[k:], jnp.full((k,), NEG, cs.dtype)])
+        levels.append(jnp.minimum(m, shifted))
+        k *= 2
+
+    # first j >= i with cs[j] <= target; skip a 2^k block only when its
+    # range-min stays above target
+    target = prev - 1
+    pos = jnp.arange(L)
+    for lev in reversed(range(len(levels))):
+        step = 1 << lev
+        block_min = jnp.where(
+            pos < L, levels[lev][jnp.minimum(pos, L - 1)], NEG)
+        pos = jnp.where(block_min > target, pos + step, pos)
+    return jnp.minimum(pos, L - 1) + 1
+
+
 def prefix_depths(nodes: jnp.ndarray, length, arity: jnp.ndarray
                   ) -> jnp.ndarray:
     """Depth of every slot (root 0; garbage past ``length``) in closed
     form — no serial walk.
 
-    In prefix order, the ancestors of slot ``j`` are exactly the slots
+    In prefix order the ancestors of slot ``j`` are exactly the slots
     ``i ≤ j`` whose subtree interval ``[i, end_i)`` contains ``j``, so
-    ``depth[j] = #{i ≤ j : end_i > j} − 1`` (the −1 removes ``j``'s own
-    interval). All ``end_i`` share one arity cumsum (the
-    :func:`subtree_end` walk): ``end_i`` is the first ``j ≥ i`` where
-    ``cs[j] == cs[i−1] − 1``. One [L, L] mask instead of an L-step
-    scan — the VPU-shaped formulation of the reference's depth stack
-    (gp.py:155-166)."""
+    ``depth[j] = #{i ≤ j : end_i > j} − 1 = j − #{i : end_i ≤ j}``
+    (``end_i > i`` makes the ``i ≤ j`` constraint automatic). With
+    every end from :func:`subtree_ends_all`, that count is one
+    histogram cumsum — O(L log L) total, replacing the r3 [L, L]
+    ancestor mask (the VPU-shaped formulation of the reference's depth
+    stack, gp.py:155-166)."""
     L = nodes.shape[0]
-    deficit = arity[nodes] - 1
-    cs = jnp.cumsum(jnp.where(jnp.arange(L) < length, deficit, 0))
-    prev = jnp.concatenate([jnp.zeros(1, cs.dtype), cs[:-1]])  # cs[i-1]
-    j = jnp.arange(L)
-    # closed[i, j]: subtree rooted at i has closed by slot j (inclusive)
-    closed = (cs[None, :] == (prev[:, None] - 1)) & (j[None, :] >= j[:, None])
-    ends = jnp.argmax(closed, axis=1) + 1            # end_i, exclusive
-    ancestors = (j[:, None] <= j[None, :]) & (ends[:, None] > j[None, :])
-    return jnp.sum(ancestors, axis=0).astype(jnp.int32) - 1
+    ends = subtree_ends_all(nodes, length, arity)
+    live = jnp.arange(L) < length
+    hist = jnp.zeros(L + 1, jnp.int32).at[
+        jnp.clip(jnp.where(live, ends, L), 0, L)].add(
+        live.astype(jnp.int32), mode="drop")
+    closed_by = jnp.cumsum(hist)[:-1]       # #(live ends <= j)
+    return (jnp.arange(L) - closed_by).astype(jnp.int32)
 
 
 def tree_height(genome: Genome, pset: PrimitiveSet) -> jnp.ndarray:
     """Tree height (root at 0), the measure of staticLimit/height
     (gp.py:155-166) — max over :func:`prefix_depths` of the live
-    prefix (one [L, L] mask op; the depth-stack walk it replaces cost
-    an L-step serial scan per tree)."""
+    prefix (O(L log L) via the all-ends binary search; the depth-stack
+    walk it replaces cost an L-step serial scan per tree)."""
     arity = pset.arity_table()
     nodes, length = genome["nodes"], genome["length"]
     depths = prefix_depths(nodes, length, arity)

@@ -225,6 +225,12 @@ def make_generator_typed(pset: PrimitiveSetTyped, max_len: int,
     t_ratio = pset.terminal_ratio
     arity = pset.arity_table()
     arg_types = pset.arg_type_table()
+    # same depth-capped scan bound as the untyped make_generator: a
+    # depth-<=max_depth tree at arity <=a never needs more slots
+    a = max(int(pset.max_arity), 1)
+    depth_cap = (max_depth + 1 if a == 1
+                 else (a ** (max_depth + 1) - 1) // (a - 1))
+    scan_len = min(max_len, depth_cap)
     max_ar = max(pset.max_arity, 1)
 
     def gen(key: jax.Array, ret_type=None) -> Genome:
@@ -278,11 +284,11 @@ def make_generator_typed(pset: PrimitiveSetTyped, max_len: int,
             length = length + pending.astype(jnp.int32)
             return (nodes, consts, dstack, tstack, sp, length), None
 
-        keys = jax.random.split(k_scan, max_len)
+        keys = jax.random.split(k_scan, scan_len)
         init = (nodes0, consts0, dstack0, tstack0, jnp.int32(1),
                 jnp.int32(0))
         (nodes, consts, _, _, _, length), _ = lax.scan(
-            step, init, (jnp.arange(max_len), keys))
+            step, init, (jnp.arange(scan_len), keys))
         return {"nodes": nodes, "consts": consts, "length": length}
 
     return gen
