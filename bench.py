@@ -489,11 +489,17 @@ def main():
         if dt == float("inf"):
             # every isolated candidate died (relay wedged under us):
             # report an honest failure line rather than hanging
+            # stamp resolution counts so tpu_capture._have_full_race
+            # can treat a fully-resolved all-failed race as terminal
+            # instead of re-running it every window (advisor r4)
             print(json.dumps({
                 "metric": "onemax_pop100k_generations_per_sec",
                 "value": 0.0, "unit": "gens/sec", "vs_baseline": 0.0,
                 "backend": "tpu", "error": "all candidates failed",
-                "candidates": outcomes}))
+                "candidates": outcomes,
+                "n_candidates": 0,
+                "n_resolved": sum(v in ("timed", "failed")
+                                  for v in outcomes.values())}))
             return
     else:
         backend = "cpu"

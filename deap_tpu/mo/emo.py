@@ -478,15 +478,28 @@ def sel_spea2(key, w, k):
             dd = jnp.where(mask[:, None] & mask[None, :], d2, big)
             dd = jnp.where(jnp.eye(n, dtype=bool), big, dd)
             rows = jnp.sort(dd, axis=1)  # [n, n] ascending NN distances
-            # lexicographic argmin over rows, masked; tie-break depth is
-            # capped — float distance ties beyond a few NN levels are
-            # vanishingly rare and the reference breaks residual ties by
-            # position anyway
-            cand = mask
-            for j in range(min(n - 1, 8)):
-                col = jnp.where(cand, rows[:, j], big)
-                nxt = cand & (col == jnp.min(col))
-                cand = nxt
+            # lexicographic argmin over rows, masked, to FULL depth —
+            # the reference's removal scan (emo.py:776-790) compares
+            # sorted-distance vectors until they differ, however deep;
+            # residual full-vector ties fall to the lowest alive index
+            # there (min_pos keeps the first candidate) exactly as
+            # argmax over the surviving-candidate mask does here. An
+            # earlier depth-8 cap measured 0.875 set overlap on a
+            # fully-tied front (tests/test_spea2_divergence.py); exact
+            # depth costs one data-dependent while_loop per removal.
+            def tie_cond(s):
+                cand, j = s
+                return (jnp.sum(cand) > 1) & (j < n)
+
+            def tie_body(s):
+                cand, j = s
+                col = jnp.where(
+                    cand, lax.dynamic_index_in_dim(
+                        rows, j, axis=1, keepdims=False), big)
+                return cand & (col == jnp.min(col)), j + 1
+
+            cand, _ = lax.while_loop(
+                tie_cond, tie_body, (mask, jnp.int32(0)))
             drop = jnp.argmax(cand)
             return mask.at[drop].set(False), count - 1
 

@@ -567,6 +567,19 @@ def evolve_packed(key: jax.Array, packed: jnp.ndarray, fit: jnp.ndarray,
             interpret=interp,
         )(seed, gT, fT)
     else:
+        # The 'input' path feeds every generation's draws as VMEM-resident
+        # kernel inputs — gene alone is (ngen, 32W, N) uint32 — so off the
+        # interpreter it only fits for tiny ngen/N; past ~a VMEM's worth
+        # Mosaic fails allocation with an opaque error. Fail fast instead.
+        draw_bytes = 4 * ngen * (tournsize + 3 + 1 + WORD * W) * N
+        if not interp and draw_bytes > 12 * 2**20:
+            raise ValueError(
+                f"evolve_packed(prng='input') would materialise "
+                f"{draw_bytes / 2**20:.0f} MiB of draw tensors as "
+                f"VMEM-resident kernel inputs (ngen={ngen}, pop={n}, "
+                f"W={W}); this cannot fit on hardware. Use prng='hw' "
+                f"(per-kernel hardware PRNG stream) or interpret=True "
+                f"(testing only).")
         ks, kp, kr, kg = jax.random.split(key, 4)
         sel = jax.random.bits(ks, (ngen, tournsize, N), jnp.uint32)
         pair = jax.random.bits(kp, (ngen, 3, N), jnp.uint32)

@@ -284,3 +284,14 @@ def test_evolve_packed_hw_rejected_off_tpu():
         pk.evolve_packed(jax.random.key(0), g, jnp.zeros(8), 100, 1,
                          cxpb=0.5, mutpb=0.2, indpb=0.05, prng="hw",
                          interpret=True)
+
+
+def test_evolve_packed_bits_vmem_guard():
+    # off-interpreter, the 'input' path materialises (ngen, 32W, N)
+    # draws as VMEM-resident inputs — must fail fast with a clear
+    # message instead of an opaque Mosaic allocation error
+    g = jnp.zeros((4096, 4), jnp.uint32)
+    with pytest.raises(ValueError, match="VMEM-resident"):
+        pk.evolve_packed(jax.random.key(0), g, jnp.zeros(4096), 128,
+                         200, cxpb=0.5, mutpb=0.2, indpb=0.05,
+                         prng="input", interpret=False)
