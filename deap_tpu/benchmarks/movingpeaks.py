@@ -15,9 +15,10 @@ pure function usable inside jit/scan:
   bumps ``nevals``, updates the running current/offline error exactly
   like the reference's per-call bookkeeping (cumulative-min over the
   batch), and triggers :func:`change_peaks` through ``lax.cond`` when
-  the evaluation counter crosses a period boundary. The change lands at
-  batch granularity rather than mid-population — the batched analog of
-  the reference's per-individual trigger.
+  the evaluation counter crosses a period boundary. By default the
+  change lands at batch granularity; ``exact=True`` reproduces the
+  reference's per-individual mid-batch trigger exactly (r5), paying a
+  per-individual scan only on batches that actually cross a boundary.
 
 Divergence kept deliberately: the reference can fluctuate the *number*
 of peaks ([min, init, max] npeaks, :126-129); here the peak count is
@@ -191,14 +192,28 @@ def change_peaks(cfg: MovingPeaksConfig, state: MovingPeaksState
 
 
 def mp_evaluate(cfg: MovingPeaksConfig, state: MovingPeaksState,
-                genomes: jnp.ndarray):
+                genomes: jnp.ndarray, exact: bool = False):
     """Evaluate a population ``[n, dim]`` → (new_state, values [n, 1]).
 
     Error bookkeeping matches the reference's sequential semantics
     (movingpeaks.py:225-244): running min of |f - optimum| threaded
-    through the batch, summed into the offline error. The peak change
-    fires once per batch if ``nevals`` crosses a period boundary.
+    through the batch, summed into the offline error. By default the
+    peak change fires once per batch if ``nevals`` crosses a period
+    boundary — the batched analog of the reference's per-individual
+    trigger.
+
+    ``exact=True`` reproduces the reference's EXACT mid-batch
+    semantics (movingpeaks.py:231-241: evaluate, count, then change
+    when ``nevals % period == 0``): individuals before the boundary
+    see the old landscape, individuals after see the new one, with as
+    many changes per batch as boundaries crossed. Implemented as a
+    ``lax.cond`` that keeps the fully-batched path when no boundary
+    falls inside the batch (the common case — identical bookkeeping,
+    full speed) and switches to a per-individual ``lax.scan`` only for
+    crossing batches, so exactness costs nothing between changes.
     """
+    if exact:
+        return _mp_evaluate_exact(cfg, state, genomes)
     n = genomes.shape[0]
     values = jax.vmap(lambda x: _landscape(cfg, state, x))(genomes)
 
@@ -221,6 +236,59 @@ def mp_evaluate(cfg: MovingPeaksConfig, state: MovingPeaksState,
             lambda s: change_peaks(cfg, s).replace(
                 current_error=jnp.asarray(jnp.inf)),
             lambda s: s, new_state)
+    return new_state, values[:, None]
+
+
+def _mp_evaluate_exact(cfg: MovingPeaksConfig, state: MovingPeaksState,
+                       genomes: jnp.ndarray):
+    """Per-evaluation-exact form of :func:`mp_evaluate` (see its
+    docstring). The scan step is the reference's ``__call__`` body
+    verbatim in order: landscape value on the current state, count,
+    running-error update against the current optimum, then
+    ``change_peaks`` when the counter hits a period multiple
+    (movingpeaks.py:231-241). The optimum is recomputed per step
+    rather than cached-until-None like the reference — identical
+    values, since the landscape only changes when the cache would be
+    invalidated anyway."""
+    n = genomes.shape[0]
+
+    def scan_path(state):
+        def step(st, x):
+            val = _landscape(cfg, st, x)
+            optimum = global_maximum(cfg, st)
+            cur = jnp.minimum(st.current_error, jnp.abs(val - optimum))
+            st = st.replace(
+                nevals=st.nevals + 1, current_error=cur,
+                offline_error_sum=st.offline_error_sum + cur)
+            if cfg.period > 0:
+                st = lax.cond(
+                    st.nevals % cfg.period == 0,
+                    lambda s: change_peaks(cfg, s).replace(
+                        current_error=jnp.asarray(jnp.inf)),
+                    lambda s: s, st)
+            return st, val
+
+        return lax.scan(step, state, genomes)
+
+    def batched_path(state):
+        # no boundary inside this batch: the batched bookkeeping is
+        # bit-identical to the sequential one and no change can fire
+        values = jax.vmap(lambda x: _landscape(cfg, state, x))(genomes)
+        optimum = global_maximum(cfg, state)
+        errs = jnp.abs(values - optimum)
+        run_min = lax.associative_scan(jnp.minimum, jnp.concatenate(
+            [state.current_error[None], errs]))
+        return state.replace(
+            nevals=state.nevals + n,
+            current_error=run_min[-1],
+            offline_error_sum=state.offline_error_sum
+            + jnp.sum(run_min[1:])), values
+
+    if cfg.period <= 0:
+        new_state, values = batched_path(state)
+        return new_state, values[:, None]
+    crossing = (state.nevals + n) // cfg.period > state.nevals // cfg.period
+    new_state, values = lax.cond(crossing, scan_path, batched_path, state)
     return new_state, values[:, None]
 
 

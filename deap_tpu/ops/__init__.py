@@ -49,6 +49,7 @@ from deap_tpu.ops.mutation import (
     mut_gaussian,
     mut_polynomial_bounded,
     mut_shuffle_indexes,
+    mut_two_opt,
     mut_uniform_int,
     strategy_floor,
 )

