@@ -156,6 +156,65 @@ def test_nsga3_with_memory_runs():
     assert sel.memory is not None
 
 
+def test_nd_rank_staircase_matches_matrix_oracle():
+    """The exact O(n log n) bi-objective staircase sort must agree with
+    the dominance-matrix peel on every tie structure: random rows,
+    duplicated rows (fitness-grouping), grid ties (single-coordinate
+    equality), a fully-tied population, a total-order chain, and a
+    single front."""
+    rng = np.random.default_rng(3)
+    cases = [
+        rng.uniform(0, 1, (257, 2)),
+        np.repeat(rng.uniform(0, 1, (128, 2)), 2, axis=0),
+        rng.integers(0, 7, (300, 2)).astype(float),
+        np.tile(rng.uniform(0, 1, (1, 2)), (50, 1)),
+        np.stack([np.arange(100.0), np.arange(100.0)], 1),
+        np.stack([np.sort(rng.uniform(0, 1, 100)),
+                  1 - np.sort(rng.uniform(0, 1, 100))], 1),
+    ]
+    for w in cases:
+        w = jnp.asarray(w, jnp.float32)
+        oracle = np.asarray(mo.emo.nd_rank(w, impl="matrix"))
+        fast = np.asarray(mo.nd_rank_staircase(w))
+        np.testing.assert_array_equal(fast, oracle)
+        # max_rank sentinel contract matches too
+        np.testing.assert_array_equal(
+            np.asarray(mo.nd_rank_staircase(w, max_rank=2)),
+            np.asarray(mo.emo.nd_rank(w, impl="matrix", max_rank=2)))
+
+
+def test_nd_rank_staircase_dispatch_and_contract():
+    """'auto' routes bi-objective populations >= the tiled threshold to
+    the staircase path; >2 objectives must reject impl='staircase'
+    loudly; return_peels reports the true front count."""
+    rng = np.random.default_rng(4)
+    w = jnp.asarray(rng.uniform(0, 1, (mo.emo.ND_TILED_THRESHOLD, 2)),
+                    jnp.float32)
+    auto = np.asarray(mo.emo.nd_rank(w))            # impl='auto'
+    stair = np.asarray(mo.nd_rank_staircase(w))
+    np.testing.assert_array_equal(auto, stair)
+    with pytest.raises(ValueError, match="nobj"):
+        mo.nd_rank_staircase(jnp.zeros((8, 3)))
+    _, peels = mo.nd_rank_staircase(w, return_peels=True)
+    _, peels_m = mo.emo.nd_rank(
+        w[:512], impl="matrix", return_peels=True)
+    _, peels_s = mo.nd_rank_staircase(w[:512], return_peels=True)
+    assert int(peels_s) == int(peels_m)
+    assert int(peels) >= int(peels_s)   # more rows, >= as many fronts
+
+
+def test_sel_nsga2_staircase_matches_matrix():
+    """sel_nsga2 selects the same SET whichever exact nd-sort backs it
+    (crowding ties within a front can reorder, the set cannot
+    change)."""
+    rng = np.random.default_rng(5)
+    w = jnp.asarray(rng.uniform(0, 1, (400, 2)), jnp.float32)
+    a = set(np.asarray(mo.sel_nsga2(None, w, 100, nd="matrix")).tolist())
+    b = set(np.asarray(
+        mo.sel_nsga2(None, w, 100, nd="staircase")).tolist())
+    assert a == b
+
+
 def test_nd_rank_max_rank_early_stop():
     w = jax.random.normal(jax.random.key(42), (60, 2))
     full = np.asarray(mo.emo.nd_rank(w, impl="matrix"))

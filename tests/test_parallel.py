@@ -5,6 +5,7 @@ mesh — the TPU-native analog of the reference's pickle-round-trip
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from deap_tpu import ops
 from deap_tpu.core.fitness import FitnessSpec
@@ -155,3 +156,28 @@ def test_mig_ring_migarray_topology():
     import pytest
     with pytest.raises(ValueError):
         mig_ring(jax.random.key(3), pops, k=1, migarray=[1, 2, 1, 0])
+
+
+@pytest.mark.slow
+def test_weak_scaling_smoke():
+    """bench_scaling's sanitized-subprocess measurement works end to
+    end at n=2 in smoke sizes: both paths produce finite throughput
+    rows. The full 1/2/4/8 curve (SCALING.json) is produced by
+    ``python bench_scaling.py``; this guards the harness itself."""
+    import importlib
+    import os as _os
+    import sys as _sys
+
+    _os.environ["DEAP_TPU_SCALING_SMOKE"] = "1"
+    try:
+        root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+        _sys.path.insert(0, root)
+        import bench_scaling
+        importlib.reload(bench_scaling)   # pick up the smoke sizes
+        row = bench_scaling.measure(2)
+        assert row["n_devices"] == 2
+        assert row["island_gens_per_sec"] > 0
+        assert row["sp_evals_per_sec"] > 0
+    finally:
+        del _os.environ["DEAP_TPU_SCALING_SMOKE"]
+        _sys.path.remove(root)
