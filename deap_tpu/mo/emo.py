@@ -119,9 +119,15 @@ def nd_rank(w: jnp.ndarray, max_rank: Optional[int] = None,
         # than dominance counts); the rank-``n`` budget sentinel only
         # applies under ``fallback='none'``, where the matrix/tiled
         # contract is "unpeeled rows report n"
-        return nd_rank_staircase(
+        res = nd_rank_staircase(
             w, None if fallback == "count" else max_rank,
             return_peels=return_peels)
+        if return_peels and fallback == "count" and max_rank is not None:
+            # keep the other impls' contract: peels never exceeds the
+            # budget, even though the ranks themselves are exact
+            ranks, peels = res
+            res = (ranks, jnp.minimum(peels, jnp.int32(stop)))
+        return res
     if impl == "tiled":
         from deap_tpu.ops.kernels import nd_rank_tiled
 
@@ -190,28 +196,39 @@ def nd_rank_staircase(w: jnp.ndarray, max_rank: Optional[int] = None,
     ``cover_k``/``fallback`` moot — callers get front-exact ranks for
     every row at no extra cost.
     """
+    from deap_tpu.core.fitness import lex_sort_desc
+
     n, nobj = w.shape
     if nobj != 2:
         raise ValueError(f"nd_rank_staircase needs nobj == 2, got {nobj}")
     stop = n if max_rank is None else min(max_rank, n)
-    order = jnp.lexsort((-w[:, 1], -w[:, 0]))
+    order = lex_sort_desc(w)
     f2 = w[order, 1]
+    neg_f2 = -f2
     same = (w[order[1:], 0] == w[order[:-1], 0]) & (f2[1:] == f2[:-1])
     head = jnp.concatenate([jnp.ones(1, bool), ~same])
 
+    # The scan carries the NEGATED front maxima (ascending), so each
+    # step is one binary search plus one single-element in-place carry
+    # update — O(log n) per step, O(n log n) total. An earlier form
+    # negated the carry and where-selected the full array every step,
+    # which XLA materialises: O(n) per step, quadratic overall
+    # (measured 3.7x per doubling at 50k→100k).
     def step(carry, x):
-        m, prev_rank = carry
-        f2i, is_head = x
-        # fronts with max-w1 >= f2i: -m is ascending, side='right'
-        # counts the equal case (equal w1 from an earlier distinct row
-        # implies strictly larger w0 — a dominator)
-        r_new = jnp.searchsorted(-m, -f2i, side="right").astype(jnp.int32)
+        neg_m, prev_rank = carry
+        nf2i, is_head = x
+        # fronts with max-w1 >= f2i ⟺ neg_m entries <= -f2i;
+        # side='right' counts the equal case (equal w1 from an earlier
+        # distinct row implies strictly larger w0 — a dominator)
+        r_new = jnp.searchsorted(neg_m, nf2i,
+                                 side="right").astype(jnp.int32)
         r = jnp.where(is_head, r_new, prev_rank)
-        m = jnp.where(is_head, m.at[r].set(f2i), m)
-        return (m, r), r
+        # non-heads write out of bounds and are dropped
+        neg_m = neg_m.at[jnp.where(is_head, r, n)].set(nf2i, mode="drop")
+        return (neg_m, r), r
 
-    m0 = jnp.full(n, -jnp.inf, w.dtype)
-    _, sorted_ranks = lax.scan(step, (m0, jnp.int32(0)), (f2, head))
+    m0 = jnp.full(n, jnp.inf, w.dtype)
+    _, sorted_ranks = lax.scan(step, (m0, jnp.int32(0)), (neg_f2, head))
     ranks = jnp.zeros(n, jnp.int32).at[order].set(sorted_ranks)
     peels = jnp.minimum(jnp.max(sorted_ranks) + 1, stop)
     if max_rank is not None:

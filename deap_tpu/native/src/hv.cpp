@@ -220,47 +220,45 @@ double exclhv(const Front& f, std::size_t i, const double* ref) {
 
 // d=4 sweep over a front already sorted DESCENDING on the 4th
 // objective: each term is (slab in obj 4) x (3-D exclusive volume),
-// and the 3-D limited set {max(p_i, p_j) : j > i} is built already
-// z-sorted in O(n) by walking a once-computed ascending-3rd-objective
-// order of the whole front — max(z_i, z_j) is non-decreasing along
-// that walk — so each inner call is pure staircase sweep, no sort.
+// and the 3-D limited set {max(p_i, p_j) : j > i} streams out
+// already z-sorted — max(z_i, z_j) is non-decreasing along an
+// ascending-3rd-objective walk — so each inner pass is pure
+// staircase sweep, no sort.
+//
+// The outer loop runs i DESCENDING while a z-sorted
+// structure-of-arrays of the points {j : j > i} grows by one
+// insertion per step — and is PRUNED to its 3-D-nondominated subset.
+// Pruning is volume-neutral: if q 3-D-dominates p (minimisation,
+// componentwise), then max(p_i, q) <= max(p_i, p) componentwise for
+// every p_i, so p's limited box is inside q's and the staircase union
+// never misses it. A newly inserted point i has the LARGEST 4th
+// objective among the live set, and on real fronts that correlates
+// with small first-three coordinates, so insertions keep collapsing
+// the live set — the inner sweep walks a short Pareto staircase, not
+// all n-1-i survivors. This is where the old 1.6x constant-factor
+// loss to the reference's AVL dimension-sweep at large-n d=4
+// (BASELINE.md) was paid.
 double wfg4_sorted(const Front& f, const double* ref) {
     const std::size_t n = f.size();
-    std::vector<std::size_t> zord(n);
-    for (std::size_t i = 0; i < n; ++i) zord[i] = i;
-    std::sort(zord.begin(), zord.end(),
-              [&](std::size_t a, std::size_t b) {
-                  return f.row(a)[2] < f.row(b)[2];
-              });
-    // z-ordered structure-of-arrays copy of the front: the inner walk
-    // below touches every point for every i (O(n^2) traversals), so it
-    // must stream sequentially, not gather scattered rows
-    std::vector<double> zx(n), zy(n), zz(n);
-    std::vector<std::size_t> zi(n);
-    for (std::size_t k = 0; k < n; ++k) {
-        const double* pj = f.row(zord[k]);
-        zx[k] = pj[0];
-        zy[k] = pj[1];
-        zz[k] = pj[2];
-        zi[k] = zord[k];
-    }
+    // z-sorted arrays of the live (3-D-nondominated) points after i;
+    // grown by memmove (sequential doubles — cheaper than any node
+    // structure at the resulting sizes)
+    std::vector<double> zx, zy, zz;
+    zx.reserve(n);
+    zy.reserve(n);
+    zz.reserve(n);
     Staircase sc;
     double total = 0.0;
-    for (std::size_t i = 0; i < n; ++i) {
-        const double* pi = f.row(i);
+    for (std::size_t ii = n; ii-- > 0;) {
+        const double* pi = f.row(ii);
         const double slab = ref[3] - pi[3];
         const double pi0 = pi[0], pi1 = pi[1], pi2 = pi[2];
         double inner = inclhv(pi, ref, 3);
-        // fused limited-set z-sweep: limited points stream out of the
-        // zord walk already z-ordered (max(z_i, z_j) is non-decreasing
-        // along it) and feed the staircase directly — no materialised
-        // front, no per-call sort
         sc.reset();
         double vol3 = 0.0, cur_z = 0.0;
         bool first = true;
-        for (std::size_t k = 0; k < n; ++k) {
-            if (zi[k] <= i) continue;  // only points after i (weakly
-                                       // lower 4th objective) limit it
+        const std::size_t live = zz.size();
+        for (std::size_t k = 0; k < live; ++k) {
             const double z = std::max(pi2, zz[k]);
             if (first) {
                 cur_z = z;
@@ -272,6 +270,36 @@ double wfg4_sorted(const Front& f, const double* ref) {
         }
         if (!first) vol3 += sc.area * (ref[2] - cur_z);
         total += slab * (inner - vol3);
+        // point i joins the live set for the remaining (smaller) i's
+        // unless 3-D-dominated; any members it dominates drop out
+        bool dominated = false;
+        for (std::size_t k = 0; k < zz.size(); ++k) {
+            if (zz[k] > pi2) break;  // z-sorted: no dominator past here
+            if (zx[k] <= pi0 && zy[k] <= pi1) {
+                dominated = true;
+                break;
+            }
+        }
+        if (dominated) continue;
+        std::size_t w = 0;
+        for (std::size_t k = 0; k < zz.size(); ++k) {
+            const bool doomed =
+                zz[k] >= pi2 && zx[k] >= pi0 && zy[k] >= pi1;
+            if (!doomed) {
+                zx[w] = zx[k];
+                zy[w] = zy[k];
+                zz[w] = zz[k];
+                ++w;
+            }
+        }
+        zx.resize(w);
+        zy.resize(w);
+        zz.resize(w);
+        const std::size_t pos = std::lower_bound(zz.begin(), zz.end(),
+                                                 pi2) - zz.begin();
+        zz.insert(zz.begin() + pos, pi2);
+        zx.insert(zx.begin() + pos, pi0);
+        zy.insert(zy.begin() + pos, pi1);
     }
     return total;
 }
@@ -315,10 +343,16 @@ Front prepare(const double* data, int n, int d, const double* ref) {
             if (p[k] >= ref[k]) { below = false; break; }
         if (below) f.push(p);
     }
-    // the d<=3 base cases absorb dominated/duplicate points natively;
-    // the O(n^2) filter would dominate their linearithmic runtime
-    // (measured: 40 of 42 ms at d=3 n=2000 was this filter)
-    return d <= 3 ? f : nds(f);
+    // the d<=3 base cases absorb dominated/duplicate points natively,
+    // and the d=4 sweep's pruned live set does too (a 4-D-dominated
+    // point's term telescopes to zero; WFG's exclusive-volume chain
+    // is an identity for ANY set, filtered or not) — at those dims
+    // the O(n^2) filter would dominate the actual computation
+    // (measured: 40 of 42 ms at d=3 n=2000, 40 of 66 ms at d=4
+    // n=2000 was this filter). From d=5 the recursion's limited sets
+    // multiply, so pre-shrinking the front is worth the quadratic
+    // pass.
+    return d <= 4 ? f : nds(f);
 }
 
 }  // namespace

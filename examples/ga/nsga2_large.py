@@ -7,8 +7,8 @@ This example runs the same ZDT1 optimisation at pop=50k (the
 BASELINE.json config) on any backend: ZDT1 is bi-objective, so the
 exact O(n log n) staircase sort (`nd_rank_staircase`,
 docs/advanced/kernels.md) ranks the 2n=100k candidate pool with no
-dominance pairs at all — ~6 s/gen on one CPU core, hypervolume 118.05
-after 20 gens against the reference's >116.0 gate. Pass
+dominance pairs at all — ~0.6 s/gen on one CPU core, hypervolume
+118.05 after 20 gens against the reference's >116.0 gate. Pass
 ``nd='tiled'`` to exercise the streaming Pallas kernel instead (the
 general >2-objective scale path, TPU-targeted).
 

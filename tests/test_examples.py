@@ -132,7 +132,7 @@ def test_nsga2_pop50k_end_to_end_quality_gate():
     weak #6): 20 generations at pop=50k through the exact O(n log n)
     staircase nd-sort, gated on the reference's hypervolume bar
     (>116.0 vs ref [11,11], deap/tests/test_algorithms.py:110-113).
-    Measured 118.05 on this box (~6 s/gen on one CPU core)."""
+    Measured 118.05 on this box (~0.6 s/gen on one CPU core)."""
     from examples.ga import nsga2_large
 
     hv = nsga2_large.main(pop=50_000, ngen=20)

@@ -75,3 +75,22 @@ def test_contributions_sum_and_positivity():
     for i in range(10):
         excl = total - native.hypervolume(np.delete(pts, i, 0), ref)
         assert contrib[i] == pytest.approx(excl, rel=1e-12)
+
+
+def test_d4_unfiltered_entry_parity_on_adversarial_fronts():
+    """d=4 skips the O(n^2) non-domination prefilter since r5 (WFG's
+    exclusive-volume chain telescopes dominated points to zero, and
+    the fused sweep's pruned live set absorbs them) — so the
+    dominance-rich, duplicate-heavy, and tie-grid cases must still
+    match the Python fallback exactly."""
+    rng = np.random.default_rng(9)
+    ref = np.full(4, 1.1)
+    cases = [
+        rng.uniform(0.0, 1.0, size=(300, 4)),          # ~half dominated
+        np.repeat(rng.uniform(0, 1, (50, 4)), 3, 0),   # heavy duplicates
+        rng.integers(0, 4, (200, 4)) / 4.0,            # tie grid
+        np.tile(rng.uniform(0, 1, (1, 4)), (20, 1)),   # all identical
+    ]
+    for pts in cases:
+        assert native.hypervolume(pts, ref) == pytest.approx(
+            py_hv(pts, ref), rel=1e-12)
