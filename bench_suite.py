@@ -67,10 +67,15 @@ from deap_tpu.strategies.cma import Strategy
 # BASELINE.md); cartpole is measured with a pure-Python rollout.
 # Values live in tpu_capture (the import-light canonical home shared
 # with bench_report.py).
-from tpu_capture import SUITE_EXTRAPOLATED, SUITE_REF  # noqa: E402
+from tpu_capture import (  # noqa: E402
+    SUITE_EXTRAPOLATED,
+    SUITE_REF,
+    SUITE_REF_CONVERGED,
+)
 
 REF = SUITE_REF
 EXTRAPOLATED = SUITE_EXTRAPOLATED
+REF_CONVERGED = SUITE_REF_CONVERGED
 
 NGEN = 50
 REPS = 3
@@ -364,6 +369,11 @@ def run_one(name: str) -> dict:
     }
     if name in EXTRAPOLATED:
         line["ref_extrapolated"] = True
+    if name in REF_CONVERGED:
+        # our lax.scan rollout pays the same cost at any skill level;
+        # the reference collapses as policies improve — report the
+        # converged-pop ratio alongside the generous initial-pop one
+        line["vs_baseline_converged"] = round(gps / REF_CONVERGED[name], 1)
     return line
 
 

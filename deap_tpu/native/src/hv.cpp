@@ -401,9 +401,11 @@ void deap_tpu_hv_contributions(const double* data, int n, int d,
         }
         double covered = 0.0;
         if (lim.size()) {
-            if (d <= 3) {
-                // the staircase base cases absorb dominated/duplicate
-                // rows; the O(m^2) filter would dominate them
+            if (d <= 4) {
+                // the d<=3 staircase base cases and the d=4 pruned
+                // sweep absorb dominated/duplicate rows natively (the
+                // same telescoping identity as prepare()); the O(m^2)
+                // filter would dominate them
                 covered = wfg(lim, ref);
             } else {
                 Front reduced = nds(lim);
