@@ -19,6 +19,12 @@ Two paths — the framework's prescribed multi-device layouts:
   onemax_island_scoop.py). Per-device deme size fixed → total
   population grows with n. The only cross-device traffic is the
   ``mig_k``-row ring hop, so throughput-per-device should be flat.
+- ``pop``: row-sharded population with shard-local evaluation — the
+  reference's P2 axis (``pool.map`` distributing EVALUATION, SURVEY
+  §2.3), here ``shard_population`` + a compute-heavy fitness that XLA
+  keeps entirely shard-local. Per-device shard size fixed → total
+  population grows with n. There should be NO steady-state
+  cross-device traffic at all.
 - ``sp``: genome-axis sharding (SURVEY §5.7) — each device holds a
   genome *slice* of every individual and evaluation reduces partial
   fitness with ``psum`` (parallel/genome_shard.py). Per-device slice
@@ -60,6 +66,7 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 DEVICE_COUNTS = (1, 2, 4, 8)
 _SMOKE = bool(os.environ.get("DEAP_TPU_SCALING_SMOKE"))
 ISLAND_SIZE = 64 if _SMOKE else 1024   # per-device deme rows
+POP_SHARD = 64 if _SMOKE else 4096     # per-device rows, pop path
 SP_POP = 64 if _SMOKE else 2048        # individuals on the SP path
 SP_SLICE = 64 if _SMOKE else 2048      # per-device genome slice length
 LENGTH = 100
@@ -128,6 +135,33 @@ def _child(n_devices: int) -> None:
     dt = timed(step, jax.random.key(1), pops)
     res["island_gens_per_sec"] = FREQ / dt
 
+    # ---- pop path: row-sharded population, shard-local heavy eval ----
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh_p = population_mesh(n_devices, ("pop",))
+    genomes_p = jax.device_put(
+        jax.random.uniform(jax.random.key(5), (POP_SHARD * n_devices, 32)),
+        NamedSharding(mesh_p, PartitionSpec("pop")))
+
+    @jax.jit
+    def heavy_eval(g):
+        # a compute-heavy, purely row-local fitness (rastrigin iterated
+        # to dominate dispatch): XLA must keep it shard-local — any
+        # cross-device traffic here is a placement regression
+        def body(i, acc):
+            x = g * (1.0 + 1e-6 * acc[:, None])
+            r = jnp.sum(x * x - 10.0 * jnp.cos(2 * jnp.pi * x) + 10.0,
+                        axis=-1)
+            return acc + r
+        return lax.fori_loop(0, 8, body, jnp.zeros(g.shape[0]))
+
+    dt = timed(heavy_eval, genomes_p)
+    # PER-DEVICE rate (like island's per-deme gens/sec), so main()'s
+    # uniform `rate * n / base` work-normalisation holds — a total-rows
+    # rate here would double-count n and inflate the efficiency n-fold
+    res["pop_evals_per_sec"] = POP_SHARD / dt
+
     # ---- SP path: genome-axis sharding, psum-reduced evaluation ----
     gmesh = genome_mesh(n_pop_shards=1, n_genome_shards=n_devices)
     genomes = jax.random.bernoulli(
@@ -166,6 +200,7 @@ def main() -> None:
     for row in rows:
         n = row["n_devices"]
         for path, key in (("island", "island_gens_per_sec"),
+                          ("pop", "pop_evals_per_sec"),
                           ("sp", "sp_evals_per_sec")):
             # work-normalised: per-device work is constant, devices
             # share the same cores, so ideal total-work throughput is
@@ -175,9 +210,9 @@ def main() -> None:
     report = {
         "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
         "backend": "cpu-virtual-mesh",
-        "config": {"island_size": ISLAND_SIZE, "sp_pop": SP_POP,
-                   "sp_slice": SP_SLICE, "length": LENGTH,
-                   "freq": FREQ, "epochs": EPOCHS},
+        "config": {"island_size": ISLAND_SIZE, "pop_shard": POP_SHARD,
+                   "sp_pop": SP_POP, "sp_slice": SP_SLICE,
+                   "length": LENGTH, "freq": FREQ, "epochs": EPOCHS},
         "antipattern_note": ANTIPATTERN_NOTE,
         "rows": rows,
     }
@@ -188,6 +223,7 @@ def main() -> None:
     # a collective that moves the global population every generation
     # lands far below this floor
     worst = min(min(r["island_work_efficiency"],
+                    r["pop_work_efficiency"],
                     r["sp_work_efficiency"]) for r in rows)
     print(json.dumps({"metric": "weak_scaling_work_efficiency_min",
                       "value": round(worst, 3), "unit": "ratio",
