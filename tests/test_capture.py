@@ -231,6 +231,31 @@ def test_full_race_rejects_partial_race(capture):
     assert not capture.already_captured("bench.py#rerace")
 
 
+def test_full_race_accepts_fully_resolved_all_failed(capture):
+    # the all-candidates-FAILED sentinel (value=0.0, "error" key) is
+    # excluded from headline_rows by design, but when every candidate
+    # resolved as a deterministic failure it is still a terminal race
+    # outcome — without accepting it the watcher would re-run the race
+    # every uptime window in that corner (advisor r4)
+    _evidence(capture, "bench.py",
+              [{"value": 0.0, "backend": "tpu",
+                "error": "all candidates failed",
+                "n_candidates": 0,
+                "n_resolved": capture.N_CANDIDATES}])
+    assert capture.already_captured("bench.py#rerace")
+
+
+def test_full_race_rejects_partial_all_failed(capture):
+    # an all-failed row whose resolution count is short (relay died
+    # mid-race) must still be retried next window
+    _evidence(capture, "bench.py",
+              [{"value": 0.0, "backend": "tpu",
+                "error": "all candidates failed",
+                "n_candidates": 0,
+                "n_resolved": capture.N_CANDIDATES - 2}])
+    assert not capture.already_captured("bench.py#rerace")
+
+
 def test_tolerant_jsonl_reader(capture, tmp_path):
     p = tmp_path / "rows.jsonl"
     p.write_text('{"a": 1}\nnot json — a writer died here\n{"b": 2}\n')
