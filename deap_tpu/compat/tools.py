@@ -657,7 +657,13 @@ def _mo():
 
 def sortNondominated(individuals, k, first_front_only=False):
     """List of non-dominated fronts covering at least ``k`` individuals
-    (emo.py:53-117); ``k == 0`` returns no fronts (emo.py:70)."""
+    (emo.py:53-117); ``k == 0`` returns no fronts (emo.py:70).
+
+    Rides ``emo.nd_rank``'s auto dispatch, so list populations get the
+    staircase (M = 2), Fenwick-sweep (M = 3) and prefix-reduction
+    (M ≥ 3) engines for free — the O(MN²) dominance matrix only below
+    the measured crossovers (docs/advanced/ndsort.md). Front slicing
+    is one stable argsort of the rank vector, not a per-front scan."""
     import numpy as np
 
     if k == 0 or not individuals:
@@ -666,12 +672,15 @@ def sortNondominated(individuals, k, first_front_only=False):
     max_rank = 1 if first_front_only else None
     ranks = np.asarray(emo.nd_rank(jnp.asarray(_wvalues(individuals)),
                                    max_rank=max_rank, impl="auto"))
+    order = np.argsort(ranks, kind="stable")
+    sorted_ranks = ranks[order]
+    # boundaries between consecutive rank groups, in rank order
+    cuts = np.flatnonzero(np.diff(sorted_ranks)) + 1
     fronts = []
     total = 0
-    for r in range(int(ranks.max()) + 1 if len(ranks) else 0):
-        front = [individuals[i] for i in np.flatnonzero(ranks == r)]
-        fronts.append(front)
-        total += len(front)
+    for group in np.split(order, cuts):
+        fronts.append([individuals[i] for i in group])
+        total += len(group)
         if first_front_only or total >= k:
             break
     return fronts
@@ -717,16 +726,16 @@ def selSPEA2(individuals, k):
 def selNSGA3(individuals, k, ref_points, nd="log"):
     """NSGA-III reference-point selection (emo.py:479-561). Randomized
     niching draws from the stdlib ``random`` stream like every other
-    compat operator; ``nd`` accepted for reference parity (both sort
-    variants hit the same kernel)."""
+    compat operator; ``nd`` follows ``emo.sel_nsga3``'s contract (the
+    reference's ``'standard'``/``'log'`` hit the auto dispatch, the
+    engine names force one nd-sort implementation)."""
     import numpy as np
 
-    del nd
     jax, jnp, emo = _mo()
     key = jax.random.key(random.getrandbits(32))
     idx = np.asarray(emo.sel_nsga3(
         key, jnp.asarray(_wvalues(individuals)), k,
-        jnp.asarray(ref_points)))
+        jnp.asarray(ref_points), nd=nd))
     return [individuals[i] for i in idx]
 
 

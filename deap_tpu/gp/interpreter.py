@@ -40,12 +40,22 @@ from __future__ import annotations
 
 from functools import partial
 from typing import Callable
+from weakref import WeakKeyDictionary
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from deap_tpu.gp.pset import PrimitiveSet
+
+#: per-pset caches: primitive dispatch closures and built interpreters,
+#: keyed weakly so a dropped pset releases everything. Repeated
+#: ``make_interpreter``/``make_batch_interpreter`` calls on the same
+#: set hand back the SAME callable — identity-stable closures keep
+#: ``jax.jit`` caches warm across toolbox rebuilds (each fresh closure
+#: used to force a full retrace of every downstream jit).
+_PRIM_ROWS_CACHE: "WeakKeyDictionary" = WeakKeyDictionary()
+_INTERPRETER_CACHE: "WeakKeyDictionary" = WeakKeyDictionary()
 
 
 def child_table(nodes: jnp.ndarray, length, arity: jnp.ndarray,
@@ -182,17 +192,39 @@ def run_data_pass(pset: PrimitiveSet, max_len: int, genome, X,
 
 def _prim_rows_builder(pset: PrimitiveSet) -> Callable:
     """The plain-primitive dispatch shared by both interpreter
-    factories (the ADF interpreter substitutes its own, gp/adf.py)."""
+    factories (the ADF interpreter substitutes its own, gp/adf.py).
+    Cached per pset (keyed on the operator roster, so a set extended
+    afterwards rebuilds) — see the module caches above."""
     if pset.has_adf:
         raise ValueError(
             "primitive set contains ADF calls; use "
             "deap_tpu.gp.adf.make_adf_interpreter")
+    cached = _PRIM_ROWS_CACHE.get(pset)
+    if cached is not None and cached[0] == pset.n_ops:
+        return cached[1]
     prims = list(pset.primitives)
 
     def prim_rows(ops_in):
         return [p.fn(*ops_in[: p.arity]) for p in prims]
 
+    _PRIM_ROWS_CACHE[pset] = (pset.n_ops, prim_rows)
     return prim_rows
+
+
+def _cached_factory(pset: PrimitiveSet, key, build: Callable) -> Callable:
+    """Return the interpreter cached under ``key`` for ``pset``, or
+    build and remember it. The cache entry also pins the operator
+    count: growing the set invalidates every interpreter built on it."""
+    entry = _INTERPRETER_CACHE.setdefault(pset, {})
+    full_key = (pset.n_ops,) + key
+    fn = entry.get(full_key)
+    if fn is None:
+        stale = [k for k in entry if k[0] != pset.n_ops]
+        for k in stale:
+            del entry[k]
+        fn = build()
+        entry[full_key] = fn
+    return fn
 
 
 def make_interpreter(pset: PrimitiveSet, max_len: int) -> Callable:
@@ -201,13 +233,22 @@ def make_interpreter(pset: PrimitiveSet, max_len: int) -> Callable:
     ``genome`` is the dict ``{"nodes": int32[max_len], "consts":
     f32[max_len], "length": int32}``; ``X`` is ``f32[points, n_args]``.
     vmap over genomes for populations, over X for multiple datasets.
+
+    Repeated calls with the same ``(pset, max_len)`` return the SAME
+    function object: the primitive dispatch, arity table and evaluator
+    closure are built once per set, so rebuilding a toolbox does not
+    re-derive the rows or invalidate downstream ``jax.jit`` caches.
     """
-    prim_rows = _prim_rows_builder(pset)
+    def build():
+        prim_rows = _prim_rows_builder(pset)
+        pset.arity_table()  # warm the per-pset table cache at build
 
-    def interpret(genome, X):
-        return run_data_pass(pset, max_len, genome, X, prim_rows)
+        def interpret(genome, X):
+            return run_data_pass(pset, max_len, genome, X, prim_rows)
 
-    return interpret
+        return interpret
+
+    return _cached_factory(pset, ("interp", max_len), build)
 
 
 def run_sweep_pass(pset: PrimitiveSet, max_len: int, genome, X,
@@ -285,6 +326,15 @@ def make_batch_interpreter(pset: PrimitiveSet, max_len: int,
     """
     if mode not in ("scan", "sweep"):
         raise ValueError(f"unknown interpreter mode {mode!r}")
+
+    def build():
+        return _build_batch_interpreter(pset, max_len, mode)
+
+    return _cached_factory(pset, ("batch", max_len, mode), build)
+
+
+def _build_batch_interpreter(pset: PrimitiveSet, max_len: int,
+                             mode: str) -> Callable:
     prim_rows = _prim_rows_builder(pset)
     ML_cap = max_len
     arity = pset.arity_table()

@@ -157,10 +157,23 @@ class PrimitiveSet:
         return t / (t + self.n_ops)
 
     def arity_table(self) -> jnp.ndarray:
-        """int32[vocab] — operator arities then zeros for terminals."""
+        """int32[vocab] — operator arities then zeros for terminals.
+
+        Built once and cached against the vocabulary state: the
+        interpreters fetch this on every evaluation pass, and handing
+        back the same device array keeps eager calls from re-uploading
+        it and retraces from re-baking a fresh constant. A set extended
+        after the first call (more primitives/terminals) rebuilds."""
+        key = (self.n_ops, self.vocab,
+               tuple(p.arity for p in self.primitives))
+        cached = getattr(self, "_arity_table_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
         n_term = self.vocab - self.n_ops
-        return jnp.asarray(
+        table = jnp.asarray(
             [p.arity for p in self.primitives] + [0] * n_term, jnp.int32)
+        self._arity_table_cache = (key, table)
+        return table
 
     def sample_terminal(self, key: jax.Array) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """Uniform terminal draw → (node_id, const_value)."""

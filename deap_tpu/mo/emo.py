@@ -7,12 +7,17 @@ selTournamentDCD (:145-195), sortLogNondominated (:234-441), NSGA-III
 
 TPU-first formulations:
 
-- Non-dominated sorting builds the full pairwise dominance matrix in one
-  fused broadcast comparison (the O(MN²) work the reference does in
-  Python loops is exactly what the VPU eats for breakfast) and peels
-  fronts with a ``while_loop``. The reference's 'log' divide-and-conquer
-  variant exists to cut *Python* constant factors; here the matrix
-  kernel IS the fast path, so ``nd='log'`` maps to the same kernel.
+- Non-dominated sorting is one contract over five engines: the fused
+  dominance matrix + ``while_loop`` front peeling (the O(MN²) work the
+  reference does in Python loops is exactly what the VPU eats for
+  breakfast), its tiled streaming twin, and the sort-based
+  peeling-free engines — bi-objective staircase, 3-objective Fenwick
+  sweep, any-M prefix chain reduction (mo/ndsort.py) — that drop the
+  front-count multiplier entirely. ``impl='auto'`` picks by
+  (n, M, backend); the measured selection matrix lives in
+  docs/advanced/ndsort.md. The reference's 'log' divide-and-conquer
+  variant exists to cut *Python* constant factors; its actual
+  asymptotic content is what 'sweep'/'dc' deliver inside XLA.
 - Crowding distances are computed for all fronts at once with a
   (rank, value) lexsort and segment min/max — no per-front Python.
 - NSGA-III niching and SPEA2 truncation are data-dependent loops; they
@@ -33,6 +38,7 @@ import numpy as np
 from jax import lax
 
 from deap_tpu.core.fitness import dominates
+from deap_tpu.mo.ndsort import nd_rank_prefix, nd_rank_sweep3
 
 
 # ---------------------------------------------------------------- nd-sort ----
@@ -46,6 +52,22 @@ def dominance_matrix(w: jnp.ndarray) -> jnp.ndarray:
 #: kernel (the resident [n, n] matrix would exceed ~64 MB of HBM and the
 #: streaming kernel wins on bandwidth).
 ND_TILED_THRESHOLD = 8192
+
+#: CPU crossover (measured, docs/advanced/ndsort.md) above which the
+#: M ≥ 3 prefix-streamed chain reduction (``impl='dc'``) beats matrix
+#: peeling — the front count already costs the matrix path ~16 peels
+#: there and keeps growing with n and M.
+ND_PREFIX_THRESHOLD = 512
+
+#: CPU crossover above which the M = 3 Fenwick sweep's linearithmic
+#: scan overtakes the O(n²) prefix reduction (measured crossover
+#: n ≈ 12-16k; both beat matrix peeling by orders of magnitude there).
+ND_SWEEP_THRESHOLD = 16384
+
+#: the impls with exact full ranks and no peel loop — cover_k is moot
+#: for them and ``fallback='count'`` degrades gracefully to the exact
+#: ranks themselves (strictly better than dominance counts).
+_ND_EXACT_IMPLS = ("staircase", "sweep", "dc")
 
 
 def nd_rank(w: jnp.ndarray, max_rank: Optional[int] = None,
@@ -63,8 +85,13 @@ def nd_rank(w: jnp.ndarray, max_rank: Optional[int] = None,
     for small n), ``'tiled'`` streams it through VMEM with the Pallas
     kernel (ops.kernels.nd_rank_tiled; scales to n ≫ 50k),
     ``'staircase'`` is the exact O(n log n) bi-objective sort
-    (:func:`nd_rank_staircase`), ``'auto'`` picks by objective count,
-    population size, and backend.
+    (:func:`nd_rank_staircase`), ``'sweep'`` the exact O(n log² n)
+    3-objective Fenwick sweep (:func:`deap_tpu.mo.ndsort
+    .nd_rank_sweep3`), ``'dc'`` the exact any-M prefix-streamed chain
+    reduction (:func:`deap_tpu.mo.ndsort.nd_rank_prefix` — one
+    front-count-free O(n²·m) pass, [n, block] memory), ``'auto'``
+    picks by objective count, population size, and backend (the
+    selection matrix is tabulated in docs/advanced/ndsort.md).
 
     ``max_rank`` stops peeling after that many fronts (the reference's
     sortNondominated ``k`` early-exit, emo.py:71-77); unpeeled rows keep
@@ -106,14 +133,28 @@ def nd_rank(w: jnp.ndarray, max_rank: Optional[int] = None,
         # n ≫ 50k on a CPU host (the [n, n] matrix would be gigabytes;
         # the tiled kernel needs a real TPU core). On a CPU host it
         # wins from tiny n (measured 2× at n=64, 300× at n=4096,
-        # 3500× at n=8192); on accelerators (TPU/GPU) the matrix is
-        # one fused parallel op while the sequential scan pays
-        # per-step latency, so the switch stays at the tiled threshold
-        # where the matrix stops fitting anyway.
+        # 3500× at n=8192). For M ≥ 3 the same logic picks between the
+        # prefix-streamed chain reduction (front-count-free O(n²·m),
+        # wins from n ≈ 512 on CPU) and — at M = 3 — the linearithmic
+        # Fenwick sweep once its scan outruns the O(n²) reduction
+        # (measured crossover n ≈ 12-16k; 129× over matrix peeling at
+        # n = 50k, docs/advanced/ndsort.md). On accelerators
+        # (TPU/GPU) the matrix is one fused parallel op while
+        # sequential scans pay per-step latency, so 'auto' keeps the
+        # matrix/tiled split there pending on-chip measurement —
+        # 'sweep'/'dc' remain available explicitly (dc's cross step
+        # already streams through the Pallas dominance kernels).
         backend = jax.default_backend()
-        if w.shape[1] == 2 and (n >= ND_TILED_THRESHOLD
-                                or (backend == "cpu" and n >= 64)):
+        nobj = w.shape[1]
+        if nobj == 2 and (n >= ND_TILED_THRESHOLD
+                          or (backend == "cpu" and n >= 64)):
             impl = "staircase"
+        elif (nobj == 3 and backend == "cpu"
+                and n >= ND_SWEEP_THRESHOLD):
+            impl = "sweep"
+        elif (nobj >= 3 and backend == "cpu"
+                and n >= ND_PREFIX_THRESHOLD):
+            impl = "dc"
         else:
             # off-TPU the tiled kernel runs under the Pallas
             # interpreter and is slower than the matrix path, so
@@ -121,16 +162,17 @@ def nd_rank(w: jnp.ndarray, max_rank: Optional[int] = None,
             impl = ("tiled" if (backend == "tpu"
                                 and n >= ND_TILED_THRESHOLD)
                     else "matrix")
-    if impl == "staircase":
+    if impl in _ND_EXACT_IMPLS:
         # exact full ranks are free here, so a ``fallback='count'``
         # caller — who asked for a well-ordered ranking past the peel
         # budget — gets the exact ranks themselves (strictly better
         # than dominance counts); the rank-``n`` budget sentinel only
         # applies under ``fallback='none'``, where the matrix/tiled
         # contract is "unpeeled rows report n"
-        res = nd_rank_staircase(
-            w, None if fallback == "count" else max_rank,
-            return_peels=return_peels)
+        fn = {"staircase": nd_rank_staircase, "sweep": nd_rank_sweep3,
+              "dc": nd_rank_prefix}[impl]
+        res = fn(w, None if fallback == "count" else max_rank,
+                 return_peels=return_peels)
         if return_peels and fallback == "count" and max_rank is not None:
             # keep the other impls' contract: peels never exceeds the
             # budget, even though the ranks themselves are exact
@@ -294,8 +336,9 @@ def sel_nsga2(key, w, k, nd: str = "standard",
 
     ``nd``: the reference's ``'standard'``/``'log'`` both map to
     ``nd_rank(impl='auto')`` (the log variant exists to cut Python
-    constants the tensor kernels don't have); ``'matrix'``/``'tiled'``
-    force a specific nd-sort implementation.
+    constants the tensor kernels don't have); ``'matrix'``/``'tiled'``/
+    ``'staircase'``/``'sweep'``/``'dc'`` force a specific nd-sort
+    implementation.
 
     ``peel_budget`` caps the peel loop at that many fronts, ranking any
     remainder by Fonseca-Fleming dominance counts (``nd_rank``'s
@@ -306,7 +349,7 @@ def sel_nsga2(key, w, k, nd: str = "standard",
     documented cost that a cut landing past the budget uses
     count-ranks (dominance-consistent, not front-exact)."""
     del key
-    if nd in ("matrix", "tiled", "staircase"):
+    if nd in ("matrix", "tiled", "staircase", "sweep", "dc"):
         impl = nd
     elif nd in ("standard", "log", "auto"):
         impl = "auto"
@@ -437,11 +480,20 @@ def sel_nsga3(key, w, k, ref_points, best_point=None, worst_point=None,
 
     Pass the previous generation's memory (best/worst/extreme points) for
     the selNSGA3WithMemory behaviour (emo.py:450-476).
+
+    ``nd`` follows :func:`sel_nsga2`'s contract: the reference's
+    ``'standard'``/``'log'`` map to the auto dispatch, the engine
+    names force one implementation.
     """
-    del nd
+    if nd in ("matrix", "tiled", "staircase", "sweep", "dc"):
+        impl = nd
+    elif nd in ("standard", "log", "auto"):
+        impl = "auto"
+    else:
+        raise ValueError(f"unknown nd sort {nd!r}")
     n, nobj = w.shape
     nref = ref_points.shape[0]
-    ranks = nd_rank(w)
+    ranks = nd_rank(w, impl=impl)
     fitnesses = -w  # minimisation space, like the reference's wvalues * -1
 
     if best_point is not None and worst_point is not None:

@@ -1,0 +1,304 @@
+"""Linearithmic M ≥ 3 non-domination ranking — pure-XLA engines.
+
+The matrix/tiled paths in :mod:`deap_tpu.mo.emo` peel fronts off a
+pairwise dominance relation: O(fronts · n²·m) work, and for the matrix
+variant O(n²) memory. The 2-objective ``nd_rank_staircase`` already
+replaced that with an O(n log n) sweep; this module is the same move
+for three and more objectives, built on two facts:
+
+1. **Rank is the longest dominating chain.** A point's front index
+   equals ``1 + max(rank of its dominators)`` (0 with none): every
+   dominator sits in a strictly earlier front, and once fronts up to
+   the deepest dominator are peeled nothing above the point remains.
+   Ranking is therefore a longest-path DP over the dominance DAG — no
+   peeling loop, no front-count-dependent trip count.
+2. **Lexicographic order is topological.** After sorting rows
+   lexicographically descending, every dominator of a row precedes it,
+   and among *distinct* rows ``j`` before ``i`` dominance reduces to
+   ``w_j ≥ w_i`` on the remaining objectives (the sort key supplies
+   the first coordinate and the strictness). Exact duplicates share
+   their group head's rank, like the staircase's fitness-grouping.
+
+Two engines consume those facts:
+
+- :func:`nd_rank_sweep3` (M = 3): one ``lax.scan`` over the sorted
+  rows. Each step must answer "max rank among processed points with
+  ``w1 ≥ y`` and ``w2 ≥ z``" — a dynamic 2-D dominated-max query. The
+  classical structure is a Fenwick tree over ``w1``-rank whose nodes
+  hold inner Fenwick trees over ``w2``-rank (O(log² n) per op), which
+  sounds hostile to XLA — but every tree *position* depends only on
+  the sort order, not on the ranks being computed, so the entire
+  control flow is hoisted out of the scan: all gather/scatter chains
+  are precomputed into two ``int32[n, ≤⌈log n⌉²]`` index tables with
+  vectorised sorts and bisections, and the scan step collapses to
+  ``gather → max → scatter-max`` on one flat f32 state vector.
+  O(n log² n) work, O(n log n) memory, n sequential steps of ~4 ops.
+- :func:`nd_rank_prefix` (any M): the divide-and-conquer front-rank
+  reduction collapsed to its streaming schedule. Rows are processed in
+  lex order in fixed blocks; each block's base ranks come from one
+  masked dominance reduction against the already-ranked prefix (tiled
+  — the ``[n, block]`` slab is the only pairwise object ever built;
+  on TPU the Pallas kernel ``ops.kernels.dominated_weight_maxes``
+  streams it through VMEM), then a serial in-block pass finishes the
+  chain DP. O(n²·m) work like a *single* peel, O(n·block) memory, and
+  — unlike peeling — one pass regardless of how many fronts the data
+  has. The win over the matrix path is the front count itself
+  (measured 34 fronts at n=4k and 81 at n=50k on uniform 3-objective
+  populations, growing with n).
+
+Both return ranks bit-identical to the dominance-matrix oracle
+(property-tested against it, including exact ties, duplicated rows and
+mixed maximise/minimise weights) and follow the ``max_rank`` sentinel
+contract of :func:`deap_tpu.mo.emo.nd_rank`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deap_tpu.core.fitness import lex_sort_desc
+
+__all__ = ["nd_rank_sweep3", "nd_rank_prefix"]
+
+
+def _fenwick_offsets(n: int):
+    """Flat-pool layout of a Fenwick-of-Fenwicks over ``n`` positions.
+
+    Outer node ``t ∈ [1, n]`` owns the positions ``(t - lsb(t), t]`` —
+    ``lsb(t)`` slots. Returns ``(off, F)``: ``off[t]`` is node ``t``'s
+    base offset in the flat state vector, ``off[n+1] = F`` is the
+    total size (and the lookup target for the invalid-node sentinel).
+    Trace-time numpy — ``n`` is static.
+    """
+    t = np.arange(1, n + 1, dtype=np.int64)
+    sizes = t & -t
+    csum = np.cumsum(sizes)
+    off = np.zeros(n + 2, np.int64)
+    if n > 1:
+        off[2:n + 1] = csum[:n - 1]
+    off[n + 1] = csum[n - 1]
+    return jnp.asarray(off, jnp.int32), int(csum[-1])
+
+
+def _sorted_groups(w: jnp.ndarray):
+    """Lex-desc processing order plus the duplicate-group head mask
+    (identical rows are adjacent after the sort; only the first of a
+    group computes a rank, the rest inherit it)."""
+    order = lex_sort_desc(w)
+    ws = w[order]
+    if w.shape[0] > 1:
+        same = jnp.all(ws[1:] == ws[:-1], axis=1)
+        head = jnp.concatenate([jnp.ones(1, bool), ~same])
+    else:
+        head = jnp.ones(w.shape[0], bool)
+    return order, ws, head
+
+
+def nd_rank_sweep3(w: jnp.ndarray, max_rank: Optional[int] = None,
+                   return_peels: bool = False):
+    """Exact 3-objective non-domination ranks in O(n log² n).
+
+    One pass over the rows in lexicographic descending order; the rank
+    of each row is ``1 + max(rank)`` over the already-processed rows
+    that cover it in the two trailing objectives (see module
+    docstring). The 2-D dominated-max structure answering that query
+    is a Fenwick tree over ``w1``-positions of inner Fenwick trees
+    over ``w2``-positions — with every tree index precomputed offline,
+    so the ``lax.scan`` step is one gather, one max, one scatter-max.
+
+    ``max_rank`` reproduces the peel-budget contract (rows at or past
+    the budget report the rank-``n`` sentinel); exactness makes
+    ``cover_k``/``fallback`` moot, as for the staircase.
+    """
+    n, nobj = w.shape
+    if nobj != 3:
+        raise ValueError(f"nd_rank_sweep3 needs nobj == 3, got {nobj}")
+    stop = n if max_rank is None else min(max_rank, n)
+    if n == 0:
+        ranks = jnp.zeros(0, jnp.int32)
+        return (ranks, jnp.int32(0)) if return_peels else ranks
+
+    A = int(n).bit_length()          # max Fenwick chain length
+    order, ws, head = _sorted_groups(w)
+    y = ws[:, 1].astype(jnp.float32)
+    z = ws[:, 2].astype(jnp.float32)
+
+    # Unique descending positions and inclusive-count query bounds per
+    # trailing objective. Dominators of row i among processed distinct
+    # rows are exactly {j : pos_y[j] <= cge_y[i] and rz[j] < cge_z[i]}:
+    # the bounds come from the *values* (counting ties in), while each
+    # point occupies one unique slot, so tie order never matters.
+    ysort = jnp.argsort(-y, stable=True)
+    posy = jnp.zeros(n, jnp.int32).at[ysort].set(
+        jnp.arange(1, n + 1, dtype=jnp.int32))        # 1-based, y desc
+    cge_y = jnp.searchsorted(-y[ysort], -y,
+                             side="right").astype(jnp.int32)
+    zsort = jnp.argsort(-z, stable=True)
+    rz = jnp.zeros(n, jnp.int32).at[zsort].set(
+        jnp.arange(n, dtype=jnp.int32))               # 0-based, z desc
+    cge_z = jnp.searchsorted(-z[zsort], -z,
+                             side="right").astype(jnp.int32)
+
+    off, F = _fenwick_offsets(n)
+    UD, QD = F, F + 1     # scatter dump / gather dump (never written)
+
+    # ---- node membership pool: each point sits in the <= A outer
+    # nodes of its update chain; one flat (node, rz)-sorted pool makes
+    # every node's members a statically-offset, rz-sorted segment.
+    node_cols = []
+    t = posy
+    for _ in range(A):
+        valid = t <= n
+        node_cols.append(jnp.where(valid, t, n + 1))
+        t = jnp.where(valid, t + (t & -t), t)
+    node_tab = jnp.stack(node_cols, 1)                     # [n, A]
+    node_flat = node_tab.reshape(-1)
+    rz_flat = jnp.broadcast_to(rz[:, None], (n, A)).reshape(-1)
+    perm = jnp.lexsort((rz_flat, node_flat))
+    rz_sorted = rz_flat[perm]
+    inner0_sorted = (jnp.arange(node_flat.shape[0], dtype=jnp.int32)
+                     - off[node_flat[perm]])
+    q_tab = jnp.zeros_like(node_flat).at[perm].set(
+        inner0_sorted).reshape(n, A)   # 0-based position inside node
+
+    # ---- update table: flat slots of every (outer node, inner chain)
+    # step of each point's insertion, padded with the dump slot.
+    u_cols = []
+    for a in range(A):
+        node = node_tab[:, a]
+        valid = node <= n
+        m_t = node & -node
+        base = off[node]
+        x = q_tab[:, a] + 1                    # 1-based inner position
+        for _ in range(A):
+            ok = valid & (x <= m_t)
+            u_cols.append(jnp.where(ok, base + x - 1, UD))
+            x = x + (x & -x)
+    U = jnp.stack(u_cols, 1)                               # [n, A*A]
+
+    # ---- query table: prefix decomposition of cge_y into <= A outer
+    # nodes; per node, a bisection finds how many members satisfy the
+    # z-bound, and that count's inner query chain is emitted.
+    q_cols = []
+    t = cge_y
+    for _ in range(A):
+        validq = t > 0
+        node = jnp.where(validq, t, n + 1)
+        m_t = jnp.where(validq, node & -node, 0)
+        base = off[node]
+        lo, hi = base, base + m_t
+        for _ in range(A + 1):                 # lower_bound on segment
+            mid = (lo + hi) // 2
+            v = rz_sorted[jnp.clip(mid, 0, rz_sorted.shape[0] - 1)]
+            active = lo < hi
+            go_right = active & (v < cge_z)
+            lo, hi = (jnp.where(go_right, mid + 1, lo),
+                      jnp.where(active & ~go_right, mid, hi))
+        x = lo - base
+        for _ in range(A):
+            okq = validq & (x > 0)
+            q_cols.append(jnp.where(okq, base + x - 1, QD))
+            x = x - (x & -x)
+        t = jnp.where(validq, t - (t & -t), t)
+    Q = jnp.stack(q_cols, 1)                               # [n, A*A]
+
+    # ---- the sweep: state holds (rank + 1) per inserted tree slot, so
+    # a query's max IS the new rank (0 = undominated). f32 is exact for
+    # ranks < 2²⁴, far past any population this runs on.
+    def step(carry, xs):
+        state, prev = carry
+        qrow, urow, is_head = xs
+        r = jnp.where(is_head, jnp.max(state[qrow]), prev)
+        state = state.at[urow].max(r + 1.0)
+        return (state, r), r
+
+    (_, _), ranks_f = lax.scan(
+        step, (jnp.zeros(F + 2, jnp.float32), jnp.float32(0)),
+        (Q, U, head))
+    sorted_ranks = ranks_f.astype(jnp.int32)
+    ranks = jnp.zeros(n, jnp.int32).at[order].set(sorted_ranks)
+    peels = jnp.minimum(jnp.max(sorted_ranks) + 1, jnp.int32(stop))
+    if max_rank is not None:
+        ranks = jnp.where(ranks < stop, ranks, n)
+    return (ranks, peels) if return_peels else ranks
+
+
+def nd_rank_prefix(w: jnp.ndarray, max_rank: Optional[int] = None,
+                   return_peels: bool = False, *, block: int = 512,
+                   cross: str = "auto",
+                   interpret: Optional[bool] = None):
+    """Exact any-M non-domination ranks in one front-count-free pass.
+
+    The divide-and-conquer front-rank reduction, streamed: rows sorted
+    lexicographically descending are consumed in fixed blocks; a
+    block's base ranks are one masked dominance max-reduction against
+    the already-ranked prefix (the cross step — only an ``[n, block]``
+    slab is ever materialised), and a serial in-block pass closes the
+    longest-chain DP. O(n²·m) work — a *single* peel's worth, against
+    the matrix/tiled paths' O(fronts · n²·m) — with O(n·block) memory.
+
+    ``cross``: ``'xla'`` computes the prefix reduction as a fused
+    masked broadcast; ``'pallas'`` streams it through
+    :func:`deap_tpu.ops.kernels.dominated_weight_maxes` tile by tile
+    (the TPU path; also exercises under the interpreter); ``'auto'``
+    picks pallas on TPU, xla elsewhere.
+    """
+    n, m = w.shape
+    stop = n if max_rank is None else min(max_rank, n)
+    if n == 0:
+        ranks = jnp.zeros(0, jnp.int32)
+        return (ranks, jnp.int32(0)) if return_peels else ranks
+    if cross == "auto":
+        cross = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if cross not in ("xla", "pallas"):
+        raise ValueError(f"unknown nd_rank_prefix cross {cross!r}")
+
+    order = lex_sort_desc(w)
+    ws = w[order].astype(jnp.float32)
+    block = max(1, min(block, n))
+    nb = -(-n // block)
+    npad = nb * block
+    wp = jnp.pad(ws, ((0, npad - n), (0, 0)),
+                 constant_values=-jnp.inf)   # pad rows dominate nothing
+    idx = jnp.arange(npad)
+    biota = jnp.arange(block)
+
+    if cross == "pallas":
+        from deap_tpu.ops.kernels import dominated_weight_maxes
+
+    def block_step(R, k):
+        start = k * block
+        blk = lax.dynamic_slice(wp, (start, jnp.int32(0)), (block, m))
+        if cross == "pallas":
+            weights = jnp.where(idx < start, R + 1.0, 0.0)
+            base = dominated_weight_maxes(wp, weights, queries=blk,
+                                          interpret=interpret)
+        else:
+            dom = (jnp.all(wp[:, None, :] >= blk[None, :, :], -1)
+                   & jnp.any(wp[:, None, :] > blk[None, :, :], -1)
+                   & (idx[:, None] < start))
+            base = jnp.max(jnp.where(dom, R[:, None] + 1.0, 0.0), axis=0)
+
+        def inner(i, rb):
+            wi = lax.dynamic_slice(blk, (i, jnp.int32(0)), (1, m))
+            d = (jnp.all(blk >= wi, -1) & jnp.any(blk > wi, -1)
+                 & (biota < i))
+            ri = jnp.maximum(base[i],
+                             jnp.max(jnp.where(d, rb + 1.0, 0.0)))
+            return rb.at[i].set(ri)
+
+        rb = lax.fori_loop(0, block, inner, jnp.zeros(block))
+        return lax.dynamic_update_slice(R, rb, (start,)), None
+
+    R, _ = lax.scan(block_step, jnp.zeros(npad), jnp.arange(nb))
+    sorted_ranks = R[:n].astype(jnp.int32)
+    ranks = jnp.zeros(n, jnp.int32).at[order].set(sorted_ranks)
+    peels = jnp.minimum(jnp.max(sorted_ranks) + 1, jnp.int32(stop))
+    if max_rank is not None:
+        ranks = jnp.where(ranks < stop, ranks, n)
+    return (ranks, peels) if return_peels else ranks

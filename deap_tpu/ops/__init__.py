@@ -55,6 +55,7 @@ from deap_tpu.ops.mutation import (
 )
 from deap_tpu.ops.kernels import (
     dominated_counts,
+    dominated_weight_maxes,
     dominated_weight_sums,
     fused_variation_eval,
     nd_rank_tiled,

@@ -44,6 +44,7 @@ from jax.experimental.pallas import tpu as pltpu
 __all__ = [
     "dominated_counts",
     "dominated_weight_sums",
+    "dominated_weight_maxes",
     "strengths_tiled",
     "nd_rank_tiled",
     "fused_variation_eval",
@@ -138,6 +139,82 @@ def dominated_weight_sums(w: jnp.ndarray, weights: jnp.ndarray, *,
         interpret=_auto_interpret(interpret),
     )(wp, wp.T, rem)
     return out[:n, 0]
+
+
+def _dom_maxes_kernel(wq_ref, wjt_ref, rem_ref, out_ref):
+    """One [TI, TJ] tile of ``max_j(weights[j] · dom[j → query i])``,
+    reduced over j on the fly — the max-combining sibling of
+    :func:`_dom_counts_kernel` (weights must be >= 0; 0 encodes
+    "absent")."""
+    j = pl.program_id(1)
+    m = wq_ref.shape[1]
+    geq = None
+    gt = None
+    for k in range(m):  # m = nobj is tiny and static: unrolled
+        a = wq_ref[:, k : k + 1]   # [TI, 1]
+        b = wjt_ref[k : k + 1, :]  # [1, TJ]
+        ge = b >= a
+        g = b > a
+        geq = ge if geq is None else (geq & ge)
+        gt = g if gt is None else (gt | g)
+    vals = jnp.where(geq & gt, rem_ref[0:1, :], 0.0)
+    tile_max = jnp.max(vals, axis=1, keepdims=True)  # [TI, 1]
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    out_ref[:] = jnp.maximum(out_ref[:], tile_max)
+
+
+def dominated_weight_maxes(w: jnp.ndarray, weights: jnp.ndarray,
+                           queries: Optional[jnp.ndarray] = None, *,
+                           block_i: int = 256, block_j: int = 512,
+                           interpret: Optional[bool] = None) -> jnp.ndarray:
+    """``out[i] = max_{j dominates queries[i]} weights[j]`` (0 with no
+    dominator), streaming [TI, m] × [m, TJ] tiles through VMEM like
+    :func:`dominated_weight_sums`.
+
+    This is the cross step of the prefix-streamed chain reduction
+    (mo.ndsort.nd_rank_prefix): with ``weights = (rank + 1) ·
+    prefix_mask`` it hands every query row the deepest dominating
+    chain in the already-ranked prefix without materialising any
+    [n, n] object. ``queries`` defaults to ``w`` (self-ranking);
+    weights must be non-negative — 0 is the "no dominator" identity.
+
+    :param w: ``f32[n, nobj]`` candidate dominators (weighted values).
+    :param weights: ``f32[n]`` per-dominator weights (>= 0).
+    :param queries: ``f32[nq, nobj]`` rows to rank against ``w``.
+    :returns: ``f32[nq]``.
+    """
+    if queries is None:
+        queries = w
+    n, m = w.shape
+    nq = queries.shape[0]
+    njp = _round_up(n, block_j)
+    nip = _round_up(nq, block_i)
+    wp = jnp.pad(w.astype(jnp.float32), ((0, njp - n), (0, 0)),
+                 constant_values=-jnp.inf)  # padded rows dominate nothing
+    qp = jnp.pad(queries.astype(jnp.float32), ((0, nip - nq), (0, 0)),
+                 constant_values=jnp.inf)   # padded queries match nothing
+    rem = jnp.pad(weights.astype(jnp.float32), (0, njp - n))[None, :]
+    out = pl.pallas_call(
+        _dom_maxes_kernel,
+        grid=(nip // block_i, njp // block_j),
+        in_specs=[
+            pl.BlockSpec((block_i, m), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((m, block_j), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_j), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((block_i, 1), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((nip, 1), jnp.float32),
+        interpret=_auto_interpret(interpret),
+    )(qp, wp.T, rem)
+    return out[:nq, 0]
 
 
 def dominated_counts(w: jnp.ndarray, remaining: jnp.ndarray, *,

@@ -12,6 +12,13 @@ of materialising the full genome anywhere.
 
 This composes with population sharding: a 2-D ``("pop", "genome")``
 mesh shards both axes, the canonical DP×SP layout.
+
+Every collective issued here runs inside a named profiling span
+(``genome_shard/<collective>``, see support.profiling.span) so an
+xplane trace attributes cross-shard time to the *specific* collective
+— the instrumentation needed to pin the n=8 weak-scaling cliff
+(VERDICT r5: 0.87 → 0.34 efficiency) on psum vs pmean vs pmax rather
+than "the sharded step".
 """
 
 from __future__ import annotations
@@ -22,7 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from deap_tpu.parallel.mesh import population_mesh
+from deap_tpu.parallel.mesh import population_mesh, shard_map
+from deap_tpu.support.profiling import span
 
 
 def genome_mesh(n_pop_shards: Optional[int] = None,
@@ -51,6 +59,15 @@ def shard_genomes(genomes: jnp.ndarray, mesh: Mesh) -> jnp.ndarray:
     return jax.device_put(genomes, NamedSharding(mesh, P("pop", "genome")))
 
 
+#: collective used per ``combine`` mode — one place, so the profiling
+#: span names and the actual collectives can never drift apart.
+_COMBINE_COLLECTIVES = {
+    "sum": ("psum", jax.lax.psum),
+    "mean": ("pmean", jax.lax.pmean),
+    "max": ("pmax", jax.lax.pmax),
+}
+
+
 def make_sharded_evaluator(partial_eval: Callable, mesh: Mesh,
                            combine: str = "sum") -> Callable:
     """Build ``evaluate(genomes [n, L]) -> f32[n]`` that runs
@@ -62,21 +79,22 @@ def make_sharded_evaluator(partial_eval: Callable, mesh: Mesh,
         per-gene scores, a partial squared-error).
     :param combine: ``"sum"`` | ``"mean"`` | ``"max"`` — the cross-shard
         reduction (``psum``-family collectives over ICI).
+
+    Both the local compute and the collective run under named spans
+    (``genome_shard/partial_eval``, ``genome_shard/psum`` …) so traces
+    captured with :func:`deap_tpu.support.profiling.trace` break the
+    sharded step down per collective.
     """
-    if combine not in ("sum", "mean", "max"):
+    if combine not in _COMBINE_COLLECTIVES:
         raise ValueError(combine)
+    cname, collective = _COMBINE_COLLECTIVES[combine]
 
     def local(genomes):
-        part = partial_eval(genomes)
-        if combine == "sum":
-            return jax.lax.psum(part, "genome")
-        if combine == "mean":
-            return jax.lax.pmean(part, "genome")
-        return jax.lax.pmax(part, "genome")
+        with span("genome_shard/partial_eval"):
+            part = partial_eval(genomes)
+        with span(f"genome_shard/{cname}"):
+            return collective(part, "genome")
 
-    mapped = jax.shard_map(
-        local, mesh=mesh,
-        in_specs=P("pop", "genome"),
-        out_specs=P("pop"),
-        check_vma=False)
+    mapped = shard_map(local, mesh=mesh,
+                       in_specs=P("pop", "genome"), out_specs=P("pop"))
     return jax.jit(mapped)

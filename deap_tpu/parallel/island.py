@@ -27,6 +27,7 @@ from deap_tpu.algorithms import evaluate_invalid, var_and
 from deap_tpu.core.fitness import FitnessSpec
 from deap_tpu.core.population import Population, gather, init_population
 from deap_tpu.ops.selection import sel_best
+from deap_tpu.parallel.mesh import axis_size, shard_map
 
 IslandState = Population  # demes stacked on the leading axis
 
@@ -71,7 +72,7 @@ def _migrate_sharded(key, pops, k, selection, axis_name):
     def shift(rows):
         # rows: [m, k, ...]; destination deme j gets rows from deme j-1,
         # deme 0 gets the previous device's deme m-1 over the ring.
-        n = lax.axis_size(axis_name)
+        n = axis_size(axis_name)
         perm = [(i, (i + 1) % n) for i in range(n)]
         incoming0 = lax.ppermute(rows[-1], axis_name, perm)
         return jnp.concatenate([incoming0[None], rows[:-1]], axis=0)
@@ -122,8 +123,7 @@ def make_island_step(toolbox, cxpb: float, mutpb: float, freq: int,
         return epoch(key, pops, lambda kk, pp: _migrate_sharded(
             kk, pp, mig_k, selection, axis_name))
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         sharded_epoch, mesh=mesh,
-        in_specs=(P(), spec_sharded), out_specs=spec_sharded,
-        check_vma=False)
+        in_specs=(P(), spec_sharded), out_specs=spec_sharded)
     return jax.jit(mapped)

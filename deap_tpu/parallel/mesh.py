@@ -19,11 +19,41 @@ Axes convention:
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def axis_size(axis_name: str):
+    """Size of a named mesh axis, from inside ``shard_map``/``pmap``.
+
+    ``lax.axis_size`` only exists on newer jax; on 0.4.x the standard
+    spelling is ``psum(1)`` over the axis, which constant-folds to the
+    axis size at trace time (no runtime collective).
+    """
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def shard_map(f: Callable, mesh: Mesh, in_specs, out_specs) -> Callable:
+    """``jax.shard_map`` across the API move.
+
+    Newer jax exposes ``jax.shard_map(..., check_vma=...)``; 0.4.x only
+    has ``jax.experimental.shard_map.shard_map(..., check_rep=...)``.
+    Every shard_map in this package goes through here (replication
+    checking off in both spellings — the collectives are explicit) so
+    the version split lives in exactly one place.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
 
 
 def population_mesh(n_devices: Optional[int] = None,

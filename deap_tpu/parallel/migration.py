@@ -28,6 +28,7 @@ from jax import lax
 
 from deap_tpu.core.population import Population, gather
 from deap_tpu.ops.selection import sel_best, sel_worst
+from deap_tpu.parallel.mesh import axis_size
 
 
 def _emigrant_idx(key, pop, k, selection):
@@ -116,7 +117,7 @@ def mig_ring_collective(key: jax.Array, pop: Population, k: int,
     rep_idx = emi_idx if replacement is None else replacement(krep, w, k)
 
     emigrants = gather(pop, emi_idx)
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if migarray is None:
         perm = [(i, (i + 1) % n) for i in range(n)]
     else:

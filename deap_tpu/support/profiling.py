@@ -34,7 +34,8 @@ from typing import Any, Callable, Iterator, Tuple
 
 import jax
 
-__all__ = ["trace", "annotate", "timed_generations", "sync"]
+__all__ = ["trace", "annotate", "span", "timed_generations",
+           "timed_phases", "sync"]
 
 
 def trace(log_dir: str, **kwargs):
@@ -43,6 +44,18 @@ def trace(log_dir: str, **kwargs):
     profile plugin / XProf. The TPU-native replacement for the
     reference's external timing harness."""
     return jax.profiler.trace(log_dir, **kwargs)
+
+
+@contextlib.contextmanager
+def span(name: str):
+    """Inline named span — the context-manager form of :func:`annotate`
+    for code that is not a whole function (a single collective inside a
+    ``shard_map`` body, one phase of a fused step). Device ops traced
+    inside the block carry ``name`` as a scope in xplane captures, so
+    per-collective time is attributable in XProf; metadata-only, never
+    changes the compiled program."""
+    with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
+        yield
 
 
 def annotate(name: str) -> Callable:
@@ -68,6 +81,29 @@ def sync(tree: Any) -> Any:
     if leaves:
         jax.device_get(jax.numpy.ravel(leaves[0])[:1])
     return tree
+
+
+def timed_phases(phases: dict, reps: int = 3) -> dict:
+    """Host-side attribution harness: ``phases`` maps a label to a
+    zero-arg thunk returning device arrays; each is run ``reps`` times
+    under :func:`sync` and the minimum wall seconds per label returned.
+
+    The differencing companion to the per-collective spans: build one
+    thunk per pipeline variant (full sharded step, collective swapped
+    for identity, partial_eval alone) and the pairwise deltas attribute
+    wall time to a specific collective even when no xplane trace can be
+    captured (e.g. the TPU relay is down and CPU host timing is all
+    there is)."""
+    out = {}
+    for name, thunk in phases.items():
+        sync(thunk())  # compile outside the timed reps
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            sync(thunk())
+            best = min(best, time.perf_counter() - t0)
+        out[name] = best
+    return out
 
 
 def timed_generations(step: Callable, state: Any, ngen: int,

@@ -64,6 +64,31 @@ def test_interpreter_known_expression(pset):
     assert "ARG0" in s or "x" in s
 
 
+def test_interpreter_factories_cached_per_pset(pset):
+    # repeated factory calls hand back the SAME callables (identity-
+    # stable closures keep downstream jit caches warm), the primitive
+    # dispatch is built once per set, and the cached arity table is
+    # one device array, not a rebuild per evaluation pass
+    assert gp.make_interpreter(pset, MAX_LEN) is gp.make_interpreter(
+        pset, MAX_LEN)
+    assert gp.make_batch_interpreter(pset, MAX_LEN) is \
+        gp.make_batch_interpreter(pset, MAX_LEN)
+    assert gp.make_interpreter(pset, MAX_LEN + 1) is not \
+        gp.make_interpreter(pset, MAX_LEN)
+    from deap_tpu.gp.interpreter import _prim_rows_builder
+    assert _prim_rows_builder(pset) is _prim_rows_builder(pset)
+    assert pset.arity_table() is pset.arity_table()
+    # growing the set invalidates: fresh rows, fresh arity table
+    fresh = gp.math_set(n_args=1)
+    before = (_prim_rows_builder(fresh), fresh.arity_table(),
+              gp.make_interpreter(fresh, MAX_LEN))
+    fresh.add_primitive(jnp.minimum, 2, name="min2")
+    assert _prim_rows_builder(fresh) is not before[0]
+    assert fresh.arity_table() is not before[1]
+    assert gp.make_interpreter(fresh, MAX_LEN) is not before[2]
+    assert int(fresh.arity_table()[fresh.n_ops - 1]) == 2
+
+
 def test_interpreter_protected_div(pset):
     from deap_tpu.gp.string import from_string
 

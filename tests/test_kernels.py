@@ -15,6 +15,7 @@ from deap_tpu.core.fitness import dominates
 from deap_tpu.mo.emo import nd_rank
 from deap_tpu.ops.kernels import (
     dominated_counts,
+    dominated_weight_maxes,
     fused_variation_eval,
     nd_rank_tiled,
 )
@@ -32,6 +33,28 @@ def test_dominated_counts_matches_matrix(n, m):
     dom = dominates(w[None, :, :], w[:, None, :])  # [i, j]: j dominates i
     want = (dom & rem[None, :]).sum(1)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.parametrize("n,nq,m", [(37, 37, 3), (300, 120, 4)])
+def test_dominated_weight_maxes_matches_matrix(n, nq, m):
+    w = jax.random.normal(jax.random.key(n), (n, m))
+    w = w.at[: n // 4].set(w[n // 4 : 2 * (n // 4)])  # exact ties
+    q = w[:nq] if nq < n else w
+    wts = jax.random.uniform(jax.random.key(2), (n,), minval=1.0,
+                             maxval=9.0)
+    got = dominated_weight_maxes(w, wts, queries=q,
+                                 block_i=128, block_j=128)
+    dom = dominates(w[None, :, :], q[:, None, :])  # [i, j]: j dom q_i
+    want = jnp.max(jnp.where(dom, wts[None, :], 0.0), axis=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+
+def test_dominated_weight_maxes_default_queries_is_self():
+    w = jax.random.normal(jax.random.key(9), (65, 3))
+    a = dominated_weight_maxes(w, jnp.ones(65), block_i=64, block_j=64)
+    b = dominated_weight_maxes(w, jnp.ones(65), queries=w,
+                               block_i=64, block_j=64)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 def test_nd_rank_tiled_matches_matrix_path():

@@ -4,7 +4,8 @@ barrier, and host-timed generation loop (SURVEY.md §5.1 parity)."""
 import jax
 import jax.numpy as jnp
 
-from deap_tpu.support.profiling import annotate, sync, timed_generations
+from deap_tpu.support.profiling import (annotate, span, sync,
+                                        timed_generations, timed_phases)
 
 
 def test_annotate_is_transparent():
@@ -14,6 +15,42 @@ def test_annotate_is_transparent():
 
     assert float(f(jnp.float32(3.0))) == 6.0
     assert float(jax.jit(f)(jnp.float32(3.0))) == 6.0
+
+
+def test_span_is_transparent_inside_jit():
+    def f(x):
+        with span("collective:psum"):
+            return x + 1.0
+
+    assert float(f(jnp.float32(1.0))) == 2.0
+    assert float(jax.jit(f)(jnp.float32(1.0))) == 2.0
+
+
+def test_timed_phases_times_every_label():
+    out = timed_phases({
+        "a": lambda: jnp.arange(8).sum(),
+        "b": lambda: jnp.ones(4) * 2.0,
+    }, reps=2)
+    assert set(out) == {"a", "b"}
+    assert all(t >= 0.0 for t in out.values())
+
+
+def test_sharded_evaluator_spans_preserve_semantics():
+    # the per-collective annotation in genome_shard must never change
+    # results: sharded == unsharded on an 8-way genome mesh
+    import numpy as np
+
+    from deap_tpu.parallel.genome_shard import (genome_mesh,
+                                                make_sharded_evaluator,
+                                                shard_genomes)
+
+    mesh = genome_mesh(n_pop_shards=1, n_genome_shards=8)
+    g = jax.random.bernoulli(jax.random.key(0), 0.5, (16, 64))
+    ev = make_sharded_evaluator(
+        lambda s: s.sum(-1).astype(jnp.float32), mesh, combine="sum")
+    got = ev(shard_genomes(g, mesh))
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(g.sum(-1), dtype=np.float32))
 
 
 def test_sync_returns_tree():
