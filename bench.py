@@ -337,16 +337,41 @@ def nd3_lines() -> list:
     return rows
 
 
-def _time_samples(run, *args):
+def _env_fingerprint(backend: str) -> dict:
+    """jax version / backend / device kind — stamped on every emitted
+    row so committed BENCH_*.json rows distinguish cached-replay from
+    fresh-capture environments. Never initialises the XLA client when
+    the backend is the (single-client) TPU: the race children must be
+    the only attachers, so the parent reports the kind as unattached."""
+    fp = {"jax": jax.__version__, "backend": backend}
+    if backend == "cpu":
+        try:
+            fp["device_kind"] = jax.devices()[0].device_kind
+        except Exception:
+            pass
+    else:
+        fp["device_kind"] = "tpu (parent unattached)"
+    return fp
+
+
+def _time_samples(run, *args, journal=None):
     """All REPS wall-second samples of run(*args) after a warm-up
     compile — the raw material for the median+spread headline protocol
-    (VERDICT r3 #7: a single sample per window rode ±25% noise)."""
+    (VERDICT r3 #7: a single sample per window rode ±25% noise).
+
+    With a journal, the warm-up marks the journal steady, so any
+    compile during the timed reps surfaces as a ``retrace`` event —
+    a retrace inside the measurement window invalidates the sample."""
     sync(run(jax.random.key(100), *args))  # compile + warm
+    if journal is not None:
+        journal.mark_steady("headline_warm")
     times = []
     for r in range(REPS):
         t0 = time.perf_counter()
         sync(run(jax.random.key(101 + r), *args))
         times.append(time.perf_counter() - t0)
+        if journal is not None:
+            journal.event("rep", rep=r, seconds=round(times[-1], 6))
     return times
 
 
@@ -589,8 +614,29 @@ def _cached_tpu_row():
     return row
 
 
-def main():
+def main(journal_path=None):
     backend = _probe_backend() if _TUNNEL_OK else "cpu"
+    tel = None
+    if journal_path:
+        # --journal: emit a run journal alongside the headline rows —
+        # header fingerprint, compile/retrace events (a retrace inside
+        # the timed reps invalidates the sample), per-rep wall times,
+        # span aggregates, summary. Header must not attach the
+        # single-client TPU from this parent process.
+        from deap_tpu.telemetry import RunTelemetry
+        tel = RunTelemetry(journal_path, init_backend=(backend == "cpu"))
+        tel.__enter__()
+        tel.journal.header(init_backend=(backend == "cpu"),
+                           bench="onemax_pop100k", pop=POP, ngen=NGEN)
+    try:
+        _main_measure(backend, tel)
+    finally:
+        if tel is not None:
+            tel.__exit__(None, None, None)
+
+
+def _main_measure(backend, tel=None):
+    journal = tel.journal if tel is not None else None
     if backend != "tpu":
         # DEAP_TPU_BENCH_LIVE=1 forces a live (CPU-fallback) run —
         # needed when measuring changes to the portable XLA path on a
@@ -607,6 +653,11 @@ def main():
                 "relay down at measurement time; replaying the most "
                 "recent TPU capture from TPU_EVIDENCE (relay timeline: "
                 "TPU_PROBE_LOG.jsonl)")
+            # env describes the *emitting* process; the measurement
+            # environment is whatever captured the replayed row
+            cached["env"] = _env_fingerprint("cpu")
+            if journal is not None:
+                journal.event("headline", **cached)
             print(json.dumps(cached))
             return
     outcomes, times, winner = {}, [], None
@@ -631,7 +682,7 @@ def main():
         backend = "cpu"
         jax.config.update("jax_platforms", "cpu")
         tb, pop = _setup()
-        times = _time_samples(make_run_xla(tb), pop)
+        times = _time_samples(make_run_xla(tb), pop, journal=journal)
         dt = min(times)
 
     times = sorted(times)
@@ -645,6 +696,7 @@ def main():
         "unit": "gens/sec",
         "vs_baseline": round(gens_per_sec / REFERENCE_GENS_PER_SEC, 1),
         "backend": backend,
+        "env": _env_fingerprint(backend),
         "best": round(NGEN / times[0], 2),
         "spread_pct": round(100 * (times[-1] - times[0]) / median_dt, 1),
         "n_samples": len(times),
@@ -671,6 +723,8 @@ def main():
         # self-describing CPU fallback: the axon relay was down at
         # measurement time — this line is not a TPU regression signal
         line["tunnel_down"] = True
+    if journal is not None:
+        journal.event("headline", **line)
     print(json.dumps(line))
     if backend == "cpu":
         # the multi-objective headline rides along on CPU runs (the
@@ -678,8 +732,11 @@ def main():
         # is a suite concern). Distinct metric name — headline parsers
         # key on "metric" and never see this as the onemax row.
         mline = mo_line(backend)
+        mline["env"] = _env_fingerprint(backend)
         if not _TUNNEL_OK:
             mline["tunnel_down"] = True
+        if journal is not None:
+            journal.event("headline", **mline)
         print(json.dumps(mline))
 
 
@@ -707,4 +764,10 @@ if __name__ == "__main__":
         print(json.dumps({"candidate": name, "seconds": min(times),
                           "times": times}))
     else:
-        main()
+        journal_path = None
+        if "--journal" in sys.argv:
+            i = sys.argv.index("--journal")
+            nxt = sys.argv[i + 1] if i + 1 < len(sys.argv) else None
+            journal_path = (nxt if nxt and not nxt.startswith("--")
+                            else "bench_journal.jsonl")
+        main(journal_path)

@@ -6,9 +6,20 @@ profile rows in ``TPU_PROFILE_{ROUND}.jsonl``) and prints BASELINE.md-
 ready tables, so summarising a relay window costs seconds, not window
 minutes. Pure file reading — no jax, safe to run any time.
 
-Usage: ``python bench_report.py``
+Usage:
+    python bench_report.py               # evidence tables (default)
+    python bench_report.py --tripwire    # regression diff of the two
+                                         # most recent BENCH_r*.json;
+                                         # exit 1 if a live-vs-live
+                                         # metric regressed > 10%
+    python bench_report.py --journal F   # summarise a run journal
+                                         # (telemetry JSONL): compiles/
+                                         # retraces, span aggregates,
+                                         # meter first/last rows
 """
 
+import glob
+import json
 import os
 import sys
 
@@ -24,6 +35,141 @@ from tpu_capture import (  # noqa: E402
     profile_rows,
     suite_rows,
 )
+
+
+# ------------------------------------------------------------ tripwire ----
+
+#: fractional worsening beyond which a live-vs-live row trips
+TRIPWIRE_THRESHOLD = 0.10
+
+#: per-unit direction: is a larger value better?
+_HIGHER_IS_BETTER = {"gens/sec": True, "x": True, "seconds": False}
+
+
+def _bench_rows(path: str) -> dict:
+    """metric -> row dicts parsed out of a committed BENCH_*.json's
+    ``tail`` (one JSON line per metric; non-JSON lines skipped)."""
+    with open(path) as fh:
+        data = json.load(fh)
+    rows = {}
+    for ln in data.get("tail", "").splitlines():
+        ln = ln.strip()
+        if not ln.startswith("{"):
+            continue
+        try:
+            d = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if "metric" in d:
+            # --nd3 style files repeat a metric per impl — key on both
+            key = d["metric"] + (":" + d["impl"] if "impl" in d else "")
+            rows[key] = d
+    return rows
+
+
+def tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
+    """Diff the two most recent committed ``BENCH_r*.json`` files and
+    flag regressions. Cached-replay rows (``cached: true`` /
+    ``tpu-cached`` backend) never trip — a replay of an old capture
+    carries no new information about the current code; the env
+    fingerprint bench.py now stamps makes the distinction visible in
+    the table. Returns the number of tripped metrics (the process exit
+    code)."""
+    files = sorted(glob.glob(os.path.join(HERE, "BENCH_r*.json")))
+    if len(files) < 2:
+        print("tripwire: need >= 2 committed BENCH_r*.json files, "
+              f"found {len(files)}")
+        return 0
+    prev_path, cur_path = files[-2], files[-1]
+    prev, cur = _bench_rows(prev_path), _bench_rows(cur_path)
+    print(f"## Bench tripwire: {os.path.basename(prev_path)} → "
+          f"{os.path.basename(cur_path)}\n")
+    print("| metric | prev | cur | Δ% | status |")
+    print("|---|---|---|---|---|")
+    tripped = 0
+    for key in sorted(set(prev) & set(cur)):
+        p, c = prev[key], cur[key]
+        pv, cv = p.get("value"), c.get("value")
+        if not isinstance(pv, (int, float)) or not isinstance(cv, (int, float)):
+            continue
+        delta_pct = 100.0 * (cv - pv) / pv if pv else float("inf")
+        replay = (p.get("cached") or c.get("cached")
+                  or "cached" in str(p.get("backend", ""))
+                  or "cached" in str(c.get("backend", "")))
+        higher_better = _HIGHER_IS_BETTER.get(c.get("unit"), True)
+        worsened = (cv < pv * (1 - threshold)) if higher_better else (
+            cv > pv * (1 + threshold))
+        if replay:
+            status = "replay (not comparable)"
+        elif worsened:
+            status = "**REGRESSION**"
+            tripped += 1
+        else:
+            status = "ok"
+        print(f"| {key} | {pv} | {cv} | {delta_pct:+.1f}% | {status} |")
+    missing = sorted(set(prev) - set(cur))
+    if missing:
+        print(f"\nmetrics dropped since {os.path.basename(prev_path)}: "
+              + ", ".join(missing))
+    if tripped:
+        print(f"\n{tripped} metric(s) regressed beyond "
+              f"{threshold:.0%} — failing.")
+    return tripped
+
+
+# ------------------------------------------------------- journal reader ----
+
+def _read_jsonl(path: str) -> list:
+    out = []
+    with open(path) as fh:
+        for ln in fh:
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                out.append(json.loads(ln))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+def journal_report(path: str) -> None:
+    """Summarise a telemetry run journal (the JSONL RunJournal format;
+    local parser — this tool must stay importable without jax)."""
+    events = _read_jsonl(path)
+    kinds = {}
+    for e in events:
+        kinds[e.get("kind", "?")] = kinds.get(e.get("kind", "?"), 0) + 1
+    print(f"## Run journal: {os.path.basename(path)}\n")
+    header = next((e for e in events if e.get("kind") == "header"), None)
+    if header:
+        env = header.get("env", {})
+        print("- env: " + ", ".join(f"{k}={v}" for k, v in env.items()))
+        if "toolbox" in header:
+            print(f"- toolbox digest: {header['toolbox'].get('digest')}")
+    print("- events: " + ", ".join(
+        f"{k}×{v}" for k, v in sorted(kinds.items())))
+    retraces = [e for e in events if e.get("kind") == "retrace"]
+    if retraces:
+        total = sum(e.get("dur_s", 0.0) for e in retraces)
+        print(f"- **{len(retraces)} retrace(s)** after steady, "
+              f"{total:.3f}s recompiling — investigate shape/closure "
+              "churn")
+    meters = [e for e in events if e.get("kind") == "meter"]
+    if meters:
+        drop = ("t", "kind")
+        fmt = lambda e: ", ".join(f"{k}={v}" for k, v in e.items()
+                                  if k not in drop and not isinstance(v, list))
+        print(f"- meter rows: {len(meters)} (first: {fmt(meters[0])}; "
+              f"last: {fmt(meters[-1])})")
+    spans = [e for e in events if e.get("kind") == "span"]
+    if spans:
+        print("\n| span | count | total s | p50 s | p99 s |")
+        print("|---|---|---|---|---|")
+        for s in sorted(spans, key=lambda s: -s.get("total_s", 0)):
+            print(f"| {s.get('name')} | {s.get('count')} | "
+                  f"{s.get('total_s', 0):.6f} | {s.get('p50_s', 0):.6f} | "
+                  f"{s.get('p99_s', 0):.6f} |")
 
 
 def main() -> None:
@@ -80,4 +226,9 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--tripwire" in sys.argv:
+        sys.exit(1 if tripwire() else 0)
+    elif "--journal" in sys.argv:
+        journal_report(sys.argv[sys.argv.index("--journal") + 1])
+    else:
+        main()

@@ -6,7 +6,10 @@ genetic programming via a batched prefix-tree interpreter, evolution
 strategies (CMA-ES and friends), multi-objective selection (NSGA-II/III,
 SPEA2), island-model and multi-host distribution over device meshes, and
 DEAP-style support tooling (toolbox registry, statistics/logbook,
-hall-of-fame/Pareto archives, checkpointing, benchmark suite).
+hall-of-fame/Pareto archives, checkpointing, benchmark suite, and a
+run-journal telemetry subsystem — in-scan metrics, JSONL host events
+with retrace tracking, span wall-time aggregation; see
+`deap_tpu.telemetry`).
 
 Design stance (see SURVEY.md §7): populations are struct-of-arrays pytrees,
 operators are pure functions `(key, ...) -> ...`, algorithms are `lax.scan`

@@ -147,15 +147,53 @@ def _maybe_stats(stats: Optional[Statistics], pop: Population):
     return stats.compile(pop) if stats is not None else {}
 
 
+# ------------------------------------------------------------- telemetry ----
+#
+# Every loop takes an optional ``telemetry`` (a RunTelemetry): when set,
+# a Meter state dict joins the scan carry and per-generation snapshots
+# ride the scan's stacked output — zero host round trips; the journal
+# gets header/run_start/meter/run_end events on the host side. When
+# None, the scan carry, xs and step body are *exactly* the untouched
+# originals, and with telemetry enabled the computed results are
+# bit-identical anyway (meter updates consume no RNG and feed nothing
+# back — pinned by tests/test_telemetry.py).
+
+def _tel_declare(meter) -> None:
+    """The built-in metric set every population loop maintains."""
+    meter.counter("nevals")
+    meter.gauge("best")
+    meter.gauge("mean")
+    meter.gauge("evaluated_frac")
+
+
+def _tel_measure(tel, mstate, nevals: jnp.ndarray, pop: Population,
+                 gen: jnp.ndarray):
+    """In-scan built-in instrumentation + user probe + live stream."""
+    m = tel.meter
+    w0 = pop.wvalues[:, 0]
+    mstate = m.inc(mstate, "nevals", nevals)
+    mstate = m.set(mstate, "best", jnp.max(w0))
+    mstate = m.set(mstate, "mean", jnp.mean(w0))
+    mstate = m.set(mstate, "evaluated_frac",
+                   nevals.astype(jnp.float32) / pop.size)
+    mstate = tel.apply_probe(mstate, pop=pop)
+    tel.live(mstate, gen)
+    return mstate
+
+
 def ea_simple(key: jax.Array, pop: Population, toolbox, cxpb: float,
               mutpb: float, ngen: int, stats: Optional[Statistics] = None,
               halloffame_size: int = 0, verbose: bool = False,
+              telemetry=None,
               ) -> Tuple[Population, Logbook, Optional[HallOfFame]]:
     """The canonical generational GA (algorithms.py:85-189).
 
     select n → varAnd → evaluate invalid → replace, scanned over ``ngen``
-    generations as one compiled program.
+    generations as one compiled program. ``telemetry`` (a
+    :class:`deap_tpu.telemetry.RunTelemetry`) threads a Meter through
+    the scan and journals the run; results are unchanged either way.
     """
+    tel = telemetry
     kscan = key
     nevals0 = jnp.sum(~pop.valid)  # like the reference's len(invalid_ind)
     pop = evaluate_invalid(pop, toolbox.evaluate)
@@ -163,9 +201,17 @@ def ea_simple(key: jax.Array, pop: Population, toolbox, cxpb: float,
     if hof is not None:
         hof = hof_update(hof, pop)
     record0 = {"nevals": nevals0, **_maybe_stats(stats, pop)}
+    if tel is not None:
+        tel.begin_run("ea_simple", toolbox, declare=_tel_declare,
+                      ngen=ngen, n=pop.size, cxpb=cxpb, mutpb=mutpb)
+        mstate0 = _tel_measure(tel, tel.meter.init(), nevals0, pop,
+                               jnp.int32(0))
 
-    def step(carry, key):
-        pop, hof = carry
+    def step(carry, xs):
+        if tel is None:
+            (pop, hof), key = carry, xs
+        else:
+            (pop, hof, mstate), (key, gen) = carry, xs
         k_sel, k_var = jax.random.split(key)
         idx = toolbox.select(k_sel, pop.wvalues, pop.size)
         off = var_and(k_var, gather(pop, idx), toolbox, cxpb, mutpb)
@@ -176,9 +222,20 @@ def ea_simple(key: jax.Array, pop: Population, toolbox, cxpb: float,
         else:
             new_hof = None
         rec = {"nevals": nevals, **_maybe_stats(stats, off)}
-        return (off, new_hof), rec
+        if tel is None:
+            return (off, new_hof), rec
+        mstate = _tel_measure(tel, mstate, nevals, off, gen)
+        return (off, new_hof, mstate), (rec, mstate)
 
-    (pop, hof), records = lax.scan(step, (pop, hof), jax.random.split(kscan, ngen))
+    if tel is None:
+        (pop, hof), records = lax.scan(step, (pop, hof),
+                                       jax.random.split(kscan, ngen))
+    else:
+        (pop, hof, _), (records, mrows) = lax.scan(
+            step, (pop, hof, mstate0),
+            (jax.random.split(kscan, ngen), jnp.arange(1, ngen + 1)))
+        tel.end_run("ea_simple", stacked_meter=mrows, initial=mstate0,
+                    ngen=ngen)
     logbook = _build_logbook(record0, records, stats)
     if verbose:
         print(logbook.stream)
@@ -208,11 +265,13 @@ def ea_mu_plus_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
                       lambda_: int, cxpb: float, mutpb: float, ngen: int,
                       stats: Optional[Statistics] = None,
                       halloffame_size: int = 0, verbose: bool = False,
+                      telemetry=None,
                       ) -> Tuple[Population, Logbook, Optional[HallOfFame]]:
     """(μ + λ) evolution (algorithms.py:248-337): parents survive into the
     selection pool."""
     assert cxpb + mutpb <= 1.0, (
         "The sum of the crossover and mutation probabilities must be <= 1.0.")
+    tel = telemetry
     kscan = key
     nevals0 = jnp.sum(~pop.valid)  # like the reference's len(invalid_ind)
     pop = evaluate_invalid(pop, toolbox.evaluate)
@@ -220,9 +279,18 @@ def ea_mu_plus_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
     if hof is not None:
         hof = hof_update(hof, pop)
     record0 = {"nevals": nevals0, **_maybe_stats(stats, pop)}
+    if tel is not None:
+        tel.begin_run("ea_mu_plus_lambda", toolbox, declare=_tel_declare,
+                      ngen=ngen, mu=mu, lambda_=lambda_, cxpb=cxpb,
+                      mutpb=mutpb)
+        mstate0 = _tel_measure(tel, tel.meter.init(), nevals0, pop,
+                               jnp.int32(0))
 
-    def step(carry, key):
-        pop, hof = carry
+    def step(carry, xs):
+        if tel is None:
+            (pop, hof), key = carry, xs
+        else:
+            (pop, hof, mstate), (key, gen) = carry, xs
         k_var, k_sel = jax.random.split(key)
         off = var_or(k_var, pop, toolbox, lambda_, cxpb, mutpb)
         nevals = jnp.sum(~off.valid)
@@ -232,9 +300,20 @@ def ea_mu_plus_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
         new_pop = gather(pool, idx)
         new_hof = hof_update(hof, off) if hof is not None else None
         rec = {"nevals": nevals, **_maybe_stats(stats, new_pop)}
-        return (new_pop, new_hof), rec
+        if tel is None:
+            return (new_pop, new_hof), rec
+        mstate = _tel_measure(tel, mstate, nevals, new_pop, gen)
+        return (new_pop, new_hof, mstate), (rec, mstate)
 
-    (pop, hof), records = lax.scan(step, (pop, hof), jax.random.split(kscan, ngen))
+    if tel is None:
+        (pop, hof), records = lax.scan(step, (pop, hof),
+                                       jax.random.split(kscan, ngen))
+    else:
+        (pop, hof, _), (records, mrows) = lax.scan(
+            step, (pop, hof, mstate0),
+            (jax.random.split(kscan, ngen), jnp.arange(1, ngen + 1)))
+        tel.end_run("ea_mu_plus_lambda", stacked_meter=mrows,
+                    initial=mstate0, ngen=ngen)
     logbook = _build_logbook(record0, records, stats)
     if verbose:
         print(logbook.stream)
@@ -245,11 +324,13 @@ def ea_mu_comma_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
                        lambda_: int, cxpb: float, mutpb: float, ngen: int,
                        stats: Optional[Statistics] = None,
                        halloffame_size: int = 0, verbose: bool = False,
+                       telemetry=None,
                        ) -> Tuple[Population, Logbook, Optional[HallOfFame]]:
     """(μ, λ) evolution (algorithms.py:340-437): only offspring survive."""
     assert lambda_ >= mu, "lambda must be greater or equal to mu."
     assert cxpb + mutpb <= 1.0, (
         "The sum of the crossover and mutation probabilities must be <= 1.0.")
+    tel = telemetry
     kscan = key
     nevals0 = jnp.sum(~pop.valid)  # like the reference's len(invalid_ind)
     pop = evaluate_invalid(pop, toolbox.evaluate)
@@ -257,9 +338,18 @@ def ea_mu_comma_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
     if hof is not None:
         hof = hof_update(hof, pop)
     record0 = {"nevals": nevals0, **_maybe_stats(stats, pop)}
+    if tel is not None:
+        tel.begin_run("ea_mu_comma_lambda", toolbox, declare=_tel_declare,
+                      ngen=ngen, mu=mu, lambda_=lambda_, cxpb=cxpb,
+                      mutpb=mutpb)
+        mstate0 = _tel_measure(tel, tel.meter.init(), nevals0, pop,
+                               jnp.int32(0))
 
-    def step(carry, key):
-        pop, hof = carry
+    def step(carry, xs):
+        if tel is None:
+            (pop, hof), key = carry, xs
+        else:
+            (pop, hof, mstate), (key, gen) = carry, xs
         k_var, k_sel = jax.random.split(key)
         off = var_or(k_var, pop, toolbox, lambda_, cxpb, mutpb)
         nevals = jnp.sum(~off.valid)
@@ -268,9 +358,20 @@ def ea_mu_comma_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
         new_pop = gather(off, idx)
         new_hof = hof_update(hof, off) if hof is not None else None
         rec = {"nevals": nevals, **_maybe_stats(stats, new_pop)}
-        return (new_pop, new_hof), rec
+        if tel is None:
+            return (new_pop, new_hof), rec
+        mstate = _tel_measure(tel, mstate, nevals, new_pop, gen)
+        return (new_pop, new_hof, mstate), (rec, mstate)
 
-    (pop, hof), records = lax.scan(step, (pop, hof), jax.random.split(kscan, ngen))
+    if tel is None:
+        (pop, hof), records = lax.scan(step, (pop, hof),
+                                       jax.random.split(kscan, ngen))
+    else:
+        (pop, hof, _), (records, mrows) = lax.scan(
+            step, (pop, hof, mstate0),
+            (jax.random.split(kscan, ngen), jnp.arange(1, ngen + 1)))
+        tel.end_run("ea_mu_comma_lambda", stacked_meter=mrows,
+                    initial=mstate0, ngen=ngen)
     logbook = _build_logbook(record0, records, stats)
     if verbose:
         print(logbook.stream)
@@ -281,6 +382,7 @@ def ea_generate_update(key: jax.Array, state: Any, toolbox, ngen: int,
                        spec: FitnessSpec,
                        stats: Optional[Statistics] = None,
                        halloffame_size: int = 0, verbose: bool = False,
+                       telemetry=None,
                        ) -> Tuple[Any, Logbook, Optional[HallOfFame]]:
     """Ask-tell loop (algorithms.py:440-503) driving CMA-ES/PBIL/EMNA-style
     strategies:
@@ -304,9 +406,17 @@ def ea_generate_update(key: jax.Array, state: Any, toolbox, ngen: int,
         spec=spec,
     )
     hof = hof_init(halloffame_size, template) if halloffame_size else None
+    tel = telemetry
+    if tel is not None:
+        tel.begin_run("ea_generate_update", toolbox, declare=_tel_declare,
+                      ngen=ngen, lambda_=lam)
+        mstate0 = tel.meter.init()
 
-    def step(carry, key):
-        state, hof = carry
+    def step(carry, xs):
+        if tel is None:
+            (state, hof), key = carry, xs
+        else:
+            (state, hof, mstate), (key, gen) = carry, xs
         genomes = toolbox.generate(key, state)
         values = _as2d(toolbox.evaluate(genomes))
         pop = Population(
@@ -315,9 +425,27 @@ def ea_generate_update(key: jax.Array, state: Any, toolbox, ngen: int,
         new_state = toolbox.update(state, genomes, values)
         new_hof = hof_update(hof, pop) if hof is not None else None
         rec = {"nevals": jnp.asarray(lam), **_maybe_stats(stats, pop)}
-        return (new_state, new_hof), rec
+        if tel is None:
+            return (new_state, new_hof), rec
+        m = tel.meter
+        w0 = pop.wvalues[:, 0]
+        mstate = m.inc(mstate, "nevals", lam)
+        mstate = m.set(mstate, "best", jnp.max(w0))
+        mstate = m.set(mstate, "mean", jnp.mean(w0))
+        mstate = m.set(mstate, "evaluated_frac", 1.0)
+        mstate = tel.apply_probe(mstate, pop=pop, state=new_state)
+        tel.live(mstate, gen)
+        return (new_state, new_hof, mstate), (rec, mstate)
 
-    (state, hof), records = lax.scan(step, (state, hof), jax.random.split(key, ngen))
+    if tel is None:
+        (state, hof), records = lax.scan(step, (state, hof),
+                                         jax.random.split(key, ngen))
+    else:
+        (state, hof, _), (records, mrows) = lax.scan(
+            step, (state, hof, mstate0),
+            (jax.random.split(key, ngen), jnp.arange(ngen)))
+        tel.end_run("ea_generate_update", stacked_meter=mrows, gen0=0,
+                    ngen=ngen)
     body = logbook_from_records(records)
     logbook = Logbook()
     logbook.header = ["gen", "nevals"] + (list(stats.fields) if stats else [])

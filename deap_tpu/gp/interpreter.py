@@ -224,6 +224,12 @@ def _cached_factory(pset: PrimitiveSet, key, build: Callable) -> Callable:
             del entry[k]
         fn = build()
         entry[full_key] = fn
+        # an interpreter rebuild invalidates downstream jax.jit caches —
+        # exactly the silent-recompile trigger the telemetry journal
+        # exists to surface; no-op unless a journal is open
+        from deap_tpu.telemetry.journal import broadcast
+        broadcast("gp_interpreter_build", key=repr(full_key),
+                  n_stale_evicted=len(stale))
     return fn
 
 

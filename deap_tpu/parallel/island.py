@@ -28,6 +28,7 @@ from deap_tpu.core.fitness import FitnessSpec
 from deap_tpu.core.population import Population, gather, init_population
 from deap_tpu.ops.selection import sel_best
 from deap_tpu.parallel.mesh import axis_size, shard_map
+from deap_tpu.support.profiling import span
 
 IslandState = Population  # demes stacked on the leading axis
 
@@ -74,7 +75,8 @@ def _migrate_sharded(key, pops, k, selection, axis_name):
         # deme 0 gets the previous device's deme m-1 over the ring.
         n = axis_size(axis_name)
         perm = [(i, (i + 1) % n) for i in range(n)]
-        incoming0 = lax.ppermute(rows[-1], axis_name, perm)
+        with span("island/ppermute"):
+            incoming0 = lax.ppermute(rows[-1], axis_name, perm)
         return jnp.concatenate([incoming0[None], rows[:-1]], axis=0)
 
     def put_rows(a, rows):
@@ -93,11 +95,20 @@ def _migrate_sharded(key, pops, k, selection, axis_name):
 def make_island_step(toolbox, cxpb: float, mutpb: float, freq: int,
                      mig_k: int, mesh: Optional[Mesh] = None,
                      axis_name: str = "island",
-                     selection: Callable = sel_best):
+                     selection: Callable = sel_best,
+                     telemetry=None):
     """Build ``step(key, pops) -> pops``: ``freq`` local generations then
     one ring migration (the reference's FREQ-generation epoch,
     onemax_island_scoop.py:64-67). Jit-compatible; pass a ``mesh`` to run
     each deme on its own mesh slice.
+
+    With ``telemetry`` (a :class:`deap_tpu.telemetry.RunTelemetry`) the
+    returned step is ``step(key, pops, mstate) -> (pops, mstate)``: a
+    Meter state rides the same jit'd program (epoch counters, migrant
+    counter, cross-island best/mean gauges — still zero host round
+    trips). Build the initial state with ``telemetry.meter.init()``
+    *after* this call (declaration happens here), and journal epochs
+    via ``telemetry.journal.meter_rows`` or per-epoch events.
     """
 
     def epoch(key, pops, migrate):
@@ -114,16 +125,48 @@ def make_island_step(toolbox, cxpb: float, mutpb: float, freq: int,
         return migrate(k_mig, pops)
 
     if mesh is None:
-        return jax.jit(lambda key, pops: epoch(
-            key, pops, partial(_migrate_local, k=mig_k, selection=selection)))
+        base = lambda key, pops: epoch(
+            key, pops, partial(_migrate_local, k=mig_k, selection=selection))
+    else:
+        spec_sharded = P(axis_name)
 
-    spec_sharded = P(axis_name)
+        def sharded_epoch(key, pops):
+            return epoch(key, pops, lambda kk, pp: _migrate_sharded(
+                kk, pp, mig_k, selection, axis_name))
 
-    def sharded_epoch(key, pops):
-        return epoch(key, pops, lambda kk, pp: _migrate_sharded(
-            kk, pp, mig_k, selection, axis_name))
+        base = shard_map(
+            sharded_epoch, mesh=mesh,
+            in_specs=(P(), spec_sharded), out_specs=spec_sharded)
 
-    mapped = shard_map(
-        sharded_epoch, mesh=mesh,
-        in_specs=(P(), spec_sharded), out_specs=spec_sharded)
-    return jax.jit(mapped)
+    if telemetry is None:
+        return jax.jit(base)
+
+    meter = telemetry.meter
+    meter.counter("epochs")
+    meter.counter("generations")
+    meter.counter("migrants")
+    meter.gauge("best")
+    meter.gauge("mean")
+    if telemetry.probe is not None and hasattr(telemetry.probe, "declare"):
+        telemetry.probe.declare(meter)
+
+    def instrumented(key, pops, mstate):
+        # instrumentation reads the epoch's *output* on the full stacked
+        # tensor, outside shard_map but inside the same jit — one
+        # compiled program, no host round trips, and the evolutionary
+        # computation itself is byte-for-byte the uninstrumented one
+        pops = base(key, pops)
+        w0 = jnp.where(pops.valid,
+                       (pops.fitness * pops.spec.warray)[..., 0], -jnp.inf)
+        n_islands = pops.valid.shape[0]
+        mstate = meter.inc(mstate, "epochs")
+        mstate = meter.inc(mstate, "generations", freq)
+        mstate = meter.inc(mstate, "migrants", mig_k * n_islands)
+        mstate = meter.set(mstate, "best", jnp.max(w0))
+        mstate = meter.set(mstate, "mean", jnp.mean(
+            jnp.where(pops.valid, w0, 0.0)) / jnp.maximum(
+                jnp.mean(pops.valid.astype(jnp.float32)), 1e-9))
+        mstate = telemetry.apply_probe(mstate, pop=pops)
+        return pops, mstate
+
+    return jax.jit(instrumented)

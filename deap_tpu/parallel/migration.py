@@ -29,6 +29,7 @@ from jax import lax
 from deap_tpu.core.population import Population, gather
 from deap_tpu.ops.selection import sel_best, sel_worst
 from deap_tpu.parallel.mesh import axis_size
+from deap_tpu.support.profiling import span
 
 
 def _emigrant_idx(key, pop, k, selection):
@@ -129,8 +130,9 @@ def mig_ring_collective(key: jax.Array, pop: Population, k: int,
                 "migarray must be a permutation of slice indices "
                 f"0..{n - 1} (each exactly once); got {dests}")
         perm = list(enumerate(dests))
-    incoming = jax.tree_util.tree_map(
-        lambda x: lax.ppermute(x, axis_name, perm), emigrants)
+    with span("migration/ppermute"):
+        incoming = jax.tree_util.tree_map(
+            lambda x: lax.ppermute(x, axis_name, perm), emigrants)
 
     genomes = jax.tree_util.tree_map(
         lambda a, r: a.at[rep_idx].set(r), pop.genomes, incoming.genomes)

@@ -140,6 +140,17 @@ class Strategy:
         arz = jax.random.normal(key, (self.lambda_, self.dim))
         return state.centroid + state.sigma * arz @ state.BD.T
 
+    #: gauges published to a telemetry Meter (telemetry.strategy_probe)
+    metric_names = ("sigma", "cond", "ps_norm")
+
+    def metrics(self, state: CMAState) -> dict:
+        """Adaptation health as scalars, evaluable inside the scanned
+        step: step size, covariance condition number (diverging cond is
+        the canonical CMA-ES degeneracy signal), and the step-size
+        evolution-path norm."""
+        return {"sigma": state.sigma, "cond": state.cond,
+                "ps_norm": jnp.linalg.norm(state.ps)}
+
     def update(self, state: CMAState, genomes: jnp.ndarray,
                values: jnp.ndarray) -> CMAState:
         """Covariance/step-size update from the evaluated offspring
@@ -246,6 +257,14 @@ class StrategyOnePlusLambda:
         """λ samples ~ parent + σ · z·Aᵀ (cma.py:278-289)."""
         arz = jax.random.normal(key, (self.lambda_, self.dim))
         return state.parent + state.sigma * arz @ state.A.T
+
+    #: gauges published to a telemetry Meter (telemetry.strategy_probe)
+    metric_names = ("sigma", "psucc")
+
+    def metrics(self, state: OnePlusLambdaState) -> dict:
+        """Step size and the smoothed success rate the 1/5th-style rule
+        steers on — the two scalars that explain (1+λ) stagnation."""
+        return {"sigma": state.sigma, "psucc": state.psucc}
 
     def update(self, state: OnePlusLambdaState, genomes: jnp.ndarray,
                values: jnp.ndarray) -> OnePlusLambdaState:
@@ -417,6 +436,16 @@ class StrategyMultiObjective:
         x = (state.x[parent] + state.sigmas[parent, None]
              * jnp.einsum("pij,pj->pi", state.A[parent], arz))
         return {"x": x, "parent": parent}
+
+    #: gauges published to a telemetry Meter (telemetry.strategy_probe)
+    metric_names = ("sigma_mean", "sigma_min", "psucc_mean")
+
+    def metrics(self, state: MOState) -> dict:
+        """Population-level adaptation health of the µ independent
+        (1+1) strategies."""
+        return {"sigma_mean": jnp.mean(state.sigmas),
+                "sigma_min": jnp.min(state.sigmas),
+                "psucc_mean": jnp.mean(state.psucc)}
 
     # ------------------------------------------------------------ update ----
 

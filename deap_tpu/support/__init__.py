@@ -9,7 +9,17 @@ from deap_tpu.support.history import (
     lineage_step,
     pair_parents,
 )
-from deap_tpu.support.profiling import annotate, sync, timed_generations, trace
+from deap_tpu.support.profiling import (
+    SpanRecorder,
+    annotate,
+    get_span_recorder,
+    set_span_recorder,
+    span,
+    sync,
+    timed_generations,
+    timed_phases,
+    trace,
+)
 from deap_tpu.support.checkpoint import (
     Checkpointer,
     restore_state,
@@ -31,8 +41,13 @@ __all__ = [
     "Lineage",
     "trace",
     "annotate",
+    "span",
     "sync",
+    "SpanRecorder",
+    "set_span_recorder",
+    "get_span_recorder",
     "timed_generations",
+    "timed_phases",
     "lineage_init",
     "lineage_step",
     "pair_parents",

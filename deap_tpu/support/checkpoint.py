@@ -59,6 +59,9 @@ def save_state(path: str, state: Any) -> None:
     with open(tmp, "wb") as f:
         pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
     os.replace(tmp, path)
+    # surface the write in any open run journal (no-op otherwise)
+    from deap_tpu.telemetry.journal import broadcast
+    broadcast("checkpoint", path=path, bytes=os.path.getsize(path))
 
 
 def restore_state(path: str) -> Any:

@@ -16,12 +16,12 @@ import numpy as np
 
 
 def _scalar(x):
+    """Collapse 0-d arrays to native Python scalars (so ``%g``
+    formatting and JSON serialisation see floats/ints, not numpy
+    types); pass everything else through unchanged."""
     a = np.asarray(x)
     if a.ndim == 0:
-        v = a.item()
-        if isinstance(v, float):
-            return v
-        return v
+        return a.item()
     return a
 
 
@@ -56,6 +56,15 @@ class Logbook(list):
         return tuple([entry.get(name, None) for entry in self] for name in names)
 
     def pop(self, index: int = 0):
+        """Remove and return entry ``index``, keeping ``stream``'s
+        not-yet-printed window consistent: only removing an entry that
+        was *already streamed* shifts the buffer index. Negative
+        indexes are normalised first — the raw comparison would treat
+        ``pop(-1)`` (usually an unstreamed tail entry) as
+        already-streamed and wrongly re-stream an old entry
+        (support.py:351-358 has the same latent bug)."""
+        if index < 0:
+            index += len(self)
         if self.buffindex > index:
             self.buffindex -= 1
         return super().pop(index)

@@ -30,12 +30,13 @@ from __future__ import annotations
 import contextlib
 import functools
 import time
-from typing import Any, Callable, Iterator, Tuple
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
 import jax
 
 __all__ = ["trace", "annotate", "span", "timed_generations",
-           "timed_phases", "sync"]
+           "timed_phases", "sync", "SpanRecorder", "set_span_recorder",
+           "get_span_recorder"]
 
 
 def trace(log_dir: str, **kwargs):
@@ -46,6 +47,83 @@ def trace(log_dir: str, **kwargs):
     return jax.profiler.trace(log_dir, **kwargs)
 
 
+class SpanRecorder:
+    """Host-side wall-time aggregation of :func:`span` blocks.
+
+    While installed (``with SpanRecorder() as rec:`` or
+    :func:`set_span_recorder`), every ``span(name)`` entry/exit is also
+    timed with ``time.perf_counter`` and accumulated per name —
+    count / total / mean / p50 / p99 / max. This is the
+    trace-independent fallback for the per-collective
+    ``genome_shard/*`` spans: when no xplane capture is possible (relay
+    down, headless CI), the recorder still yields numbers. Spans inside
+    jit-compiled code fire once per trace, so for compiled collectives
+    the recorded time is *trace* time — use :func:`timed_phases` to
+    attribute execution time; spans on host paths record true wall
+    time per call.
+
+    Aggregates feed the run journal
+    (``deap_tpu.telemetry.RunJournal.spans``). A bounded reservoir
+    (``max_samples`` per name) backs the percentiles; count/total stay
+    exact past the bound.
+    """
+
+    def __init__(self, max_samples: int = 4096):
+        self.max_samples = int(max_samples)
+        self._samples: Dict[str, list] = {}
+        self._count: Dict[str, int] = {}
+        self._total: Dict[str, float] = {}
+        self._prev: Optional["SpanRecorder"] = None
+
+    def record(self, name: str, seconds: float) -> None:
+        self._count[name] = self._count.get(name, 0) + 1
+        self._total[name] = self._total.get(name, 0.0) + seconds
+        bucket = self._samples.setdefault(name, [])
+        if len(bucket) < self.max_samples:
+            bucket.append(seconds)
+
+    def aggregates(self) -> Dict[str, Dict[str, float]]:
+        """``{name: {count, total_s, mean_s, p50_s, p99_s, max_s}}``."""
+        out: Dict[str, Dict[str, float]] = {}
+        for name, n in self._count.items():
+            total = self._total[name]
+            samples = sorted(self._samples.get(name, ()))
+            agg = {"count": n, "total_s": total, "mean_s": total / n}
+            if samples:
+                m = len(samples)
+                agg["p50_s"] = samples[(m - 1) // 2]
+                agg["p99_s"] = samples[min(m - 1, int(0.99 * (m - 1)))]
+                agg["max_s"] = samples[-1]
+            out[name] = agg
+        return out
+
+    def __enter__(self) -> "SpanRecorder":
+        self._prev = set_span_recorder(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        set_span_recorder(self._prev)
+        self._prev = None
+
+
+# The active recorder — one slot, module-global: span() is called from
+# inside shard_map bodies during tracing, where thread-locals tied to
+# the caller would be invisible.
+_RECORDER: list = [None]
+
+
+def set_span_recorder(rec: Optional[SpanRecorder]) -> Optional[SpanRecorder]:
+    """Install ``rec`` as the active span recorder (None disables);
+    returns the previous one so callers can restore it."""
+    prev = _RECORDER[0]
+    _RECORDER[0] = rec
+    return prev
+
+
+def get_span_recorder() -> Optional[SpanRecorder]:
+    return _RECORDER[0]
+
+
 @contextlib.contextmanager
 def span(name: str):
     """Inline named span — the context-manager form of :func:`annotate`
@@ -53,9 +131,19 @@ def span(name: str):
     ``shard_map`` body, one phase of a fused step). Device ops traced
     inside the block carry ``name`` as a scope in xplane captures, so
     per-collective time is attributable in XProf; metadata-only, never
-    changes the compiled program."""
-    with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
-        yield
+    changes the compiled program. When a :class:`SpanRecorder` is
+    installed the block is additionally wall-timed on the host."""
+    rec = _RECORDER[0]
+    if rec is None:
+        with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
+            yield
+        return
+    t0 = time.perf_counter()
+    try:
+        with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
+            yield
+    finally:
+        rec.record(name, time.perf_counter() - t0)
 
 
 def annotate(name: str) -> Callable:
@@ -75,11 +163,27 @@ def sync(tree: Any) -> Any:
     """Block until ``tree``'s arrays have materialised. On remote-
     attached TPU runtimes ``jax.block_until_ready`` can return before
     device execution finishes, so this additionally fetches one scalar
-    from the first array — cheap, and an actual completion barrier."""
+    from the first fetchable array — cheap, and an actual completion
+    barrier.
+
+    Robust to awkward trees: non-array leaves (python scalars, None
+    from an optional carry) and zero-size arrays are skipped rather
+    than raveled; committed / sharded arrays fetch a single element of
+    their first addressable shard so the barrier never forces a
+    cross-device gather of the whole array.
+    """
     jax.block_until_ready(tree)
-    leaves = jax.tree_util.tree_leaves(tree)
-    if leaves:
-        jax.device_get(jax.numpy.ravel(leaves[0])[:1])
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if not isinstance(leaf, jax.Array) or leaf.size == 0:
+            continue
+        try:
+            shard = leaf.addressable_shards[0].data
+            jax.device_get(jax.numpy.ravel(shard)[:1])
+        except (AttributeError, IndexError, TypeError):
+            # no addressable shards on this runtime (or an exotic array
+            # type): fall back to raveling the array itself
+            jax.device_get(jax.numpy.ravel(leaf)[:1])
+        break
     return tree
 
 

@@ -1,0 +1,45 @@
+"""Run-journal telemetry — the observability subsystem.
+
+Three planes (see docs/advanced/telemetry.md):
+
+1. **In-scan metrics** (:mod:`~deap_tpu.telemetry.meter`): a
+   :class:`Meter` of counters/gauges/histograms whose state rides the
+   jit'd generation scans as auxiliary carry and comes back as stacked
+   per-generation arrays — zero host round trips, plus an opt-in
+   ``jax.debug.callback`` streaming emitter.
+2. **Host events** (:mod:`~deap_tpu.telemetry.journal`): a JSONL
+   :class:`RunJournal` with run header (backend/device/toolbox
+   fingerprint), compile/**retrace** events via ``jax.monitoring``
+   listeners, subsystem events, and a final summary.
+3. **Span aggregation**: while a :class:`RunTelemetry` context is
+   active, ``support.profiling.span`` blocks aggregate host wall time
+   per name (count/total/p50/p99) into the journal — the per-collective
+   ``genome_shard/*`` spans yield numbers even with no xplane capture.
+
+The reference's only telemetry is the ``nevals`` logbook column; none
+of the JAX-native EC frameworks (evosax, Kozax — PAPERS.md) emit
+structured machine-readable run telemetry either. This subsystem is
+opt-in everywhere and changes no computed result when enabled.
+"""
+
+from deap_tpu.telemetry.journal import (
+    RunJournal,
+    broadcast,
+    environment_fingerprint,
+    read_journal,
+    toolbox_fingerprint,
+)
+from deap_tpu.telemetry.meter import Meter, MeterState
+from deap_tpu.telemetry.run import RunTelemetry, strategy_probe
+
+__all__ = [
+    "Meter",
+    "MeterState",
+    "RunJournal",
+    "RunTelemetry",
+    "broadcast",
+    "environment_fingerprint",
+    "read_journal",
+    "strategy_probe",
+    "toolbox_fingerprint",
+]

@@ -1,0 +1,250 @@
+"""Run journal — structured JSONL host events for a whole run.
+
+The host-events plane of the telemetry subsystem: one append-only JSONL
+file per run, each line ``{"t": <secs since open>, "kind": ..., ...}``.
+Kinds written by this module and the algorithm integrations:
+
+- ``header`` — backend / device / jax-version / process fingerprint,
+  plus an optional toolbox fingerprint (which operators, bound args).
+- ``run_start`` / ``run_end`` — one per algorithm invocation.
+- ``compile`` / ``retrace`` — every XLA backend compile observed via
+  ``jax.monitoring`` listeners. Compiles after :meth:`RunJournal.
+  mark_steady` are journaled as ``retrace``: the silent-recompile
+  failure mode (a shape or closure change re-triggering compilation
+  mid-run) becomes a visible, machine-readable event instead of an
+  unexplained wall-time cliff.
+- ``meter`` — per-generation metric rows decoded from a
+  :class:`~deap_tpu.telemetry.meter.Meter`'s stacked scan output.
+- ``span`` — per-name wall-time aggregates from a
+  :class:`~deap_tpu.support.profiling.SpanRecorder`.
+- ``event`` kinds from subsystems (checkpoint, migration, eval-batch,
+  GP interpreter cache misses) via :meth:`RunJournal.event` or the
+  module-level :func:`broadcast` (which reaches every open journal —
+  used by code that must not hold a journal reference).
+- ``summary`` — final roll-up written on close.
+
+``jax.monitoring`` only supports registering listeners (there is no
+unregister), so one process-wide listener pair is installed lazily and
+dispatches to the set of currently-open journals.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["RunJournal", "read_journal", "broadcast",
+           "toolbox_fingerprint", "environment_fingerprint"]
+
+_LOCK = threading.Lock()
+_ACTIVE: List["RunJournal"] = []
+_LISTENERS_INSTALLED = [False]
+
+#: the jax.monitoring duration event that marks one XLA backend compile
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def _on_duration(event: str, duration: float, **_kw) -> None:
+    if event != _COMPILE_EVENT:
+        return
+    with _LOCK:
+        journals = list(_ACTIVE)
+    for j in journals:
+        j._compile_observed(duration)
+
+
+def _install_listeners() -> bool:
+    if _LISTENERS_INSTALLED[0]:
+        return True
+    try:
+        import jax.monitoring
+        jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:
+        return False
+    _LISTENERS_INSTALLED[0] = True
+    return True
+
+
+def broadcast(kind: str, **payload: Any) -> None:
+    """Write an event into every currently-open journal. For subsystem
+    code (GP interpreter cache, checkpointing) that should surface
+    events when a journal happens to be active but must not depend on
+    one being passed in."""
+    with _LOCK:
+        journals = list(_ACTIVE)
+    for j in journals:
+        j.event(kind, **payload)
+
+
+def toolbox_fingerprint(toolbox: Any) -> Dict[str, Any]:
+    """Which operators a toolbox binds, and a stable digest of the
+    configuration — so journals from different runs are comparable
+    ("same toolbox, different wall time" vs "different toolbox")."""
+    aliases: Dict[str, str] = {}
+    for name, val in sorted(vars(toolbox).items()):
+        func = getattr(val, "func", val)
+        bound = ""
+        args = getattr(val, "args", ())
+        kwargs = getattr(val, "keywords", {}) or {}
+        if args or kwargs:
+            bound = repr((args, tuple(sorted(kwargs.items()))))
+        aliases[name] = "%s.%s%s" % (
+            getattr(func, "__module__", "?"),
+            getattr(func, "__name__", "?"), bound)
+    digest = hashlib.sha1(
+        json.dumps(aliases, sort_keys=True).encode()).hexdigest()[:12]
+    return {"aliases": aliases, "digest": digest}
+
+
+def environment_fingerprint(init_backend: bool = True) -> Dict[str, Any]:
+    """jax version / backend / device kind+count — the row fingerprint
+    that distinguishes cached-replay from fresh-capture benchmark rows.
+    ``init_backend=False`` skips anything that would initialise the XLA
+    client (single-client TPU runtimes must not be attached twice)."""
+    import jax
+
+    fp: Dict[str, Any] = {"jax": jax.__version__}
+    if not init_backend:
+        return fp
+    try:
+        devices = jax.devices()
+        fp["backend"] = jax.default_backend()
+        fp["device_kind"] = devices[0].device_kind
+        fp["n_devices"] = len(devices)
+        fp["process_count"] = jax.process_count()
+    except Exception as e:  # backend failed to initialise: still a journal
+        fp["backend_error"] = repr(e)[:200]
+    return fp
+
+
+class RunJournal:
+    """Append-only JSONL journal for one run. Usable directly or (more
+    commonly) through :class:`deap_tpu.telemetry.RunTelemetry`::
+
+        with RunJournal("run.jsonl") as journal:
+            journal.header(toolbox=tb)
+            ... run ...
+            journal.summary(gens=100)
+    """
+
+    def __init__(self, path: str, run_id: Optional[str] = None):
+        self.path = str(path)
+        self.run_id = run_id or hex(int(time.time() * 1e6))[2:]
+        self._t0 = time.time()
+        self._fh = open(self.path, "w")
+        self._steady: Optional[str] = None
+        self.n_compiles = 0
+        self.n_retraces = 0
+        self._closed = False
+        self._monitoring = _install_listeners()
+        with _LOCK:
+            _ACTIVE.append(self)
+
+    # --------------------------------------------------------- plumbing ----
+
+    def _write(self, kind: str, payload: Dict[str, Any]) -> None:
+        if self._closed:
+            return
+        line = {"t": round(time.time() - self._t0, 6), "kind": kind}
+        line.update(payload)
+        self._fh.write(json.dumps(line) + "\n")
+        self._fh.flush()
+
+    # ----------------------------------------------------------- events ----
+
+    def header(self, toolbox: Any = None, init_backend: bool = True,
+               **extra: Any) -> None:
+        payload: Dict[str, Any] = {
+            "run_id": self.run_id,
+            "env": environment_fingerprint(init_backend),
+            "monitoring": self._monitoring,
+        }
+        if toolbox is not None:
+            payload["toolbox"] = toolbox_fingerprint(toolbox)
+        payload.update(extra)
+        self._write("header", payload)
+
+    def event(self, kind: str, **payload: Any) -> None:
+        self._write(kind, payload)
+
+    def _compile_observed(self, duration: float) -> None:
+        self.n_compiles += 1
+        if self._steady is None:
+            self._write("compile", {"dur_s": round(duration, 6),
+                                    "seq": self.n_compiles})
+        else:
+            self.n_retraces += 1
+            self._write("retrace", {"dur_s": round(duration, 6),
+                                    "seq": self.n_compiles,
+                                    "after": self._steady})
+
+    def mark_steady(self, label: str = "") -> None:
+        """Declare compilation finished: every backend compile observed
+        after this point is journaled as a ``retrace`` — the silent
+        recompile the in-scan design is supposed to make impossible.
+        Algorithm integrations call this when their first instrumented
+        run completes."""
+        if self._steady is None:
+            self._steady = label or "steady"
+            self._write("steady", {"label": self._steady,
+                                   "n_compiles": self.n_compiles})
+
+    def meter_rows(self, meter: Any, stacked: Any, gen0: int = 1,
+                   initial: Any = None) -> None:
+        """Write per-generation ``meter`` rows from a scan's stacked
+        meter output; ``initial`` (the pre-scan state) becomes the
+        ``gen0 - 1`` row."""
+        if initial is not None:
+            self._write("meter", {"gen": gen0 - 1, **meter.row(initial)})
+        for i, row in enumerate(meter.rows(stacked)):
+            self._write("meter", {"gen": gen0 + i, **row})
+
+    def spans(self, recorder: Any) -> None:
+        """Write one ``span`` aggregate row per span name recorded by a
+        :class:`~deap_tpu.support.profiling.SpanRecorder`."""
+        for name, agg in sorted(recorder.aggregates().items()):
+            self._write("span", {"name": name, **{
+                k: (round(v, 9) if isinstance(v, float) else v)
+                for k, v in agg.items()}})
+
+    def summary(self, **payload: Any) -> None:
+        payload.setdefault("n_compiles", self.n_compiles)
+        payload.setdefault("n_retraces", self.n_retraces)
+        self._write("summary", payload)
+
+    # ---------------------------------------------------------- closing ----
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        with _LOCK:
+            if self in _ACTIVE:
+                _ACTIVE.remove(self)
+        self._closed = True
+        self._fh.close()
+
+    def __enter__(self) -> "RunJournal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_journal(path: str) -> List[Dict[str, Any]]:
+    """Parse a journal back into a list of event dicts (malformed lines
+    are skipped — a crashed writer must not make the journal
+    unreadable)."""
+    out: List[Dict[str, Any]] = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out

@@ -1,0 +1,176 @@
+"""In-scan metrics plane — counters/gauges/histograms as scan carry.
+
+The reference's only run telemetry is the per-generation ``nevals``
+logbook column (deap/algorithms.py:158,185). Our loops compile whole
+runs into one ``lax.scan``, so anything worth observing must ride the
+scan as data: a :class:`Meter` declares a fixed set of metrics, its
+``init()`` state is a flat dict-of-arrays pytree threaded as auxiliary
+carry, and pure functional updates (``inc``/``set``/``observe``) run
+on device inside the step. Emitting the state as the scan's stacked
+``y`` output yields per-generation metric rows with **zero host round
+trips**; an opt-in ``jax.debug.callback`` emitter streams live rows
+for long runs (see :meth:`Meter.stream`).
+
+Telemetry must never change computed results: meter updates read
+population state but touch no RNG keys and feed nothing back into the
+evolutionary computation (pinned by
+``tests/test_telemetry.py::test_meter_carry_bit_identical``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Mapping, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Meter", "MeterState"]
+
+MeterState = Dict[str, jnp.ndarray]
+
+_KINDS = ("counter", "gauge", "histogram")
+
+
+class Meter:
+    """Declarative metric registry with a pytree state.
+
+    Declare every metric *before* ``init()`` — the state is scan carry,
+    so its structure is fixed at trace time::
+
+        meter = Meter()
+        meter.counter("nevals")
+        meter.gauge("best")
+        meter.histogram("fitness", lo=0.0, hi=100.0, bins=16)
+        state = meter.init()
+        # inside the scanned step (pure, on device):
+        state = meter.inc(state, "nevals", jnp.sum(~pop.valid))
+        state = meter.set(state, "best", jnp.max(pop.wvalues[:, 0]))
+        state = meter.observe(state, "fitness", pop.wvalues[:, 0])
+
+    Counters are monotone and cumulative across generations; gauges
+    hold the last value set; histograms accumulate bucket counts over
+    a fixed ``[lo, hi)`` range (under/overflow clamps into the edge
+    buckets, so totals stay conserved).
+    """
+
+    def __init__(self):
+        self._specs: Dict[str, dict] = {}
+
+    # ------------------------------------------------------- declaration ----
+
+    def _declare(self, name: str, **spec) -> None:
+        prev = self._specs.get(name)
+        if prev is not None:
+            if prev != spec:
+                raise ValueError(
+                    f"metric {name!r} re-declared with a different spec: "
+                    f"{prev} vs {spec}")
+            return  # idempotent: algorithms and user probes may both declare
+        self._specs[name] = spec
+
+    def counter(self, name: str, shape: Sequence[int] = (),
+                dtype=jnp.int32) -> None:
+        self._declare(name, kind="counter", shape=tuple(shape),
+                      dtype=jnp.dtype(dtype).name)
+
+    def gauge(self, name: str, shape: Sequence[int] = (),
+              dtype=jnp.float32) -> None:
+        self._declare(name, kind="gauge", shape=tuple(shape),
+                      dtype=jnp.dtype(dtype).name)
+
+    def histogram(self, name: str, lo: float, hi: float,
+                  bins: int = 16) -> None:
+        if not hi > lo:
+            raise ValueError(f"histogram {name!r}: need hi > lo, "
+                             f"got [{lo}, {hi})")
+        self._declare(name, kind="histogram", lo=float(lo), hi=float(hi),
+                      bins=int(bins))
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        return tuple(self._specs)
+
+    def spec(self, name: str) -> dict:
+        return dict(self._specs[name])
+
+    # ------------------------------------------------------------- state ----
+
+    def init(self) -> MeterState:
+        state: MeterState = {}
+        for name, s in self._specs.items():
+            if s["kind"] == "histogram":
+                state[name] = jnp.zeros((s["bins"],), jnp.int32)
+            else:
+                state[name] = jnp.zeros(s["shape"], jnp.dtype(s["dtype"]))
+        return state
+
+    def _check(self, name: str, kind: str) -> dict:
+        s = self._specs.get(name)
+        if s is None:
+            raise KeyError(f"metric {name!r} was never declared "
+                           f"(known: {sorted(self._specs)})")
+        if s["kind"] != kind:
+            raise TypeError(f"metric {name!r} is a {s['kind']}, "
+                            f"not a {kind}")
+        return s
+
+    # --------------------------------------------------- in-scan updates ----
+
+    def inc(self, state: MeterState, name: str, value=1) -> MeterState:
+        s = self._check(name, "counter")
+        v = jnp.asarray(value, jnp.dtype(s["dtype"]))
+        return {**state, name: state[name] + v}
+
+    def set(self, state: MeterState, name: str, value) -> MeterState:
+        s = self._check(name, "gauge")
+        v = jnp.broadcast_to(
+            jnp.asarray(value, jnp.dtype(s["dtype"])), s["shape"])
+        return {**state, name: v}
+
+    def observe(self, state: MeterState, name: str, values,
+                mask=None) -> MeterState:
+        """Bucketize ``values`` into the histogram's counts; ``mask``
+        (same shape) drops rows without changing bucket geometry."""
+        s = self._check(name, "histogram")
+        v = jnp.ravel(jnp.asarray(values, jnp.float32))
+        bins, lo, hi = s["bins"], s["lo"], s["hi"]
+        idx = jnp.clip(
+            jnp.floor((v - lo) / (hi - lo) * bins), 0, bins - 1
+        ).astype(jnp.int32)
+        ones = jnp.ones_like(idx)
+        if mask is not None:
+            ones = jnp.where(jnp.ravel(mask), ones, 0)
+        return {**state, name: state[name].at[idx].add(ones)}
+
+    # --------------------------------------------------------- streaming ----
+
+    def stream(self, state: MeterState, gen, emit: Callable) -> None:
+        """Opt-in live tail: inside jit/scan, ship this generation's
+        state to the host ``emit(gen, row_dict)`` via
+        ``jax.debug.callback``. Unordered (does not serialise device
+        execution); the callback sees concrete numpy values."""
+        def _cb(gen, **st):
+            emit(int(gen), self.row(st))
+        jax.debug.callback(_cb, gen, **state)
+
+    # ----------------------------------------------------- host decoding ----
+
+    def row(self, state: Mapping[str, Any]) -> Dict[str, Any]:
+        """One state snapshot as a JSON-serialisable dict."""
+        out: Dict[str, Any] = {}
+        for name, s in self._specs.items():
+            a = np.asarray(state[name])
+            if a.ndim == 0:
+                out[name] = a.item()
+            else:
+                out[name] = a.tolist()
+        return out
+
+    def rows(self, stacked: Mapping[str, Any]) -> list:
+        """Decode a scan's stacked ``[ngen, ...]`` meter output into a
+        list of per-generation row dicts."""
+        arrs = {k: np.asarray(v) for k, v in stacked.items()}
+        ngen = next(iter(arrs.values())).shape[0] if arrs else 0
+        return [self.row({k: v[i] for k, v in arrs.items()})
+                for i in range(ngen)]

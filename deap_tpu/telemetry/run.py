@@ -1,0 +1,183 @@
+"""RunTelemetry — the façade the algorithm loops accept.
+
+Bundles the three telemetry planes behind one opt-in object:
+
+- a :class:`~deap_tpu.telemetry.meter.Meter` whose state the scanned
+  loops thread as auxiliary carry (in-scan metrics, zero host round
+  trips),
+- a :class:`~deap_tpu.telemetry.journal.RunJournal` receiving host
+  events (header, run_start/run_end, compile/retrace, meter rows,
+  span aggregates, summary),
+- a :class:`~deap_tpu.support.profiling.SpanRecorder` installed for
+  the duration of the context, so named spans (the per-collective
+  ``genome_shard/*`` instrumentation) aggregate host wall time even
+  when no xplane trace can be captured.
+
+Usage::
+
+    from deap_tpu.telemetry import RunTelemetry
+
+    with RunTelemetry("run.jsonl") as tel:
+        pop, logbook, hof = algorithms.ea_simple(
+            key, pop, toolbox, 0.5, 0.2, ngen=100, telemetry=tel)
+    # run.jsonl now holds the header, one meter row per generation,
+    # every compile/retrace, span aggregates and a summary.
+
+Enabling telemetry must not change computed results: the meter rides
+the scan as extra carry but feeds nothing back into the evolutionary
+computation (pinned bit-identical by ``tests/test_telemetry.py``).
+
+A ``probe`` extends the built-in instrumentation with caller metrics;
+it is a callable ``probe(meter, mstate, **ctx) -> mstate`` (ctx carries
+``pop=`` and, for ask-tell loops, ``state=``), optionally with a
+``declare(meter)`` method run before ``meter.init()`` — see
+:func:`strategy_probe` for the CMA-ES shaped one.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Callable, Optional
+
+from deap_tpu.support.profiling import SpanRecorder, set_span_recorder
+from deap_tpu.telemetry.journal import RunJournal
+from deap_tpu.telemetry.meter import Meter
+
+__all__ = ["RunTelemetry", "strategy_probe"]
+
+
+class RunTelemetry:
+    """One run's telemetry configuration + lifecycle.
+
+    :param journal: path to a JSONL file, or an existing
+        :class:`RunJournal` (shared journals let several runs — e.g. a
+        warmup and a measured run — land in one file, which is also how
+        retraces across runs become visible).
+    :param meter: a pre-declared :class:`Meter`; default a fresh one
+        (algorithm loops declare their built-in metrics on it).
+    :param probe: extra in-scan instrumentation (see module docstring).
+    :param stream: emit a live per-generation row via
+        ``jax.debug.callback`` (stderr tail + ``meter_live`` journal
+        events) — for watching long runs; costs a host callback per
+        generation, so off by default.
+    :param spans: install a :class:`SpanRecorder` while the context is
+        active (default True).
+    """
+
+    def __init__(self, journal, meter: Optional[Meter] = None,
+                 probe: Optional[Callable] = None, stream: bool = False,
+                 spans: bool = True, init_backend: bool = True):
+        if isinstance(journal, RunJournal):
+            self.journal = journal
+            self._owns_journal = False
+        else:
+            self.journal = RunJournal(journal)
+            self._owns_journal = True
+        self.meter = meter if meter is not None else Meter()
+        self.probe = probe
+        self.stream = bool(stream)
+        self.recorder: Optional[SpanRecorder] = (
+            SpanRecorder() if spans else None)
+        self._init_backend = init_backend
+        self._prev_recorder: Optional[SpanRecorder] = None
+        self._entered = False
+        self._header_written = False
+
+    # --------------------------------------------------------- lifecycle ----
+
+    def __enter__(self) -> "RunTelemetry":
+        self._entered = True
+        if self.recorder is not None:
+            self._prev_recorder = set_span_recorder(self.recorder)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self.recorder is not None:
+            set_span_recorder(self._prev_recorder)
+            self.journal.spans(self.recorder)
+        self.journal.summary()
+        if self._owns_journal:
+            self.journal.close()
+        self._entered = False
+
+    # ------------------------------------------------- algorithm helpers ----
+
+    def begin_run(self, algorithm: str, toolbox: Any = None,
+                  declare: Optional[Callable] = None, **params: Any) -> None:
+        """Called by an instrumented loop before ``meter.init()``:
+        writes the header (once) and a ``run_start`` event, and runs
+        declaration hooks (the loop's built-ins arrive via ``declare``,
+        the probe's via its ``declare`` method)."""
+        if not self._header_written:
+            self.journal.header(toolbox=toolbox,
+                                init_backend=self._init_backend)
+            self._header_written = True
+        if declare is not None:
+            declare(self.meter)
+        if self.probe is not None and hasattr(self.probe, "declare"):
+            self.probe.declare(self.meter)
+        self.journal.event("run_start", algorithm=algorithm, **params)
+
+    def apply_probe(self, mstate, **ctx):
+        """In-scan: run the user probe (if any) after the built-ins."""
+        if self.probe is None:
+            return mstate
+        return self.probe(self.meter, mstate, **ctx)
+
+    def live(self, mstate, gen) -> None:
+        """In-scan: opt-in streaming emitter (no-op unless ``stream``)."""
+        if not self.stream:
+            return
+        self.meter.stream(mstate, gen, self._emit_live)
+
+    def _emit_live(self, gen: int, row: dict) -> None:
+        self.journal.event("meter_live", gen=gen, **row)
+        print(f"[deap_tpu] gen {gen}: " + " ".join(
+            f"{k}={v}" for k, v in row.items()
+            if not isinstance(v, list)), file=sys.stderr)
+
+    def end_run(self, algorithm: str, stacked_meter=None, initial=None,
+                gen0: int = 1, **summary: Any) -> None:
+        """Called by an instrumented loop after its scan returns: decode
+        and journal the per-generation meter rows, write ``run_end``,
+        and mark the journal steady so later compiles surface as
+        retraces."""
+        if stacked_meter is not None:
+            self.journal.meter_rows(self.meter, stacked_meter, gen0=gen0,
+                                    initial=initial)
+        self.journal.event("run_end", algorithm=algorithm, **summary)
+        self.journal.mark_steady(algorithm)
+
+
+def strategy_probe(strategy: Any, prefix: str = "") -> Callable:
+    """A probe publishing an ask-tell strategy's internal state as
+    gauges — CMA-ES σ / condition number, (1+λ) success rate, … — for
+    any strategy exposing ``metric_names`` and ``metrics(state)``
+    (see ``deap_tpu.strategies.cma``)::
+
+        strat = cma.Strategy(centroid=[0.0] * 10, sigma=0.5)
+        with RunTelemetry("cma.jsonl",
+                          probe=strategy_probe(strat)) as tel:
+            state, logbook, _ = algorithms.ea_generate_update(
+                key, strat.initial_state(), toolbox, 50,
+                spec=strat.spec, telemetry=tel)
+    """
+    names = tuple(getattr(strategy, "metric_names", ()))
+    if not names:
+        raise TypeError(
+            f"{type(strategy).__name__} exposes no metric_names; "
+            "strategy_probe needs a telemetry-aware strategy")
+
+    class _Probe:
+        def declare(self, meter: Meter) -> None:
+            for n in names:
+                meter.gauge(prefix + n)
+
+        def __call__(self, meter: Meter, mstate, state=None, **_ctx):
+            if state is None:
+                return mstate
+            for k, v in strategy.metrics(state).items():
+                mstate = meter.set(mstate, prefix + k, v)
+            return mstate
+
+    return _Probe()

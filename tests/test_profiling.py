@@ -1,10 +1,14 @@
 """Profiler hooks (support.profiling): annotation transparency, sync
-barrier, and host-timed generation loop (SURVEY.md §5.1 parity)."""
+barrier, span wall-time recording, and host-timed generation loop
+(SURVEY.md §5.1 parity)."""
+
+import time
 
 import jax
 import jax.numpy as jnp
 
-from deap_tpu.support.profiling import (annotate, span, sync,
+from deap_tpu.support.profiling import (SpanRecorder, annotate,
+                                        get_span_recorder, span, sync,
                                         timed_generations, timed_phases)
 
 
@@ -57,6 +61,105 @@ def test_sync_returns_tree():
     tree = {"a": jnp.arange(4), "b": (jnp.ones(2),)}
     out = sync(tree)
     assert out is tree
+
+
+def test_sync_handles_empty_and_awkward_trees():
+    # empty tree, zero-size leading leaf, and non-array leaves must not
+    # crash the barrier (they used to: leaves[0] was raveled blindly)
+    assert sync({}) == {}
+    t = {"a": jnp.zeros((0, 3)), "b": jnp.arange(2)}
+    assert sync(t) is t
+    t2 = {"x": 3.5, "y": [1, 2], "z": None}
+    assert sync(t2) is t2
+    assert sync({"only_empty": jnp.zeros((0,))}) is not None
+
+
+def test_sync_handles_committed_and_sharded_arrays():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deap_tpu.parallel.mesh import population_mesh
+
+    committed = jax.device_put(jnp.arange(8), jax.devices("cpu")[1])
+    assert sync(committed) is committed
+    mesh = population_mesh(8, ("pop",))
+    sharded = jax.device_put(jnp.arange(64.0),
+                             NamedSharding(mesh, P("pop")))
+    assert sync({"s": sharded})["s"] is sharded
+
+
+def test_span_recorder_aggregates_and_uninstalls():
+    with SpanRecorder() as rec:
+        for _ in range(5):
+            with span("fast"):
+                pass
+        with span("slow"):
+            time.sleep(0.02)
+    agg = rec.aggregates()
+    assert agg["fast"]["count"] == 5
+    assert agg["slow"]["count"] == 1
+    assert agg["slow"]["total_s"] >= 0.015
+    assert set(agg["fast"]) >= {"count", "total_s", "mean_s", "p50_s",
+                                "p99_s", "max_s"}
+    assert agg["fast"]["p50_s"] <= agg["fast"]["p99_s"] <= agg["fast"]["max_s"]
+    # leaving the context uninstalls: later spans are not recorded
+    assert get_span_recorder() is None
+    with span("after"):
+        pass
+    assert "after" not in rec.aggregates()
+
+
+def test_span_recorder_records_inside_jit_trace():
+    # spans in compiled code fire once per trace — the recorder must
+    # capture that (trace-time attribution), and re-running the cached
+    # executable must not double-count
+    def f(x):
+        with span("jit/body"):
+            return x * 2.0
+
+    with SpanRecorder() as rec:
+        jf = jax.jit(f)
+        jf(jnp.float32(1.0))
+        jf(jnp.float32(2.0))  # cache hit: no new trace, no new sample
+    assert rec.aggregates()["jit/body"]["count"] == 1
+
+
+def test_span_recorder_semantics_transparent():
+    with SpanRecorder():
+        def f(x):
+            with span("s"):
+                return x + 1.0
+        assert float(jax.jit(f)(jnp.float32(1.0))) == 2.0
+
+
+def test_timed_phases_excludes_warmup_and_takes_min_of_reps():
+    calls = {"n": 0}
+
+    def thunk():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            time.sleep(0.2)   # the "compile" call: must not be timed
+        elif calls["n"] == 2:
+            time.sleep(0.05)  # slow rep: min-of-reps must discard it
+        return jnp.float32(1.0)
+
+    out = timed_phases({"p": thunk}, reps=2)
+    assert calls["n"] == 3  # 1 warmup + 2 timed reps
+    assert out["p"] < 0.045, (
+        "timed_phases must report the MIN rep, excluding the warmup "
+        f"(got {out['p']:.3f}s)")
+
+
+def test_timed_generations_times_each_step_individually():
+    sleeps = [0.0, 0.08, 0.0]
+
+    def step(x):
+        time.sleep(sleeps[int(x)])
+        return x + 1
+
+    dts = [dt for _, _, dt in timed_generations(step, jnp.int32(0), ngen=3)]
+    assert dts[1] >= 0.07, "slow generation must show in its own slot"
+    assert dts[0] < 0.07 and dts[2] < 0.07, (
+        "fast generations must not absorb the slow one's time")
 
 
 def test_timed_generations_progresses_state():

@@ -1,0 +1,105 @@
+"""Static drift-check: every collective issued from ``deap_tpu/parallel``
+must be lexically wrapped in a named profiling ``span(...)``.
+
+The per-collective spans are the only way cross-shard time stays
+attributable (xplane scopes when a trace is possible, SpanRecorder
+wall-time aggregates when it is not — the n=8 weak-scaling cliff
+investigation depends on them). A new collective added without a span
+would silently rot that coverage; this AST walk makes the omission a
+test failure instead.
+"""
+
+import ast
+import os
+
+import deap_tpu.parallel as parallel_pkg
+
+#: call names that issue (or dispatch to) a collective. ``collective``
+#: covers genome_shard's table-dispatched psum/pmean/pmax call site —
+#: the function reference lives in _COMBINE_COLLECTIVES, the call goes
+#: through a local name.
+COLLECTIVE_CALLS = {"psum", "pmean", "pmax", "ppermute", "all_gather",
+                    "all_to_all", "collective"}
+
+PARALLEL_DIR = os.path.dirname(os.path.abspath(parallel_pkg.__file__))
+
+
+def _call_name(node: ast.Call):
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _is_axis_size_idiom(node: ast.Call) -> bool:
+    """``psum(1, axis)`` is the mesh-metadata spelling of axis_size —
+    it constant-folds to the mesh shape and moves no data, so it is
+    exempt from the span requirement (parallel/mesh.py)."""
+    return (bool(node.args)
+            and isinstance(node.args[0], ast.Constant)
+            and node.args[0].value == 1)
+
+
+def _span_wrapped(node: ast.AST, parents: dict) -> bool:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.With, ast.AsyncWith)):
+            for item in cur.items:
+                ce = item.context_expr
+                if isinstance(ce, ast.Call) and _call_name(ce) == "span":
+                    return True
+        cur = parents.get(cur)
+    return False
+
+
+def _collective_calls(tree: ast.AST):
+    parents = {}
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            parents[child] = parent
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and _call_name(node) in COLLECTIVE_CALLS
+                and not _is_axis_size_idiom(node)):
+            yield node, parents
+
+
+def test_every_parallel_collective_is_span_wrapped():
+    violations = []
+    n_checked = 0
+    for fname in sorted(os.listdir(PARALLEL_DIR)):
+        if not fname.endswith(".py"):
+            continue
+        path = os.path.join(PARALLEL_DIR, fname)
+        with open(path) as fh:
+            tree = ast.parse(fh.read(), filename=path)
+        for node, parents in _collective_calls(tree):
+            n_checked += 1
+            if not _span_wrapped(node, parents):
+                violations.append(
+                    f"{fname}:{node.lineno}: {_call_name(node)}(...) "
+                    "outside any span(...) block")
+    # the check must actually be exercising call sites — an empty scan
+    # would pass vacuously if the detection logic rotted instead
+    assert n_checked >= 3, (
+        f"only {n_checked} collective call sites found under parallel/ "
+        "— the AST detection itself has drifted")
+    assert not violations, (
+        "collectives without a named profiling span (add `with "
+        "span(\"<module>/<collective>\"):` — see genome_shard.py):\n"
+        + "\n".join(violations))
+
+
+def test_genome_shard_span_names_cover_every_combine_mode():
+    """The span name table and the collective table live in one dict
+    (genome_shard._COMBINE_COLLECTIVES) precisely so they cannot drift;
+    pin that the names stay the documented ``genome_shard/<collective>``
+    scheme for every combine mode."""
+    from deap_tpu.parallel.genome_shard import _COMBINE_COLLECTIVES
+
+    assert set(_COMBINE_COLLECTIVES) == {"sum", "mean", "max"}
+    for mode, (cname, fn) in _COMBINE_COLLECTIVES.items():
+        assert cname in COLLECTIVE_CALLS
+        assert fn.__name__ == cname

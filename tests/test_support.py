@@ -71,6 +71,49 @@ def test_logbook_chapters_stream():
     assert "1" in first and "2" in second and "1" not in second.splitlines()[-1]
 
 
+def test_logbook_scalar_collapses_to_python_types():
+    # 0-d arrays must come back as native Python scalars (so "%g"
+    # formatting and JSON serialisation never see numpy types);
+    # n-d arrays pass through
+    from deap_tpu.support.logbook import _scalar
+
+    assert _scalar(np.float32(2.5)) == 2.5
+    assert isinstance(_scalar(np.float32(2.5)), float)
+    assert _scalar(np.int64(3)) == 3
+    assert isinstance(_scalar(np.int64(3)), int)
+    assert isinstance(_scalar(jnp.float32(1.5)), float)
+    arr = np.arange(3)
+    assert _scalar(arr) is arr
+
+
+def test_logbook_pop_zero_index_shifts_stream_window():
+    lb = Logbook()
+    lb.record(a=1)
+    lb.record(a=2)
+    _ = lb.stream          # both streamed; buffindex == 2
+    lb.pop(0)              # removed an already-streamed entry
+    assert lb.buffindex == 1
+    lb.record(a=3)
+    assert lb.stream.strip().splitlines()[-1].strip() == "3"
+
+
+def test_logbook_pop_negative_index_keeps_stream_window():
+    # pop(-1) removes the newest (not-yet-streamed) entry; the raw
+    # `buffindex > index` comparison treated every negative index as
+    # already-streamed and re-streamed an old entry
+    lb = Logbook()
+    for a in (1, 2, 3):
+        lb.record(a=a)
+    _ = lb.stream          # buffindex == 3
+    lb.record(a=4)
+    lb.pop(-1)             # drop the unstreamed a=4
+    assert lb.buffindex == 3
+    lb.record(a=5)
+    out = lb.stream
+    assert out.strip() == "5", (
+        f"already-streamed entries leaked back into stream: {out!r}")
+
+
 def test_hof_tracks_best_and_dedups():
     pop = _pop([3.0, 1.0, 3.0, 5.0],
                genomes=jnp.array([[1.0], [2.0], [1.0], [3.0]]))

@@ -1,0 +1,343 @@
+"""Run-journal telemetry subsystem (deap_tpu.telemetry).
+
+Pins the acceptance contract of ISSUE 2: per-generation meter rows in
+the JSONL journal, retrace events via jax.monitoring, per-span
+aggregates for every genome_shard/* collective — and, above all, that
+enabling telemetry changes no computed result (population/logbook
+arrays bit-identical)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deap_tpu import algorithms, ops
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import init_population
+from deap_tpu.core.toolbox import Toolbox
+from deap_tpu.telemetry import (
+    Meter,
+    RunJournal,
+    RunTelemetry,
+    read_journal,
+    strategy_probe,
+    toolbox_fingerprint,
+)
+
+
+def _onemax_toolbox():
+    tb = Toolbox()
+    tb.register("evaluate", lambda g: g.sum(-1).astype(jnp.float32))
+    tb.register("mate", ops.cx_two_point)
+    tb.register("mutate", ops.mut_flip_bit, indpb=0.05)
+    tb.register("select", ops.sel_tournament, tournsize=3)
+    return tb
+
+
+def _onemax_pop(key, n=64, length=32):
+    return init_population(key, n, ops.bernoulli_genome(length),
+                           FitnessSpec((1.0,)))
+
+
+# ================================================================ Meter ====
+
+def test_meter_counter_gauge_histogram_semantics():
+    m = Meter()
+    m.counter("n")
+    m.gauge("g")
+    m.histogram("h", lo=0.0, hi=10.0, bins=5)
+    s = m.init()
+    s = m.inc(s, "n", 3)
+    s = m.inc(s, "n")
+    s = m.set(s, "g", 2.5)
+    # values land in [lo,hi) buckets; out-of-range clamps to the edges
+    s = m.observe(s, "h", jnp.array([0.5, 1.0, 9.9, -3.0, 42.0]))
+    assert int(s["n"]) == 4
+    assert float(s["g"]) == 2.5
+    np.testing.assert_array_equal(np.asarray(s["h"]), [3, 0, 0, 0, 2])
+    # masked observe drops rows but keeps geometry
+    s = m.observe(s, "h", jnp.array([5.0, 5.0]),
+                  mask=jnp.array([True, False]))
+    np.testing.assert_array_equal(np.asarray(s["h"]), [3, 0, 1, 0, 2])
+
+
+def test_meter_declarations_idempotent_and_checked():
+    m = Meter()
+    m.counter("n")
+    m.counter("n")  # same spec: fine (algorithm + probe may both declare)
+    with pytest.raises(ValueError):
+        m.counter("n", dtype=jnp.int64)  # different spec: loud
+    with pytest.raises(KeyError):
+        m.inc(m.init(), "missing")
+    m.gauge("g")
+    with pytest.raises(TypeError):
+        m.inc(m.init(), "g")  # kind mismatch
+
+
+def test_meter_rows_decode_stacked_scan_output():
+    m = Meter()
+    m.counter("n")
+    m.gauge("g")
+
+    def step(s, x):
+        s = m.inc(s, "n")
+        s = m.set(s, "g", x)
+        return s, s
+
+    _, stacked = jax.lax.scan(step, m.init(), jnp.arange(3.0))
+    rows = m.rows(stacked)
+    assert [r["n"] for r in rows] == [1, 2, 3]
+    assert [r["g"] for r in rows] == [0.0, 1.0, 2.0]
+    assert json.dumps(rows)  # JSON-serialisable end to end
+
+
+# ===================================================== bit-identicality ====
+
+def test_meter_carry_bit_identical_across_loops(tmp_path):
+    """Enabling telemetry threads extra carry through every scanned
+    loop but must change no computed result — population and logbook
+    arrays bit-identical."""
+    tb = _onemax_toolbox()
+    pop0 = _onemax_pop(jax.random.key(1))
+    runs = {
+        "ea_simple": lambda tel: algorithms.ea_simple(
+            jax.random.key(2), pop0, tb, 0.5, 0.2, 8, halloffame_size=3,
+            telemetry=tel),
+        "ea_mu_plus_lambda": lambda tel: algorithms.ea_mu_plus_lambda(
+            jax.random.key(3), pop0, tb, mu=64, lambda_=64, cxpb=0.5,
+            mutpb=0.2, ngen=8, telemetry=tel),
+        "ea_mu_comma_lambda": lambda tel: algorithms.ea_mu_comma_lambda(
+            jax.random.key(4), pop0, tb, mu=64, lambda_=96, cxpb=0.5,
+            mutpb=0.2, ngen=8, telemetry=tel),
+    }
+    for name, run in runs.items():
+        base_pop, base_lb, base_hof = run(None)
+        with RunTelemetry(str(tmp_path / f"{name}.jsonl")) as tel:
+            tel_pop, tel_lb, tel_hof = run(tel)
+        np.testing.assert_array_equal(
+            np.asarray(base_pop.genomes), np.asarray(tel_pop.genomes),
+            err_msg=f"{name}: genomes drifted under telemetry")
+        np.testing.assert_array_equal(
+            np.asarray(base_pop.fitness), np.asarray(tel_pop.fitness),
+            err_msg=f"{name}: fitness drifted under telemetry")
+        assert base_lb.select("nevals") == tel_lb.select("nevals"), name
+        if base_hof is not None:
+            np.testing.assert_array_equal(
+                np.asarray(base_hof.fitness), np.asarray(tel_hof.fitness),
+                err_msg=f"{name}: hall of fame drifted under telemetry")
+
+
+# ========================================================= the journal ====
+
+def test_ea_simple_journal_acceptance(tmp_path):
+    """The OneMax acceptance run: meter rows for every generation,
+    header + run events, and >= 1 retrace event once a post-steady
+    shape change forces a recompile."""
+    tb = _onemax_toolbox()
+    path = str(tmp_path / "run.jsonl")
+    ngen = 10
+    with RunTelemetry(path) as tel:
+        pop, logbook, _ = algorithms.ea_simple(
+            jax.random.key(2), _onemax_pop(jax.random.key(1)), tb,
+            0.5, 0.2, ngen, telemetry=tel)
+        # second run, different population size: the silent-recompile
+        # failure mode — must surface as retrace events, not vanish
+        algorithms.ea_simple(
+            jax.random.key(5), _onemax_pop(jax.random.key(6), n=32), tb,
+            0.5, 0.2, 4, telemetry=tel)
+    events = read_journal(path)
+    kinds = [e["kind"] for e in events]
+    assert kinds.count("header") == 1
+    header = events[kinds.index("header")]
+    assert header["env"]["jax"] == jax.__version__
+    assert header["env"]["backend"] == "cpu"
+    assert "digest" in header["toolbox"]
+
+    meters = [e for e in events if e["kind"] == "meter"]
+    # run 1: gens 0..ngen, run 2: gens 0..4
+    assert [m["gen"] for m in meters[: ngen + 1]] == list(range(ngen + 1))
+    assert meters[0]["nevals"] == 64  # whole initial population
+    assert meters[ngen]["nevals"] >= meters[1]["nevals"]  # monotone
+    assert meters[ngen]["best"] == float(np.max(np.asarray(pop.fitness)))
+    for m in meters:
+        assert set(m) >= {"gen", "nevals", "best", "mean",
+                          "evaluated_frac"}
+
+    assert "steady" in kinds
+    retraces = [e for e in events if e["kind"] == "retrace"]
+    assert len(retraces) >= 1, "post-steady recompile must be journaled"
+    assert all(e["dur_s"] >= 0 for e in retraces)
+    assert kinds[-1] == "summary"
+    assert events[-1]["n_retraces"] == len(retraces)
+
+
+def test_island_genome_shard_journal_acceptance(tmp_path):
+    """The 8-island acceptance run (8 virtual CPU devices, see
+    conftest): per-epoch meter rows with the meter carried inside the
+    jit'd island step, plus span aggregates for every genome_shard/*
+    collective captured without any xplane trace."""
+    from deap_tpu.algorithms import evaluate_invalid
+    from deap_tpu.parallel import island_init, make_island_step
+    from deap_tpu.parallel.genome_shard import (genome_mesh,
+                                                make_sharded_evaluator,
+                                                shard_genomes)
+    from deap_tpu.parallel.mesh import population_mesh, shard_population
+
+    tb = _onemax_toolbox()
+    path = str(tmp_path / "island.jsonl")
+    with RunTelemetry(path) as tel:
+        tel.journal.header(toolbox=tb)
+        mesh = population_mesh(8, ("island",))
+        pops = island_init(jax.random.key(0), 8, 16,
+                           ops.bernoulli_genome(24), FitnessSpec((1.0,)))
+        pops = jax.vmap(lambda p: evaluate_invalid(p, tb.evaluate))(pops)
+        pops = shard_population(pops, mesh, "island")
+        step = make_island_step(tb, cxpb=0.5, mutpb=0.2, freq=2, mig_k=2,
+                                mesh=mesh, telemetry=tel)
+        mstate = tel.meter.init()
+        for epoch in range(3):
+            pops, mstate = step(jax.random.fold_in(jax.random.key(9), epoch),
+                                pops, mstate)
+            tel.journal.event("meter", gen=epoch,
+                              **tel.meter.row(mstate))
+        # the genome-sharded evaluator exercises every combine mode's
+        # collective under the active SpanRecorder
+        gmesh = genome_mesh(n_pop_shards=1, n_genome_shards=8)
+        g = jax.random.bernoulli(jax.random.key(5), 0.5, (16, 64))
+        for combine in ("sum", "mean", "max"):
+            ev = make_sharded_evaluator(
+                lambda s: s.sum(-1).astype(jnp.float32), gmesh,
+                combine=combine)
+            ev(shard_genomes(g, gmesh))
+
+    events = read_journal(path)
+    meters = [e for e in events if e["kind"] == "meter"]
+    assert len(meters) == 3
+    assert meters[-1]["epochs"] == 3
+    assert meters[-1]["generations"] == 6
+    assert meters[-1]["migrants"] == 3 * 2 * 8
+    assert meters[-1]["best"] <= 24.0 and meters[-1]["best"] > 0
+
+    spans = {e["name"]: e for e in events if e["kind"] == "span"}
+    for expected in ("genome_shard/partial_eval", "genome_shard/psum",
+                     "genome_shard/pmean", "genome_shard/pmax",
+                     "island/ppermute"):
+        assert expected in spans, f"missing span aggregate: {expected}"
+        agg = spans[expected]
+        assert agg["count"] >= 1
+        assert agg["total_s"] >= 0
+        assert set(agg) >= {"count", "total_s", "mean_s", "p50_s",
+                            "p99_s", "max_s"}
+
+
+def test_generate_update_strategy_probe(tmp_path):
+    """ea_generate_update + strategy_probe: CMA-ES internals (sigma,
+    condition number) ride the scan as gauges — and telemetry changes
+    nothing."""
+    from deap_tpu.strategies import cma
+
+    dim = 4
+    strat = cma.Strategy(centroid=[0.5] * dim, sigma=0.3, lambda_=8)
+    tb = Toolbox()
+    tb.register("evaluate", lambda x: jnp.sum(x ** 2, axis=-1))
+    tb.register("generate", strat.generate)
+    tb.register("update", strat.update)
+
+    base_state, base_lb, _ = algorithms.ea_generate_update(
+        jax.random.key(3), strat.initial_state(), tb, ngen=5,
+        spec=strat.spec)
+    path = str(tmp_path / "cma.jsonl")
+    with RunTelemetry(path, probe=strategy_probe(strat)) as tel:
+        tel_state, tel_lb, _ = algorithms.ea_generate_update(
+            jax.random.key(3), strat.initial_state(), tb, ngen=5,
+            spec=strat.spec, telemetry=tel)
+    np.testing.assert_array_equal(np.asarray(base_state.centroid),
+                                  np.asarray(tel_state.centroid))
+    np.testing.assert_array_equal(np.asarray(base_state.C),
+                                  np.asarray(tel_state.C))
+
+    meters = [e for e in read_journal(path) if e["kind"] == "meter"]
+    assert len(meters) == 5
+    for m in meters:
+        assert m["sigma"] > 0
+        assert m["cond"] >= 1.0 - 1e-5
+        assert m["nevals"] % 8 == 0
+    assert meters[-1]["nevals"] == 40
+
+
+def test_strategy_probe_rejects_plain_objects():
+    with pytest.raises(TypeError):
+        strategy_probe(object())
+
+
+def test_streaming_emitter(tmp_path):
+    """stream=True ships live per-generation rows through
+    jax.debug.callback into the journal (and stderr)."""
+    tb = _onemax_toolbox()
+    path = str(tmp_path / "stream.jsonl")
+    with RunTelemetry(path, stream=True) as tel:
+        algorithms.ea_simple(
+            jax.random.key(2), _onemax_pop(jax.random.key(1), n=16), tb,
+            0.5, 0.2, 4, telemetry=tel)
+    live = [e for e in read_journal(path) if e["kind"] == "meter_live"]
+    assert len(live) >= 4  # gen 0 (eager) + in-scan callbacks
+    gens = {e["gen"] for e in live}
+    assert gens >= {1, 2, 3, 4}
+    for e in live:
+        assert "best" in e and "nevals" in e
+
+
+def test_shared_journal_and_broadcast(tmp_path):
+    """Several runs can share one journal; broadcast() reaches every
+    open journal (the GP-interpreter/checkpoint event path)."""
+    from deap_tpu.telemetry import broadcast
+
+    path = str(tmp_path / "shared.jsonl")
+    with RunJournal(path) as journal:
+        journal.header(init_backend=False)
+        broadcast("custom_event", detail="x")
+        tb = _onemax_toolbox()
+        with RunTelemetry(journal) as tel:
+            algorithms.ea_simple(
+                jax.random.key(2), _onemax_pop(jax.random.key(1), n=16),
+                tb, 0.5, 0.2, 2, telemetry=tel)
+    events = read_journal(path)
+    kinds = [e["kind"] for e in events]
+    assert "custom_event" in kinds
+    assert "run_start" in kinds and "run_end" in kinds
+    # a closed journal is inert: broadcast after close writes nothing
+    n = len(events)
+    broadcast("after_close")
+    assert len(read_journal(path)) == n
+
+
+def test_checkpoint_event_broadcast(tmp_path):
+    from deap_tpu.support.checkpoint import save_state
+
+    path = str(tmp_path / "ckpt.jsonl")
+    with RunJournal(path) as journal:
+        save_state(str(tmp_path / "s.ckpt"), {"x": jnp.arange(4)})
+    events = read_journal(path)
+    ck = [e for e in events if e["kind"] == "checkpoint"]
+    assert len(ck) == 1 and ck[0]["bytes"] > 0
+
+
+def test_toolbox_fingerprint_stable_and_sensitive():
+    tb1, tb2 = _onemax_toolbox(), _onemax_toolbox()
+    fp1, fp2 = toolbox_fingerprint(tb1), toolbox_fingerprint(tb2)
+    assert fp1["digest"] == fp2["digest"]
+    assert "select" in fp1["aliases"]
+    tb2.register("select", ops.sel_tournament, tournsize=5)
+    assert toolbox_fingerprint(tb2)["digest"] != fp1["digest"]
+
+
+def test_read_journal_skips_malformed_lines(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    with open(path, "w") as fh:
+        fh.write('{"kind": "header"}\n')
+        fh.write('{"kind": "meter", "gen": 1,\n')  # crashed mid-write
+        fh.write('{"kind": "summary"}\n')
+    events = read_journal(path)
+    assert [e["kind"] for e in events] == ["header", "summary"]
