@@ -741,7 +741,18 @@ def _main_measure(backend, tel=None):
 
 
 if __name__ == "__main__":
-    if "--nd3" in sys.argv:
+    if "--gp-race" in sys.argv:
+        # the GP interpreter race: reference-proxy vs scan-loop vs the
+        # specialized host loop, back-to-back in one session, plus
+        # per-component deltas (mask/grouped/dedup/tiling) — committed
+        # as BENCH_GP.json (see bench_gp.py)
+        import bench_gp
+
+        i = sys.argv.index("--gp-race")
+        nxt = sys.argv[i + 1] if i + 1 < len(sys.argv) else None
+        bench_gp.main(nxt if nxt and not nxt.startswith("--")
+                      else "BENCH_GP.json")
+    elif "--nd3" in sys.argv:
         # the M>=3 nd-sort acceptance measurement: per-impl nd_rank
         # timings at n=50k plus the NSGA-II 3-obj generations/sec row,
         # one JSON line each (committed as BENCH_ND3.json)

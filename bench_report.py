@@ -43,7 +43,8 @@ from tpu_capture import (  # noqa: E402
 TRIPWIRE_THRESHOLD = 0.10
 
 #: per-unit direction: is a larger value better?
-_HIGHER_IS_BETTER = {"gens/sec": True, "x": True, "seconds": False}
+_HIGHER_IS_BETTER = {"gens/sec": True, "x": True, "seconds": False,
+                     "ms": False}
 
 
 def _bench_rows(path: str) -> dict:
@@ -67,22 +68,49 @@ def _bench_rows(path: str) -> dict:
     return rows
 
 
-def tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
-    """Diff the two most recent committed ``BENCH_r*.json`` files and
-    flag regressions. Cached-replay rows (``cached: true`` /
-    ``tpu-cached`` backend) never trip — a replay of an old capture
-    carries no new information about the current code; the env
-    fingerprint bench.py now stamps makes the distinction visible in
-    the table. Returns the number of tripped metrics (the process exit
-    code)."""
-    files = sorted(glob.glob(os.path.join(HERE, "BENCH_r*.json")))
-    if len(files) < 2:
-        print("tripwire: need >= 2 committed BENCH_r*.json files, "
-              f"found {len(files)}")
+def gp_tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
+    """The gp_symbreg paired-row check. BENCH_GP.json carries the old
+    scan-loop and the new specialized-loop throughputs measured
+    back-to-back in the SAME session (bench.py --gp-race) — the only
+    pairing that means anything on a box whose load swings ±40%
+    (VERDICT weak #8). Trips when the specialized interpreter falls
+    more than ``threshold`` below the scan loop it replaced
+    (live-vs-live, same session), and diffs consecutive committed
+    BENCH_GP*.json files with the same rules as the headline
+    tripwire. Returns the number of tripped rows."""
+    files = sorted(glob.glob(os.path.join(HERE, "BENCH_GP*.json")))
+    if not files:
+        print("gp tripwire: no committed BENCH_GP*.json yet")
         return 0
-    prev_path, cur_path = files[-2], files[-1]
+    tripped = 0
+    cur = _bench_rows(files[-1])
+
+    def find(metric):
+        # rows carry an impl tag, so keys are "metric:impl"
+        return next((cur[k] for k in cur
+                     if k == metric or k.startswith(metric + ":")), None)
+
+    new = find("gp_symbreg_pop4096_pts256_generations_per_sec")
+    old = find("gp_symbreg_scan_loop_generations_per_sec")
+    print(f"\n## GP paired rows ({os.path.basename(files[-1])})\n")
+    if new and old and isinstance(new.get("value"), (int, float)):
+        ratio = new["value"] / old["value"]
+        ok = ratio >= (1 - threshold)
+        print(f"- specialized loop {new['value']} vs scan loop "
+              f"{old['value']} gens/s, same session: {ratio:.2f}× "
+              + ("ok" if ok else "**REGRESSION** (specialized "
+                 "interpreter slower than the scan loop it replaced)"))
+        tripped += 0 if ok else 1
+    else:
+        print("- paired rows missing from latest BENCH_GP file")
+    if len(files) >= 2:
+        tripped += _diff_rows(files[-2], files[-1], threshold)
+    return tripped
+
+
+def _diff_rows(prev_path: str, cur_path: str, threshold: float) -> int:
     prev, cur = _bench_rows(prev_path), _bench_rows(cur_path)
-    print(f"## Bench tripwire: {os.path.basename(prev_path)} → "
+    print(f"\n## Bench tripwire: {os.path.basename(prev_path)} → "
           f"{os.path.basename(cur_path)}\n")
     print("| metric | prev | cur | Δ% | status |")
     print("|---|---|---|---|---|")
@@ -114,6 +142,26 @@ def tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
     if tripped:
         print(f"\n{tripped} metric(s) regressed beyond "
               f"{threshold:.0%} — failing.")
+    return tripped
+
+
+def tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
+    """Diff the two most recent committed ``BENCH_r*.json`` files and
+    flag regressions; then the gp_symbreg paired rows
+    (:func:`gp_tripwire`). Cached-replay rows (``cached: true`` /
+    ``tpu-cached`` backend) never trip — a replay of an old capture
+    carries no new information about the current code; the env
+    fingerprint bench.py stamps makes the distinction visible in the
+    table. Returns the number of tripped metrics (the process exit
+    code)."""
+    files = sorted(glob.glob(os.path.join(HERE, "BENCH_r*.json")))
+    tripped = 0
+    if len(files) < 2:
+        print("tripwire: need >= 2 committed BENCH_r*.json files, "
+              f"found {len(files)}")
+    else:
+        tripped += _diff_rows(files[-2], files[-1], threshold)
+    tripped += gp_tripwire(threshold)
     return tripped
 
 

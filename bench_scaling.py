@@ -194,6 +194,193 @@ def measure(n_devices: int) -> dict:
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+# ------------------------------------------------ sp cliff attribution ----
+
+#: the attribution sweep's variants (VERDICT r5 weak #5 / next #4): the
+#: n=8 sp efficiency cliff (0.87 -> 0.34) could be (a) psum cost
+#: scaling with global size, (b) XLA layout effects tied to the slice
+#: width, or (c) pure 1-core virtual-mesh contention. Each variant
+#: isolates one axis; the conclusion is computed by differencing the
+#: measured curves, not asserted.
+ATTR_SLICE = 2048
+ATTR_POP = 2048          # the SP config that measured the r5 cliff
+ATTR_VARIANTS = (
+    # (name, slice_len, combine, compute_reps)
+    ("base", ATTR_SLICE, "sum", 1),
+    ("no_collective", ATTR_SLICE, "none", 1),   # same compute, no psum
+    ("heavy_compute", ATTR_SLICE, "sum", 8),    # 8x compute per psum
+    ("narrow_slice", 512, "sum", 1),
+    ("wide_slice", 8192, "sum", 1),
+)
+ATTR_DEVICES = (1, 4, 8)
+
+
+def _attr_child(n_devices: int) -> None:
+    """Measure every attribution variant on ``n_devices`` virtual
+    devices; one JSON dict on stdout. Sanitized subprocess only."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from deap_tpu.parallel import genome_mesh, shard_genomes
+    from deap_tpu.parallel.genome_shard import make_sharded_evaluator
+    from deap_tpu.parallel.mesh import shard_map
+    from deap_tpu.support.profiling import SpanRecorder
+
+    assert len(jax.devices()) == n_devices, jax.devices()
+    res = {"n_devices": n_devices, "variants": {}}
+
+    for name, slice_len, combine, reps in ATTR_VARIANTS:
+        gmesh = genome_mesh(n_pop_shards=1, n_genome_shards=n_devices)
+        genomes = jax.random.uniform(
+            jax.random.key(2), (ATTR_POP, slice_len * n_devices))
+
+        def partial_eval(g, reps=reps):
+            # rastrigin-flavoured local reduction, iterated ``reps``
+            # times: varies compute per collective (the psum
+            # "frequency" relative to useful work) without touching
+            # the communication volume
+            def body(i, acc):
+                x = g * (1.0 + 1e-6 * acc[:, None])
+                return acc + jnp.sum(
+                    x * x - 10.0 * jnp.cos(2 * jnp.pi * x) + 10.0,
+                    axis=-1)
+            return lax.fori_loop(0, reps, body,
+                                 jnp.zeros(g.shape[0]))
+
+        if combine == "none":
+            # identical local compute, NO cross-shard reduction: the
+            # partials stay sharded — any residual inefficiency vs
+            # n=1 is contention/layout, not the collective
+            fn = jax.jit(shard_map(
+                lambda g: partial_eval(g)[:, None], mesh=gmesh,
+                in_specs=P("pop", "genome"),
+                out_specs=P("pop", "genome")))
+        else:
+            fn = make_sharded_evaluator(partial_eval, gmesh,
+                                        combine=combine)
+        sharded = shard_genomes(genomes, gmesh)
+
+        with SpanRecorder() as rec:
+            out = fn(sharded)                 # compile + warm
+            jax.block_until_ready(out)
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(EPOCHS):
+                    out = fn(sharded)
+                jax.block_until_ready(out)
+                best = min(best, (time.perf_counter() - t0) / EPOCHS)
+        spans = {k: {"count": v["count"],
+                     "total_s": round(v["total_s"], 6)}
+                 for k, v in rec.aggregates().items()}
+        res["variants"][name] = {
+            "slice": slice_len, "combine": combine,
+            "compute_reps": reps,
+            "evals_per_sec": ATTR_POP / best,
+            # trace-time per-collective spans (SpanRecorder fires once
+            # per trace under jit) — compile-phase attribution; the
+            # execution attribution is the differenced timings
+            "spans_trace_time": spans,
+        }
+    print(json.dumps(res))
+
+
+def _attr_measure(n_devices: int) -> dict:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    out = subprocess.run(
+        [sys.executable, "-c",
+         f"import bench_scaling as b; b._attr_child({int(n_devices)})"],
+        cwd=HERE, env=env, capture_output=True, text=True, timeout=1800)
+    if out.returncode != 0:
+        raise RuntimeError(f"attr child n={n_devices} failed "
+                           f"(rc={out.returncode}):\n{out.stderr[-2000:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def attribute_sp() -> None:
+    """Run the attribution sweep and fold the result (rows + computed
+    conclusion) into SCALING.json's ``sp_attribution`` section."""
+    rows = [_attr_measure(n) for n in ATTR_DEVICES]
+    base = rows[0]["variants"]
+    eff = {}
+    for row in rows:
+        n = row["n_devices"]
+        for name, v in row["variants"].items():
+            e = v["evals_per_sec"] * n / base[name]["evals_per_sec"]
+            v["work_efficiency"] = round(e, 3)
+            eff[(name, n)] = e
+        print(json.dumps(row))
+
+    n_hi = ATTR_DEVICES[-1]
+    e_base = eff[("base", n_hi)]
+    e_none = eff[("no_collective", n_hi)]
+    e_heavy = eff[("heavy_compute", n_hi)]
+    e_narrow = eff[("narrow_slice", n_hi)]
+    e_wide = eff[("wide_slice", n_hi)]
+    parts = [
+        f"at n={n_hi}: base eff {e_base:.2f}, no-collective "
+        f"{e_none:.2f}, 8x-compute-per-psum {e_heavy:.2f}, "
+        f"narrow(512) {e_narrow:.2f}, wide(8192) {e_wide:.2f}."
+    ]
+    if e_base >= 0.7:
+        parts.append(
+            "The r5 cliff (0.34) did NOT reproduce at the same "
+            "pop/slice config in this sweep — consistent with the "
+            "r5 capture riding transient shared-box load rather than "
+            "a property of the sharded program; the variants below "
+            "bound where a real cliff could come from.")
+    elif e_none < 0.7:
+        parts.append(
+            "The cliff persists with the psum REMOVED entirely, so it "
+            "is predominantly 1-core virtual-mesh contention "
+            "(n XLA programs time-slicing one physical core), not "
+            "collective cost — expect it not to reproduce on real "
+            "multi-chip ICI.")
+    else:
+        parts.append(
+            f"Removing the psum recovers efficiency to {e_none:.2f}: "
+            "the collective itself is the dominant cost at n=8.")
+    if e_heavy > e_base + 0.1:
+        parts.append(
+            f"Raising compute per psum 8x lifts efficiency to "
+            f"{e_heavy:.2f}: the psum frequency (per-evaluation "
+            "reduction) is a real secondary term — batching "
+            "evaluations per collective would recover it.")
+    if abs(e_narrow - e_wide) > 0.15:
+        parts.append(
+            f"Slice width moves efficiency ({e_narrow:.2f} at 512 vs "
+            f"{e_wide:.2f} at 8192): per-slice compute granularity / "
+            "XLA layout contributes.")
+    else:
+        parts.append("Slice width barely moves the curve: no "
+                     "layout/granularity effect.")
+    conclusion = " ".join(parts)
+    print(json.dumps({"sp_attribution_conclusion": conclusion}))
+
+    report = {}
+    if os.path.exists(OUT):
+        with open(OUT) as f:
+            report = json.load(f)
+    report["sp_attribution"] = {
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "config": {"pop": ATTR_POP, "epochs": EPOCHS,
+                   "variants": [list(v) for v in ATTR_VARIANTS],
+                   "device_counts": list(ATTR_DEVICES)},
+        "rows": rows,
+        "conclusion": conclusion,
+    }
+    with open(OUT, "w") as f:
+        json.dump(report, f, indent=1)
+
+
 def main() -> None:
     rows = [measure(n) for n in DEVICE_COUNTS]
     base = rows[0]
@@ -231,4 +418,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--attribute-sp" in sys.argv:
+        attribute_sp()
+    else:
+        main()

@@ -286,51 +286,16 @@ def bench_cartpole():
 
 
 def bench_gp_symbreg():
-    """On TPU, races the two interpreter modes (the scan interpreter
-    that won on CPU vs the level-synchronous sweep built for wide fused
-    accelerator steps — gp/interpreter.py docstring) and keeps the
-    faster; CPU keeps scan (sweep measured ~9× slower there)."""
-    from deap_tpu import gp
+    """Races the interpreter schedules with a SHORT probe — the jit'd
+    scan loop, the level-synchronous sweep loop (TPU only), and the
+    host-dispatch grouped+dedup loop (gp/loop.py, the bench.py
+    --gp-race winner on CPU) — then measures the winner alone at full
+    length (bench_gp.suite_gps). Probing first keeps the staged
+    scan-vs-sweep-vs-grouped TPU race inside a few minutes of relay
+    window, where the old full-length-per-mode race needed tens."""
+    from bench_gp import suite_gps
 
-    POP, MAX_LEN = 4096, 64
-    pset = gp.math_set(n_args=1)
-    gen = gp.gen_half_and_half(pset, MAX_LEN, 1, 2)
-    expr_mut = gp.make_generator(pset, 32, 0, 2, "full")
-    X = jnp.linspace(-1.0, 1.0, 256, endpoint=False)[:, None]
-    y = X[:, 0] ** 4 + X[:, 0] ** 3 + X[:, 0] ** 2 + X[:, 0]
-    limit = gp.static_limit(lambda g: gp.tree_height(g, pset), 17)
-
-    def build(mode):
-        evaluate = gp.make_population_evaluator(
-            pset, MAX_LEN, lambda pred, y: jnp.mean((pred - y) ** 2),
-            mode=mode)
-        tb = Toolbox()
-        tb.register("evaluate", lambda gs: -evaluate(gs, X, y))
-        tb.register("mate", limit(gp.make_cx_one_point(pset)))
-        tb.register("mutate", limit(gp.make_mut_uniform(pset, expr_mut)))
-        tb.register("select", ops.sel_tournament, tournsize=3)
-
-        pop = init_population(jax.random.key(1), POP, gen,
-                              FitnessSpec((1.0,)))
-        pop = evaluate_invalid(pop, tb.evaluate)
-
-        @jax.jit
-        def run(key, pop):
-            def step(p, k):
-                k1, k2 = jax.random.split(k)
-                idx = tb.select(k1, p.wvalues, POP)
-                off = var_and(k2, gather(p, idx), tb, 0.5, 0.1)
-                return evaluate_invalid(off, tb.evaluate), 0
-
-            p, _ = lax.scan(step, pop, jax.random.split(key, NGEN))
-            return p.wvalues
-
-        return run, pop
-
-    gps = _time(*build("scan"))
-    if jax.default_backend() == "tpu":
-        gps = max(gps, _time(*build("sweep")))
-    return gps
+    return suite_gps()
 
 
 # cmaes runs LAST: its scan-of-eigh is the largest compile shipped

@@ -60,7 +60,8 @@ from deap_tpu.gp.semantic import (
     make_mut_semantic,
 )
 from deap_tpu.gp.harm import harm
-from deap_tpu.gp import ant
+from deap_tpu.gp.loop import make_gp_loop, make_symbreg_loop
+from deap_tpu.gp import ant, loop
 
 __all__ = [
     "PrimitiveSetTyped",
@@ -90,6 +91,8 @@ __all__ = [
     "make_batch_interpreter",
     "make_interpreter",
     "make_population_evaluator",
+    "make_gp_loop",
+    "make_symbreg_loop",
     "make_generator",
     "gen_full",
     "gen_grow",

@@ -31,12 +31,19 @@ Branches = Sequence[Tuple[PrimitiveSet, int]]   # [(pset, max_len), ...]
 
 
 def _build_branch(pset: PrimitiveSet, max_len: int, branch_idx: int,
-                  interps: dict, max_actives=None) -> Callable:
+                  interps: dict, max_actives=None,
+                  masks=None) -> Callable:
     """interp(genomes, X) for one branch; ADF nodes dispatch into
     ``interps`` (already built for every branch index > branch_idx).
     ``max_actives[i]`` optionally bounds branch *i*'s passes to its
-    population's largest live prefix (gp/interpreter.py contract)."""
-    prims = list(pset.primitives)
+    population's largest live prefix (gp/interpreter.py contract);
+    ``masks[i]`` optionally restricts branch *i*'s dispatch to its live
+    opcode subset (ids into ``pset.primitives`` — ADF call ids
+    included, so an unused callee is neither evaluated nor recursed
+    into)."""
+    ids = (range(pset.n_ops) if masks is None or masks[branch_idx] is None
+           else masks[branch_idx])
+    prims = [(i, pset.primitives[i]) for i in ids]
     ma = None if max_actives is None else max_actives[branch_idx]
 
     def interpret(genomes, X):
@@ -45,12 +52,12 @@ def _build_branch(pset: PrimitiveSet, max_len: int, branch_idx: int,
         # dispatch into the callee branch's interpreter
         def prim_rows(ops_in):
             rows = []
-            for p in prims:
+            for i, p in prims:
                 if p.adf is None:
-                    rows.append(p.fn(*ops_in[: p.arity]))
+                    rows.append((i, p.fn(*ops_in[: p.arity])))
                 else:
                     sub_X = jnp.stack(ops_in[: p.arity], axis=1)
-                    rows.append(interps[p.adf](genomes, sub_X))
+                    rows.append((i, interps[p.adf](genomes, sub_X)))
             return rows
 
         return run_data_pass(pset, max_len, genomes[branch_idx], X,
@@ -81,11 +88,13 @@ def _validate_branches(branches: Branches) -> None:
                     f"{callee.n_args} arguments")
 
 
-def _link_branches(branches: Branches, max_actives=None) -> Callable:
+def _link_branches(branches: Branches, max_actives=None,
+                   masks=None) -> Callable:
     interps: dict = {}
     for i in reversed(range(len(branches))):
         pset, max_len = branches[i]
-        interps[i] = _build_branch(pset, max_len, i, interps, max_actives)
+        interps[i] = _build_branch(pset, max_len, i, interps,
+                                   max_actives, masks)
     return interps[0]
 
 
@@ -98,22 +107,67 @@ def make_adf_interpreter(branches: Branches) -> Callable:
     return _link_branches(branches)
 
 
-def make_adf_batch_interpreter(branches: Branches) -> Callable:
+def make_adf_batch_interpreter(branches: Branches,
+                               specialize: str = "auto") -> Callable:
     """``interpret(genomes, X) -> f32[pop, points]`` over a population
     of multi-branch individuals (a tuple of stacked branch genomes) —
     the ADF analog of ``gp.make_batch_interpreter``: every branch's
     passes are bounded to that branch's population-max live prefix
     ``T_i = max(length_i)``, closed over the vmapped call so the
-    bounds stay unbatched (batch-uniform writes)."""
+    bounds stay unbatched (batch-uniform writes).
+
+    ``specialize='auto'`` composes the live-vocab masks of
+    ``gp.make_batch_interpreter`` with ADF dispatch: when called with
+    concrete genomes, each branch's select-chain is compiled for that
+    branch's live opcode subset — ADF call ids included, so a call
+    primitive no live tree uses skips the whole callee recursion.
+    Masks grow monotonically per interpreter (bounded recompiles);
+    under tracing the full per-branch vocabularies are used.
+    Bit-identical either way."""
     _validate_branches(branches)
+    if specialize not in ("auto", "none"):
+        raise ValueError(f"unknown specialize policy {specialize!r}")
+
+    def _traced(masks):
+        def interpret_batch(genomes, X):
+            Ts = tuple(
+                jnp.clip(jnp.max(g["length"]), 1,
+                         min(g["nodes"].shape[-1], ml)).astype(jnp.int32)
+                for g, (_, ml) in zip(genomes, branches))
+            main = _link_branches(branches, Ts, masks)
+            return jax.vmap(lambda gt: main(gt, X))(genomes)
+
+        return interpret_batch
+
+    base = _traced(None)
+    if specialize == "none":
+        return base
+
+    from deap_tpu.gp.interpreter import _is_concrete, _used_ops
+
+    state = {"masks": tuple(() for _ in branches), "cache": {}}
 
     def interpret_batch(genomes, X):
-        Ts = tuple(
-            jnp.clip(jnp.max(g["length"]), 1,
-                     min(g["nodes"].shape[-1], ml)).astype(jnp.int32)
-            for g, (_, ml) in zip(genomes, branches))
-        main = _link_branches(branches, Ts)
-        return jax.vmap(lambda gt: main(gt, X))(genomes)
+        leaves = [a for g in genomes
+                  for a in (g["nodes"], g["consts"], g["length"])] + [X]
+        if not _is_concrete(*leaves):
+            return base(genomes, X)
+        import numpy as np
+
+        masks = []
+        for prev, g, (ps, ml) in zip(state["masks"], genomes, branches):
+            used = _used_ops(ps.n_ops, np.asarray(g["nodes"])[:, :ml],
+                             np.asarray(g["length"]))
+            masks.append(tuple(sorted(set(prev) | set(used))))
+        state["masks"] = key = tuple(masks)
+        fn = state["cache"].get(key)
+        if fn is None:
+            fn = state["cache"][key] = jax.jit(_traced(key))
+            from deap_tpu.telemetry.journal import broadcast
+            broadcast("gp_dispatch", mode="adf", mask=[
+                [branches[i][0].primitives[j].name for j in m]
+                for i, m in enumerate(key)])
+        return fn(genomes, X)
 
     return interpret_batch
 

@@ -3,44 +3,83 @@
 This replaces the reference's per-individual string codegen + Python
 ``eval`` (/root/reference/deap/gp.py:462-487, the most TPU-hostile stack
 in the reference per SURVEY.md §3.3) with a vectorised prefix-tree
-interpreter: one pass over node slots (a ``lax.scan``, or a
-``fori_loop`` with a dynamic trip count on the batch path), operating
-on a stack of *data vectors*, ``vmap``-batched over the population.
-Evaluating a population of trees on all datapoints is a single XLA
-program with no per-individual dispatch, and — unlike the reference,
-which hits a MemoryError past depth ~90 via nested lambda eval
-(gp.py:481-487) — cost is O(max_len · vocab · points) worst case, or
-O(max_active · vocab · points) via :func:`make_batch_interpreter`,
-which bounds both passes to the population's largest live prefix
-``T = max(length)``.
+interpreter: one pass over node slots, operating on a stack of *data
+vectors*, ``vmap``-batched over the population. Evaluating a population
+of trees on all datapoints is a single XLA program with no
+per-individual dispatch, and — unlike the reference, which hits a
+MemoryError past depth ~90 via nested lambda eval (gp.py:481-487) —
+cost is bounded by the population's largest live prefix.
 
 Execution model — two passes over the prefix:
 
-1. **Child-table pre-pass (ints only).** Walk the prefix right-to-left
-   with a stack of *slot indices*: for each operator slot record which
-   slots hold its operands. This touches only ``int32[max_len]``
-   arrays, so its per-tree dynamic pushes cost nothing.
+1. **Child-table pre-pass (ints only).** Entry ``[slot, i]`` of the
+   child table is the slot holding operand *i* of the node at ``slot``.
+   Computed in closed form from the all-slots subtree-end query
+   (``gp.tree.subtree_ends_all``): first child = slot+1, each next
+   sibling starts where the previous subtree ends — pure gathers,
+   O(L log L), no serial walk (the old L-step index-stack scan cost
+   ~35 ms/gen at pop=4096 on one CPU core; this form ~5 ms).
 2. **Data pass.** Walk slots right-to-left filling an output buffer
-   ``out[max_len, points]``: every primitive is evaluated on the
-   slots' operand rows (vocab is small — the VPU eats the redundancy),
-   the node id selects the row, and the result lands at ``out[slot]``.
+   ``out[max_len, points]``: the live primitives are evaluated on the
+   slots' operand rows, the node id selects the row, and the result
+   lands at ``out[slot]``.
 
-The pre-pass exists so the data pass writes at a **batch-uniform**
-index (the scan's own slot counter): under ``vmap`` a per-tree write
-position turns ``dynamic_update_slice`` into a scatter, which forces
-XLA to copy the whole data buffer every step — measured ~250× slower
-than the arithmetic itself. With uniform write positions the buffer
-updates alias in place and only the (read-only) operand *gathers* are
-per-tree. In prefix order children always sit at higher slots than
-their parent, so right-to-left slot order evaluates children first for
-every tree regardless of its length.
+The data pass writes at a **batch-uniform** index (the scan's own slot
+counter): under ``vmap`` a per-tree write position turns
+``dynamic_update_slice`` into a scatter, which forces XLA to copy the
+whole data buffer every step — measured ~250× slower than the
+arithmetic itself. With uniform write positions the buffer updates
+alias in place and only the (read-only) operand *gathers* are per-tree.
+In prefix order children always sit at higher slots than their parent,
+so right-to-left slot order evaluates children first for every tree
+regardless of its length.
+
+Live-population specialization (this module's dispatch layer)
+-------------------------------------------------------------
+
+The naive data pass pays an O(vocab) ``jnp.where`` select-chain at
+every slot of every tree — every primitive, transcendentals included,
+evaluated whether or not any live tree uses it. Three mechanisms make
+dispatch scale with what the population *actually uses* instead:
+
+- **Live-vocab masks.** When the batch interpreter is called with
+  concrete (non-traced) genomes, the population's opcode histogram is
+  read on the host and the select-chain is compiled for the *live*
+  subset only. Observed masks are rounded UP to the monotone union of
+  every opcode seen so far by that interpreter, so the number of
+  compiled variants is bounded by ``n_ops`` per interpreter — the
+  mask lattice. Under ``jax.jit`` tracing the full-vocab chain is used
+  (bit-identical; masking is purely an optimisation).
+- **Unique-genome dispatch.** Selection duplicates winners: measured
+  symbreg populations converge to ~15% unique genomes. The concrete
+  path evaluates each distinct genome once and gathers results back —
+  bit-identical by construction. Unique counts are rounded up on a
+  coarse size lattice to bound shape-driven retraces.
+- **Opcode-major evaluation** (``mode='grouped'``). Live operator
+  slots are flattened across the population, sorted by
+  ``(depth desc, opcode)``, and padded so every ``chunk``-slot block is
+  single-opcode; evaluation is then one sequential loop over chunks
+  where ``lax.switch`` applies exactly ONE primitive to each block —
+  each primitive runs once per site instead of once per vocab entry
+  per slot. Dependencies are honoured because children (strictly
+  deeper) sort into earlier chunks. On TPU the chunk loop can run as
+  one Pallas fused gather-dispatch-scatter kernel
+  (``ops.kernels.gp_grouped_dispatch``). Grouped requires concrete
+  genomes; under tracing it falls back to the scan chain.
+
+All specialized paths are bit-identical to the full-vocab scan
+interpreter (pinned by tests/test_gp_dispatch.py); picking one is
+purely a performance decision. Measured component deltas live in
+BENCH_GP.json (``bench.py --gp-race``).
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import Callable
+from typing import Callable, Optional, Sequence, Tuple
 from weakref import WeakKeyDictionary
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -57,61 +96,41 @@ from deap_tpu.gp.pset import PrimitiveSet
 _PRIM_ROWS_CACHE: "WeakKeyDictionary" = WeakKeyDictionary()
 _INTERPRETER_CACHE: "WeakKeyDictionary" = WeakKeyDictionary()
 
+#: default instruction-block size for ``mode='grouped'`` — every chunk
+#: is single-opcode; smaller chunks waste less padding, larger chunks
+#: amortise more per-step dispatch overhead (128 measured best on CPU
+#: at pop=4096, pts=256; the TPU kernel wants sublane multiples)
+DEFAULT_CHUNK = 128
+
+
+def _is_concrete(*arrays) -> bool:
+    return not any(isinstance(a, jax.core.Tracer) for a in arrays)
+
 
 def child_table(nodes: jnp.ndarray, length, arity: jnp.ndarray,
                 max_ar: int, max_active=None) -> jnp.ndarray:
-    """Child-slot table for a prefix genome — the int-only pre-pass
-    shared by this module's interpreter and the ADF branch interpreter
-    (gp/adf.py).
+    """Child-slot table for a prefix genome — closed form.
 
-    Walks the prefix right-to-left with a stack of slot indices; entry
-    ``[slot, i]`` of the returned ``int32[ML, max_ar]`` is the slot
-    holding operand *i* of the node at ``slot`` (garbage, never
-    referenced, for terminals and padding).
+    Entry ``[slot, i]`` of the returned ``int32[ML, max_ar]`` is the
+    slot holding operand *i* of the node at ``slot`` (garbage, never
+    referenced, for terminals and padding). In prefix order the first
+    child of an operator at ``slot`` is ``slot+1`` and each next
+    sibling starts where the previous child's subtree ends, so the
+    whole table is gathers over :func:`gp.tree.subtree_ends_all` —
+    no serial walk. ``max_active`` is accepted for API compatibility
+    (the closed form always costs O(L log L) ints, which is cheaper
+    than even the bounded walk it replaced)."""
+    del max_active
+    from deap_tpu.gp.tree import subtree_ends_all
 
-    ``max_active`` (a traced scalar ≥ every tree's ``length``) bounds
-    the walk to the population's live prefix instead of the full genome
-    width — see :func:`run_data_pass` for the batching contract.
-    """
     ML = nodes.shape[0]
-    ar_all = jnp.where(jnp.arange(ML) < length, arity[nodes], 0)
-
-    def pre(carry, rt):
-        stack, sp = carry
-        valid = rt < length
-        children = jnp.stack([
-            lax.dynamic_index_in_dim(stack, sp - 1 - i, keepdims=False)
-            for i in range(max_ar)])
-        new_sp = jnp.where(valid, sp - ar_all[rt] + 1, sp)
-        pushed = lax.dynamic_update_index_in_dim(
-            stack, rt, new_sp - 1, axis=0)
-        stack = jnp.where(valid, pushed, stack)
-        return (stack, new_sp), children
-
-    if max_active is None:
-        _, ch = lax.scan(
-            pre, (jnp.zeros(ML + max_ar, jnp.int32), jnp.int32(0)),
-            jnp.arange(ML - 1, -1, -1))
-        return ch[::-1]
-
-    # dynamic trip count: only slots < max_active can be live, so the
-    # right-to-left walk may start at max_active-1.  The write position
-    # rt stays batch-uniform as long as max_active is unbatched under
-    # vmap (a population-level reduction closed over per-tree calls).
-    T = max_active
-
-    def body(t, carry):
-        stack, sp, ch = carry
-        rt = T - 1 - t
-        (stack, sp), children = pre((stack, sp), rt)
-        ch = lax.dynamic_update_index_in_dim(ch, children, rt, axis=0)
-        return stack, sp, ch
-
-    _, _, ch = lax.fori_loop(
-        0, T, body,
-        (jnp.zeros(ML + max_ar, jnp.int32), jnp.int32(0),
-         jnp.zeros((ML, max_ar), jnp.int32)))
-    return ch
+    ends = subtree_ends_all(nodes, length, arity)     # [ML], exclusive
+    cols = []
+    child = jnp.minimum(jnp.arange(ML, dtype=jnp.int32) + 1, ML - 1)
+    for _ in range(max_ar):
+        cols.append(child)
+        child = jnp.minimum(ends[child].astype(jnp.int32), ML - 1)
+    return jnp.stack(cols, axis=1)
 
 
 def run_data_pass(pset: PrimitiveSet, max_len: int, genome, X,
@@ -119,14 +138,15 @@ def run_data_pass(pset: PrimitiveSet, max_len: int, genome, X,
     """Shared two-pass evaluation core (this module's interpreter and
     the ADF branch interpreter in gp/adf.py).
 
-    ``prim_rows(ops_in) -> [rows]`` evaluates every primitive on the
-    operand vectors (the ADF interpreter dispatches call nodes into
-    other branches here); everything else — child table, output buffer,
-    row selection, padding semantics — is identical across both.
-    Returns the root's value vector ``f32[points]``.
+    ``prim_rows(ops_in) -> [(node_id, row), ...]`` evaluates the live
+    primitives on the operand vectors and tags each result row with the
+    node id that selects it (the ADF interpreter dispatches call nodes
+    into other branches here); everything else — child table, output
+    buffer, row selection, padding semantics — is identical across
+    callers. Returns the root's value vector ``f32[points]``.
 
-    ``max_active`` bounds both passes to the live prefix: a traced
-    int32 ≥ every tree's ``length``.  With it the cost drops from
+    ``max_active`` bounds the data pass to the live prefix: a traced
+    int32 ≥ every tree's ``length``. With it the cost drops from
     O(max_len·vocab·points) to O(max_active·vocab·points) — early GP
     generations hold trees of 3-15 nodes in 64-slot genomes, so this
     is the difference between paying for the genome *width* and paying
@@ -153,8 +173,7 @@ def run_data_pass(pset: PrimitiveSet, max_len: int, genome, X,
     consts = consts[:ML]
     P = X.shape[0]
     argsT = X.T.astype(jnp.float32)                # [n_args, P]
-    C = child_table(nodes, length, arity, max_ar,
-                    max_active=max_active)         # [ML, max_ar]
+    C = child_table(nodes, length, arity, max_ar)  # [ML, max_ar]
 
     # pass 2: fill the output buffer, children before parents
     def step(out, rt):
@@ -166,8 +185,8 @@ def run_data_pass(pset: PrimitiveSet, max_len: int, genome, X,
             lax.dynamic_index_in_dim(out, cr[i], keepdims=False)
             for i in range(max_ar)
         ]
-        rows = prim_rows(ops_in)
-        rows.extend(argsT)                          # argument terminals
+        rows = list(prim_rows(ops_in))
+        rows.extend((pset.n_ops + j, a) for j, a in enumerate(argsT))
         # every constant-family id (fixed terminal or ERC) shares the
         # one constant row
         row = jnp.minimum(node, jnp.int32(const_row))
@@ -176,8 +195,8 @@ def run_data_pass(pset: PrimitiveSet, max_len: int, genome, X,
         # materialise a [vocab, P] buffer per tree per step (measured
         # ~2× slower on CPU at pop=4096, pts=256)
         res = jnp.broadcast_to(consts[rt], (P,))    # constant default
-        for i, r in enumerate(rows):
-            res = jnp.where(row == i, r, res)
+        for nid, r in rows:
+            res = jnp.where(row == nid, r, res)
         return lax.dynamic_update_index_in_dim(out, res, rt, axis=0)
 
     out0 = jnp.zeros((ML, P), jnp.float32)
@@ -190,24 +209,36 @@ def run_data_pass(pset: PrimitiveSet, max_len: int, genome, X,
     return out[0]
 
 
-def _prim_rows_builder(pset: PrimitiveSet) -> Callable:
+def _prim_rows_builder(pset: PrimitiveSet,
+                       mask: Optional[Tuple[int, ...]] = None) -> Callable:
     """The plain-primitive dispatch shared by both interpreter
     factories (the ADF interpreter substitutes its own, gp/adf.py).
-    Cached per pset (keyed on the operator roster, so a set extended
-    afterwards rebuilds) — see the module caches above."""
+
+    ``mask`` — live opcode ids — restricts the returned rows to the
+    primitives that actually occur in the population (the live-vocab
+    specialization); ``None`` means the full set. Cached per
+    ``(pset, mask)`` keyed on the operator roster, so a set extended
+    afterwards rebuilds — see the module caches above."""
     if pset.has_adf:
         raise ValueError(
             "primitive set contains ADF calls; use "
             "deap_tpu.gp.adf.make_adf_interpreter")
-    cached = _PRIM_ROWS_CACHE.get(pset)
-    if cached is not None and cached[0] == pset.n_ops:
-        return cached[1]
-    prims = list(pset.primitives)
+    mask = None if mask is None else tuple(sorted(mask))
+    entry = _PRIM_ROWS_CACHE.setdefault(pset, {})
+    key = (pset.n_ops, mask)
+    cached = entry.get(key)
+    if cached is not None:
+        return cached
+    stale = [k for k in entry if k[0] != pset.n_ops]
+    for k in stale:
+        del entry[k]
+    ids = range(pset.n_ops) if mask is None else mask
+    prims = [(i, pset.primitives[i]) for i in ids]
 
     def prim_rows(ops_in):
-        return [p.fn(*ops_in[: p.arity]) for p in prims]
+        return [(i, p.fn(*ops_in[: p.arity])) for i, p in prims]
 
-    _PRIM_ROWS_CACHE[pset] = (pset.n_ops, prim_rows)
+    entry[key] = prim_rows
     return prim_rows
 
 
@@ -269,7 +300,8 @@ def run_sweep_pass(pset: PrimitiveSet, max_len: int, genome, X,
     elementwise pass over ``[slots, points]``, the shape the VPU (and a
     CPU's vector units) actually like.  ``n_sweeps`` must be unbatched
     under ``vmap`` (a population-level reduction), like
-    ``run_data_pass``'s ``max_active``.
+    ``run_data_pass``'s ``max_active``. ``prim_rows`` uses the same
+    ``[(node_id, row), ...]`` contract as :func:`run_data_pass`.
     """
     arity = pset.arity_table()
     max_ar = max(pset.max_arity, 1)
@@ -282,8 +314,7 @@ def run_sweep_pass(pset: PrimitiveSet, max_len: int, genome, X,
     consts = consts[:ML]
     P = X.shape[0]
     argsT = X.T.astype(jnp.float32)                 # [n_args, P]
-    C = child_table(nodes, length, arity, max_ar,
-                    max_active=max_active)          # [ML, max_ar]
+    C = child_table(nodes, length, arity, max_ar)   # [ML, max_ar]
 
     node = jnp.where(jnp.arange(ML) < length, nodes, jnp.int32(const_row))
     row = jnp.minimum(node, jnp.int32(const_row))   # [ML]
@@ -291,11 +322,13 @@ def run_sweep_pass(pset: PrimitiveSet, max_len: int, genome, X,
 
     def sweep(out):
         ops_in = [jnp.take(out, C[:, i], axis=0) for i in range(max_ar)]
-        rows = prim_rows(ops_in)                    # each [ML, P]
-        rows.extend(jnp.broadcast_to(a[None, :], (ML, P)) for a in argsT)
+        rows = list(prim_rows(ops_in))              # each [ML, P]
+        rows.extend((pset.n_ops + j,
+                     jnp.broadcast_to(a[None, :], (ML, P)))
+                    for j, a in enumerate(argsT))
         res = const_plane
-        for i, r in enumerate(rows):
-            res = jnp.where((row == i)[:, None], r, res)
+        for nid, r in rows:
+            res = jnp.where((row == nid)[:, None], r, res)
         return res
 
     out = lax.fori_loop(0, n_sweeps, lambda s, o: sweep(o),
@@ -303,47 +336,327 @@ def run_sweep_pass(pset: PrimitiveSet, max_len: int, genome, X,
     return out[0]
 
 
+# ---------------------------------------------------------- size lattices ----
+
+def _round_size(n: int, floor: int = 8) -> int:
+    """Round ``n`` up on a coarse geometric lattice ({pow2, 0.75·pow2})
+    so data-dependent batch/schedule sizes hit a bounded set of compiled
+    shapes (~2 per size decade)."""
+    n = max(int(n), 1)
+    if n <= floor:
+        return floor
+    p = 1 << (n - 1).bit_length()
+    if (3 * p) // 4 >= n:
+        return (3 * p) // 4
+    return p
+
+
+def _used_ops(n_ops: int, nodes: np.ndarray, length: np.ndarray
+              ) -> Tuple[int, ...]:
+    """The population's live opcode set, read from concrete arrays."""
+    live = np.arange(nodes.shape[1])[None, :] < length[:, None]
+    ids = nodes[live]
+    return tuple(np.unique(ids[ids < n_ops]).tolist())
+
+
+def _dedup_rows(nodes: np.ndarray, consts: np.ndarray, length: np.ndarray
+                ) -> Tuple[np.ndarray, np.ndarray]:
+    """(first_indices, inverse) over byte-identical live prefixes —
+    padding slots are normalised out so two genomes equal on their live
+    prefix dedup together even when their padding differs."""
+    live = np.arange(nodes.shape[1])[None, :] < length[:, None]
+    nn = np.where(live, nodes, -1).astype(np.int32)
+    cc = np.where(live, consts, 0.0).astype(np.float32)
+    blob = np.ascontiguousarray(np.concatenate([nn, cc.view(np.int32)], 1))
+    seen: dict = {}
+    inv = np.empty(len(blob), np.int64)
+    first = []
+    for i, row in enumerate(blob):
+        b = row.tobytes()
+        j = seen.get(b)
+        if j is None:
+            seen[b] = j = len(first)
+            first.append(i)
+        inv[i] = j
+    return np.asarray(first, np.int64), inv
+
+
+# ------------------------------------------------- grouped (opcode-major) ----
+
+def _round_chunks(n: int) -> int:
+    """Chunk-count lattice: pure powers of two (floor 8). The chunk
+    count is the ONLY data-dependent static in the grouped evaluator's
+    jit signature, so its lattice directly bounds recompiles — a
+    typical run's growth path hits 8→16→32→64 and stops."""
+    n = max(int(n), 1)
+    return max(8, 1 << (n - 1).bit_length())
+
+
+def _ends_np(nodes: np.ndarray, length: np.ndarray,
+             arity: np.ndarray) -> np.ndarray:
+    """Numpy port of ``gp.tree.subtree_ends_all`` for a population —
+    the grouped schedule builder runs on the host every generation, and
+    a jitted ends/depths helper would re-specialize (compile) on every
+    new population-size class; this costs ~3 ms at [4096, 64] and never
+    compiles anything."""
+    pop, L = nodes.shape
+    live = np.arange(L)[None, :] < length[:, None]
+    deficit = np.where(live, arity[nodes] - 1, 0).astype(np.int64)
+    cs = np.cumsum(deficit, axis=1)
+    prev = np.concatenate(
+        [np.zeros((pop, 1), cs.dtype), cs[:, :-1]], axis=1)
+    NEG = -(2 ** 30)
+    levels = [cs]
+    k = 1
+    while k < L:
+        m = levels[-1]
+        shifted = np.concatenate(
+            [m[:, k:], np.full((pop, k), NEG, cs.dtype)], axis=1)
+        levels.append(np.minimum(m, shifted))
+        k *= 2
+    target = prev - 1
+    rows = np.arange(pop)[:, None]
+    pos = np.broadcast_to(np.arange(L), (pop, L)).copy()
+    for lev in reversed(range(len(levels))):
+        step = 1 << lev
+        block_min = np.where(
+            pos < L, levels[lev][rows, np.minimum(pos, L - 1)], NEG)
+        pos = np.where(block_min > target, pos + step, pos)
+    return (np.minimum(pos, L - 1) + 1).astype(np.int32)
+
+
+def _depths_np(ends: np.ndarray, length: np.ndarray) -> np.ndarray:
+    """Numpy port of ``gp.tree.prefix_depths`` given the ends —
+    ``depth[j] = j − #{live i : end_i ≤ j}``."""
+    pop, L = ends.shape
+    live = np.arange(L)[None, :] < length[:, None]
+    rows = np.broadcast_to(np.arange(pop)[:, None], (pop, L))
+    hist = np.zeros((pop, L + 1), np.int32)
+    np.add.at(hist, (rows, np.clip(np.where(live, ends, L), 0, L)),
+              live.astype(np.int32))
+    closed_by = np.cumsum(hist, axis=1)[:, :-1]
+    return (np.arange(L)[None, :] - closed_by).astype(np.int32)
+
+
+def build_grouped_schedule(pset: PrimitiveSet, nodes: np.ndarray,
+                           consts: np.ndarray, length: np.ndarray,
+                           ends: np.ndarray, depths: np.ndarray,
+                           mask: Sequence[int], chunk: int) -> dict:
+    """Compile a concrete population into an opcode-major instruction
+    schedule (host side, numpy).
+
+    Every live operator slot becomes one instruction; instructions are
+    sorted by ``(depth desc, opcode)`` and each ``(depth, opcode)`` run
+    is padded to a multiple of ``chunk`` so every chunk is pure (single
+    opcode). Children are strictly deeper than their parents, so chunk
+    order is a valid evaluation order. Operands reference the shared
+    value buffer: rows ``0..n_args-1`` hold the input arguments,
+    row ``n_args + position`` holds instruction ``position``'s result;
+    constant operands are inlined. The chunk count is rounded up on the
+    size lattice (:func:`_round_size`) so schedules hit a bounded set
+    of compiled shapes; pad chunks run opcode 0 on argument row 0 and
+    write only their own rows (never referenced).
+    """
+    n_ops, n_args = pset.n_ops, pset.n_args
+    max_ar = max(pset.max_arity, 1)
+    const_id = pset.const_id
+    pop, ML = nodes.shape
+    branch_of = {op: b for b, op in enumerate(mask)}
+
+    live = np.arange(ML)[None, :] < length[:, None]
+    is_op = live & (nodes < n_ops)
+    ti, si = np.nonzero(is_op)
+    opc = nodes[ti, si]
+    dep = depths[ti, si]
+    order = np.lexsort((opc, -dep))
+    ti, si, opc, dep = ti[order], si[order], opc[order], dep[order]
+    ni = len(ti)
+
+    if ni:
+        grp = np.empty(ni, np.int64)
+        grp[0] = 0
+        grp[1:] = np.cumsum((dep[1:] != dep[:-1]) | (opc[1:] != opc[:-1]))
+        counts = np.bincount(grp)
+        padded = -(-counts // chunk) * chunk
+        offs = np.concatenate([[0], np.cumsum(padded)])
+        within = np.arange(ni) - np.concatenate(
+            [[0], np.cumsum(counts)])[grp]
+        posn = offs[grp] + within
+        nchunks = int(offs[-1]) // chunk
+    else:
+        posn = np.zeros(0, np.int64)
+        nchunks = 0
+    nchunks = _round_chunks(nchunks)
+    total = nchunks * chunk
+
+    # value-row index per (tree, slot): op slots -> n_args + position,
+    # argument slots -> their argument row; constants stay inline
+    val_row = np.zeros((pop, ML), np.int32)
+    val_row[ti, si] = n_args + posn
+    arg_sites = live & (nodes >= n_ops) & (nodes < const_id)
+    val_row[arg_sites] = nodes[arg_sites] - n_ops
+    const_sites = live & (nodes >= const_id)
+
+    chunk_ops = np.zeros(nchunks, np.int32)
+    if ni:
+        chunk_ops[posn // chunk] = np.vectorize(branch_of.get)(opc)
+
+    src_idx = np.zeros((total, max_ar), np.int32)
+    src_const = np.zeros((total, max_ar), np.float32)
+    src_isc = np.zeros((total, max_ar), bool)
+    if ni:
+        # children: first child = slot+1, next siblings at subtree ends
+        child = np.minimum(si + 1, ML - 1)
+        for j in range(max_ar):
+            cc = const_sites[ti, child]
+            src_idx[posn, j] = val_row[ti, child]
+            src_isc[posn, j] = cc
+            src_const[posn, j] = np.where(cc, consts[ti, child], 0.0)
+            child = np.minimum(ends[ti, child], ML - 1)
+
+    root_live = length > 0
+    root_idx = val_row[:, 0].astype(np.int32)
+    root_isc = const_sites[:, 0] | ~root_live
+    root_const = np.where(root_live, consts[:, 0], 0.0).astype(np.float32)
+    return {
+        "chunk_ops": chunk_ops, "src_idx": src_idx,
+        "src_const": src_const, "src_isc": src_isc,
+        "root_idx": root_idx, "root_const": root_const,
+        "root_isc": root_isc, "n_instructions": ni, "nchunks": nchunks,
+    }
+
+
+def _grouped_eval_builder(pset: PrimitiveSet, mask: Tuple[int, ...],
+                          chunk: int) -> Callable:
+    """The jitted chunk-loop evaluator for one live mask: sequential
+    ``lax.switch`` over pure-opcode chunks, returning the filled value
+    buffer. The ONLY data-dependent static in its signature is the
+    chunk count (latticed by :func:`_round_chunks`); root extraction is
+    done eagerly by the dispatcher so population-size classes never
+    re-specialize this function."""
+    n_args = pset.n_args
+    max_ar = max(pset.max_arity, 1)
+    branches = [
+        (lambda f=pset.primitives[op].fn, a=pset.primitives[op].arity:
+         (lambda ops: f(*ops[:a])))()
+        for op in mask
+    ] or [lambda ops: ops[0]]
+
+    @jax.jit
+    def evaluate(chunk_ops, src_idx, src_const, src_isc, X):
+        P = X.shape[0]
+        nchunks = chunk_ops.shape[0]
+        argsT = X.T.astype(jnp.float32)
+        buf = jnp.zeros((n_args + nchunks * chunk, P), jnp.float32)
+        buf = lax.dynamic_update_slice_in_dim(buf, argsT, 0, axis=0)
+
+        def step(c, buf):
+            base = c * chunk
+            si = lax.dynamic_slice_in_dim(src_idx, base, chunk)
+            sc = lax.dynamic_slice_in_dim(src_const, base, chunk)
+            sb = lax.dynamic_slice_in_dim(src_isc, base, chunk)
+            ops_in = [jnp.where(sb[:, j, None], sc[:, j, None],
+                                buf[si[:, j]]) for j in range(max_ar)]
+            res = lax.switch(chunk_ops[c], branches, ops_in)
+            return lax.dynamic_update_slice_in_dim(
+                buf, res, n_args + base, axis=0)
+
+        return lax.fori_loop(0, nchunks, step, buf)
+
+    return evaluate
+
+
+def _grouped_eval_kernel_builder(pset: PrimitiveSet,
+                                 mask: Tuple[int, ...],
+                                 chunk: int) -> Callable:
+    """TPU path: same schedule, evaluated by the Pallas fused
+    gather-dispatch-scatter kernel (one kernel launch for the whole
+    chunk sequence — see ops.kernels.gp_grouped_dispatch)."""
+    from deap_tpu.ops.kernels import gp_grouped_dispatch
+
+    n_args = pset.n_args
+    fns = [(pset.primitives[op].fn, pset.primitives[op].arity)
+           for op in mask] or [(lambda a: a, 1)]
+
+    @jax.jit
+    def evaluate(chunk_ops, src_idx, src_const, src_isc, X):
+        P = X.shape[0]
+        argsT = X.T.astype(jnp.float32)
+        nrows = n_args + chunk_ops.shape[0] * chunk
+        buf = jnp.zeros((nrows, P), jnp.float32)
+        buf = lax.dynamic_update_slice_in_dim(buf, argsT, 0, axis=0)
+        return gp_grouped_dispatch(buf, chunk_ops, src_idx, src_const,
+                                   src_isc, fns, chunk=chunk,
+                                   n_args=n_args)
+
+    return evaluate
+
+
+# --------------------------------------------------------- batch dispatch ----
+
 def make_batch_interpreter(pset: PrimitiveSet, max_len: int,
-                           mode: str = "scan") -> Callable:
+                           mode: str = "scan",
+                           specialize: str = "auto",
+                           dedup: Optional[bool] = None,
+                           points_tile: Optional[int] = None,
+                           chunk: int = DEFAULT_CHUNK) -> Callable:
     """Build ``interpret(genomes, X) -> f32[pop, points]`` over a whole
     population — the fast path for fitness evaluation.
 
     Unlike ``vmap(make_interpreter(...))``, this computes the
-    population's active length ``T = max(length)`` and bounds both
+    population's active length ``T = max(length)`` and bounds the
     interpreter passes to ``T`` slots instead of the full ``max_len``
     genome width.  ``T`` is closed over the vmapped per-tree call, so
     vmap keeps it unbatched and every buffer write stays batch-uniform
-    (the contract in :func:`run_data_pass`).  Early generations (trees
-    of 3-15 nodes in 64-slot genomes) evaluate ~4-20× less work; cost
-    tracks bloat exactly like the reference's direct ``eval`` of the
-    current trees (gp.py:462-487) rather than the genome width.
+    (the contract in :func:`run_data_pass`).
 
-    ``mode='sweep'`` switches the data pass to the level-synchronous
-    form (:func:`run_sweep_pass`): ``max-height+1`` parallel sweeps
-    over all slots instead of ``T`` serial steps.  Results are
-    identical; pick by measurement.  Measured (pop=4096, pts=256,
-    vocab 10, one CPU core): scan 136/270/327 ms vs sweep
-    1268/2261/2848 ms on small/mid/large trees — the sweeps' full-width
-    × vocab redundancy (every slot re-evaluates every primitive every
-    sweep, transcendentals included) buries the serial-step savings on
-    CPU; the mode exists for accelerator measurement, where wide fused
-    elementwise passes are closer to free and serial scan steps are
-    not.
+    :param mode: ``'scan'`` — serial slot walk (two-pass, the portable
+        default); ``'sweep'`` — level-synchronous
+        (:func:`run_sweep_pass`): ``max-height+1`` parallel sweeps over
+        all slots; ``'grouped'`` — opcode-major chunked dispatch (each
+        live primitive evaluated exactly once per site; requires
+        concrete genomes, falls back to ``scan`` under tracing; on TPU
+        the chunk loop runs as one Pallas kernel).
+    :param specialize: ``'auto'`` — when called with concrete (eager)
+        genomes, compile the select-chain for the live opcode subset
+        only, rounded monotonically (mask lattice) so recompiles are
+        bounded by ``n_ops``; ``'none'`` — always the full vocabulary
+        (the pre-specialization behaviour).
+    :param dedup: evaluate each distinct genome once and gather results
+        back (concrete path only; bit-identical). Default: on when
+        ``specialize='auto'``.
+    :param points_tile: evaluate the points axis in tiles of this many
+        rows so the ``out[T, points]`` buffer stays cache-resident at
+        large point counts (both paths; bit-identical — points never
+        interact).
+    :param chunk: grouped-mode instruction block size.
+
+    All modes/specializations return bit-identical results (pinned by
+    tests/test_gp_dispatch.py); pick by measurement — BENCH_GP.json
+    holds the per-component deltas measured by ``bench.py --gp-race``.
     """
-    if mode not in ("scan", "sweep"):
+    if mode not in ("scan", "sweep", "grouped"):
         raise ValueError(f"unknown interpreter mode {mode!r}")
+    if specialize not in ("auto", "none"):
+        raise ValueError(f"unknown specialize policy {specialize!r}")
+    dedup = (specialize == "auto") if dedup is None else dedup
 
     def build():
-        return _build_batch_interpreter(pset, max_len, mode)
+        return _build_batch_dispatcher(pset, max_len, mode, specialize,
+                                       dedup, points_tile, chunk)
 
-    return _cached_factory(pset, ("batch", max_len, mode), build)
+    return _cached_factory(
+        pset, ("batch", max_len, mode, specialize, dedup, points_tile,
+               chunk), build)
 
 
-def _build_batch_interpreter(pset: PrimitiveSet, max_len: int,
-                             mode: str) -> Callable:
-    prim_rows = _prim_rows_builder(pset)
-    ML_cap = max_len
+def _traced_batch(pset: PrimitiveSet, max_len: int, mode: str,
+                  mask: Optional[Tuple[int, ...]] = None) -> Callable:
+    """The pure traced population interpreter (usable inside user jit):
+    scan or sweep over the live prefix, optionally mask-specialized."""
+    prim_rows = _prim_rows_builder(pset, mask)
     arity = pset.arity_table()
+    ML_cap = max_len
 
     def interpret_batch(genomes, X):
         ML = min(genomes["nodes"].shape[-1], ML_cap)
@@ -373,23 +686,196 @@ def _build_batch_interpreter(pset: PrimitiveSet, max_len: int,
     return interpret_batch
 
 
+def _points_pad(X, tile: int):
+    P = X.shape[0]
+    nt = -(-P // tile)
+    pad = nt * tile - P
+    if pad:
+        X = jnp.concatenate([X, jnp.broadcast_to(X[:1], (pad,) + X.shape[1:])])
+    return X, nt, P
+
+
+def _build_batch_dispatcher(pset: PrimitiveSet, max_len: int, mode: str,
+                            specialize: str, dedup: bool,
+                            points_tile: Optional[int],
+                            chunk: int) -> Callable:
+    pset.arity_table()  # warm the table cache outside any trace
+    base_mode = "scan" if mode == "grouped" else mode
+    base = _traced_batch(pset, max_len, base_mode)
+    if points_tile:
+        base_untiled = base
+
+        def base(genomes, X):
+            Xp, nt, P = _points_pad(X, points_tile)
+            tiles = Xp.reshape(nt, points_tile, -1)
+            preds = lax.map(lambda xt: base_untiled(genomes, xt), tiles)
+            return jnp.moveaxis(preds, 0, 1).reshape(
+                genomes["length"].shape[0], nt * points_tile)[:, :P]
+
+    if specialize == "none":
+        return base
+
+    state = {"mask": (), "journaled": None}
+    arity_np = np.asarray([p.arity for p in pset.primitives]
+                          + [0] * (pset.vocab - pset.n_ops), np.int32)
+
+    def _mask_for(nodes_np, length_np):
+        used = _used_ops(pset.n_ops, nodes_np, length_np)
+        mask = tuple(sorted(set(state["mask"]) | set(used)))
+        state["mask"] = mask
+        return mask
+
+    def _jit_traced(mask, key):
+        return _cached_factory(
+            pset, key + (mask,),
+            lambda: jax.jit(_traced_batch(pset, max_len, base_mode,
+                                          mask)))
+
+    def _grouped_fn(mask):
+        backend = jax.default_backend()
+        if backend == "tpu":
+            return _cached_factory(
+                pset, ("grpk", max_len, chunk, mask),
+                lambda: _grouped_eval_kernel_builder(pset, mask, chunk))
+        return _cached_factory(
+            pset, ("grp", max_len, chunk, mask),
+            lambda: _grouped_eval_builder(pset, mask, chunk))
+
+    def _journal(mask, extra):
+        tag = (mask,) + tuple(sorted(extra.items()))
+        if state["journaled"] != tag:
+            state["journaled"] = tag
+            from deap_tpu.telemetry.journal import broadcast
+            broadcast("gp_dispatch", mode=mode,
+                      mask=[pset.primitives[i].name for i in mask],
+                      **extra)
+
+    def _concrete_unique(genomes, X):
+
+        nodes_np = np.asarray(genomes["nodes"])[:, :max_len]
+        consts_np = np.asarray(genomes["consts"])[:, :max_len]
+        length_np = np.asarray(genomes["length"])
+        pop = nodes_np.shape[0]
+        mask = _mask_for(nodes_np, length_np)
+
+        first = inv = None
+        if dedup:
+            first, inv = _dedup_rows(nodes_np, consts_np, length_np)
+
+        if mode == "grouped":
+            # the grouped evaluator's only shape class is the chunk
+            # count, so the deduped subset needs no padding here
+            if dedup:
+                nodes_np, consts_np = nodes_np[first], consts_np[first]
+                length_np = length_np[first]
+            ends = _ends_np(nodes_np, length_np, arity_np)
+            depths = _depths_np(ends, length_np)
+            sched = build_grouped_schedule(
+                pset, nodes_np, consts_np, length_np, ends, depths,
+                mask, chunk)
+            fn = _grouped_fn(mask)
+            args = [jnp.asarray(sched[k]) for k in
+                    ("chunk_ops", "src_idx", "src_const", "src_isc")]
+            _journal(mask, {"nchunks": sched["nchunks"],
+                            "n_unique": len(first) if dedup else pop})
+            ri, rc, rb = (sched["root_idx"], sched["root_const"],
+                          sched["root_isc"])
+            if dedup:
+                # latticed root count: the eager root gather otherwise
+                # compiles per exact unique-count shape every call
+                nr = min(_round_size(len(ri)), pop)
+                ri, rc, rb = (np.resize(ri, nr), np.resize(rc, nr),
+                              np.resize(rb, nr))
+            root_isc = jnp.asarray(rb)[:, None]
+            root_const = jnp.asarray(rc)[:, None]
+            root_idx = jnp.asarray(ri)
+            if points_tile:
+                Xp, nt, P = _points_pad(X, points_tile)
+                outs = [fn(*args, Xp[t * points_tile:
+                                     (t + 1) * points_tile])
+                        for t in range(nt)]
+                buf = jnp.concatenate(outs, axis=1)[:, :P]
+            else:
+                buf = fn(*args, X)
+            preds = jnp.where(root_isc, root_const, buf[root_idx])
+        else:
+            if dedup:
+                # jitted per sub-batch shape: pad the unique count on
+                # the size lattice so shape classes stay bounded
+                nu = _round_size(len(first), floor=min(8, pop))
+                sel = np.resize(first, min(nu, pop))
+                nodes_np, consts_np = nodes_np[sel], consts_np[sel]
+                length_np = length_np[sel]
+            sub = {"nodes": jnp.asarray(nodes_np),
+                   "consts": jnp.asarray(consts_np),
+                   "length": jnp.asarray(length_np)}
+            fn = _jit_traced(mask, ("batchj", max_len, base_mode,
+                                    bool(points_tile), points_tile))
+            _journal(mask, {"n_unique": len(first) if dedup else pop})
+            if points_tile:
+                Xp, nt, P = _points_pad(X, points_tile)
+                outs = [fn(sub, Xp[t * points_tile:(t + 1) * points_tile])
+                        for t in range(nt)]
+                preds = jnp.concatenate(outs, axis=1)[:, :P]
+            else:
+                preds = fn(sub, X)
+
+        if dedup:
+            return preds, jnp.asarray(inv)
+        return preds[:pop], None
+
+    def interpret_batch(genomes, X):
+        leaves = [genomes["nodes"], genomes["consts"],
+                  genomes["length"], X]
+        if not _is_concrete(*leaves):
+            return base(genomes, X)
+        preds, inv = _concrete_unique(genomes, X)
+        return preds if inv is None else preds[inv]
+
+    def interpret_unique(genomes, X):
+        """(preds, inverse) without the un-dedup expansion: callers
+        reducing preds to per-tree scalars (fitness) should reduce
+        FIRST and gather the scalars through ``inverse`` — that skips
+        a [pop, points] gather per evaluation. ``inverse`` is None
+        when nothing was deduplicated (use preds row-for-row)."""
+        leaves = [genomes["nodes"], genomes["consts"],
+                  genomes["length"], X]
+        if not _is_concrete(*leaves):
+            return base(genomes, X), None
+        return _concrete_unique(genomes, X)
+
+    interpret_batch.unique = interpret_unique
+    return interpret_batch
+
+
 def make_population_evaluator(pset: PrimitiveSet, max_len: int,
                               loss: Callable,
-                              mode: str = "scan") -> Callable:
+                              mode: str = "scan",
+                              **dispatch_kwargs) -> Callable:
     """``evaluate(genomes, X, y) -> f32[pop]``-style batched evaluator:
     interpret every tree on every datapoint and reduce with ``loss(pred,
     X, ...)``. The usual symbolic-regression fitness (mean squared error
     over the sample points, examples/gp/symbreg.py:55-61) is
     ``loss=lambda pred, y: jnp.mean((pred - y) ** 2)``.
 
-    ``mode`` is forwarded to :func:`make_batch_interpreter` — keep the
-    default ``"scan"`` on CPU; ``"sweep"`` is the level-synchronous
-    variant for accelerator measurement.
+    ``mode`` and the specialization knobs are forwarded to
+    :func:`make_batch_interpreter` — keep the default ``"scan"`` inside
+    jit; eager callers get live-vocab masking and unique-genome
+    dispatch automatically (``specialize='auto'``), and may pick
+    ``mode='grouped'`` for opcode-major evaluation.
     """
-    interp = make_batch_interpreter(pset, max_len, mode=mode)
+    interp = make_batch_interpreter(pset, max_len, mode=mode,
+                                    **dispatch_kwargs)
+    unique = getattr(interp, "unique", None)
 
     def evaluate(genomes, X, y):
-        preds = interp(genomes, X)                          # [pop, points]
-        return jax.vmap(lambda p: loss(p, y))(preds)
+        if unique is None:
+            preds = interp(genomes, X)                      # [pop, points]
+            return jax.vmap(lambda p: loss(p, y))(preds)
+        # reduce on the UNIQUE rows, then expand the per-tree scalars:
+        # skips a [pop, points] un-dedup gather per evaluation
+        preds, inv = unique(genomes, X)
+        vals = jax.vmap(lambda p: loss(p, y))(preds)
+        return vals if inv is None else vals[inv]
 
     return evaluate

@@ -1,0 +1,288 @@
+"""Host-dispatch GP generation engine — the loop shape that lets the
+interpreter's live-population specialization actually engage.
+
+The jit'd ``lax.scan`` loops in :mod:`deap_tpu.algorithms` trace the
+evaluator once, so everything inside is shape-static and full-vocab:
+the interpreter cannot specialize on what the *current* population
+contains. This engine instead drives one generation at a time from the
+host — selection and variation stay jit-compiled on device, while
+evaluation dispatches through the concrete-genome path of
+``gp.make_batch_interpreter`` (live-vocab masks, unique-genome dedup,
+opcode-major grouped mode). Two further reference behaviours that the
+scan loops pay for but the reference never did become free here:
+
+- **Invalid-only evaluation, for real.** ``evaluate_invalid`` computes
+  every row and masks the write (the only formulation a traced scan
+  allows); with cxpb=0.5/mutpb=0.1 that is ~2× the reference's work.
+  Here the touched mask is concrete, so only touched rows are gathered
+  and evaluated — exactly ``nevals`` of the reference loop
+  (algorithms.py:149-152).
+- **Algebraic height limits.** ``static_limit`` re-derives every
+  offspring's height from scratch (an O(L log L) all-ends query per
+  variation operator — measured 2×28 ms/gen at pop=4096 on one CPU
+  core). A splice cannot change the depth of any node outside the
+  spliced subtree, so this engine threads per-tree *depth arrays*
+  through every splice: the donor segment's depths shift by
+  ``depth[target] − depth[donor root]`` and everything else is copied —
+  the child's height is a masked max, no tree walk. The carried depths
+  are pinned equal to ``prefix_depths`` recomputation by
+  tests/test_gp_dispatch.py.
+
+Semantics match ``algorithms.ea_simple`` + ``var_and`` with
+``static_limit``-wrapped one-point crossover and uniform mutation
+(keep-parent on limit breach or overflow; adjacent-pair mating;
+touched-row invalidation); RNG streams differ, as everywhere in this
+framework. ``bench.py --gp-race`` races this engine against the
+scan-loop formulation live (BENCH_GP.json).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deap_tpu import ops
+from deap_tpu.gp.interpreter import (DEFAULT_CHUNK, _round_size,
+                                     make_batch_interpreter)
+from deap_tpu.gp.pset import PrimitiveSet
+from deap_tpu.gp.tree import (make_generator, prefix_depths, subtree_end,
+                              _splice)
+
+
+def _splice_depths(dep, i, e, donor_dep, di, donor_len, shift, ok):
+    """Depth array of ``_splice(g, i, e, donor, di, donor_len)``: head
+    and tail keep their depths (a splice cannot re-depth anything
+    outside the replaced subtree), the donor segment shifts by
+    ``shift = dep[i] − donor_dep[di]``. ``ok`` mirrors _splice's
+    overflow keep-parent."""
+    L = dep.shape[0]
+    k = jnp.arange(L)
+    seg = e - i
+    in_head = k < i
+    in_donor = (k >= i) & (k < i + donor_len)
+    src_tail = jnp.clip(k - donor_len + seg, 0, L - 1)
+    src_donor = jnp.clip(di + k - i, 0, L - 1)
+    mixed = jnp.where(in_head, dep,
+                      jnp.where(in_donor, donor_dep[src_donor] + shift,
+                                dep[src_tail]))
+    return jnp.where(ok, mixed, dep)
+
+
+def _height(dep, length):
+    live = jnp.arange(dep.shape[0]) < length
+    return jnp.max(jnp.where(live, dep, 0))
+
+
+def make_gp_loop(pset: PrimitiveSet, max_len: int, evaluate: Callable, *,
+                 cxpb: float, mutpb: float, tournsize: int = 3,
+                 height_limit: int = 17,
+                 mut_min: int = 0, mut_max: int = 2,
+                 mut_width: Optional[int] = None) -> Callable:
+    """Build ``run(key, genomes, ngen) -> result`` — the host-dispatch
+    eaSimple-shaped GP loop (tournament selection, adjacent-pair
+    one-point crossover at ``cxpb``, uniform subtree mutation at
+    ``mutpb`` with a fresh genFull(mut_min, mut_max) donor, Koza
+    ``height_limit`` keep-parent, invalid-only evaluation).
+
+    ``evaluate(genomes) -> f32[n]`` maximization fitness, called
+    EAGERLY with concrete sub-populations — pair it with a
+    ``make_batch_interpreter``/``make_population_evaluator`` evaluator
+    so the live-vocab/dedup/grouped dispatch engages. The result dict
+    carries the final population + depth arrays, the best individual,
+    and the reference-comparable ``nevals`` per generation."""
+    arity = pset.arity_table()
+    mut_width = mut_width or min(max_len, 32)
+    expr = make_generator(pset, mut_width, mut_min, mut_max, "full")
+    ML = max_len
+
+    depths_of = jax.jit(jax.vmap(
+        lambda g: prefix_depths(g["nodes"], g["length"], arity)))
+
+    def pair_cx(key, g1, d1, g2, d2):
+        k1, k2 = jax.random.split(key)
+        len1, len2 = g1["length"], g2["length"]
+        ok = (len1 >= 2) & (len2 >= 2)
+        i1 = jnp.where(len1 >= 2,
+                       jax.random.randint(k1, (), 1, jnp.maximum(len1, 2)), 0)
+        i2 = jnp.where(len2 >= 2,
+                       jax.random.randint(k2, (), 1, jnp.maximum(len2, 2)), 0)
+        e1 = subtree_end(g1["nodes"], arity, i1)
+        e2 = subtree_end(g2["nodes"], arity, i2)
+        c1 = _splice(g1, i1, e1, g2["nodes"], g2["consts"], i2, e2 - i2)
+        c2 = _splice(g2, i2, e2, g1["nodes"], g1["consts"], i1, e1 - i1)
+        # _splice keeps the parent on overflow; mirror its predicate so
+        # the depth arrays revert in lockstep
+        ok1 = ok & (g1["length"] - (e1 - i1) + (e2 - i2) <= ML)
+        ok2 = ok & (g2["length"] - (e2 - i2) + (e1 - i1) <= ML)
+        dd1 = _splice_depths(d1, i1, e1, d2, i2, e2 - i2,
+                             d1[i1] - d2[i2], ok1)
+        dd2 = _splice_depths(d2, i2, e2, d1, i1, e1 - i1,
+                             d2[i2] - d1[i1], ok2)
+        bad1 = ~ok | (_height(dd1, c1["length"]) > height_limit)
+        bad2 = ~ok | (_height(dd2, c2["length"]) > height_limit)
+        keep = lambda bad, c, g: jax.tree_util.tree_map(
+            lambda a, b: jnp.where(bad, b, a), c, g)
+        c1 = keep(bad1, c1, g1)
+        c2 = keep(bad2, c2, g2)
+        dd1 = jnp.where(bad1, d1, dd1)
+        dd2 = jnp.where(bad2, d2, dd2)
+        return c1, dd1, c2, dd2
+
+    def one_mut(key, g, d):
+        k_i, k_e = jax.random.split(key)
+        i = jax.random.randint(k_i, (), 0, jnp.maximum(g["length"], 1))
+        e = subtree_end(g["nodes"], arity, i)
+        new = expr(k_e)
+        new_dep = prefix_depths(new["nodes"], new["length"], arity)
+        c = _splice(g, i, e, new["nodes"], new["consts"], 0,
+                    new["length"])
+        ok = g["length"] - (e - i) + new["length"] <= ML
+        dd = _splice_depths(d, i, e, new_dep, 0, new["length"],
+                            d[i], ok)
+        bad = _height(dd, c["length"]) > height_limit
+        c = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(bad, b, a), c, g)
+        dd = jnp.where(bad, d, dd)
+        return c, dd
+
+    @jax.jit
+    def select(key, genomes, depths, fit):
+        n = fit.shape[0]
+        idx = ops.sel_tournament(key, fit[:, None], n,
+                                 tournsize=tournsize)
+        return (jax.tree_util.tree_map(lambda a: a[idx], genomes),
+                depths[idx], fit[idx])
+
+    @partial(jax.jit, static_argnums=1)
+    def draw_flags(key, n):
+        k_pair, k_ind = jax.random.split(key)
+        return (jax.random.bernoulli(k_pair, cxpb, (n // 2,)),
+                jax.random.bernoulli(k_ind, mutpb, (n,)))
+
+    @jax.jit
+    def cx_apply(key, genomes, depths, pp):
+        """Gather the drawn pairs, cross them, scatter the offspring —
+        one fused jit. Keys derive from the PAIR id, not the array
+        position: lattice padding cycles indices, and duplicate
+        scatters are only deterministic when duplicates compute the
+        same offspring (np.resize pads by cycling, so row k of the
+        computed sub-batch always belongs to pp[k])."""
+        rows_e, rows_o = pp * 2, pp * 2 + 1
+        g_e = jax.tree_util.tree_map(lambda a: a[rows_e], genomes)
+        g_o = jax.tree_util.tree_map(lambda a: a[rows_o], genomes)
+        keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(pp)
+        c1, dd1, c2, dd2 = jax.vmap(pair_cx)(
+            keys, g_e, depths[rows_e], g_o, depths[rows_o])
+        genomes = jax.tree_util.tree_map(
+            lambda a, s1, s2: a.at[rows_e].set(s1).at[rows_o].set(s2),
+            genomes, c1, c2)
+        return genomes, depths.at[rows_e].set(dd1).at[rows_o].set(dd2)
+
+    @jax.jit
+    def mut_apply(key, genomes, depths, mp):
+        g_m = jax.tree_util.tree_map(lambda a: a[mp], genomes)
+        keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(mp)
+        m_g, m_d = jax.vmap(one_mut)(keys, g_m, depths[mp])
+        genomes = jax.tree_util.tree_map(
+            lambda a, s: a.at[mp].set(s), genomes, m_g)
+        return genomes, depths.at[mp].set(m_d)
+
+    def vary(key, genomes, depths, n):
+        """Host-compacted var_and: crossover/mutation are computed only
+        for the rows the cxpb/mutpb draws actually touch (the scan
+        formulation computes every candidate and selects -- ~2x/10x the
+        work at the default rates), padded on the size lattice so
+        compacted shapes stay cache-warm. Semantics match var_and:
+        adjacent pairs mate with prob cxpb, every row then mutates with
+        prob mutpb, touched rows are invalidated."""
+        k_draw, k_cx, k_mut = jax.random.split(key, 3)
+        do_cx, do_mut = draw_flags(k_draw, n)
+        do_cx, do_mut = np.asarray(do_cx), np.asarray(do_mut)
+
+        pidx = np.nonzero(do_cx)[0]
+        if len(pidx):
+            pp = np.resize(pidx,
+                           min(_round_size(len(pidx)), max(n // 2, 1)))
+            genomes, depths = cx_apply(k_cx, genomes, depths,
+                                       jnp.asarray(pp))
+
+        midx = np.nonzero(do_mut)[0]
+        if len(midx):
+            mp = np.resize(midx, min(_round_size(len(midx)), n))
+            genomes, depths = mut_apply(k_mut, genomes, depths,
+                                        jnp.asarray(mp))
+
+        touched = np.zeros(n, bool)
+        touched[pidx * 2] = True
+        touched[pidx * 2 + 1] = True
+        touched[midx] = True
+        return genomes, depths, touched
+
+    def run(key, genomes, ngen: int):
+        n = int(np.asarray(genomes["length"]).shape[0])
+        depths = depths_of(genomes)
+        fit = evaluate(genomes)
+        nevals = [n]
+        best_i = int(jnp.argmax(fit))
+        best = (jax.tree_util.tree_map(lambda a: a[best_i], genomes),
+                float(fit[best_i]))
+        for gen in range(1, ngen + 1):
+            k = jax.random.fold_in(key, gen)
+            k_sel, k_var = jax.random.split(k)
+            genomes, depths, fit = select(k_sel, genomes, depths, fit)
+            genomes, depths, touched = vary(k_var, genomes, depths, n)
+            idx = np.nonzero(touched)[0]
+            ne = len(idx)
+            nevals.append(ne)
+            if ne:
+                padded = np.resize(idx, min(_round_size(ne), n))
+                sub = jax.tree_util.tree_map(
+                    lambda a: a[jnp.asarray(padded)], genomes)
+                w = evaluate(sub)
+                # full-padded scatter (cycled duplicates agree) — see
+                # _scatter in vary for the shape-class rationale
+                fit = fit.at[jnp.asarray(padded)].set(w)
+            best_i = int(jnp.argmax(fit))
+            if float(fit[best_i]) > best[1]:
+                best = (jax.tree_util.tree_map(
+                    lambda a: a[best_i], genomes), float(fit[best_i]))
+        return {"genomes": genomes, "depths": depths, "fitness": fit,
+                "best_genome": best[0], "best_fitness": best[1],
+                "nevals": nevals}
+
+    run.select = select              # exposed for tests
+    run.vary = vary
+    run.depths_of = depths_of
+    return run
+
+
+def make_symbreg_loop(pset: PrimitiveSet, max_len: int, X, y, *,
+                      cxpb: float = 0.5, mutpb: float = 0.1,
+                      mode: str = "grouped", chunk: int = DEFAULT_CHUNK,
+                      dedup: Optional[bool] = None,
+                      points_tile: Optional[int] = None,
+                      **loop_kwargs) -> Callable:
+    """The canonical symbolic-regression configuration of
+    :func:`make_gp_loop`: negative-MSE fitness through the specialized
+    batch interpreter (``mode='grouped'`` + dedup by default)."""
+    interp = make_batch_interpreter(pset, max_len, mode=mode,
+                                    chunk=chunk, dedup=dedup,
+                                    points_tile=points_tile)
+    y = jnp.asarray(y, jnp.float32)
+    mse = jax.jit(lambda preds: -jnp.mean((preds - y[None, :]) ** 2,
+                                          axis=1))
+
+    def evaluate(genomes):
+        # fitness reduces on the unique rows; only the scalars expand
+        preds, inv = interp.unique(genomes, X)
+        vals = mse(preds)
+        return vals if inv is None else vals[inv]
+
+    return make_gp_loop(pset, max_len, evaluate, cxpb=cxpb, mutpb=mutpb,
+                        **loop_kwargs)

@@ -172,6 +172,12 @@ class PrimitiveSet:
         n_term = self.vocab - self.n_ops
         table = jnp.asarray(
             [p.arity for p in self.primitives] + [0] * n_term, jnp.int32)
+        if isinstance(table, jax.core.Tracer) or not (
+                jax.core.trace_state_clean()):
+            # first call happened under a trace: the array belongs to
+            # that trace — handing it to a later caller would leak a
+            # tracer, so serve it uncached
+            return table
         self._arity_table_cache = (key, table)
         return table
 

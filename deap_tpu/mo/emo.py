@@ -578,6 +578,52 @@ class SelNSGA3WithMemory:
 
 # ------------------------------------------------------------------ SPEA2 ----
 
+def _two_sum(a, b):
+    """Error-free float addition: returns (s, err) with s = fl(a+b)
+    and s + err == a + b exactly (Knuth TwoSum; XLA does not
+    reassociate floats, so the transform survives jit)."""
+    s = a + b
+    bb = s - a
+    return s, (a - (s - bb)) + (b - bb)
+
+
+def _two_prod_f32(a, b):
+    """Error-free f32 product via Veltkamp splitting (no FMA in XLA's
+    portable op set): (p, err) with p = fl(a·b), p + err == a·b."""
+    split = jnp.float32(4097.0)            # 2^12 + 1 for f32
+    ca, cb = a * split, b * split
+    ah = ca - (ca - a)
+    al = a - ah
+    bh = cb - (cb - b)
+    bl = b - bh
+    p = a * b
+    err = ((ah * bh - p) + ah * bl + al * bh) + al * bl
+    return p, err
+
+
+def _d2_compensated(w: jnp.ndarray):
+    """Pairwise squared distances in double-float32: (hi, lo) with
+    hi = the f32 head and lo the residual, together carrying ~48
+    significant bits — enough to reproduce the reference's float64 tie
+    structure from f32 inputs WITHOUT float64 hardware (f32 is the
+    TPU-native dtype; plain f32 distances collapse distinct f64
+    distances into spurious ties, measured 0.85 truncation-set overlap
+    on the adversarial tied front before this, PARITY.md)."""
+    n, nobj = w.shape
+    hi = jnp.zeros((n, n), jnp.float32)
+    lo = jnp.zeros((n, n), jnp.float32)
+    for c in range(nobj):                  # nobj is tiny and static
+        a = w[:, c][:, None]
+        b = w[:, c][None, :]
+        d, derr = _two_sum(a, -b)          # exact difference
+        p, perr = _two_prod_f32(d, d)
+        # (d + derr)² = d² + 2·d·derr + derr²; d² = p + perr exactly
+        corr = perr + 2.0 * d * derr + derr * derr
+        hi, e = _two_sum(hi, p)
+        lo = lo + (e + corr)
+    return hi, lo
+
+
 def _knn_density(d2: jnp.ndarray, kth: jnp.ndarray) -> jnp.ndarray:
     """SPEA2 density ``1/(σ_k + 2)`` (emo.py:726-746) from a square
     pairwise-distance matrix. The diagonal is excluded, and ``kth`` is
@@ -618,7 +664,20 @@ def sel_spea2(key, w, k):
     fill_score = raw + density
     under_order = jnp.lexsort((fill_score, ~nd_mask))
 
-    # ---- over-full: truncation among the non-dominated set
+    # ---- over-full: truncation among the non-dominated set.
+    # float32 inputs get double-float (hi, lo) distances: plain f32
+    # squared distances collapse distinct reference-f64 distances into
+    # spurious ties, so the truncation removed different members on
+    # tie-heavy fronts (0.85 set overlap, VERDICT r5 weak #7). The
+    # compensated pair carries ~48 significant bits and reproduces the
+    # f64 tie structure on the TPU-native dtype; float64 inputs keep
+    # the plain single-key compare (already reference-exact there).
+    extended = w.dtype == jnp.float32
+    if extended:
+        d2_hi, d2_lo = _d2_compensated(w)
+    else:
+        d2_hi, d2_lo = d2, jnp.zeros_like(d2)
+
     def truncate(nd_mask):
         def cond(state):
             mask, count = state
@@ -627,9 +686,15 @@ def sel_spea2(key, w, k):
         def body(state):
             mask, count = state
             big = jnp.inf
-            dd = jnp.where(mask[:, None] & mask[None, :], d2, big)
-            dd = jnp.where(jnp.eye(n, dtype=bool), big, dd)
-            rows = jnp.sort(dd, axis=1)  # [n, n] ascending NN distances
+            alive = mask[:, None] & mask[None, :]
+            off_diag = ~jnp.eye(n, dtype=bool)
+            ddh = jnp.where(alive & off_diag, d2_hi, big)
+            ddl = jnp.where(alive & off_diag, d2_lo, 0.0)
+            # per-row ascending NN distances, ordered by the FULL
+            # (hi, lo) value — lo only decides among equal-hi entries
+            order = jnp.lexsort((ddl, ddh), axis=-1)
+            rows_h = jnp.take_along_axis(ddh, order, axis=1)
+            rows_l = jnp.take_along_axis(ddl, order, axis=1)
             # lexicographic argmin over rows, masked, to FULL depth —
             # the reference's removal scan (emo.py:776-790) compares
             # sorted-distance vectors until they differ, however deep;
@@ -645,10 +710,14 @@ def sel_spea2(key, w, k):
 
             def tie_body(s):
                 cand, j = s
-                col = jnp.where(
+                colh = jnp.where(
                     cand, lax.dynamic_index_in_dim(
-                        rows, j, axis=1, keepdims=False), big)
-                return cand & (col == jnp.min(col)), j + 1
+                        rows_h, j, axis=1, keepdims=False), big)
+                cand = cand & (colh == jnp.min(colh))
+                coll = jnp.where(
+                    cand, lax.dynamic_index_in_dim(
+                        rows_l, j, axis=1, keepdims=False), big)
+                return cand & (coll == jnp.min(coll)), j + 1
 
             cand, _ = lax.while_loop(
                 tie_cond, tie_body, (mask, jnp.int32(0)))

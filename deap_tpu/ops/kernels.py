@@ -38,6 +38,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -49,6 +50,7 @@ __all__ = [
     "nd_rank_tiled",
     "fused_variation_eval",
     "run_fused_kernel",
+    "gp_grouped_dispatch",
 ]
 
 _INV24 = 1.0 / (1 << 24)
@@ -296,6 +298,105 @@ def nd_rank_tiled(w: jnp.ndarray, max_fronts: Optional[int] = None, *,
         ranks = jax.lax.cond(remaining.any() & (current >= stop),
                              count_rank, lambda r: r, ranks)
     return (ranks, current) if return_peels else ranks
+
+
+# ------------------------------------------- GP opcode-major dispatch ----
+
+def gp_grouped_dispatch(buf: jnp.ndarray, chunk_ops: jnp.ndarray,
+                        src_idx: jnp.ndarray, src_const: jnp.ndarray,
+                        src_isc: jnp.ndarray, ops_fns, *, chunk: int,
+                        n_args: int,
+                        interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Fused gather-dispatch-scatter for the opcode-major GP
+    interpreter (gp/interpreter.py ``mode='grouped'``).
+
+    The whole chunk sequence runs as ONE kernel launch: grid step ``c``
+    DMAs its ``chunk`` instructions' operand rows out of the shared
+    value buffer (held in HBM, input/output-aliased), applies exactly
+    one primitive — ``ops_fns[chunk_ops[c]]`` — to the gathered block,
+    and DMAs the result back to the chunk's own contiguous rows. TPU
+    grid steps execute in order, so the data dependency (children sort
+    into earlier chunks) is honoured without host round trips; the XLA
+    formulation pays a ``dynamic_slice``/``switch``/``update`` dispatch
+    per chunk instead.
+
+    :param buf: ``f32[n_args + nchunks·chunk, P]`` value buffer with
+        the argument rows filled; returned with every instruction row
+        computed (donated/aliased).
+    :param chunk_ops: ``int32[nchunks]`` branch index per chunk.
+    :param src_idx: ``int32[nchunks·chunk, max_ar]`` operand row ids.
+    :param src_const: ``f32[...]`` inline constants where ``src_isc``.
+    :param src_isc: operand-is-constant mask (any numeric/bool dtype).
+    :param ops_fns: ``[(fn, arity), ...]`` — the live primitives.
+    """
+    R, P = buf.shape
+    nchunks = chunk_ops.shape[0]
+    max_ar = src_idx.shape[1]
+    interp = _auto_interpret(interpret)
+    isc = src_isc.astype(jnp.float32)
+
+    def kernel(op_ref, si_ref, sc_ref, sb_ref, buf_ref, out_ref,
+               gath_ref, res_ref, sem, out_sem):
+        del buf_ref  # aliased with out_ref; all access goes through out
+        c = pl.program_id(0)
+
+        def fetch(k, _):
+            # operand rows come from the OUTPUT ref: it aliases the
+            # input buffer, and earlier chunks' results live there
+            for j in range(max_ar):
+                cp = pltpu.make_async_copy(
+                    out_ref.at[si_ref[k, j]], gath_ref.at[j, k], sem)
+                cp.start()
+                cp.wait()
+            return 0
+
+        lax.fori_loop(0, chunk, fetch, 0, unroll=False)
+        # constants REPLACE the gathered row (a select, not a blend —
+        # a gathered NaN/inf must not leak through the constant path)
+        ops_in = [jnp.where(sb_ref[:, j][:, None] > 0.5,
+                            sc_ref[:, j][:, None], gath_ref[j])
+                  for j in range(max_ar)]
+        for b, (fn, ar) in enumerate(ops_fns):
+            @pl.when(op_ref[0] == b)
+            def _(fn=fn, ar=ar):
+                res_ref[:] = fn(*ops_in[:ar])
+        cp = pltpu.make_async_copy(
+            res_ref, out_ref.at[pl.ds(n_args + c * chunk, chunk)],
+            out_sem)
+        cp.start()
+        cp.wait()
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        grid=(nchunks,),
+        in_specs=[
+            pl.BlockSpec((1,), lambda c: (c,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((chunk, max_ar), lambda c: (c, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((chunk, max_ar), lambda c: (c, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk, max_ar), lambda c: (c, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((max_ar, chunk, P), jnp.float32),
+            pltpu.VMEM((chunk, P), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((R, P), jnp.float32),
+        input_output_aliases={4: 0},
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interp,
+    )(chunk_ops, src_idx, src_const, isc, buf)
 
 
 # ------------------------------------------------- fused bitstring varAnd ----

@@ -161,17 +161,36 @@ def test_tsp_gr24_reaches_reference_optimum():
     """Same comparability gate on the larger gr24 instance: since the
     r5 memetic upgrade (shuffle kick + batched 2-opt polish,
     ops.mut_two_opt) the seeded full-config run reaches the published
-    optimum 1272 exactly (was 1347, a 5.9% gap, under pure
-    PMX+shuffle). Skipped where the reference tree is absent."""
+    optimum 1272 (was 1347, a 5.9% gap, under pure PMX+shuffle).
+
+    A missing reference instance FAILS this test rather than skipping
+    it (VERDICT r5 weak #9: the silent skip made the repo demonstrate
+    nothing on real TSPLIB data while looking green) — opt out
+    explicitly with DEAP_TPU_ALLOW_MISSING_REF=1 on hosts that never
+    vendored the reference tree. The quality bar is a pinned-seed
+    tolerance band around the published optimum, not exact float
+    equality: a platform/JAX-version RNG change may land a near-optimal
+    tour, and `best == 1272.0` was flaky-by-construction."""
+    import os
     import pathlib
 
     gr24 = pathlib.Path("/root/reference/examples/ga/tsp/gr24.json")
     if not gr24.exists():
-        pytest.skip("reference gr24 instance not available")
+        if os.environ.get("DEAP_TPU_ALLOW_MISSING_REF"):
+            pytest.skip("reference gr24 instance not available "
+                        "(DEAP_TPU_ALLOW_MISSING_REF set)")
+        pytest.fail(
+            f"reference TSP instance {gr24} is absent — the gr24 "
+            "comparability gate cannot run. Vendor the instance or set "
+            "DEAP_TPU_ALLOW_MISSING_REF=1 to acknowledge the gap "
+            "explicitly (it no longer skips silently).")
     from examples.ga import tsp
 
     best = tsp.main(smoke=False, instance=str(gr24))
-    assert best == 1272.0, best
+    # published optimum 1272; accept a pinned-seed band of +1.5% so a
+    # platform RNG drift that lands a near-optimal tour doesn't flake,
+    # while a real regression (the pre-r5 1347 = +5.9%) still fails
+    assert 1272.0 <= best <= 1272.0 * 1.015, best
 
 
 @pytest.mark.slow

@@ -1,0 +1,350 @@
+"""Live-population specialization parity suite.
+
+Every dispatch specialization of the batched GP interpreter —
+live-vocab masks, unique-genome dedup, opcode-major grouped mode, the
+Pallas fused dispatch kernel, points tiling — must be BIT-identical to
+the plain full-vocab scan interpreter; specialization is a performance
+decision, never a semantics one. Also pins the mask-lattice retrace
+budget (via the telemetry journal's build events), the ADF mask
+composition, and the host-dispatch loop engine's algebraically-carried
+depth arrays.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deap_tpu import gp
+from deap_tpu.gp.interpreter import (
+    _dedup_rows,
+    _depths_np,
+    _ends_np,
+    _grouped_eval_kernel_builder,
+    _round_chunks,
+    _used_ops,
+    build_grouped_schedule,
+)
+from deap_tpu.gp.tree import prefix_depths, subtree_ends_all
+
+ML = 48
+
+
+def _population(pset, seeds, min_d=1, max_d=5, ml=ML):
+    gen = gp.gen_half_and_half(pset, ml, min_d, max_d)
+    pop = [gen(jax.random.key(s)) for s in seeds]
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *pop)
+
+
+def _bloat_varying(pset, ml=ML):
+    """Tiny trees, deep trees, and duplicated rows in one population —
+    the shapes that exercise max_active bounding, dedup, and the
+    grouped schedule's (depth, opcode) runs at once."""
+    small = _population(pset, range(8), 0, 1, ml)
+    deep = _population(pset, range(100, 108), 4, 6, ml)
+    pop = jax.tree_util.tree_map(
+        lambda a, b: jnp.concatenate([a, b, a[:4]]), small, deep)
+    return pop
+
+
+@pytest.fixture(scope="module")
+def pset():
+    ps = gp.math_set(n_args=2)
+    ps.arity_table()
+    return ps
+
+
+@pytest.fixture(scope="module")
+def X():
+    return jnp.stack([jnp.linspace(-2.0, 2.0, 33),
+                      jnp.linspace(0.5, 3.0, 33)], axis=1)
+
+
+#: (pset id, pop fingerprint) -> reference output; the full-vocab scan
+#: reference compile is the suite's long pole, so share it
+_REF_CACHE: dict = {}
+
+
+def _reference(pset, genomes, X, ml=ML):
+    key = (id(pset), ml, genomes["nodes"].shape,
+           hash(np.asarray(genomes["nodes"]).tobytes()))
+    if key not in _REF_CACHE:
+        ref = gp.make_batch_interpreter(pset, ml, specialize="none")
+        _REF_CACHE[key] = np.asarray(jax.jit(ref)(genomes, X))
+    return _REF_CACHE[key]
+
+
+@pytest.mark.parametrize("kw", [
+    dict(mode="scan"),
+    dict(mode="grouped"),
+    dict(mode="grouped", dedup=False),
+    dict(mode="grouped", points_tile=10),   # non-divisible tile
+    # each further variant pays its own ~10 s interpreter compile on
+    # this box — exhaustive coverage rides the slow tier
+    pytest.param(dict(mode="scan", dedup=False),
+                 marks=pytest.mark.slow),
+    pytest.param(dict(mode="sweep"), marks=pytest.mark.slow),
+    pytest.param(dict(mode="grouped", chunk=16),
+                 marks=pytest.mark.slow),
+    pytest.param(dict(mode="scan", points_tile=16),
+                 marks=pytest.mark.slow),
+])
+def test_specializations_bit_identical(pset, X, kw):
+    genomes = _bloat_varying(pset)
+    want = _reference(pset, genomes, X)
+    got = np.asarray(gp.make_batch_interpreter(pset, ML, **kw)(genomes, X))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_traced_fallback_bit_identical(pset, X):
+    """Inside jit the dispatcher must fall back to the traced full
+    chain (grouped included) and still match."""
+    genomes = _bloat_varying(pset)
+    want = _reference(pset, genomes, X)
+    for mode in ("scan", "grouped"):
+        f = gp.make_batch_interpreter(pset, ML, mode=mode)
+        got = np.asarray(jax.jit(f)(genomes, X))
+        np.testing.assert_array_equal(got, want)
+
+
+def test_erc_heavy_dedup_parity(pset, X):
+    """ERC-heavy trees: rows differing ONLY in constant values must not
+    dedup together, and grouped's inline-constant operands must match
+    the chain exactly."""
+    ps = pset
+    genomes = _population(ps, range(24), 1, 3)
+    # duplicate every tree, then perturb the copies' ERC values
+    def dup(a):
+        return jnp.concatenate([a, a])
+    genomes = jax.tree_util.tree_map(dup, genomes)
+    is_erc = (genomes["nodes"] == ps.erc_id)
+    bumped = jnp.where(is_erc, genomes["consts"] + 0.125,
+                       genomes["consts"])
+    genomes = dict(genomes)
+    genomes["consts"] = jnp.concatenate(
+        [genomes["consts"][:24], bumped[24:]])
+    want = _reference(ps, genomes, X)
+    for mode in ("scan", "grouped"):
+        got = np.asarray(
+            gp.make_batch_interpreter(ps, ML, mode=mode)(genomes, X))
+        np.testing.assert_array_equal(got, want)
+    first, inv = _dedup_rows(np.asarray(genomes["nodes"]),
+                             np.asarray(genomes["consts"]),
+                             np.asarray(genomes["length"]))
+    # perturbed ERC copies are distinct genomes
+    assert len(first) > 24
+
+
+def test_typed_pset_parity(X):
+    ps = gp.spam_set(n_features=2)
+    ps.arity_table()
+    gen = gp.make_generator_typed(ps, ML, 2, 4)
+    pop = [gen(jax.random.key(s)) for s in range(16)]
+    genomes = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *pop)
+    want = _reference(ps, genomes, X)
+    for kw in (dict(mode="scan"), dict(mode="grouped"),
+               dict(mode="sweep")):
+        got = np.asarray(
+            gp.make_batch_interpreter(ps, ML, **kw)(genomes, X))
+        np.testing.assert_array_equal(got, want)
+
+
+def test_adf_masked_parity():
+    main = gp.PrimitiveSet("MAIN", 1)
+    main.add_primitive(jnp.add, 2, "add")
+    main.add_primitive(jnp.multiply, 2, "mul")
+    main.add_adf("ADF0", 1, branch=1)
+    sub = gp.PrimitiveSet("ADF0", 1)
+    sub.add_primitive(jnp.subtract, 2, "sub")
+    sub.add_primitive(jnp.cos, 1, "cos")
+    branches = [(main, 24), (sub, 16)]
+    geng = gp.make_adf_generator(branches, 1, 3)
+    pop = [geng(jax.random.key(s)) for s in range(12)]
+    genomes = tuple(
+        jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                               *[p[b] for p in pop])
+        for b in range(2))
+    X = jnp.linspace(-1.0, 1.0, 9)[:, None]
+    plain = gp.make_adf_batch_interpreter(branches, specialize="none")
+    want = np.asarray(jax.jit(plain)(genomes, X))
+    masked = gp.make_adf_batch_interpreter(branches)
+    got = np.asarray(masked(genomes, X))
+    np.testing.assert_array_equal(got, want)
+    # traced fallback of the masked interpreter
+    got_j = np.asarray(jax.jit(masked)(genomes, X))
+    np.testing.assert_array_equal(got_j, want)
+
+
+def test_mask_lattice_bounds_rebuilds(tmp_path):
+    """The monotone mask union bounds evaluator rebuilds by n_ops: a
+    population stream whose vocab oscillates must not rebuild once the
+    union covers it — journaled build events are the evidence (the PR 2
+    retrace plumbing)."""
+    from deap_tpu.telemetry.journal import RunJournal, read_journal
+
+    ps = gp.math_set(n_args=1)
+    ps.arity_table()
+    f = gp.make_batch_interpreter(ps, 24, mode="scan", dedup=False)
+    X = jnp.linspace(-1.0, 1.0, 7)[:, None]
+
+    def pop_with_ops(ops_subset):
+        # hand-built single-op trees: op(ARG0, ARG0) or op(ARG0)
+        rows = []
+        for op in ops_subset:
+            ar = int(ps.arity_table()[op])
+            nodes = [op] + [ps.n_ops] * ar
+            g = {"nodes": jnp.asarray(nodes + [0] * (24 - len(nodes)),
+                                      jnp.int32),
+                 "consts": jnp.zeros(24, jnp.float32),
+                 "length": jnp.asarray(len(nodes), jnp.int32)}
+            rows.append(g)
+        return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *rows)
+
+    path = tmp_path / "j.jsonl"
+    with RunJournal(str(path)) as journal:
+        journal.header(init_backend=False)
+        streams = [(0,), (0, 1), (0,), (1, 2), (0, 2), (1,), (0, 1, 2),
+                   (2,), (0, 1)]
+        for subset in streams:
+            f(pop_with_ops(subset), X)
+    events = read_journal(str(path))
+    builds = [e for e in events if e.get("kind") == "gp_interpreter_build"]
+    dispatches = [e for e in events if e.get("kind") == "gp_dispatch"]
+    # monotone union: at most one build per newly-seen opcode (3 here)
+    assert len(builds) <= 3, builds
+    assert dispatches and set(dispatches[-1]["mask"]) >= {"add", "sub",
+                                                          "mul"}
+
+
+def test_grouped_schedule_chunks_pure(pset):
+    """Every chunk of the grouped schedule holds exactly one opcode and
+    children land in strictly earlier chunks than their parents."""
+    genomes = _bloat_varying(pset)
+    nodes = np.asarray(genomes["nodes"])
+    consts = np.asarray(genomes["consts"])
+    length = np.asarray(genomes["length"])
+    arity_np = np.asarray(pset.arity_table())
+    ends = _ends_np(nodes, length, arity_np)
+    depths = _depths_np(ends, length)
+    # numpy ends/depths agree with the jax closed forms
+    for i in range(0, len(length), 5):
+        g = jax.tree_util.tree_map(lambda a: a[i], genomes)
+        je = np.asarray(subtree_ends_all(g["nodes"], g["length"],
+                                         pset.arity_table()))
+        jd = np.asarray(prefix_depths(g["nodes"], g["length"],
+                                      pset.arity_table()))
+        live = np.arange(ML) < int(length[i])
+        np.testing.assert_array_equal(ends[i][live], je[live])
+        np.testing.assert_array_equal(depths[i][live], jd[live])
+    mask = _used_ops(pset.n_ops, nodes, length)
+    chunk = 16
+    s = build_grouped_schedule(pset, nodes, consts, length, ends, depths,
+                               mask, chunk)
+    # chunk count sits on the lattice and covers every instruction
+    # (plus the per-(depth, opcode)-run alignment padding)
+    assert s["nchunks"] == _round_chunks(s["nchunks"])
+    assert s["nchunks"] * chunk >= s["n_instructions"]
+    total = s["nchunks"] * chunk
+    assert s["src_idx"].shape == (total, pset.max_arity)
+    # REAL operand slots (j < the chunk opcode's arity) always point
+    # strictly below the instruction's own row — children sort into
+    # earlier positions, terminals are arg rows or inline constants —
+    # so the sequential chunk order is a valid evaluation order.
+    # (Slots past the arity are gathered then discarded by
+    # ``fn(*ops[:arity])`` and may point anywhere in bounds.)
+    own_row = pset.n_args + np.arange(total)
+    chunk_arity = arity_np[np.asarray(mask)][s["chunk_ops"]]   # [nchunks]
+    pos_arity = np.repeat(chunk_arity, chunk)                  # [total]
+    si = np.asarray(s["src_idx"])
+    for j in range(pset.max_arity):
+        sel = pos_arity > j
+        assert (si[sel, j] < own_row[sel]).all()
+    assert (si < pset.n_args + total).all() and (si >= 0).all()
+
+
+def test_grouped_kernel_interpret_parity():
+    """The Pallas fused gather-dispatch-scatter kernel (interpret mode
+    off-TPU) matches the scan chain bit-for-bit."""
+    ps = gp.math_set(n_args=1)
+    ps.arity_table()
+    genomes = _population(ps, range(10), 1, 3, ml=24)
+    X = jnp.linspace(-2.0, 2.0, 8)[:, None]
+    want = _reference(ps, genomes, X, ml=24)
+    nodes = np.asarray(genomes["nodes"])
+    consts = np.asarray(genomes["consts"])
+    length = np.asarray(genomes["length"])
+    arity_np = np.asarray(ps.arity_table())
+    ends = _ends_np(nodes, length, arity_np)
+    depths = _depths_np(ends, length)
+    mask = _used_ops(ps.n_ops, nodes, length)
+    sched = build_grouped_schedule(ps, nodes, consts, length, ends,
+                                   depths, mask, chunk=8)
+    fn = _grouped_eval_kernel_builder(ps, mask, 8)
+    args = [jnp.asarray(sched[k]) for k in
+            ("chunk_ops", "src_idx", "src_const", "src_isc")]
+    buf = fn(*args, X)
+    preds = np.where(sched["root_isc"][:, None],
+                     sched["root_const"][:, None],
+                     np.asarray(buf)[sched["root_idx"]])
+    np.testing.assert_array_equal(preds, want)
+
+
+# ------------------------------------------------------- loop engine ----
+
+def test_loop_carried_depths_exact_and_limited():
+    """The engine's algebraically-spliced depth arrays must equal a
+    prefix_depths recomputation after many generations, every tree must
+    stay a valid prefix, and Koza's height limit must hold."""
+    from deap_tpu.gp.loop import make_symbreg_loop
+
+    POP, ml = 256, 48
+    ps = gp.math_set(n_args=1)
+    ps.arity_table()
+    X = jnp.linspace(-1.0, 1.0, 32, endpoint=False)[:, None]
+    y = X[:, 0] ** 3 + X[:, 0]
+    gen = gp.gen_half_and_half(ps, ml, 1, 2)
+    genomes = jax.vmap(gen)(jax.random.split(jax.random.key(3), POP))
+    run = make_symbreg_loop(ps, ml, X, y, height_limit=6)
+    r = run(jax.random.key(0), genomes, 12)
+
+    arity = ps.arity_table()
+    dep_re = np.asarray(jax.vmap(
+        lambda g: prefix_depths(g["nodes"], g["length"], arity))(
+        r["genomes"]))
+    lens = np.asarray(r["genomes"]["length"])
+    live = np.arange(ml)[None, :] < lens[:, None]
+    np.testing.assert_array_equal(
+        np.where(live, np.asarray(r["depths"]), 0),
+        np.where(live, dep_re, 0))
+    assert (np.max(np.where(live, dep_re, 0), axis=1) <= 6).all()
+
+    arity_np = np.asarray(arity)
+    nodes = np.asarray(r["genomes"]["nodes"])
+    for i in range(0, POP, 17):
+        need = 1
+        for t in range(int(lens[i])):
+            need += arity_np[nodes[i, t]] - 1
+        assert need == 0 and lens[i] >= 1
+
+    # invalid-only evaluation: per-gen nevals strictly below pop
+    assert all(ne <= POP for ne in r["nevals"])
+    assert np.mean(r["nevals"][1:]) < POP
+
+
+@pytest.mark.slow
+def test_loop_improves_fitness():
+    from deap_tpu.gp.loop import make_symbreg_loop
+
+    POP, ml = 512, 48
+    ps = gp.math_set(n_args=1)
+    ps.arity_table()
+    X = jnp.linspace(-1.0, 1.0, 32, endpoint=False)[:, None]
+    y = X[:, 0] ** 2 + X[:, 0]
+    gen = gp.gen_half_and_half(ps, ml, 1, 2)
+    genomes = jax.vmap(gen)(jax.random.split(jax.random.key(5), POP))
+    run = make_symbreg_loop(ps, ml, X, y)
+    r0 = run(jax.random.key(1), genomes, 0)
+    r = run(jax.random.key(1), genomes, 15)
+    assert r["best_fitness"] >= r0["best_fitness"]
+    assert -r["best_fitness"] < 0.2, -r["best_fitness"]

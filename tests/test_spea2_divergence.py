@@ -3,13 +3,20 @@
 sel_spea2 documents the deliberate divergences from the reference's
 selSPEA2 (/root/reference/deap/tools/emo.py:692-842):
 
-1. (closed in r5) the truncation tie-break formerly capped its
-   lexicographic compare at depth 8; it now runs to full depth with
-   the reference's lowest-alive-index residual tie-break, giving
-   exact set parity in float64. In float32 the tie structure of
-   squared distances differs from the reference's float64, so
-   tie-heavy fronts still diverge — a precision property, not an
-   algorithmic one;
+1. (closed in r5/r6) the truncation tie-break formerly capped its
+   lexicographic compare at depth 8; r5 took it to full depth with
+   the reference's lowest-alive-index residual tie-break (exact set
+   parity in float64), and r6 closed the float32 gap: the truncation
+   loop's distances are computed in double-float32 (error-free
+   two-sum/two-product, ~48 significant bits) and compared
+   lexicographically on (hi, lo), so the f32 path reproduces the
+   reference's float64 tie structure exactly GIVEN THE SAME INPUTS.
+   What remains out of reach by construction is caller-side input
+   quantization: objectives rounded to f32 before selection are
+   different numbers than their f64 originals, and no selector
+   arithmetic can recover ordering information destroyed upstream —
+   the f32 test therefore feeds both implementations the same
+   f32-quantized values;
 2. the reference's upper-triangular density artifact (distances only
    filled for j > i, emo.py:733-740) is *not* reproduced — we use the
    full distance matrix the paper specifies;
@@ -185,20 +192,24 @@ def test_spea2_tie_heavy_truncation_exact(ref_tools):
     assert ov == 1.0, ov
 
 
-def test_spea2_tie_heavy_truncation_f32_structural(ref_tools):
-    """float32 run of the same front: squared-distance ties differ
-    from the reference's float64, so the selected *sets* legitimately
-    diverge — but both must keep at least one of each duplicate pair
-    (the structural property tie-breaking protects)."""
-    w = _tie_heavy_front(120)
+def test_spea2_tie_heavy_truncation_f32_exact(ref_tools):
+    """float32 run of the same front, BOTH implementations fed the
+    same f32-quantized objectives (float() of an f32 value is exact,
+    so the reference sees bit-identical inputs): since the truncation
+    loop compares double-float32 distances — f64-equivalent given the
+    inputs, pinned reference-free by tests/test_mo.py — the selected
+    SET must now match the reference exactly in f32 too. (Historic:
+    0.85 overlap when plain f32 distances collapsed distinct f64
+    distances into spurious ties — VERDICT r5 weak #7, closed.)"""
+    w = _tie_heavy_front(120).astype(np.float32)
     k = 80
     ours = _our_select(w, k)
-    refs = _ref_select(ref_tools, w, k)
+    refs = _ref_select(ref_tools, w.astype(np.float64), k)
     ov = _overlap(ours, refs, k)
     print("tie-heavy overlap (f32):", ov)
 
-    # structural check: among the 40 dropped, no spatial point loses
-    # both copies while another keeps both (maximal spread under ties)
+    # structural check kept: among the 40 dropped, every duplicate
+    # pair retains at least one member (maximal spread under ties)
     def pair_counts(sel):
         c = np.zeros(60, np.int32)
         for i in sel:
@@ -207,9 +218,8 @@ def test_spea2_tie_heavy_truncation_f32_structural(ref_tools):
 
     for name, sel in (("ours", ours), ("ref", refs)):
         c = pair_counts(sel)
-        # k=80 over 60 pairs: every pair keeps at least one member
         assert (c >= 1).all(), (name, c)
-    assert ov >= 0.80, ov
+    assert ov == 1.0, ov
 
 
 def test_spea2_underfull_density_fill_overlap(ref_tools):
