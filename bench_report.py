@@ -11,11 +11,22 @@ Usage:
     python bench_report.py --tripwire    # regression diff of the two
                                          # most recent BENCH_r*.json;
                                          # exit 1 if a live-vs-live
-                                         # metric regressed > 10%
+                                         # metric regressed > 10%, or
+                                         # if probe overhead in the
+                                         # latest BENCH_PROBES*.json
+                                         # exceeds 3% (paired rows,
+                                         # same session)
     python bench_report.py --journal F   # summarise a run journal
                                          # (telemetry JSONL): compiles/
                                          # retraces, span aggregates,
                                          # meter first/last rows
+    python bench_report.py --health F    # full run-health report for a
+                                         # journal: per-probe
+                                         # sparklines, alarm timeline,
+                                         # span p50/p99 table
+                                         # (deap_tpu/telemetry/
+                                         # report.py, loaded standalone
+                                         # — still no jax import)
 """
 
 import glob
@@ -145,6 +156,51 @@ def _diff_rows(prev_path: str, cur_path: str, threshold: float) -> int:
     return tripped
 
 
+#: fractional telemetry-probe overhead beyond which the probe pair trips
+PROBE_OVERHEAD_THRESHOLD = 0.03
+
+
+def probe_tripwire(threshold: float = PROBE_OVERHEAD_THRESHOLD) -> int:
+    """The telemetry-probe overhead gate. BENCH_PROBES.json carries a
+    probe-off and a probe-on headline-config row (pop=100k) measured
+    back-to-back in the SAME session (bench.py --probes) — in-scan
+    probes promise near-zero cost, and this is where that promise is
+    enforced: trips when the probe-on run falls more than ``threshold``
+    below its probe-off pair. Returns the number of tripped rows."""
+    files = sorted(glob.glob(os.path.join(HERE, "BENCH_PROBES*.json")))
+    if not files:
+        print("probe tripwire: no committed BENCH_PROBES*.json yet")
+        return 0
+    rows = _bench_rows(files[-1])
+    off = rows.get("onemax_pop100k_probe_off_generations_per_sec")
+    on = rows.get("onemax_pop100k_probe_on_generations_per_sec")
+    ov = rows.get("onemax_pop100k_probe_overhead_pct")
+    print(f"\n## Probe overhead ({os.path.basename(files[-1])})\n")
+    if ov is not None and isinstance(ov.get("value"), (int, float)):
+        # the committed row's estimator (min-of-interleaved-reps —
+        # contention noise is one-sided) is the gate
+        overhead = ov["value"] / 100.0
+    elif (off and on and isinstance(off.get("value"), (int, float))
+            and isinstance(on.get("value"), (int, float))):
+        overhead = 1.0 - on["value"] / off["value"]
+    else:
+        print("- paired probe rows missing from latest BENCH_PROBES "
+              "file")
+        return 0
+    ok = overhead <= threshold
+    pair = ""
+    if off and on:
+        pair = (f"probes on {on['value']} vs off {off['value']} gens/s "
+                f"(n_probe_metrics={on.get('n_probe_metrics', '?')}), ")
+    print(f"- {pair}same session: {100 * overhead:+.2f}% overhead "
+          + ("ok" if ok else f"**REGRESSION** (> {threshold:.0%} — "
+             "an in-scan probe got expensive)"))
+    if len(files) >= 2:
+        return (0 if ok else 1) + _diff_rows(files[-2], files[-1],
+                                             TRIPWIRE_THRESHOLD)
+    return 0 if ok else 1
+
+
 def tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
     """Diff the two most recent committed ``BENCH_r*.json`` files and
     flag regressions; then the gp_symbreg paired rows
@@ -162,6 +218,7 @@ def tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
     else:
         tripped += _diff_rows(files[-2], files[-1], threshold)
     tripped += gp_tripwire(threshold)
+    tripped += probe_tripwire()
     return tripped
 
 
@@ -273,9 +330,26 @@ def main() -> None:
                   f"{gap:.4f} ms/gen of fusion/overhead delta.")
 
 
+def health_report(path: str) -> None:
+    """Full run-health report (sparklines, alarms, spans) via
+    deap_tpu/telemetry/report.py — loaded by FILE PATH, because
+    importing the package would initialise jax and this tool's contract
+    is to run anywhere (tests/test_probes.py pins the no-jax
+    guarantee)."""
+    import importlib.util
+
+    rp = os.path.join(HERE, "deap_tpu", "telemetry", "report.py")
+    spec = importlib.util.spec_from_file_location("_telemetry_report", rp)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    print(mod.render_report(path))
+
+
 if __name__ == "__main__":
     if "--tripwire" in sys.argv:
         sys.exit(1 if tripwire() else 0)
+    elif "--health" in sys.argv:
+        health_report(sys.argv[sys.argv.index("--health") + 1])
     elif "--journal" in sys.argv:
         journal_report(sys.argv[sys.argv.index("--journal") + 1])
     else:

@@ -167,8 +167,14 @@ def _tel_declare(meter) -> None:
 
 
 def _tel_measure(tel, mstate, nevals: jnp.ndarray, pop: Population,
-                 gen: jnp.ndarray):
-    """In-scan built-in instrumentation + user probe + live stream."""
+                 gen: jnp.ndarray, sel_idx=None, sel_pool=None,
+                 parent_idx=None):
+    """In-scan built-in instrumentation + probes + live stream.
+
+    ``sel_idx``/``sel_pool``/``parent_idx`` hand the probes the
+    selection indices the loop already holds (selection-pressure and
+    lineage probes read them — see telemetry/probes.py); the pool size
+    is a static int so bincounts stay shape-static."""
     m = tel.meter
     w0 = pop.wvalues[:, 0]
     mstate = m.inc(mstate, "nevals", nevals)
@@ -176,24 +182,35 @@ def _tel_measure(tel, mstate, nevals: jnp.ndarray, pop: Population,
     mstate = m.set(mstate, "mean", jnp.mean(w0))
     mstate = m.set(mstate, "evaluated_frac",
                    nevals.astype(jnp.float32) / pop.size)
-    mstate = tel.apply_probe(mstate, pop=pop)
+    mstate = tel.apply_probe(mstate, pop=pop, gen=gen, sel_idx=sel_idx,
+                             sel_pool=sel_pool, parent_idx=parent_idx)
     tel.live(mstate, gen)
     return mstate
+
+
+def _check_probes(probes, telemetry):
+    if probes and telemetry is None:
+        raise ValueError(
+            "probes= requires telemetry= (a RunTelemetry): probe state "
+            "rides the telemetry Meter carry")
 
 
 def ea_simple(key: jax.Array, pop: Population, toolbox, cxpb: float,
               mutpb: float, ngen: int, stats: Optional[Statistics] = None,
               halloffame_size: int = 0, verbose: bool = False,
-              telemetry=None,
+              telemetry=None, probes=(),
               ) -> Tuple[Population, Logbook, Optional[HallOfFame]]:
     """The canonical generational GA (algorithms.py:85-189).
 
     select n → varAnd → evaluate invalid → replace, scanned over ``ngen``
     generations as one compiled program. ``telemetry`` (a
     :class:`deap_tpu.telemetry.RunTelemetry`) threads a Meter through
-    the scan and journals the run; results are unchanged either way.
+    the scan and journals the run; ``probes`` adds in-scan population
+    probes (:mod:`deap_tpu.telemetry.probes`) to that meter. Results
+    are unchanged either way.
     """
     tel = telemetry
+    _check_probes(probes, tel)
     kscan = key
     nevals0 = jnp.sum(~pop.valid)  # like the reference's len(invalid_ind)
     pop = evaluate_invalid(pop, toolbox.evaluate)
@@ -203,7 +220,8 @@ def ea_simple(key: jax.Array, pop: Population, toolbox, cxpb: float,
     record0 = {"nevals": nevals0, **_maybe_stats(stats, pop)}
     if tel is not None:
         tel.begin_run("ea_simple", toolbox, declare=_tel_declare,
-                      ngen=ngen, n=pop.size, cxpb=cxpb, mutpb=mutpb)
+                      probes=probes, ngen=ngen, n=pop.size, cxpb=cxpb,
+                      mutpb=mutpb)
         mstate0 = _tel_measure(tel, tel.meter.init(), nevals0, pop,
                                jnp.int32(0))
 
@@ -224,7 +242,12 @@ def ea_simple(key: jax.Array, pop: Population, toolbox, cxpb: float,
         rec = {"nevals": nevals, **_maybe_stats(stats, off)}
         if tel is None:
             return (off, new_hof), rec
-        mstate = _tel_measure(tel, mstate, nevals, off, gen)
+        # ea_simple's selection doubles as parentage: child i descends
+        # from pop[idx[i]] (plus its crossover partner) — hand probes
+        # both the pressure view (sel_idx) and the lineage view
+        mstate = _tel_measure(tel, mstate, nevals, off, gen,
+                              sel_idx=idx, sel_pool=pop.size,
+                              parent_idx=idx)
         return (off, new_hof, mstate), (rec, mstate)
 
     if tel is None:
@@ -265,13 +288,14 @@ def ea_mu_plus_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
                       lambda_: int, cxpb: float, mutpb: float, ngen: int,
                       stats: Optional[Statistics] = None,
                       halloffame_size: int = 0, verbose: bool = False,
-                      telemetry=None,
+                      telemetry=None, probes=(),
                       ) -> Tuple[Population, Logbook, Optional[HallOfFame]]:
     """(μ + λ) evolution (algorithms.py:248-337): parents survive into the
     selection pool."""
     assert cxpb + mutpb <= 1.0, (
         "The sum of the crossover and mutation probabilities must be <= 1.0.")
     tel = telemetry
+    _check_probes(probes, tel)
     kscan = key
     nevals0 = jnp.sum(~pop.valid)  # like the reference's len(invalid_ind)
     pop = evaluate_invalid(pop, toolbox.evaluate)
@@ -281,8 +305,8 @@ def ea_mu_plus_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
     record0 = {"nevals": nevals0, **_maybe_stats(stats, pop)}
     if tel is not None:
         tel.begin_run("ea_mu_plus_lambda", toolbox, declare=_tel_declare,
-                      ngen=ngen, mu=mu, lambda_=lambda_, cxpb=cxpb,
-                      mutpb=mutpb)
+                      probes=probes, ngen=ngen, mu=mu, lambda_=lambda_,
+                      cxpb=cxpb, mutpb=mutpb)
         mstate0 = _tel_measure(tel, tel.meter.init(), nevals0, pop,
                                jnp.int32(0))
 
@@ -302,7 +326,11 @@ def ea_mu_plus_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
         rec = {"nevals": nevals, **_maybe_stats(stats, new_pop)}
         if tel is None:
             return (new_pop, new_hof), rec
-        mstate = _tel_measure(tel, mstate, nevals, new_pop, gen)
+        # environmental selection over the (mu + lambda) union: probes
+        # see which pool rows survived, not parentage (varOr's parents
+        # are internal draws)
+        mstate = _tel_measure(tel, mstate, nevals, new_pop, gen,
+                              sel_idx=idx, sel_pool=pool.size)
         return (new_pop, new_hof, mstate), (rec, mstate)
 
     if tel is None:
@@ -324,13 +352,14 @@ def ea_mu_comma_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
                        lambda_: int, cxpb: float, mutpb: float, ngen: int,
                        stats: Optional[Statistics] = None,
                        halloffame_size: int = 0, verbose: bool = False,
-                       telemetry=None,
+                       telemetry=None, probes=(),
                        ) -> Tuple[Population, Logbook, Optional[HallOfFame]]:
     """(μ, λ) evolution (algorithms.py:340-437): only offspring survive."""
     assert lambda_ >= mu, "lambda must be greater or equal to mu."
     assert cxpb + mutpb <= 1.0, (
         "The sum of the crossover and mutation probabilities must be <= 1.0.")
     tel = telemetry
+    _check_probes(probes, tel)
     kscan = key
     nevals0 = jnp.sum(~pop.valid)  # like the reference's len(invalid_ind)
     pop = evaluate_invalid(pop, toolbox.evaluate)
@@ -340,8 +369,8 @@ def ea_mu_comma_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
     record0 = {"nevals": nevals0, **_maybe_stats(stats, pop)}
     if tel is not None:
         tel.begin_run("ea_mu_comma_lambda", toolbox, declare=_tel_declare,
-                      ngen=ngen, mu=mu, lambda_=lambda_, cxpb=cxpb,
-                      mutpb=mutpb)
+                      probes=probes, ngen=ngen, mu=mu, lambda_=lambda_,
+                      cxpb=cxpb, mutpb=mutpb)
         mstate0 = _tel_measure(tel, tel.meter.init(), nevals0, pop,
                                jnp.int32(0))
 
@@ -360,7 +389,8 @@ def ea_mu_comma_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
         rec = {"nevals": nevals, **_maybe_stats(stats, new_pop)}
         if tel is None:
             return (new_pop, new_hof), rec
-        mstate = _tel_measure(tel, mstate, nevals, new_pop, gen)
+        mstate = _tel_measure(tel, mstate, nevals, new_pop, gen,
+                              sel_idx=idx, sel_pool=off.size)
         return (new_pop, new_hof, mstate), (rec, mstate)
 
     if tel is None:
@@ -382,7 +412,7 @@ def ea_generate_update(key: jax.Array, state: Any, toolbox, ngen: int,
                        spec: FitnessSpec,
                        stats: Optional[Statistics] = None,
                        halloffame_size: int = 0, verbose: bool = False,
-                       telemetry=None,
+                       telemetry=None, probes=(),
                        ) -> Tuple[Any, Logbook, Optional[HallOfFame]]:
     """Ask-tell loop (algorithms.py:440-503) driving CMA-ES/PBIL/EMNA-style
     strategies:
@@ -407,9 +437,10 @@ def ea_generate_update(key: jax.Array, state: Any, toolbox, ngen: int,
     )
     hof = hof_init(halloffame_size, template) if halloffame_size else None
     tel = telemetry
+    _check_probes(probes, tel)
     if tel is not None:
         tel.begin_run("ea_generate_update", toolbox, declare=_tel_declare,
-                      ngen=ngen, lambda_=lam)
+                      probes=probes, ngen=ngen, lambda_=lam)
         mstate0 = tel.meter.init()
 
     def step(carry, xs):
@@ -433,7 +464,7 @@ def ea_generate_update(key: jax.Array, state: Any, toolbox, ngen: int,
         mstate = m.set(mstate, "best", jnp.max(w0))
         mstate = m.set(mstate, "mean", jnp.mean(w0))
         mstate = m.set(mstate, "evaluated_frac", 1.0)
-        mstate = tel.apply_probe(mstate, pop=pop, state=new_state)
+        mstate = tel.apply_probe(mstate, pop=pop, state=new_state, gen=gen)
         tel.live(mstate, gen)
         return (new_state, new_hof, mstate), (rec, mstate)
 

@@ -83,7 +83,8 @@ def make_gp_loop(pset: PrimitiveSet, max_len: int, evaluate: Callable, *,
                  cxpb: float, mutpb: float, tournsize: int = 3,
                  height_limit: int = 17,
                  mut_min: int = 0, mut_max: int = 2,
-                 mut_width: Optional[int] = None) -> Callable:
+                 mut_width: Optional[int] = None,
+                 telemetry=None, probes=()) -> Callable:
     """Build ``run(key, genomes, ngen) -> result`` — the host-dispatch
     eaSimple-shaped GP loop (tournament selection, adjacent-pair
     one-point crossover at ``cxpb``, uniform subtree mutation at
@@ -95,7 +96,18 @@ def make_gp_loop(pset: PrimitiveSet, max_len: int, evaluate: Callable, *,
     ``make_batch_interpreter``/``make_population_evaluator`` evaluator
     so the live-vocab/dedup/grouped dispatch engages. The result dict
     carries the final population + depth arrays, the best individual,
-    and the reference-comparable ``nevals`` per generation."""
+    and the reference-comparable ``nevals`` per generation.
+
+    ``telemetry``/``probes``: the host-dispatch counterpart of the
+    scanned loops' instrumentation — one decoded ``meter`` row per
+    generation lands in the journal as it happens (this loop has a
+    host in it anyway), probes get the selection indices and, since
+    the population is concrete here, the GP interpreter's *exact*
+    dedup count via ``host_clone_rate`` (TreeDiversityProbe prefers it
+    over its in-scan hash). Because the driver is host-side, a
+    :class:`~deap_tpu.telemetry.probes.HealthMonitor` configured with
+    ``early_stop`` genuinely stops the run (``result["stopped_at"]``
+    records the generation). Telemetry changes no computed result."""
     arity = pset.arity_table()
     mut_width = mut_width or min(max_len, 32)
     expr = make_generator(pset, mut_width, mut_min, mut_max, "full")
@@ -157,7 +169,7 @@ def make_gp_loop(pset: PrimitiveSet, max_len: int, evaluate: Callable, *,
         idx = ops.sel_tournament(key, fit[:, None], n,
                                  tournsize=tournsize)
         return (jax.tree_util.tree_map(lambda a: a[idx], genomes),
-                depths[idx], fit[idx])
+                depths[idx], fit[idx], idx)
 
     @partial(jax.jit, static_argnums=1)
     def draw_flags(key, n):
@@ -224,6 +236,46 @@ def make_gp_loop(pset: PrimitiveSet, max_len: int, evaluate: Callable, *,
         touched[midx] = True
         return genomes, depths, touched
 
+    tel = telemetry
+    if probes and tel is None:
+        raise ValueError("probes= requires telemetry= (a RunTelemetry):"
+                         " probe state rides the telemetry Meter carry")
+    if tel is not None:
+        from deap_tpu.telemetry.probes import TreeDiversityProbe
+        # the exact interpreter-style dedup costs an O(nL) host pass —
+        # only pay it for a probe that will publish it
+        _host_dedup = any(isinstance(p, TreeDiversityProbe)
+                          for p in tuple(probes) + (tel.probe,)
+                          if p is not None)
+
+    def _measure(mstate, ne, genomes, fit, gen, sel_idx=None):
+        """One generation's instrumentation — mirrors algorithms.py's
+        ``_tel_measure`` but runs eagerly (concrete genomes), so the
+        GP interpreter's exact dedup substitutes for the in-scan hash."""
+        from deap_tpu.core.fitness import FitnessSpec
+        from deap_tpu.core.population import Population
+        from deap_tpu.gp.interpreter import _dedup_rows
+
+        n = fit.shape[0]
+        m = tel.meter
+        mstate = m.inc(mstate, "nevals", ne)
+        mstate = m.set(mstate, "best", jnp.max(fit))
+        mstate = m.set(mstate, "mean", jnp.mean(fit))
+        mstate = m.set(mstate, "evaluated_frac", ne / n)
+        clone = None
+        if _host_dedup:
+            first, _ = _dedup_rows(np.asarray(genomes["nodes"]),
+                                   np.asarray(genomes["consts"]),
+                                   np.asarray(genomes["length"]))
+            clone = 1.0 - len(first) / n
+        pv = Population(genomes=genomes, fitness=jnp.asarray(fit)[:, None],
+                        valid=jnp.ones(n, bool), spec=FitnessSpec((1.0,)))
+        mstate = tel.apply_probe(
+            mstate, pop=pv, gen=gen, sel_idx=sel_idx, sel_pool=n,
+            parent_idx=sel_idx, host_clone_rate=clone)
+        tel.record_row(mstate, gen)
+        return mstate
+
     def run(key, genomes, ngen: int):
         n = int(np.asarray(genomes["length"]).shape[0])
         depths = depths_of(genomes)
@@ -232,10 +284,18 @@ def make_gp_loop(pset: PrimitiveSet, max_len: int, evaluate: Callable, *,
         best_i = int(jnp.argmax(fit))
         best = (jax.tree_util.tree_map(lambda a: a[best_i], genomes),
                 float(fit[best_i]))
+        stopped_at = None
+        if tel is not None:
+            from deap_tpu.algorithms import _tel_declare
+            tel.begin_run("gp_loop", None, declare=_tel_declare,
+                          probes=probes, ngen=ngen, n=n, cxpb=cxpb,
+                          mutpb=mutpb)
+            mstate = _measure(tel.meter.init(), n, genomes, fit, 0)
         for gen in range(1, ngen + 1):
             k = jax.random.fold_in(key, gen)
             k_sel, k_var = jax.random.split(k)
-            genomes, depths, fit = select(k_sel, genomes, depths, fit)
+            genomes, depths, fit, sel_idx = select(k_sel, genomes,
+                                                   depths, fit)
             genomes, depths, touched = vary(k_var, genomes, depths, n)
             idx = np.nonzero(touched)[0]
             ne = len(idx)
@@ -252,9 +312,18 @@ def make_gp_loop(pset: PrimitiveSet, max_len: int, evaluate: Callable, *,
             if float(fit[best_i]) > best[1]:
                 best = (jax.tree_util.tree_map(
                     lambda a: a[best_i], genomes), float(fit[best_i]))
+            if tel is not None:
+                mstate = _measure(mstate, ne, genomes, fit, gen, sel_idx)
+                # the host is in the loop, so tripwires can actually
+                # stop the run — the scanned loops can only journal
+                if tel.health is not None and tel.health.stop_requested:
+                    stopped_at = gen
+                    break
+        if tel is not None:
+            tel.end_run("gp_loop", ngen=ngen, stopped_at=stopped_at)
         return {"genomes": genomes, "depths": depths, "fitness": fit,
                 "best_genome": best[0], "best_fitness": best[1],
-                "nevals": nevals}
+                "nevals": nevals, "stopped_at": stopped_at}
 
     run.select = select              # exposed for tests
     run.vary = vary

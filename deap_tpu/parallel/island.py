@@ -92,11 +92,24 @@ def _migrate_sharded(key, pops, k, selection, axis_name):
     )
 
 
+def _flatten_demes(pops: Population) -> Population:
+    """Merge the deme axis into the individual axis — a stacked
+    ``[n_islands, island_size, ...]`` island tensor viewed as one flat
+    population, the shape every standard probe expects."""
+    flat = lambda a: jnp.reshape(a, (-1,) + a.shape[2:])
+    return pops.replace(
+        genomes=jax.tree_util.tree_map(flat, pops.genomes),
+        extras=jax.tree_util.tree_map(flat, pops.extras),
+        fitness=flat(pops.fitness),
+        valid=flat(pops.valid),
+    )
+
+
 def make_island_step(toolbox, cxpb: float, mutpb: float, freq: int,
                      mig_k: int, mesh: Optional[Mesh] = None,
                      axis_name: str = "island",
                      selection: Callable = sel_best,
-                     telemetry=None):
+                     telemetry=None, probes=()):
     """Build ``step(key, pops) -> pops``: ``freq`` local generations then
     one ring migration (the reference's FREQ-generation epoch,
     onemax_island_scoop.py:64-67). Jit-compatible; pass a ``mesh`` to run
@@ -106,9 +119,15 @@ def make_island_step(toolbox, cxpb: float, mutpb: float, freq: int,
     returned step is ``step(key, pops, mstate) -> (pops, mstate)``: a
     Meter state rides the same jit'd program (epoch counters, migrant
     counter, cross-island best/mean gauges — still zero host round
-    trips). Build the initial state with ``telemetry.meter.init()``
-    *after* this call (declaration happens here), and journal epochs
-    via ``telemetry.journal.meter_rows`` or per-epoch events.
+    trips). On the mesh path the best/mean gauges are reduced *inside*
+    the shard_map'd epoch via ``pmax``/``psum`` collectives (each under
+    a named profiling span, like every collective in this package), so
+    the probe pipeline survives sharded demes without gathering the
+    population. ``probes`` adds population probes, applied to the
+    deme-flattened epoch output. Build the initial state with
+    ``telemetry.meter.init()`` *after* this call (declaration happens
+    here), and journal epochs via ``telemetry.record_row`` or
+    ``telemetry.journal.meter_rows``.
     """
 
     def epoch(key, pops, migrate):
@@ -124,9 +143,22 @@ def make_island_step(toolbox, cxpb: float, mutpb: float, freq: int,
         pops, _ = lax.scan(gen, pops, jax.random.split(k_gen, freq))
         return migrate(k_mig, pops)
 
+    tel = telemetry
+
+    def _local_stats(pops):
+        """Per-shard sufficient statistics for the cross-island
+        best/mean gauges: max, sum and valid count over local demes."""
+        w0 = jnp.where(pops.valid,
+                       (pops.fitness * pops.spec.warray)[..., 0], -jnp.inf)
+        return (jnp.max(w0),
+                jnp.sum(jnp.where(pops.valid, w0, 0.0)),
+                jnp.sum(pops.valid.astype(jnp.float32)))
+
     if mesh is None:
         base = lambda key, pops: epoch(
             key, pops, partial(_migrate_local, k=mig_k, selection=selection))
+        base_tel = lambda key, pops: (
+            lambda out: (out, _local_stats(out)))(base(key, pops))
     else:
         spec_sharded = P(axis_name)
 
@@ -134,39 +166,58 @@ def make_island_step(toolbox, cxpb: float, mutpb: float, freq: int,
             return epoch(key, pops, lambda kk, pp: _migrate_sharded(
                 kk, pp, mig_k, selection, axis_name))
 
+        def sharded_epoch_tel(key, pops):
+            # meter reductions ride the same shard_map'd program as the
+            # epoch itself: per-shard stats collapse to replicated
+            # scalars via pmax/psum, each inside a named span so the
+            # probe overhead stays attributable per collective
+            pops = sharded_epoch(key, pops)
+            lmax, lsum, lcnt = _local_stats(pops)
+            with span("island/pmax"):
+                gmax = lax.pmax(lmax, axis_name)
+            with span("island/psum"):
+                gsum = lax.psum(lsum, axis_name)
+                gcnt = lax.psum(lcnt, axis_name)
+            return pops, (gmax, gsum, gcnt)
+
         base = shard_map(
             sharded_epoch, mesh=mesh,
             in_specs=(P(), spec_sharded), out_specs=spec_sharded)
+        base_tel = shard_map(
+            sharded_epoch_tel, mesh=mesh,
+            in_specs=(P(), spec_sharded),
+            out_specs=(spec_sharded, (P(), P(), P())))
 
-    if telemetry is None:
+    if tel is None:
+        if probes:
+            raise ValueError("probes= requires telemetry= (a "
+                             "RunTelemetry): probe state rides the "
+                             "telemetry Meter carry")
         return jax.jit(base)
 
-    meter = telemetry.meter
+    meter = tel.meter
     meter.counter("epochs")
     meter.counter("generations")
     meter.counter("migrants")
     meter.gauge("best")
     meter.gauge("mean")
-    if telemetry.probe is not None and hasattr(telemetry.probe, "declare"):
-        telemetry.probe.declare(meter)
+    if tel.probe is not None and hasattr(tel.probe, "declare"):
+        tel.probe.declare(meter)
+    tel.add_probes(probes)
 
     def instrumented(key, pops, mstate):
-        # instrumentation reads the epoch's *output* on the full stacked
-        # tensor, outside shard_map but inside the same jit — one
-        # compiled program, no host round trips, and the evolutionary
+        # one compiled program, no host round trips; the evolutionary
         # computation itself is byte-for-byte the uninstrumented one
-        pops = base(key, pops)
-        w0 = jnp.where(pops.valid,
-                       (pops.fitness * pops.spec.warray)[..., 0], -jnp.inf)
+        # (meter reductions read the epoch output, feed nothing back)
+        pops, (gmax, gsum, gcnt) = base_tel(key, pops)
         n_islands = pops.valid.shape[0]
         mstate = meter.inc(mstate, "epochs")
         mstate = meter.inc(mstate, "generations", freq)
         mstate = meter.inc(mstate, "migrants", mig_k * n_islands)
-        mstate = meter.set(mstate, "best", jnp.max(w0))
-        mstate = meter.set(mstate, "mean", jnp.mean(
-            jnp.where(pops.valid, w0, 0.0)) / jnp.maximum(
-                jnp.mean(pops.valid.astype(jnp.float32)), 1e-9))
-        mstate = telemetry.apply_probe(mstate, pop=pops)
+        mstate = meter.set(mstate, "best", gmax)
+        mstate = meter.set(mstate, "mean",
+                           gsum / jnp.maximum(gcnt, 1.0))
+        mstate = tel.apply_probe(mstate, pop=_flatten_demes(pops))
         return pops, mstate
 
     return jax.jit(instrumented)

@@ -135,9 +135,21 @@ class History:
             self.record(gen_rec)
 
     def get_genealogy(self, ind_id: int, max_depth: float = float("inf")) -> Dict[int, Tuple[int, ...]]:
-        """Ancestor subtree of ``ind_id`` up to ``max_depth`` generations
-        (the reference's ``getGenealogy``, support.py:123-152)."""
+        """Ancestor subgraph of ``ind_id`` up to ``max_depth`` generations
+        (the reference's ``getGenealogy``, support.py:123-152 — which
+        recurses per parent reference and re-walks shared ancestors).
+
+        Iterative BFS with an explicit visited set: every node is
+        expanded at most once, so diamond-shaped lineages (one ancestor
+        reachable along several lines — ubiquitous once crossover
+        recombines relatives) cost O(nodes + edges), not O(paths),
+        and deep lineages cannot hit the recursion limit. A shared
+        ancestor sitting at several different depths is expanded at its
+        *shallowest* occurrence, which is what bounds ``max_depth``
+        correctly. Pinned on a diamond in
+        tests/test_checkpoint_history.py."""
         out: Dict[int, Tuple[int, ...]] = {}
+        seen = {int(ind_id)}  # enqueued-ever: memo across shared ancestors
         frontier = [int(ind_id)]
         depth = 0
         while frontier and depth < max_depth:
@@ -146,7 +158,10 @@ class History:
                 parents = self.genealogy_tree.get(cid, ())
                 if parents:
                     out[cid] = parents
-                    nxt.extend(p for p in parents if p not in out)
-            frontier = list(dict.fromkeys(nxt))
+                    for p in parents:
+                        if p not in seen:
+                            seen.add(p)
+                            nxt.append(p)
+            frontier = nxt
             depth += 1
         return out

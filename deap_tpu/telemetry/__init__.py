@@ -16,6 +16,14 @@ Three planes (see docs/advanced/telemetry.md):
    per name (count/total/p50/p99) into the journal — the per-collective
    ``genome_shard/*`` spans yield numbers even with no xplane capture.
 
+On top of the pipes, :mod:`~deap_tpu.telemetry.probes` is the
+evolution-specific *content*: jit-safe population probes (diversity,
+selection pressure, landscape stats, front quality) threaded through
+every loop's ``probes=`` argument, a host-side :class:`HealthMonitor`
+turning meter rows into journaled ``alarm`` events, and
+:mod:`~deap_tpu.telemetry.report` — a stdlib-only terminal renderer for
+any journal (``python bench_report.py --health run.jsonl``).
+
 The reference's only telemetry is the ``nevals`` logbook column; none
 of the JAX-native EC frameworks (evosax, Kozax — PAPERS.md) emit
 structured machine-readable run telemetry either. This subsystem is
@@ -30,16 +38,40 @@ from deap_tpu.telemetry.journal import (
     toolbox_fingerprint,
 )
 from deap_tpu.telemetry.meter import Meter, MeterState
+from deap_tpu.telemetry.probes import (
+    PROBE_REGISTRY,
+    DiversityProbe,
+    FitnessProbe,
+    FrontProbe,
+    HealthMonitor,
+    Probe,
+    SelectionProbe,
+    TreeDiversityProbe,
+    compose_probes,
+    exact_hypervolume,
+    register_probe,
+)
 from deap_tpu.telemetry.run import RunTelemetry, strategy_probe
 
 __all__ = [
     "Meter",
     "MeterState",
+    "PROBE_REGISTRY",
+    "Probe",
+    "DiversityProbe",
+    "TreeDiversityProbe",
+    "FitnessProbe",
+    "SelectionProbe",
+    "FrontProbe",
+    "HealthMonitor",
     "RunJournal",
     "RunTelemetry",
     "broadcast",
+    "compose_probes",
     "environment_fingerprint",
+    "exact_hypervolume",
     "read_journal",
+    "register_probe",
     "strategy_probe",
     "toolbox_fingerprint",
 ]

@@ -36,7 +36,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-__all__ = ["RunJournal", "read_journal", "broadcast",
+__all__ = ["RunJournal", "JournalRows", "read_journal", "broadcast",
            "toolbox_fingerprint", "environment_fingerprint"]
 
 _LOCK = threading.Lock()
@@ -233,18 +233,55 @@ class RunJournal:
         self.close()
 
 
-def read_journal(path: str) -> List[Dict[str, Any]]:
-    """Parse a journal back into a list of event dicts (malformed lines
-    are skipped — a crashed writer must not make the journal
-    unreadable)."""
-    out: List[Dict[str, Any]] = []
-    with open(path) as fh:
-        for line in fh:
-            line = line.strip()
-            if not line:
-                continue
+class JournalRows(List[Dict[str, Any]]):
+    """``read_journal``'s result: a plain list of event dicts, plus
+    where the file stopped being parseable.
+
+    - ``tear_offset`` — byte offset of a torn *tail* (a final line a
+      killed writer never finished — truncated JSON or missing its
+      newline), or ``None`` when the journal ends cleanly.
+    - ``skipped_offsets`` — byte offsets of malformed *interior* lines
+      (newline-terminated but unparseable: a crashed writer mid-file,
+      interleaved garbage).
+    """
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        self.tear_offset: Optional[int] = None
+        self.skipped_offsets: List[int] = []
+
+
+def read_journal(path: str, strict: bool = False) -> JournalRows:
+    """Parse a journal back into a list of event dicts.
+
+    A journal from a killed run usually ends in a torn line (the
+    writer died mid-``write``); by default (``strict=False``) the
+    complete rows are returned and the tear's byte offset is reported
+    on the result (:class:`JournalRows` ``.tear_offset`` — resume
+    tooling can truncate there and append). Malformed interior lines
+    are skipped with their offsets recorded. ``strict=True`` raises
+    ``ValueError`` naming the first bad byte offset instead.
+    """
+    out = JournalRows()
+    with open(path, "rb") as fh:
+        data = fh.read()
+    offset = 0
+    for raw in data.split(b"\n"):
+        terminated = offset + len(raw) < len(data)
+        line = raw.strip()
+        if line:
             try:
-                out.append(json.loads(line))
-            except json.JSONDecodeError:
-                continue
+                out.append(json.loads(line.decode("utf-8")))
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                if strict:
+                    raise ValueError(
+                        f"{path}: unparseable journal line at byte "
+                        f"{offset}" + ("" if terminated else
+                                       " (torn tail — writer killed "
+                                       "mid-write?)"))
+                if terminated:
+                    out.skipped_offsets.append(offset)
+                else:
+                    out.tear_offset = offset
+        offset += len(raw) + 1
     return out

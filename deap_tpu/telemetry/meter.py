@@ -70,14 +70,19 @@ class Meter:
         self._specs[name] = spec
 
     def counter(self, name: str, shape: Sequence[int] = (),
-                dtype=jnp.int32) -> None:
+                dtype=jnp.int32, internal: bool = False) -> None:
         self._declare(name, kind="counter", shape=tuple(shape),
-                      dtype=jnp.dtype(dtype).name)
+                      dtype=jnp.dtype(dtype).name, internal=bool(internal))
 
     def gauge(self, name: str, shape: Sequence[int] = (),
-              dtype=jnp.float32) -> None:
+              dtype=jnp.float32, internal: bool = False) -> None:
+        """``internal=True`` marks carry-only state (a probe's previous
+        best, a per-individual lineage array): it lives in the meter
+        state like any gauge but is omitted from :meth:`row`/:meth:`
+        rows`, so bulky or meaningless-to-humans carries never bloat
+        the journal."""
         self._declare(name, kind="gauge", shape=tuple(shape),
-                      dtype=jnp.dtype(dtype).name)
+                      dtype=jnp.dtype(dtype).name, internal=bool(internal))
 
     def histogram(self, name: str, lo: float, hi: float,
                   bins: int = 16) -> None:
@@ -154,12 +159,23 @@ class Meter:
             emit(int(gen), self.row(st))
         jax.debug.callback(_cb, gen, **state)
 
+    def get(self, state: MeterState, name: str) -> jnp.ndarray:
+        """Read a metric's current value out of the state (probes use
+        this for carried quantities)."""
+        if name not in self._specs:
+            raise KeyError(f"metric {name!r} was never declared "
+                           f"(known: {sorted(self._specs)})")
+        return state[name]
+
     # ----------------------------------------------------- host decoding ----
 
     def row(self, state: Mapping[str, Any]) -> Dict[str, Any]:
-        """One state snapshot as a JSON-serialisable dict."""
+        """One state snapshot as a JSON-serialisable dict (``internal``
+        metrics — carry-only state — are omitted)."""
         out: Dict[str, Any] = {}
         for name, s in self._specs.items():
+            if s.get("internal"):
+                continue
             a = np.asarray(state[name])
             if a.ndim == 0:
                 out[name] = a.item()

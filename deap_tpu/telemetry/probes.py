@@ -1,0 +1,718 @@
+"""Search-dynamics probes — jit-safe population analytics on the Meter.
+
+PR 2 built the telemetry *pipes* (the :class:`~deap_tpu.telemetry.meter.
+Meter` carry, the JSONL journal); this module is the evolution-specific
+*content*: a library of probes that turn a per-generation population
+snapshot into diversity / selection-pressure / landscape / front-quality
+metrics, entirely on device inside the compiled scan. The reference's
+support objects answer these questions on the host between generations
+(``Statistics``/``History``/``ParetoFront`` — tools/support.py); here a
+whole run is one ``lax.scan``, so anything worth knowing must ride the
+scan as data, like evosax/Kozax keep their ES statistics on device
+(PAPERS.md).
+
+A probe is a callable ``probe(meter, mstate, **ctx) -> mstate`` with a
+``declare(meter)`` hook and a ``metric_names`` tuple naming every
+journal-visible metric it maintains (the doc-drift gate in
+``tests/test_probe_coverage.py`` keys on it). The context the
+instrumented loops provide:
+
+- ``pop`` — the post-step :class:`~deap_tpu.core.population.Population`
+  (for island steps, the deme axis flattened away);
+- ``gen`` — the generation index (``None`` for stateless island epochs);
+- ``sel_idx`` / ``sel_pool`` — the selection index vector the loop just
+  used and the (static) size of the pool it indexes into;
+- ``parent_idx`` — per-child parent indices into the *previous*
+  population, when the loop's selection doubles as parentage
+  (``ea_simple``, the GP host loop);
+- ``state`` — the strategy state (ask-tell loops);
+- ``journal`` — the active RunJournal, for host-side sampled events;
+- ``host_clone_rate`` — exact clone rate, when a host-dispatch loop
+  already ran the GP interpreter's dedup (see
+  :class:`TreeDiversityProbe`).
+
+Probes read population state, consume no RNG, and feed nothing back:
+enabling any of them leaves populations/logbooks/hofs bit-identical
+(pinned by ``tests/test_probes.py``). Carried quantities (previous
+best, stagnation age, lineage depths) live in ordinary Meter gauges, so
+they need no new carry plumbing; bulky per-individual carries are
+declared ``internal`` and never reach the journal.
+
+The :class:`HealthMonitor` is the host-side layer that turns decoded
+meter rows into journaled ``alarm`` events (NaN/Inf fitness, clone-rate
+spike, premature convergence, zero-improvement window) with an optional
+early-stop signal for host-driven loops.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "PROBE_REGISTRY",
+    "register_probe",
+    "Probe",
+    "DiversityProbe",
+    "TreeDiversityProbe",
+    "FitnessProbe",
+    "SelectionProbe",
+    "FrontProbe",
+    "HealthMonitor",
+    "compose_probes",
+    "exact_hypervolume",
+]
+
+#: probe-class registry — the doc-drift gate iterates this, so every
+#: probe class must register (tests/test_probe_coverage.py fails on a
+#: ``*Probe`` class defined here but absent from the registry)
+PROBE_REGISTRY: Dict[str, type] = {}
+
+
+def register_probe(cls: type) -> type:
+    PROBE_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+class Probe:
+    """Base protocol. ``metric_names`` lists every journal-visible
+    metric the probe declares — documentation tooling and the drift
+    gate read it, so keep it exact."""
+
+    metric_names: Tuple[str, ...] = ()
+
+    def declare(self, meter) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def __call__(self, meter, mstate, **ctx):  # pragma: no cover
+        raise NotImplementedError
+
+
+# ------------------------------------------------------------ helpers ----
+
+def _strided(n: int, k: int) -> jnp.ndarray:
+    """k row indices spread evenly over [0, n) — deterministic, no RNG
+    (probes must not touch the loop's key stream)."""
+    k = min(int(k), int(n))
+    return (jnp.arange(k) * n) // k
+
+
+def _unique_count(rows: jnp.ndarray) -> jnp.ndarray:
+    """Number of distinct rows of an int32 ``[n, d]`` matrix, via a
+    64-bit-equivalent double hash (two independent 32-bit multiply-add
+    hashes, compared lexicographically after a sort). Collision
+    probability ~ n²/2⁶⁴ — negligible against the metric's purpose.
+    O(nd + n log n), jit-safe."""
+    v = rows.astype(jnp.uint32)
+    d = v.shape[1]
+    j = jnp.arange(d, dtype=jnp.uint32)
+    w1 = j * jnp.uint32(2654435761) + jnp.uint32(0x9E3779B9)
+    w2 = (j + jnp.uint32(0x7FEE3F)) * jnp.uint32(2246822519) + jnp.uint32(
+        0x85EBCA6B)
+    h1 = jnp.sum(v * w1[None, :], axis=1, dtype=jnp.uint32)
+    h2 = jnp.sum(v * w2[None, :], axis=1, dtype=jnp.uint32)
+    order = jnp.lexsort((h2, h1))
+    s1, s2 = h1[order], h2[order]
+    fresh = (s1[1:] != s1[:-1]) | (s2[1:] != s2[:-1])
+    return jnp.int32(1) + jnp.sum(fresh.astype(jnp.int32))
+
+
+def _genome_matrix(genomes: Any) -> jnp.ndarray:
+    """Flatten any genome pytree to ``f32[n, D]`` (shared leading axis)."""
+    leaves = jax.tree_util.tree_leaves(genomes)
+    n = leaves[0].shape[0]
+    return jnp.concatenate(
+        [jnp.reshape(a, (n, -1)).astype(jnp.float32) for a in leaves],
+        axis=1)
+
+
+# ========================================================== diversity ====
+
+@register_probe
+class DiversityProbe(Probe):
+    """Genotypic diversity of vector genomes (bitstring / real / any
+    pytree, flattened).
+
+    Every statistic is computed on a deterministic strided sample of
+    ``sample`` rows (no RNG — probes must not touch the loop's key
+    stream): the in-scan cost budget is a few percent of a generation
+    at pop=100k, which rules out any full-population pass beyond the
+    built-ins' reductions. Costs are O(K·d) gather + O(K²) pairwise
+    via one Gram matmul.
+
+    - ``div_msd`` — mean pairwise squared distance over the sample's
+      ordered pairs, via the centroid identity ``2k/(k-1) · Σ_d var_d``
+      (an unbiased estimator of the population quantity).
+    - ``div_pdist_mean`` / ``div_pdist_std`` / ``div_pdist_min`` —
+      euclidean pairwise-distance moments of the sample block.
+    - ``div_unique_frac`` — fraction of genotypically distinct rows in
+      the sample (double 32-bit row hash); the complement estimates
+      the population clone rate. For an *exact* population clone rate
+      use ``full_unique=True`` (adds an O(nd + n log n) pass — ~80 ms
+      at pop=100k on one CPU core, far over the in-scan budget there,
+      fine for host-driven loops and modest populations).
+    """
+
+    metric_names = ("div_msd", "div_pdist_mean", "div_pdist_std",
+                    "div_pdist_min", "div_unique_frac")
+
+    def __init__(self, sample: int = 256, full_unique: bool = False):
+        self.sample = int(sample)
+        self.full_unique = bool(full_unique)
+
+    def declare(self, meter) -> None:
+        for name in self.metric_names:
+            meter.gauge(name)
+
+    def __call__(self, meter, mstate, pop=None, **_ctx):
+        if pop is None:
+            return mstate
+        leaves = jax.tree_util.tree_leaves(pop.genomes)
+        n = leaves[0].shape[0]
+        idx = _strided(n, self.sample)
+        # gather rows BEFORE flattening to f32 — the flatten itself is
+        # an O(nd) copy (~19 ms at pop=100k), over the in-scan budget
+        sub = _genome_matrix(jax.tree_util.tree_map(
+            lambda a: jnp.take(a, idx, axis=0), pop.genomes))
+        k = sub.shape[0]
+
+        mu = jnp.mean(sub, axis=0)
+        var_sum = jnp.mean(jnp.sum((sub - mu[None, :]) ** 2, axis=1))
+        msd = (2.0 * k / max(k - 1, 1)) * var_sum
+        mstate = meter.set(mstate, "div_msd", msd)
+
+        # ||a-b||² = ||a||² + ||b||² − 2a·b — one matmul instead of a
+        # materialised [K, K, d] difference tensor
+        sqn = jnp.sum(sub * sub, axis=1)
+        sq = sqn[:, None] + sqn[None, :] - 2.0 * (sub @ sub.T)
+        pd = jnp.sqrt(jnp.maximum(sq, 0.0))
+        off = ~jnp.eye(k, dtype=bool)
+        npair = max(k * (k - 1), 1)
+        pmean = jnp.sum(jnp.where(off, pd, 0.0)) / npair
+        pvar = jnp.sum(jnp.where(off, (pd - pmean) ** 2, 0.0)) / npair
+        pmin = jnp.min(jnp.where(off, pd, jnp.inf)) if k > 1 else jnp.float32(0)
+        mstate = meter.set(mstate, "div_pdist_mean", pmean)
+        mstate = meter.set(mstate, "div_pdist_std", jnp.sqrt(pvar))
+        mstate = meter.set(mstate, "div_pdist_min",
+                           jnp.where(jnp.isfinite(pmin), pmin, 0.0))
+
+        hashed = _genome_matrix(pop.genomes) if self.full_unique else sub
+        rows = jax.lax.bitcast_convert_type(hashed, jnp.int32)
+        uniq = _unique_count(rows)
+        mstate = meter.set(mstate, "div_unique_frac",
+                           uniq.astype(jnp.float32) / hashed.shape[0])
+        return mstate
+
+
+@register_probe
+class TreeDiversityProbe(Probe):
+    """Genotypic diversity of GP tree populations (prefix-linearised
+    ``{"nodes", "consts", "length"}`` genomes, gp/tree.py layout).
+
+    - ``gp_opcode_entropy`` — Shannon entropy (nats) of the live-slot
+      opcode histogram: the same live-vocab signal the specialized
+      interpreter masks on (gp/interpreter.py ``_used_ops``), as a
+      convergence scalar. Collapsing entropy means the population is
+      abandoning operators.
+    - ``gp_clone_rate`` — ``1 − unique/n`` over live prefixes, padding
+      normalised out exactly like the interpreter's dedup
+      (``_dedup_rows``): in-scan it uses the double row hash; a
+      host-dispatch loop that already deduped passes the exact count
+      via ``host_clone_rate`` and the probe publishes that instead.
+    - ``gp_mean_size`` — mean live prefix length.
+    """
+
+    metric_names = ("gp_opcode_entropy", "gp_clone_rate", "gp_mean_size")
+
+    def __init__(self, pset):
+        self.n_ops = int(pset.n_ops)
+
+    def declare(self, meter) -> None:
+        for name in self.metric_names:
+            meter.gauge(name)
+
+    def __call__(self, meter, mstate, pop=None, host_clone_rate=None,
+                 **_ctx):
+        if pop is None:
+            return mstate
+        g = pop.genomes
+        nodes = jnp.asarray(g["nodes"], jnp.int32)
+        consts = jnp.asarray(g["consts"], jnp.float32)
+        length = jnp.asarray(g["length"], jnp.int32)
+        n, L = nodes.shape
+        live = jnp.arange(L)[None, :] < length[:, None]
+
+        is_op = live & (nodes < self.n_ops)
+        ids = jnp.where(is_op, nodes, self.n_ops)  # overflow bucket
+        hist = jnp.zeros(self.n_ops + 1, jnp.float32).at[ids.ravel()].add(
+            is_op.ravel().astype(jnp.float32))[: self.n_ops]
+        total = jnp.maximum(jnp.sum(hist), 1.0)
+        p = hist / total
+        ent = -jnp.sum(jnp.where(p > 0, p * jnp.log(p), 0.0))
+        mstate = meter.set(mstate, "gp_opcode_entropy", ent)
+
+        if host_clone_rate is not None:
+            mstate = meter.set(mstate, "gp_clone_rate", host_clone_rate)
+        else:
+            nn = jnp.where(live, nodes, -1)
+            cc = jax.lax.bitcast_convert_type(
+                jnp.where(live, consts, 0.0), jnp.int32)
+            uniq = _unique_count(jnp.concatenate([nn, cc], axis=1))
+            mstate = meter.set(mstate, "gp_clone_rate",
+                               1.0 - uniq.astype(jnp.float32) / n)
+        mstate = meter.set(mstate, "gp_mean_size",
+                           jnp.mean(length.astype(jnp.float32)))
+        return mstate
+
+
+# ================================================== fitness landscape ====
+
+@register_probe
+class FitnessProbe(Probe):
+    """Fitness-landscape shape and search progress, from the first
+    weighted objective.
+
+    - ``fit_gap`` — best − median: how far the elite sits above the
+      bulk (a collapsing gap with low diversity = converged). The
+      median is taken over a deterministic strided ``sample`` (a full
+      100k-row sort is ~25 ms on one CPU core — over the in-scan
+      budget); the best is the exact full-population max.
+    - ``fit_velocity`` — best-so-far improvement this generation.
+    - ``stagnation_age`` — generations since best-so-far last improved
+      by more than ``min_delta``.
+
+    The previous best rides the meter as an ``internal`` gauge — it is
+    carry, not a journal metric.
+    """
+
+    metric_names = ("fit_gap", "fit_velocity", "stagnation_age")
+
+    def __init__(self, min_delta: float = 0.0, sample: int = 1024):
+        self.min_delta = float(min_delta)
+        self.sample = int(sample)
+
+    def declare(self, meter) -> None:
+        meter.gauge("fit_gap")
+        meter.gauge("fit_velocity")
+        meter.gauge("stagnation_age", dtype=jnp.int32)
+        meter.gauge("fit_prev_best", internal=True)
+        meter.gauge("fit_seen", dtype=jnp.int32, internal=True)
+
+    def __call__(self, meter, mstate, pop=None, **_ctx):
+        if pop is None:
+            return mstate
+        w0 = pop.wvalues[:, 0]
+        best = jnp.max(w0)
+        sub = _strided(w0.shape[0], self.sample)
+        med = jnp.nanmedian(jnp.where(pop.valid[sub], w0[sub], jnp.nan))
+        prev = mstate["fit_prev_best"]
+        seen = mstate["fit_seen"] > 0
+        improved = best > prev + self.min_delta
+        vel = jnp.where(seen, best - prev, 0.0)
+        stag = jnp.where(seen & ~improved,
+                         mstate["stagnation_age"] + 1, 0)
+        mstate = meter.set(mstate, "fit_gap", best - med)
+        mstate = meter.set(mstate, "fit_velocity", vel)
+        mstate = meter.set(mstate, "stagnation_age", stag)
+        mstate = meter.set(mstate, "fit_prev_best",
+                           jnp.where(seen, jnp.maximum(prev, best), best))
+        mstate = meter.set(mstate, "fit_seen", 1)
+        return mstate
+
+
+# ================================================= selection pressure ====
+
+@register_probe
+class SelectionProbe(Probe):
+    """Selection pressure, from the index vector the loop already holds
+    (no extra compute touches the population).
+
+    - ``sel_eff_parents`` — effective parent count, the inverse Simpson
+      index ``1/Σ pᵢ²`` of the selection-count distribution: n means
+      uniform selection, 1 means one individual swept the pool.
+    - ``sel_loss_diversity`` — Blickle & Thiele's loss of diversity:
+      the fraction of the selection pool never picked.
+    - ``lineage_depth_mean`` / ``lineage_depth_max`` — generations of
+      ancestry per individual, the scalarised form of
+      ``support.history.Lineage``: the per-individual depth array rides
+      the meter as an ``internal`` gauge and advances by
+      ``depth[parent_idx] + 1`` exactly as :func:`~deap_tpu.support.
+      history.lineage_step` advances ids. Only loops whose selection
+      doubles as parentage provide ``parent_idx`` (``ea_simple``, the
+      GP host loop); elsewhere the lineage gauges hold their last
+      value.
+
+    ``every=k`` decimates the pressure statistics to every k-th
+    generation (``lax.cond`` — the gauges hold their last value in
+    between): the count pass is one scatter-add over the pool, which
+    XLA's CPU backend executes serially (~5 ms at pool=100k), and
+    selection pressure moves slowly enough that sampling it is free
+    accuracy. Lineage depths always advance every generation (a gather,
+    cheap; skipping one would corrupt the depths for good).
+    """
+
+    metric_names = ("sel_eff_parents", "sel_loss_diversity",
+                    "lineage_depth_mean", "lineage_depth_max")
+
+    def __init__(self, n: Optional[int] = None, lineage: bool = True,
+                 every: int = 1):
+        """``n`` — population size, required when ``lineage`` is on
+        (the internal depth gauge is declared with that shape)."""
+        if lineage and n is None:
+            raise ValueError("SelectionProbe(lineage=True) needs n= "
+                             "(the per-individual depth gauge's shape)")
+        self.n = None if n is None else int(n)
+        self.lineage = bool(lineage)
+        self.every = max(int(every), 1)
+
+    def declare(self, meter) -> None:
+        meter.gauge("sel_eff_parents")
+        meter.gauge("sel_loss_diversity")
+        if self.lineage:
+            meter.gauge("lineage_depth_mean")
+            meter.gauge("lineage_depth_max", dtype=jnp.int32)
+            meter.gauge("lineage_depth", shape=(self.n,),
+                        dtype=jnp.int32, internal=True)
+
+    def __call__(self, meter, mstate, sel_idx=None, sel_pool=None,
+                 parent_idx=None, gen=None, **_ctx):
+        if sel_idx is not None and sel_pool:
+            k = sel_idx.shape[0]
+
+            def pressure(ms):
+                counts = jnp.zeros(int(sel_pool),
+                                   jnp.float32).at[sel_idx].add(1.0)
+                p = counts / k
+                eff = 1.0 / jnp.maximum(jnp.sum(p * p), 1e-12)
+                ms = meter.set(ms, "sel_eff_parents", eff)
+                return meter.set(ms, "sel_loss_diversity", jnp.mean(
+                    (counts == 0).astype(jnp.float32)))
+
+            if self.every > 1 and gen is not None:
+                mstate = jax.lax.cond(
+                    jnp.mod(jnp.asarray(gen), self.every) == 0,
+                    pressure, lambda ms: ms, mstate)
+            else:
+                mstate = pressure(mstate)
+        if self.lineage and parent_idx is not None:
+            depth = mstate["lineage_depth"]
+            nd = jnp.take(depth, parent_idx, axis=0) + 1
+            mstate = meter.set(mstate, "lineage_depth", nd)
+            mstate = meter.set(mstate, "lineage_depth_mean",
+                               jnp.mean(nd.astype(jnp.float32)))
+            mstate = meter.set(mstate, "lineage_depth_max", jnp.max(nd))
+        return mstate
+
+
+# ====================================================== front quality ====
+
+def _hv_slab(P: jnp.ndarray, ref: jnp.ndarray) -> jnp.ndarray:
+    """Exact hypervolume of the union of boxes ``[ref, p]`` for M in
+    {1, 2, 3}, maximisation, ``P`` pre-clipped to ``>= ref``.
+
+    M=2 is the classic staircase after an x-descending sort (O(K log
+    K)); M=3 is the slab decomposition — sweep z descending, each slab
+    ``(z_i − z_next)`` times the 2-D staircase area of the points above
+    it — vectorised as one K×K membership matrix + row-wise cummax
+    (O(K²) memory and time, which is the probe's documented budget).
+    Dominated points never change a union, so no front filter is
+    needed."""
+    m = P.shape[1]
+    if m == 1:
+        return jnp.max(P[:, 0]) - ref[0]
+    xo = jnp.argsort(-P[:, 0])
+    xs, ys = P[xo, 0], P[xo, 1]
+    widths = xs - jnp.concatenate([xs[1:], ref[None, 0]])
+    if m == 2:
+        ymax = jax.lax.cummax(ys)
+        return jnp.sum(widths * (ymax - ref[1]))
+    zo = jnp.argsort(-P[:, 2])
+    zs = P[zo, 2]
+    slabs = zs - jnp.concatenate([zs[1:], ref[None, 2]])
+    # rank of each x-sorted point in the z order: zrank[i] = position
+    # of point i (original index) in the z-descending sweep
+    k = P.shape[0]
+    zrank = jnp.zeros(k, jnp.int32).at[zo].set(jnp.arange(k, dtype=jnp.int32))
+    member = zrank[xo][None, :] <= jnp.arange(k)[:, None]  # [slab, xpos]
+    ymax = jax.lax.cummax(jnp.where(member, ys[None, :], ref[1]), axis=1)
+    areas = jnp.sum(widths[None, :] * (ymax - ref[1]), axis=1)
+    return jnp.sum(slabs * areas)
+
+
+def exact_hypervolume(wvalues, ref) -> float:
+    """Host-side exact hypervolume (native WFG / pure-python fallback,
+    deap_tpu.native) of the points strictly dominating ``ref``, in the
+    package's maximisation convention. The sampled ground truth the
+    in-scan ``hv_proxy`` is checked against."""
+    from deap_tpu.native import hypervolume
+
+    w = np.asarray(wvalues, np.float64)
+    r = np.asarray(ref, np.float64)
+    keep = np.all(w > r[None, :], axis=1) & np.all(np.isfinite(w), axis=1)
+    if not keep.any():
+        return 0.0
+    return float(hypervolume(-w[keep], -r))
+
+
+@register_probe
+class FrontProbe(Probe):
+    """Per-generation multi-objective front quality, M ≤ 3.
+
+    Works on a deterministic strided sample of ``max_points`` rows
+    (the O(K²) parts are the documented budget; K defaults to 512):
+
+    - ``front_frac`` — non-dominated fraction of the sample (O(K²)
+      dominance check).
+    - ``front_spread`` — euclidean norm of the front's per-objective
+      extents (is the front covering, or a point?).
+    - ``front_spacing`` — Schott's spacing: std of each front point's
+      nearest-front-neighbour distance (uniformity of coverage).
+    - ``hv_proxy`` — **exact** hypervolume of the sampled points
+      w.r.t. ``ref`` (staircase for M=2, slab decomposition for M=3):
+      a proxy only in that it sees the sample, not the population.
+
+    With ``exact_every=k`` the sampled points also ship to the host
+    every k generations (one small ``jax.debug.callback`` transfer) and
+    the native exact hypervolume lands in the journal as ``hv_exact``
+    events — the cross-check against ``hv_proxy`` costs nothing
+    in-scan.
+    """
+
+    metric_names = ("front_frac", "front_spread", "front_spacing",
+                    "hv_proxy")
+
+    def __init__(self, ref: Sequence[float], max_points: int = 512,
+                 exact_every: int = 0):
+        self.ref = tuple(float(r) for r in ref)
+        self.max_points = int(max_points)
+        self.exact_every = int(exact_every)
+
+    def declare(self, meter) -> None:
+        for name in self.metric_names:
+            meter.gauge(name)
+
+    def _host_exact(self, journal, gen, pts):
+        gen = int(gen)
+        if self.exact_every and gen % self.exact_every == 0:
+            journal.event("hv_exact", gen=gen,
+                          value=exact_hypervolume(pts, self.ref),
+                          n_points=int(pts.shape[0]))
+
+    def __call__(self, meter, mstate, pop=None, gen=None, journal=None,
+                 **_ctx):
+        if pop is None:
+            return mstate
+        W = pop.wvalues
+        m = W.shape[1]
+        if m != len(self.ref):
+            raise ValueError(f"FrontProbe ref has {len(self.ref)} "
+                             f"objectives, population has {m}")
+        if m > 3:
+            raise ValueError("FrontProbe supports M <= 3 (in-scan "
+                             "hypervolume); use exact_hypervolume on "
+                             "the host for higher M")
+        ref = jnp.asarray(self.ref, jnp.float32)
+        idx = _strided(W.shape[0], self.max_points)
+        S = W[idx]
+        P = jnp.maximum(S, ref[None, :])  # invalid (-inf) rows collapse
+        k = P.shape[0]
+
+        ge = jnp.all(P[None, :, :] >= P[:, None, :], axis=-1)
+        gt = jnp.any(P[None, :, :] > P[:, None, :], axis=-1)
+        dominated = jnp.any(ge & gt, axis=1)
+        front = ~dominated
+        nfront = jnp.maximum(jnp.sum(front.astype(jnp.float32)), 1.0)
+        mstate = meter.set(mstate, "front_frac",
+                           jnp.mean(front.astype(jnp.float32)))
+
+        lo = jnp.min(jnp.where(front[:, None], P, jnp.inf), axis=0)
+        hi = jnp.max(jnp.where(front[:, None], P, -jnp.inf), axis=0)
+        ext = jnp.where(jnp.isfinite(hi - lo), hi - lo, 0.0)
+        mstate = meter.set(mstate, "front_spread",
+                           jnp.sqrt(jnp.sum(ext ** 2)))
+
+        sq = jnp.sum((P[:, None, :] - P[None, :, :]) ** 2, axis=-1)
+        pairs = front[:, None] & front[None, :] & ~jnp.eye(k, dtype=bool)
+        nn = jnp.min(jnp.where(pairs, jnp.sqrt(sq), jnp.inf), axis=1)
+        nn = jnp.where(jnp.isfinite(nn), nn, 0.0)
+        nn_mean = jnp.sum(jnp.where(front, nn, 0.0)) / nfront
+        spacing = jnp.sqrt(
+            jnp.sum(jnp.where(front, (nn - nn_mean) ** 2, 0.0)) / nfront)
+        mstate = meter.set(mstate, "front_spacing", spacing)
+
+        mstate = meter.set(mstate, "hv_proxy", _hv_slab(P, ref))
+
+        if self.exact_every and journal is not None and gen is not None:
+            jax.debug.callback(
+                lambda g, pts: self._host_exact(journal, g, pts), gen, S)
+        return mstate
+
+
+# ----------------------------------------------------------- compose ----
+
+def compose_probes(*probes: Callable) -> Probe:
+    """One probe that declares and applies several in order (the shape
+    the loops build internally from their ``probes=`` argument)."""
+
+    class _Composite(Probe):
+        metric_names = tuple(
+            n for p in probes for n in getattr(p, "metric_names", ()))
+
+        def declare(self, meter) -> None:
+            for p in probes:
+                if hasattr(p, "declare"):
+                    p.declare(meter)
+
+        def __call__(self, meter, mstate, **ctx):
+            for p in probes:
+                mstate = p(meter, mstate, **ctx)
+            return mstate
+
+    return _Composite()
+
+
+# ======================================================= host tripwires ====
+
+class HealthMonitor:
+    """Host-side run-health tripwires over decoded meter rows.
+
+    Feed it rows (via :class:`~deap_tpu.telemetry.run.RunTelemetry`
+    ``health=``, which wires it into live streaming, host-driven
+    ``record_row`` and the post-scan decode) and it emits ``alarm``
+    dicts; the telemetry layer journals each as an ``alarm`` event.
+
+    Tripwires (each armed only when its threshold is configured):
+
+    - ``non_finite`` — any scalar metric in the row is NaN/Inf
+      (``nan_check``, on by default: a NaN fitness silently poisons
+      max/argmax selection).
+    - ``clone_spike`` — clone rate above ``clone_rate_max``; reads
+      ``clone_key`` (default ``gp_clone_rate``) and falls back to
+      ``1 − div_unique_frac``.
+    - ``premature_convergence`` — ``diversity_key`` fell below
+      ``diversity_floor`` (optionally only before ``premature_min_gen``
+      — collapse late in a run may just be convergence). Re-arms when
+      diversity recovers.
+    - ``zero_improvement`` — no ``best`` improvement beyond
+      ``improvement_eps`` for ``stagnation_window`` consecutive rows
+      (uses the row's ``stagnation_age`` when a FitnessProbe provides
+      it, otherwise tracks ``best`` itself). Re-arms after improvement.
+
+    ``early_stop`` names alarm kinds (or ``True`` for all) that set
+    :attr:`stop_requested` — host-driven loops (the GP engine, island
+    epoch drivers) poll it between generations; scanned loops cannot
+    stop mid-scan, their alarms land in the journal post-hoc.
+    ``on_alarm`` is called with each alarm dict as it fires.
+    """
+
+    #: every alarm kind this monitor can emit (report/tests key on it)
+    ALARM_KINDS = ("non_finite", "clone_spike", "premature_convergence",
+                   "zero_improvement")
+
+    def __init__(self, *, nan_check: bool = True,
+                 clone_rate_max: Optional[float] = None,
+                 clone_key: str = "gp_clone_rate",
+                 diversity_floor: Optional[float] = None,
+                 diversity_key: str = "div_msd",
+                 premature_min_gen: Optional[int] = None,
+                 stagnation_window: Optional[int] = None,
+                 improvement_eps: float = 0.0,
+                 early_stop=(), on_alarm: Optional[Callable] = None):
+        self.nan_check = bool(nan_check)
+        self.clone_rate_max = clone_rate_max
+        self.clone_key = clone_key
+        self.diversity_floor = diversity_floor
+        self.diversity_key = diversity_key
+        self.premature_min_gen = premature_min_gen
+        self.stagnation_window = stagnation_window
+        self.improvement_eps = float(improvement_eps)
+        self.early_stop = (set(self.ALARM_KINDS) if early_stop is True
+                           else set(early_stop))
+        self.on_alarm = on_alarm
+        self.alarms: List[dict] = []
+        self._best: Optional[float] = None
+        self._stag = 0
+        self._stag_fired = False
+        self._div_fired = False
+        self._stop = False
+
+    @property
+    def stop_requested(self) -> bool:
+        return self._stop
+
+    def _fire(self, kind: str, gen, **detail) -> dict:
+        alarm = {"alarm": kind, "gen": gen, **detail}
+        self.alarms.append(alarm)
+        if kind in self.early_stop:
+            self._stop = True
+        if self.on_alarm is not None:
+            self.on_alarm(alarm)
+        return alarm
+
+    def _clone_rate(self, row) -> Optional[float]:
+        v = row.get(self.clone_key)
+        if v is None and "div_unique_frac" in row:
+            v = 1.0 - row["div_unique_frac"]
+        return v
+
+    def check_row(self, row: Dict[str, Any],
+                  gen: Optional[int] = None) -> List[dict]:
+        """Run every armed tripwire on one decoded meter row; returns
+        (and records) the alarms it fired."""
+        if gen is None:
+            gen = row.get("gen")
+        fired: List[dict] = []
+
+        if self.nan_check:
+            bad = [k for k, v in row.items()
+                   if isinstance(v, float) and not math.isfinite(v)]
+            if bad:
+                fired.append(self._fire("non_finite", gen, metrics=bad))
+
+        if self.clone_rate_max is not None:
+            cr = self._clone_rate(row)
+            if cr is not None and cr > self.clone_rate_max:
+                fired.append(self._fire(
+                    "clone_spike", gen, value=round(float(cr), 6),
+                    threshold=self.clone_rate_max))
+
+        if self.diversity_floor is not None:
+            div = row.get(self.diversity_key)
+            if div is not None and math.isfinite(div):
+                early = (self.premature_min_gen is None
+                         or gen is None or gen < self.premature_min_gen)
+                if div < self.diversity_floor and early:
+                    if not self._div_fired:
+                        self._div_fired = True
+                        fired.append(self._fire(
+                            "premature_convergence", gen,
+                            metric=self.diversity_key,
+                            value=round(float(div), 6),
+                            floor=self.diversity_floor))
+                elif div >= self.diversity_floor:
+                    self._div_fired = False  # re-arm on recovery
+
+        if self.stagnation_window is not None:
+            age = row.get("stagnation_age")
+            if age is None:
+                best = row.get("best")
+                if best is not None and math.isfinite(best):
+                    if (self._best is None
+                            or best > self._best + self.improvement_eps):
+                        self._best, self._stag = best, 0
+                    else:
+                        self._stag += 1
+                age = self._stag
+            if age >= self.stagnation_window:
+                if not self._stag_fired:
+                    self._stag_fired = True
+                    fired.append(self._fire(
+                        "zero_improvement", gen, age=int(age),
+                        window=self.stagnation_window))
+            else:
+                self._stag_fired = False  # improvement re-arms
+        return fired

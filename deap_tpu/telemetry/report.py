@@ -1,0 +1,218 @@
+"""Terminal run report for any telemetry journal — **no jax import**.
+
+Renders a JSONL :class:`~deap_tpu.telemetry.journal.RunJournal` into a
+human-readable run-health report: header fingerprint, per-probe
+sparklines over the meter rows, the alarm timeline, retrace summary and
+the span p50/p99 table. This is the triage tool for a box that cannot
+(or must not) initialise a backend — summarising a TPU run's journal on
+a laptop, or inside CI where attaching the single-client runtime is
+forbidden — so the module imports nothing but the standard library.
+
+To keep that guarantee it loads ``journal.py``'s parser by file path
+(the ``deap_tpu`` package ``__init__`` imports jax; ``journal.py``
+itself does not), and ``tests/test_probes.py`` pins "renders a journal
+without jax in ``sys.modules``" in a subprocess.
+
+Usage::
+
+    python bench_report.py --health run.jsonl      # the wired-up entry
+    python -m deap_tpu.telemetry.report run.jsonl  # jax already loaded
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import math
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+__all__ = ["render_report", "sparkline", "main"]
+
+_SPARK = "▁▂▃▄▅▆▇█"
+_MAX_SPARK = 48  # terminal budget per series
+
+_journal_mod = None
+
+
+def _journal():
+    """journal.py loaded standalone (not via the package, which would
+    drag in jax) — shares the exact parser, including the torn-tail
+    handling."""
+    global _journal_mod
+    if _journal_mod is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "journal.py")
+        spec = importlib.util.spec_from_file_location(
+            "_deap_tpu_journal_standalone", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _journal_mod = mod
+    return _journal_mod
+
+
+def sparkline(values: List[float], width: int = _MAX_SPARK) -> str:
+    """Unicode sparkline of a numeric series; non-finite points render
+    as ``·``. Longer series are strided down to ``width`` points."""
+    vals = list(values)
+    if not vals:
+        return ""
+    if len(vals) > width:
+        vals = [vals[(i * len(vals)) // width] for i in range(width)]
+    finite = [v for v in vals if isinstance(v, (int, float))
+              and math.isfinite(v)]
+    if not finite:
+        return "·" * len(vals)
+    lo, hi = min(finite), max(finite)
+    span = hi - lo
+    out = []
+    for v in vals:
+        if not (isinstance(v, (int, float)) and math.isfinite(v)):
+            out.append("·")
+        elif span == 0:
+            out.append(_SPARK[3])
+        else:
+            out.append(_SPARK[min(int((v - lo) / span * 8), 7)])
+    return "".join(out)
+
+
+def _fmt(v: Any) -> str:
+    if isinstance(v, float):
+        if not math.isfinite(v):
+            return str(v)
+        return f"{v:.6g}"
+    return str(v)
+
+
+def _meter_series(events: List[Dict[str, Any]]):
+    """meter rows → {metric: [(gen, value), ...]} for scalar numerics
+    (histogram lists are skipped — sparklines are per-scalar)."""
+    series: Dict[str, List] = {}
+    for e in events:
+        if e.get("kind") != "meter":
+            continue
+        gen = e.get("gen")
+        for k, v in e.items():
+            if k in ("kind", "t", "gen"):
+                continue
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            series.setdefault(k, []).append((gen, v))
+    return series
+
+
+def render_report(path: str, lines: Optional[List[str]] = None) -> str:
+    """The full report as one string (also returned line-by-line into
+    ``lines`` when given — bench_report prints as it renders)."""
+    out: List[str] = [] if lines is None else lines
+    events = _journal().read_journal(path)
+
+    out.append(f"# Run report: {os.path.basename(path)}")
+    out.append("")
+    if getattr(events, "tear_offset", None) is not None:
+        out.append(f"**torn tail** at byte {events.tear_offset} — the "
+                   "writer was killed mid-line; rows below are the "
+                   "complete prefix")
+    if getattr(events, "skipped_offsets", None):
+        out.append(f"{len(events.skipped_offsets)} malformed interior "
+                   f"line(s) skipped (byte offsets "
+                   f"{events.skipped_offsets[:5]}…)")
+
+    header = next((e for e in events if e.get("kind") == "header"), None)
+    if header is not None:
+        env = header.get("env", {})
+        out.append("- env: " + ", ".join(
+            f"{k}={v}" for k, v in env.items()))
+        if "toolbox" in header:
+            out.append("- toolbox digest: "
+                       f"{header['toolbox'].get('digest')}")
+    runs = [e for e in events if e.get("kind") == "run_start"]
+    if runs:
+        out.append("- runs: " + ", ".join(
+            str(e.get("algorithm", "?")) for e in runs))
+
+    retraces = [e for e in events if e.get("kind") == "retrace"]
+    compiles = [e for e in events if e.get("kind") == "compile"]
+    line = (f"- compiles: {len(compiles)}"
+            f", retraces after steady: {len(retraces)}")
+    if retraces:
+        line += (f" (**{sum(e.get('dur_s', 0.0) for e in retraces):.3f}s"
+                 " recompiling — investigate shape/closure churn**)")
+    out.append(line)
+
+    # ------------------------------------------------ probe sparklines ----
+    series = _meter_series(events)
+    if series:
+        out.append("")
+        out.append("## Metrics (per generation)")
+        out.append("")
+        width = max(len(k) for k in series)
+        for name in sorted(series):
+            pts = series[name]
+            vals = [v for _, v in pts]
+            out.append(f"{name.ljust(width)}  {sparkline(vals)}  "
+                       f"min={_fmt(min(vals))} max={_fmt(max(vals))} "
+                       f"last={_fmt(vals[-1])}")
+
+    hv = [e for e in events if e.get("kind") == "hv_exact"]
+    if hv:
+        out.append("")
+        out.append("## Exact hypervolume samples (host, native)")
+        for e in hv:
+            out.append(f"- gen {e.get('gen')}: {_fmt(e.get('value'))} "
+                       f"({e.get('n_points')} sampled points)")
+
+    # ----------------------------------------------------- alarm timeline ----
+    alarms = [e for e in events if e.get("kind") == "alarm"]
+    out.append("")
+    out.append(f"## Alarms ({len(alarms)})")
+    out.append("")
+    if alarms:
+        for a in alarms:
+            detail = ", ".join(
+                f"{k}={_fmt(v)}" for k, v in a.items()
+                if k not in ("kind", "t", "alarm", "gen"))
+            out.append(f"- gen {a.get('gen')} ▲ **{a.get('alarm')}**"
+                       + (f" ({detail})" if detail else ""))
+    else:
+        out.append("- none — no tripwire fired (or no HealthMonitor "
+                   "was attached)")
+
+    # --------------------------------------------------------- span table ----
+    spans = [e for e in events if e.get("kind") == "span"]
+    if spans:
+        out.append("")
+        out.append("## Spans (host wall time)")
+        out.append("")
+        out.append("| span | count | total s | p50 s | p99 s |")
+        out.append("|---|---|---|---|---|")
+        for s in sorted(spans, key=lambda s: -s.get("total_s", 0)):
+            out.append(
+                f"| {s.get('name')} | {s.get('count')} | "
+                f"{s.get('total_s', 0):.6f} | {s.get('p50_s', 0):.6f} | "
+                f"{s.get('p99_s', 0):.6f} |")
+
+    summary = next((e for e in reversed(events)
+                    if e.get("kind") == "summary"), None)
+    if summary is not None:
+        out.append("")
+        out.append("## Summary")
+        out.append("- " + ", ".join(
+            f"{k}={_fmt(v)}" for k, v in summary.items()
+            if k not in ("kind", "t")))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    paths = [a for a in argv if not a.startswith("-")]
+    if not paths:
+        print("usage: report.py <journal.jsonl> [...]", file=sys.stderr)
+        return 2
+    for p in paths:
+        print(render_report(p))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

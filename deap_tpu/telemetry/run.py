@@ -29,9 +29,14 @@ computation (pinned bit-identical by ``tests/test_telemetry.py``).
 
 A ``probe`` extends the built-in instrumentation with caller metrics;
 it is a callable ``probe(meter, mstate, **ctx) -> mstate`` (ctx carries
-``pop=`` and, for ask-tell loops, ``state=``), optionally with a
-``declare(meter)`` method run before ``meter.init()`` — see
-:func:`strategy_probe` for the CMA-ES shaped one.
+``pop=``, ``gen=``, the loop's selection indices ``sel_idx=``/
+``sel_pool=``/``parent_idx=``, ``journal=`` and, for ask-tell loops,
+``state=``), optionally with a ``declare(meter)`` method run before
+``meter.init()`` — see :func:`strategy_probe` for the CMA-ES shaped
+one and :mod:`deap_tpu.telemetry.probes` for the search-dynamics
+library the loops accept via their ``probes=`` argument. A
+:class:`~deap_tpu.telemetry.probes.HealthMonitor` passed as
+``health=`` turns decoded meter rows into journaled ``alarm`` events.
 """
 
 from __future__ import annotations
@@ -62,11 +67,17 @@ class RunTelemetry:
         generation, so off by default.
     :param spans: install a :class:`SpanRecorder` while the context is
         active (default True).
+    :param health: a :class:`~deap_tpu.telemetry.probes.HealthMonitor`;
+        every decoded meter row (live-streamed, host-recorded or
+        post-scan) runs through its tripwires and each alarm lands in
+        the journal as an ``alarm`` event. Host-driven loops also poll
+        ``health.stop_requested`` for early stopping.
     """
 
     def __init__(self, journal, meter: Optional[Meter] = None,
                  probe: Optional[Callable] = None, stream: bool = False,
-                 spans: bool = True, init_backend: bool = True):
+                 spans: bool = True, init_backend: bool = True,
+                 health=None):
         if isinstance(journal, RunJournal):
             self.journal = journal
             self._owns_journal = False
@@ -75,6 +86,8 @@ class RunTelemetry:
             self._owns_journal = True
         self.meter = meter if meter is not None else Meter()
         self.probe = probe
+        self.health = health
+        self._run_probes: tuple = ()
         self.stream = bool(stream)
         self.recorder: Optional[SpanRecorder] = (
             SpanRecorder() if spans else None)
@@ -103,26 +116,44 @@ class RunTelemetry:
     # ------------------------------------------------- algorithm helpers ----
 
     def begin_run(self, algorithm: str, toolbox: Any = None,
-                  declare: Optional[Callable] = None, **params: Any) -> None:
+                  declare: Optional[Callable] = None, probes=(),
+                  **params: Any) -> None:
         """Called by an instrumented loop before ``meter.init()``:
         writes the header (once) and a ``run_start`` event, and runs
         declaration hooks (the loop's built-ins arrive via ``declare``,
-        the probe's via its ``declare`` method)."""
+        probe declarations via each probe's ``declare`` method).
+        ``probes`` — this run's extra probes (the loop's ``probes=``
+        argument, see :mod:`deap_tpu.telemetry.probes`)."""
         if not self._header_written:
             self.journal.header(toolbox=toolbox,
                                 init_backend=self._init_backend)
             self._header_written = True
         if declare is not None:
             declare(self.meter)
+        self.add_probes(probes)
         if self.probe is not None and hasattr(self.probe, "declare"):
             self.probe.declare(self.meter)
         self.journal.event("run_start", algorithm=algorithm, **params)
 
+    def add_probes(self, probes) -> None:
+        """Register (and declare) extra probes for subsequent runs —
+        ``begin_run`` calls this with the loop's ``probes=`` argument;
+        ``make_island_step`` calls it directly (no begin_run there).
+        Idempotent per probe instance; must precede ``meter.init()``."""
+        for p in tuple(probes or ()):
+            if any(p is q for q in self._run_probes):
+                continue
+            if hasattr(p, "declare"):
+                p.declare(self.meter)
+            self._run_probes = self._run_probes + (p,)
+
     def apply_probe(self, mstate, **ctx):
-        """In-scan: run the user probe (if any) after the built-ins."""
-        if self.probe is None:
-            return mstate
-        return self.probe(self.meter, mstate, **ctx)
+        """In-scan: run the user probe and this run's probes, in
+        registration order, after the loop's built-ins."""
+        for p in ((self.probe,) if self.probe is not None else ()) \
+                + self._run_probes:
+            mstate = p(self.meter, mstate, journal=self.journal, **ctx)
+        return mstate
 
     def live(self, mstate, gen) -> None:
         """In-scan: opt-in streaming emitter (no-op unless ``stream``)."""
@@ -132,19 +163,42 @@ class RunTelemetry:
 
     def _emit_live(self, gen: int, row: dict) -> None:
         self.journal.event("meter_live", gen=gen, **row)
+        self._check_health(row, gen)
         print(f"[deap_tpu] gen {gen}: " + " ".join(
             f"{k}={v}" for k, v in row.items()
             if not isinstance(v, list)), file=sys.stderr)
 
+    def _check_health(self, row: dict, gen) -> None:
+        """Run the HealthMonitor tripwires on one decoded row; every
+        alarm becomes a journal ``alarm`` event."""
+        if self.health is None:
+            return
+        for alarm in self.health.check_row(row, gen=gen):
+            self.journal.event("alarm", **alarm)
+
+    def record_row(self, mstate, gen) -> None:
+        """Host-driven loops (the GP engine, island epoch drivers):
+        journal one decoded ``meter`` row and run the health tripwires
+        on it — the per-generation counterpart of the scanned loops'
+        post-scan decode."""
+        row = self.meter.row(mstate)
+        self.journal.event("meter", gen=gen, **row)
+        self._check_health(row, gen)
+
     def end_run(self, algorithm: str, stacked_meter=None, initial=None,
                 gen0: int = 1, **summary: Any) -> None:
         """Called by an instrumented loop after its scan returns: decode
-        and journal the per-generation meter rows, write ``run_end``,
-        and mark the journal steady so later compiles surface as
-        retraces."""
+        and journal the per-generation meter rows (running health
+        tripwires on each), write ``run_end``, and mark the journal
+        steady so later compiles surface as retraces."""
         if stacked_meter is not None:
             self.journal.meter_rows(self.meter, stacked_meter, gen0=gen0,
                                     initial=initial)
+            if self.health is not None:
+                if initial is not None:
+                    self._check_health(self.meter.row(initial), gen0 - 1)
+                for i, row in enumerate(self.meter.rows(stacked_meter)):
+                    self._check_health(row, gen0 + i)
         self.journal.event("run_end", algorithm=algorithm, **summary)
         self.journal.mark_steady(algorithm)
 

@@ -126,6 +126,36 @@ def test_lineage_ids_and_history():
     assert 5 not in hist.get_genealogy(9, max_depth=1)
 
 
+def test_genealogy_diamond_shared_ancestors():
+    """Diamond lineage: D is an ancestor of A along two lines (A→B→D,
+    A→C→D). BFS with a visited set must return it ONCE, expand it once,
+    and honour max_depth at its shallowest occurrence — the reference's
+    per-path recursion re-walks shared ancestors, which blows up
+    combinatorially once crossover recombines relatives."""
+    hist = History()
+    hist.found(1)                                  # id 1 = D (founder)
+    hist.record(np.asarray([[1], [1]]))            # gen1: B=2, C=3 of D
+    hist.record(np.asarray([[2, 3]]))              # gen2: A=4 of B and C
+    gene = hist.get_genealogy(4)
+    assert gene == {4: (2, 3), 2: (1,), 3: (1,)}
+    # depth 1: only A's own parents; D (depth 2 on both lines) excluded
+    assert hist.get_genealogy(4, max_depth=1) == {4: (2, 3)}
+    # a long chain hanging off one diamond arm must not be re-walked
+    # through the other: build diamond-of-diamonds and check linearity
+    hist2 = History()
+    hist2.found(1)
+    n_layers = 40
+    for _ in range(n_layers):                      # each layer: a diamond
+        top = hist2._next_id - 1
+        hist2.record(np.asarray([[top], [top]]))   # two children of top
+        a, b = hist2._next_id - 2, hist2._next_id - 1
+        hist2.record(np.asarray([[a, b]]))         # merge them
+    gene2 = hist2.get_genealogy(hist2._next_id - 1)
+    # 3 nodes per layer (merge + two arms), founder reached via layer 1
+    assert len(gene2) == 3 * n_layers
+    assert gene2[2] == (1,) and gene2[3] == (1,)
+
+
 def test_pair_parents_matches_varand_pairing():
     sel = jnp.asarray([4, 2, 7, 1])
     cx = jnp.asarray([True, False])

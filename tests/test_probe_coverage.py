@@ -1,0 +1,95 @@
+"""Static drift-check for the probe catalogue — the probes counterpart
+of test_span_coverage.py.
+
+Three ways the probe library rots silently, made loud:
+
+1. A ``*Probe`` class added to ``telemetry/probes.py`` without
+   ``@register_probe`` — invisible to tooling that iterates the
+   registry (the docs gate below, future report features).
+2. A registered probe without an honest ``metric_names`` declaration —
+   the report tool and the docs table key on it.
+3. A probe or metric missing from the catalogue table in
+   ``docs/advanced/telemetry.md`` — the documented probe set and the
+   shipped probe set must be the same set.
+"""
+
+import ast
+import os
+import re
+
+import deap_tpu.telemetry.probes as probes_mod
+from deap_tpu.telemetry.probes import PROBE_REGISTRY, Probe
+
+PROBES_PATH = os.path.abspath(probes_mod.__file__)
+DOC_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "docs", "advanced", "telemetry.md")
+
+
+def _probe_classes_in_source():
+    """Every class whose name ends in 'Probe' defined in probes.py
+    (AST — not the registry, which is exactly what might have rotted)."""
+    with open(PROBES_PATH) as fh:
+        tree = ast.parse(fh.read(), filename=PROBES_PATH)
+    return {node.name for node in ast.walk(tree)
+            if isinstance(node, ast.ClassDef)
+            and node.name.endswith("Probe")
+            and node.name != "Probe"}
+
+
+def test_every_probe_class_is_registered():
+    source_probes = _probe_classes_in_source()
+    assert source_probes, "AST scan found no probe classes — detection drifted"
+    missing = source_probes - set(PROBE_REGISTRY)
+    assert not missing, (
+        f"probe classes defined in probes.py but not @register_probe'd: "
+        f"{sorted(missing)} — the docs gate and registry tooling cannot "
+        "see them")
+
+
+def test_every_registered_probe_declares_metric_names():
+    assert len(PROBE_REGISTRY) >= 5
+    for name, cls in PROBE_REGISTRY.items():
+        assert issubclass(cls, Probe), name
+        names = getattr(cls, "metric_names", None)
+        assert isinstance(names, tuple) and names, (
+            f"{name}.metric_names must be a non-empty tuple — the "
+            "journal report and docs table key on it")
+        assert all(isinstance(n, str) and n for n in names), name
+        assert len(set(names)) == len(names), f"{name}: duplicate metrics"
+
+
+def test_probe_table_in_docs_covers_registry():
+    """Every registered probe appears as a `ClassName` row in the
+    telemetry doc's probe catalogue, listing every one of its
+    metric_names — doc drift is a test failure, not a stale table."""
+    with open(DOC_PATH) as fh:
+        doc = fh.read()
+    table_rows = {m.group(1): m.group(0) for m in re.finditer(
+        r"^\| `(\w+Probe)` \|.*$", doc, flags=re.M)}
+    for name, cls in PROBE_REGISTRY.items():
+        assert name in table_rows, (
+            f"{name} missing from the probe catalogue table in "
+            f"{DOC_PATH} (docs/advanced/telemetry.md)")
+        row = table_rows[name]
+        for metric in cls.metric_names:
+            assert f"`{metric}`" in row, (
+                f"{name}: metric `{metric}` missing from its probe "
+                f"catalogue row in docs/advanced/telemetry.md")
+    stale = set(table_rows) - set(PROBE_REGISTRY)
+    assert not stale, (
+        f"docs/advanced/telemetry.md documents unregistered probes: "
+        f"{sorted(stale)}")
+
+
+def test_alarm_kinds_documented():
+    """Every HealthMonitor alarm kind appears in the alarm-semantics
+    table of docs/advanced/telemetry.md."""
+    from deap_tpu.telemetry.probes import HealthMonitor
+
+    with open(DOC_PATH) as fh:
+        doc = fh.read()
+    for kind in HealthMonitor.ALARM_KINDS:
+        assert f"`{kind}`" in doc, (
+            f"alarm kind {kind!r} undocumented in "
+            "docs/advanced/telemetry.md")

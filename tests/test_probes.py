@@ -1,0 +1,626 @@
+"""Search-dynamics probes (telemetry.probes) — ISSUE 4's contract.
+
+Four layers:
+
+1. probe math against oracles (hv_proxy vs the native WFG
+   hypervolume, unique counts vs numpy, selection pressure on crafted
+   index vectors, stagnation bookkeeping over a synthetic scan);
+2. the pinned-parity guarantee: probes on/off leaves
+   populations/logbooks/hofs bit-identical across all four
+   algorithms.py loops, the island mesh path and the GP host loop;
+3. HealthMonitor tripwires on synthetic rows + journal wiring;
+4. the acceptance runs: an OneMax ea_simple journal and an 8-island +
+   genome-shard journal each carrying >= 6 distinct probe metrics per
+   generation plus a synthetic-triggered alarm, rendered end-to-end by
+   ``bench_report.py --health`` in a subprocess that never imports
+   jax.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deap_tpu import algorithms, ops
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import init_population
+from deap_tpu.core.toolbox import Toolbox
+from deap_tpu.telemetry import (
+    DiversityProbe,
+    FitnessProbe,
+    FrontProbe,
+    HealthMonitor,
+    Meter,
+    RunTelemetry,
+    SelectionProbe,
+    TreeDiversityProbe,
+    exact_hypervolume,
+    read_journal,
+)
+from deap_tpu.telemetry.probes import _unique_count
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def _onemax_toolbox():
+    tb = Toolbox()
+    tb.register("evaluate", lambda g: g.sum(-1).astype(jnp.float32))
+    tb.register("mate", ops.cx_two_point)
+    tb.register("mutate", ops.mut_flip_bit, indpb=0.05)
+    tb.register("select", ops.sel_tournament, tournsize=3)
+    return tb
+
+
+def _onemax_pop(key, n=64, length=32):
+    return init_population(key, n, ops.bernoulli_genome(length),
+                           FitnessSpec((1.0,)))
+
+
+def _probe_set(n):
+    return [DiversityProbe(sample=16), FitnessProbe(),
+            SelectionProbe(n=n)]
+
+
+def _mo_pop(w, key=None, spec_len=None):
+    w = jnp.asarray(w, jnp.float32)
+    m = spec_len or w.shape[1]
+    pop = init_population(key or jax.random.key(0), w.shape[0],
+                          ops.bernoulli_genome(8), FitnessSpec((1.0,) * m))
+    return pop.with_fitness(w)
+
+
+# ========================================================= probe math ====
+
+def test_unique_count_matches_numpy():
+    rng = np.random.RandomState(0)
+    base = rng.randint(-5, 5, size=(37, 9)).astype(np.int32)
+    rows = np.concatenate([base, base[:11]])  # guaranteed clones
+    got = int(_unique_count(jnp.asarray(rows)))
+    want = len(np.unique(rows, axis=0))
+    assert got == want
+    assert int(_unique_count(jnp.asarray(rows[:1]))) == 1
+
+
+def test_diversity_probe_clones_and_distances():
+    # 4 copies each of two antipodal bitstrings: 2 distinct of 8 rows,
+    # every cross-pair distance = sqrt(L), every same-pair = 0
+    L = 16
+    a = np.zeros(L, bool)
+    b = np.ones(L, bool)
+    g = jnp.asarray(np.stack([a, b] * 4))
+    pop = init_population(jax.random.key(0), 8, ops.bernoulli_genome(L),
+                          FitnessSpec((1.0,)))
+    pop = pop.replace(genomes=g).with_fitness(jnp.zeros(8))
+    m = Meter()
+    p = DiversityProbe(sample=8)
+    p.declare(m)
+    s = p(m, m.init(), pop=pop)
+    assert float(s["div_unique_frac"]) == 0.25
+    assert float(s["div_pdist_min"]) == 0.0  # clones exist
+    # ordered cross pairs: 32 of 56 at distance sqrt(16)=4
+    np.testing.assert_allclose(float(s["div_pdist_mean"]),
+                               4.0 * 32 / 56, rtol=1e-6)
+    # msd identity: mean over ordered pairs of squared distance
+    np.testing.assert_allclose(float(s["div_msd"]), 16.0 * 32 / 56,
+                               rtol=1e-6)
+
+
+def test_tree_diversity_probe_entropy_and_clones():
+    gp = pytest.importorskip("deap_tpu.gp")
+    ps = gp.math_set(n_args=1)
+    n_ops = ps.n_ops
+    L = 8
+    # genome 0: single terminal (no ops); genome 1..3: op 0 at root —
+    # clones of each other; genome 4: op 1 at root
+    term = n_ops  # ARG0
+    rows = np.full((5, L), term, np.int32)
+    lengths = np.array([1, 3, 3, 3, 3], np.int32)
+    for i in (1, 2, 3):
+        rows[i, 0] = 0
+    rows[4, 0] = 1
+    genomes = {"nodes": jnp.asarray(rows),
+               "consts": jnp.zeros((5, L), jnp.float32),
+               "length": jnp.asarray(lengths)}
+    pop = init_population(jax.random.key(0), 5, ops.bernoulli_genome(4),
+                          FitnessSpec((1.0,)))
+    pop = pop.replace(genomes=genomes).with_fitness(jnp.zeros(5))
+    m = Meter()
+    p = TreeDiversityProbe(ps)
+    p.declare(m)
+    s = p(m, m.init(), pop=pop)
+    # opcode histogram: op0 x3, op1 x1 -> H = -(3/4 ln 3/4 + 1/4 ln 1/4)
+    want_h = -(0.75 * np.log(0.75) + 0.25 * np.log(0.25))
+    np.testing.assert_allclose(float(s["gp_opcode_entropy"]), want_h,
+                               rtol=1e-5)
+    assert float(s["gp_clone_rate"]) == pytest.approx(1 - 3 / 5)
+    assert float(s["gp_mean_size"]) == pytest.approx(np.mean(lengths))
+    # the host-dispatch loop hands over the interpreter's exact count
+    s2 = p(m, m.init(), pop=pop, host_clone_rate=0.125)
+    assert float(s2["gp_clone_rate"]) == 0.125
+
+
+def test_fitness_probe_velocity_and_stagnation():
+    m = Meter()
+    p = FitnessProbe()
+    p.declare(m)
+    s = m.init()
+    bests = [1.0, 3.0, 3.0, 3.0, 5.0]
+    ages, vels = [], []
+    for b in bests:
+        pop = _mo_pop(np.full((8, 1), b, np.float32))
+        s = p(m, s, pop=pop)
+        ages.append(int(s["stagnation_age"]))
+        vels.append(float(s["fit_velocity"]))
+    assert ages == [0, 0, 1, 2, 0]
+    assert vels == [0.0, 2.0, 0.0, 0.0, 2.0]
+    assert float(s["fit_gap"]) == 0.0  # best == median on a flat pop
+
+
+def test_selection_probe_pressure_math():
+    m = Meter()
+    p = SelectionProbe(n=8)
+    p.declare(m)
+    s = m.init()
+    # all 8 selections hit row 0: eff parents 1, 7/8 never selected
+    s = p(m, s, sel_idx=jnp.zeros(8, jnp.int32), sel_pool=8,
+          parent_idx=jnp.zeros(8, jnp.int32))
+    assert float(s["sel_eff_parents"]) == pytest.approx(1.0)
+    assert float(s["sel_loss_diversity"]) == pytest.approx(7 / 8)
+    assert float(s["lineage_depth_mean"]) == 1.0
+    # uniform selection: eff parents n, loss 0
+    s = p(m, s, sel_idx=jnp.arange(8), sel_pool=8,
+          parent_idx=jnp.arange(8))
+    assert float(s["sel_eff_parents"]) == pytest.approx(8.0)
+    assert float(s["sel_loss_diversity"]) == 0.0
+    assert int(s["lineage_depth_max"]) == 2
+
+
+def test_selection_probe_every_decimation():
+    """every=k updates the pressure gauges on k-th generations only
+    (holding in between) while lineage advances every generation."""
+    m = Meter()
+    p = SelectionProbe(n=4, every=2)
+    p.declare(m)
+    s = m.init()
+    uni, conc = jnp.arange(4), jnp.zeros(4, jnp.int32)
+    s = p(m, s, sel_idx=uni, sel_pool=4, parent_idx=uni,
+          gen=jnp.int32(0))                       # gen 0: updates
+    assert float(s["sel_eff_parents"]) == pytest.approx(4.0)
+    s = p(m, s, sel_idx=conc, sel_pool=4, parent_idx=conc,
+          gen=jnp.int32(1))                       # gen 1: held
+    assert float(s["sel_eff_parents"]) == pytest.approx(4.0)
+    assert int(s["lineage_depth_max"]) == 2       # lineage not held
+    s = p(m, s, sel_idx=conc, sel_pool=4, parent_idx=conc,
+          gen=jnp.int32(2))                       # gen 2: updates
+    assert float(s["sel_eff_parents"]) == pytest.approx(1.0)
+
+
+@pytest.mark.parametrize("m_obj", [1, 2, 3])
+def test_front_probe_hv_matches_native_oracle(m_obj):
+    """hv_proxy is the EXACT hypervolume of the sampled points — pin it
+    against the native WFG implementation, including duplicates and
+    dominated points."""
+    rng = np.random.RandomState(7 + m_obj)
+    w = rng.rand(60, m_obj).astype(np.float32)
+    w[10] = w[3]          # duplicate
+    w[11] = w[4] * 0.5    # dominated
+    pop = _mo_pop(w)
+    m = Meter()
+    p = FrontProbe(ref=(0.0,) * m_obj, max_points=64)
+    p.declare(m)
+    s = jax.jit(lambda pp: p(m, m.init(), pop=pp))(pop)
+    np.testing.assert_allclose(
+        float(s["hv_proxy"]),
+        exact_hypervolume(w, (0.0,) * m_obj), rtol=1e-5)
+    assert 0.0 < float(s["front_frac"]) <= 1.0
+    assert float(s["front_spread"]) >= 0.0
+
+
+def test_front_probe_rejects_high_m_and_ref_mismatch():
+    pop = _mo_pop(np.random.RandomState(0).rand(10, 4).astype(np.float32))
+    m = Meter()
+    p = FrontProbe(ref=(0.0,) * 4)
+    p.declare(m)
+    with pytest.raises(ValueError, match="M <= 3"):
+        p(m, m.init(), pop=pop)
+    p2 = FrontProbe(ref=(0.0, 0.0))
+    p2.declare(m)
+    with pytest.raises(ValueError, match="objectives"):
+        p2(m, m.init(), pop=pop)
+
+
+def test_front_probe_exact_every_journals_host_hv(tmp_path):
+    """exact_every=k ships the sample to the host every k gens and the
+    native exact hypervolume lands as hv_exact events agreeing with the
+    in-scan proxy."""
+    w = np.random.RandomState(3).rand(32, 2).astype(np.float32)
+    pop = _mo_pop(w)
+    path = str(tmp_path / "hv.jsonl")
+    with RunTelemetry(path) as tel:
+        tel.journal.header(init_backend=False)
+        p = FrontProbe(ref=(0.0, 0.0), max_points=32, exact_every=2)
+        p.declare(tel.meter)
+        s = tel.meter.init()
+        for gen in range(4):
+            s = p(tel.meter, s, pop=pop, gen=jnp.int32(gen),
+                  journal=tel.journal)
+        jax.effects_barrier()
+        proxy = float(s["hv_proxy"])
+    hv = [e for e in read_journal(path) if e["kind"] == "hv_exact"]
+    assert [e["gen"] for e in hv] == [0, 2]
+    for e in hv:
+        assert e["value"] == pytest.approx(proxy, rel=1e-5)
+
+
+def test_meter_internal_gauges_stay_out_of_rows():
+    m = Meter()
+    m.gauge("visible")
+    m.gauge("carry", internal=True)
+    m.gauge("depths", shape=(4,), dtype=jnp.int32, internal=True)
+    s = m.init()
+    row = m.row(s)
+    assert "visible" in row
+    assert "carry" not in row and "depths" not in row
+    assert "carry" in s  # still real carry state
+
+
+# ================================================== pinned parity ====
+
+def test_probes_pinned_identical_across_loops(tmp_path):
+    """The PR 2 meter guarantee extended to probes: probe-on runs leave
+    populations/logbooks/hofs bit-identical across all four loops."""
+    tb = _onemax_toolbox()
+    pop0 = _onemax_pop(jax.random.key(1))
+    runs = {
+        "ea_simple": lambda tel, pr: algorithms.ea_simple(
+            jax.random.key(2), pop0, tb, 0.5, 0.2, 6, halloffame_size=3,
+            telemetry=tel, probes=pr),
+        "ea_mu_plus_lambda": lambda tel, pr: algorithms.ea_mu_plus_lambda(
+            jax.random.key(3), pop0, tb, mu=64, lambda_=64, cxpb=0.5,
+            mutpb=0.2, ngen=6, telemetry=tel, probes=pr),
+        "ea_mu_comma_lambda": lambda tel, pr: algorithms.ea_mu_comma_lambda(
+            jax.random.key(4), pop0, tb, mu=64, lambda_=96, cxpb=0.5,
+            mutpb=0.2, ngen=6, telemetry=tel, probes=pr),
+    }
+    for name, run in runs.items():
+        base_pop, base_lb, base_hof = run(None, ())
+        with RunTelemetry(str(tmp_path / f"{name}.jsonl")) as tel:
+            tel_pop, tel_lb, tel_hof = run(tel, _probe_set(64))
+        np.testing.assert_array_equal(
+            np.asarray(base_pop.genomes), np.asarray(tel_pop.genomes),
+            err_msg=f"{name}: genomes drifted under probes")
+        np.testing.assert_array_equal(
+            np.asarray(base_pop.fitness), np.asarray(tel_pop.fitness),
+            err_msg=f"{name}: fitness drifted under probes")
+        assert base_lb.select("nevals") == tel_lb.select("nevals"), name
+        if base_hof is not None:
+            np.testing.assert_array_equal(
+                np.asarray(base_hof.fitness), np.asarray(tel_hof.fitness),
+                err_msg=f"{name}: hall of fame drifted under probes")
+        meters = [e for e in read_journal(str(tmp_path / f"{name}.jsonl"))
+                  if e["kind"] == "meter"]
+        probe_keys = [k for k in meters[-1]
+                      if k.startswith(("div_", "fit_", "sel_",
+                                       "stagnation"))]
+        assert len(probe_keys) >= 6, (name, sorted(meters[-1]))
+
+
+def test_probes_pinned_identical_generate_update(tmp_path):
+    """ea_generate_update: probes compose with strategy_probe and the
+    strategy state stays bit-identical."""
+    from deap_tpu.strategies import cma
+    from deap_tpu.telemetry import strategy_probe
+
+    dim = 4
+    strat = cma.Strategy(centroid=[0.5] * dim, sigma=0.3, lambda_=8)
+    tb = Toolbox()
+    tb.register("evaluate", lambda x: jnp.sum(x ** 2, axis=-1))
+    tb.register("generate", strat.generate)
+    tb.register("update", strat.update)
+
+    base_state, _, _ = algorithms.ea_generate_update(
+        jax.random.key(3), strat.initial_state(), tb, ngen=5,
+        spec=strat.spec)
+    path = str(tmp_path / "cma.jsonl")
+    with RunTelemetry(path, probe=strategy_probe(strat)) as tel:
+        tel_state, _, _ = algorithms.ea_generate_update(
+            jax.random.key(3), strat.initial_state(), tb, ngen=5,
+            spec=strat.spec, telemetry=tel,
+            probes=[DiversityProbe(sample=8), FitnessProbe()])
+    np.testing.assert_array_equal(np.asarray(base_state.centroid),
+                                  np.asarray(tel_state.centroid))
+    np.testing.assert_array_equal(np.asarray(base_state.C),
+                                  np.asarray(tel_state.C))
+    meters = [e for e in read_journal(path) if e["kind"] == "meter"]
+    assert len(meters) == 5
+    for m in meters:
+        assert m["sigma"] > 0          # strategy_probe still works
+        assert "div_msd" in m and "stagnation_age" in m
+
+
+def test_probes_require_telemetry():
+    tb = _onemax_toolbox()
+    pop0 = _onemax_pop(jax.random.key(1), n=8, length=8)
+    with pytest.raises(ValueError, match="telemetry"):
+        algorithms.ea_simple(jax.random.key(2), pop0, tb, 0.5, 0.2, 2,
+                             probes=[FitnessProbe()])
+
+
+def test_probes_pinned_identical_island_mesh(tmp_path):
+    """The shard_map'd island path: probes + in-shard meter reductions
+    leave the stacked populations bit-identical."""
+    from deap_tpu.algorithms import evaluate_invalid
+    from deap_tpu.parallel import island_init, make_island_step
+    from deap_tpu.parallel.mesh import population_mesh, shard_population
+
+    tb = _onemax_toolbox()
+    mesh = population_mesh(8, ("island",))
+
+    def mkpops():
+        pops = island_init(jax.random.key(0), 8, 16,
+                           ops.bernoulli_genome(24), FitnessSpec((1.0,)))
+        pops = jax.vmap(lambda p: evaluate_invalid(p, tb.evaluate))(pops)
+        return shard_population(pops, mesh, "island")
+
+    pops_a = mkpops()
+    step_a = make_island_step(tb, cxpb=0.5, mutpb=0.2, freq=2, mig_k=2,
+                              mesh=mesh)
+    for e in range(3):
+        pops_a = step_a(jax.random.fold_in(jax.random.key(9), e), pops_a)
+
+    pops_b = mkpops()
+    path = str(tmp_path / "island.jsonl")
+    with RunTelemetry(path) as tel:
+        tel.journal.header(toolbox=tb)
+        step_b = make_island_step(
+            tb, cxpb=0.5, mutpb=0.2, freq=2, mig_k=2, mesh=mesh,
+            telemetry=tel, probes=[DiversityProbe(sample=16),
+                                   FitnessProbe()])
+        mstate = tel.meter.init()
+        for e in range(3):
+            pops_b, mstate = step_b(
+                jax.random.fold_in(jax.random.key(9), e), pops_b, mstate)
+            tel.record_row(mstate, e)
+    np.testing.assert_array_equal(np.asarray(pops_a.genomes),
+                                  np.asarray(pops_b.genomes))
+    np.testing.assert_array_equal(np.asarray(pops_a.fitness),
+                                  np.asarray(pops_b.fitness))
+    np.testing.assert_array_equal(np.asarray(pops_a.valid),
+                                  np.asarray(pops_b.valid))
+
+
+def test_probes_pinned_identical_gp_loop():
+    """GP host-dispatch loop: probes leave the evolved population and
+    best fitness bit-identical."""
+    gp = pytest.importorskip("deap_tpu.gp")
+    from deap_tpu.gp.loop import make_symbreg_loop
+
+    POP, ml = 64, 24
+    ps = gp.math_set(n_args=1)
+    X = jnp.linspace(-1.0, 1.0, 16, endpoint=False)[:, None]
+    y = X[:, 0] ** 2 + X[:, 0]
+    gen = gp.gen_half_and_half(ps, ml, 1, 2)
+    genomes = jax.vmap(gen)(jax.random.split(jax.random.key(3), POP))
+
+    ra = make_symbreg_loop(ps, ml, X, y, height_limit=6)(
+        jax.random.key(0), genomes, 4)
+    import tempfile
+    with RunTelemetry(tempfile.mktemp(suffix=".jsonl")) as tel:
+        rb = make_symbreg_loop(
+            ps, ml, X, y, height_limit=6, telemetry=tel,
+            probes=[TreeDiversityProbe(ps), FitnessProbe(),
+                    SelectionProbe(n=POP)])(jax.random.key(0), genomes, 4)
+    np.testing.assert_array_equal(np.asarray(ra["genomes"]["nodes"]),
+                                  np.asarray(rb["genomes"]["nodes"]))
+    np.testing.assert_array_equal(np.asarray(ra["fitness"]),
+                                  np.asarray(rb["fitness"]))
+    assert ra["best_fitness"] == rb["best_fitness"]
+    assert ra["nevals"] == rb["nevals"]
+
+
+# ==================================================== health monitor ====
+
+def test_health_monitor_each_tripwire_and_rearm():
+    hm = HealthMonitor(clone_rate_max=0.5, diversity_floor=0.1,
+                       stagnation_window=2)
+    assert hm.check_row({"best": 1.0, "div_msd": 5.0}, gen=0) == []
+    # clone spike via the div_unique_frac fallback
+    a = hm.check_row({"best": 2.0, "div_unique_frac": 0.3}, gen=1)
+    assert [x["alarm"] for x in a] == ["clone_spike"]
+    # premature convergence fires once, re-arms on recovery
+    a = hm.check_row({"best": 3.0, "div_msd": 0.01}, gen=2)
+    assert [x["alarm"] for x in a] == ["premature_convergence"]
+    assert hm.check_row({"best": 4.0, "div_msd": 0.01}, gen=3) == []
+    hm.check_row({"best": 5.0, "div_msd": 5.0}, gen=4)   # recovery
+    a = hm.check_row({"best": 6.0, "div_msd": 0.01}, gen=5)
+    assert [x["alarm"] for x in a] == ["premature_convergence"]
+    # zero-improvement: monitor tracks best itself (no stagnation_age)
+    hm2 = HealthMonitor(stagnation_window=2)
+    for g, b in enumerate([1.0, 1.0, 1.0]):
+        fired = hm2.check_row({"best": b}, gen=g)
+    assert [x["alarm"] for x in fired] == ["zero_improvement"]
+    # fires once; improvement re-arms
+    assert hm2.check_row({"best": 1.0}, gen=3) == []
+    hm2.check_row({"best": 9.0}, gen=4)
+    for g, b in enumerate([9.0, 9.0], start=5):
+        fired = hm2.check_row({"best": b}, gen=g)
+    assert [x["alarm"] for x in fired] == ["zero_improvement"]
+    # stagnation_age from a FitnessProbe takes precedence
+    hm3 = HealthMonitor(stagnation_window=3)
+    assert hm3.check_row({"best": 1.0, "stagnation_age": 3}, gen=0)
+
+
+def test_health_monitor_non_finite_and_early_stop():
+    hm = HealthMonitor(early_stop=("non_finite",), improvement_eps=0.0)
+    a = hm.check_row({"best": float("nan"), "mean": 1.0}, gen=7)
+    assert a[0]["alarm"] == "non_finite" and a[0]["metrics"] == ["best"]
+    assert hm.stop_requested
+    calls = []
+    hm2 = HealthMonitor(on_alarm=calls.append)
+    hm2.check_row({"mean": float("inf")}, gen=1)
+    assert calls and calls[0]["alarm"] == "non_finite"
+    assert not hm2.stop_requested  # early_stop not armed
+
+
+def test_health_monitor_premature_min_gen_gate():
+    hm = HealthMonitor(diversity_floor=0.1, premature_min_gen=10)
+    assert hm.check_row({"div_msd": 0.01}, gen=3)   # early: fires
+    hm2 = HealthMonitor(diversity_floor=0.1, premature_min_gen=10)
+    assert hm2.check_row({"div_msd": 0.01}, gen=50) == []  # late: ok
+
+
+# ================================================== journal hardening ====
+
+def test_read_journal_torn_tail(tmp_path):
+    """A killed writer leaves a torn final line: default read returns
+    the complete rows and reports the tear's byte offset; strict
+    raises."""
+    path = str(tmp_path / "torn.jsonl")
+    good = b'{"kind": "header"}\n{"kind": "meter", "gen": 1}\n'
+    with open(path, "wb") as fh:
+        fh.write(good)
+        fh.write(b'{"kind": "meter", "gen": 2, "best": 12.')  # killed here
+    rows = read_journal(path)
+    assert [e["kind"] for e in rows] == ["header", "meter"]
+    assert rows.tear_offset == len(good)
+    assert rows.skipped_offsets == []
+    with pytest.raises(ValueError, match=f"byte {len(good)}"):
+        read_journal(path, strict=True)
+
+
+def test_read_journal_interior_garbage_offsets(tmp_path):
+    path = str(tmp_path / "mid.jsonl")
+    l1 = b'{"kind": "header"}\n'
+    l2 = b'{"kind": "meter", "gen": 1,\n'  # crashed mid-write, newline
+    with open(path, "wb") as fh:
+        fh.write(l1 + l2 + b'{"kind": "summary"}\n')
+    rows = read_journal(path)
+    assert [e["kind"] for e in rows] == ["header", "summary"]
+    assert rows.tear_offset is None
+    assert rows.skipped_offsets == [len(l1)]
+    with pytest.raises(ValueError):
+        read_journal(path, strict=True)
+
+
+def test_read_journal_clean_file_has_no_tear(tmp_path):
+    path = str(tmp_path / "ok.jsonl")
+    with open(path, "w") as fh:
+        fh.write('{"kind": "header"}\n{"kind": "summary"}\n')
+    rows = read_journal(path, strict=True)
+    assert len(rows) == 2 and rows.tear_offset is None
+
+
+# ======================================================== acceptance ====
+
+def _render_health_no_jax(journal_path):
+    """bench_report.py --health in a clean subprocess; assert jax never
+    gets imported and return the rendered report."""
+    code = (
+        "import sys, runpy\n"
+        f"sys.argv = ['bench_report.py', '--health', {journal_path!r}]\n"
+        f"runpy.run_path({os.path.join(REPO, 'bench_report.py')!r}, "
+        "run_name='__main__')\n"
+        "assert 'jax' not in sys.modules, 'health report imported jax'\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    return r.stdout
+
+
+def test_acceptance_ea_simple_probed_journal(tmp_path):
+    """OneMax ea_simple: >= 6 distinct probe metrics per generation,
+    a synthetic-triggered alarm, and the --health report renders it
+    without jax."""
+    tb = _onemax_toolbox()
+    path = str(tmp_path / "run.jsonl")
+    # synthetic trigger: a diversity floor no bitstring population can
+    # satisfy, so premature_convergence must fire
+    hm = HealthMonitor(diversity_floor=1e9, stagnation_window=1)
+    with RunTelemetry(path, health=hm) as tel:
+        algorithms.ea_simple(
+            jax.random.key(2), _onemax_pop(jax.random.key(1)), tb,
+            0.5, 0.2, 8, telemetry=tel, probes=_probe_set(64))
+    events = read_journal(path)
+    meters = [e for e in events if e["kind"] == "meter"]
+    assert len(meters) == 9  # gen 0..8
+    probe_names = {"div_msd", "div_pdist_mean", "div_pdist_std",
+                   "div_pdist_min", "div_unique_frac", "fit_gap",
+                   "fit_velocity", "stagnation_age", "sel_eff_parents",
+                   "sel_loss_diversity", "lineage_depth_mean",
+                   "lineage_depth_max"}
+    for m in meters:
+        assert len(probe_names & set(m)) >= 6, sorted(m)
+    alarms = [e for e in events if e["kind"] == "alarm"]
+    assert alarms, "synthetic threshold must trigger >= 1 alarm"
+    assert any(a["alarm"] == "premature_convergence" for a in alarms)
+
+    report = _render_health_no_jax(path)
+    assert "div_msd" in report and "Alarms" in report
+    assert "premature_convergence" in report
+
+
+@pytest.mark.slow
+def test_acceptance_island_genome_shard_probed_journal(tmp_path):
+    """8-island + genome-shard acceptance run: per-epoch meter rows
+    with >= 6 probe metrics, in-shard reduction spans, a synthetic
+    alarm, and a no-jax --health render."""
+    from deap_tpu.algorithms import evaluate_invalid
+    from deap_tpu.parallel import island_init, make_island_step
+    from deap_tpu.parallel.genome_shard import (genome_mesh,
+                                                make_sharded_evaluator,
+                                                shard_genomes)
+    from deap_tpu.parallel.mesh import population_mesh, shard_population
+
+    tb = _onemax_toolbox()
+    path = str(tmp_path / "island.jsonl")
+    hm = HealthMonitor(diversity_floor=1e9)
+    with RunTelemetry(path, health=hm) as tel:
+        tel.journal.header(toolbox=tb)
+        mesh = population_mesh(8, ("island",))
+        pops = island_init(jax.random.key(0), 8, 16,
+                           ops.bernoulli_genome(24), FitnessSpec((1.0,)))
+        pops = jax.vmap(lambda p: evaluate_invalid(p, tb.evaluate))(pops)
+        pops = shard_population(pops, mesh, "island")
+        step = make_island_step(
+            tb, cxpb=0.5, mutpb=0.2, freq=2, mig_k=2, mesh=mesh,
+            telemetry=tel,
+            probes=[DiversityProbe(sample=16), FitnessProbe()])
+        mstate = tel.meter.init()
+        for epoch in range(3):
+            pops, mstate = step(
+                jax.random.fold_in(jax.random.key(9), epoch), pops,
+                mstate)
+            tel.record_row(mstate, epoch)
+        gmesh = genome_mesh(n_pop_shards=1, n_genome_shards=8)
+        g = jax.random.bernoulli(jax.random.key(5), 0.5, (16, 64))
+        ev = make_sharded_evaluator(
+            lambda s: s.sum(-1).astype(jnp.float32), gmesh,
+            combine="sum")
+        ev(shard_genomes(g, gmesh))
+
+    events = read_journal(path)
+    meters = [e for e in events if e["kind"] == "meter"]
+    assert len(meters) == 3
+    probe_names = {"div_msd", "div_pdist_mean", "div_pdist_std",
+                   "div_pdist_min", "div_unique_frac", "fit_gap",
+                   "fit_velocity", "stagnation_age"}
+    for m in meters:
+        assert len(probe_names & set(m)) >= 6, sorted(m)
+        assert m["best"] > 0 and m["epochs"] >= 1
+    alarms = [e for e in events if e["kind"] == "alarm"]
+    assert any(a["alarm"] == "premature_convergence" for a in alarms)
+    spans = {e["name"] for e in events if e["kind"] == "span"}
+    # the meter reductions ride the sharded epoch under named spans
+    assert {"island/pmax", "island/psum",
+            "genome_shard/psum"} <= spans, spans
+
+    report = _render_health_no_jax(path)
+    assert "premature_convergence" in report
+    assert "island/pmax" in report
