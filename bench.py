@@ -510,6 +510,118 @@ def probe_overhead_lines(out_path: str = "BENCH_PROBES.json") -> list:
     return rows
 
 
+# ---------------------------------- resilience overhead (pop=100k) ----
+
+#: headline config length for the paired segmented-vs-monolithic rows
+#: (matches PROBE_NGEN so the per-run constants sit in real proportion)
+RES_NGEN = 100
+#: generations per segment — at pop=100k/CPU a checkpoint lands every
+#: ~8 s of compute, the right granularity/overhead trade for this scale
+RES_SEGMENT = 50
+RES_REPS = 3
+
+
+def resilience_overhead_lines(out_path: str = "BENCH_RESILIENCE.json",
+                              ) -> list:
+    """The resilience acceptance measurement: the headline OneMax
+    config (pop=100k) run as ONE monolithic scan vs the SAME scan step
+    driven in ``RES_SEGMENT``-generation segments by ``ResilientRun``
+    with a crash-consistent checkpoint (fsync + CRC) at every segment
+    boundary — back-to-back interleaved in one session, min-of-reps
+    (the probe-bench protocol: contention noise is one-sided). Both
+    sides reuse one prebuilt step closure so the paired rows compare
+    steady-state cost, not per-call retrace constants.
+    ``bench_report.py --tripwire`` gates the committed overhead ≤3%."""
+    import shutil
+    import tempfile
+
+    from jax import lax as _lax
+
+    from deap_tpu.algorithms import _pop_loop_init, make_ea_simple_step
+    from deap_tpu.resilience import ResilientRun
+    from deap_tpu.resilience.engine import _ScanLoopSpec
+
+    jax.config.update("jax_platforms", "cpu")
+    tb, pop0 = _setup()
+    key = jax.random.key(90)
+    step = make_ea_simple_step(tb, 0.5, 0.2)
+    pop, hof, record0 = _pop_loop_init(pop0, tb, 0, None)
+    carry0 = (pop, hof)
+
+    def run_off():
+        carry, _ = _lax.scan(step, carry0,
+                             jax.random.split(key, RES_NGEN))
+        sync(carry[0].fitness)
+
+    ckdir = tempfile.mkdtemp(prefix="bench_resilience_")
+    # ONE spec across reps: its cached jitted segment scan compiles
+    # once (a real run compiles once too — a fresh spec per rep would
+    # time 25-gen-scan recompiles, not the segmentation)
+    spec = _ScanLoopSpec(
+        "ea_simple", step, key, carry0, RES_NGEN, None, None,
+        record0=record0,
+        build_result=lambda st, recs: st["carry"][0])
+
+    def run_on():
+        res = ResilientRun(os.path.join(ckdir, "ck"),
+                           segment_len=RES_SEGMENT, keep=2)
+        res.ckpt.clear()  # each rep is a fresh run, not a resume
+        out = res._drive(spec, RES_NGEN)
+        sync(out.fitness)
+
+    try:
+        run_off()  # compile + warm (one executable serves both sides)
+        run_on()
+        t_off, t_on = [], []
+        for _ in range(RES_REPS):
+            t0 = time.perf_counter()
+            run_off()
+            t_off.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            run_on()
+            t_on.append(time.perf_counter() - t0)
+        t_off, t_on = sorted(t_off), sorted(t_on)
+    finally:
+        shutil.rmtree(ckdir, ignore_errors=True)
+    env = _env_fingerprint("cpu")
+    n_ckpts = (RES_NGEN + RES_SEGMENT - 1) // RES_SEGMENT
+    rows = []
+    for name, times in (("monolithic", t_off), ("segmented", t_on)):
+        med = times[len(times) // 2]
+        row = {
+            "metric": f"onemax_pop100k_resilience_{name}"
+                      "_generations_per_sec",
+            "value": round(RES_NGEN / med, 3), "unit": "gens/sec",
+            "backend": "cpu", "pop": POP, "ngen": RES_NGEN,
+            "n_samples": len(times),
+            "best": round(RES_NGEN / times[0], 3),
+            "spread_pct": round(100 * (times[-1] - times[0]) / med, 1),
+            "env": env,
+        }
+        if name == "segmented":
+            row["segment_len"] = RES_SEGMENT
+            row["n_checkpoints"] = n_ckpts
+        rows.append(row)
+    rows.append({
+        "metric": "onemax_pop100k_resilience_overhead_pct",
+        "value": round(100 * (t_on[0] - t_off[0]) / t_off[0], 2),
+        "unit": "pct", "threshold_pct": 3.0,
+        "estimator": "min_of_reps", "segment_len": RES_SEGMENT,
+        "n_checkpoints": n_ckpts, "env": env,
+    })
+    if out_path:
+        payload = {
+            "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "env": env,
+            "config": {"pop": POP, "length": LENGTH, "ngen": RES_NGEN,
+                       "segment_len": RES_SEGMENT, "reps": RES_REPS},
+            "tail": "\n".join(json.dumps(r) for r in rows),
+        }
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=1)
+    return rows
+
+
 def _journal_probe_run(tel, tb, pop):
     """--journal satellite: a short probed headline-config run so the
     journal carries per-generation probe rows (search-dynamics
@@ -949,6 +1061,17 @@ if __name__ == "__main__":
         nxt = sys.argv[i + 1] if i + 1 < len(sys.argv) else None
         out = nxt if nxt and not nxt.startswith("--") else "BENCH_PROBES.json"
         for row in probe_overhead_lines(out):
+            print(json.dumps(row), flush=True)
+    elif "--resilience" in sys.argv:
+        # the resilience acceptance measurement: monolithic scan vs
+        # ResilientRun-segmented run with per-segment crash-consistent
+        # checkpoints, same session (committed as BENCH_RESILIENCE.json;
+        # bench_report.py --tripwire gates overhead <= 3%)
+        i = sys.argv.index("--resilience")
+        nxt = sys.argv[i + 1] if i + 1 < len(sys.argv) else None
+        out = (nxt if nxt and not nxt.startswith("--")
+               else "BENCH_RESILIENCE.json")
+        for row in resilience_overhead_lines(out):
             print(json.dumps(row), flush=True)
     elif "--nd3" in sys.argv:
         # the M>=3 nd-sort acceptance measurement: per-impl nd_rank

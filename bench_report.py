@@ -201,6 +201,56 @@ def probe_tripwire(threshold: float = PROBE_OVERHEAD_THRESHOLD) -> int:
     return 0 if ok else 1
 
 
+#: fractional segmented-run overhead beyond which the resilience pair trips
+RESILIENCE_OVERHEAD_THRESHOLD = 0.03
+
+
+def resilience_tripwire(
+        threshold: float = RESILIENCE_OVERHEAD_THRESHOLD) -> int:
+    """The segmented-run overhead gate. BENCH_RESILIENCE.json carries a
+    monolithic-scan and a ResilientRun-segmented headline-config row
+    (pop=100k, per-segment fsync'd CRC checkpoints) measured
+    back-to-back in the SAME session (bench.py --resilience): trips
+    when the segmented run falls more than ``threshold`` below its
+    monolithic pair. Returns the number of tripped rows."""
+    files = sorted(glob.glob(os.path.join(HERE,
+                                          "BENCH_RESILIENCE*.json")))
+    if not files:
+        print("resilience tripwire: no committed BENCH_RESILIENCE*.json "
+              "yet")
+        return 0
+    rows = _bench_rows(files[-1])
+    mono = rows.get(
+        "onemax_pop100k_resilience_monolithic_generations_per_sec")
+    seg = rows.get(
+        "onemax_pop100k_resilience_segmented_generations_per_sec")
+    ov = rows.get("onemax_pop100k_resilience_overhead_pct")
+    print(f"\n## Resilience overhead ({os.path.basename(files[-1])})\n")
+    if ov is not None and isinstance(ov.get("value"), (int, float)):
+        overhead = ov["value"] / 100.0
+    elif (mono and seg and isinstance(mono.get("value"), (int, float))
+            and isinstance(seg.get("value"), (int, float))):
+        overhead = 1.0 - seg["value"] / mono["value"]
+    else:
+        print("- paired resilience rows missing from latest "
+              "BENCH_RESILIENCE file")
+        return 0
+    ok = overhead <= threshold
+    pair = ""
+    if mono and seg:
+        pair = (f"segmented {seg['value']} vs monolithic "
+                f"{mono['value']} gens/s (segment_len="
+                f"{seg.get('segment_len', '?')}, "
+                f"{seg.get('n_checkpoints', '?')} checkpoints), ")
+    print(f"- {pair}same session: {100 * overhead:+.2f}% overhead "
+          + ("ok" if ok else f"**REGRESSION** (> {threshold:.0%} — "
+             "segmented execution got expensive)"))
+    if len(files) >= 2:
+        return (0 if ok else 1) + _diff_rows(files[-2], files[-1],
+                                             TRIPWIRE_THRESHOLD)
+    return 0 if ok else 1
+
+
 def tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
     """Diff the two most recent committed ``BENCH_r*.json`` files and
     flag regressions; then the gp_symbreg paired rows
@@ -219,6 +269,7 @@ def tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
         tripped += _diff_rows(files[-2], files[-1], threshold)
     tripped += gp_tripwire(threshold)
     tripped += probe_tripwire()
+    tripped += resilience_tripwire()
     return tripped
 
 

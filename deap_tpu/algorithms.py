@@ -195,35 +195,36 @@ def _check_probes(probes, telemetry):
             "rides the telemetry Meter carry")
 
 
-def ea_simple(key: jax.Array, pop: Population, toolbox, cxpb: float,
-              mutpb: float, ngen: int, stats: Optional[Statistics] = None,
-              halloffame_size: int = 0, verbose: bool = False,
-              telemetry=None, probes=(),
-              ) -> Tuple[Population, Logbook, Optional[HallOfFame]]:
-    """The canonical generational GA (algorithms.py:85-189).
-
-    select n → varAnd → evaluate invalid → replace, scanned over ``ngen``
-    generations as one compiled program. ``telemetry`` (a
-    :class:`deap_tpu.telemetry.RunTelemetry`) threads a Meter through
-    the scan and journals the run; ``probes`` adds in-scan population
-    probes (:mod:`deap_tpu.telemetry.probes`) to that meter. Results
-    are unchanged either way.
-    """
-    tel = telemetry
-    _check_probes(probes, tel)
-    kscan = key
+def _pop_loop_init(pop: Population, toolbox, halloffame_size: int,
+                   stats: Optional[Statistics]):
+    """The shared gen-0 protocol of the three population loops:
+    evaluate the invalid founders, seed the hall of fame, build the
+    gen-0 logbook record. Returns ``(pop, hof, record0)`` — also the
+    entry point the segmented :mod:`deap_tpu.resilience` driver uses,
+    so its gen 0 can never drift from the monolithic loops'."""
     nevals0 = jnp.sum(~pop.valid)  # like the reference's len(invalid_ind)
     pop = evaluate_invalid(pop, toolbox.evaluate)
     hof = hof_init(halloffame_size, pop) if halloffame_size else None
     if hof is not None:
         hof = hof_update(hof, pop)
     record0 = {"nevals": nevals0, **_maybe_stats(stats, pop)}
-    if tel is not None:
-        tel.begin_run("ea_simple", toolbox, declare=_tel_declare,
-                      probes=probes, ngen=ngen, n=pop.size, cxpb=cxpb,
-                      mutpb=mutpb)
-        mstate0 = _tel_measure(tel, tel.meter.init(), nevals0, pop,
-                               jnp.int32(0))
+    return pop, hof, record0
+
+
+# The make_*_step factories build the per-generation scan step of each
+# loop family. The loop functions below scan them over all ngen
+# generations in one compiled program; the resilience engine
+# (deap_tpu/resilience/engine.py) scans the SAME step over key slices,
+# which is what makes segmented-with-checkpoints runs bit-identical to
+# monolithic ones. Carry layout: (pop, hof) — or (pop, hof, mstate)
+# with telemetry, in which case xs is (key, gen) instead of key.
+
+def make_ea_simple_step(toolbox, cxpb: float, mutpb: float,
+                        stats: Optional[Statistics] = None,
+                        telemetry=None) -> Callable:
+    """The eaSimple generation step: select n → varAnd → evaluate
+    invalid → replace (algorithms.py:163-181)."""
+    tel = telemetry
 
     def step(carry, xs):
         if tel is None:
@@ -249,6 +250,37 @@ def ea_simple(key: jax.Array, pop: Population, toolbox, cxpb: float,
                               sel_idx=idx, sel_pool=pop.size,
                               parent_idx=idx)
         return (off, new_hof, mstate), (rec, mstate)
+
+    return step
+
+
+def ea_simple(key: jax.Array, pop: Population, toolbox, cxpb: float,
+              mutpb: float, ngen: int, stats: Optional[Statistics] = None,
+              halloffame_size: int = 0, verbose: bool = False,
+              telemetry=None, probes=(),
+              ) -> Tuple[Population, Logbook, Optional[HallOfFame]]:
+    """The canonical generational GA (algorithms.py:85-189).
+
+    select n → varAnd → evaluate invalid → replace, scanned over ``ngen``
+    generations as one compiled program. ``telemetry`` (a
+    :class:`deap_tpu.telemetry.RunTelemetry`) threads a Meter through
+    the scan and journals the run; ``probes`` adds in-scan population
+    probes (:mod:`deap_tpu.telemetry.probes`) to that meter. Results
+    are unchanged either way.
+    """
+    tel = telemetry
+    _check_probes(probes, tel)
+    kscan = key
+    pop, hof, record0 = _pop_loop_init(pop, toolbox, halloffame_size,
+                                       stats)
+    if tel is not None:
+        tel.begin_run("ea_simple", toolbox, declare=_tel_declare,
+                      probes=probes, ngen=ngen, n=pop.size, cxpb=cxpb,
+                      mutpb=mutpb)
+        mstate0 = _tel_measure(tel, tel.meter.init(), record0["nevals"],
+                               pop, jnp.int32(0))
+
+    step = make_ea_simple_step(toolbox, cxpb, mutpb, stats, tel)
 
     if tel is None:
         (pop, hof), records = lax.scan(step, (pop, hof),
@@ -284,31 +316,13 @@ def _build_logbook(record0, records, stats) -> Logbook:
     return logbook
 
 
-def ea_mu_plus_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
-                      lambda_: int, cxpb: float, mutpb: float, ngen: int,
-                      stats: Optional[Statistics] = None,
-                      halloffame_size: int = 0, verbose: bool = False,
-                      telemetry=None, probes=(),
-                      ) -> Tuple[Population, Logbook, Optional[HallOfFame]]:
-    """(μ + λ) evolution (algorithms.py:248-337): parents survive into the
-    selection pool."""
-    assert cxpb + mutpb <= 1.0, (
-        "The sum of the crossover and mutation probabilities must be <= 1.0.")
+def make_ea_mu_plus_lambda_step(toolbox, mu: int, lambda_: int,
+                                cxpb: float, mutpb: float,
+                                stats: Optional[Statistics] = None,
+                                telemetry=None) -> Callable:
+    """The (μ + λ) generation step: varOr → evaluate invalid → select μ
+    from the parent+offspring union (algorithms.py:248-337)."""
     tel = telemetry
-    _check_probes(probes, tel)
-    kscan = key
-    nevals0 = jnp.sum(~pop.valid)  # like the reference's len(invalid_ind)
-    pop = evaluate_invalid(pop, toolbox.evaluate)
-    hof = hof_init(halloffame_size, pop) if halloffame_size else None
-    if hof is not None:
-        hof = hof_update(hof, pop)
-    record0 = {"nevals": nevals0, **_maybe_stats(stats, pop)}
-    if tel is not None:
-        tel.begin_run("ea_mu_plus_lambda", toolbox, declare=_tel_declare,
-                      probes=probes, ngen=ngen, mu=mu, lambda_=lambda_,
-                      cxpb=cxpb, mutpb=mutpb)
-        mstate0 = _tel_measure(tel, tel.meter.init(), nevals0, pop,
-                               jnp.int32(0))
 
     def step(carry, xs):
         if tel is None:
@@ -333,6 +347,34 @@ def ea_mu_plus_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
                               sel_idx=idx, sel_pool=pool.size)
         return (new_pop, new_hof, mstate), (rec, mstate)
 
+    return step
+
+
+def ea_mu_plus_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
+                      lambda_: int, cxpb: float, mutpb: float, ngen: int,
+                      stats: Optional[Statistics] = None,
+                      halloffame_size: int = 0, verbose: bool = False,
+                      telemetry=None, probes=(),
+                      ) -> Tuple[Population, Logbook, Optional[HallOfFame]]:
+    """(μ + λ) evolution (algorithms.py:248-337): parents survive into the
+    selection pool."""
+    assert cxpb + mutpb <= 1.0, (
+        "The sum of the crossover and mutation probabilities must be <= 1.0.")
+    tel = telemetry
+    _check_probes(probes, tel)
+    kscan = key
+    pop, hof, record0 = _pop_loop_init(pop, toolbox, halloffame_size,
+                                       stats)
+    if tel is not None:
+        tel.begin_run("ea_mu_plus_lambda", toolbox, declare=_tel_declare,
+                      probes=probes, ngen=ngen, mu=mu, lambda_=lambda_,
+                      cxpb=cxpb, mutpb=mutpb)
+        mstate0 = _tel_measure(tel, tel.meter.init(), record0["nevals"],
+                               pop, jnp.int32(0))
+
+    step = make_ea_mu_plus_lambda_step(toolbox, mu, lambda_, cxpb,
+                                       mutpb, stats, tel)
+
     if tel is None:
         (pop, hof), records = lax.scan(step, (pop, hof),
                                        jax.random.split(kscan, ngen))
@@ -348,31 +390,13 @@ def ea_mu_plus_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
     return pop, logbook, hof
 
 
-def ea_mu_comma_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
-                       lambda_: int, cxpb: float, mutpb: float, ngen: int,
-                       stats: Optional[Statistics] = None,
-                       halloffame_size: int = 0, verbose: bool = False,
-                       telemetry=None, probes=(),
-                       ) -> Tuple[Population, Logbook, Optional[HallOfFame]]:
-    """(μ, λ) evolution (algorithms.py:340-437): only offspring survive."""
-    assert lambda_ >= mu, "lambda must be greater or equal to mu."
-    assert cxpb + mutpb <= 1.0, (
-        "The sum of the crossover and mutation probabilities must be <= 1.0.")
+def make_ea_mu_comma_lambda_step(toolbox, mu: int, lambda_: int,
+                                 cxpb: float, mutpb: float,
+                                 stats: Optional[Statistics] = None,
+                                 telemetry=None) -> Callable:
+    """The (μ, λ) generation step: varOr → evaluate invalid → select μ
+    from the offspring only (algorithms.py:340-437)."""
     tel = telemetry
-    _check_probes(probes, tel)
-    kscan = key
-    nevals0 = jnp.sum(~pop.valid)  # like the reference's len(invalid_ind)
-    pop = evaluate_invalid(pop, toolbox.evaluate)
-    hof = hof_init(halloffame_size, pop) if halloffame_size else None
-    if hof is not None:
-        hof = hof_update(hof, pop)
-    record0 = {"nevals": nevals0, **_maybe_stats(stats, pop)}
-    if tel is not None:
-        tel.begin_run("ea_mu_comma_lambda", toolbox, declare=_tel_declare,
-                      probes=probes, ngen=ngen, mu=mu, lambda_=lambda_,
-                      cxpb=cxpb, mutpb=mutpb)
-        mstate0 = _tel_measure(tel, tel.meter.init(), nevals0, pop,
-                               jnp.int32(0))
 
     def step(carry, xs):
         if tel is None:
@@ -393,6 +417,34 @@ def ea_mu_comma_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
                               sel_idx=idx, sel_pool=off.size)
         return (new_pop, new_hof, mstate), (rec, mstate)
 
+    return step
+
+
+def ea_mu_comma_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
+                       lambda_: int, cxpb: float, mutpb: float, ngen: int,
+                       stats: Optional[Statistics] = None,
+                       halloffame_size: int = 0, verbose: bool = False,
+                       telemetry=None, probes=(),
+                       ) -> Tuple[Population, Logbook, Optional[HallOfFame]]:
+    """(μ, λ) evolution (algorithms.py:340-437): only offspring survive."""
+    assert lambda_ >= mu, "lambda must be greater or equal to mu."
+    assert cxpb + mutpb <= 1.0, (
+        "The sum of the crossover and mutation probabilities must be <= 1.0.")
+    tel = telemetry
+    _check_probes(probes, tel)
+    kscan = key
+    pop, hof, record0 = _pop_loop_init(pop, toolbox, halloffame_size,
+                                       stats)
+    if tel is not None:
+        tel.begin_run("ea_mu_comma_lambda", toolbox, declare=_tel_declare,
+                      probes=probes, ngen=ngen, mu=mu, lambda_=lambda_,
+                      cxpb=cxpb, mutpb=mutpb)
+        mstate0 = _tel_measure(tel, tel.meter.init(), record0["nevals"],
+                               pop, jnp.int32(0))
+
+    step = make_ea_mu_comma_lambda_step(toolbox, mu, lambda_, cxpb,
+                                        mutpb, stats, tel)
+
     if tel is None:
         (pop, hof), records = lax.scan(step, (pop, hof),
                                        jax.random.split(kscan, ngen))
@@ -408,22 +460,11 @@ def ea_mu_comma_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
     return pop, logbook, hof
 
 
-def ea_generate_update(key: jax.Array, state: Any, toolbox, ngen: int,
-                       spec: FitnessSpec,
-                       stats: Optional[Statistics] = None,
-                       halloffame_size: int = 0, verbose: bool = False,
-                       telemetry=None, probes=(),
-                       ) -> Tuple[Any, Logbook, Optional[HallOfFame]]:
-    """Ask-tell loop (algorithms.py:440-503) driving CMA-ES/PBIL/EMNA-style
-    strategies:
-
-    - ``toolbox.generate``: ``(key, state) -> genomes``
-    - ``toolbox.update``:   ``(state, genomes, values) -> state``
-
-    The whole generate → evaluate → update cycle is one scanned step; the
-    strategy state is a pytree in the carry.
-    """
-    # Shape template for the hall of fame, without running compute.
+def _generate_update_init(toolbox, state: Any, spec: FitnessSpec,
+                          halloffame_size: int):
+    """Ask-tell loop setup: infer λ and build the hall of fame from a
+    shape template, without running compute. Returns ``(lam, hof)`` —
+    shared with the segmented resilience driver."""
     g_shape = jax.eval_shape(toolbox.generate, jax.random.key(0), state)
     lam = jax.tree_util.tree_leaves(g_shape)[0].shape[0]
     v_shape = jax.eval_shape(toolbox.evaluate, g_shape)
@@ -436,12 +477,15 @@ def ea_generate_update(key: jax.Array, state: Any, toolbox, ngen: int,
         spec=spec,
     )
     hof = hof_init(halloffame_size, template) if halloffame_size else None
+    return lam, hof
+
+
+def make_ea_generate_update_step(toolbox, spec: FitnessSpec, lam: int,
+                                 stats: Optional[Statistics] = None,
+                                 telemetry=None) -> Callable:
+    """The ask-tell generation step: generate → evaluate → update
+    (algorithms.py:440-503); carry ``(state, hof[, mstate])``."""
     tel = telemetry
-    _check_probes(probes, tel)
-    if tel is not None:
-        tel.begin_run("ea_generate_update", toolbox, declare=_tel_declare,
-                      probes=probes, ngen=ngen, lambda_=lam)
-        mstate0 = tel.meter.init()
 
     def step(carry, xs):
         if tel is None:
@@ -468,6 +512,49 @@ def ea_generate_update(key: jax.Array, state: Any, toolbox, ngen: int,
         tel.live(mstate, gen)
         return (new_state, new_hof, mstate), (rec, mstate)
 
+    return step
+
+
+def _build_gu_logbook(records, stats) -> Logbook:
+    """The ask-tell loop's logbook: one row per generation starting at
+    gen 0 (no separate founder record)."""
+    body = logbook_from_records(records)
+    logbook = Logbook()
+    logbook.header = ["gen", "nevals"] + (list(stats.fields) if stats else [])
+    for gen in range(len(body)):
+        entry = dict(body[gen])
+        for name, chapter in body.chapters.items():
+            entry[name] = dict(chapter[gen])
+        logbook.record(gen=gen, **entry)
+    return logbook
+
+
+def ea_generate_update(key: jax.Array, state: Any, toolbox, ngen: int,
+                       spec: FitnessSpec,
+                       stats: Optional[Statistics] = None,
+                       halloffame_size: int = 0, verbose: bool = False,
+                       telemetry=None, probes=(),
+                       ) -> Tuple[Any, Logbook, Optional[HallOfFame]]:
+    """Ask-tell loop (algorithms.py:440-503) driving CMA-ES/PBIL/EMNA-style
+    strategies:
+
+    - ``toolbox.generate``: ``(key, state) -> genomes``
+    - ``toolbox.update``:   ``(state, genomes, values) -> state``
+
+    The whole generate → evaluate → update cycle is one scanned step; the
+    strategy state is a pytree in the carry.
+    """
+    lam, hof = _generate_update_init(toolbox, state, spec,
+                                     halloffame_size)
+    tel = telemetry
+    _check_probes(probes, tel)
+    if tel is not None:
+        tel.begin_run("ea_generate_update", toolbox, declare=_tel_declare,
+                      probes=probes, ngen=ngen, lambda_=lam)
+        mstate0 = tel.meter.init()
+
+    step = make_ea_generate_update_step(toolbox, spec, lam, stats, tel)
+
     if tel is None:
         (state, hof), records = lax.scan(step, (state, hof),
                                          jax.random.split(key, ngen))
@@ -477,14 +564,7 @@ def ea_generate_update(key: jax.Array, state: Any, toolbox, ngen: int,
             (jax.random.split(key, ngen), jnp.arange(ngen)))
         tel.end_run("ea_generate_update", stacked_meter=mrows, gen0=0,
                     ngen=ngen)
-    body = logbook_from_records(records)
-    logbook = Logbook()
-    logbook.header = ["gen", "nevals"] + (list(stats.fields) if stats else [])
-    for gen in range(len(body)):
-        entry = dict(body[gen])
-        for name, chapter in body.chapters.items():
-            entry[name] = dict(chapter[gen])
-        logbook.record(gen=gen, **entry)
+    logbook = _build_gu_logbook(records, stats)
     if verbose:
         print(logbook.stream)
     return state, logbook, hof

@@ -276,58 +276,105 @@ def make_gp_loop(pset: PrimitiveSet, max_len: int, evaluate: Callable, *,
         tel.record_row(mstate, gen)
         return mstate
 
-    def run(key, genomes, ngen: int):
+    # The engine is factored into init_state / advance / finalize so a
+    # driver with a host between generations (deap_tpu/resilience) can
+    # checkpoint the full loop state at any generation boundary and
+    # resume bit-exactly: per-generation keys derive from
+    # fold_in(key, gen) — stateless in the generation index — so the
+    # only state is what these functions carry in the state dict.
+
+    def begin_telemetry(ngen: int, n: int) -> None:
+        """Declare this loop's telemetry (meter built-ins + probes) and
+        journal the run start. ``init_state`` calls it; a resumed run
+        (whose gen-0 happened in an earlier process) calls it directly
+        so the fresh Meter knows the metric set the checkpointed
+        mstate was built against."""
+        from deap_tpu.algorithms import _tel_declare
+        tel.begin_run("gp_loop", None, declare=_tel_declare,
+                      probes=probes, ngen=ngen, n=n, cxpb=cxpb,
+                      mutpb=mutpb)
+
+    def init_state(key, genomes, ngen: int) -> dict:
         n = int(np.asarray(genomes["length"]).shape[0])
         depths = depths_of(genomes)
         fit = evaluate(genomes)
-        nevals = [n]
+        state = {"gen": 0, "genomes": genomes, "depths": depths,
+                 "fit": fit, "nevals": [n], "stopped_at": None,
+                 "mstate": None}
         best_i = int(jnp.argmax(fit))
-        best = (jax.tree_util.tree_map(lambda a: a[best_i], genomes),
-                float(fit[best_i]))
-        stopped_at = None
+        state["best_genome"] = jax.tree_util.tree_map(
+            lambda a: a[best_i], genomes)
+        state["best_fitness"] = float(fit[best_i])
         if tel is not None:
-            from deap_tpu.algorithms import _tel_declare
-            tel.begin_run("gp_loop", None, declare=_tel_declare,
-                          probes=probes, ngen=ngen, n=n, cxpb=cxpb,
-                          mutpb=mutpb)
-            mstate = _measure(tel.meter.init(), n, genomes, fit, 0)
-        for gen in range(1, ngen + 1):
-            k = jax.random.fold_in(key, gen)
-            k_sel, k_var = jax.random.split(k)
-            genomes, depths, fit, sel_idx = select(k_sel, genomes,
-                                                   depths, fit)
-            genomes, depths, touched = vary(k_var, genomes, depths, n)
-            idx = np.nonzero(touched)[0]
-            ne = len(idx)
-            nevals.append(ne)
-            if ne:
-                padded = np.resize(idx, min(_round_size(ne), n))
-                sub = jax.tree_util.tree_map(
-                    lambda a: a[jnp.asarray(padded)], genomes)
-                w = evaluate(sub)
-                # full-padded scatter (cycled duplicates agree) — see
-                # _scatter in vary for the shape-class rationale
-                fit = fit.at[jnp.asarray(padded)].set(w)
-            best_i = int(jnp.argmax(fit))
-            if float(fit[best_i]) > best[1]:
-                best = (jax.tree_util.tree_map(
-                    lambda a: a[best_i], genomes), float(fit[best_i]))
-            if tel is not None:
-                mstate = _measure(mstate, ne, genomes, fit, gen, sel_idx)
-                # the host is in the loop, so tripwires can actually
-                # stop the run — the scanned loops can only journal
-                if tel.health is not None and tel.health.stop_requested:
-                    stopped_at = gen
-                    break
+            begin_telemetry(ngen, n)
+            state["mstate"] = _measure(tel.meter.init(), n, genomes,
+                                       fit, 0)
+        return state
+
+    def advance(key, state: dict) -> dict:
+        """One generation, in place: gen ``state['gen'] + 1`` of the
+        run keyed by ``key``. Sets ``stopped_at`` when a HealthMonitor
+        requested an early stop (the caller's loop honours it)."""
+        genomes, depths, fit = (state["genomes"], state["depths"],
+                                state["fit"])
+        n = int(np.asarray(genomes["length"]).shape[0])
+        gen = state["gen"] + 1
+        k = jax.random.fold_in(key, gen)
+        k_sel, k_var = jax.random.split(k)
+        genomes, depths, fit, sel_idx = select(k_sel, genomes,
+                                               depths, fit)
+        genomes, depths, touched = vary(k_var, genomes, depths, n)
+        idx = np.nonzero(touched)[0]
+        ne = len(idx)
+        state["nevals"].append(ne)
+        if ne:
+            padded = np.resize(idx, min(_round_size(ne), n))
+            sub = jax.tree_util.tree_map(
+                lambda a: a[jnp.asarray(padded)], genomes)
+            w = evaluate(sub)
+            # full-padded scatter (cycled duplicates agree) — see
+            # _scatter in vary for the shape-class rationale
+            fit = fit.at[jnp.asarray(padded)].set(w)
+        best_i = int(jnp.argmax(fit))
+        if float(fit[best_i]) > state["best_fitness"]:
+            state["best_genome"] = jax.tree_util.tree_map(
+                lambda a: a[best_i], genomes)
+            state["best_fitness"] = float(fit[best_i])
+        state.update(gen=gen, genomes=genomes, depths=depths, fit=fit)
         if tel is not None:
-            tel.end_run("gp_loop", ngen=ngen, stopped_at=stopped_at)
-        return {"genomes": genomes, "depths": depths, "fitness": fit,
-                "best_genome": best[0], "best_fitness": best[1],
-                "nevals": nevals, "stopped_at": stopped_at}
+            state["mstate"] = _measure(state["mstate"], ne, genomes,
+                                       fit, gen, sel_idx)
+            # the host is in the loop, so tripwires can actually
+            # stop the run — the scanned loops can only journal
+            if tel.health is not None and tel.health.stop_requested:
+                state["stopped_at"] = gen
+        return state
+
+    def finalize(state: dict, ngen: int) -> dict:
+        if tel is not None:
+            tel.end_run("gp_loop", ngen=ngen,
+                        stopped_at=state["stopped_at"])
+        return {"genomes": state["genomes"], "depths": state["depths"],
+                "fitness": state["fit"],
+                "best_genome": state["best_genome"],
+                "best_fitness": state["best_fitness"],
+                "nevals": state["nevals"],
+                "stopped_at": state["stopped_at"]}
+
+    def run(key, genomes, ngen: int):
+        state = init_state(key, genomes, ngen)
+        while state["gen"] < ngen and state["stopped_at"] is None:
+            advance(key, state)
+        return finalize(state, ngen)
 
     run.select = select              # exposed for tests
     run.vary = vary
     run.depths_of = depths_of
+    run.init_state = init_state     # segmented driving (resilience)
+    run.advance = advance
+    run.finalize = finalize
+    run.begin_telemetry = begin_telemetry if tel is not None else None
+    run.telemetry = tel
     return run
 
 

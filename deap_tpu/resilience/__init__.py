@@ -1,0 +1,57 @@
+"""Resilience layer — preemption-safe segmented runs, crash-consistent
+checkpoints, transient-failure handling, and the fault-injection
+harness that proves the recovery paths (docs/advanced/resilience.md).
+
+Quick start::
+
+    from deap_tpu.resilience import ResilientRun
+
+    res = ResilientRun("ckpts/exp42", segment_len=100)
+    pop, logbook, hof = res.ea_simple(key, pop, toolbox, 0.5, 0.2,
+                                      ngen=10_000)
+
+The run checkpoints every 100 generations; SIGTERM/SIGINT finish the
+in-flight segment, save and raise :class:`Preempted`; re-invoking the
+same call resumes from the newest valid checkpoint with bit-identical
+results to an uninterrupted run.
+"""
+
+from deap_tpu.resilience.engine import (
+    QUARANTINE_PENALTY,
+    Preempted,
+    ResilientRun,
+    RetryPolicy,
+    classify_error,
+    quarantine_non_finite,
+)
+from deap_tpu.resilience.faultinject import (
+    CorruptCheckpoint,
+    FailSegments,
+    Fault,
+    FaultPlan,
+    InjectedCrash,
+    InjectedTransient,
+    KillAt,
+    PreemptAt,
+    corrupt_file,
+    nan_inject_evaluate,
+)
+
+__all__ = [
+    "QUARANTINE_PENALTY",
+    "Preempted",
+    "ResilientRun",
+    "RetryPolicy",
+    "classify_error",
+    "quarantine_non_finite",
+    "CorruptCheckpoint",
+    "FailSegments",
+    "Fault",
+    "FaultPlan",
+    "InjectedCrash",
+    "InjectedTransient",
+    "KillAt",
+    "PreemptAt",
+    "corrupt_file",
+    "nan_inject_evaluate",
+]

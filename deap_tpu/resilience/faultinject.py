@@ -1,0 +1,207 @@
+"""Deterministic fault injection — the proof harness for the
+resilience layer.
+
+A :class:`FaultPlan` is a list of :class:`Fault` objects fired by
+:class:`~deap_tpu.resilience.engine.ResilientRun` at well-defined
+points of the drive (``segment_start`` / ``segment_attempt`` /
+``segment_end`` / ``saved``), each carrying the segment bounds and the
+live :class:`~deap_tpu.support.checkpoint.Checkpointer`. Every fault is
+a pure function of (event, bounds, its own fire counter) — no clocks,
+no RNG — so a chaos test replays the exact same failure schedule every
+run, which is what lets ``tests/test_chaos.py`` pin *bit-exact*
+recovery rather than "it eventually finished".
+
+Catalogue:
+
+- :class:`KillAt` — simulate a hard kill (OOM-killer, node loss) by
+  raising :class:`InjectedCrash` at a generation boundary, before or
+  after the segment's checkpoint lands. The test then resumes with a
+  fresh driver, exactly like a rescheduled pod would.
+- :class:`PreemptAt` — deliver a real ``SIGTERM`` to this process at a
+  segment boundary; the driver's handler finishes the in-flight
+  segment, saves, journals ``preempted`` and raises ``Preempted``.
+- :class:`CorruptCheckpoint` — flip (or truncate to) bytes of the
+  checkpoint file just written, emulating a torn/rotted snapshot; the
+  CRC layer must detect it and fall back.
+- :class:`FailSegments` — raise a classifiable transient error
+  (``RESOURCE_EXHAUSTED`` by default) on the first ``times`` attempts
+  of a segment, exercising retry/backoff/degrade.
+- :func:`nan_inject_evaluate` — wrap an evaluator so chosen rows come
+  back NaN, exercising the quarantine wrapper and the ``non_finite``
+  alarm.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from typing import Any, List, Optional
+
+import jax.numpy as jnp
+
+__all__ = ["InjectedCrash", "InjectedTransient", "Fault", "FaultPlan",
+           "KillAt", "PreemptAt", "CorruptCheckpoint", "FailSegments",
+           "nan_inject_evaluate", "corrupt_file"]
+
+
+class InjectedCrash(RuntimeError):
+    """A simulated hard kill — deliberately *not* classified transient,
+    so the driver must not retry it (a real SIGKILL retries nothing)."""
+
+
+class InjectedTransient(RuntimeError):
+    """A simulated infrastructure error whose message carries a
+    transient marker (``RESOURCE_EXHAUSTED`` etc.) so
+    :func:`~deap_tpu.resilience.engine.classify_error` retries it."""
+
+
+class Fault:
+    """One scheduled failure. Subclasses implement :meth:`fire`;
+    ``fired`` counts activations so plans stay single-shot by
+    default."""
+
+    def __init__(self):
+        self.fired = 0
+
+    def fire(self, event: str, **ctx) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+class FaultPlan:
+    """An ordered set of faults sharing the driver's event stream."""
+
+    def __init__(self, faults: Optional[List[Fault]] = None):
+        self.faults = list(faults or [])
+        self.log: List[dict] = []
+
+    def add(self, fault: Fault) -> "FaultPlan":
+        self.faults.append(fault)
+        return self
+
+    def fire(self, event: str, **ctx) -> None:
+        self.log.append({"event": event,
+                         **{k: v for k, v in ctx.items()
+                            if isinstance(v, (int, str, float))}})
+        for f in self.faults:
+            f.fire(event, **ctx)
+
+
+class KillAt(Fault):
+    """Raise :class:`InjectedCrash` when the drive crosses generation
+    ``gen`` — ``when='before_save'`` kills after the segment computed
+    but before its checkpoint landed (the worst crash window: that
+    segment's work is lost and resume replays it), ``'after_save'``
+    kills right after the checkpoint landed."""
+
+    def __init__(self, gen: int, when: str = "before_save"):
+        super().__init__()
+        if when not in ("before_save", "after_save"):
+            raise ValueError(f"unknown when={when!r}")
+        self.gen = int(gen)
+        self.when = when
+
+    def fire(self, event: str, **ctx) -> None:
+        want = "segment_end" if self.when == "before_save" else "saved"
+        if event == want and not self.fired and ctx["hi"] >= self.gen:
+            self.fired += 1
+            raise InjectedCrash(
+                f"injected hard kill at gen {ctx['hi']} ({self.when})")
+
+
+class PreemptAt(Fault):
+    """Deliver a real ``SIGTERM`` to this process when the drive
+    crosses generation ``gen`` — exercises the actual signal-handler
+    path: the driver finishes the segment, saves, raises
+    ``Preempted``."""
+
+    def __init__(self, gen: int, signum: int = signal.SIGTERM):
+        super().__init__()
+        self.gen = int(gen)
+        self.signum = signum
+
+    def fire(self, event: str, **ctx) -> None:
+        if event == "segment_end" and not self.fired \
+                and ctx["hi"] >= self.gen:
+            self.fired += 1
+            signal.raise_signal(self.signum)
+
+
+def corrupt_file(path: str, mode: str = "flip", nbytes: int = 16,
+                 offset: int = -256) -> None:
+    """Deterministically damage a file in place. ``flip`` XORs
+    ``nbytes`` bytes starting at ``offset`` (negative = from the end);
+    ``truncate`` cuts the file to ``offset`` bytes."""
+    size = os.path.getsize(path)
+    if mode == "truncate":
+        with open(path, "r+b") as f:
+            f.truncate(max(0, size + offset if offset < 0 else offset))
+        return
+    if mode != "flip":
+        raise ValueError(f"unknown mode={mode!r}")
+    start = size + offset if offset < 0 else offset
+    start = max(0, min(start, max(0, size - nbytes)))
+    with open(path, "r+b") as f:
+        f.seek(start)
+        chunk = f.read(nbytes)
+        f.seek(start)
+        f.write(bytes(b ^ 0xA5 for b in chunk))
+
+
+class CorruptCheckpoint(Fault):
+    """After the checkpoint for generation ``gen`` lands, damage its
+    bytes (``mode`` as in :func:`corrupt_file`) — the restore path must
+    detect the CRC mismatch and fall back to the newest valid older
+    step. ``then_crash=True`` also raises :class:`InjectedCrash` so the
+    test resumes from the damaged directory."""
+
+    def __init__(self, gen: int, mode: str = "flip",
+                 then_crash: bool = True):
+        super().__init__()
+        self.gen = int(gen)
+        self.mode = mode
+        self.then_crash = then_crash
+
+    def fire(self, event: str, **ctx) -> None:
+        if event == "saved" and not self.fired and ctx["hi"] >= self.gen:
+            self.fired += 1
+            corrupt_file(ctx["path"], mode=self.mode)
+            if self.then_crash:
+                raise InjectedCrash(
+                    f"injected crash after corrupting {ctx['path']}")
+
+
+class FailSegments(Fault):
+    """Fail the first ``times`` attempts of the segment starting at
+    ``lo`` with a transient error (``marker`` lands in the message so
+    the classifier sees it) — retry/backoff must absorb the failures
+    and the result must stay bit-exact."""
+
+    def __init__(self, lo: int, times: int = 2,
+                 marker: str = "RESOURCE_EXHAUSTED"):
+        super().__init__()
+        self.lo = int(lo)
+        self.times = int(times)
+        self.marker = marker
+
+    def fire(self, event: str, **ctx) -> None:
+        if event == "segment_attempt" and ctx["lo"] == self.lo \
+                and self.fired < self.times:
+            self.fired += 1
+            raise InjectedTransient(
+                f"{self.marker}: injected transient failure "
+                f"(attempt {ctx['attempt']})")
+
+
+def nan_inject_evaluate(evaluate, rows: Any):
+    """Wrap a batched evaluator so fitness rows ``rows`` (indices)
+    come back NaN every call — deterministic input for the
+    quarantine wrapper and the ``non_finite`` alarm path."""
+    rows = jnp.asarray(rows)
+
+    def wrapped(genomes):
+        values = evaluate(genomes)
+        flat_bad = jnp.zeros(values.shape[0], bool).at[rows].set(True)
+        bad = flat_bad.reshape((-1,) + (1,) * (values.ndim - 1))
+        return jnp.where(bad, jnp.nan, values)
+
+    return wrapped

@@ -21,9 +21,12 @@ from deap_tpu.support.profiling import (
     trace,
 )
 from deap_tpu.support.checkpoint import (
+    CheckpointCorruptError,
     Checkpointer,
+    checkpoint_meta,
     restore_state,
     save_state,
+    verify_checkpoint,
 )
 
 __all__ = [
@@ -51,7 +54,10 @@ __all__ = [
     "lineage_init",
     "lineage_step",
     "pair_parents",
+    "CheckpointCorruptError",
     "Checkpointer",
+    "checkpoint_meta",
     "save_state",
     "restore_state",
+    "verify_checkpoint",
 ]

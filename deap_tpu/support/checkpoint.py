@@ -9,12 +9,20 @@ fame, PRNG key — is one pytree, so a checkpoint is a faithful snapshot
 by construction and resuming is bit-exact (explicit `jax.random` keys
 make RNG restoration trivial, SURVEY.md §5.4).
 
-Implementation: a self-contained portable format — flattened pytree →
-numpy arrays + pickled treedef, written atomically. Typed PRNG key
-arrays are converted through ``jax.random.key_data``/``wrap_key_data``
-so they survive serialization. (Evolution state is tiny next to NN
-checkpoints; for multi-host sharded runs, swap :func:`save_state` for an
-orbax checkpointer behind the same :class:`Checkpointer` interface.)
+Implementation: a self-contained **crash-consistent** portable format
+(version 2) — each packed leaf is pickled to its own blob with a CRC32,
+plus a CRC'd treedef blob and a format-version tag, written
+fsync-before-rename so a power cut or SIGKILL can never leave a torn
+file under the final name. Typed PRNG key arrays are converted through
+``jax.random.key_data``/``wrap_key_data`` with the canonical impl name
+stored explicitly at pack time. :func:`restore_state` verifies every
+CRC and raises :class:`CheckpointCorruptError` on any mismatch or
+unreadable payload; :class:`Checkpointer` turns that into automatic
+fallback to the newest *valid* older step, and its rotation never
+deletes the last verified-good snapshot. Version-1 files (the pre-CRC
+format) still restore. (Evolution state is tiny next to NN checkpoints;
+for multi-host sharded runs, swap :func:`save_state` for an orbax
+checkpointer behind the same :class:`Checkpointer` interface.)
 """
 
 from __future__ import annotations
@@ -23,7 +31,8 @@ import os
 import pickle
 import re
 import shutil
-from typing import Any, List, Optional
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -31,11 +40,38 @@ import numpy as np
 
 _PRNG_TAG = "__prng_key__"
 
+#: payload format written by :func:`save_state`; bump when the layout
+#: changes (restore keeps reading every older version)
+FORMAT_VERSION = 2
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint file exists but cannot be trusted: unreadable
+    pickle, CRC mismatch, or a payload that is not a checkpoint."""
+
+    def __init__(self, path: str, detail: str):
+        super().__init__(f"corrupt checkpoint {path}: {detail}")
+        self.path = path
+        self.detail = detail
+
+
+def _key_impl_name(key: jax.Array) -> str:
+    """Canonical PRNG impl name for a typed key array. jax's
+    ``key_impl`` has returned a plain string (0.4.x) and a PRNGSpec
+    object (newer) — normalise to the registry name that
+    ``wrap_key_data(..., impl=name)`` accepts, with no repr parsing."""
+    spec = jax.random.key_impl(key)
+    if isinstance(spec, str):
+        return spec
+    name = getattr(spec, "name", None) or getattr(
+        getattr(spec, "_impl", None), "name", None)
+    return name if isinstance(name, str) else str(spec)
+
 
 def _pack_leaf(leaf: Any) -> Any:
     if isinstance(leaf, jax.Array) and jnp.issubdtype(leaf.dtype, jax.dtypes.prng_key):
-        impl = str(jax.random.key_impl(leaf))
-        return {_PRNG_TAG: impl, "data": np.asarray(jax.random.key_data(leaf))}
+        return {_PRNG_TAG: _key_impl_name(leaf),
+                "data": np.asarray(jax.random.key_data(leaf))}
     if isinstance(leaf, jax.Array):
         return np.asarray(leaf)
     return leaf
@@ -43,37 +79,144 @@ def _pack_leaf(leaf: Any) -> Any:
 
 def _unpack_leaf(leaf: Any) -> Any:
     if isinstance(leaf, dict) and _PRNG_TAG in leaf:
-        m = re.search(r"'(\w+)'", leaf[_PRNG_TAG])
-        impl = m.group(1) if m else leaf[_PRNG_TAG]
+        impl = leaf[_PRNG_TAG]
+        # version-1 files written under jax versions whose key_impl
+        # stringified to a repr (e.g. "PRNGSpec('rbg')") — extract the
+        # quoted name; version-2 files store the canonical name as-is
+        m = re.search(r"'(\w+)'", impl)
+        if m:
+            impl = m.group(1)
         return jax.random.wrap_key_data(jnp.asarray(leaf["data"]), impl=impl)
     if isinstance(leaf, np.ndarray):
         return jnp.asarray(leaf)
     return leaf
 
 
-def save_state(path: str, state: Any) -> None:
-    """Serialize an arbitrary state pytree to ``path`` (atomic write)."""
+def _fsync_dir(path: str) -> None:
+    """fsync the directory entry so the rename itself is durable (an
+    atomic replace only guarantees old-or-new content; the *name* can
+    still vanish in a crash without this). Best-effort — not every
+    filesystem hands out directory fds."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def save_state(path: str, state: Any, meta: Optional[Dict[str, Any]] = None,
+               ) -> None:
+    """Serialize an arbitrary state pytree to ``path``.
+
+    Crash-consistent: the payload (per-leaf blobs + CRC32s + format
+    version + optional ``meta`` dict) is written to a temp file,
+    fsync'd, atomically renamed over ``path``, and the directory entry
+    fsync'd — at no point can a reader observe a torn file under the
+    final name. ``meta`` round-trips via :func:`checkpoint_meta`
+    without deserializing the state (run-id chaining reads it)."""
     leaves, treedef = jax.tree_util.tree_flatten(state)
-    payload = {"leaves": [_pack_leaf(l) for l in leaves], "treedef": treedef}
+    blobs = [pickle.dumps(_pack_leaf(l), protocol=pickle.HIGHEST_PROTOCOL)
+             for l in leaves]
+    treedef_blob = pickle.dumps(treedef, protocol=pickle.HIGHEST_PROTOCOL)
+    payload = {
+        "format_version": FORMAT_VERSION,
+        "treedef": treedef_blob,
+        "treedef_crc": zlib.crc32(treedef_blob),
+        "leaves": blobs,
+        "crcs": [zlib.crc32(b) for b in blobs],
+        "meta": dict(meta or {}),
+    }
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
+    _fsync_dir(path)
     # surface the write in any open run journal (no-op otherwise)
     from deap_tpu.telemetry.journal import broadcast
     broadcast("checkpoint", path=path, bytes=os.path.getsize(path))
 
 
+def _load_payload(path: str) -> Any:
+    try:
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    except FileNotFoundError:
+        raise
+    except Exception as e:  # torn/garbage pickle, EOF, bad opcode ...
+        raise CheckpointCorruptError(path, f"unreadable payload ({e!r})")
+
+
+def _verify_payload(path: str, payload: Any) -> None:
+    """CRC-check a version>=2 payload; raise on the first mismatch."""
+    if not isinstance(payload, dict):
+        raise CheckpointCorruptError(path, "payload is not a dict")
+    version = payload.get("format_version")
+    if version is None:
+        # version-1 format: {"leaves": [...], "treedef": treedef} — no
+        # checksums to verify, structural presence is the only check
+        if "leaves" not in payload or "treedef" not in payload:
+            raise CheckpointCorruptError(path, "not a checkpoint payload")
+        return
+    for k in ("treedef", "treedef_crc", "leaves", "crcs"):
+        if k not in payload:
+            raise CheckpointCorruptError(path, f"missing field {k!r}")
+    if zlib.crc32(payload["treedef"]) != payload["treedef_crc"]:
+        raise CheckpointCorruptError(path, "treedef CRC mismatch")
+    if len(payload["leaves"]) != len(payload["crcs"]):
+        raise CheckpointCorruptError(path, "leaf/CRC count mismatch")
+    for i, (blob, crc) in enumerate(zip(payload["leaves"],
+                                        payload["crcs"])):
+        if zlib.crc32(blob) != crc:
+            raise CheckpointCorruptError(path, f"leaf {i} CRC mismatch")
+
+
+def verify_checkpoint(path: str) -> Dict[str, Any]:
+    """Validate ``path`` without materialising the state: checks the
+    pickle container and every CRC. Returns the ``meta`` dict. Raises
+    :class:`CheckpointCorruptError` (or ``FileNotFoundError``)."""
+    payload = _load_payload(path)
+    _verify_payload(path, payload)
+    meta = payload.get("meta", {}) if isinstance(payload, dict) else {}
+    return meta if isinstance(meta, dict) else {}
+
+
+def checkpoint_meta(path: str) -> Dict[str, Any]:
+    """The ``meta`` dict stored by :func:`save_state` (empty for
+    version-1 files). Verifies CRCs on the way."""
+    return verify_checkpoint(path)
+
+
 def restore_state(path: str) -> Any:
-    """Load a state pytree written by :func:`save_state`."""
-    with open(path, "rb") as f:
-        payload = pickle.load(f)
-    leaves = [_unpack_leaf(l) for l in payload["leaves"]]
-    return jax.tree_util.tree_unflatten(payload["treedef"], leaves)
+    """Load a state pytree written by :func:`save_state`.
+
+    Verifies the format version and every CRC first; raises
+    :class:`CheckpointCorruptError` naming the failure rather than
+    returning silently-wrong state. Reads both the current and the
+    version-1 (pre-CRC) payload layout."""
+    payload = _load_payload(path)
+    _verify_payload(path, payload)
+    if payload.get("format_version") is None:
+        leaves = [_unpack_leaf(l) for l in payload["leaves"]]
+        return jax.tree_util.tree_unflatten(payload["treedef"], leaves)
+    try:
+        treedef = pickle.loads(payload["treedef"])
+        leaves = [_unpack_leaf(pickle.loads(b))
+                  for b in payload["leaves"]]
+    except Exception as e:  # CRC passed but unpickling failed anyway
+        raise CheckpointCorruptError(path, f"undecodable leaf ({e!r})")
+    return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
 class Checkpointer:
-    """Step-indexed checkpoint directory with rotation.
+    """Step-indexed checkpoint directory with corruption-safe rotation.
 
     The tensor analog of the reference's every-FREQ-generations pickle
     recipe (checkpoint.rst:22-70):
@@ -82,12 +225,24 @@ class Checkpointer:
     >>> if ckpt.latest_step() is not None:
     ...     state = ckpt.restore()          # resume, RNG key included
     >>> ckpt.save(gen, state)               # inside the outer loop
+
+    Robustness contract (tests/test_checkpoint_hardening.py):
+
+    - :meth:`restore` with no explicit step walks steps newest-first
+      and silently falls back past corrupt files to the newest *valid*
+      one (each skip journaled as a ``checkpoint_corrupt`` event).
+    - rotation never deletes the newest checkpoint known to be valid:
+      a save whose own verification fails rotates nothing.
+    - :meth:`steps`/:meth:`latest_step` return ``[]``/``None`` when the
+      directory was removed out from under a live run; only an actual
+      :meth:`restore` raises (a clear error naming the missing path).
     """
 
     def __init__(self, directory: str, keep: int = 3, prefix: str = "ckpt"):
         self.directory = directory
         self.keep = keep
         self.prefix = prefix
+        self._verified: set = set()   # steps whose file passed CRC
         os.makedirs(directory, exist_ok=True)
 
     def _path(self, step: int) -> str:
@@ -95,8 +250,12 @@ class Checkpointer:
 
     def steps(self) -> List[int]:
         pat = re.compile(rf"{re.escape(self.prefix)}_(\d+)\.pkl$")
+        try:
+            names = os.listdir(self.directory)
+        except (FileNotFoundError, NotADirectoryError):
+            return []  # directory removed under a live run: no steps
         out = []
-        for name in os.listdir(self.directory):
+        for name in names:
             m = pat.match(name)
             if m:
                 out.append(int(m.group(1)))
@@ -106,21 +265,92 @@ class Checkpointer:
         steps = self.steps()
         return steps[-1] if steps else None
 
-    def save(self, step: int, state: Any) -> str:
+    def save(self, step: int, state: Any,
+             meta: Optional[Dict[str, Any]] = None) -> str:
         path = self._path(step)
-        save_state(path, state)
+        os.makedirs(self.directory, exist_ok=True)
+        save_state(path, state, meta=meta)
+        try:
+            verify_checkpoint(path)
+            self._verified.add(step)
+        except (CheckpointCorruptError, FileNotFoundError):
+            # the write itself went bad (disk fault): keep every older
+            # file — rotating here could delete the only good snapshot
+            from deap_tpu.telemetry.journal import broadcast
+            broadcast("checkpoint_corrupt", path=path,
+                      phase="post_save_verify")
+            return path
         if self.keep is not None:
-            for old in self.steps()[: -self.keep]:
+            steps = self.steps()
+            last_good = max((s for s in self._verified if s in steps),
+                            default=None)
+            for old in steps[: -self.keep]:
+                if old == last_good:
+                    continue  # never delete the last verified-good one
                 os.remove(self._path(old))
         return path
 
     def restore(self, step: Optional[int] = None) -> Any:
+        """Restore a checkpoint. With ``step=None``: the newest valid
+        one — corrupt files are skipped (journaled) and the next older
+        step is tried; raises only when nothing valid remains. With an
+        explicit ``step``: exactly that file, raising
+        ``FileNotFoundError``/:class:`CheckpointCorruptError`."""
+        if step is not None:
+            path = self._path(step)
+            if not os.path.exists(path):
+                raise FileNotFoundError(
+                    f"no checkpoint for step {step}: {path} is missing")
+            state = restore_state(path)
+            self._verified.add(step)
+            return state
+        got = self.restore_latest()
+        if got is None:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        return got[1]
+
+    def restore_latest(self) -> Optional[Tuple[int, Any]]:
+        """``(step, state)`` of the newest valid checkpoint, or ``None``
+        when the directory holds no checkpoints at all. Corrupt files
+        are skipped newest-first, each journaled as a
+        ``checkpoint_corrupt`` event; if every file is corrupt, raises
+        :class:`CheckpointCorruptError`."""
+        from deap_tpu.telemetry.journal import broadcast
+
+        steps = self.steps()
+        if not steps:
+            return None
+        last_error: Optional[CheckpointCorruptError] = None
+        for s in reversed(steps):
+            path = self._path(s)
+            try:
+                state = restore_state(path)
+            except FileNotFoundError:
+                continue  # rotated away between listdir and read
+            except CheckpointCorruptError as e:
+                last_error = e
+                broadcast("checkpoint_corrupt", path=path,
+                          detail=e.detail, fallback=True)
+                continue
+            self._verified.add(s)
+            if s != steps[-1]:
+                broadcast("checkpoint_fallback", path=path, step=s,
+                          skipped=[x for x in steps if x > s])
+            return s, state
+        raise last_error if last_error is not None else FileNotFoundError(
+            f"no checkpoints in {self.directory}")
+
+    def meta(self, step: Optional[int] = None) -> Dict[str, Any]:
+        """The ``meta`` dict of a checkpoint (default: latest step) —
+        run-id chaining reads this without materialising the state."""
         if step is None:
             step = self.latest_step()
             if step is None:
-                raise FileNotFoundError(f"no checkpoints in {self.directory}")
-        return restore_state(self._path(step))
+                raise FileNotFoundError(
+                    f"no checkpoints in {self.directory}")
+        return checkpoint_meta(self._path(step))
 
     def clear(self) -> None:
         shutil.rmtree(self.directory, ignore_errors=True)
+        self._verified.clear()
         os.makedirs(self.directory, exist_ok=True)

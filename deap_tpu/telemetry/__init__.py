@@ -45,6 +45,7 @@ from deap_tpu.telemetry.probes import (
     FrontProbe,
     HealthMonitor,
     Probe,
+    QuarantineProbe,
     SelectionProbe,
     TreeDiversityProbe,
     compose_probes,
@@ -64,6 +65,7 @@ __all__ = [
     "SelectionProbe",
     "FrontProbe",
     "HealthMonitor",
+    "QuarantineProbe",
     "RunJournal",
     "RunTelemetry",
     "broadcast",

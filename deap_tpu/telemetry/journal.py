@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -130,9 +131,17 @@ class RunJournal:
             journal.summary(gens=100)
     """
 
-    def __init__(self, path: str, run_id: Optional[str] = None):
+    def __init__(self, path: str, run_id: Optional[str] = None,
+                 fsync_every: Optional[int] = None):
+        """``fsync_every=n`` opts into durability: every n-th row the
+        file is fsync'd, so a killed run loses at most n-1 rows (the
+        default flush-only policy can lose the whole OS-buffered tail).
+        The torn-tail tolerance of :func:`read_journal` composes with
+        it — a kill mid-``write`` still tears at most the final line."""
         self.path = str(path)
         self.run_id = run_id or hex(int(time.time() * 1e6))[2:]
+        self.fsync_every = int(fsync_every) if fsync_every else None
+        self._rows_since_sync = 0
         self._t0 = time.time()
         self._fh = open(self.path, "w")
         self._steady: Optional[str] = None
@@ -152,6 +161,11 @@ class RunJournal:
         line.update(payload)
         self._fh.write(json.dumps(line) + "\n")
         self._fh.flush()
+        if self.fsync_every:
+            self._rows_since_sync += 1
+            if self._rows_since_sync >= self.fsync_every:
+                os.fsync(self._fh.fileno())
+                self._rows_since_sync = 0
 
     # ----------------------------------------------------------- events ----
 
@@ -224,6 +238,11 @@ class RunJournal:
             if self in _ACTIVE:
                 _ACTIVE.remove(self)
         self._closed = True
+        if self.fsync_every and self._rows_since_sync:
+            try:
+                os.fsync(self._fh.fileno())
+            except OSError:
+                pass
         self._fh.close()
 
     def __enter__(self) -> "RunJournal":

@@ -324,6 +324,48 @@ class FitnessProbe(Probe):
         return mstate
 
 
+# ================================================ quarantine counting ====
+
+@register_probe
+class QuarantineProbe(Probe):
+    """Count fitness rows quarantined by
+    :func:`deap_tpu.resilience.quarantine_non_finite` — the wrapper
+    substitutes a sentinel ``penalty`` for NaN/Inf evaluations, and
+    this probe counts sentinel rows in the post-step population so
+    the poisoning stays visible in the journal after the substitution
+    hid it from ``isfinite``.
+
+    - ``quarantined`` — rows at the sentinel this generation (a spike
+      means the evaluator is emitting non-finite fitness *now*).
+    - ``quarantined_total`` — cumulative count over the run.
+
+    A nonzero ``quarantined`` row fires the HealthMonitor's existing
+    ``non_finite`` alarm (the alarm the sentinel would otherwise
+    silence). ``penalty`` must match the wrapper's.
+    """
+
+    metric_names = ("quarantined", "quarantined_total")
+
+    def __init__(self, penalty: Optional[float] = None):
+        if penalty is None:
+            from deap_tpu.resilience.engine import QUARANTINE_PENALTY
+            penalty = QUARANTINE_PENALTY
+        self.penalty = float(penalty)
+
+    def declare(self, meter) -> None:
+        meter.gauge("quarantined", dtype=jnp.int32)
+        meter.counter("quarantined_total")
+
+    def __call__(self, meter, mstate, pop=None, **_ctx):
+        if pop is None:
+            return mstate
+        hit = jnp.any(pop.fitness == jnp.float32(self.penalty), axis=-1)
+        n = jnp.sum(hit & pop.valid).astype(jnp.int32)
+        mstate = meter.set(mstate, "quarantined", n)
+        mstate = meter.inc(mstate, "quarantined_total", n)
+        return mstate
+
+
 # ================================================= selection pressure ====
 
 @register_probe
@@ -670,8 +712,16 @@ class HealthMonitor:
         if self.nan_check:
             bad = [k for k, v in row.items()
                    if isinstance(v, float) and not math.isfinite(v)]
+            # quarantined evaluations were substituted with a finite
+            # sentinel (resilience.quarantine_non_finite) — the probe's
+            # count keeps the non-finite origin visible to this alarm
+            nq = row.get("quarantined", 0)
+            if isinstance(nq, (int, float)) and nq > 0:
+                bad = bad + ["quarantined"]
             if bad:
-                fired.append(self._fire("non_finite", gen, metrics=bad))
+                fired.append(self._fire(
+                    "non_finite", gen, metrics=bad,
+                    **({"quarantined": int(nq)} if nq else {})))
 
         if self.clone_rate_max is not None:
             cr = self._clone_rate(row)

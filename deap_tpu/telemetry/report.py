@@ -154,6 +154,51 @@ def render_report(path: str, lines: Optional[List[str]] = None) -> str:
                        f"min={_fmt(min(vals))} max={_fmt(max(vals))} "
                        f"last={_fmt(vals[-1])}")
 
+    # ------------------------------------------- resilience timeline ----
+    segs = [e for e in events if e.get("kind") == "segment"]
+    resumed = [e for e in events if e.get("kind") == "resumed"]
+    preempted = [e for e in events if e.get("kind") == "preempted"]
+    degraded = [e for e in events if e.get("kind") == "degraded"]
+    corrupt = [e for e in events
+               if e.get("kind") == "checkpoint_corrupt"]
+    quarantine = [e for e in events if e.get("kind") == "quarantine"]
+    if segs or resumed or preempted or degraded or corrupt:
+        out.append("")
+        out.append("## Resilience (segments / recoveries)")
+        out.append("")
+        if resumed:
+            # run-id chaining: each resume names the run it continues,
+            # so a preempted run's journals stitch into one timeline
+            chain = " → ".join(
+                [str(resumed[0].get("resumed_from"))]
+                + [str(e.get("run_id")) for e in resumed])
+            out.append(f"- run chain: {chain}")
+            for e in resumed:
+                out.append(f"- resumed at gen {e.get('step')} from run "
+                           f"{e.get('resumed_from')}")
+        if segs:
+            lo = min(e.get("lo", 0) for e in segs)
+            hi = max(e.get("hi", 0) for e in segs)
+            out.append(f"- {len(segs)} segment(s) covering gens "
+                       f"[{lo}, {hi}]")
+        for e in preempted:
+            out.append(f"- ▲ **preempted** at gen {e.get('step')} "
+                       f"(signal {e.get('signum')}) — checkpoint saved, "
+                       "clean exit")
+        for e in degraded:
+            out.append(
+                f"- ▲ **degraded** segment [{e.get('lo')}, "
+                f"{e.get('hi')}): {e.get('error_kind')} attempt "
+                f"{e.get('attempt')}, backoff {e.get('backoff_s')}s"
+                + (f", action: {e['action']}" if e.get("action") else ""))
+        for e in corrupt:
+            out.append(f"- ▲ **corrupt checkpoint** skipped: "
+                       f"{os.path.basename(str(e.get('path', '?')))}")
+        if quarantine:
+            total = sum(e.get("n", 0) for e in quarantine)
+            out.append(f"- {total} non-finite evaluation(s) quarantined "
+                       f"across {len(quarantine)} event(s)")
+
     hv = [e for e in events if e.get("kind") == "hv_exact"]
     if hv:
         out.append("")

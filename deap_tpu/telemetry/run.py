@@ -67,6 +67,9 @@ class RunTelemetry:
         generation, so off by default.
     :param spans: install a :class:`SpanRecorder` while the context is
         active (default True).
+    :param fsync_every: when this context owns the journal, its
+        durability policy: fsync every n rows, so a killed run loses at
+        most n-1 buffered rows (see :class:`RunJournal`).
     :param health: a :class:`~deap_tpu.telemetry.probes.HealthMonitor`;
         every decoded meter row (live-streamed, host-recorded or
         post-scan) runs through its tripwires and each alarm lands in
@@ -77,12 +80,12 @@ class RunTelemetry:
     def __init__(self, journal, meter: Optional[Meter] = None,
                  probe: Optional[Callable] = None, stream: bool = False,
                  spans: bool = True, init_backend: bool = True,
-                 health=None):
+                 health=None, fsync_every: Optional[int] = None):
         if isinstance(journal, RunJournal):
             self.journal = journal
             self._owns_journal = False
         else:
-            self.journal = RunJournal(journal)
+            self.journal = RunJournal(journal, fsync_every=fsync_every)
             self._owns_journal = True
         self.meter = meter if meter is not None else Meter()
         self.probe = probe

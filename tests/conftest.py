@@ -47,6 +47,11 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if item.nodeid in slow_ids and "slow" not in item.keywords:
             item.add_marker(pytest.mark.slow)
+        if "chaos" in item.keywords and "slow" not in item.keywords:
+            # chaos (fault-injection) tests ride the slow tier: they
+            # re-run whole evolutions per fault plan. `-m chaos`
+            # still selects exactly them.
+            item.add_marker(pytest.mark.slow)
         if "slow" not in item.keywords:
             item.add_marker(pytest.mark.fast)
 

@@ -1,0 +1,273 @@
+"""Chaos suite — deterministic fault plans against every recovery path.
+
+Every test runs a full evolution twice: once uninterrupted, once under
+an injected failure schedule (hard kill before/after the checkpoint
+lands, corrupted-latest-checkpoint, simulated preemption, combined
+plans) followed by a resume — and pins the recovered result
+**bit-identical** to the uninterrupted one. Marked ``chaos`` (which the
+conftest folds into the slow tier): select with ``pytest -m chaos``.
+"""
+
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deap_tpu import algorithms, ops
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import init_population
+from deap_tpu.core.toolbox import Toolbox
+from deap_tpu.resilience import (
+    CorruptCheckpoint,
+    FaultPlan,
+    InjectedCrash,
+    KillAt,
+    Preempted,
+    PreemptAt,
+    ResilientRun,
+)
+from deap_tpu.telemetry import RunTelemetry, read_journal
+
+pytestmark = pytest.mark.chaos
+
+NGEN = 9
+SEG = 2
+
+
+def _toolbox():
+    tb = Toolbox()
+    tb.register("evaluate", lambda g: g.sum(-1).astype(jnp.float32))
+    tb.register("mate", ops.cx_two_point)
+    tb.register("mutate", ops.mut_flip_bit, indpb=0.1)
+    tb.register("select", ops.sel_tournament, tournsize=3)
+    return tb
+
+
+def _pop(n=64, length=16, seed=0):
+    return init_population(jax.random.key(seed), n,
+                           ops.bernoulli_genome(length),
+                           FitnessSpec((1.0,)))
+
+
+def _assert_pop_equal(a, b):
+    np.testing.assert_array_equal(np.asarray(a.genomes),
+                                  np.asarray(b.genomes))
+    np.testing.assert_array_equal(np.asarray(a.fitness),
+                                  np.asarray(b.fitness))
+
+
+def _mono(tb, pop, key):
+    return algorithms.ea_simple(key, pop, tb, 0.5, 0.2, ngen=NGEN,
+                                halloffame_size=4)
+
+
+@pytest.mark.parametrize("when", ["before_save", "after_save"])
+def test_hard_kill_then_resume_bit_exact(tmp_path, when):
+    """Hard kill at gen 6 — before the segment's checkpoint lands
+    (that segment's work is lost, resume replays it) and after (resume
+    continues from it). Both recover bit-exactly."""
+    tb, pop, key = _toolbox(), _pop(), jax.random.key(21)
+    p1, lb1, h1 = _mono(tb, pop, key)
+    d = str(tmp_path / "ck")
+    with pytest.raises(InjectedCrash):
+        ResilientRun(d, segment_len=SEG,
+                     fault_plan=FaultPlan([KillAt(6, when=when)])
+                     ).ea_simple(key, pop, tb, 0.5, 0.2, ngen=NGEN,
+                                 halloffame_size=4)
+    # the crash left a checkpoint at gen 4 (before_save) or 6 (after)
+    ck = ResilientRun(d, segment_len=SEG)
+    assert ck.ckpt.latest_step() == (4 if when == "before_save" else 6)
+    p2, lb2, h2 = ck.ea_simple(key, pop, tb, 0.5, 0.2, ngen=NGEN,
+                               halloffame_size=4)
+    _assert_pop_equal(p1, p2)
+    np.testing.assert_array_equal(np.asarray(h1.fitness),
+                                  np.asarray(h2.fitness))
+    assert [r["nevals"] for r in lb1] == [r["nevals"] for r in lb2]
+
+
+def test_corrupted_latest_checkpoint_falls_back(tmp_path):
+    """The latest checkpoint is byte-corrupted after it lands, then the
+    process dies; resume must detect the CRC mismatch, journal it, fall
+    back to the previous valid step, replay — and still end
+    bit-exact."""
+    tb, pop, key = _toolbox(), _pop(), jax.random.key(22)
+    p1, _, _ = _mono(tb, pop, key)
+    d = str(tmp_path / "ck")
+    with pytest.raises(InjectedCrash):
+        ResilientRun(d, segment_len=SEG,
+                     fault_plan=FaultPlan(
+                         [CorruptCheckpoint(6, mode="flip")])
+                     ).ea_simple(key, pop, tb, 0.5, 0.2, ngen=NGEN,
+                                 halloffame_size=4)
+    jpath = str(tmp_path / "resume.jsonl")
+    with RunTelemetry(jpath) as tel:
+        res = ResilientRun(d, segment_len=SEG, telemetry=tel)
+        p2, _, _ = res.ea_simple(key, pop, tb, 0.5, 0.2, ngen=NGEN,
+                                 halloffame_size=4)
+    _assert_pop_equal(p1, p2)
+    rows = read_journal(jpath)
+    kinds = [r["kind"] for r in rows]
+    assert "checkpoint_corrupt" in kinds  # the detection is visible
+    resumed = [r for r in rows if r["kind"] == "resumed"]
+    assert resumed and resumed[0]["step"] == 4  # fell back past gen 6
+
+
+def test_corrupted_latest_truncated_falls_back(tmp_path):
+    tb, pop, key = _toolbox(), _pop(), jax.random.key(23)
+    p1, _, _ = _mono(tb, pop, key)
+    d = str(tmp_path / "ck")
+    with pytest.raises(InjectedCrash):
+        ResilientRun(d, segment_len=SEG,
+                     fault_plan=FaultPlan(
+                         [CorruptCheckpoint(4, mode="truncate")])
+                     ).ea_simple(key, pop, tb, 0.5, 0.2, ngen=NGEN,
+                                 halloffame_size=4)
+    p2, _, _ = ResilientRun(d, segment_len=SEG).ea_simple(
+        key, pop, tb, 0.5, 0.2, ngen=NGEN, halloffame_size=4)
+    _assert_pop_equal(p1, p2)
+
+
+def test_double_preemption_chain(tmp_path):
+    """Two SIGTERMs across three processes: preempt at gen 2, resume,
+    preempt again at gen 6, resume, finish — the run-id chain links all
+    three and the result is bit-exact."""
+    tb, pop, key = _toolbox(), _pop(), jax.random.key(24)
+    p1, _, _ = _mono(tb, pop, key)
+    d = str(tmp_path / "ck")
+    ids = []
+    r1 = ResilientRun(d, segment_len=SEG,
+                      fault_plan=FaultPlan([PreemptAt(2)]))
+    with pytest.raises(Preempted):
+        r1.ea_simple(key, pop, tb, 0.5, 0.2, ngen=NGEN,
+                     halloffame_size=4)
+    ids.append(r1.run_id)
+    r2 = ResilientRun(d, segment_len=SEG,
+                      fault_plan=FaultPlan([PreemptAt(6)]))
+    with pytest.raises(Preempted) as exc:
+        r2.ea_simple(key, pop, tb, 0.5, 0.2, ngen=NGEN,
+                     halloffame_size=4)
+    assert exc.value.step == 6
+    assert r2.resumed_from == ids[0]
+    r3 = ResilientRun(d, segment_len=SEG)
+    p2, _, _ = r3.ea_simple(key, pop, tb, 0.5, 0.2, ngen=NGEN,
+                            halloffame_size=4)
+    assert r3.resumed_from == r2.run_id
+    _assert_pop_equal(p1, p2)
+
+
+def test_sigint_also_preempts(tmp_path):
+    tb, pop, key = _toolbox(), _pop(), jax.random.key(25)
+    d = str(tmp_path / "ck")
+    with pytest.raises(Preempted) as exc:
+        ResilientRun(d, segment_len=SEG,
+                     fault_plan=FaultPlan(
+                         [PreemptAt(4, signum=signal.SIGINT)])
+                     ).ea_simple(key, pop, tb, 0.5, 0.2, ngen=NGEN)
+    assert exc.value.signum == signal.SIGINT
+    assert os.path.exists(exc.value.path)
+
+
+def test_gp_loop_kill_and_corrupt_chain(tmp_path):
+    """The GP host engine under a combined plan: corrupt the gen-4
+    checkpoint, crash, resume (falls back to gen 2, replays), finish —
+    bit-exact against the uninterrupted run."""
+    import deap_tpu.gp as gp
+    from deap_tpu.gp.loop import make_symbreg_loop
+
+    ps = gp.math_set(n_args=1)
+    X = jnp.linspace(-1.0, 1.0, 32, endpoint=False)[:, None]
+    y = X[:, 0] ** 3 + X[:, 0]
+    genomes = jax.vmap(gp.gen_half_and_half(ps, 48, 1, 2))(
+        jax.random.split(jax.random.key(3), 128))
+    run = make_symbreg_loop(ps, 48, X, y, height_limit=6)
+    r1 = run(jax.random.key(9), genomes, NGEN)
+    d = str(tmp_path / "ck")
+    with pytest.raises(InjectedCrash):
+        ResilientRun(d, segment_len=SEG,
+                     fault_plan=FaultPlan(
+                         [CorruptCheckpoint(4, mode="flip")])).gp_loop(
+            make_symbreg_loop(ps, 48, X, y, height_limit=6),
+            jax.random.key(9), genomes, NGEN)
+    r2 = ResilientRun(d, segment_len=SEG).gp_loop(
+        make_symbreg_loop(ps, 48, X, y, height_limit=6),
+        jax.random.key(9), genomes, NGEN)
+    np.testing.assert_array_equal(np.asarray(r1["fitness"]),
+                                  np.asarray(r2["fitness"]))
+    for k in ("nodes", "consts", "length"):
+        np.testing.assert_array_equal(np.asarray(r1["genomes"][k]),
+                                      np.asarray(r2["genomes"][k]))
+    assert r1["nevals"] == r2["nevals"]
+
+
+def test_island_kill_then_resume(tmp_path):
+    from deap_tpu.parallel import island_init, make_island_step
+
+    tb = _toolbox()
+    pops = island_init(jax.random.key(2), 4, 32,
+                       ops.bernoulli_genome(16), FitnessSpec((1.0,)))
+    pops = jax.vmap(lambda p: algorithms.evaluate_invalid(
+        p, tb.evaluate))(pops)
+    step = make_island_step(tb, cxpb=0.5, mutpb=0.2, freq=2, mig_k=1)
+    key = jax.random.key(7)
+    ref = pops
+    for epoch in range(6):
+        ref = step(jax.random.fold_in(key, epoch), ref)
+    d = str(tmp_path / "ck")
+    with pytest.raises(InjectedCrash):
+        ResilientRun(d, segment_len=2,
+                     fault_plan=FaultPlan([KillAt(4)])).island_run(
+            step, key, pops, 6)
+    got = ResilientRun(d, segment_len=2).island_run(step, key, pops, 6)
+    _assert_pop_equal(ref, got)
+
+
+def test_mu_plus_lambda_kill_then_resume(tmp_path):
+    tb, pop, key = _toolbox(), _pop(), jax.random.key(26)
+    p1, lb1, _ = algorithms.ea_mu_plus_lambda(
+        key, pop, tb, 64, 128, 0.4, 0.3, ngen=NGEN)
+    d = str(tmp_path / "ck")
+    with pytest.raises(InjectedCrash):
+        ResilientRun(d, segment_len=SEG,
+                     fault_plan=FaultPlan([KillAt(6)])
+                     ).ea_mu_plus_lambda(key, pop, tb, 64, 128, 0.4,
+                                         0.3, ngen=NGEN)
+    p2, lb2, _ = ResilientRun(d, segment_len=SEG).ea_mu_plus_lambda(
+        key, pop, tb, 64, 128, 0.4, 0.3, ngen=NGEN)
+    _assert_pop_equal(p1, p2)
+    assert [r["nevals"] for r in lb1] == [r["nevals"] for r in lb2]
+
+
+def test_generate_update_kill_then_resume(tmp_path):
+    from deap_tpu.strategies import cma
+
+    strat = cma.Strategy(centroid=[0.0] * 6, sigma=0.5)
+    tb = Toolbox()
+    tb.register("generate", strat.generate)
+    tb.register("update", strat.update)
+    tb.register("evaluate", lambda g: -jnp.sum(g ** 2, axis=-1))
+    key = jax.random.key(27)
+    s1, lb1, _ = algorithms.ea_generate_update(
+        key, strat.initial_state(), tb, ngen=NGEN, spec=strat.spec)
+    d = str(tmp_path / "ck")
+    with pytest.raises(InjectedCrash):
+        ResilientRun(d, segment_len=SEG,
+                     fault_plan=FaultPlan([KillAt(6)])
+                     ).ea_generate_update(key, strat.initial_state(),
+                                          tb, ngen=NGEN,
+                                          spec=strat.spec)
+    s2, lb2, _ = ResilientRun(d, segment_len=SEG).ea_generate_update(
+        key, strat.initial_state(), tb, ngen=NGEN, spec=strat.spec)
+    for a, b in zip(jax.tree_util.tree_leaves(s1),
+                    jax.tree_util.tree_leaves(s2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_chaos_marker_rides_slow_tier(request):
+    """This file's tests must be excluded from `-m "not slow"` (the
+    tier-1 gate) and selected by `-m chaos` — the conftest folds the
+    chaos marker into the slow tier."""
+    assert "chaos" in request.node.keywords
+    assert "slow" in request.node.keywords

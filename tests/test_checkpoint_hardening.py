@@ -1,0 +1,323 @@
+"""Crash-consistent checkpoint format + Checkpointer robustness.
+
+The hardened format (per-leaf CRC32, format version, fsync-before-
+rename) must detect every byte-level corruption instead of restoring
+silently-wrong state; the Checkpointer must fall back past corrupt
+files to the newest valid step, never rotate away the last verified-
+good snapshot, and survive its directory being removed under a live
+run. Round-trip coverage spans every state family the resilience
+driver snapshots: strategy states (CMA / (1+λ) / MO-CMA), GP
+concrete-genome populations with depth arrays, island-sharded pytrees,
+and Meter/probe carries.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deap_tpu import ops
+from deap_tpu.algorithms import evaluate_invalid
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import init_population
+from deap_tpu.resilience.faultinject import corrupt_file
+from deap_tpu.support import (
+    CheckpointCorruptError,
+    Checkpointer,
+    checkpoint_meta,
+    restore_state,
+    save_state,
+    verify_checkpoint,
+)
+
+
+def _assert_tree_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ------------------------------------------------------- PRNG impl fix ----
+
+@pytest.mark.parametrize("impl", ["threefry2x32", "rbg"])
+def test_prng_key_impl_roundtrips_both_impls(tmp_path, impl):
+    """The impl name is stored canonically at pack time (no repr
+    parsing) and must round-trip for every typed-key impl."""
+    key = jax.random.key(123, impl=impl)
+    path = str(tmp_path / "k.pkl")
+    save_state(path, {"key": key})
+    out = restore_state(path)["key"]
+    assert jnp.issubdtype(out.dtype, jax.dtypes.prng_key)
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(out)),
+        np.asarray(jax.random.key_data(key)))
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.uniform(out, (4,))),
+        np.asarray(jax.random.uniform(key, (4,))))
+
+
+def test_legacy_v1_payload_still_restores(tmp_path):
+    """Files written by the pre-CRC format (plain {leaves, treedef})
+    keep restoring — old runs must stay resumable."""
+    import pickle
+
+    key = jax.random.key(7)
+    leaves, treedef = jax.tree_util.tree_flatten(
+        {"a": jnp.arange(5), "n": 3})
+    payload = {"leaves": [np.asarray(l) if isinstance(l, jax.Array)
+                          else l for l in leaves],
+               "treedef": treedef}
+    path = str(tmp_path / "v1.pkl")
+    with open(path, "wb") as f:
+        pickle.dump(payload, f)
+    out = restore_state(path)
+    assert out["n"] == 3
+    np.testing.assert_array_equal(np.asarray(out["a"]), np.arange(5))
+    del key
+
+
+# --------------------------------------------------- corruption paths ----
+
+def test_crc_detects_flipped_bytes(tmp_path):
+    path = str(tmp_path / "s.pkl")
+    save_state(path, {"x": jnp.arange(4096, dtype=jnp.float32)})
+    verify_checkpoint(path)  # pristine file verifies
+    corrupt_file(path, mode="flip")
+    with pytest.raises(CheckpointCorruptError):
+        restore_state(path)
+    with pytest.raises(CheckpointCorruptError):
+        verify_checkpoint(path)
+
+
+def test_truncated_file_detected(tmp_path):
+    path = str(tmp_path / "s.pkl")
+    save_state(path, {"x": jnp.arange(4096, dtype=jnp.int32)})
+    corrupt_file(path, mode="truncate", offset=-128)
+    with pytest.raises(CheckpointCorruptError):
+        restore_state(path)
+
+
+def test_restore_falls_back_to_newest_valid_step(tmp_path):
+    ckpt = Checkpointer(str(tmp_path / "c"), keep=4)
+    for s in range(4):
+        ckpt.save(s, {"s": jnp.asarray(s)})
+    # corrupt the two newest files; restore must fall back to step 1
+    corrupt_file(ckpt._path(3), mode="flip")
+    corrupt_file(ckpt._path(2), mode="truncate", offset=-64)
+    state = ckpt.restore()
+    assert int(state["s"]) == 1
+    step, state2 = ckpt.restore_latest()
+    assert step == 1 and int(state2["s"]) == 1
+    # an explicit step never falls back — it raises
+    with pytest.raises(CheckpointCorruptError):
+        ckpt.restore(3)
+
+
+def test_all_corrupt_raises(tmp_path):
+    ckpt = Checkpointer(str(tmp_path / "c"), keep=3)
+    ckpt.save(0, {"s": 0})
+    corrupt_file(ckpt._path(0), mode="flip")
+    with pytest.raises(CheckpointCorruptError):
+        ckpt.restore()
+
+
+def test_rotation_never_deletes_last_verified_good(tmp_path,
+                                                   monkeypatch):
+    """A save whose own post-write verification fails must rotate
+    nothing: deleting by count alone could remove the only good
+    snapshot."""
+    import deap_tpu.support.checkpoint as cp
+
+    ckpt = Checkpointer(str(tmp_path / "c"), keep=1)
+    ckpt.save(0, {"s": 0})
+    assert ckpt.steps() == [0]
+
+    real_save = cp.save_state
+
+    def broken_save(path, state, meta=None):
+        real_save(path, state, meta=meta)
+        corrupt_file(path, mode="flip")  # disk fault on the new file
+
+    monkeypatch.setattr(cp, "save_state", broken_save)
+    ckpt.save(1, {"s": 1})
+    monkeypatch.undo()
+    # keep=1 would normally leave only step 1 — but step 1 is bad, so
+    # step 0 (the last verified-good checkpoint) must survive
+    assert 0 in ckpt.steps()
+    assert int(ckpt.restore()["s"]) == 0
+    # a later healthy save rotates normally again
+    ckpt.save(2, {"s": 2})
+    assert int(ckpt.restore()["s"]) == 2
+
+
+def test_steps_empty_when_directory_removed(tmp_path):
+    """Directory removed out from under a live run: steps()/
+    latest_step() degrade to empty, restore() raises a clear error."""
+    import shutil
+
+    d = str(tmp_path / "gone")
+    ckpt = Checkpointer(d, keep=2)
+    ckpt.save(0, {"s": 0})
+    shutil.rmtree(d)
+    assert ckpt.steps() == []
+    assert ckpt.latest_step() is None
+    assert ckpt.restore_latest() is None
+    with pytest.raises(FileNotFoundError, match="gone"):
+        ckpt.restore()
+    with pytest.raises(FileNotFoundError, match="step 0"):
+        ckpt.restore(0)
+
+
+def test_meta_roundtrip_without_state(tmp_path):
+    path = str(tmp_path / "m.pkl")
+    save_state(path, {"x": jnp.zeros(8)},
+               meta={"run_id": "abc123", "step": 7})
+    assert checkpoint_meta(path) == {"run_id": "abc123", "step": 7}
+    ckpt = Checkpointer(str(tmp_path / "c"))
+    ckpt.save(3, {"x": 1}, meta={"run_id": "zzz"})
+    assert ckpt.meta()["run_id"] == "zzz"
+
+
+def test_checkpoint_event_broadcast(tmp_path):
+    """save_state surfaces a ``checkpoint`` event in any open journal."""
+    from deap_tpu.telemetry import RunJournal, read_journal
+
+    jpath = str(tmp_path / "j.jsonl")
+    with RunJournal(jpath) as j:
+        save_state(str(tmp_path / "s.pkl"), {"x": 1})
+        del j
+    kinds = [r["kind"] for r in read_journal(jpath)]
+    assert "checkpoint" in kinds
+
+
+# ------------------------------------------- state-family round trips ----
+
+def test_roundtrip_cma_state(tmp_path):
+    from deap_tpu.strategies import cma
+
+    strat = cma.Strategy(centroid=[0.5] * 8, sigma=0.3)
+    state = strat.initial_state()
+    # advance once so the state is not all-zeros
+    genomes = strat.generate(jax.random.key(0), state)
+    values = -jnp.sum(genomes ** 2, axis=-1, keepdims=True)
+    state = strat.update(state, genomes, values)
+    path = str(tmp_path / "cma.pkl")
+    save_state(path, state)
+    _assert_tree_equal(state, restore_state(path))
+
+
+def test_roundtrip_one_plus_lambda_state(tmp_path):
+    from deap_tpu.strategies import cma
+
+    strat = cma.StrategyOnePlusLambda(
+        parent=jnp.zeros(6), parent_fitness=[1.0], sigma=0.4, lambda_=8)
+    state = strat.initial_state()
+    path = str(tmp_path / "opl.pkl")
+    save_state(path, state)
+    _assert_tree_equal(state, restore_state(path))
+
+
+def test_roundtrip_mo_cma_state(tmp_path):
+    from deap_tpu.strategies import cma
+
+    pop = jax.random.uniform(jax.random.key(1), (8, 5))
+    fits = jax.random.uniform(jax.random.key(2), (8, 2))
+    strat = cma.StrategyMultiObjective(pop, fits, sigma=0.3, mu=4,
+                                       lambda_=4)
+    state = strat.initial_state()
+    path = str(tmp_path / "mo.pkl")
+    save_state(path, state)
+    _assert_tree_equal(state, restore_state(path))
+
+
+def test_roundtrip_gp_population_with_depths(tmp_path):
+    import deap_tpu.gp as gp
+    from deap_tpu.gp.tree import prefix_depths
+
+    ps = gp.math_set(n_args=1)
+    genomes = jax.vmap(gp.gen_half_and_half(ps, 48, 1, 3))(
+        jax.random.split(jax.random.key(4), 64))
+    arity = ps.arity_table()
+    depths = jax.vmap(lambda g: prefix_depths(
+        g["nodes"], g["length"], arity))(genomes)
+    state = {"genomes": genomes, "depths": depths,
+             "nevals": [64, 10, 12]}
+    path = str(tmp_path / "gp.pkl")
+    save_state(path, state)
+    out = restore_state(path)
+    _assert_tree_equal(state["genomes"], out["genomes"])
+    np.testing.assert_array_equal(np.asarray(depths),
+                                  np.asarray(out["depths"]))
+    assert out["nevals"] == [64, 10, 12]
+
+
+def test_roundtrip_island_stacked_population(tmp_path):
+    from deap_tpu.parallel import island_init
+
+    pops = island_init(jax.random.key(5), 4, 16,
+                       ops.bernoulli_genome(12), FitnessSpec((1.0,)))
+    pops = jax.vmap(lambda p: evaluate_invalid(
+        p, lambda g: g.sum(-1).astype(jnp.float32)))(pops)
+    path = str(tmp_path / "isl.pkl")
+    save_state(path, {"pops": pops, "epoch": 3})
+    out = restore_state(path)
+    assert out["epoch"] == 3
+    _assert_tree_equal(pops, out["pops"])
+
+
+def test_roundtrip_meter_and_probe_carry(tmp_path):
+    """The Meter state the loops thread as carry — including probe
+    ``internal`` gauges (FitnessProbe's previous-best, stagnation) —
+    must survive a checkpoint so a resumed run's telemetry continues
+    rather than restarting."""
+    from deap_tpu.telemetry import Meter
+    from deap_tpu.telemetry.probes import FitnessProbe
+
+    meter = Meter()
+    meter.counter("nevals")
+    meter.gauge("best")
+    probe = FitnessProbe()
+    probe.declare(meter)
+    ms = meter.init()
+    ms = meter.inc(ms, "nevals", 42)
+    ms = meter.set(ms, "best", 7.5)
+    pop = init_population(jax.random.key(0), 32,
+                          ops.bernoulli_genome(8), FitnessSpec((1.0,)))
+    pop = evaluate_invalid(pop, lambda g: g.sum(-1).astype(jnp.float32))
+    ms = probe(meter, ms, pop=pop)
+    path = str(tmp_path / "meter.pkl")
+    save_state(path, {"mstate": ms})
+    out = restore_state(path)["mstate"]
+    _assert_tree_equal(ms, out)
+    # a second probe application on the restored carry behaves
+    # identically to one on the live carry (stagnation continuity)
+    _assert_tree_equal(probe(meter, ms, pop=pop),
+                       probe(meter, out, pop=pop))
+
+
+def test_fsync_every_journal_policy(tmp_path):
+    """RunJournal(fsync_every=n): rows are fsync'd in batches of n, a
+    torn tail appended by a killed writer still parses via
+    read_journal's tolerance, and offsets line up."""
+    from deap_tpu.telemetry import RunJournal, read_journal
+
+    jpath = str(tmp_path / "j.jsonl")
+    j = RunJournal(jpath, fsync_every=2)
+    for i in range(5):
+        j.event("tick", i=i)
+    # the file on disk already holds every flushed row
+    rows = read_journal(jpath)
+    assert [r["i"] for r in rows if r["kind"] == "tick"] == list(range(5))
+    j.close()
+    # simulate a kill mid-write: append a torn (newline-less) line
+    with open(jpath, "a") as fh:
+        fh.write('{"t": 1.0, "kind": "tick", "i": 99')
+    rows = read_journal(jpath)
+    assert rows.tear_offset is not None
+    assert [r["i"] for r in rows if r["kind"] == "tick"] == list(range(5))
+    with pytest.raises(ValueError):
+        read_journal(jpath, strict=True)

@@ -1,0 +1,422 @@
+"""ResilientRun — segmented/checkpointed execution pinned bit-exact.
+
+The acceptance bar of the resilience layer: for every loop family
+(the four ``algorithms.py`` scans, the GP host engine, the island
+epoch driver), a run chunked into segments with checkpoints between
+them — including one interrupted and resumed from disk — produces
+populations/logbooks/hofs bit-identical to the uninterrupted monolithic
+run. Plus: transient-error retry/backoff with ``degraded`` journaling,
+fatal errors propagating unretried, SIGTERM preemption honoured at the
+segment boundary, and the non-finite quarantine wrapper. The heavier
+fault matrices live in ``tests/test_chaos.py`` (``-m chaos``).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deap_tpu import algorithms, ops
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import init_population
+from deap_tpu.core.toolbox import Toolbox
+from deap_tpu.resilience import (
+    QUARANTINE_PENALTY,
+    FailSegments,
+    FaultPlan,
+    Preempted,
+    PreemptAt,
+    ResilientRun,
+    RetryPolicy,
+    classify_error,
+    nan_inject_evaluate,
+    quarantine_non_finite,
+)
+from deap_tpu.telemetry import RunTelemetry, read_journal
+
+NGEN = 7
+SEG = 3  # deliberately not dividing NGEN: last segment is short
+
+
+def _toolbox():
+    tb = Toolbox()
+    tb.register("evaluate", lambda g: g.sum(-1).astype(jnp.float32))
+    tb.register("mate", ops.cx_two_point)
+    tb.register("mutate", ops.mut_flip_bit, indpb=0.1)
+    tb.register("select", ops.sel_tournament, tournsize=3)
+    return tb
+
+
+def _pop(n=64, length=16, seed=0):
+    return init_population(jax.random.key(seed), n,
+                           ops.bernoulli_genome(length),
+                           FitnessSpec((1.0,)))
+
+
+def _assert_pop_equal(a, b):
+    np.testing.assert_array_equal(np.asarray(a.genomes),
+                                  np.asarray(b.genomes))
+    np.testing.assert_array_equal(np.asarray(a.fitness),
+                                  np.asarray(b.fitness))
+    np.testing.assert_array_equal(np.asarray(a.valid),
+                                  np.asarray(b.valid))
+
+
+def _assert_logbook_equal(a, b):
+    assert len(a) == len(b)
+    for ra, rb in zip(a, b):
+        assert set(ra) == set(rb)
+        for k in ra:
+            np.testing.assert_array_equal(np.asarray(ra[k]),
+                                          np.asarray(rb[k]))
+
+
+# ------------------------------------------------ scan-loop families ----
+
+def test_segmented_ea_simple_bit_exact(tmp_path):
+    tb, pop, key = _toolbox(), _pop(), jax.random.key(1)
+    p1, lb1, h1 = algorithms.ea_simple(key, pop, tb, 0.5, 0.2,
+                                       ngen=NGEN, halloffame_size=4)
+    res = ResilientRun(str(tmp_path / "ck"), segment_len=SEG)
+    p2, lb2, h2 = res.ea_simple(key, pop, tb, 0.5, 0.2, ngen=NGEN,
+                                halloffame_size=4)
+    _assert_pop_equal(p1, p2)
+    _assert_logbook_equal(lb1, lb2)
+    np.testing.assert_array_equal(np.asarray(h1.fitness),
+                                  np.asarray(h2.fitness))
+    np.testing.assert_array_equal(np.asarray(h1.genomes),
+                                  np.asarray(h2.genomes))
+
+
+def test_segmented_mu_plus_lambda_bit_exact(tmp_path):
+    tb, pop, key = _toolbox(), _pop(), jax.random.key(2)
+    p1, lb1, _ = algorithms.ea_mu_plus_lambda(
+        key, pop, tb, 64, 128, 0.4, 0.3, ngen=NGEN)
+    res = ResilientRun(str(tmp_path / "ck"), segment_len=2)
+    p2, lb2, _ = res.ea_mu_plus_lambda(key, pop, tb, 64, 128, 0.4,
+                                       0.3, ngen=NGEN)
+    _assert_pop_equal(p1, p2)
+    _assert_logbook_equal(lb1, lb2)
+
+
+def test_segmented_mu_comma_lambda_bit_exact(tmp_path):
+    tb, pop, key = _toolbox(), _pop(), jax.random.key(3)
+    p1, lb1, _ = algorithms.ea_mu_comma_lambda(
+        key, pop, tb, 64, 128, 0.4, 0.3, ngen=NGEN)
+    res = ResilientRun(str(tmp_path / "ck"), segment_len=SEG)
+    p2, lb2, _ = res.ea_mu_comma_lambda(key, pop, tb, 64, 128, 0.4,
+                                        0.3, ngen=NGEN)
+    _assert_pop_equal(p1, p2)
+    _assert_logbook_equal(lb1, lb2)
+
+
+def test_segmented_generate_update_bit_exact(tmp_path):
+    from deap_tpu.strategies import cma
+
+    strat = cma.Strategy(centroid=[0.0] * 6, sigma=0.5)
+    tb = Toolbox()
+    tb.register("generate", strat.generate)
+    tb.register("update", strat.update)
+    tb.register("evaluate", lambda g: -jnp.sum(g ** 2, axis=-1))
+    key = jax.random.key(4)
+    s1, lb1, h1 = algorithms.ea_generate_update(
+        key, strat.initial_state(), tb, ngen=NGEN, spec=strat.spec,
+        halloffame_size=3)
+    res = ResilientRun(str(tmp_path / "ck"), segment_len=SEG)
+    s2, lb2, h2 = res.ea_generate_update(
+        key, strat.initial_state(), tb, ngen=NGEN, spec=strat.spec,
+        halloffame_size=3)
+    for a, b in zip(jax.tree_util.tree_leaves(s1),
+                    jax.tree_util.tree_leaves(s2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    _assert_logbook_equal(lb1, lb2)
+    np.testing.assert_array_equal(np.asarray(h1.fitness),
+                                  np.asarray(h2.fitness))
+
+
+# ------------------------------------------------------ host families ----
+
+def test_segmented_gp_loop_bit_exact(tmp_path):
+    import deap_tpu.gp as gp
+    from deap_tpu.gp.loop import make_symbreg_loop
+
+    ps = gp.math_set(n_args=1)
+    X = jnp.linspace(-1.0, 1.0, 32, endpoint=False)[:, None]
+    y = X[:, 0] ** 3 + X[:, 0]
+    genomes = jax.vmap(gp.gen_half_and_half(ps, 48, 1, 2))(
+        jax.random.split(jax.random.key(3), 128))
+    run = make_symbreg_loop(ps, 48, X, y, height_limit=6)
+    r1 = run(jax.random.key(9), genomes, NGEN)
+    run2 = make_symbreg_loop(ps, 48, X, y, height_limit=6)
+    res = ResilientRun(str(tmp_path / "ck"), segment_len=SEG)
+    r2 = res.gp_loop(run2, jax.random.key(9), genomes, NGEN)
+    np.testing.assert_array_equal(np.asarray(r1["fitness"]),
+                                  np.asarray(r2["fitness"]))
+    for k in ("nodes", "consts", "length"):
+        np.testing.assert_array_equal(np.asarray(r1["genomes"][k]),
+                                      np.asarray(r2["genomes"][k]))
+    np.testing.assert_array_equal(np.asarray(r1["depths"]),
+                                  np.asarray(r2["depths"]))
+    assert r1["nevals"] == r2["nevals"]
+    assert r1["best_fitness"] == r2["best_fitness"]
+
+
+def test_segmented_island_bit_exact(tmp_path):
+    from deap_tpu.parallel import island_init, make_island_step
+
+    tb = _toolbox()
+    pops = island_init(jax.random.key(2), 4, 32,
+                       ops.bernoulli_genome(16), FitnessSpec((1.0,)))
+    pops = jax.vmap(lambda p: algorithms.evaluate_invalid(
+        p, tb.evaluate))(pops)
+    step = make_island_step(tb, cxpb=0.5, mutpb=0.2, freq=3, mig_k=2)
+    key = jax.random.key(7)
+    ref = pops
+    for epoch in range(5):
+        ref = step(jax.random.fold_in(key, epoch), ref)
+    res = ResilientRun(str(tmp_path / "ck"), segment_len=2)
+    got = res.island_run(step, key, pops, 5)
+    _assert_pop_equal(ref, got)
+
+
+def test_segmented_island_mesh_bit_exact(tmp_path):
+    """The shard_map'd island path: checkpoint gathers to host, resume
+    re-applies placement via ``reshard=`` — still bit-exact against
+    the uninterrupted sharded run (8 virtual CPU devices, conftest)."""
+    from functools import partial
+
+    from deap_tpu.parallel import (island_init, make_island_step,
+                                   population_mesh, shard_population)
+
+    assert len(jax.devices()) >= 8
+    tb = _toolbox()
+    mesh = population_mesh(8, ("island",))
+    pops = island_init(jax.random.key(2), 8, 16,
+                       ops.bernoulli_genome(16), FitnessSpec((1.0,)))
+    pops = jax.vmap(lambda p: algorithms.evaluate_invalid(
+        p, tb.evaluate))(pops)
+    pops = shard_population(pops, mesh, "island")
+    step = make_island_step(tb, cxpb=0.5, mutpb=0.2, freq=2, mig_k=1,
+                            mesh=mesh)
+    key = jax.random.key(7)
+    ref = pops
+    for epoch in range(4):
+        ref = step(jax.random.fold_in(key, epoch), ref)
+
+    from deap_tpu.resilience import FaultPlan, InjectedCrash, KillAt
+
+    d = str(tmp_path / "ck")
+    reshard = partial(shard_population, mesh=mesh, axis="island")
+    with pytest.raises(InjectedCrash):
+        ResilientRun(d, segment_len=2,
+                     fault_plan=FaultPlan([KillAt(4)])).island_run(
+            step, key, pops, 4, reshard=reshard)
+    got = ResilientRun(d, segment_len=2).island_run(
+        step, key, pops, 4, reshard=reshard)
+    _assert_pop_equal(ref, got)
+
+
+# --------------------------------------------------------- preemption ----
+
+def test_sigterm_preempts_then_resumes_bit_exact(tmp_path):
+    """A real SIGTERM mid-run: the driver finishes the in-flight
+    segment, checkpoints, journals ``preempted`` and raises
+    ``Preempted``; re-invoking the same call resumes and the final
+    state is bit-identical to an uninterrupted run."""
+    tb, pop, key = _toolbox(), _pop(), jax.random.key(5)
+    p1, lb1, _ = algorithms.ea_simple(key, pop, tb, 0.5, 0.2, ngen=NGEN)
+    d = str(tmp_path / "ck")
+    jpath = str(tmp_path / "j.jsonl")
+    with RunTelemetry(jpath) as tel:
+        res = ResilientRun(d, segment_len=2, telemetry=tel,
+                           fault_plan=FaultPlan([PreemptAt(4)]))
+        with pytest.raises(Preempted) as exc:
+            res.ea_simple(key, pop, tb, 0.5, 0.2, ngen=NGEN)
+    assert exc.value.step == 4
+    assert os.path.exists(exc.value.path)
+    rows = read_journal(jpath)
+    assert any(r["kind"] == "preempted" for r in rows)
+
+    p2, lb2, _ = ResilientRun(d, segment_len=2).ea_simple(
+        key, pop, tb, 0.5, 0.2, ngen=NGEN)
+    _assert_pop_equal(p1, p2)
+    _assert_logbook_equal(lb1, lb2)
+
+
+def test_resume_journals_run_id_chain(tmp_path):
+    """Segment linkage: the resumed run journals ``resumed`` with the
+    prior run's id (read from checkpoint meta), so report tooling can
+    stitch the segments into one timeline."""
+    tb, pop, key = _toolbox(), _pop(), jax.random.key(6)
+    d = str(tmp_path / "ck")
+    with RunTelemetry(str(tmp_path / "a.jsonl")) as tel:
+        res1 = ResilientRun(d, segment_len=2, telemetry=tel,
+                            fault_plan=FaultPlan([PreemptAt(2)]))
+        with pytest.raises(Preempted):
+            res1.ea_simple(key, pop, tb, 0.5, 0.2, ngen=NGEN)
+        first_id = res1.run_id
+    with RunTelemetry(str(tmp_path / "b.jsonl")) as tel:
+        res2 = ResilientRun(d, segment_len=2, telemetry=tel)
+        res2.ea_simple(key, pop, tb, 0.5, 0.2, ngen=NGEN)
+        assert res2.resumed_from == first_id
+    rows = read_journal(str(tmp_path / "b.jsonl"))
+    resumed = [r for r in rows if r["kind"] == "resumed"]
+    assert resumed and resumed[0]["resumed_from"] == first_id
+    assert resumed[0]["step"] == 2
+
+
+def test_refuses_resume_of_different_algorithm(tmp_path):
+    tb, pop, key = _toolbox(), _pop(), jax.random.key(8)
+    d = str(tmp_path / "ck")
+    with pytest.raises(Preempted):
+        ResilientRun(d, segment_len=2,
+                     fault_plan=FaultPlan([PreemptAt(2)])).ea_simple(
+            key, pop, tb, 0.5, 0.2, ngen=NGEN)
+    with pytest.raises(ValueError, match="refusing to resume"):
+        ResilientRun(d, segment_len=2).ea_mu_comma_lambda(
+            key, pop, tb, 64, 128, 0.4, 0.3, ngen=NGEN)
+
+
+# ------------------------------------------------- failure handling ----
+
+def test_transient_retry_backoff_and_degraded_events(tmp_path):
+    """Two injected RESOURCE_EXHAUSTED failures on one segment: the
+    driver backs off, calls the degrade hook, journals two ``degraded``
+    events, and the final result is still bit-exact (retries re-run
+    from the in-memory pre-segment state)."""
+    tb, pop, key = _toolbox(), _pop(), jax.random.key(9)
+    p1, _, _ = algorithms.ea_simple(key, pop, tb, 0.5, 0.2, ngen=NGEN)
+    jpath = str(tmp_path / "j.jsonl")
+    sleeps, degrades = [], []
+    with RunTelemetry(jpath) as tel:
+        res = ResilientRun(
+            str(tmp_path / "ck"), segment_len=2, telemetry=tel,
+            retry=RetryPolicy(max_retries=3, backoff_s=0.01,
+                              sleep=sleeps.append),
+            degrade_cb=lambda kind, exc: degrades.append(kind)
+            or "halved eval batch",
+            fault_plan=FaultPlan([FailSegments(lo=2, times=2)]))
+        p2, _, _ = res.ea_simple(key, pop, tb, 0.5, 0.2, ngen=NGEN)
+    _assert_pop_equal(p1, p2)
+    assert degrades == ["resource_exhausted"] * 2
+    assert len(sleeps) == 2 and sleeps[1] > sleeps[0]  # backoff grows
+    rows = read_journal(jpath)
+    degraded = [r for r in rows if r["kind"] == "degraded"]
+    assert len(degraded) == 2
+    assert degraded[0]["error_kind"] == "resource_exhausted"
+    assert degraded[0]["action"] == "halved eval batch"
+
+
+def test_retry_budget_exhausted_raises(tmp_path):
+    from deap_tpu.resilience import InjectedTransient
+
+    tb, pop, key = _toolbox(), _pop(), jax.random.key(10)
+    res = ResilientRun(
+        str(tmp_path / "ck"), segment_len=2,
+        retry=RetryPolicy(max_retries=1, backoff_s=0.0,
+                          sleep=lambda s: None),
+        fault_plan=FaultPlan([FailSegments(lo=0, times=5)]))
+    with pytest.raises(InjectedTransient):
+        res.ea_simple(key, pop, tb, 0.5, 0.2, ngen=NGEN)
+
+
+def test_fatal_error_propagates_unretried(tmp_path):
+    """A deterministic failure (shape error, assertion) must not burn
+    retries — classify_error returns None and it propagates at once."""
+    tb, pop, key = _toolbox(), _pop(), jax.random.key(11)
+    attempts = []
+
+    class _Boom(FaultPlan):
+        def fire(self, event, **ctx):
+            if event == "segment_attempt":
+                attempts.append(ctx["attempt"])
+                raise ValueError("deterministic bug")
+
+    res = ResilientRun(str(tmp_path / "ck"), segment_len=2,
+                       fault_plan=_Boom())
+    with pytest.raises(ValueError, match="deterministic bug"):
+        res.ea_simple(key, pop, tb, 0.5, 0.2, ngen=NGEN)
+    assert attempts == [0]
+
+
+def test_classify_error_vocabulary():
+    assert classify_error(
+        RuntimeError("RESOURCE_EXHAUSTED: oom")) == "resource_exhausted"
+    assert classify_error(
+        RuntimeError("Out of memory allocating 1g")) == "resource_exhausted"
+    assert classify_error(
+        RuntimeError("UNAVAILABLE: socket closed")) == "transient"
+    assert classify_error(ValueError("bad shape")) is None
+    assert classify_error(AssertionError("x")) is None
+
+
+# ---------------------------------------------------------- quarantine ----
+
+def test_quarantine_substitutes_penalty_and_journals(tmp_path):
+    tb = _toolbox()
+    pop = _pop()
+    wrapped = quarantine_non_finite(
+        nan_inject_evaluate(tb.evaluate, [3, 5]))
+    jpath = str(tmp_path / "q.jsonl")
+    from deap_tpu.telemetry import RunJournal
+
+    with RunJournal(jpath):
+        vals = np.asarray(wrapped(pop.genomes))
+        jax.effects_barrier()
+    assert np.isfinite(vals).all()
+    assert vals[3] == np.float32(QUARANTINE_PENALTY)
+    assert vals[5] == np.float32(QUARANTINE_PENALTY)
+    rows = read_journal(jpath)
+    q = [r for r in rows if r["kind"] == "quarantine"]
+    assert q and q[0]["n"] == 2
+
+
+def test_quarantine_probe_counts_and_alarms(tmp_path):
+    """QuarantineProbe Meter-counts sentinel rows each generation and
+    its count feeds the HealthMonitor's existing non_finite alarm —
+    without the probe the sentinel substitution would silence it."""
+    from deap_tpu.telemetry.probes import HealthMonitor, QuarantineProbe
+
+    tb = _toolbox()
+    tb.register("evaluate", quarantine_non_finite(
+        nan_inject_evaluate(
+            lambda g: g.sum(-1).astype(jnp.float32), [0, 1, 2]),
+        journal=False))
+    pop, key = _pop(), jax.random.key(12)
+    jpath = str(tmp_path / "qa.jsonl")
+    health = HealthMonitor()
+    with RunTelemetry(jpath, health=health) as tel:
+        algorithms.ea_simple(key, pop, tb, 0.5, 0.2, ngen=3,
+                             telemetry=tel, probes=(QuarantineProbe(),))
+    rows = read_journal(jpath)
+    meters = [r for r in rows if r["kind"] == "meter"]
+    assert meters and all("quarantined" in r for r in meters)
+    assert meters[0]["quarantined"] == 3  # the injected rows
+    alarms = [r for r in rows if r["kind"] == "alarm"]
+    assert alarms and alarms[0]["alarm"] == "non_finite"
+    assert "quarantined" in alarms[0]["metrics"]
+
+
+# ----------------------------------------------- telemetry invariance ----
+
+def test_segmented_telemetry_on_bit_identical(tmp_path):
+    """Segmenting + telemetry + probes together still change no
+    computed result (the PR-2/PR-4 invariant extended to segments)."""
+    from deap_tpu.telemetry.probes import DiversityProbe, FitnessProbe
+
+    tb, pop, key = _toolbox(), _pop(), jax.random.key(13)
+    p1, lb1, _ = algorithms.ea_simple(key, pop, tb, 0.5, 0.2, ngen=NGEN)
+    with RunTelemetry(str(tmp_path / "t.jsonl")) as tel:
+        res = ResilientRun(str(tmp_path / "ck"), segment_len=SEG,
+                           telemetry=tel)
+        p2, lb2, _ = res.ea_simple(
+            key, pop, tb, 0.5, 0.2, ngen=NGEN,
+            probes=(DiversityProbe(sample=32), FitnessProbe()))
+    _assert_pop_equal(p1, p2)
+    _assert_logbook_equal(lb1, lb2)
+    rows = read_journal(str(tmp_path / "t.jsonl"))
+    meters = [r for r in rows if r["kind"] == "meter"]
+    assert len(meters) == NGEN + 1  # gen 0 .. NGEN, across segments
+    assert [r["gen"] for r in meters] == list(range(NGEN + 1))
