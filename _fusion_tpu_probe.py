@@ -1,0 +1,184 @@
+"""TPU capture child for the fused-variation pairs (bench.py --fusion).
+
+Runs in its OWN process (the relay TPU is single-client: the
+orchestrating parent must never attach — same discipline as bench.py's
+race candidates). Three measurements, one JSON line each on stdout:
+
+1. hardware parity gate: ``ops.kernels.fused_variation`` on the real
+   core vs the fused XLA apply on identical masks — bit-equal, else a
+   structured ``gate_failed`` line (a fast wrong kernel must never
+   produce a committed row);
+2. the variation-plane pair at the headline config (pop=100k):
+   unfused composition vs ``fused='kernel'``, same scanned protocol;
+3. the GP compaction pair (host round trip — a real PCIe/relay sync
+   here — vs on-device prefix-sum).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+POP, L, NGEN, REPS = 100_000, 100, 20, 3
+
+
+def main() -> int:
+    from deap_tpu import ops
+    from deap_tpu.algorithms import evaluate_invalid, var_and
+    from deap_tpu.core.fitness import FitnessSpec
+    from deap_tpu.core.population import gather, init_population
+    from deap_tpu.core.toolbox import Toolbox
+    from deap_tpu.gp.loop import make_compaction_pipelines
+    from deap_tpu.ops import variation as V
+    from deap_tpu.ops.kernels import fused_variation
+
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"gate_failed": "backend is not tpu"}))
+        return 1
+    kind = jax.devices()[0].device_kind
+
+    tb = Toolbox()
+    tb.register("evaluate", lambda g: g.sum(-1).astype(jnp.float32))
+    tb.register("mate", ops.cx_two_point)
+    tb.register("mutate", ops.mut_flip_bit, indpb=0.05)
+    tb.register("select", ops.sel_tournament, tournsize=3)
+
+    # ---- 1. hardware parity gate (small, fast) ----
+    n, l = 512, 96
+    g = jax.random.bernoulli(jax.random.key(3), 0.5, (n, l))
+    plan = V.resolve_plan(tb)
+    cx_row, lo, hi, do_mut, mask, arg = V.var_and_masks(
+        jax.random.key(4), n, l, 0.6, 0.4, plan, g.dtype)
+    pos = V.pair_partner_positions(n)
+    want = V.apply_variation(g, None, pos, cx_row, lo, hi, do_mut,
+                             mask, arg, "flip")
+    got = fused_variation(g, jnp.arange(n, dtype=jnp.int32), pos,
+                          cx_row, lo, hi, do_mut, mask, None,
+                          mut_kind="flip", block_i=256,
+                          interpret=False)
+    if not bool((got == want).all()):
+        bad = int(jnp.sum(jnp.any(got != want, axis=-1)))
+        print(json.dumps({"gate_failed":
+                          f"kernel != xla apply on {bad} rows (hw)"}))
+        return 1
+    print(json.dumps({"hw_parity": True, "device_kind": kind}),
+          flush=True)
+
+    # ---- 2. variation-plane pair at pop=100k ----
+    pop = init_population(jax.random.key(1), POP,
+                          ops.bernoulli_genome(L), FitnessSpec((1.0,)))
+    pop = evaluate_invalid(pop, tb.evaluate)
+
+    def unfused_step(p, key):
+        k_sel, k_var = jax.random.split(key)
+        idx = tb.select(k_sel, p.wvalues, p.size)
+        off = var_and(k_var, gather(p, idx), tb, 0.5, 0.2, fused=False)
+        return evaluate_invalid(off, tb.evaluate), None
+
+    def fused_step(p, key):
+        k_sel, k_var = jax.random.split(key)
+        idx = tb.select(k_sel, p.wvalues, p.size)
+        off = var_and(k_var, p, tb, 0.5, 0.2, fused="kernel",
+                      sel_idx=idx)
+        return evaluate_invalid(off, tb.evaluate), None
+
+    def mk(step):
+        @jax.jit
+        def run(key, p):
+            p, _ = lax.scan(step, p, jax.random.split(key, NGEN))
+            return p.wvalues[:, 0]
+        return run
+
+    run_u, run_f = mk(unfused_step), mk(fused_step)
+    wu = run_u(jax.random.key(50), pop)
+    wf = run_f(jax.random.key(50), pop)
+    if not bool((wu == wf).all()):
+        print(json.dumps({"gate_failed":
+                          "fused scan diverged from unfused on hw"}))
+        return 1
+
+    def fetch(x):  # force completion via scalar fetch (bench.py note)
+        return float(jnp.sum(x))
+
+    rows = []
+    t_u, t_f = [], []
+    for r in range(REPS):
+        t0 = time.perf_counter()
+        fetch(run_u(jax.random.key(60 + r), pop))
+        t_u.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        fetch(run_f(jax.random.key(60 + r), pop))
+        t_f.append(time.perf_counter() - t0)
+    for name, ts in (("unfused", t_u), ("fused", t_f)):
+        ts = sorted(ts)
+        rows.append({
+            "metric": f"onemax_pop100k_varplane_{name}"
+                      "_generations_per_sec",
+            "value": round(NGEN / ts[len(ts) // 2], 3),
+            "unit": "gens/sec", "backend": "tpu",
+            "device_kind": kind, "pop": POP, "ngen": NGEN,
+            "n_samples": len(ts),
+            "best": round(NGEN / ts[0], 3),
+        })
+    rows.append({
+        "metric": "onemax_pop100k_varplane_fused_speedup_x",
+        "value": round(min(t_u) / min(t_f), 3), "unit": "x",
+        "backend": "tpu", "device_kind": kind,
+        "estimator": "min_of_reps", "bit_identical": True,
+        "threshold_x": 1.2,
+    })
+
+    # ---- 3. GP compaction pair (host sync is real PCIe here) ----
+    host_fn, dev_fn = make_compaction_pipelines(0.5, 0.1)
+    n = POP
+    (h, hc), (d, dc) = (host_fn(jax.random.key(70), n),
+                        dev_fn(jax.random.key(70), n))
+    if hc != dc or not all(bool((a == b).all()) for a, b in zip(h, d)):
+        print(json.dumps({"gate_failed": "compaction parity (hw)"}))
+        return 1
+    for r in range(4):  # warm both shape classes
+        host_fn(jax.random.fold_in(jax.random.key(8), r), n)
+        dev_fn(jax.random.fold_in(jax.random.key(8), r), n)
+    ROUNDS = 50
+    ct_h, ct_d = [], []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        for r in range(ROUNDS):
+            host_fn(jax.random.fold_in(jax.random.key(9), r), n)
+        ct_h.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        for r in range(ROUNDS):
+            dev_fn(jax.random.fold_in(jax.random.key(9), r), n)
+        ct_d.append(time.perf_counter() - t0)
+    for name, ts in (("host", ct_h), ("device", ct_d)):
+        ts = sorted(ts)
+        rows.append({
+            "metric": f"gp_compaction_pop100k_{name}_rounds_per_sec",
+            "value": round(ROUNDS / ts[len(ts) // 2], 2),
+            "unit": "rounds/sec", "backend": "tpu",
+            "device_kind": kind, "pop": n, "n_samples": len(ts),
+            "best": round(ROUNDS / ts[0], 2),
+        })
+    rows.append({
+        "metric": "gp_compaction_pop100k_device_speedup_x",
+        "value": round(min(ct_h) / min(ct_d), 3), "unit": "x",
+        "backend": "tpu", "device_kind": kind,
+        "estimator": "min_of_reps", "bit_identical": True,
+        "threshold_x": 1.2,
+    })
+    for row in rows:
+        print(json.dumps(row), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except Exception as e:  # structured resolution for the parent
+        print(json.dumps({"gate_failed": repr(e)[:400]}))
+        sys.exit(1)

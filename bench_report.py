@@ -201,8 +201,70 @@ def probe_tripwire(threshold: float = PROBE_OVERHEAD_THRESHOLD) -> int:
     return 0 if ok else 1
 
 
-#: fractional segmented-run overhead beyond which the resilience pair trips
-RESILIENCE_OVERHEAD_THRESHOLD = 0.03
+#: fractional speedup shortfall beyond which a fusion pair trips: the
+#: SHIPPED side of a committed pair (the fused default / the auto-
+#: resolved compaction) must not fall >10% below the same-session
+#: alternative — the gate that lets fused/auto stay the default
+FUSION_PAIR_THRESHOLD = 0.10
+
+
+def fusion_tripwire(threshold: float = FUSION_PAIR_THRESHOLD) -> int:
+    """The fused-variation-plane gate. BENCH_FUSION.json carries the
+    unfused-vs-fused variation plane (bit-identity asserted before
+    timing; the row's ``rng_bound_pct`` records how much of the step
+    is shared threefry that no fusion can touch) and the
+    host-vs-device GP compaction pipelines plus the ``auto``
+    resolution, all same-session (bench.py --fusion). Trips when the
+    fused default falls more than ``threshold`` below the unfused
+    composition, or when ``compaction='auto'`` resolves more than
+    ``threshold`` below the measured winner. Also diffs consecutive
+    committed BENCH_FUSION files. Returns the number of tripped
+    rows."""
+    files = sorted(glob.glob(os.path.join(HERE, "BENCH_FUSION*.json")))
+    if not files:
+        print("fusion tripwire: no committed BENCH_FUSION*.json yet")
+        return 0
+    rows = _bench_rows(files[-1])
+    tripped = 0
+    print(f"\n## Fusion pairs ({os.path.basename(files[-1])})\n")
+    f = rows.get("onemax_pop100k_varplane_fused_generations_per_sec")
+    u = rows.get("onemax_pop100k_varplane_unfused_generations_per_sec")
+    s = rows.get("onemax_pop100k_varplane_fused_speedup_x")
+    if (f and u and isinstance(f.get("value"), (int, float))
+            and isinstance(u.get("value"), (int, float))):
+        ratio = f["value"] / u["value"]
+        ok = ratio >= (1 - threshold)
+        rng = (s or {}).get("rng_bound_pct")
+        print(f"- fused variation plane: fused {f['value']} vs unfused "
+              f"{u['value']} gens/s, same session: {ratio:.2f}×"
+              + (f" (rng-bound {rng}% of the step — the bit-parity "
+                 "ceiling on this backend)" if rng is not None else "")
+              + (" ok" if ok else " **REGRESSION** (fused default "
+                 "slower than the composition it replaced)"))
+        tripped += 0 if ok else 1
+    else:
+        print("- fused variation plane: paired rows missing")
+    auto = rows.get("gp_compaction_pop100k_auto_vs_best_x")
+    if auto and isinstance(auto.get("value"), (int, float)):
+        ok = auto["value"] >= (1 - threshold)
+        print(f"- GP compaction auto-dispatch: {auto['value']:.2f}× of "
+              f"the measured winner (resolved "
+              f"{auto.get('resolved', '?')!r}) "
+              + ("ok" if ok else "**REGRESSION** (auto picked a path "
+                 ">10% below the same-session winner)"))
+        tripped += 0 if ok else 1
+    else:
+        print("- GP compaction auto row missing")
+    if len(files) >= 2:
+        tripped += _diff_rows(files[-2], files[-1], TRIPWIRE_THRESHOLD)
+    return tripped
+
+
+#: fractional segmented-run overhead beyond which the resilience pair
+#: trips — tightened from 3% to 1.5% once checkpoint double-buffering
+#: (async boundary writes overlapped with the next segment's compute)
+#: landed
+RESILIENCE_OVERHEAD_THRESHOLD = 0.015
 
 
 def resilience_tripwire(
@@ -270,6 +332,7 @@ def tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
     tripped += gp_tripwire(threshold)
     tripped += probe_tripwire()
     tripped += resilience_tripwire()
+    tripped += fusion_tripwire()
     return tripped
 
 

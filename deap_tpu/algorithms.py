@@ -30,6 +30,7 @@ from jax import lax
 
 from deap_tpu.core.population import Population, concat, gather
 from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.ops import variation as _variation
 from deap_tpu.support.hof import HallOfFame, hof_init, hof_update
 from deap_tpu.support.logbook import Logbook, logbook_from_records
 from deap_tpu.support.stats import Statistics
@@ -54,15 +55,153 @@ def evaluate_invalid(pop: Population, evaluate: Callable) -> Population:
     return pop.with_fitness(values, mask=~pop.valid)
 
 
+# ------------------------------------------------- fused variation plane ----
+#
+# var_and / var_or accept a ``fused`` mode: when the toolbox's (mate,
+# mutate) pair is fused-capable (ops.variation.resolve_plan) and the
+# genomes are one [n, L] array, the variation plane runs as a single
+# pass — masks drawn with the unfused operators' exact RNG tree, then
+# one fused apply (the XLA formulation off-TPU, the Pallas
+# ops.kernels.fused_variation kernel on TPU). Results are BIT-IDENTICAL
+# to the unfused composition either way (tests/test_fused_variation.py
+# pins populations/logbooks across all four loops), so 'auto' is the
+# default everywhere. The dispatch decision is journaled as a
+# ``variation_dispatch`` event (visible in bench_report.py --journal /
+# --health), mirroring the GP interpreter's gp_dispatch events.
+
+#: dtypes the Pallas kernel's f32 workspace represents exactly for
+#: every mut kind (bool / f32 genomes; everything else takes the
+#: equally-bit-exact fused XLA path)
+_KERNEL_EXACT_DTYPES = (jnp.bool_, jnp.float32)
+
+
+def _journal_dispatch(**payload) -> None:
+    from deap_tpu.telemetry.journal import broadcast
+    broadcast("variation_dispatch", **payload)
+
+
+def _resolve_fused(fused, toolbox, genomes, op: str):
+    """Resolve a ``fused=`` request to ``(mode, plan)`` where mode is
+    ``None`` (unfused), ``'xla'`` or ``'kernel'``; journals the
+    decision. ``'auto'`` silently falls back when the configuration is
+    not fused-capable; an explicit ``'xla'``/``'kernel'`` raises
+    instead of silently computing something slower than asked for."""
+    if fused in (False, None, "off"):
+        _journal_dispatch(op=op, path="unfused", reason="disabled")
+        return None, None
+    if fused is True:
+        fused = "auto"
+    if fused not in ("auto", "xla", "kernel"):
+        raise ValueError(f"unknown fused mode {fused!r}")
+    plan = _variation.resolve_plan(toolbox)
+    leaf = _variation.single_genome_leaf(genomes)
+    reason = None
+    if plan is None:
+        reason = "operators not fused-capable"
+    elif leaf is None:
+        reason = "genome pytree is not a single [n, L] array"
+    if reason is not None:
+        if fused != "auto":
+            raise ValueError(f"fused={fused!r} requested but {reason}")
+        _journal_dispatch(op=op, path="unfused", reason=reason)
+        return None, None
+    mode, reason = fused, "requested"
+    if fused == "auto":
+        if jax.default_backend() == "tpu":
+            mode, reason = "kernel", "tpu backend"
+        else:
+            # the Pallas interpreter would be far slower than XLA: the
+            # off-TPU fused path is the XLA formulation, not the
+            # kernel run under interpret mode
+            mode = "xla"
+            reason = (f"{jax.default_backend()} backend "
+                      "(interpret-mode kernel fallback declined)")
+    if mode == "kernel" and leaf.dtype not in _KERNEL_EXACT_DTYPES:
+        if fused == "kernel":
+            raise ValueError(
+                f"fused='kernel' requested but genome dtype "
+                f"{leaf.dtype} is outside the kernel's exact-f32 set")
+        mode = "xla"
+        reason = f"dtype {leaf.dtype} outside the kernel's exact set"
+    _journal_dispatch(op=op, path=f"fused_{mode}", reason=reason,
+                      mate=plan.mate_name, mutate=plan.mut_name,
+                      mut_kind=plan.mut_kind)
+    return mode, plan
+
+
+def _apply_fused(mode: str, g, src, partner, cx_row, lo, hi, mut_row,
+                 mask, arg, mut_kind: str):
+    if mode == "kernel":
+        from deap_tpu.ops.kernels import fused_variation
+        if src is None:
+            src = jnp.arange(cx_row.shape[0], dtype=jnp.int32)
+        return fused_variation(g, src, partner, cx_row, lo, hi,
+                               mut_row, mask, arg, mut_kind=mut_kind,
+                               interpret=False)
+    return _variation.apply_variation(g, src, partner, cx_row, lo, hi,
+                                      mut_row, mask, arg, mut_kind)
+
+
+def _rebuild_genomes(template, children):
+    treedef = jax.tree_util.tree_structure(template)
+    return jax.tree_util.tree_unflatten(treedef, [children])
+
+
 def var_and(key: jax.Array, pop: Population, toolbox, cxpb: float,
-            mutpb: float) -> Population:
+            mutpb: float, fused="auto",
+            sel_idx: Optional[jnp.ndarray] = None) -> Population:
     """Crossover AND mutation variation (algorithms.py:33-82).
 
     Adjacent pairs (0,1), (2,3), ... mate with probability ``cxpb``; each
     individual then mutates with probability ``mutpb``; every touched row
     is invalidated. An odd last individual never mates, like the
     reference's pairwise zip.
+
+    ``fused`` selects the variation-plane execution (see the module
+    note above): ``'auto'`` (default — fused when the configuration
+    supports it, bit-identical either way), ``'xla'`` / ``'kernel'``
+    (explicit, raising when unsupported), or ``False`` (the original
+    composition). ``sel_idx`` composes a selection gather into the
+    plane: ``var_and(k, pop, tb, ..., sel_idx=idx)`` ==
+    ``var_and(k, gather(pop, idx), tb, ...)`` with the parent gather
+    fused into the variation pass instead of materialised.
     """
+    mode, plan = _resolve_fused(fused, toolbox, pop.genomes, "var_and")
+    if mode is None:
+        if sel_idx is not None:
+            pop = gather(pop, sel_idx)
+        return _var_and_unfused(key, pop, toolbox, cxpb, mutpb)
+
+    g = _variation.single_genome_leaf(pop.genomes)
+    n = int(sel_idx.shape[0]) if sel_idx is not None else pop.size
+    L = g.shape[1]
+    cx_row, lo, hi, do_mut, mask, arg = _variation.var_and_masks(
+        key, n, L, cxpb, mutpb, plan, g.dtype)
+    if sel_idx is None:
+        src, base = None, pop
+    else:
+        # fitness/valid/extras row-select only: the genome-plane gather
+        # happens inside the fused apply
+        src, base = sel_idx, gather(pop.replace(genomes=()), sel_idx)
+    if mode == "kernel":
+        # the kernel DMAs partner rows by explicit index; the XLA apply
+        # derives the adjacent-pair partner view by reshape instead
+        # (partner_idx=None), saving a second full gather
+        partner_pos = _variation.pair_partner_positions(n)
+        partner = (partner_pos if src is None
+                   else jnp.take(src, partner_pos))
+    else:
+        partner = None
+    children = _apply_fused(mode, g, src, partner, cx_row, lo, hi,
+                            do_mut, mask, arg, plan.mut_kind)
+    genomes = _rebuild_genomes(pop.genomes, children)
+    return base.replace(genomes=genomes).invalidate(cx_row | do_mut)
+
+
+def _var_and_unfused(key: jax.Array, pop: Population, toolbox,
+                     cxpb: float, mutpb: float) -> Population:
+    """The original compute-both-then-select composition — the parity
+    oracle the fused plane is pinned against."""
     n = pop.size
     npairs = n // 2
     k_pair, k_cx, k_ind, k_mut = jax.random.split(key, 4)
@@ -103,17 +242,34 @@ def var_and(key: jax.Array, pop: Population, toolbox, cxpb: float,
 
 
 def var_or(key: jax.Array, pop: Population, toolbox, lambda_: int,
-           cxpb: float, mutpb: float) -> Population:
+           cxpb: float, mutpb: float, fused="auto") -> Population:
     """Crossover OR mutation OR reproduction (algorithms.py:192-245).
 
     Each of the ``lambda_`` children independently: with prob cxpb the
     first child of a mating of two distinct random parents; elif with
     prob mutpb a mutant of a random parent; else an unchanged copy that
     *keeps* its parent's (valid) fitness, exactly like the reference.
+
+    ``fused`` as in :func:`var_and`: the fused plane composes the
+    per-child parent gathers (``i``/``j``/``m`` draws) into its
+    one-pass apply — bit-identical to this composition.
     """
     assert cxpb + mutpb <= 1.0, (
         "The sum of the crossover and mutation probabilities must be "
         "smaller or equal to 1.0.")
+    mode, plan = _resolve_fused(fused, toolbox, pop.genomes, "var_or")
+    if mode is not None:
+        g = _variation.single_genome_leaf(pop.genomes)
+        base_idx, j, choice_cx, lo, hi, choice_mut, mask, arg = (
+            _variation.var_or_masks(key, pop.size, lambda_, g.shape[1],
+                                    cxpb, mutpb, plan, g.dtype))
+        children_g = _apply_fused(mode, g, base_idx, j, choice_cx, lo,
+                                  hi, choice_mut, mask, arg,
+                                  plan.mut_kind)
+        base = gather(pop.replace(genomes=()), base_idx)
+        genomes = _rebuild_genomes(pop.genomes, children_g)
+        return base.replace(genomes=genomes).invalidate(
+            choice_cx | choice_mut)
     n = pop.size
     k_u, k_p1, k_p2, k_pm, k_cx, k_mut = jax.random.split(key, 6)
     u = jax.random.uniform(k_u, (lambda_,))
@@ -221,9 +377,11 @@ def _pop_loop_init(pop: Population, toolbox, halloffame_size: int,
 
 def make_ea_simple_step(toolbox, cxpb: float, mutpb: float,
                         stats: Optional[Statistics] = None,
-                        telemetry=None) -> Callable:
+                        telemetry=None, fused="auto") -> Callable:
     """The eaSimple generation step: select n → varAnd → evaluate
-    invalid → replace (algorithms.py:163-181)."""
+    invalid → replace (algorithms.py:163-181). ``fused`` (see
+    :func:`var_and`) collapses select-gather + crossover + mutation
+    into one pass over the genome plane — bit-identical results."""
     tel = telemetry
 
     def step(carry, xs):
@@ -233,7 +391,8 @@ def make_ea_simple_step(toolbox, cxpb: float, mutpb: float,
             (pop, hof, mstate), (key, gen) = carry, xs
         k_sel, k_var = jax.random.split(key)
         idx = toolbox.select(k_sel, pop.wvalues, pop.size)
-        off = var_and(k_var, gather(pop, idx), toolbox, cxpb, mutpb)
+        off = var_and(k_var, pop, toolbox, cxpb, mutpb, fused=fused,
+                      sel_idx=idx)
         nevals = jnp.sum(~off.valid)
         off = evaluate_invalid(off, toolbox.evaluate)
         if hof is not None:
@@ -257,7 +416,7 @@ def make_ea_simple_step(toolbox, cxpb: float, mutpb: float,
 def ea_simple(key: jax.Array, pop: Population, toolbox, cxpb: float,
               mutpb: float, ngen: int, stats: Optional[Statistics] = None,
               halloffame_size: int = 0, verbose: bool = False,
-              telemetry=None, probes=(),
+              telemetry=None, probes=(), fused="auto",
               ) -> Tuple[Population, Logbook, Optional[HallOfFame]]:
     """The canonical generational GA (algorithms.py:85-189).
 
@@ -266,7 +425,8 @@ def ea_simple(key: jax.Array, pop: Population, toolbox, cxpb: float,
     :class:`deap_tpu.telemetry.RunTelemetry`) threads a Meter through
     the scan and journals the run; ``probes`` adds in-scan population
     probes (:mod:`deap_tpu.telemetry.probes`) to that meter. Results
-    are unchanged either way.
+    are unchanged either way. ``fused`` (see :func:`var_and`) picks the
+    variation-plane execution — bit-identical results in every mode.
     """
     tel = telemetry
     _check_probes(probes, tel)
@@ -280,7 +440,8 @@ def ea_simple(key: jax.Array, pop: Population, toolbox, cxpb: float,
         mstate0 = _tel_measure(tel, tel.meter.init(), record0["nevals"],
                                pop, jnp.int32(0))
 
-    step = make_ea_simple_step(toolbox, cxpb, mutpb, stats, tel)
+    step = make_ea_simple_step(toolbox, cxpb, mutpb, stats, tel,
+                               fused=fused)
 
     if tel is None:
         (pop, hof), records = lax.scan(step, (pop, hof),
@@ -319,7 +480,7 @@ def _build_logbook(record0, records, stats) -> Logbook:
 def make_ea_mu_plus_lambda_step(toolbox, mu: int, lambda_: int,
                                 cxpb: float, mutpb: float,
                                 stats: Optional[Statistics] = None,
-                                telemetry=None) -> Callable:
+                                telemetry=None, fused="auto") -> Callable:
     """The (μ + λ) generation step: varOr → evaluate invalid → select μ
     from the parent+offspring union (algorithms.py:248-337)."""
     tel = telemetry
@@ -330,7 +491,8 @@ def make_ea_mu_plus_lambda_step(toolbox, mu: int, lambda_: int,
         else:
             (pop, hof, mstate), (key, gen) = carry, xs
         k_var, k_sel = jax.random.split(key)
-        off = var_or(k_var, pop, toolbox, lambda_, cxpb, mutpb)
+        off = var_or(k_var, pop, toolbox, lambda_, cxpb, mutpb,
+                     fused=fused)
         nevals = jnp.sum(~off.valid)
         off = evaluate_invalid(off, toolbox.evaluate)
         pool = concat([pop, off])
@@ -354,7 +516,7 @@ def ea_mu_plus_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
                       lambda_: int, cxpb: float, mutpb: float, ngen: int,
                       stats: Optional[Statistics] = None,
                       halloffame_size: int = 0, verbose: bool = False,
-                      telemetry=None, probes=(),
+                      telemetry=None, probes=(), fused="auto",
                       ) -> Tuple[Population, Logbook, Optional[HallOfFame]]:
     """(μ + λ) evolution (algorithms.py:248-337): parents survive into the
     selection pool."""
@@ -373,7 +535,7 @@ def ea_mu_plus_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
                                pop, jnp.int32(0))
 
     step = make_ea_mu_plus_lambda_step(toolbox, mu, lambda_, cxpb,
-                                       mutpb, stats, tel)
+                                       mutpb, stats, tel, fused=fused)
 
     if tel is None:
         (pop, hof), records = lax.scan(step, (pop, hof),
@@ -393,7 +555,7 @@ def ea_mu_plus_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
 def make_ea_mu_comma_lambda_step(toolbox, mu: int, lambda_: int,
                                  cxpb: float, mutpb: float,
                                  stats: Optional[Statistics] = None,
-                                 telemetry=None) -> Callable:
+                                 telemetry=None, fused="auto") -> Callable:
     """The (μ, λ) generation step: varOr → evaluate invalid → select μ
     from the offspring only (algorithms.py:340-437)."""
     tel = telemetry
@@ -404,7 +566,8 @@ def make_ea_mu_comma_lambda_step(toolbox, mu: int, lambda_: int,
         else:
             (pop, hof, mstate), (key, gen) = carry, xs
         k_var, k_sel = jax.random.split(key)
-        off = var_or(k_var, pop, toolbox, lambda_, cxpb, mutpb)
+        off = var_or(k_var, pop, toolbox, lambda_, cxpb, mutpb,
+                     fused=fused)
         nevals = jnp.sum(~off.valid)
         off = evaluate_invalid(off, toolbox.evaluate)
         idx = toolbox.select(k_sel, off.wvalues, mu)
@@ -424,7 +587,7 @@ def ea_mu_comma_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
                        lambda_: int, cxpb: float, mutpb: float, ngen: int,
                        stats: Optional[Statistics] = None,
                        halloffame_size: int = 0, verbose: bool = False,
-                       telemetry=None, probes=(),
+                       telemetry=None, probes=(), fused="auto",
                        ) -> Tuple[Population, Logbook, Optional[HallOfFame]]:
     """(μ, λ) evolution (algorithms.py:340-437): only offspring survive."""
     assert lambda_ >= mu, "lambda must be greater or equal to mu."
@@ -443,7 +606,7 @@ def ea_mu_comma_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
                                pop, jnp.int32(0))
 
     step = make_ea_mu_comma_lambda_step(toolbox, mu, lambda_, cxpb,
-                                        mutpb, stats, tel)
+                                        mutpb, stats, tel, fused=fused)
 
     if tel is None:
         (pop, hof), records = lax.scan(step, (pop, hof),
@@ -533,7 +696,7 @@ def ea_generate_update(key: jax.Array, state: Any, toolbox, ngen: int,
                        spec: FitnessSpec,
                        stats: Optional[Statistics] = None,
                        halloffame_size: int = 0, verbose: bool = False,
-                       telemetry=None, probes=(),
+                       telemetry=None, probes=(), fused="auto",
                        ) -> Tuple[Any, Logbook, Optional[HallOfFame]]:
     """Ask-tell loop (algorithms.py:440-503) driving CMA-ES/PBIL/EMNA-style
     strategies:
@@ -542,8 +705,13 @@ def ea_generate_update(key: jax.Array, state: Any, toolbox, ngen: int,
     - ``toolbox.update``:   ``(state, genomes, values) -> state``
 
     The whole generate → evaluate → update cycle is one scanned step; the
-    strategy state is a pytree in the carry.
+    strategy state is a pytree in the carry. ``fused`` is accepted for
+    signature uniformity with the other three loops but is inert here:
+    this loop's variation lives inside the strategy's ``generate``
+    (there is no mate/mutate plane to fuse), so every mode computes the
+    same program.
     """
+    del fused  # no variation plane in the ask-tell loop (see docstring)
     lam, hof = _generate_update_init(toolbox, state, spec,
                                      halloffame_size)
     tel = telemetry

@@ -16,7 +16,15 @@ scan loops pay for but the reference never did become free here:
   allows); with cxpb=0.5/mutpb=0.1 that is ~2× the reference's work.
   Here the touched mask is concrete, so only touched rows are gathered
   and evaluated — exactly ``nevals`` of the reference loop
-  (algorithms.py:149-152).
+  (algorithms.py:149-152). Since PR 6 the touched/crossover/mutation
+  index compaction runs **on device** (``compaction='device'``, the
+  default): one jit draws the flags and prefix-sum-packs them into
+  cycle-padded index arrays (:func:`gp.interpreter.compact_indices`,
+  ``np.resize`` pad semantics), and the host reads back only the three
+  counts — the full-flag-array fetch + host ``np.nonzero``/``np.resize``
+  + index re-upload that used to serialise every generation's dispatch
+  is gone. ``compaction='host'`` keeps the PR-3 formulation as the
+  bit-parity oracle (tests/test_gp_compaction.py).
 - **Algebraic height limits.** ``static_limit`` re-derives every
   offspring's height from scratch (an O(L log L) all-ends query per
   variation operator — measured 2×28 ms/gen at pop=4096 on one CPU
@@ -49,10 +57,12 @@ from jax import lax
 
 from deap_tpu import ops
 from deap_tpu.gp.interpreter import (DEFAULT_CHUNK, _round_size,
+                                     compact_indices,
                                      make_batch_interpreter)
 from deap_tpu.gp.pset import PrimitiveSet
 from deap_tpu.gp.tree import (make_generator, prefix_depths, subtree_end,
                               _splice)
+from deap_tpu.support.profiling import span
 
 
 def _splice_depths(dep, i, e, donor_dep, di, donor_len, shift, ok):
@@ -79,11 +89,116 @@ def _height(dep, length):
     return jnp.max(jnp.where(live, dep, 0))
 
 
+def make_flag_compactor(cxpb: float, mutpb: float) -> Callable:
+    """The device half of the GP variation plane: one jit that draws
+    the generation's cx/mut Bernoullis AND compacts them into
+    cycle-padded index arrays (``np.resize`` semantics, see
+    :func:`deap_tpu.gp.interpreter.compact_indices`) — so the only
+    thing the host ever reads back is the three counts (12 bytes),
+    not the flag arrays themselves.
+
+    Returns ``flags_compact(key, n) -> (cx_idx [n//2], mut_idx [n],
+    touched_idx [n], counts int32[3])`` with the exact key-split tree
+    of the host path's ``draw_flags`` (bit-parity pinned by
+    tests/test_gp_compaction.py)."""
+
+    @partial(jax.jit, static_argnums=1)
+    def flags_compact(key, n: int):
+        k_pair, k_ind = jax.random.split(key)
+        do_cx = jax.random.bernoulli(k_pair, cxpb, (n // 2,))
+        do_mut = jax.random.bernoulli(k_ind, mutpb, (n,))
+        cx_idx, n_cx = compact_indices(do_cx, max(n // 2, 1))
+        mut_idx, n_mut = compact_indices(do_mut, n)
+        touched = do_mut
+        if n // 2:
+            touched = touched | jnp.zeros(n, bool).at[: 2 * (n // 2)].set(
+                jnp.repeat(do_cx, 2))
+        t_idx, n_t = compact_indices(touched, n)
+        return cx_idx, mut_idx, t_idx, jnp.stack([n_cx, n_mut, n_t])
+
+    return flags_compact
+
+
+def make_compaction_pipelines(cxpb: float, mutpb: float):
+    """The two variation-compaction pipelines isolated from the rest of
+    the loop — the paired measurement behind ``bench.py --fusion`` and
+    the parity suite. Each maps ``(key, n)`` to device-resident,
+    lattice-padded ``(cx_idx, mut_idx, touched_idx)`` plus the three
+    counts, ready for the cx/mut/eval dispatch; values are
+    bit-identical between the two (same draws, same ``np.resize``
+    cycle-pad rule).
+
+    - ``host_fn``: the PR-3 round trip — fetch both flag arrays,
+      ``np.nonzero``/``np.resize`` on the host, re-upload.
+    - ``device_fn``: one jit (draw + prefix-sum compaction), a 12-byte
+      count fetch, device-side lattice slices.
+    """
+
+    @partial(jax.jit, static_argnums=1)
+    def draw_flags(key, n: int):
+        k_pair, k_ind = jax.random.split(key)
+        return (jax.random.bernoulli(k_pair, cxpb, (n // 2,)),
+                jax.random.bernoulli(k_ind, mutpb, (n,)))
+
+    flags_compact = make_flag_compactor(cxpb, mutpb)
+
+    def host_fn(key, n: int):
+        do_cx, do_mut = draw_flags(key, n)
+        do_cx, do_mut = np.asarray(do_cx), np.asarray(do_mut)
+        pidx = np.nonzero(do_cx)[0]
+        midx = np.nonzero(do_mut)[0]
+        touched = np.zeros(n, bool)
+        touched[pidx * 2] = True
+        touched[pidx * 2 + 1] = True
+        touched[midx] = True
+        tidx = np.nonzero(touched)[0]
+        out = []
+        for idx, cap in ((pidx, max(n // 2, 1)), (midx, n), (tidx, n)):
+            P = min(_round_size(max(len(idx), 1)), cap)
+            padded = (np.resize(idx, P) if len(idx)
+                      else np.zeros(P, np.int32))
+            out.append(jnp.asarray(padded, jnp.int32))
+        jax.block_until_ready(out)
+        return tuple(out), (len(pidx), len(midx), len(tidx))
+
+    def device_fn(key, n: int):
+        cx_idx, mut_idx, t_idx, counts = flags_compact(key, n)
+        n_cx, n_mut, n_t = (int(c) for c in np.asarray(counts))
+        out = []
+        for idx, c, cap in ((cx_idx, n_cx, max(n // 2, 1)),
+                            (mut_idx, n_mut, n), (t_idx, n_t, n)):
+            P = min(_round_size(max(c, 1)), cap)
+            out.append(idx[:P])
+        jax.block_until_ready(out)
+        return tuple(out), (n_cx, n_mut, n_t)
+
+    return host_fn, device_fn
+
+
+def resolve_compaction(mode: str) -> str:
+    """``'auto'`` → the measured winner per backend: ``'device'`` on
+    accelerators (the host round trip is a real transfer+sync there,
+    and the prefix-sum compaction stays on device), ``'host'`` on the
+    CPU backend — where "device" IS the host, the flag fetch is a
+    memcpy, and numpy's serial nonzero scan is bandwidth-optimal:
+    measured host/device at pop=1k..100k on this box's CPU, the host
+    pipeline wins at every size (1.1-4x), so auto never picks a slower
+    path. Both modes are bit-identical (tests/test_gp_compaction.py).
+    """
+    if mode == "auto":
+        import jax as _jax
+        return "host" if _jax.default_backend() == "cpu" else "device"
+    if mode not in ("device", "host"):
+        raise ValueError(f"unknown compaction mode {mode!r}")
+    return mode
+
+
 def make_gp_loop(pset: PrimitiveSet, max_len: int, evaluate: Callable, *,
                  cxpb: float, mutpb: float, tournsize: int = 3,
                  height_limit: int = 17,
                  mut_min: int = 0, mut_max: int = 2,
                  mut_width: Optional[int] = None,
+                 compaction: str = "auto",
                  telemetry=None, probes=()) -> Callable:
     """Build ``run(key, genomes, ngen) -> result`` — the host-dispatch
     eaSimple-shaped GP loop (tournament selection, adjacent-pair
@@ -94,7 +209,12 @@ def make_gp_loop(pset: PrimitiveSet, max_len: int, evaluate: Callable, *,
     ``evaluate(genomes) -> f32[n]`` maximization fitness, called
     EAGERLY with concrete sub-populations — pair it with a
     ``make_batch_interpreter``/``make_population_evaluator`` evaluator
-    so the live-vocab/dedup/grouped dispatch engages. The result dict
+    so the live-vocab/dedup/grouped dispatch engages. ``compaction``
+    picks how the per-generation touched/cx/mut index sets are built:
+    ``'device'`` (default — jit'd prefix-sum compaction, only the three
+    counts cross to the host) or ``'host'`` (the PR-3
+    ``np.nonzero``/``np.resize`` round trip; bit-identical results,
+    kept as the parity oracle). The result dict
     carries the final population + depth arrays, the best individual,
     and the reference-comparable ``nevals`` per generation.
 
@@ -205,17 +325,24 @@ def make_gp_loop(pset: PrimitiveSet, max_len: int, evaluate: Callable, *,
             lambda a, s: a.at[mp].set(s), genomes, m_g)
         return genomes, depths.at[mp].set(m_d)
 
-    def vary(key, genomes, depths, n):
-        """Host-compacted var_and: crossover/mutation are computed only
-        for the rows the cxpb/mutpb draws actually touch (the scan
-        formulation computes every candidate and selects -- ~2x/10x the
-        work at the default rates), padded on the size lattice so
-        compacted shapes stay cache-warm. Semantics match var_and:
-        adjacent pairs mate with prob cxpb, every row then mutates with
-        prob mutpb, touched rows are invalidated."""
+    flags_compact = make_flag_compactor(cxpb, mutpb)
+    compaction = resolve_compaction(compaction)
+    _device_compaction = compaction == "device"
+
+    def vary_host(key, genomes, depths, n):
+        """Host-compacted var_and (the PR-3 formulation, kept as the
+        parity oracle): the flag arrays round-trip to the host, which
+        runs ``np.nonzero``/``np.resize`` and re-uploads the padded
+        index arrays — a full device sync in the middle of every
+        generation's dispatch. Crossover/mutation are computed only for
+        the rows the cxpb/mutpb draws actually touch, padded on the
+        size lattice so compacted shapes stay cache-warm. Semantics
+        match var_and: adjacent pairs mate with prob cxpb, every row
+        then mutates with prob mutpb, touched rows are invalidated."""
         k_draw, k_cx, k_mut = jax.random.split(key, 3)
         do_cx, do_mut = draw_flags(k_draw, n)
-        do_cx, do_mut = np.asarray(do_cx), np.asarray(do_mut)
+        with span("gp_loop/host_compaction_fetch"):
+            do_cx, do_mut = np.asarray(do_cx), np.asarray(do_mut)
 
         pidx = np.nonzero(do_cx)[0]
         if len(pidx):
@@ -234,7 +361,37 @@ def make_gp_loop(pset: PrimitiveSet, max_len: int, evaluate: Callable, *,
         touched[pidx * 2] = True
         touched[pidx * 2 + 1] = True
         touched[midx] = True
-        return genomes, depths, touched
+        tidx = np.nonzero(touched)[0]
+        return genomes, depths, tidx, len(tidx)
+
+    def vary_device(key, genomes, depths, n):
+        """On-device-compacted var_and: ONE jit draws the flags and
+        prefix-sum-compacts them into cycle-padded index arrays
+        (:func:`~deap_tpu.gp.interpreter.compact_indices`, bit-equal to
+        the host path's ``np.nonzero``+``np.resize``); the host reads
+        back only the three counts (12 bytes — needed anyway to pick
+        the lattice slice and for reference-exact ``nevals``), slices
+        the device arrays at lattice sizes, and dispatches. The flag
+        arrays, the nonzero scan, and the pad construction never leave
+        the device — the variation plane's full-array host sync is
+        gone (journaled as ``variation_dispatch``; the host path's
+        fetch is span-visible as ``gp_loop/host_compaction_fetch``,
+        absent here)."""
+        k_draw, k_cx, k_mut = jax.random.split(key, 3)
+        cx_idx, mut_idx, t_idx, counts = flags_compact(k_draw, n)
+        with span("gp_loop/compaction_count_fetch"):
+            n_cx, n_mut, n_t = (int(c) for c in np.asarray(counts))
+        if n_cx:
+            P = min(_round_size(n_cx), max(n // 2, 1))
+            genomes, depths = cx_apply(k_cx, genomes, depths,
+                                       cx_idx[:P])
+        if n_mut:
+            P = min(_round_size(n_mut), n)
+            genomes, depths = mut_apply(k_mut, genomes, depths,
+                                        mut_idx[:P])
+        return genomes, depths, t_idx, n_t
+
+    vary = vary_device if _device_compaction else vary_host
 
     tel = telemetry
     if probes and tel is None:
@@ -296,6 +453,15 @@ def make_gp_loop(pset: PrimitiveSet, max_len: int, evaluate: Callable, *,
 
     def init_state(key, genomes, ngen: int) -> dict:
         n = int(np.asarray(genomes["length"]).shape[0])
+        from deap_tpu.telemetry.journal import broadcast
+        broadcast("variation_dispatch", op="gp_loop", path=compaction,
+                  n=n,
+                  # what the variation plane reads back per generation:
+                  # three count scalars (device) vs both flag arrays
+                  # (host, 1 byte/bool) — the journal evidence that the
+                  # device path's compaction runs without a host sync
+                  host_fetch_bytes_per_gen=(
+                      12 if _device_compaction else n // 2 + n))
         depths = depths_of(genomes)
         fit = evaluate(genomes)
         state = {"gen": 0, "genomes": genomes, "depths": depths,
@@ -323,18 +489,20 @@ def make_gp_loop(pset: PrimitiveSet, max_len: int, evaluate: Callable, *,
         k_sel, k_var = jax.random.split(k)
         genomes, depths, fit, sel_idx = select(k_sel, genomes,
                                                depths, fit)
-        genomes, depths, touched = vary(k_var, genomes, depths, n)
-        idx = np.nonzero(touched)[0]
-        ne = len(idx)
+        genomes, depths, t_idx, ne = vary(k_var, genomes, depths, n)
         state["nevals"].append(ne)
         if ne:
-            padded = np.resize(idx, min(_round_size(ne), n))
-            sub = jax.tree_util.tree_map(
-                lambda a: a[jnp.asarray(padded)], genomes)
+            P = min(_round_size(ne), n)
+            # identical padded index values either way: the device
+            # array is already cycle-padded (np.resize semantics), the
+            # host array cycles here
+            padded = (t_idx[:P] if _device_compaction
+                      else jnp.asarray(np.resize(t_idx, P)))
+            sub = jax.tree_util.tree_map(lambda a: a[padded], genomes)
             w = evaluate(sub)
             # full-padded scatter (cycled duplicates agree) — see
-            # _scatter in vary for the shape-class rationale
-            fit = fit.at[jnp.asarray(padded)].set(w)
+            # vary_host for the shape-class rationale
+            fit = fit.at[padded].set(w)
         best_i = int(jnp.argmax(fit))
         if float(fit[best_i]) > state["best_fitness"]:
             state["best_genome"] = jax.tree_util.tree_map(
@@ -369,6 +537,10 @@ def make_gp_loop(pset: PrimitiveSet, max_len: int, evaluate: Callable, *,
 
     run.select = select              # exposed for tests
     run.vary = vary
+    run.vary_host = vary_host        # parity oracle (tests/bench)
+    run.vary_device = vary_device
+    run.flags_compact = flags_compact
+    run.compaction = compaction
     run.depths_of = depths_of
     run.init_state = init_state     # segmented driving (resilience)
     run.advance = advance

@@ -57,9 +57,15 @@ from deap_tpu.ops.kernels import (
     dominated_counts,
     dominated_weight_maxes,
     dominated_weight_sums,
+    fused_variation,
     fused_variation_eval,
     nd_rank_tiled,
     strengths_tiled,
+)
+from deap_tpu.ops.variation import (
+    VariationPlan,
+    apply_variation,
+    resolve_plan,
 )
 from deap_tpu.ops.kernels_real import (
     eval_rastrigin,
@@ -91,6 +97,7 @@ from deap_tpu.ops.selection import (
     sel_tournament_binned,
     sel_tournament_sorted,
     sel_worst,
+    tournament_aspirants,
 )
 
 # DEAP-style aliases (reference names → tensor ops)

@@ -37,6 +37,17 @@ def cx_one_point(key, g1, g2):
     return jnp.where(mask, g2, g1), jnp.where(mask, g1, g2)
 
 
+def _one_point_segment(key, size):
+    """``cx_one_point``'s cut as a half-open swap segment — the SAME
+    single randint draw from the whole key, so the fused variation
+    plane (ops.variation) reproduces the operator's bits exactly."""
+    point = jax.random.randint(key, (), 1, size)
+    return point, jnp.int32(size)
+
+
+cx_one_point.fused_segment_draw = _one_point_segment
+
+
 def _two_points(key, size):
     """The reference's two-point draw (crossover.py:44-50): p1 ~ U{1..L}
     (randint is inclusive there), p2 ~ U{1..L-1} bumped past p1 — a
@@ -55,6 +66,11 @@ def cx_two_point(key, g1, g2):
     idx = jnp.arange(g1.shape[0])
     mask = (idx >= lo) & (idx < hi)
     return jnp.where(mask, g2, g1), jnp.where(mask, g1, g2)
+
+
+# the fused variation plane consumes _two_points directly: the swap
+# segment [lo, hi) IS the operator's whole randomness
+cx_two_point.fused_segment_draw = _two_points
 
 
 def cx_uniform(key, g1, g2, indpb):

@@ -48,6 +48,7 @@ __all__ = [
     "dominated_weight_maxes",
     "strengths_tiled",
     "nd_rank_tiled",
+    "fused_variation",
     "fused_variation_eval",
     "run_fused_kernel",
     "gp_grouped_dispatch",
@@ -397,6 +398,148 @@ def gp_grouped_dispatch(buf: jnp.ndarray, chunk_ops: jnp.ndarray,
             dimension_semantics=("arbitrary",)),
         interpret=interp,
     )(chunk_ops, src_idx, src_const, isc, buf)
+
+
+# ------------------------------------------------ fused variation plane ----
+
+def _fused_variation_kernel(si_ref, pi_ref, cx_ref, lo_ref, hi_ref,
+                            mut_ref, mask_ref, arg_ref, g_ref, out_ref,
+                            selfb, partb, sem, *, mut_kind):
+    """One [TI, Lp] output tile of the mask-driven variation plane:
+    DMA each row's self + partner genomes straight out of the (ANY-
+    space) population, segment-swap where the crossover mask says so,
+    apply the mutation mask — one VMEM residency per genome row.
+    ``arg_ref`` is ``None`` for the 'flip' kind (the wrapper drops the
+    input entirely rather than streaming a dead [n, Lp] tensor)."""
+    TI, Lp = selfb.shape
+
+    def fetch(k, _):
+        cp = pltpu.make_async_copy(g_ref.at[si_ref[k]], selfb.at[k], sem)
+        cp.start()
+        cp.wait()
+        cp = pltpu.make_async_copy(g_ref.at[pi_ref[k]], partb.at[k], sem)
+        cp.start()
+        cp.wait()
+        return 0
+
+    lax.fori_loop(0, TI, fetch, 0, unroll=False)
+    col = jax.lax.broadcasted_iota(jnp.int32, (TI, Lp), 1)
+    seg = (cx_ref[:] > 0.5) & (col >= lo_ref[:]) & (col < hi_ref[:])
+    child = jnp.where(seg, partb[:], selfb[:])
+    if mut_kind == "flip":
+        mval = 1.0 - child
+    elif mut_kind == "add":
+        mval = child + arg_ref[:]
+    else:  # 'set'
+        mval = arg_ref[:]
+    m = (mut_ref[:] > 0.5) & (mask_ref[:] > 0.5)
+    out_ref[:] = jnp.where(m, mval, child)
+
+
+def fused_variation(genomes: jnp.ndarray, src_idx: jnp.ndarray,
+                    partner_idx: jnp.ndarray, cx_row: jnp.ndarray,
+                    lo: jnp.ndarray, hi: jnp.ndarray,
+                    mut_row: jnp.ndarray, mut_mask: jnp.ndarray,
+                    mut_arg: Optional[jnp.ndarray] = None, *,
+                    mut_kind: str = "flip", block_i: int = 256,
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Tournament-select gather + paired segment crossover + per-gene
+    mutation in ONE pass over the population — the Pallas apply of the
+    fused variation plane (:mod:`deap_tpu.ops.variation`).
+
+    The caller computes the selection winners (``src_idx`` — e.g.
+    tournament winners from :func:`ops.selection.tournament_aspirants`,
+    whose fitness-plane work is tiny) and the variation masks with the
+    unfused operators' exact RNG draws; this kernel then does ALL the
+    genome-plane work in one sweep: each output row's self and partner
+    parents are DMA'd from HBM into VMEM (the selection gather, the
+    crossover partner gather), the swap segment ``[lo, hi)`` is applied
+    where ``cx_row``, and the mutation mask rewrites genes where
+    ``mut_row & mut_mask`` — against the unfused chain's 6+ HBM sweeps
+    (gather, both crossover children, interleave, mutant population,
+    final selects). Bit-parity with
+    :func:`ops.variation.apply_variation` is pinned in
+    tests/test_kernels.py (interpret mode; f32 ops are IEEE-identical).
+
+    :param genomes: ``[N, L]`` population (bool / 0-1 ints / float32).
+    :param src_idx: ``int32[n]`` self-parent row per output row.
+    :param partner_idx: ``int32[n]`` crossover-partner row.
+    :param cx_row: ``bool[n]`` crossover applies to this row.
+    :param lo: ``int32[n]`` / ``hi``: the half-open swap segment.
+    :param mut_row: ``bool[n]`` mutation applies to this row.
+    :param mut_mask: ``bool[n, L]`` per-gene mutation mask.
+    :param mut_arg: ``[n, L]`` additive noise (``'add'``) or
+        replacement values (``'set'``); ``None`` for ``'flip'``.
+    :param mut_kind: ``'flip' | 'add' | 'set'``.
+    :returns: ``[n, L]`` children in the input dtype.
+    """
+    if mut_kind not in ("flip", "add", "set"):
+        raise ValueError(f"unknown mut_kind {mut_kind!r}")
+    if mut_kind != "flip" and mut_arg is None:
+        raise ValueError(f"mut_kind={mut_kind!r} needs mut_arg")
+    n = src_idx.shape[0]
+    N, L = genomes.shape
+    interp = _auto_interpret(interpret)
+    Lp = _round_up(L, 128)
+    ni = _round_up(n, block_i)
+    g = jnp.pad(genomes.astype(jnp.float32), ((0, 0), (0, Lp - L)))
+    pad1 = lambda a: jnp.pad(a, (0, ni - n))
+    # padded rows: index 0 (a real row — harmless), flags 0 → identity;
+    # the tail is sliced off before returning
+    si = pad1(src_idx.astype(jnp.int32))
+    pi = pad1(partner_idx.astype(jnp.int32))
+    cxf = pad1(cx_row.astype(jnp.float32))[:, None]
+    mutf = pad1(mut_row.astype(jnp.float32))[:, None]
+    lo2 = pad1(lo.astype(jnp.int32))[:, None]
+    hi2 = pad1(hi.astype(jnp.int32))[:, None]
+    mask = jnp.pad(mut_mask.astype(jnp.float32),
+                   ((0, ni - n), (0, Lp - L)))
+
+    ispec = lambda: pl.BlockSpec((block_i,), lambda i: (i,),
+                                 memory_space=pltpu.SMEM)
+    vrow = lambda: pl.BlockSpec((block_i, 1), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM)
+    vtile = lambda: pl.BlockSpec((block_i, Lp), lambda i: (i, 0),
+                                 memory_space=pltpu.VMEM)
+    in_specs = [ispec(), ispec(), vrow(), vrow(), vrow(), vrow(),
+                vtile()]
+    inputs = [si, pi, cxf, lo2, hi2, mutf, mask]
+    if mut_kind == "flip":
+        kernel = functools.partial(
+            lambda *refs, mut_kind: _fused_variation_kernel(
+                *refs[:7], None, *refs[7:], mut_kind=mut_kind),
+            mut_kind=mut_kind)
+    else:
+        arg = jnp.pad(mut_arg.astype(jnp.float32),
+                      ((0, ni - n), (0, Lp - L)))
+        in_specs.append(vtile())
+        inputs.append(arg)
+        kernel = functools.partial(_fused_variation_kernel,
+                                   mut_kind=mut_kind)
+    in_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))
+    inputs.append(g)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        grid=(ni // block_i,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((block_i, Lp), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((block_i, Lp), jnp.float32),
+            pltpu.VMEM((block_i, Lp), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((ni, Lp), jnp.float32),
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interp,
+    )(*inputs)
+    return out[:n, :L].astype(genomes.dtype)
 
 
 # ------------------------------------------------- fused bitstring varAnd ----

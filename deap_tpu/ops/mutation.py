@@ -31,6 +31,26 @@ def mut_gaussian(key, g, mu, sigma, indpb):
     return jnp.where(mask, g + noise, g)
 
 
+# --- fused-plan factories (ops.variation) ------------------------------
+#
+# Each factory takes the operator's bound keyword parameters and returns
+# ``(kind, draw)`` where ``draw(key, L, dtype) -> (mask, arg)``
+# reproduces the operator's internal jax.random calls bit-exactly —
+# same key splits, same shapes, same dtypes — so the fused variation
+# plane's masked apply computes the identical child rows.
+
+def _gaussian_fused(mu, sigma, indpb):
+    def draw(key, L, dtype):
+        km, kn = jax.random.split(key)
+        mask = jax.random.bernoulli(km, indpb, (L,))
+        noise = mu + sigma * jax.random.normal(kn, (L,), dtype=dtype)
+        return mask, noise
+    return "add", draw
+
+
+mut_gaussian.fused_plan = _gaussian_fused
+
+
 def mut_polynomial_bounded(key, g, eta, low, up, indpb):
     """Deb's polynomial bounded mutation (mutation.py:51-97), per-gene
     with prob indpb, clipped to [low, up]."""
@@ -79,6 +99,16 @@ def mut_flip_bit(key, g, indpb):
     return jnp.where(mask, flipped, g)
 
 
+def _flip_bit_fused(indpb):
+    def draw(key, L, dtype):
+        del dtype  # flip needs no values, only the operator's mask bits
+        return jax.random.bernoulli(key, indpb, (L,)), None
+    return "flip", draw
+
+
+mut_flip_bit.fused_plan = _flip_bit_fused
+
+
 def mut_uniform_int(key, g, low, up, indpb):
     """Integer replacement (mutation.py:145-172): redraw in [low, up]
     (inclusive) with prob indpb."""
@@ -90,6 +120,21 @@ def mut_uniform_int(key, g, low, up, indpb):
     u = jax.random.uniform(kv, g.shape)
     draw = (low_a + jnp.floor(u * (up_a - low_a + 1))).astype(g.dtype)
     return jnp.where(mask, draw, g)
+
+
+def _uniform_int_fused(low, up, indpb):
+    def draw(key, L, dtype):
+        km, kv = jax.random.split(key)
+        mask = jax.random.bernoulli(km, indpb, (L,))
+        low_a = jnp.broadcast_to(jnp.asarray(low, dtype), (L,))
+        up_a = jnp.broadcast_to(jnp.asarray(up, dtype), (L,))
+        u = jax.random.uniform(kv, (L,))
+        val = (low_a + jnp.floor(u * (up_a - low_a + 1))).astype(dtype)
+        return mask, val
+    return "set", draw
+
+
+mut_uniform_int.fused_plan = _uniform_int_fused
 
 
 def mut_es_log_normal(key, g, strategy, c, indpb):

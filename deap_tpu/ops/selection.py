@@ -60,10 +60,21 @@ def sel_worst(key, w, k):
     return _lex_sort_asc(w)[:k]
 
 
+def tournament_aspirants(key, n, k, tournsize):
+    """The tournament's aspirant draw, factored out so every consumer
+    shares one RNG contract: :func:`sel_tournament` resolves winners
+    from it here, and the fused variation plane
+    (:mod:`deap_tpu.ops.variation`) composes those winners straight
+    into its one-pass gather+crossover+mutation apply — selection's
+    genome-plane gather never materialises separately, and bit-parity
+    between the fused and unfused generation steps holds by
+    construction."""
+    return jax.random.randint(key, (k, tournsize), 0, n)
+
+
 def sel_tournament(key, w, k, tournsize):
     """k tournaments of tournsize uniform aspirants (selection.py:51-69)."""
-    n = w.shape[0]
-    aspirants = jax.random.randint(key, (k, tournsize), 0, n)
+    aspirants = tournament_aspirants(key, w.shape[0], k, tournsize)
     return _tournament_winners(w, aspirants)
 
 

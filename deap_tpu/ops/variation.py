@@ -1,0 +1,250 @@
+"""Fused variation plane — one-pass select-gather + crossover + mutation.
+
+The reference's generation step (``varAnd``, algorithms.py:33-82) and our
+:func:`deap_tpu.algorithms.var_and` port both execute the variation
+plane as a chain of separate ops: materialise the selection gather,
+compute both crossover children for every pair, interleave, compute a
+full mutant population, and select between them row by row — at
+pop = 100k × 100 genes that is six-plus full HBM sweeps of the genome
+plane per generation. This module collapses the chain into **one pass**
+while staying **bit-identical** to the unfused composition:
+
+- every random draw (pair/row Bernoullis, crossover points, per-gene
+  mutation masks and values) is replicated with *exactly* the key-split
+  tree and jax.random calls of the unfused operators — see
+  :func:`var_and_masks` / :func:`var_or_masks`;
+- the apply step (:func:`apply_variation`) is then a pure function of
+  those masks: per output row, gather self + partner (composing the
+  selection indices, so selection's genome-plane gather never
+  materialises separately), one segment-select for crossover, one
+  masked write for mutation. Selects and adds of identical operands are
+  bit-identical to the unfused ``where`` chains by construction —
+  pinned by tests/test_fused_variation.py across all four EA loops.
+
+Recognition is capability-based: crossover operators advertise a
+``fused_segment_draw`` attribute (the draw that reproduces their cut
+points — :mod:`deap_tpu.ops.crossover` tags ``cx_one_point`` and
+``cx_two_point``) and mutation operators a ``fused_plan`` factory
+(:mod:`deap_tpu.ops.mutation` tags ``mut_flip_bit``, ``mut_gaussian``,
+``mut_uniform_int``). Anything else — or a genome pytree that is not a
+single ``[n, L]`` array — falls back to the unfused composition, which
+is bit-identical anyway; the decision is journaled as a
+``variation_dispatch`` event either way.
+
+Two apply backends share the mask contract:
+
+- ``'xla'`` — the fused formulation below: XLA fuses the mask logic
+  into the two gathers' consumers, so the plane is ~3 genome sweeps
+  instead of 6+. The CPU/GPU path, and the default off-TPU.
+- ``'kernel'`` — :func:`deap_tpu.ops.kernels.fused_variation`: a
+  Pallas kernel that DMAs each tile's self/partner rows straight out
+  of HBM and applies crossover + mutation in VMEM, one genome sweep.
+  TPU only (the Pallas interpreter would be slower than XLA); its
+  interpret-mode bit-parity against this module's XLA apply is pinned
+  in tests/test_kernels.py.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["VariationPlan", "resolve_plan", "var_and_masks",
+           "var_or_masks", "apply_variation", "pair_partner_positions"]
+
+
+class VariationPlan(NamedTuple):
+    """The fused plane's static description of a (mate, mutate) pair.
+
+    ``mate_draw(key, L) -> (lo, hi)`` reproduces the crossover
+    operator's cut draw as a half-open swap segment ``[lo, hi)``;
+    ``mut_draw(key, L, dtype) -> (mask, arg)`` reproduces the mutation
+    operator's per-gene draws (``arg`` is ``None`` for ``'flip'``, the
+    additive noise for ``'add'``, the replacement values for
+    ``'set'``)."""
+
+    mate_draw: Callable
+    mate_name: str
+    mut_kind: str  # 'flip' | 'add' | 'set'
+    mut_draw: Callable
+    mut_name: str
+
+
+def _partial_parts(op) -> Tuple[Callable, tuple, dict]:
+    fn = getattr(op, "func", op)
+    args = tuple(getattr(op, "args", ()) or ())
+    kwargs = dict(getattr(op, "keywords", {}) or {})
+    return fn, args, kwargs
+
+
+def resolve_plan(toolbox) -> Optional[VariationPlan]:
+    """A :class:`VariationPlan` for ``toolbox``'s (mate, mutate) pair,
+    or ``None`` when either operator lacks fused support. Bound
+    operator parameters must be keywords (the reference registration
+    style, ``tb.register("mutate", mut_flip_bit, indpb=0.05)``);
+    positional binds shift the ``(key, genome)`` call signature and are
+    not recognised."""
+    mate = getattr(toolbox, "mate", None)
+    mutate = getattr(toolbox, "mutate", None)
+    if mate is None or mutate is None:
+        return None
+    mate_fn, mate_args, mate_kwargs = _partial_parts(mate)
+    mut_fn, mut_args, mut_kwargs = _partial_parts(mutate)
+    seg_draw = getattr(mate_fn, "fused_segment_draw", None)
+    mut_factory = getattr(mut_fn, "fused_plan", None)
+    if seg_draw is None or mut_factory is None:
+        return None
+    if mate_args or mate_kwargs or mut_args:
+        return None
+    try:
+        mut_kind, mut_draw = mut_factory(**mut_kwargs)
+    except TypeError:  # missing/unknown bound params: not this config
+        return None
+    return VariationPlan(
+        mate_draw=seg_draw,
+        mate_name=getattr(mate_fn, "__name__", "?"),
+        mut_kind=mut_kind,
+        mut_draw=mut_draw,
+        mut_name=getattr(mut_fn, "__name__", "?"),
+    )
+
+
+def single_genome_leaf(genomes) -> Optional[jnp.ndarray]:
+    """The ``[n, L]`` array of a single-leaf genome pytree, or ``None``
+    when the structure is not one the fused plane handles."""
+    leaves = jax.tree_util.tree_leaves(genomes)
+    if len(leaves) != 1 or leaves[0].ndim != 2:
+        return None
+    return leaves[0]
+
+
+def pair_partner_positions(n: int) -> jnp.ndarray:
+    """Row ``i``'s adjacent-pair mate: ``i ^ 1``, clamped so an odd
+    trailing row partners itself (it never mates — var_and's zip
+    drop)."""
+    pos = jnp.arange(n, dtype=jnp.int32)
+    return jnp.minimum(pos ^ 1, n - 1)
+
+
+# ------------------------------------------------------------- var_and ----
+
+def var_and_masks(key: jax.Array, n: int, L: int, cxpb: float,
+                  mutpb: float, plan: VariationPlan, dtype):
+    """Replicate :func:`deap_tpu.algorithms.var_and`'s draw tree
+    bit-exactly, expanded to row level.
+
+    Returns ``(cx_row [n], lo [n], hi [n], do_mut [n], mask [n, L],
+    arg [n, L] | None)`` — the same bits the unfused composition would
+    have consumed: ``split(key, 4)`` into pair/cx/row/mut keys, the
+    crossover draw vmapped over ``split(k_cx, npairs)``, the mutation
+    draw vmapped over ``split(k_mut, n)``."""
+    npairs = n // 2
+    k_pair, k_cx, k_ind, k_mut = jax.random.split(key, 4)
+
+    if npairs:
+        cx_keys = jax.random.split(k_cx, npairs)
+        lo_p, hi_p = jax.vmap(lambda k: plan.mate_draw(k, L))(cx_keys)
+        do_cx = jax.random.bernoulli(k_pair, cxpb, (npairs,))
+        rep = lambda a: jnp.zeros(n, a.dtype).at[: 2 * npairs].set(
+            jnp.repeat(a, 2))
+        cx_row = jnp.zeros(n, bool).at[: 2 * npairs].set(
+            jnp.repeat(do_cx, 2))
+        lo = rep(lo_p.astype(jnp.int32))
+        hi = rep(hi_p.astype(jnp.int32))
+    else:
+        cx_row = jnp.zeros(n, bool)
+        lo = jnp.zeros(n, jnp.int32)
+        hi = jnp.zeros(n, jnp.int32)
+
+    mut_keys = jax.random.split(k_mut, n)
+    mask, arg = jax.vmap(lambda k: plan.mut_draw(k, L, dtype))(mut_keys)
+    do_mut = jax.random.bernoulli(k_ind, mutpb, (n,))
+    return cx_row, lo, hi, do_mut, mask, arg
+
+
+# -------------------------------------------------------------- var_or ----
+
+def var_or_masks(key: jax.Array, n: int, lambda_: int, L: int,
+                 cxpb: float, mutpb: float, plan: VariationPlan, dtype):
+    """Replicate :func:`deap_tpu.algorithms.var_or`'s draw tree
+    bit-exactly. Returns ``(base_idx [λ], partner_idx [λ], choice_cx,
+    lo, hi, choice_mut, mask, arg)`` — base/partner compose the
+    parent gathers into the fused apply."""
+    k_u, k_p1, k_p2, k_pm, k_cx, k_mut = jax.random.split(key, 6)
+    u = jax.random.uniform(k_u, (lambda_,))
+    choice_cx = u < cxpb
+    choice_mut = (u >= cxpb) & (u < cxpb + mutpb)
+
+    i = jax.random.randint(k_p1, (lambda_,), 0, n)
+    j = jax.random.randint(k_p2, (lambda_,), 0, n - 1)
+    j = jnp.where(j >= i, j + 1, j)
+    m = jax.random.randint(k_pm, (lambda_,), 0, n)
+    base_idx = jnp.where(choice_cx, i, m)
+
+    cx_keys = jax.random.split(k_cx, lambda_)
+    lo, hi = jax.vmap(lambda k: plan.mate_draw(k, L))(cx_keys)
+    mut_keys = jax.random.split(k_mut, lambda_)
+    mask, arg = jax.vmap(lambda k: plan.mut_draw(k, L, dtype))(mut_keys)
+    return (base_idx, j, choice_cx, lo.astype(jnp.int32),
+            hi.astype(jnp.int32), choice_mut, mask, arg)
+
+
+# --------------------------------------------------------------- apply ----
+
+def _pair_swapped(rows: jnp.ndarray) -> jnp.ndarray:
+    """Rows with each adjacent pair's members exchanged (an odd tail
+    row maps to itself) — the var_and partner view, built by reshaping
+    the already-gathered rows instead of a second full gather."""
+    n = rows.shape[0]
+    npairs = n // 2
+    if npairs == 0:
+        return rows
+    head = rows[: 2 * npairs].reshape(npairs, 2, -1)[:, ::-1, :]
+    head = head.reshape(2 * npairs, rows.shape[-1])
+    if n == 2 * npairs:
+        return head
+    return jnp.concatenate([head, rows[2 * npairs:]], axis=0)
+
+
+def apply_variation(genomes: jnp.ndarray,
+                    src_idx: Optional[jnp.ndarray],
+                    partner_idx: Optional[jnp.ndarray],
+                    cx_row: jnp.ndarray, lo: jnp.ndarray,
+                    hi: jnp.ndarray, mut_row: jnp.ndarray,
+                    mut_mask: jnp.ndarray,
+                    mut_arg: Optional[jnp.ndarray], mut_kind: str,
+                    ) -> jnp.ndarray:
+    """The fused XLA apply: composed gather(s) + one segment select +
+    one masked mutation write.
+
+    ``out[r] = mut(cx(genomes[src_idx[r]], genomes[partner_idx[r]]))``
+    where crossover swaps columns ``[lo[r], hi[r])`` when ``cx_row[r]``
+    and mutation rewrites ``mut_mask[r]`` genes when ``mut_row[r]`` —
+    bit-identical to the unfused compute-both-then-select chains for
+    the same masks. ``src_idx=None`` means rows are already in place.
+    ``partner_idx=None`` means adjacent-pair partners (the var_and
+    pairing): the partner view is then a pair-swap reshape of the
+    already-gathered rows, so the whole plane costs ONE genome gather
+    where the unfused chain pays a gather plus an interleave copy plus
+    the discarded-candidate intermediates.
+    """
+    self_rows = (genomes if src_idx is None
+                 else jnp.take(genomes, src_idx, axis=0))
+    partner_rows = (_pair_swapped(self_rows) if partner_idx is None
+                    else jnp.take(genomes, partner_idx, axis=0))
+    L = genomes.shape[-1]
+    col = jnp.arange(L, dtype=jnp.int32)[None, :]
+    seg = cx_row[:, None] & (col >= lo[:, None]) & (col < hi[:, None])
+    child = jnp.where(seg, partner_rows, self_rows)
+    if mut_kind == "flip":
+        mval = (~child.astype(bool)).astype(child.dtype)
+    elif mut_kind == "add":
+        mval = child + mut_arg
+    elif mut_kind == "set":
+        mval = mut_arg
+    else:
+        raise ValueError(f"unknown mut_kind {mut_kind!r}")
+    m = mut_row[:, None] & mut_mask
+    return jnp.where(m, mval, child)

@@ -34,7 +34,15 @@ Three planes:
   journaled, fallback to the newest valid step). Checkpoint ``meta``
   carries the run id, so a resumed run journals ``resumed`` with
   ``resumed_from`` and ``telemetry/report.py`` stitches the segments
-  into one timeline.
+  into one timeline. Saves are **double-buffered** by default
+  (:class:`~deap_tpu.support.checkpoint.AsyncCheckpointWriter`): the
+  boundary state is snapshotted synchronously (immutable leaves +
+  async device→host copy) and serialized/fsync'd by a background
+  thread while the next segment computes — drained before the next
+  boundary's write, before any ``Preempted`` raise, and before the
+  drive returns, so durability and bit-exactness are unchanged while
+  the segmented-run overhead drops under the tightened 1.5% gate
+  (``bench.py --resilience``).
 - **Failure handling** — segment execution is wrapped in transient
   -error classification (:func:`classify_error`) with bounded
   retry/backoff (:class:`RetryPolicy`); each retry is journaled as a
@@ -61,7 +69,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from deap_tpu import algorithms as algos
-from deap_tpu.support.checkpoint import Checkpointer
+from deap_tpu.support.checkpoint import AsyncCheckpointWriter, Checkpointer
 
 __all__ = ["Preempted", "RetryPolicy", "ResilientRun", "classify_error",
            "quarantine_non_finite", "QUARANTINE_PENALTY"]
@@ -408,6 +416,18 @@ class ResilientRun:
     :param handle_signals: install SIGTERM/SIGINT handlers for the
         duration of the drive (main thread only; off-thread drives
         skip installation silently).
+    :param double_buffer: overlap each boundary checkpoint's
+        serialize+fsync with the NEXT segment's compute: the state is
+        snapshotted synchronously (tree-flattened immutable leaves +
+        async device→host copy), written by a background thread, and
+        the write is always drained before the next boundary's write,
+        before a ``Preempted`` raise, and before the drive returns —
+        so every durability and bit-exactness guarantee of the
+        synchronous path is preserved while the resilience tax drops
+        toward zero (``bench.py --resilience``, gate 1.5%). Forced off
+        when a ``fault_plan`` is present: the chaos harness's event
+        schedule (corrupt-after-save etc.) assumes the file exists the
+        moment ``saved`` fires.
     :param fault_plan: a deterministic
         :class:`~deap_tpu.resilience.faultinject.FaultPlan` — test
         harness hook, inert in production.
@@ -417,7 +437,8 @@ class ResilientRun:
                  keep: int = 3, telemetry=None,
                  retry: Optional[RetryPolicy] = None,
                  degrade_cb: Optional[Callable] = None,
-                 handle_signals: bool = True, fault_plan=None,
+                 handle_signals: bool = True,
+                 double_buffer: bool = True, fault_plan=None,
                  run_id: Optional[str] = None):
         if isinstance(checkpoints, Checkpointer):
             self.ckpt = checkpoints
@@ -431,6 +452,9 @@ class ResilientRun:
         self.degrade_cb = degrade_cb
         self.handle_signals = bool(handle_signals)
         self.fault_plan = fault_plan
+        # chaos plans fire on 'saved' with the path in hand — only the
+        # synchronous save satisfies that contract
+        self.double_buffer = bool(double_buffer) and fault_plan is None
         if run_id is None and telemetry is not None:
             run_id = telemetry.journal.run_id
         self.run_id = run_id or hex(int(time.time() * 1e6))[2:]
@@ -595,26 +619,50 @@ class ResilientRun:
                                 segment_len=self.segment_len)
         state["_resilience"]["run_id"] = self.run_id
 
-        with self._signals():
-            gen = int(state["gen"])
-            while gen < total and not spec.stop_requested(state):
-                hi = min(gen + self.segment_len, total)
-                self._fault("segment_start", lo=gen, hi=hi)
-                state = self._run_segment(spec, state, gen, hi)
-                self._fault("segment_end", lo=gen, hi=hi)
-                path = self.ckpt.save(hi, state,
-                                      meta=dict(state["_resilience"],
-                                                step=hi))
-                self.last_step = hi
-                self._journal_event("segment", algorithm=spec.algorithm,
-                                    lo=gen, hi=hi, path=path)
-                self._fault("saved", lo=gen, hi=hi, path=path)
-                gen = hi
-                if self.preempt_requested:
+        writer = AsyncCheckpointWriter() if self.double_buffer else None
+        try:
+            with self._signals():
+                gen = int(state["gen"])
+                while gen < total and not spec.stop_requested(state):
+                    hi = min(gen + self.segment_len, total)
+                    self._fault("segment_start", lo=gen, hi=hi)
+                    state = self._run_segment(spec, state, gen, hi)
+                    self._fault("segment_end", lo=gen, hi=hi)
+                    meta = dict(state["_resilience"], step=hi)
+                    if writer is not None:
+                        # double-buffered: snapshot now, write in the
+                        # background; submit() first drains the PREVIOUS
+                        # boundary's write, which by then has overlapped
+                        # with this whole segment's compute
+                        path = writer.submit(self.ckpt, hi, state,
+                                             meta=meta)
+                    else:
+                        path = self.ckpt.save(hi, state, meta=meta)
+                    self.last_step = hi
+                    self._journal_event("segment",
+                                        algorithm=spec.algorithm,
+                                        lo=gen, hi=hi, path=path,
+                                        async_save=writer is not None)
+                    self._fault("saved", lo=gen, hi=hi, path=path)
+                    gen = hi
+                    if self.preempt_requested:
+                        if writer is not None:
+                            writer.wait()  # durable before we claim so
+                        self._journal_event(
+                            "preempted", algorithm=spec.algorithm,
+                            step=gen, signum=self._preempt_signum)
+                        raise Preempted(gen, path,
+                                        self._preempt_signum or 0)
+            if writer is not None:
+                writer.wait()  # surface any background write error
+        except BaseException:
+            if writer is not None:
+                try:  # the final good write should still land
+                    writer.wait()
+                except Exception as e:
                     self._journal_event(
-                        "preempted", algorithm=spec.algorithm,
-                        step=gen, signum=self._preempt_signum)
-                    raise Preempted(gen, path, self._preempt_signum or 0)
+                        "checkpoint_write_failed", error=repr(e)[:300])
+            raise
         return spec.finalize(state)
 
     def _run_segment(self, spec, state, lo, hi):

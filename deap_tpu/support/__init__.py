@@ -21,6 +21,7 @@ from deap_tpu.support.profiling import (
     trace,
 )
 from deap_tpu.support.checkpoint import (
+    AsyncCheckpointWriter,
     CheckpointCorruptError,
     Checkpointer,
     checkpoint_meta,
@@ -28,6 +29,7 @@ from deap_tpu.support.checkpoint import (
     save_state,
     verify_checkpoint,
 )
+from deap_tpu.support import compilecache
 
 __all__ = [
     "Statistics",
@@ -54,9 +56,11 @@ __all__ = [
     "lineage_init",
     "lineage_step",
     "pair_parents",
+    "AsyncCheckpointWriter",
     "CheckpointCorruptError",
     "Checkpointer",
     "checkpoint_meta",
+    "compilecache",
     "save_state",
     "restore_state",
     "verify_checkpoint",

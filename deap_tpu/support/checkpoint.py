@@ -31,6 +31,7 @@ import os
 import pickle
 import re
 import shutil
+import threading
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -248,6 +249,12 @@ class Checkpointer:
     def _path(self, step: int) -> str:
         return os.path.join(self.directory, f"{self.prefix}_{step:08d}.pkl")
 
+    def path_for(self, step: int) -> str:
+        """The file path a :meth:`save` of ``step`` lands at — exposed
+        so asynchronous writers can report the destination before the
+        write completes."""
+        return self._path(step)
+
     def steps(self) -> List[int]:
         pat = re.compile(rf"{re.escape(self.prefix)}_(\d+)\.pkl$")
         try:
@@ -354,3 +361,75 @@ class Checkpointer:
         shutil.rmtree(self.directory, ignore_errors=True)
         self._verified.clear()
         os.makedirs(self.directory, exist_ok=True)
+
+
+class AsyncCheckpointWriter:
+    """Double-buffered checkpoint writes: snapshot on the caller's
+    thread, serialize + fsync on a background one.
+
+    :meth:`submit` (1) flattens the state pytree — the leaves are
+    immutable device arrays / scalars, so later in-place mutation of
+    the live state dicts cannot leak into the file — (2) starts the
+    device→host copy of every array leaf (``copy_to_host_async``, a
+    non-blocking DMA enqueue), and (3) hands the snapshot to a worker
+    thread that materialises the host buffers and runs the ordinary
+    crash-consistent :meth:`Checkpointer.save` (fsync-before-rename,
+    CRC, rotation). The caller is free to dispatch the next segment's
+    compute immediately — the D2H copy and the pickle/fsync overlap
+    with it, which is what drives the segmented-run tax toward zero
+    (``bench.py --resilience``, gate tightened to 1.5%).
+
+    At most one write is in flight: :meth:`submit` waits for the
+    previous one first (bounded memory — classic double buffering), and
+    any worker exception is re-raised on the caller's thread at the
+    next :meth:`wait`/:meth:`submit`, so a failing disk still fails the
+    run rather than rotting silently. The on-disk format and its
+    guarantees are unchanged — a kill mid-write leaves the previous
+    checkpoint intact, exactly as with synchronous saves.
+    """
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._exc: Optional[BaseException] = None
+        self.last_path: Optional[str] = None
+
+    def submit(self, ckpt: Checkpointer, step: int, state: Any,
+               meta: Optional[Dict[str, Any]] = None) -> str:
+        """Queue ``ckpt.save(step, state, meta)``; returns the path the
+        checkpoint will land at. Blocks only until the *previous*
+        submit finished."""
+        self.wait()
+        leaves, treedef = jax.tree_util.tree_flatten(state)
+        for leaf in leaves:
+            if isinstance(leaf, jax.Array):
+                try:
+                    leaf.copy_to_host_async()
+                except Exception:
+                    pass  # a prefetch hint only; np.asarray still works
+
+        def work():
+            try:
+                snap = jax.tree_util.tree_unflatten(treedef, leaves)
+                self.last_path = ckpt.save(step, snap, meta=meta)
+            except BaseException as e:  # surfaced at the next wait()
+                self._exc = e
+
+        self._thread = threading.Thread(
+            target=work, name="deap-tpu-ckpt-writer", daemon=True)
+        self._thread.start()
+        return ckpt.path_for(step)
+
+    @property
+    def in_flight(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def wait(self) -> None:
+        """Block until the in-flight write (if any) is durable; re-raise
+        its exception on this thread."""
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise exc

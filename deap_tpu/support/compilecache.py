@@ -1,0 +1,61 @@
+"""Persistent XLA compilation cache wiring — cold-start economics.
+
+First slice of ROADMAP item 5: a serving system dies on compile
+latency, and every new (pop, genome-shape, opcode-mask, chunk-count)
+tuple pays a fresh XLA compile. JAX ships a persistent compilation
+cache (compiled executables keyed by computation fingerprint, written
+to a directory) that turns the second process's cold start into a disk
+read; this module is the one place that knows how to switch it on for
+the pinned jax version, so the bench path (``bench.py`` honours
+``DEAP_TPU_COMPILE_CACHE``; ``bench.py --coldstart`` measures the
+cold-vs-warm ``time_to_first_generation`` delta) and any serving front
+end share one opt-in.
+
+Opt-in only: the cache trades disk for latency and changes no computed
+result, but a shared default directory could cross-contaminate
+benchmark environments — so nothing is enabled unless the caller (or
+the environment variable) asks.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+#: the environment opt-in bench.py and the examples honour
+ENV_VAR = "DEAP_TPU_COMPILE_CACHE"
+
+
+def enable(path: str, min_compile_time_secs: float = 0.0) -> str:
+    """Point JAX's persistent compilation cache at ``path`` (created if
+    missing) and lower the persistence thresholds so even the small
+    per-shape executables of the bench/serving lattices are cached.
+    Config names that the pinned jax doesn't know are skipped — the
+    cache then simply persists less, it never breaks."""
+    import jax
+
+    path = os.path.abspath(os.path.expanduser(str(path)))
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    for name, value in (
+        ("jax_persistent_cache_min_compile_time_secs",
+         float(min_compile_time_secs)),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+        # 0.4.37 gates non-TPU executable caching behind this knob
+        ("jax_persistent_cache_enable_xla_caches", "all"),
+    ):
+        try:
+            jax.config.update(name, value)
+        except Exception:
+            pass
+    return path
+
+
+def enable_from_env(var: str = ENV_VAR) -> Optional[str]:
+    """Enable the cache iff ``$DEAP_TPU_COMPILE_CACHE`` names a
+    directory; returns the resolved path (or ``None``). The bench
+    entrypoints call this right after importing jax."""
+    path = os.environ.get(var)
+    if not path:
+        return None
+    return enable(path)

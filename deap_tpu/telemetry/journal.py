@@ -143,6 +143,10 @@ class RunJournal:
         self.fsync_every = int(fsync_every) if fsync_every else None
         self._rows_since_sync = 0
         self._t0 = time.time()
+        # rows arrive from the main thread AND background writers (the
+        # async checkpoint worker broadcasts checkpoint events): one
+        # lock keeps lines whole
+        self._write_lock = threading.Lock()
         self._fh = open(self.path, "w")
         self._steady: Optional[str] = None
         self.n_compiles = 0
@@ -159,13 +163,16 @@ class RunJournal:
             return
         line = {"t": round(time.time() - self._t0, 6), "kind": kind}
         line.update(payload)
-        self._fh.write(json.dumps(line) + "\n")
-        self._fh.flush()
-        if self.fsync_every:
-            self._rows_since_sync += 1
-            if self._rows_since_sync >= self.fsync_every:
-                os.fsync(self._fh.fileno())
-                self._rows_since_sync = 0
+        with self._write_lock:
+            if self._closed:
+                return
+            self._fh.write(json.dumps(line) + "\n")
+            self._fh.flush()
+            if self.fsync_every:
+                self._rows_since_sync += 1
+                if self._rows_since_sync >= self.fsync_every:
+                    os.fsync(self._fh.fileno())
+                    self._rows_since_sync = 0
 
     # ----------------------------------------------------------- events ----
 
@@ -237,13 +244,14 @@ class RunJournal:
         with _LOCK:
             if self in _ACTIVE:
                 _ACTIVE.remove(self)
-        self._closed = True
-        if self.fsync_every and self._rows_since_sync:
-            try:
-                os.fsync(self._fh.fileno())
-            except OSError:
-                pass
-        self._fh.close()
+        with self._write_lock:  # never close the fh under a writer
+            self._closed = True
+            if self.fsync_every and self._rows_since_sync:
+                try:
+                    os.fsync(self._fh.fileno())
+                except OSError:
+                    pass
+            self._fh.close()
 
     def __enter__(self) -> "RunJournal":
         return self

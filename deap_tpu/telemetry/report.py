@@ -140,6 +140,25 @@ def render_report(path: str, lines: Optional[List[str]] = None) -> str:
                  " recompiling — investigate shape/closure churn**)")
     out.append(line)
 
+    # which execution the variation plane resolved to (fused kernel /
+    # fused XLA / unfused composition; GP compaction device vs host) —
+    # a fallback here is the run silently not using the fast path
+    dispatches = [e for e in events
+                  if e.get("kind") == "variation_dispatch"]
+    if dispatches:
+        counts: dict = {}
+        for e in dispatches:
+            key = (str(e.get("op", "?")), str(e.get("path", "?")))
+            counts[key] = counts.get(key, 0) + 1
+        out.append("- variation dispatch: " + ", ".join(
+            f"{op}→{path}×{c}"
+            for (op, path), c in sorted(counts.items())))
+        fallbacks = [e for e in dispatches if e.get("path") == "unfused"
+                     and e.get("reason") not in (None, "disabled")]
+        if fallbacks:
+            out.append(f"  - ▲ {len(fallbacks)} fused-plane fallback(s):"
+                       f" {fallbacks[0].get('reason')}")
+
     # ------------------------------------------------ probe sparklines ----
     series = _meter_series(events)
     if series:

@@ -1,0 +1,236 @@
+"""Fused variation plane — bit-identity against the unfused composition.
+
+The contract under test (docs/advanced/fused_variation.md): for every
+recognised (mate, mutate) pair, every fused mode computes EXACTLY the
+arrays the unfused var_and/var_or composition computes — same RNG
+draws, same selects — across operators, dtypes, degenerate population
+sizes, probability extremes, and all four EA loops (where 'auto' is now
+the default, so these pins are what lets that default exist).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deap_tpu import ops
+from deap_tpu.algorithms import (ea_generate_update, ea_mu_comma_lambda,
+                                 ea_mu_plus_lambda, ea_simple,
+                                 evaluate_invalid, var_and, var_or)
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import gather, init_population
+from deap_tpu.core.toolbox import Toolbox
+from deap_tpu.ops import variation
+
+
+def _bit_toolbox(indpb=0.05, mate=ops.cx_two_point):
+    tb = Toolbox()
+    tb.register("evaluate", lambda g: g.sum(-1).astype(jnp.float32))
+    tb.register("mate", mate)
+    tb.register("mutate", ops.mut_flip_bit, indpb=indpb)
+    tb.register("select", ops.sel_tournament, tournsize=3)
+    return tb
+
+
+def _bit_pop(n, L=23, seed=1):
+    pop = init_population(jax.random.key(seed), n,
+                          ops.bernoulli_genome(L), FitnessSpec((1.0,)))
+    return evaluate_invalid(pop, lambda g: g.sum(-1).astype(jnp.float32))
+
+
+def _same_pop(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ------------------------------------------------------------ resolve ----
+
+def test_resolve_plan_recognises_supported_pairs():
+    for mate in (ops.cx_one_point, ops.cx_two_point):
+        tb = _bit_toolbox(mate=mate)
+        plan = variation.resolve_plan(tb)
+        assert plan is not None and plan.mut_kind == "flip"
+
+
+def test_resolve_plan_rejects_unrecognised_and_positional():
+    tb = _bit_toolbox()
+    tb.register("mutate", ops.mut_shuffle_indexes, indpb=0.1)
+    assert variation.resolve_plan(tb) is None
+    tb = _bit_toolbox()
+    tb.register("mutate", ops.mut_flip_bit, 0.05)  # positional bind
+    assert variation.resolve_plan(tb) is None
+    tb = _bit_toolbox()
+    tb.register("mate", ops.cx_uniform, indpb=0.3)  # per-gene cx mask
+    assert variation.resolve_plan(tb) is None
+
+
+def test_explicit_fused_mode_raises_when_unsupported():
+    tb = _bit_toolbox()
+    tb.register("mutate", lambda k, g: g)
+    pop = _bit_pop(16)
+    with pytest.raises(ValueError, match="fused"):
+        var_and(jax.random.key(0), pop, tb, 0.5, 0.2, fused="xla")
+    # 'auto' silently falls back to the unfused composition
+    a = var_and(jax.random.key(0), pop, tb, 0.5, 0.2, fused="auto")
+    b = var_and(jax.random.key(0), pop, tb, 0.5, 0.2, fused=False)
+    _same_pop(a, b)
+
+
+# ----------------------------------------------------- var_and parity ----
+
+@pytest.mark.parametrize("n", [1, 2, 3, 16, 101])
+@pytest.mark.parametrize("probs", [(0.5, 0.2), (0.0, 0.0), (1.0, 1.0)])
+def test_var_and_fused_bit_identical(n, probs):
+    cxpb, mutpb = probs
+    tb = _bit_toolbox()
+    pop = _bit_pop(n)
+    key = jax.random.key(7)
+    _same_pop(var_and(key, pop, tb, cxpb, mutpb, fused=False),
+              var_and(key, pop, tb, cxpb, mutpb, fused="xla"))
+
+
+@pytest.mark.parametrize("mate", [ops.cx_one_point, ops.cx_two_point])
+def test_var_and_fused_gaussian_float(mate):
+    tb = Toolbox()
+    tb.register("evaluate", lambda g: -jnp.sum(g ** 2, -1))
+    tb.register("mate", mate)
+    tb.register("mutate", ops.mut_gaussian, mu=0.0, sigma=0.4,
+                indpb=0.25)
+    pop = init_population(jax.random.key(3), 51,
+                          ops.uniform_genome(14, -1, 1),
+                          FitnessSpec((1.0,)))
+    pop = evaluate_invalid(pop, tb.evaluate)
+    key = jax.random.key(9)
+    _same_pop(var_and(key, pop, tb, 0.6, 0.3, fused=False),
+              var_and(key, pop, tb, 0.6, 0.3, fused="xla"))
+
+
+def test_var_and_fused_uniform_int():
+    tb = Toolbox()
+    tb.register("evaluate", lambda g: g.sum(-1).astype(jnp.float32))
+    tb.register("mate", ops.cx_two_point)
+    tb.register("mutate", ops.mut_uniform_int, low=0, up=9, indpb=0.2)
+    pop = init_population(jax.random.key(4), 33,
+                          ops.randint_genome(12, 0, 10),
+                          FitnessSpec((1.0,)))
+    pop = evaluate_invalid(pop, tb.evaluate)
+    key = jax.random.key(10)
+    _same_pop(var_and(key, pop, tb, 0.5, 0.5, fused=False),
+              var_and(key, pop, tb, 0.5, 0.5, fused="xla"))
+
+
+def test_var_and_sel_idx_composition():
+    """var_and(pop, sel_idx=idx) == var_and(gather(pop, idx)) — the
+    selection gather composes into the fused pass losslessly."""
+    tb = _bit_toolbox()
+    pop = _bit_pop(64)
+    idx = tb.select(jax.random.key(5), pop.wvalues, pop.size)
+    key = jax.random.key(6)
+    _same_pop(var_and(key, gather(pop, idx), tb, 0.5, 0.2, fused=False),
+              var_and(key, pop, tb, 0.5, 0.2, fused="xla", sel_idx=idx))
+    # and the unfused fallback honours sel_idx the same way
+    tb2 = _bit_toolbox()
+    tb2.register("mutate", lambda k, g: g)  # force fallback
+    _same_pop(
+        var_and(key, gather(pop, idx), tb2, 0.5, 0.2, fused=False),
+        var_and(key, pop, tb2, 0.5, 0.2, fused="auto", sel_idx=idx))
+
+
+# ------------------------------------------------------ var_or parity ----
+
+@pytest.mark.parametrize("lam", [1, 20, 64])
+def test_var_or_fused_bit_identical(lam):
+    tb = _bit_toolbox()
+    pop = _bit_pop(40)
+    key = jax.random.key(11)
+    _same_pop(var_or(key, pop, tb, lam, 0.4, 0.3, fused=False),
+              var_or(key, pop, tb, lam, 0.4, 0.3, fused="xla"))
+
+
+def test_var_or_fused_reproduction_keeps_fitness():
+    """cxpb=mutpb=0: every child is an unchanged copy that keeps its
+    parent's valid fitness — identical in both modes."""
+    tb = _bit_toolbox()
+    pop = _bit_pop(16)
+    key = jax.random.key(12)
+    a = var_or(key, pop, tb, 16, 0.0, 0.0, fused=False)
+    b = var_or(key, pop, tb, 16, 0.0, 0.0, fused="xla")
+    _same_pop(a, b)
+    assert bool(b.valid.all())
+
+
+# ------------------------------------------------------- loop parity ----
+
+def _same_result(a, b):
+    _same_pop((a[0], a[2]), (b[0], b[2]))
+    assert str(a[1]) == str(b[1])  # logbooks render identically
+
+
+def test_ea_simple_fused_bit_identical():
+    tb = _bit_toolbox()
+    pop = _bit_pop(64)
+    args = (jax.random.key(2), pop, tb, 0.5, 0.2, 6)
+    _same_result(ea_simple(*args, halloffame_size=4, fused=False),
+                 ea_simple(*args, halloffame_size=4, fused="auto"))
+
+
+def test_ea_mu_plus_lambda_fused_bit_identical():
+    tb = _bit_toolbox()
+    pop = _bit_pop(48)
+    args = (jax.random.key(2), pop, tb, 48, 64, 0.4, 0.3, 5)
+    _same_result(
+        ea_mu_plus_lambda(*args, halloffame_size=4, fused=False),
+        ea_mu_plus_lambda(*args, halloffame_size=4, fused="auto"))
+
+
+def test_ea_mu_comma_lambda_fused_bit_identical():
+    tb = _bit_toolbox()
+    pop = _bit_pop(48)
+    args = (jax.random.key(2), pop, tb, 48, 72, 0.4, 0.3, 5)
+    _same_result(
+        ea_mu_comma_lambda(*args, halloffame_size=4, fused=False),
+        ea_mu_comma_lambda(*args, halloffame_size=4, fused="auto"))
+
+
+def test_ea_generate_update_accepts_fused():
+    """The ask-tell loop has no variation plane: fused= is accepted
+    (signature uniformity) and inert."""
+    from deap_tpu.strategies import Strategy
+
+    strat = Strategy(centroid=[1.0] * 4, sigma=0.5, lambda_=8,
+                     spec=FitnessSpec((-1.0,)))
+    tb = Toolbox()
+    tb.register("generate", strat.generate)
+    tb.register("update", strat.update)
+    tb.register("evaluate", lambda g: jnp.sum(g ** 2, -1))
+    a = ea_generate_update(jax.random.key(1), strat.initial_state(),
+                           tb, 4, strat.spec, fused=False)
+    b = ea_generate_update(jax.random.key(1), strat.initial_state(),
+                           tb, 4, strat.spec, fused="auto")
+    for x, y in zip(jax.tree_util.tree_leaves(a[0]),
+                    jax.tree_util.tree_leaves(b[0])):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ------------------------------------------------- dispatch journaling ----
+
+def test_variation_dispatch_journaled(tmp_path):
+    from deap_tpu.telemetry.journal import RunJournal, read_journal
+
+    tb = _bit_toolbox()
+    pop = _bit_pop(16)
+    path = str(tmp_path / "j.jsonl")
+    with RunJournal(path):
+        var_and(jax.random.key(0), pop, tb, 0.5, 0.2, fused="auto")
+        tb2 = _bit_toolbox()
+        tb2.register("mutate", lambda k, g: g)
+        var_and(jax.random.key(0), pop, tb2, 0.5, 0.2, fused="auto")
+    rows = [e for e in read_journal(path)
+            if e.get("kind") == "variation_dispatch"]
+    paths = [e["path"] for e in rows]
+    assert "fused_xla" in paths or "fused_kernel" in paths
+    assert "unfused" in paths
+    fused_row = next(e for e in rows if e["path"].startswith("fused"))
+    assert fused_row["mate"] == "cx_two_point"
+    assert fused_row["mutate"] == "mut_flip_bit"

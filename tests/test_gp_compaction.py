@@ -1,0 +1,176 @@
+"""On-device GP variation compaction — np.resize semantics + parity.
+
+Satellite contract of the fused-variation PR: before the on-device
+prefix-sum compaction replaced the host ``np.nonzero``/``np.resize``
+round trip, the host path's exact pad behaviour (np.resize pads by
+CYCLING the source array) is pinned here as a regression oracle — so
+device-vs-host parity is a tested equality of padded index arrays, not
+an assertion in a docstring.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deap_tpu import gp
+from deap_tpu.gp.interpreter import _round_size, compact_indices
+from deap_tpu.gp.loop import (make_compaction_pipelines,
+                              make_flag_compactor, make_symbreg_loop)
+
+
+# ------------------------------------------------ np.resize semantics ----
+
+def test_np_resize_pads_by_cycling():
+    """The host compaction's pad rule, pinned: ``np.resize(a, P)``
+    repeats the source cyclically — out[k] == a[k % len(a)] — for both
+    growth and truncation. The device compaction reproduces exactly
+    this rule; if a numpy upgrade ever changed it, this test (not a
+    silent parity break) is what fails."""
+    a = np.asarray([5, 9, 2])
+    np.testing.assert_array_equal(np.resize(a, 7),
+                                  [5, 9, 2, 5, 9, 2, 5])
+    np.testing.assert_array_equal(np.resize(a, 2), [5, 9])
+    idx = np.arange(7) % len(a)
+    np.testing.assert_array_equal(np.resize(a, 7), a[idx])
+
+
+@pytest.mark.parametrize("n,p,seed", [(100, 0.3, 0), (64, 0.0, 1),
+                                      (64, 1.0, 2), (1, 0.5, 3),
+                                      (7, 0.6, 4), (513, 0.1, 5)])
+def test_compact_indices_matches_nonzero_resize(n, p, seed):
+    mask = np.asarray(jax.random.bernoulli(jax.random.key(seed), p,
+                                           (n,)))
+    idx, count = jax.jit(compact_indices, static_argnums=1)(
+        jnp.asarray(mask), n)
+    idx, count = np.asarray(idx), int(count)
+    nz = np.nonzero(mask)[0]
+    assert count == len(nz)
+    if count:
+        np.testing.assert_array_equal(idx, np.resize(nz, n))
+        # and every lattice slice equals the host path's padded array
+        for P in {min(_round_size(count), n), min(count, n), n}:
+            np.testing.assert_array_equal(idx[:P], np.resize(nz, P))
+    else:
+        assert not idx.any()
+
+
+def test_compact_indices_is_jit_static_shaped():
+    """Same compiled shape for every count — the property that lets the
+    compaction live inside one jit with zero host involvement."""
+    f = jax.jit(compact_indices, static_argnums=1)
+    shapes = set()
+    for seed in range(4):
+        mask = jax.random.bernoulli(jax.random.key(seed), 0.4, (96,))
+        idx, count = f(mask, 96)
+        shapes.add(idx.shape)
+    assert shapes == {(96,)}
+
+
+# ----------------------------------------------------- pipeline parity ----
+
+@pytest.mark.parametrize("n", [2, 101, 1000])
+def test_compaction_pipelines_bit_identical(n):
+    host_fn, dev_fn = make_compaction_pipelines(0.5, 0.1)
+    key = jax.random.key(n)
+    (h, hc), (d, dc) = host_fn(key, n), dev_fn(key, n)
+    assert hc == dc
+    for a, b in zip(h, d):
+        assert a.shape == b.shape
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_flag_compactor_counts_match_flags():
+    fc = make_flag_compactor(0.6, 0.2)
+    n = 200
+    cx_idx, mut_idx, t_idx, counts = fc(jax.random.key(1), n)
+    n_cx, n_mut, n_t = (int(c) for c in np.asarray(counts))
+    k_pair, k_ind = jax.random.split(jax.random.key(1))
+    do_cx = np.asarray(jax.random.bernoulli(k_pair, 0.6, (n // 2,)))
+    do_mut = np.asarray(jax.random.bernoulli(k_ind, 0.2, (n,)))
+    assert n_cx == do_cx.sum() and n_mut == do_mut.sum()
+    touched = do_mut.copy()
+    touched[np.repeat(np.nonzero(do_cx)[0] * 2, 2)
+            + np.tile([0, 1], do_cx.sum())] = True
+    assert n_t == touched.sum()
+    np.testing.assert_array_equal(np.asarray(t_idx)[:n_t],
+                                  np.nonzero(touched)[0])
+
+
+def test_resolve_compaction_auto_and_validation():
+    from deap_tpu.gp.loop import resolve_compaction
+
+    assert resolve_compaction("device") == "device"
+    assert resolve_compaction("host") == "host"
+    expect = "host" if jax.default_backend() == "cpu" else "device"
+    assert resolve_compaction("auto") == expect
+    with pytest.raises(ValueError, match="compaction"):
+        resolve_compaction("nope")
+
+
+# ------------------------------------------------- full-loop parity ----
+
+def test_gp_loop_device_compaction_bit_identical():
+    """The whole host-dispatch GP engine, host- vs device-compacted:
+    same key → identical final genomes, depths, fitness, nevals."""
+    POP, ml = 128, 48
+    ps = gp.math_set(n_args=1)
+    ps.arity_table()
+    X = jnp.linspace(-1.0, 1.0, 32, endpoint=False)[:, None]
+    y = X[:, 0] ** 2 + X[:, 0]
+    gen = gp.gen_half_and_half(ps, ml, 1, 2)
+    genomes = jax.vmap(gen)(jax.random.split(jax.random.key(3), POP))
+    res = {}
+    for mode in ("host", "device"):
+        run = make_symbreg_loop(ps, ml, X, y, height_limit=6,
+                                compaction=mode)
+        res[mode] = run(jax.random.key(0), genomes, 8)
+    a, b = res["host"], res["device"]
+    for x, yv in zip(jax.tree_util.tree_leaves(a["genomes"]),
+                     jax.tree_util.tree_leaves(b["genomes"])):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(yv))
+    np.testing.assert_array_equal(np.asarray(a["fitness"]),
+                                  np.asarray(b["fitness"]))
+    np.testing.assert_array_equal(np.asarray(a["depths"]),
+                                  np.asarray(b["depths"]))
+    assert a["nevals"] == b["nevals"]
+    assert a["best_fitness"] == b["best_fitness"]
+
+
+def test_gp_loop_journal_evidence(tmp_path):
+    """The journal/span evidence behind 'zero host syncs in the
+    variation compaction': the device-path run journals
+    ``variation_dispatch`` with a 12-byte per-generation host fetch,
+    and the host path's full-array fetch span never appears in its
+    span aggregates (while the host-path run's does)."""
+    from deap_tpu.telemetry import RunTelemetry
+    from deap_tpu.telemetry.journal import read_journal
+
+    POP, ml = 64, 32
+    ps = gp.math_set(n_args=1)
+    ps.arity_table()
+    X = jnp.linspace(-1.0, 1.0, 16, endpoint=False)[:, None]
+    y = X[:, 0] ** 2
+    gen = gp.gen_half_and_half(ps, ml, 1, 2)
+    genomes = jax.vmap(gen)(jax.random.split(jax.random.key(4), POP))
+
+    spans = {}
+    for mode in ("host", "device"):
+        path = str(tmp_path / f"{mode}.jsonl")
+        with RunTelemetry(path) as tel:
+            run = make_symbreg_loop(ps, ml, X, y, compaction=mode,
+                                    telemetry=tel)
+            run(jax.random.key(0), genomes, 4)
+        rows = read_journal(path)
+        disp = [e for e in rows
+                if e.get("kind") == "variation_dispatch"
+                and e.get("op") == "gp_loop"]
+        assert disp and disp[0]["path"] == mode
+        if mode == "device":
+            assert disp[0]["host_fetch_bytes_per_gen"] == 12
+        else:
+            assert disp[0]["host_fetch_bytes_per_gen"] > POP
+        spans[mode] = {e.get("name") for e in rows
+                       if e.get("kind") == "span"}
+    assert "gp_loop/host_compaction_fetch" in spans["host"]
+    assert "gp_loop/host_compaction_fetch" not in spans["device"]

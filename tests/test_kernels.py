@@ -217,6 +217,114 @@ def test_sel_spea2_stream_tie_break_unbiased():
     assert idx.max() > 100  # stable-sort bias would cap indices at 49
 
 
+# ----------------------------------------- fused variation-plane kernel ----
+#
+# ops.kernels.fused_variation (the Pallas apply of the fused variation
+# plane) pinned bit-identical to ops.variation.apply_variation — the
+# XLA formulation that is itself pinned bit-identical to the unfused
+# var_and/var_or composition in tests/test_fused_variation.py. Odd
+# shapes are the satellite contract: pop sizes off the block lattice,
+# pop=1/2 degenerate tournaments/pairings, zero-probability cx/mut.
+
+from deap_tpu.ops import variation as _variation
+from deap_tpu.ops.crossover import cx_one_point, cx_two_point
+from deap_tpu.ops.kernels import fused_variation
+from deap_tpu.ops.mutation import mut_flip_bit, mut_gaussian
+
+
+def _flip_plan(indpb=0.1, mate=cx_two_point):
+    kind, draw = mut_flip_bit.fused_plan(indpb)
+    return _variation.VariationPlan(mate.fused_segment_draw,
+                                    mate.__name__, kind, draw,
+                                    "mut_flip_bit")
+
+
+def _kernel_vs_xla(g, plan, cxpb, mutpb, block_i, key, src=None):
+    n = g.shape[0] if src is None else src.shape[0]
+    masks = _variation.var_and_masks(key, n, g.shape[1], cxpb, mutpb,
+                                     plan, g.dtype)
+    cx_row, lo, hi, do_mut, mask, arg = masks
+    pos = _variation.pair_partner_positions(n)
+    partner = pos if src is None else jnp.take(src, pos)
+    want = _variation.apply_variation(g, src, partner, cx_row, lo, hi,
+                                      do_mut, mask, arg, plan.mut_kind)
+    s = jnp.arange(n, dtype=jnp.int32) if src is None else src
+    got = fused_variation(g, s, partner, cx_row, lo, hi, do_mut, mask,
+                          arg, mut_kind=plan.mut_kind, block_i=block_i,
+                          interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.parametrize("n,block_i", [(70, 64), (65, 64), (127, 32),
+                                       (256, 256)])
+def test_fused_variation_kernel_off_lattice_pops(n, block_i):
+    """Pop sizes that are not a multiple of the block size: the padded
+    tail must never leak into the returned rows."""
+    g = jax.random.bernoulli(jax.random.key(n), 0.5, (n, 33))
+    _kernel_vs_xla(g, _flip_plan(), 0.7, 0.4, block_i,
+                   jax.random.key(n + 1))
+
+
+@pytest.mark.parametrize("n", [1, 2])
+def test_fused_variation_kernel_degenerate_pops(n):
+    """pop=1 (no pair at all) and pop=2 (one pair): the adjacent-pair
+    clamp and the odd-tail no-mate rule, at the smallest sizes."""
+    g = jax.random.bernoulli(jax.random.key(n), 0.5, (n, 17))
+    _kernel_vs_xla(g, _flip_plan(), 1.0, 1.0, 8, jax.random.key(5))
+
+
+@pytest.mark.parametrize("cxpb,mutpb", [(0.0, 0.5), (0.5, 0.0),
+                                        (0.0, 0.0)])
+def test_fused_variation_kernel_zero_probabilities(cxpb, mutpb):
+    g = jax.random.bernoulli(jax.random.key(3), 0.5, (48, 21))
+    _kernel_vs_xla(g, _flip_plan(), cxpb, mutpb, 16, jax.random.key(6))
+    if cxpb == mutpb == 0.0:
+        # and the all-zero case is the identity on the population
+        plan = _flip_plan()
+        masks = _variation.var_and_masks(jax.random.key(6), 48, 21,
+                                         0.0, 0.0, plan, g.dtype)
+        cx_row, lo, hi, do_mut, mask, arg = masks
+        pos = _variation.pair_partner_positions(48)
+        out = fused_variation(g, jnp.arange(48, dtype=jnp.int32), pos,
+                              cx_row, lo, hi, do_mut, mask, None,
+                              mut_kind="flip", block_i=16,
+                              interpret=True)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(g))
+
+
+def test_fused_variation_kernel_composed_selection():
+    """src_idx composes the selection gather into the kernel: parity
+    against the XLA apply given the same winners."""
+    n = 90
+    g = jax.random.bernoulli(jax.random.key(8), 0.5, (n, 40))
+    src = jax.random.randint(jax.random.key(9), (n,), 0, n)
+    _kernel_vs_xla(g, _flip_plan(mate=cx_one_point), 0.6, 0.3, 32,
+                   jax.random.key(10), src=src)
+
+
+def test_fused_variation_kernel_add_kind_f32():
+    n, L = 50, 24
+    kind, draw = mut_gaussian.fused_plan(mu=0.0, sigma=0.5, indpb=0.3)
+    plan = _variation.VariationPlan(cx_two_point.fused_segment_draw,
+                                    "cx_two_point", kind, draw,
+                                    "mut_gaussian")
+    g = jax.random.uniform(jax.random.key(11), (n, L))
+    _kernel_vs_xla(g, plan, 0.5, 0.6, 16, jax.random.key(12))
+
+
+def test_fused_variation_kernel_rejects_bad_kind():
+    g = jnp.zeros((8, 8), jnp.float32)
+    z = jnp.zeros(8, jnp.int32)
+    with pytest.raises(ValueError, match="mut_kind"):
+        fused_variation(g, z, z, z.astype(bool), z, z, z.astype(bool),
+                        jnp.zeros((8, 8), bool), mut_kind="nope",
+                        interpret=True)
+    with pytest.raises(ValueError, match="mut_arg"):
+        fused_variation(g, z, z, z.astype(bool), z, z, z.astype(bool),
+                        jnp.zeros((8, 8), bool), mut_kind="add",
+                        interpret=True)
+
+
 # ---------------------------------------------------- real-valued kernel ----
 
 def test_real_fused_eval_exact_and_noop():

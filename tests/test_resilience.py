@@ -420,3 +420,112 @@ def test_segmented_telemetry_on_bit_identical(tmp_path):
     meters = [r for r in rows if r["kind"] == "meter"]
     assert len(meters) == NGEN + 1  # gen 0 .. NGEN, across segments
     assert [r["gen"] for r in meters] == list(range(NGEN + 1))
+
+
+# -------------------------------------- double-buffered checkpoints ----
+
+def test_double_buffer_matches_sync_results_and_checkpoints(tmp_path):
+    """Async boundary writes change nothing observable: same final
+    population/logbook as the synchronous driver, and the checkpoint
+    files restore to bit-identical state pytrees."""
+    from deap_tpu.support.checkpoint import Checkpointer
+
+    tb, pop, key = _toolbox(), _pop(), jax.random.key(21)
+    results = {}
+    for db in (False, True):
+        res = ResilientRun(str(tmp_path / f"ck_{db}"), segment_len=SEG,
+                           double_buffer=db)
+        results[db] = res.ea_simple(key, pop, tb, 0.5, 0.2, ngen=NGEN,
+                                    halloffame_size=4)
+    (p1, lb1, h1), (p2, lb2, h2) = results[False], results[True]
+    _assert_pop_equal(p1, p2)
+    _assert_logbook_equal(lb1, lb2)
+    s1 = Checkpointer(str(tmp_path / "ck_False")).restore()
+    s2 = Checkpointer(str(tmp_path / "ck_True")).restore()
+    s1.pop("_resilience")  # carries per-driver run ids, by design
+    s2.pop("_resilience")
+    l1 = jax.tree_util.tree_leaves(s1)
+    l2 = jax.tree_util.tree_leaves(s2)
+    assert len(l1) == len(l2)
+
+    def _np(x):
+        if isinstance(x, jax.Array) and jnp.issubdtype(
+                x.dtype, jax.dtypes.prng_key):
+            return np.asarray(jax.random.key_data(x))
+        return np.asarray(x)
+
+    for a, b in zip(l1, l2):
+        np.testing.assert_array_equal(_np(a), _np(b))
+
+
+def test_double_buffer_resume_bit_exact(tmp_path):
+    """Preempt after the first ASYNCHRONOUSLY-written segment, then
+    resume in a fresh driver — the async write must be durable before
+    Preempted is raised, and the resumed run bit-exact."""
+    tb, pop, key = _toolbox(), _pop(), jax.random.key(22)
+    p1, lb1, _ = algorithms.ea_simple(key, pop, tb, 0.5, 0.2, ngen=NGEN)
+    res = ResilientRun(str(tmp_path / "ck"), segment_len=SEG)
+    assert res.double_buffer
+    res.preempt_requested = True  # honoured after the first segment
+    with pytest.raises(Preempted):
+        res.ea_simple(key, pop, tb, 0.5, 0.2, ngen=NGEN)
+    assert res.ckpt.latest_step() == SEG  # the async write landed
+    res2 = ResilientRun(str(tmp_path / "ck"), segment_len=SEG)
+    p2, lb2, _ = res2.ea_simple(key, pop, tb, 0.5, 0.2, ngen=NGEN)
+    _assert_pop_equal(p1, p2)
+    _assert_logbook_equal(lb1, lb2)
+
+
+def test_async_writer_snapshot_immune_to_mutation(tmp_path):
+    """The double-buffer contract: in-place mutation of the live state
+    dict AFTER submit cannot leak into the file (the GP loop mutates
+    its state dict in place between segments)."""
+    import time as _time
+
+    from deap_tpu.support.checkpoint import (AsyncCheckpointWriter,
+                                             Checkpointer)
+
+    ck = Checkpointer(str(tmp_path / "ck"))
+    writer = AsyncCheckpointWriter()
+    state = {"gen": 3, "vals": jnp.arange(4), "log": [1, 2]}
+    writer.submit(ck, 3, state, meta={"m": 1})
+    state["gen"] = 99          # rebind
+    state["log"].append(777)   # in-place append
+    writer.wait()
+    got = ck.restore(3)
+    assert got["gen"] == 3
+    assert got["log"] == [1, 2]
+    np.testing.assert_array_equal(np.asarray(got["vals"]),
+                                  np.arange(4))
+    assert ck.meta(3)["m"] == 1
+    del _time
+
+
+def test_async_writer_error_surfaces_on_wait(tmp_path):
+    from deap_tpu.support.checkpoint import (AsyncCheckpointWriter,
+                                             Checkpointer)
+
+    class _Boom(Checkpointer):
+        def save(self, *a, **kw):
+            raise OSError("disk gone")
+
+    writer = AsyncCheckpointWriter()
+    writer.submit(_Boom(str(tmp_path / "ck")), 1, {"x": 1})
+    with pytest.raises(OSError, match="disk gone"):
+        writer.wait()
+    # the writer is reusable after the failure surfaced
+    ck = Checkpointer(str(tmp_path / "ck2"))
+    writer.submit(ck, 2, {"x": 2})
+    writer.wait()
+    assert ck.restore(2) == {"x": 2}
+
+
+def test_fault_plan_forces_synchronous_saves(tmp_path):
+    """Chaos plans assume the checkpoint exists the moment 'saved'
+    fires — a fault_plan must disable double buffering."""
+    from deap_tpu.resilience.faultinject import FaultPlan
+
+    res = ResilientRun(str(tmp_path / "ck"), fault_plan=FaultPlan())
+    assert res.double_buffer is False
+    res2 = ResilientRun(str(tmp_path / "ck2"))
+    assert res2.double_buffer is True
