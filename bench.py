@@ -783,6 +783,197 @@ def coldstart_lines() -> list:
     return rows
 
 
+# ------------------------------------- multi-tenant serving (1k runs) ----
+
+#: the serving scenario: 1k concurrent small tenants per workload —
+#: the north-star shape (millions of users, mostly tiny jobs)
+SERVING_TENANTS = 1000
+SERVING_LANES = 1024          # pow-2 lane lattice point covering 1k
+SERVING_ONEMAX = dict(pop=16, length=32, ngen=10)
+SERVING_CMA = dict(dim=8, lambda_=8, ngen=10)
+SERVING_REPS = 3
+
+
+def _serving_onemax_setup():
+    tb = Toolbox()
+    tb.register("evaluate", lambda g: g.sum(-1).astype(jnp.float32))
+    tb.register("mate", ops.cx_two_point)
+    tb.register("mutate", ops.mut_flip_bit, indpb=0.05)
+    tb.register("select", ops.sel_tournament, tournsize=3)
+    spec = FitnessSpec((1.0,))
+    pop0 = init_population(
+        jax.random.key(0), SERVING_ONEMAX["pop"],
+        ops.bernoulli_genome(SERVING_ONEMAX["length"]), spec)
+    return tb, pop0
+
+
+def _serving_min_of_reps(fn, reps=SERVING_REPS):
+    fn()  # compile + warm
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def serving_lines(out_path: str = "BENCH_SERVING.json") -> list:
+    """The multi-run serving acceptance measurement (ROADMAP item 1):
+    aggregate generations/sec for 1k concurrent small tenants driven
+    through ONE vectorized multi-run scan
+    (:class:`deap_tpu.serving.MultiRunEngine`, 1024-lane lattice
+    batch) vs the SAME 1k jobs run sequentially in the same session —
+    min-of-reps on both sides, for a OneMax GA bucket and a CMA-ES
+    ask-tell bucket.
+
+    The sequential baseline is the STEELMAN: one pre-jitted solo
+    runner (the exact factory step the engine vmaps) reused across all
+    1k tenants, so it pays one dispatch per tenant and zero retraces.
+    The library's actual sequential entry point (``algorithms.
+    ea_simple`` per job) retraces its freshly-closed step every call
+    and lands orders of magnitude slower — committed as an ungated
+    context row (measured on a subsample, labelled as such), because
+    bounding exactly that retrace churn is what the serving layer's
+    shape buckets are for."""
+    from deap_tpu import algorithms as algos
+    from deap_tpu.serving.multirun import MultiRunEngine
+    from deap_tpu.strategies import cma as _cma
+
+    n = SERVING_TENANTS
+    rows = []
+    envfp = _env_fingerprint("cpu")
+
+    # ------------------------------------------------ OneMax GA bucket ----
+    tb, pop0 = _serving_onemax_setup()
+    ngen = SERVING_ONEMAX["ngen"]
+    keys = jax.vmap(jax.random.key)(jnp.arange(1000, 1000 + n))
+    pops = jax.tree_util.tree_map(
+        lambda a: jnp.broadcast_to(a[None], (n,) + a.shape), pop0)
+
+    step = algos.make_ea_simple_step(tb, 0.5, 0.2)
+
+    def solo(key, pop):
+        pop, hof, _ = algos._pop_loop_init(pop, tb, 0, None)
+        (pop, hof), _ = lax.scan(step, (pop, hof),
+                                 jax.random.split(key, ngen))
+        return pop
+
+    solo_j = jax.jit(solo)
+
+    def run_sequential():
+        for i in range(n):
+            out = solo_j(keys[i], pop0)
+        sync(out.fitness)
+
+    eng = MultiRunEngine("ea_simple", tb)
+
+    def run_batched():
+        b = eng.pack_fresh(keys, pops, ngen,
+                           {"cxpb": 0.5, "mutpb": 0.2},
+                           n_lanes=SERVING_LANES)
+        b, _ = eng.advance(b, ngen)
+        sync(b["shadow"][0].fitness)
+
+    seq_s = _serving_min_of_reps(run_sequential, reps=2)
+    bat_s = _serving_min_of_reps(run_batched)
+    total_gens = n * ngen
+    rows += [
+        {"metric": "serving_onemax_1k_sequential_gens_per_sec",
+         "value": round(total_gens / seq_s, 1), "unit": "gens/sec",
+         "tenants": n, "seconds": round(seq_s, 4),
+         "baseline": "steelman (pre-jitted solo runner, zero retraces)",
+         **SERVING_ONEMAX, "env": envfp},
+        {"metric": "serving_onemax_1k_batched_gens_per_sec",
+         "value": round(total_gens / bat_s, 1), "unit": "gens/sec",
+         "tenants": n, "lanes": SERVING_LANES,
+         "seconds": round(bat_s, 4), **SERVING_ONEMAX, "env": envfp},
+        {"metric": "serving_onemax_1k_batched_vs_sequential_x",
+         "value": round(seq_s / bat_s, 2), "unit": "x", "env": envfp},
+    ]
+
+    # today's library entry point, per job (retraces every call):
+    # subsampled — the full 1k would take ~30 min of pure recompiles,
+    # which is precisely the pathology the bucket lattice removes
+    sub = 5
+    t0 = time.perf_counter()
+    for i in range(sub):
+        algos.ea_simple(keys[i], pop0, tb, 0.5, 0.2, ngen)
+    per_tenant = (time.perf_counter() - t0) / sub
+    rows.append({
+        "metric": "serving_onemax_entrypoint_seconds_per_tenant",
+        "value": round(per_tenant, 3), "unit": "seconds/tenant",
+        "n_measured": sub, "extrapolated": True,
+        "note": ("algorithms.ea_simple per job retraces its step "
+                 "closure every call; ungated context row"),
+        "env": envfp})
+
+    # ------------------------------------------------ CMA-ES bucket ----
+    dim, lam = SERVING_CMA["dim"], SERVING_CMA["lambda_"]
+    ngen_c = SERVING_CMA["ngen"]
+    strat = _cma.Strategy(centroid=[3.0] * dim, sigma=0.5, lambda_=lam)
+    tbc = Toolbox()
+    tbc.register("evaluate", lambda g: (g ** 2).sum(-1))
+    tbc.register("generate", strat.generate)
+    tbc.register("update", strat.update)
+    st0 = strat.initial_state()
+    keys_c = jax.vmap(jax.random.key)(jnp.arange(5000, 5000 + n))
+    states = jax.tree_util.tree_map(
+        lambda a: jnp.broadcast_to(a[None], (n,) + a.shape), st0)
+
+    step_c = algos.make_ea_generate_update_step(tbc, strat.spec, lam)
+
+    def solo_c(key, st):
+        (st, _), _ = lax.scan(step_c, (st, None),
+                              jax.random.split(key, ngen_c))
+        return st
+
+    solo_cj = jax.jit(solo_c)
+
+    def run_sequential_c():
+        for i in range(n):
+            out = solo_cj(keys_c[i], st0)
+        sync(out.centroid)
+
+    eng_c = MultiRunEngine("ea_generate_update", tbc, spec=strat.spec,
+                           state_template=st0)
+
+    def run_batched_c():
+        b = eng_c.pack_fresh(keys_c, states, ngen_c,
+                             n_lanes=SERVING_LANES)
+        b, _ = eng_c.advance(b, ngen_c)
+        sync(b["shadow"][0].centroid)
+
+    seq_c = _serving_min_of_reps(run_sequential_c, reps=2)
+    bat_c = _serving_min_of_reps(run_batched_c)
+    total_c = n * ngen_c
+    rows += [
+        {"metric": "serving_cma_1k_sequential_gens_per_sec",
+         "value": round(total_c / seq_c, 1), "unit": "gens/sec",
+         "tenants": n, "seconds": round(seq_c, 4),
+         "baseline": "steelman (pre-jitted solo runner, zero retraces)",
+         **SERVING_CMA, "env": envfp},
+        {"metric": "serving_cma_1k_batched_gens_per_sec",
+         "value": round(total_c / bat_c, 1), "unit": "gens/sec",
+         "tenants": n, "lanes": SERVING_LANES,
+         "seconds": round(bat_c, 4), **SERVING_CMA, "env": envfp},
+        {"metric": "serving_cma_1k_batched_vs_sequential_x",
+         "value": round(seq_c / bat_c, 2), "unit": "x", "env": envfp},
+    ]
+
+    if out_path:
+        payload = {
+            "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "env": envfp,
+            "config": {"tenants": n, "lanes": SERVING_LANES,
+                       "onemax": SERVING_ONEMAX, "cma": SERVING_CMA,
+                       "reps": SERVING_REPS},
+            "tail": "\n".join(json.dumps(r) for r in rows),
+        }
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=1)
+    return rows
+
+
 # ---------------------------------- resilience overhead (pop=100k) ----
 
 #: headline config length for the paired segmented-vs-monolithic rows
@@ -1351,6 +1542,19 @@ if __name__ == "__main__":
                else "BENCH_FUSION.json")
         for row in fusion_lines(out,
                                 coldstart="--no-coldstart" not in sys.argv):
+            print(json.dumps(row), flush=True)
+    elif "--serving" in sys.argv:
+        # the multi-tenant serving acceptance measurement: 1k
+        # concurrent OneMax + CMA tenants through one vectorized
+        # multi-run scan vs the same 1k sequentially, same session
+        # (committed as BENCH_SERVING.json; bench_report.py --tripwire
+        # gates the batched/sequential ratios)
+        jax.config.update("jax_platforms", "cpu")
+        i = sys.argv.index("--serving")
+        nxt = sys.argv[i + 1] if i + 1 < len(sys.argv) else None
+        out = (nxt if nxt and not nxt.startswith("--")
+               else "BENCH_SERVING.json")
+        for row in serving_lines(out):
             print(json.dumps(row), flush=True)
     elif "--coldstart-child" in sys.argv:
         _coldstart_child(

@@ -313,6 +313,48 @@ def resilience_tripwire(
     return 0 if ok else 1
 
 
+#: minimum batched-over-sequential aggregate-gens/sec ratios the
+#: serving pairs must hold (bench.py --serving, BENCH_SERVING.json).
+#: The sequential side is the steelman pre-jitted solo runner; the
+#: OneMax GA bucket must clear the acceptance 5x, the CMA bucket (whose
+#: batched path is bound by the 1024-lane batched eigh) its measured
+#: 2.9x less noise margin.
+SERVING_RATIO_GATES = {
+    "serving_onemax_1k_batched_vs_sequential_x": 5.0,
+    "serving_cma_1k_batched_vs_sequential_x": 2.0,
+}
+
+
+def serving_tripwire(gates=None) -> int:
+    """The multi-tenant serving gate: each committed batched-vs-
+    sequential ratio row in the latest BENCH_SERVING*.json must stay
+    at or above its floor (same-session pairs — a live-vs-live
+    comparison, never cached). Returns the number of tripped rows."""
+    gates = dict(SERVING_RATIO_GATES if gates is None else gates)
+    files = sorted(glob.glob(os.path.join(HERE, "BENCH_SERVING*.json")))
+    if not files:
+        print("serving tripwire: no committed BENCH_SERVING*.json yet")
+        return 0
+    rows = _bench_rows(files[-1])
+    print(f"\n## Serving throughput ({os.path.basename(files[-1])})\n")
+    tripped = 0
+    for metric, floor in gates.items():
+        row = rows.get(metric)
+        if row is None or not isinstance(row.get("value"), (int, float)):
+            print(f"- {metric}: **missing** from latest file")
+            tripped += 1
+            continue
+        ok = row["value"] >= floor
+        print(f"- {metric}: {row['value']}x (floor {floor}x) "
+              + ("ok" if ok else
+                 "**REGRESSION** (batched serving lost its edge "
+                 "over sequential)"))
+        tripped += 0 if ok else 1
+    if len(files) >= 2:
+        tripped += _diff_rows(files[-2], files[-1], TRIPWIRE_THRESHOLD)
+    return tripped
+
+
 def tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
     """Diff the two most recent committed ``BENCH_r*.json`` files and
     flag regressions; then the gp_symbreg paired rows
@@ -333,6 +375,7 @@ def tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
     tripped += probe_tripwire()
     tripped += resilience_tripwire()
     tripped += fusion_tripwire()
+    tripped += serving_tripwire()
     return tripped
 
 

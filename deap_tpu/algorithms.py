@@ -36,6 +36,19 @@ from deap_tpu.support.logbook import Logbook, logbook_from_records
 from deap_tpu.support.stats import Statistics
 
 
+def _check_cx_mut(cxpb, mutpb) -> None:
+    """The reference's ``cxpb + mutpb <= 1.0`` guard, skipped when the
+    probabilities are traced values (the multi-run engine vmaps the
+    step factories with *per-run* cxpb/mutpb arrays — see
+    :mod:`deap_tpu.serving.multirun`; callers there validate on the
+    host before packing)."""
+    if isinstance(cxpb, jax.core.Tracer) or isinstance(mutpb, jax.core.Tracer):
+        return
+    assert float(cxpb) + float(mutpb) <= 1.0, (
+        "The sum of the crossover and mutation probabilities must be "
+        "smaller or equal to 1.0.")
+
+
 def _tree_where(mask: jnp.ndarray, a: Any, b: Any) -> Any:
     def w(x, y):
         m = mask.reshape(mask.shape + (1,) * (x.ndim - mask.ndim))
@@ -254,9 +267,7 @@ def var_or(key: jax.Array, pop: Population, toolbox, lambda_: int,
     per-child parent gathers (``i``/``j``/``m`` draws) into its
     one-pass apply — bit-identical to this composition.
     """
-    assert cxpb + mutpb <= 1.0, (
-        "The sum of the crossover and mutation probabilities must be "
-        "smaller or equal to 1.0.")
+    _check_cx_mut(cxpb, mutpb)
     mode, plan = _resolve_fused(fused, toolbox, pop.genomes, "var_or")
     if mode is not None:
         g = _variation.single_genome_leaf(pop.genomes)
@@ -374,6 +385,12 @@ def _pop_loop_init(pop: Population, toolbox, halloffame_size: int,
 # which is what makes segmented-with-checkpoints runs bit-identical to
 # monolithic ones. Carry layout: (pop, hof) — or (pop, hof, mstate)
 # with telemetry, in which case xs is (key, gen) instead of key.
+#
+# Run axis: every factory also accepts TRACED cxpb/mutpb (a vmap lane's
+# per-run scalar) — probabilities only feed bernoulli/uniform
+# comparisons, never shapes, so the multi-run serving engine
+# (deap_tpu/serving/multirun.py) can vmap one step over N independent
+# runs with per-run hyperparameters and stay bit-identical per lane.
 
 def make_ea_simple_step(toolbox, cxpb: float, mutpb: float,
                         stats: Optional[Statistics] = None,
@@ -520,8 +537,7 @@ def ea_mu_plus_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
                       ) -> Tuple[Population, Logbook, Optional[HallOfFame]]:
     """(μ + λ) evolution (algorithms.py:248-337): parents survive into the
     selection pool."""
-    assert cxpb + mutpb <= 1.0, (
-        "The sum of the crossover and mutation probabilities must be <= 1.0.")
+    _check_cx_mut(cxpb, mutpb)
     tel = telemetry
     _check_probes(probes, tel)
     kscan = key
@@ -591,8 +607,7 @@ def ea_mu_comma_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
                        ) -> Tuple[Population, Logbook, Optional[HallOfFame]]:
     """(μ, λ) evolution (algorithms.py:340-437): only offspring survive."""
     assert lambda_ >= mu, "lambda must be greater or equal to mu."
-    assert cxpb + mutpb <= 1.0, (
-        "The sum of the crossover and mutation probabilities must be <= 1.0.")
+    _check_cx_mut(cxpb, mutpb)
     tel = telemetry
     _check_probes(probes, tel)
     kscan = key

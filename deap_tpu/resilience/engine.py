@@ -431,6 +431,11 @@ class ResilientRun:
     :param fault_plan: a deterministic
         :class:`~deap_tpu.resilience.faultinject.FaultPlan` — test
         harness hook, inert in production.
+    :param tenant_id: multi-tenant serving stamp: written into every
+        checkpoint's v2 ``meta`` and required of any checkpoint this
+        run resumes from (``restore_latest(tenant_id=...)``), so
+        co-located or mis-pointed tenant directories can never
+        cross-restore (see ``docs/advanced/serving.md``).
     """
 
     def __init__(self, checkpoints, *, segment_len: int = 10,
@@ -439,7 +444,8 @@ class ResilientRun:
                  degrade_cb: Optional[Callable] = None,
                  handle_signals: bool = True,
                  double_buffer: bool = True, fault_plan=None,
-                 run_id: Optional[str] = None):
+                 run_id: Optional[str] = None,
+                 tenant_id: Optional[str] = None):
         if isinstance(checkpoints, Checkpointer):
             self.ckpt = checkpoints
         else:
@@ -458,6 +464,12 @@ class ResilientRun:
         if run_id is None and telemetry is not None:
             run_id = telemetry.journal.run_id
         self.run_id = run_id or hex(int(time.time() * 1e6))[2:]
+        # multi-tenant serving: stamp every checkpoint with the owning
+        # tenant and restore only checkpoints carrying that stamp
+        # (Checkpointer.restore_latest(tenant_id=...)) — a mis-pointed
+        # checkpoint directory resumes nothing instead of resuming
+        # someone else's run
+        self.tenant_id = tenant_id
         self.preempt_requested = False
         self._preempt_signum: Optional[int] = None
         self.resumed_from: Optional[str] = None
@@ -595,7 +607,7 @@ class ResilientRun:
 
     def _drive(self, spec: _LoopSpec, total: int):
         total = int(total)
-        resumed = self.ckpt.restore_latest()
+        resumed = self.ckpt.restore_latest(tenant_id=self.tenant_id)
         if resumed is not None:
             step0, state = resumed
             meta = state.get("_resilience", {})
@@ -629,6 +641,8 @@ class ResilientRun:
                     state = self._run_segment(spec, state, gen, hi)
                     self._fault("segment_end", lo=gen, hi=hi)
                     meta = dict(state["_resilience"], step=hi)
+                    if self.tenant_id is not None:
+                        meta["tenant_id"] = self.tenant_id
                     if writer is not None:
                         # double-buffered: snapshot now, write in the
                         # background; submit() first drains the PREVIOUS

@@ -1,0 +1,43 @@
+"""Multi-tenant evolution serving — run axis + ask-tell scheduler.
+
+Two planes on top of the core loops (ROADMAP item 1):
+
+- :mod:`deap_tpu.serving.multirun` — the **vectorized multi-run
+  engine**: N independent runs (distinct seeds, per-run hyperparams
+  and generation budgets) advance through ONE compiled scan by
+  vmapping the :mod:`deap_tpu.algorithms` step factories, with per-run
+  telemetry riding the batched Meter carry and per-lane bit-identity
+  to the solo loops pinned by ``tests/test_serving.py``.
+- :mod:`deap_tpu.serving.scheduler` — the **ask-tell serving layer**:
+  job admission into shape buckets, pow-2 lane-lattice packing so the
+  compiled-shape set stays bounded (and reusable across processes via
+  :func:`enable_compile_cache`), segment-cadence execution, and
+  per-tenant eviction/resume with crash-consistent checkpoints as the
+  swap unit.
+
+See ``docs/advanced/serving.md`` for the job model, the bucket
+lattice, eviction semantics and the bit-identity contract.
+"""
+
+from deap_tpu.serving.multirun import FAMILIES, MultiRunEngine, multirun
+from deap_tpu.serving.tenant import (
+    Job,
+    Tenant,
+    bucket_key,
+    pad_pow2,
+)
+from deap_tpu.serving.scheduler import Scheduler, prewarm
+from deap_tpu.support.compilecache import enable_compile_cache
+
+__all__ = [
+    "FAMILIES",
+    "Job",
+    "MultiRunEngine",
+    "Scheduler",
+    "Tenant",
+    "bucket_key",
+    "enable_compile_cache",
+    "multirun",
+    "pad_pow2",
+    "prewarm",
+]

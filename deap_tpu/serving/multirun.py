@@ -1,0 +1,583 @@
+"""Vectorized multi-run engine — N independent runs through ONE scan.
+
+The serving data plane (ROADMAP item 1): today's loops drive exactly
+one run per process, but the workload the north star describes is
+millions of *small* independent jobs — evosax demonstrates that
+vmapping independent ES runs through one compiled step is the natural
+JAX win, and Kozax the same for GP populations (PAPERS.md). This module
+adds the missing run axis on top of the PR 5 step factories
+(:func:`deap_tpu.algorithms.make_ea_simple_step` and friends): N runs
+with distinct seeds, per-run hyperparameters (cxpb/mutpb enter the
+factories as vmap-lane tracers — probabilities feed only
+bernoulli/uniform comparisons, never shapes) and per-run generation
+budgets advance together through one jit-compiled ``lax.scan``.
+
+Correctness contract — **per-lane bit-identity**: a run's batched
+trajectory (population, logbook records, hall of fame, per-generation
+Meter/probe rows) is bit-identical to the same job run solo through
+the monolithic loop, pinned by ``tests/test_serving.py`` for the
+ea_simple / (μ+λ) / (μ,λ) population families and the CMA ask-tell
+family. The construction
+that makes this exact rather than approximate:
+
+- **per-run key folding** — each lane's per-generation keys are
+  ``jax.random.split(base_key_r, ngen_r)``, exactly the array the solo
+  loop scans; lanes store the raw ``key_data`` (uint32) padded to the
+  bucket's key horizon and re-wrap per step, so no cross-run key
+  arithmetic exists at all;
+- **vmapped solo step** — the batched step is ``jax.vmap`` of a lane
+  function that instantiates the *same* factory step the solo loop
+  scans (with the lane's traced hyperparams), so each lane computes
+  the solo program;
+- **masked stepping** — a finished lane (``gen >= ngen``) becomes a
+  no-op until the scheduler swaps it out: a *shadow* copy of the carry
+  latches the lane's state on its last active step (see
+  :meth:`MultiRunEngine._segment` for why the mask must hang off the
+  recurrence instead of feeding back into it), so heterogeneous
+  ``ngen`` in one batch costs no correctness, only the finished
+  lanes' wasted flops.
+
+The engine is deliberately host-light: :meth:`MultiRunEngine.advance`
+runs one segment (k generations) on device and returns stacked
+per-generation outputs plus the active mask; slicing a lane's rows out
+(:meth:`lane_records` / :meth:`lane_meter_rows`) and assembling a
+solo-format result (:meth:`lane_result`) happen at segment boundaries,
+which are already host sync points in the scheduler
+(:mod:`deap_tpu.serving.scheduler`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deap_tpu import algorithms as algos
+from deap_tpu.core.population import Population
+from deap_tpu.support.checkpoint import _key_impl_name
+
+__all__ = ["MultiRunEngine", "FAMILIES", "multirun"]
+
+#: the loop families the run axis covers (the GP host-dispatch loop and
+#: the island epoch driver stay host-driven — their run axis is future
+#: work, tracked on the ROADMAP)
+FAMILIES = ("ea_simple", "ea_mu_plus_lambda", "ea_mu_comma_lambda",
+            "ea_generate_update")
+
+#: per-family hyperparameters that may vary per run (everything else —
+#: mu/lambda/population shape/operators — is static per bucket)
+_HYPER_NAMES = {
+    "ea_simple": ("cxpb", "mutpb"),
+    "ea_mu_plus_lambda": ("cxpb", "mutpb"),
+    "ea_mu_comma_lambda": ("cxpb", "mutpb"),
+    "ea_generate_update": (),
+}
+
+
+def _tree_stack(trees: Sequence[Any]) -> Any:
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def _tree_index(tree: Any, i: int) -> Any:
+    return jax.tree_util.tree_map(lambda a: a[i], tree)
+
+
+def _tree_where(mask, a, b):
+    return algos._tree_where(jnp.asarray(mask), a, b)
+
+
+class MultiRunEngine:
+    """One compiled multi-run program for one *bucket* of jobs.
+
+    A bucket fixes everything shape- or program-relevant: the loop
+    ``family``, the ``toolbox`` (operators AND evaluate — tenants in a
+    bucket share the problem program), population shape/dtype,
+    mu/lambda, stats/probes/telemetry configuration and the hall-of-fame
+    size. Per-run freedom: base PRNG key, initial population / strategy
+    state values, ``ngen``, and the family's per-run hyperparameters
+    (``cxpb``/``mutpb``; CMA's sigma/centroid vary through the initial
+    state — see ``Strategy.initial_state(sigma=..., centroid=...)``).
+
+    Lifecycle::
+
+        eng = MultiRunEngine("ea_simple", toolbox, stats=stats)
+        lanes = [eng.lane_init(key_r, pop_r, ngen_r,
+                               {"cxpb": 0.5, "mutpb": 0.2})
+                 for ...]
+        batch = eng.pack(lanes, n_lanes=8, horizon=64)
+        batch, seg = eng.advance(batch, k=10)      # one segment
+        lane = eng.unpack(batch, i)                # swap unit
+        pop, logbook, hof = eng.lane_result(
+            lane, eng.lane_records([seg], i))
+
+    ``telemetry`` may be a :class:`~deap_tpu.telemetry.RunTelemetry`;
+    its Meter (built-ins + ``probes``) joins the lane carry, so the
+    scan's stacked output holds *per-run* metric rows. Live streaming
+    (``stream=True``) is rejected — a vmapped ``debug.callback`` would
+    interleave lanes.
+    """
+
+    def __init__(self, family: str, toolbox, *, mu: Optional[int] = None,
+                 lambda_: Optional[int] = None, spec=None,
+                 state_template=None, stats=None, telemetry=None,
+                 probes=(), halloffame_size: int = 0, fused="auto"):
+        if family not in FAMILIES:
+            raise ValueError(f"unknown loop family {family!r} "
+                             f"(known: {FAMILIES})")
+        self.family = family
+        self.toolbox = toolbox
+        self.mu = mu
+        self.lambda_ = lambda_
+        self.spec = spec
+        self.stats = stats
+        self.tel = telemetry
+        self.probes = tuple(probes or ())
+        self.halloffame_size = int(halloffame_size)
+        self.fused = fused
+        self.gen_offset = 0 if family == "ea_generate_update" else 1
+        self.hyper_names = _HYPER_NAMES[family]
+        if self.tel is not None:
+            if getattr(self.tel, "stream", False):
+                raise ValueError(
+                    "multirun: telemetry stream=True is unsupported "
+                    "(per-lane debug callbacks interleave); decode "
+                    "rows at segment boundaries instead")
+            self.tel.begin_run(
+                f"multirun/{family}", toolbox,
+                declare=algos._tel_declare, probes=self.probes,
+                serving=True)
+        if family == "ea_generate_update":
+            if spec is None or state_template is None:
+                raise ValueError(
+                    "ea_generate_update needs spec= (FitnessSpec) and "
+                    "state_template= (one strategy state, shape "
+                    "template for λ/hof inference)")
+            self.lam, self._hof0 = algos._generate_update_init(
+                toolbox, state_template, spec, self.halloffame_size)
+        else:
+            if family != "ea_simple" and (mu is None or lambda_ is None):
+                raise ValueError(f"{family} needs mu= and lambda_=")
+        self._key_impl: Optional[str] = None
+        # one jitted segment program, cached per (lanes, horizon, k)
+        # shape triple — the bucket lattice keeps that set small
+        self._advance = jax.jit(self._segment, static_argnames=("k",))
+        # jitted batch-admission programs (pack_fresh): stable function
+        # identity per engine so repeated fresh admissions hit the jit
+        # cache instead of re-tracing
+        self._fresh_init = jax.jit(jax.vmap(self._fresh_lane0))
+        self._presplit = jax.jit(
+            lambda keys, ngen: jax.vmap(lambda k: jax.random.key_data(
+                jax.random.split(k, ngen)))(keys),
+            static_argnames=("ngen",))
+
+    # ------------------------------------------------------------ steps ----
+
+    def _fresh_lane0(self, pop):
+        """One lane's gen-0 protocol (population families) — vmapped
+        and jitted by :meth:`pack_fresh`."""
+        pop, hof, record0 = algos._pop_loop_init(
+            pop, self.toolbox, self.halloffame_size, self.stats)
+        c = (pop, hof)
+        if self.tel is not None:
+            c = c + (algos._tel_measure(
+                self.tel, self.tel.meter.init(),
+                record0["nevals"], pop, jnp.int32(0)),)
+        return c, record0
+
+    def _solo_step(self, hyper: Dict[str, Any]) -> Callable:
+        """The factory step of this family for one lane's (possibly
+        traced) hyperparameters — the exact program the solo loop
+        scans."""
+        tb, stats, tel = self.toolbox, self.stats, self.tel
+        if self.family == "ea_simple":
+            return algos.make_ea_simple_step(
+                tb, hyper["cxpb"], hyper["mutpb"], stats, tel,
+                fused=self.fused)
+        if self.family == "ea_mu_plus_lambda":
+            return algos.make_ea_mu_plus_lambda_step(
+                tb, self.mu, self.lambda_, hyper["cxpb"],
+                hyper["mutpb"], stats, tel, fused=self.fused)
+        if self.family == "ea_mu_comma_lambda":
+            return algos.make_ea_mu_comma_lambda_step(
+                tb, self.mu, self.lambda_, hyper["cxpb"],
+                hyper["mutpb"], stats, tel, fused=self.fused)
+        return algos.make_ea_generate_update_step(
+            tb, self.spec, self.lam, stats, tel)
+
+    def _lane_step(self, hyper, carry, key_data, gen):
+        """One generation of one lane (vmapped by the segment): the
+        solo factory step with this lane's key and traced hyper."""
+        key = jax.random.wrap_key_data(key_data, impl=self._key_impl)
+        if self.tel is None:
+            xs = key
+        else:
+            xs = (key, (gen + self.gen_offset).astype(jnp.int32))
+        return self._solo_step(hyper)(carry, xs)
+
+    def _segment(self, batch: Dict[str, Any], k: int):
+        """k masked generations for every lane; returns the new batch
+        and ``(ys, active)`` stacked ``[k, lanes, ...]``.
+
+        Masked stepping is SHADOWED rather than fed back: the live
+        carry always advances through the unmasked vmapped step (a
+        finished lane just burns flops on its padded zero key), while
+        a shadow copy latches each lane's carry on its last active
+        step and freezes. Feeding a ``where`` back into the live
+        recurrence would perturb XLA CPU's codegen of the step itself
+        (fusion/FMA-contraction choices shift by 1 ulp — observed on
+        the CMA covariance update), breaking the bit-identity
+        contract; the shadow select hangs off the recurrence as a pure
+        consumer, and per-lane results stay bit-exact — pinned by
+        tests/test_serving.py for all four families."""
+        keys, ngen, hyper = batch["keys"], batch["ngen"], batch["hyper"]
+
+        def body(carry, _):
+            lane_carry, shadow, gen = carry
+            active = gen < ngen
+            # lane r consumes split(base_r, ngen_r)[gen_r]; the clip
+            # only guards the padded key rows of finished lanes
+            idx = jnp.minimum(gen, keys.shape[1] - 1)
+            kd = jax.vmap(lambda kr, i: kr[i])(keys, idx)
+            lane_carry, ys = jax.vmap(self._lane_step)(
+                hyper, lane_carry, kd, gen)
+            shadow = jax.vmap(_tree_where)(active, lane_carry, shadow)
+            return (lane_carry, shadow,
+                    gen + active.astype(gen.dtype)), (ys, active)
+
+        (lane_carry, shadow, gen), (ys, active) = lax.scan(
+            body, (batch["carry"], batch["shadow"], batch["gen"]),
+            None, length=k)
+        return {**batch, "carry": lane_carry, "shadow": shadow,
+                "gen": gen}, {"ys": ys, "active": active}
+
+    # ------------------------------------------------------------ lanes ----
+
+    def lane_init(self, key, init, ngen: int,
+                  hyper: Optional[Dict[str, float]] = None
+                  ) -> Dict[str, Any]:
+        """Build one lane's state from a solo job spec.
+
+        ``init`` is the founder :class:`Population` (population
+        families) or the initial strategy state (ask-tell). Runs the
+        exact gen-0 protocol of the solo loop (founder evaluation, hof
+        seeding, gen-0 record and meter row), pre-splits the lane's
+        per-generation keys, and returns the checkpointable lane dict —
+        the scheduler's swap unit."""
+        ngen = int(ngen)
+        if ngen < 1:
+            raise ValueError("ngen must be >= 1")
+        hyper = dict(hyper or {})
+        missing = [h for h in self.hyper_names if h not in hyper]
+        if missing:
+            raise ValueError(f"{self.family} lane needs hyper "
+                             f"{missing}")
+        extra = [h for h in hyper if h not in self.hyper_names]
+        if extra:
+            raise ValueError(f"{self.family} takes no hyper {extra}")
+        if self.hyper_names == ("cxpb", "mutpb") and \
+                self.family != "ea_simple":
+            if hyper["cxpb"] + hyper["mutpb"] > 1.0:
+                raise ValueError("cxpb + mutpb must be <= 1.0")
+        impl = _key_impl_name(key)
+        if self._key_impl is None:
+            self._key_impl = impl
+        elif impl != self._key_impl:
+            raise ValueError(
+                f"lane key impl {impl!r} != bucket impl "
+                f"{self._key_impl!r}")
+        keys = jax.random.key_data(jax.random.split(key, ngen))
+
+        lane: Dict[str, Any] = {
+            "gen": jnp.int32(0),
+            "ngen": jnp.int32(ngen),
+            "keys": keys,
+            "hyper": {h: jnp.float32(hyper[h])
+                      for h in self.hyper_names},
+            "mstate0": None,
+        }
+        if self.family == "ea_generate_update":
+            hof = self._hof0
+            carry = (init, hof)
+            if self.tel is not None:
+                carry = carry + (self.tel.meter.init(),)
+            lane["carry"] = carry
+            lane["record0"] = None
+            return lane
+        if not isinstance(init, Population):
+            raise TypeError(f"{self.family} lane init must be a "
+                            f"Population, got {type(init).__name__}")
+        pop, hof, record0 = algos._pop_loop_init(
+            init, self.toolbox, self.halloffame_size, self.stats)
+        carry = (pop, hof)
+        if self.tel is not None:
+            mstate0 = algos._tel_measure(
+                self.tel, self.tel.meter.init(), record0["nevals"],
+                pop, jnp.int32(0))
+            carry = carry + (mstate0,)
+            lane["mstate0"] = mstate0
+        lane["carry"] = carry
+        lane["record0"] = record0
+        return lane
+
+    def pack_fresh(self, keys, inits, ngen: int,
+                   hyper: Optional[Dict[str, Any]] = None,
+                   *, n_lanes: Optional[int] = None,
+                   horizon: Optional[int] = None) -> Dict[str, Any]:
+        """Vectorized :meth:`lane_init` + :meth:`pack` for a batch of
+        FRESH same-``ngen`` jobs: the gen-0 protocol (founder
+        evaluation, hof seeding, gen-0 record/meter row) runs as ONE
+        vmapped program instead of R eager dispatches — how a 1k-tenant
+        admission stays O(1) in host round trips. ``keys`` is a list or
+        stacked typed-key array, ``inits`` a list of per-run
+        Populations/states or one pytree with a leading run axis;
+        ``hyper`` values may be scalars (broadcast) or per-run arrays.
+        Per-lane results are bit-identical to the lane-at-a-time path
+        (same key folding, same gen-0 program under vmap)."""
+        ngen = int(ngen)
+        if ngen < 1:
+            raise ValueError("ngen must be >= 1")
+        if isinstance(keys, (list, tuple)):
+            keys = jnp.stack(keys)
+        R = int(keys.shape[0])
+        n_lanes = R if n_lanes is None else int(n_lanes)
+        horizon = ngen if horizon is None else int(horizon)
+        if R > n_lanes or ngen > horizon:
+            raise ValueError("batch exceeds n_lanes/horizon")
+        impl = _key_impl_name(keys)
+        if self._key_impl is None:
+            self._key_impl = impl
+        if isinstance(inits, (list, tuple)):
+            inits = _tree_stack(inits)
+        hyper = dict(hyper or {})
+        missing = [h for h in self.hyper_names if h not in hyper]
+        if missing:
+            raise ValueError(f"{self.family} needs hyper {missing}")
+        hyper_arr = {
+            h: jnp.broadcast_to(jnp.asarray(hyper[h], jnp.float32), (R,))
+            for h in self.hyper_names}
+
+        keys_data = self._presplit(keys, ngen=ngen)
+
+        if self.family == "ea_generate_update":
+            bcast = lambda a: jnp.broadcast_to(a[None], (R,) + a.shape)
+            hof = jax.tree_util.tree_map(bcast, self._hof0)
+            carry = (inits, hof)
+            if self.tel is not None:
+                carry = carry + (jax.tree_util.tree_map(
+                    bcast, self.tel.meter.init()),)
+            record0 = None
+        else:
+            carry, record0 = self._fresh_init(inits)
+
+        mstate0 = (carry[2] if self.tel is not None
+                   and self.family != "ea_generate_update" else None)
+        batch = {"carry": carry, "shadow": carry,
+                 "gen": jnp.zeros(R, jnp.int32),
+                 "ngen": jnp.full(R, ngen, jnp.int32),
+                 "keys": keys_data, "hyper": hyper_arr,
+                 "record0": record0, "mstate0": mstate0, "n_real": R}
+        if horizon > ngen:
+            pad = jnp.zeros(
+                (R, horizon - ngen) + keys_data.shape[2:],
+                keys_data.dtype)
+            batch["keys"] = jnp.concatenate([keys_data, pad], axis=1)
+        if n_lanes > R:
+            grow = lambda a: jnp.concatenate(
+                [a, jnp.broadcast_to(a[:1],
+                                     (n_lanes - R,) + a.shape[1:])])
+            for k in ("carry", "shadow", "gen", "keys", "hyper",
+                      "record0", "mstate0"):
+                batch[k] = jax.tree_util.tree_map(grow, batch[k])
+            batch["ngen"] = jnp.concatenate(
+                [batch["ngen"], jnp.zeros(n_lanes - R, jnp.int32)])
+        return batch
+
+    def pack(self, lanes: Sequence[Dict[str, Any]], n_lanes: int,
+             horizon: int) -> Dict[str, Any]:
+        """Stack lane states into one batch of ``n_lanes`` slots with a
+        key ``horizon`` (both lattice-padded by the scheduler so the
+        compiled-shape set stays bounded). Missing slots are filled
+        with an inactive clone of lane 0 (``ngen=0`` → the mask keeps
+        it a no-op forever); each lane's key array is zero-padded to
+        the horizon (padding rows are unreachable while active)."""
+        if not lanes:
+            raise ValueError("pack needs at least one lane")
+        if len(lanes) > n_lanes:
+            raise ValueError(f"{len(lanes)} lanes > {n_lanes} slots")
+        padded = []
+        for lane in lanes:
+            T = int(lane["keys"].shape[0])
+            if T > horizon:
+                raise ValueError(
+                    f"lane ngen {T} exceeds key horizon {horizon}")
+            if T < horizon:
+                pad = jnp.zeros((horizon - T,) + lane["keys"].shape[1:],
+                                lane["keys"].dtype)
+                lane = {**lane,
+                        "keys": jnp.concatenate([lane["keys"], pad])}
+            padded.append(lane)
+        dummy = {**padded[0], "gen": jnp.int32(0),
+                 "ngen": jnp.int32(0)}
+        padded += [dummy] * (n_lanes - len(padded))
+        stacked = _tree_stack(padded)
+        return {"carry": stacked["carry"],
+                "shadow": stacked["carry"], "gen": stacked["gen"],
+                "ngen": stacked["ngen"], "keys": stacked["keys"],
+                "hyper": stacked["hyper"],
+                "record0": stacked["record0"],
+                "mstate0": stacked["mstate0"],
+                "n_real": len(lanes)}
+
+    def unpack(self, batch: Dict[str, Any], i: int) -> Dict[str, Any]:
+        """Lane ``i``'s state back out of a batch — the per-tenant swap
+        unit the scheduler checkpoints. The carry is read from the
+        SHADOW (== the live carry for a still-active lane; the frozen
+        completion state for a finished one — see :meth:`_segment`).
+        Key padding is trimmed back to the lane's own ``ngen`` so a
+        resume into a different bucket horizon re-pads cleanly."""
+        lane = {k: _tree_index(batch[k], i)
+                for k in ("gen", "ngen", "keys", "hyper", "record0",
+                          "mstate0")}
+        lane["carry"] = _tree_index(batch["shadow"], i)
+        lane["keys"] = lane["keys"][: int(lane["ngen"])]
+        return lane
+
+    def advance(self, batch: Dict[str, Any], k: int
+                ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """Run one segment of ``k`` generations for every lane through
+        the jitted scan; returns ``(batch, seg)`` where ``seg`` holds
+        the stacked per-generation outputs ``ys`` ``[k, lanes, ...]``
+        and the ``active`` mask ``[k, lanes]`` (host code drops the
+        masked rows)."""
+        return self._advance(batch, k=int(k))
+
+    def done(self, batch: Dict[str, Any]) -> np.ndarray:
+        """Host bool per slot: the lane finished its budget."""
+        return np.asarray(batch["gen"]) >= np.asarray(batch["ngen"])
+
+    # ---------------------------------------------------- result decode ----
+
+    def _lane_rows(self, segs: Sequence[Dict[str, Any]], i: int,
+                   part: int) -> Any:
+        """Lane ``i``'s active generation rows of ys component ``part``
+        (0 = records, 1 = meter rows), concatenated across segments as
+        numpy stacked arrays (``None`` when no rows)."""
+        chunks = []
+        for seg in segs:
+            mask = np.asarray(seg["active"])[:, i]
+            if not mask.any():
+                continue
+            ys = seg["ys"]
+            if self.tel is not None:
+                ys = ys[part]
+            elif part == 1:
+                return None
+            chunks.append(jax.tree_util.tree_map(
+                lambda a: np.asarray(a)[:, i][mask], ys))
+        if not chunks:
+            return None
+        return jax.tree_util.tree_map(
+            lambda *xs: np.concatenate(xs, axis=0), *chunks)
+
+    def lane_records(self, segs: Sequence[Dict[str, Any]], i: int):
+        """Lane ``i``'s logbook records (stacked over its completed
+        generations) — same pytree the solo scan's ys carries."""
+        return self._lane_rows(segs, i, 0)
+
+    def lane_meter_rows(self, segs: Sequence[Dict[str, Any]], i: int,
+                        lane: Optional[Dict[str, Any]] = None,
+                        gen_start: int = 0) -> List[dict]:
+        """Lane ``i``'s decoded per-generation Meter rows (telemetry
+        engines only): the gen-0 row (from the lane's ``mstate0``, when
+        given and ``gen_start == 0``) plus one row per completed
+        generation — identical to the solo run's journal rows for the
+        same seed. ``gen_start`` is the lane's completed-generation
+        count *before* ``segs`` (the scheduler drains rows one segment
+        at a time)."""
+        if self.tel is None:
+            return []
+        rows: List[dict] = []
+        if gen_start == 0 and lane is not None \
+                and lane.get("mstate0") is not None:
+            rows.append({"gen": 0,
+                         **self.tel.meter.row(lane["mstate0"])})
+        stacked = self._lane_rows(segs, i, 1)
+        if stacked is not None:
+            for g, row in enumerate(self.tel.meter.rows(stacked)):
+                rows.append({"gen": gen_start + g + self.gen_offset,
+                             **row})
+        return rows
+
+    @staticmethod
+    def concat_records(chunks: Sequence[Any]):
+        """Concatenate per-segment :meth:`lane_records` chunks along
+        the generation axis (``None`` chunks skipped)."""
+        chunks = [c for c in chunks if c is not None]
+        if not chunks:
+            return None
+        return jax.tree_util.tree_map(
+            lambda *xs: np.concatenate(xs, axis=0), *chunks)
+
+    def lane_result(self, lane: Dict[str, Any], records: Any):
+        """Assemble a lane's solo-format result from its state and its
+        accumulated :meth:`lane_records` rows: ``(pop, logbook, hof)``
+        for population families, ``(state, logbook, hof)`` for ask-tell
+        — bit-identical to the monolithic loop's return."""
+        carry = lane["carry"]
+        if self.family == "ea_generate_update":
+            if records is None:
+                records = {"nevals": np.zeros((0,), np.int32)}
+            logbook = algos._build_gu_logbook(records, self.stats)
+            return carry[0], logbook, carry[1]
+        if records is None:
+            records = jax.tree_util.tree_map(
+                lambda a: np.zeros((0,) + np.asarray(a).shape,
+                                   np.asarray(a).dtype),
+                lane["record0"])
+        logbook = algos._build_logbook(lane["record0"], records,
+                                       self.stats)
+        return carry[0], logbook, carry[1]
+
+
+def multirun(family: str, toolbox, keys, inits, ngen, hyper=None, *,
+             segment_len: Optional[int] = None, **engine_kwargs
+             ) -> List[tuple]:
+    """Run N independent jobs to completion through one vectorized
+    program and return each job's solo-format result.
+
+    The convenience wrapper over :class:`MultiRunEngine` for callers
+    that want the run axis without the serving scheduler (benchmarks,
+    parameter sweeps, restarts-as-batch)::
+
+        results = multirun(
+            "ea_simple", toolbox,
+            keys=[jax.random.key(s) for s in range(32)],
+            inits=[pop] * 32, ngen=100,
+            hyper=[{"cxpb": c, "mutpb": 0.2} for c in cx_grid])
+
+    ``ngen`` and ``hyper`` broadcast (a scalar / single dict applies to
+    every run). ``segment_len`` chunks the scan (default: one segment
+    covering max ngen)."""
+    n = len(keys)
+    if len(inits) != n:
+        raise ValueError("len(inits) != len(keys)")
+    ngens = [int(g) for g in (ngen if isinstance(ngen, (list, tuple))
+                              else [ngen] * n)]
+    hypers = (hyper if isinstance(hyper, (list, tuple))
+              else [hyper] * n)
+    eng = MultiRunEngine(family, toolbox, **engine_kwargs)
+    lanes = [eng.lane_init(k, p, g, h)
+             for k, p, g, h in zip(keys, inits, ngens, hypers)]
+    horizon = max(ngens)
+    batch = eng.pack(lanes, n_lanes=n, horizon=horizon)
+    k = int(segment_len) if segment_len else horizon
+    segs = []
+    while not eng.done(batch).all():
+        batch, seg = eng.advance(batch, k)
+        segs.append(seg)
+    return [eng.lane_result(eng.unpack(batch, i),
+                            eng.lane_records(segs, i))
+            for i in range(n)]

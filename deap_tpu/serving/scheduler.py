@@ -1,0 +1,369 @@
+"""Ask-tell scheduler — multi-tenant serving over the multi-run engine.
+
+The host control loop in front of :class:`~deap_tpu.serving.multirun.
+MultiRunEngine`: jobs are **submitted**, admitted into **shape buckets**
+(:func:`~deap_tpu.serving.tenant.bucket_key`), packed up to
+``max_lanes`` tenants per device batch on the pow-2 lane lattice, and
+advanced **segment by segment** — the ResilientRun cadence: every
+segment boundary is a host sync where telemetry rows drain (journaled
+per ``tenant_id``), health tripwires run (an early-stop frees the
+lane), finished tenants return their solo-format results, and the
+crash-consistent per-tenant checkpoint is written. That checkpoint is
+also the **swap unit**: when jobs queue behind a full batch, resident
+tenants past their fairness quantum are evicted at the boundary
+(checkpoint → drop lane) and later resumed bit-exactly
+(``restore_latest(tenant_id=...)`` — co-located tenant dirs can't
+cross-restore).
+
+Compile economics: a bucket compiles one program per (lane-count,
+key-horizon, segment-length) lattice point; :func:`prewarm` compiles
+the expected lattice at startup (one journaled ``prewarm`` event per
+bucket), and :func:`~deap_tpu.support.compilecache.enable_compile_cache`
+persists the executables so the next process's cold start is a disk
+read (``bench.py --coldstart``).
+
+Single-device, single-thread by design — the loop is a *cadence*, not
+a server; an RPC front end calls :meth:`Scheduler.submit` /
+:meth:`Scheduler.step` on its own schedule. Every future scaling PR
+(mesh sharding, TPU relay windows) slots in below ``advance``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from deap_tpu.serving.multirun import MultiRunEngine
+from deap_tpu.serving.tenant import Job, Tenant, bucket_key, pad_pow2
+from deap_tpu.support.compilecache import enable_compile_cache
+from deap_tpu.telemetry.meter import Meter
+from deap_tpu.telemetry.run import RunTelemetry
+
+__all__ = ["Scheduler", "prewarm"]
+
+
+class _Bucket:
+    """One shape bucket: its engine, admission queue and residency."""
+
+    def __init__(self, key, engine: MultiRunEngine):
+        self.key = key
+        self.engine = engine
+        self.queue: List[Tenant] = []
+        self.residents: List[Tenant] = []
+        self.batch: Optional[Dict[str, Any]] = None
+        self.horizon = 1
+
+    @property
+    def runnable(self) -> bool:
+        return bool(self.queue) or bool(self.residents)
+
+
+class Scheduler:
+    """Admit → pack → advance → drain/evict, one segment per step.
+
+    :param root: serving root directory — the shared journal
+        (``<root>/journal.jsonl``) plus one run dir per tenant
+        (``<root>/tenants/<id>/``: checkpoints, isolated from every
+        other tenant).
+    :param max_lanes: tenants packed per device batch (padded up to the
+        pow-2 lattice with inactive dummy lanes).
+    :param segment_len: generations per segment — the
+        eviction/telemetry/checkpoint granularity, exactly
+        ``ResilientRun``'s ``segment_len``.
+    :param fair_quantum: segments a resident tenant may hold a lane
+        while others queue; beyond it the tenant is evicted at the next
+        boundary (checkpoint as swap unit). ``None`` disables eviction.
+    :param checkpoint_every: write each resident tenant's checkpoint
+        every n-th boundary (1 = every boundary; ``None``/0 = only when
+        evicting — cheaper, but a crash then loses since-admission
+        progress).
+    :param telemetry: thread a per-bucket Meter (+ each job's probes)
+        through the batched scan and journal per-generation rows under
+        each ``tenant_id``. Costs the stacked meter output; off → only
+        lifecycle events are journaled.
+    :param compile_cache: path → :func:`enable_compile_cache` before
+        the first compile (persistent across processes).
+    """
+
+    def __init__(self, root: str, *, max_lanes: int = 8,
+                 segment_len: int = 10,
+                 fair_quantum: Optional[int] = 1,
+                 checkpoint_every: Optional[int] = 1,
+                 telemetry: bool = True,
+                 compile_cache: Optional[str] = None,
+                 journal_fsync_every: Optional[int] = None):
+        self.root = str(root)
+        os.makedirs(self.root, exist_ok=True)
+        if compile_cache:
+            enable_compile_cache(compile_cache)
+        self.max_lanes = int(max_lanes)
+        self.segment_len = int(segment_len)
+        self.fair_quantum = fair_quantum
+        self.checkpoint_every = checkpoint_every
+        self.telemetry = bool(telemetry)
+        from deap_tpu.telemetry.journal import RunJournal
+        self.journal = RunJournal(
+            os.path.join(self.root, "journal.jsonl"),
+            fsync_every=journal_fsync_every)
+        self.buckets: Dict[Any, _Bucket] = {}
+        self.tenants: Dict[str, Tenant] = {}
+        self._boundaries = 0
+        self._rr: List[Any] = []  # round-robin bucket order
+
+    # -------------------------------------------------------- admission ----
+
+    def submit(self, job: Job) -> str:
+        """Queue a job; returns its tenant id. Jobs with equal bucket
+        keys share one compiled program (see :func:`bucket_key`)."""
+        if job.tenant_id in self.tenants:
+            raise ValueError(f"tenant id {job.tenant_id!r} already "
+                             "submitted")
+        if job.family in ("ea_mu_plus_lambda", "ea_mu_comma_lambda") \
+                and (job.mu is None or job.lambda_ is None):
+            raise ValueError(f"{job.family} job needs mu/lambda_")
+        bkey = bucket_key(job)
+        bucket = self.buckets.get(bkey)
+        if bucket is None:
+            bucket = _Bucket(bkey, self._make_engine(job))
+            self.buckets[bkey] = bucket
+            self._rr.append(bkey)
+        tenant = Tenant(job, self.root)
+        self.tenants[tenant.id] = tenant
+        bucket.queue.append(tenant)
+        bucket.horizon = max(bucket.horizon, pad_pow2(int(job.ngen)))
+        self.journal.event("job_submitted", tenant_id=tenant.id,
+                           family=job.family, ngen=int(job.ngen),
+                           bucket=repr(bkey[:2]))
+        return tenant.id
+
+    def _make_engine(self, job: Job) -> MultiRunEngine:
+        tel = None
+        if self.telemetry:
+            tel = RunTelemetry(self.journal, meter=Meter(),
+                               spans=False, init_backend=False)
+        kwargs: Dict[str, Any] = {}
+        if job.family == "ea_generate_update":
+            kwargs.update(spec=job.spec, state_template=job.init)
+        return MultiRunEngine(
+            job.family, job.toolbox, mu=job.mu, lambda_=job.lambda_,
+            stats=job.stats, telemetry=tel, probes=job.probes,
+            halloffame_size=job.halloffame_size, **kwargs)
+
+    # ---------------------------------------------------------- prewarm ----
+
+    def prewarm(self, jobs: Iterable[Job],
+                lane_counts: Optional[Sequence[int]] = None) -> int:
+        """Compile each template job's bucket lattice before serving:
+        for every distinct bucket among ``jobs``, pack an inactive
+        dummy batch at each lattice lane count and run one segment
+        through the jitted program. With a persistent compile cache
+        enabled this is a disk read after the first process. Journals
+        one ``prewarm`` event per (bucket, lane-count); returns the
+        number of programs warmed."""
+        counts = (tuple(int(c) for c in lane_counts) if lane_counts
+                  else (pad_pow2(self.max_lanes),))
+        warmed = 0
+        seen = set()
+        for job in jobs:
+            bkey = bucket_key(job)
+            if bkey in seen:
+                continue
+            seen.add(bkey)
+            bucket = self.buckets.get(bkey)
+            if bucket is None:
+                bucket = _Bucket(bkey, self._make_engine(job))
+                self.buckets[bkey] = bucket
+                self._rr.append(bkey)
+            horizon = pad_pow2(int(job.ngen))
+            bucket.horizon = max(bucket.horizon, horizon)
+            eng = bucket.engine
+            lane = eng.lane_init(job.key, job.init, job.ngen,
+                                 job.hyper)
+            for n_lanes in counts:
+                t0 = time.perf_counter()
+                probe = eng.pack([lane], n_lanes=pad_pow2(n_lanes),
+                                 horizon=bucket.horizon)
+                # ngen=0 everywhere: the program compiles, no tenant
+                # state advances
+                probe["ngen"] = np.zeros_like(np.asarray(probe["ngen"]))
+                eng.advance(probe, self.segment_len)
+                warmed += 1
+                self.journal.event(
+                    "prewarm", bucket=repr(bkey[:2]),
+                    family=eng.family, lanes=pad_pow2(n_lanes),
+                    horizon=bucket.horizon,
+                    segment_len=self.segment_len,
+                    compile_s=round(time.perf_counter() - t0, 4))
+        return warmed
+
+    # ------------------------------------------------------- the cadence ----
+
+    def step(self) -> bool:
+        """One scheduling round: pick the next runnable bucket
+        (round-robin), ensure its batch is packed (admitting /
+        resuming / evicting at this boundary), advance one segment,
+        drain the boundary. Returns False when nothing is runnable."""
+        bucket = self._next_bucket()
+        if bucket is None:
+            return False
+        self._repack(bucket)
+        batch, seg = bucket.engine.advance(bucket.batch,
+                                           self.segment_len)
+        bucket.batch = batch
+        self._drain_boundary(bucket, seg)
+        return True
+
+    def run(self, max_steps: Optional[int] = None) -> Dict[str, tuple]:
+        """Drive :meth:`step` until every submitted job finished (or
+        ``max_steps``); returns ``{tenant_id: solo-format result}``."""
+        steps = 0
+        while self.step():
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return {t.id: t.result for t in self.tenants.values()
+                if t.result is not None}
+
+    def close(self) -> None:
+        self.journal.summary(
+            tenants=len(self.tenants),
+            finished=sum(t.done for t in self.tenants.values()))
+        self.journal.close()
+
+    def __enter__(self) -> "Scheduler":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ---------------------------------------------------------- internals ----
+
+    def _next_bucket(self) -> Optional[_Bucket]:
+        for _ in range(len(self._rr)):
+            bkey = self._rr.pop(0)
+            self._rr.append(bkey)
+            if self.buckets[bkey].runnable:
+                return self.buckets[bkey]
+        return None
+
+    def _repack(self, bucket: _Bucket) -> None:
+        """Boundary admission control: evict over-quantum residents
+        when jobs queue, fill free lanes from the queue, and (re)pack
+        the batch only when residency changed."""
+        eng = bucket.engine
+        changed = bucket.batch is None
+
+        # eviction — only under contention, only past the quantum
+        if bucket.queue and self.fair_quantum is not None:
+            free = self.max_lanes - len(bucket.residents)
+            want = len(bucket.queue) - free
+            if want > 0:
+                victims = sorted(
+                    (t for t in bucket.residents
+                     if t.segments_resident >= self.fair_quantum),
+                    key=lambda t: -t.segments_resident)[:want]
+                for t in victims:
+                    path = t.checkpoint(eng)
+                    self.journal.event(
+                        "tenant_evicted", tenant_id=t.id, gen=t.gen,
+                        path=path)
+                    t.evict()
+                    bucket.residents.remove(t)
+                    bucket.queue.append(t)
+                    changed = True
+
+        # admission — resume from checkpoint or fresh-init
+        while bucket.queue and len(bucket.residents) < self.max_lanes:
+            t = bucket.queue.pop(0)
+            if t.has_checkpoint:
+                t.restore(eng)
+                self.journal.event("tenant_resumed", tenant_id=t.id,
+                                   gen=t.gen)
+            else:
+                t.lane = eng.lane_init(t.job.key, t.job.init,
+                                       t.job.ngen, t.job.hyper)
+                self.journal.event("tenant_admitted", tenant_id=t.id,
+                                   ngen=int(t.job.ngen))
+                for row in eng.lane_meter_rows((), 0, lane=t.lane):
+                    self._journal_row(t, row)
+            t.status = Tenant.RUNNING
+            t.segments_resident = 0
+            bucket.residents.append(t)
+            changed = True
+
+        if changed and bucket.residents:
+            lanes = []
+            for slot, t in enumerate(bucket.residents):
+                t.slot = slot
+                lanes.append(t.lane)
+            bucket.batch = eng.pack(
+                lanes, n_lanes=pad_pow2(len(lanes), self.max_lanes),
+                horizon=bucket.horizon)
+
+    def _journal_row(self, tenant: Tenant, row: dict) -> None:
+        self.journal.event("meter", tenant_id=tenant.id, **row)
+        health = tenant.job.health
+        if health is not None:
+            for alarm in health.check_row(row, gen=row.get("gen")):
+                self.journal.event("alarm", tenant_id=tenant.id,
+                                   **alarm)
+
+    def _drain_boundary(self, bucket: _Bucket, seg: Dict[str, Any]
+                        ) -> None:
+        """The per-segment host sync: rows → tenants/journal/health,
+        completion, checkpoints."""
+        eng = bucket.engine
+        self._boundaries += 1
+        gens = np.asarray(bucket.batch["gen"])
+        finished: List[Tenant] = []
+        for t in list(bucket.residents):
+            i = t.slot
+            gen_before = t.gen
+            chunk = eng.lane_records((seg,), i)
+            if chunk is not None:
+                t.record_chunks.append(chunk)
+            for row in eng.lane_meter_rows((seg,), i,
+                                           gen_start=gen_before):
+                self._journal_row(t, row)
+            t.gen = int(gens[i])
+            t.segments_resident += 1
+            t.lane = eng.unpack(bucket.batch, i)
+            health = t.job.health
+            stop = health is not None and health.stop_requested
+            if t.gen >= int(t.job.ngen) or stop:
+                t.result = eng.lane_result(
+                    t.lane, eng.concat_records(t.record_chunks))
+                if stop and t.gen < int(t.job.ngen):
+                    t.status = Tenant.STOPPED
+                    t.stopped_at = t.gen
+                else:
+                    t.status = Tenant.FINISHED
+                self.journal.event(
+                    "tenant_finished", tenant_id=t.id, gen=t.gen,
+                    status=t.status)
+                finished.append(t)
+            elif self.checkpoint_every and \
+                    self._boundaries % self.checkpoint_every == 0:
+                t.checkpoint(eng)
+        if finished:
+            for t in finished:
+                bucket.residents.remove(t)
+                t.slot = None
+            bucket.batch = None  # repack next round
+
+        self.journal.event(
+            "segment", bucket=repr(bucket.key[:2]),
+            family=eng.family, lanes=int(len(gens)),
+            residents=len(bucket.residents) + len(finished),
+            finished=[t.id for t in finished])
+
+
+def prewarm(scheduler: Scheduler, jobs: Iterable[Job],
+            lane_counts: Optional[Sequence[int]] = None) -> int:
+    """Module-level alias for :meth:`Scheduler.prewarm` — compile the
+    shape-bucket lattice at scheduler startup (one journaled
+    ``prewarm`` event per bucket/lane-count)."""
+    return scheduler.prewarm(jobs, lane_counts=lane_counts)

@@ -1,0 +1,196 @@
+"""Jobs, tenants and the shape-bucket lattice.
+
+The serving layer's control-plane records. A :class:`Job` is what a
+user submits: which loop family, which problem program (toolbox), a
+seed, a generation budget and the per-run knobs. A :class:`Tenant` is
+the scheduler's runtime record of one job: its status, its per-tenant
+run directory (checkpoints + accumulated logbook rows), its lane state
+while resident, and its health monitor.
+
+**Bucketing.** One compiled multi-run program can only serve jobs that
+share everything shape- or program-relevant, so jobs are admitted into
+buckets keyed by :func:`bucket_key` — loop family, population/state
+shapes and dtypes, fitness arity and weights, mu/lambda, the toolbox
+program fingerprint (operators + evaluate), stats fields, probe types
+and hall-of-fame size. Within a bucket, per-tenant freedom is exactly
+what the engine vmaps: seed, initial values, ``ngen``, cxpb/mutpb (and,
+for CMA, sigma/centroid through the initial state).
+
+**Lattice.** Lane counts and key horizons are padded up to powers of
+two (:func:`pad_pow2`) — the same bounded-shape-set trick as the GP
+interpreter's chunk-count lattice — so a bucket compiles O(log)
+distinct programs no matter how tenant counts and budgets churn, and a
+persistent compile cache (:func:`deap_tpu.serving.enable_compile_cache`)
+makes them one-time across processes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from deap_tpu.core.population import Population
+from deap_tpu.support.checkpoint import Checkpointer
+from deap_tpu.telemetry.journal import toolbox_fingerprint
+
+__all__ = ["Job", "Tenant", "bucket_key", "pad_pow2"]
+
+
+def pad_pow2(n: int, cap: Optional[int] = None) -> int:
+    """The smallest power of two >= ``n`` (optionally clamped to
+    ``cap``) — the lane-count / key-horizon lattice."""
+    if n < 1:
+        raise ValueError("pad_pow2 needs n >= 1")
+    p = 1
+    while p < n:
+        p *= 2
+    if cap is not None:
+        p = min(p, int(cap))
+    return p
+
+
+@dataclasses.dataclass
+class Job:
+    """One evolution job as submitted to the scheduler.
+
+    ``init`` is the founder :class:`Population` (population families)
+    or the initial strategy state (``ea_generate_update``, which also
+    needs ``spec``). ``hyper`` holds the family's per-run knobs
+    (``cxpb``/``mutpb``). ``program`` tags the problem program for
+    bucketing; default is the toolbox fingerprint digest — override it
+    when two toolboxes are built from the same factory and should
+    share compiles (closures fingerprint by identity). ``health`` is a
+    per-tenant :class:`~deap_tpu.telemetry.probes.HealthMonitor`
+    (stateful — never share one instance across jobs); alarms journal
+    under this tenant's id and ``early_stop`` frees the lane at the
+    next segment boundary.
+    """
+
+    tenant_id: str
+    family: str
+    toolbox: Any
+    key: Any
+    init: Any
+    ngen: int
+    hyper: Dict[str, float] = dataclasses.field(default_factory=dict)
+    mu: Optional[int] = None
+    lambda_: Optional[int] = None
+    spec: Any = None
+    stats: Any = None
+    probes: Tuple = ()
+    halloffame_size: int = 0
+    health: Any = None
+    program: Optional[str] = None
+
+
+def _shape_sig(tree: Any) -> Tuple:
+    return tuple((tuple(np.shape(leaf)), np.asarray(leaf).dtype.name)
+                 for leaf in jax.tree_util.tree_leaves(tree))
+
+
+def bucket_key(job: Job) -> Tuple:
+    """The hashable bucket a job is admitted into: jobs with equal keys
+    run through one compiled multi-run program."""
+    program = job.program
+    if program is None:
+        program = toolbox_fingerprint(job.toolbox)["digest"]
+    if isinstance(job.init, Population):
+        shapes = (("pop", job.init.size, job.init.nobj,
+                   tuple(job.init.spec.weights)),
+                  _shape_sig(job.init.genomes),
+                  _shape_sig(job.init.extras))
+    else:
+        weights = (tuple(job.spec.weights)
+                   if job.spec is not None else None)
+        shapes = (("state", weights), _shape_sig(job.init))
+    stats_fields = (tuple(job.stats.fields)
+                    if job.stats is not None else ())
+    probe_types = tuple(type(p).__name__ for p in job.probes)
+    return (job.family, program, shapes, job.mu, job.lambda_,
+            stats_fields, probe_types, int(job.halloffame_size))
+
+
+class Tenant:
+    """Runtime record of one admitted job.
+
+    Owns the per-tenant run directory (``<root>/tenants/<id>/``) whose
+    checkpoints are the scheduler's swap unit: :meth:`checkpoint`
+    writes the lane state + accumulated logbook rows with
+    ``tenant_id`` in the v2 meta, :meth:`restore` reads the newest
+    valid checkpoint back *filtered on that id* — co-located or
+    misconfigured tenant directories can never cross-restore
+    (``Checkpointer.restore_latest(tenant_id=...)``).
+    """
+
+    #: admission/run states
+    QUEUED, RUNNING, FINISHED, STOPPED = \
+        "queued", "running", "finished", "stopped"
+
+    def __init__(self, job: Job, root: str):
+        self.job = job
+        self.id = job.tenant_id
+        self.run_dir = os.path.join(root, "tenants", str(job.tenant_id))
+        self.status = self.QUEUED
+        self.gen = 0
+        self.slot: Optional[int] = None
+        self.segments_resident = 0
+        self.lane: Optional[Dict[str, Any]] = None
+        self.record_chunks: List[Any] = []
+        self.result: Optional[tuple] = None
+        self.stopped_at: Optional[int] = None
+        self.has_checkpoint = False
+        self._ckpt: Optional[Checkpointer] = None
+
+    @property
+    def ckpt(self) -> Checkpointer:
+        if self._ckpt is None:
+            self._ckpt = Checkpointer(
+                os.path.join(self.run_dir, "ckpt"), keep=2)
+        return self._ckpt
+
+    @property
+    def done(self) -> bool:
+        return self.status in (self.FINISHED, self.STOPPED)
+
+    def checkpoint(self, engine, meta: Optional[Dict[str, Any]] = None
+                   ) -> str:
+        """Persist the swap unit: lane state + logbook rows so far,
+        keyed by the completed-generation count, ``tenant_id`` in the
+        meta."""
+        records = engine.concat_records(self.record_chunks)
+        state = {"lane": self.lane, "records": records,
+                 "family": engine.family}
+        m = {"tenant_id": self.id, "gen": self.gen,
+             "ngen": int(self.job.ngen), **(meta or {})}
+        path = self.ckpt.save(self.gen, state, meta=m)
+        self.has_checkpoint = True
+        return path
+
+    def restore(self, engine) -> None:
+        """Load the newest valid checkpoint *for this tenant* back into
+        the in-memory lane/records (the resume half of the swap)."""
+        got = self.ckpt.restore_latest(tenant_id=self.id)
+        if got is None:
+            raise FileNotFoundError(
+                f"tenant {self.id}: no checkpoint under "
+                f"{self.ckpt.directory}")
+        step, state = got
+        if state.get("family") != engine.family:
+            raise ValueError(
+                f"tenant {self.id}: checkpoint family "
+                f"{state.get('family')!r} != bucket {engine.family!r}")
+        self.lane = state["lane"]
+        self.record_chunks = ([] if state["records"] is None
+                              else [state["records"]])
+        self.gen = int(step)
+
+    def evict(self) -> None:
+        self.status = self.QUEUED
+        self.slot = None
+        self.lane = None          # swap unit is on disk
+        self.record_chunks = []   # rolled into the checkpoint
+        self.segments_resident = 0

@@ -125,12 +125,23 @@ class Strategy:
         damps = 1.0 + 2.0 * max(0.0, math.sqrt((mueff - 1.0) / (dim + 1.0)) - 1.0) + self.cs
         self.damps = params.get("damps", damps)
 
-    def initial_state(self) -> CMAState:
+    def initial_state(self, sigma: Optional[float] = None,
+                      centroid=None) -> CMAState:
+        """Fresh state; ``sigma``/``centroid`` override the constructor
+        values per *state* — the multi-tenant serving layer shares one
+        Strategy configuration (λ, weights, learning rates are static
+        per compiled bucket) across tenants whose runs differ only in
+        these initial-state knobs (deap_tpu/serving/)."""
         C = jnp.asarray(self._cmatrix0)
         evals, B = jnp.linalg.eigh(C)
+        c0 = (self._centroid0 if centroid is None
+              else np.asarray(centroid, np.float32))
+        if c0.shape != (self.dim,):
+            raise ValueError(
+                f"centroid override shape {c0.shape} != ({self.dim},)")
         return CMAState(
-            centroid=jnp.asarray(self._centroid0),
-            sigma=jnp.float32(self._sigma0),
+            centroid=jnp.asarray(c0),
+            sigma=jnp.float32(self._sigma0 if sigma is None else sigma),
             C=C, B=B, diagD=jnp.sqrt(evals),
             ps=jnp.zeros(self.dim), pc=jnp.zeros(self.dim),
             count=jnp.int32(0))

@@ -189,10 +189,22 @@ def verify_checkpoint(path: str) -> Dict[str, Any]:
     return meta if isinstance(meta, dict) else {}
 
 
-def checkpoint_meta(path: str) -> Dict[str, Any]:
+def checkpoint_meta(path: str,
+                    tenant_id: Optional[str] = None) -> Dict[str, Any]:
     """The ``meta`` dict stored by :func:`save_state` (empty for
-    version-1 files). Verifies CRCs on the way."""
-    return verify_checkpoint(path)
+    version-1 files). Verifies CRCs on the way.
+
+    ``tenant_id`` asserts ownership: the serving layer stamps every
+    per-tenant checkpoint with its tenant id, and a reader that knows
+    whose state it expects passes it here — a mismatch (including a
+    file with no tenant stamp at all) raises ``ValueError`` instead of
+    handing one tenant another tenant's state."""
+    meta = verify_checkpoint(path)
+    if tenant_id is not None and meta.get("tenant_id") != tenant_id:
+        raise ValueError(
+            f"checkpoint {path} belongs to tenant "
+            f"{meta.get('tenant_id')!r}, not {tenant_id!r}")
+    return meta
 
 
 def restore_state(path: str) -> Any:
@@ -316,12 +328,19 @@ class Checkpointer:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
         return got[1]
 
-    def restore_latest(self) -> Optional[Tuple[int, Any]]:
+    def restore_latest(self, tenant_id: Optional[str] = None
+                       ) -> Optional[Tuple[int, Any]]:
         """``(step, state)`` of the newest valid checkpoint, or ``None``
         when the directory holds no checkpoints at all. Corrupt files
         are skipped newest-first, each journaled as a
         ``checkpoint_corrupt`` event; if every file is corrupt, raises
-        :class:`CheckpointCorruptError`."""
+        :class:`CheckpointCorruptError`.
+
+        ``tenant_id`` (the serving layer's per-tenant swap unit) makes
+        the walk *ownership-filtered*: files whose v2 ``meta`` carries
+        a different ``tenant_id`` — or none at all — are skipped (each
+        journaled as ``checkpoint_tenant_mismatch``), so co-located or
+        misconfigured tenant directories can never cross-restore."""
         from deap_tpu.telemetry.journal import broadcast
 
         steps = self.steps()
@@ -331,6 +350,13 @@ class Checkpointer:
         for s in reversed(steps):
             path = self._path(s)
             try:
+                if tenant_id is not None:
+                    meta = checkpoint_meta(path)
+                    if meta.get("tenant_id") != tenant_id:
+                        broadcast("checkpoint_tenant_mismatch",
+                                  path=path, expected=tenant_id,
+                                  found=meta.get("tenant_id"))
+                        continue
                 state = restore_state(path)
             except FileNotFoundError:
                 continue  # rotated away between listdir and read
@@ -344,6 +370,8 @@ class Checkpointer:
                 broadcast("checkpoint_fallback", path=path, step=s,
                           skipped=[x for x in steps if x > s])
             return s, state
+        if tenant_id is not None and last_error is None:
+            return None  # only foreign-tenant files present
         raise last_error if last_error is not None else FileNotFoundError(
             f"no checkpoints in {self.directory}")
 

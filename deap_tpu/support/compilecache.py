@@ -25,6 +25,15 @@ from typing import Optional
 #: the environment opt-in bench.py and the examples honour
 ENV_VAR = "DEAP_TPU_COMPILE_CACHE"
 
+#: the directory the programmatic opt-in resolved to (None = not
+#: enabled through this module)
+_enabled_path: Optional[str] = None
+
+
+def enabled_path() -> Optional[str]:
+    """Where the cache currently points (via this module), or None."""
+    return _enabled_path
+
 
 def enable(path: str, min_compile_time_secs: float = 0.0) -> str:
     """Point JAX's persistent compilation cache at ``path`` (created if
@@ -48,7 +57,39 @@ def enable(path: str, min_compile_time_secs: float = 0.0) -> str:
             jax.config.update(name, value)
         except Exception:
             pass
+    global _enabled_path
+    _enabled_path = path
     return path
+
+
+def enable_compile_cache(path: Optional[str] = None,
+                         min_compile_time_secs: float = 0.0) -> str:
+    """The programmatic opt-in (closes ROADMAP item 5's API half):
+    point the persistent XLA compile cache at ``path`` — default
+    ``$DEAP_TPU_COMPILE_CACHE``, else ``~/.cache/deap_tpu/xla`` — and
+    journal a ``compile_cache`` event into any open run journal so a
+    serving run's cold-start economics are attributable. Idempotent:
+    re-enabling the same directory is a no-op. Returns the resolved
+    path.
+
+    The serving scheduler calls this (``Scheduler(compile_cache=...)``)
+    before its first compile; paired with
+    :func:`deap_tpu.serving.prewarm`, the shape-bucket lattice then
+    compiles once per *fleet*, not once per process."""
+    if path is None:
+        path = os.environ.get(ENV_VAR) or os.path.join(
+            os.path.expanduser("~"), ".cache", "deap_tpu", "xla")
+    resolved = os.path.abspath(os.path.expanduser(str(path)))
+    if _enabled_path == resolved:
+        return resolved
+    resolved = enable(resolved,
+                      min_compile_time_secs=min_compile_time_secs)
+    try:
+        from deap_tpu.telemetry.journal import broadcast
+        broadcast("compile_cache", path=resolved)
+    except Exception:
+        pass
+    return resolved
 
 
 def enable_from_env(var: str = ENV_VAR) -> Optional[str]:

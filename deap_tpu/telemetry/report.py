@@ -93,12 +93,75 @@ def _meter_series(events: List[Dict[str, Any]]):
             continue
         gen = e.get("gen")
         for k, v in e.items():
-            if k in ("kind", "t", "gen"):
+            if k in ("kind", "t", "gen", "tenant_id"):
                 continue
             if isinstance(v, bool) or not isinstance(v, (int, float)):
                 continue
             series.setdefault(k, []).append((gen, v))
     return series
+
+
+def _tenant_sections(events: List[Dict[str, Any]], out: List[str]
+                     ) -> bool:
+    """Multi-tenant serving journals: group meter/alarm/lifecycle rows
+    by ``tenant_id`` and render one per-tenant block (metric
+    sparklines + that tenant's alarm timeline), plus the scheduler's
+    admission/eviction ledger. Returns True when the journal was
+    multi-tenant (the caller then skips the single-run sections that
+    would interleave tenants)."""
+    tenants: Dict[str, List[Dict[str, Any]]] = {}
+    for e in events:
+        tid = e.get("tenant_id")
+        if tid is not None:
+            tenants.setdefault(str(tid), []).append(e)
+    if not tenants:
+        return False
+
+    prewarms = [e for e in events if e.get("kind") == "prewarm"]
+    if prewarms:
+        total = sum(e.get("compile_s", 0.0) for e in prewarms)
+        out.append(f"- prewarm: {len(prewarms)} bucket program(s), "
+                   f"{total:.3f}s compiling")
+    segs = [e for e in events if e.get("kind") == "segment"
+            and "tenant_id" not in e]
+    if segs:
+        out.append(f"- {len(segs)} scheduler segment(s)")
+
+    out.append("")
+    out.append(f"## Tenants ({len(tenants)})")
+    for tid in sorted(tenants):
+        rows = tenants[tid]
+        out.append("")
+        out.append(f"### tenant {tid}")
+        life = {k: sum(1 for e in rows if e.get("kind") == k)
+                for k in ("tenant_admitted", "tenant_evicted",
+                          "tenant_resumed", "tenant_finished")}
+        fin = next((e for e in rows
+                    if e.get("kind") == "tenant_finished"), None)
+        bits = [f"evicted×{life['tenant_evicted']}"
+                if life["tenant_evicted"] else None,
+                f"resumed×{life['tenant_resumed']}"
+                if life["tenant_resumed"] else None]
+        status = (f"{fin.get('status', 'finished')} at gen "
+                  f"{fin.get('gen')}" if fin else "in flight")
+        out.append("- " + ", ".join([status] + [b for b in bits if b]))
+        series = _meter_series(rows)
+        if series:
+            width = max(len(k) for k in series)
+            for name in sorted(series):
+                vals = [v for _, v in series[name]]
+                out.append(f"{name.ljust(width)}  {sparkline(vals)}  "
+                           f"min={_fmt(min(vals))} "
+                           f"max={_fmt(max(vals))} "
+                           f"last={_fmt(vals[-1])}")
+        alarms = [e for e in rows if e.get("kind") == "alarm"]
+        for a in alarms:
+            detail = ", ".join(
+                f"{k}={_fmt(v)}" for k, v in a.items()
+                if k not in ("kind", "t", "alarm", "gen", "tenant_id"))
+            out.append(f"- gen {a.get('gen')} ▲ **{a.get('alarm')}**"
+                       + (f" ({detail})" if detail else ""))
+    return True
 
 
 def render_report(path: str, lines: Optional[List[str]] = None) -> str:
@@ -158,6 +221,21 @@ def render_report(path: str, lines: Optional[List[str]] = None) -> str:
         if fallbacks:
             out.append(f"  - ▲ {len(fallbacks)} fused-plane fallback(s):"
                        f" {fallbacks[0].get('reason')}")
+
+    # ----------------------------------------- multi-tenant journals ----
+    if _tenant_sections(events, out):
+        # per-tenant blocks replace the single-run meter/alarm
+        # sections (which would interleave tenants); the summary
+        # still applies to the scheduler process as a whole
+        summary = next((e for e in reversed(events)
+                        if e.get("kind") == "summary"), None)
+        if summary is not None:
+            out.append("")
+            out.append("## Summary")
+            out.append("- " + ", ".join(
+                f"{k}={_fmt(v)}" for k, v in summary.items()
+                if k not in ("kind", "t")))
+        return "\n".join(out)
 
     # ------------------------------------------------ probe sparklines ----
     series = _meter_series(events)

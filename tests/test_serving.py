@@ -1,0 +1,408 @@
+"""Serving layer — multi-run vectorization + multi-tenant scheduler.
+
+The acceptance bar of ``deap_tpu/serving/``: a tenant's batched
+trajectory must be **bit-identical** to the same job run solo through
+the monolithic loops — populations, logbooks, halls of fame and
+per-generation Meter/probe rows — pinned here for ea_simple,
+mu+lambda, mu,lambda and the CMA ask-tell family (mixed per-run ngen
+and hyperparameters in one batch). Plus the scheduler half: shape
+buckets and the pow-2 lane lattice, segment-cadence execution,
+checkpoint-as-swap-unit eviction/resume under contention, per-tenant
+health early-stop, and prewarm journaling.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deap_tpu import algorithms, ops
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import init_population
+from deap_tpu.core.toolbox import Toolbox
+from deap_tpu.serving import (
+    Job,
+    MultiRunEngine,
+    Scheduler,
+    bucket_key,
+    multirun,
+    pad_pow2,
+    prewarm,
+)
+from deap_tpu.strategies import cma
+from deap_tpu.support.stats import Statistics
+from deap_tpu.telemetry import RunTelemetry, read_journal
+from deap_tpu.telemetry.probes import (
+    DiversityProbe,
+    FitnessProbe,
+    HealthMonitor,
+)
+
+
+def _toolbox():
+    tb = Toolbox()
+    tb.register("evaluate", lambda g: g.sum(-1).astype(jnp.float32))
+    tb.register("mate", ops.cx_two_point)
+    tb.register("mutate", ops.mut_flip_bit, indpb=0.1)
+    tb.register("select", ops.sel_tournament, tournsize=3)
+    return tb
+
+
+def _pops(n_runs=3, n=24, length=16):
+    spec = FitnessSpec((1.0,))
+    return [init_population(jax.random.key(s), n,
+                            ops.bernoulli_genome(length), spec)
+            for s in range(n_runs)]
+
+
+def _keys(n_runs=3, base=100):
+    return [jax.random.key(base + s) for s in range(n_runs)]
+
+
+def _assert_pop_equal(a, b):
+    np.testing.assert_array_equal(np.asarray(a.genomes),
+                                  np.asarray(b.genomes))
+    np.testing.assert_array_equal(np.asarray(a.fitness),
+                                  np.asarray(b.fitness))
+    np.testing.assert_array_equal(np.asarray(a.valid),
+                                  np.asarray(b.valid))
+
+
+def _assert_logbook_equal(a, b):
+    assert len(a) == len(b)
+    for ra, rb in zip(a, b):
+        assert set(ra) == set(rb)
+        for k in ra:
+            np.testing.assert_array_equal(np.asarray(ra[k]),
+                                          np.asarray(rb[k]))
+
+
+HYPER = [{"cxpb": 0.5, "mutpb": 0.2}, {"cxpb": 0.7, "mutpb": 0.1},
+         {"cxpb": 0.3, "mutpb": 0.3}]
+
+
+# ------------------------------------------- batched-vs-solo parity ----
+
+def test_multirun_ea_simple_bit_identity():
+    """Mixed ngen + per-run cxpb/mutpb in one batch == each job solo
+    (populations, logbooks incl. stats fields, hofs)."""
+    tb, pops, keys = _toolbox(), _pops(), _keys()
+    stats = Statistics()
+    stats.register("max", jnp.max)
+    stats.register("mean", jnp.mean)
+    ngen = [7, 5, 7]
+    res = multirun("ea_simple", tb, keys, pops, ngen, HYPER,
+                   segment_len=3, stats=stats, halloffame_size=4)
+    for r in range(3):
+        sp, slb, sh = algorithms.ea_simple(
+            keys[r], pops[r], tb, HYPER[r]["cxpb"], HYPER[r]["mutpb"],
+            ngen[r], stats=stats, halloffame_size=4)
+        bp, blb, bh = res[r]
+        _assert_pop_equal(sp, bp)
+        _assert_logbook_equal(slb, blb)
+        np.testing.assert_array_equal(np.asarray(sh.genomes),
+                                      np.asarray(bh.genomes))
+        np.testing.assert_array_equal(np.asarray(sh.fitness),
+                                      np.asarray(bh.fitness))
+
+
+def test_multirun_mu_plus_lambda_bit_identity():
+    tb, pops, keys = _toolbox(), _pops(), _keys(base=40)
+    res = multirun("ea_mu_plus_lambda", tb, keys, pops, 6, HYPER,
+                   segment_len=4, mu=24, lambda_=24, halloffame_size=3)
+    for r in range(3):
+        sp, slb, sh = algorithms.ea_mu_plus_lambda(
+            keys[r], pops[r], tb, 24, 24, HYPER[r]["cxpb"],
+            HYPER[r]["mutpb"], 6, halloffame_size=3)
+        bp, blb, bh = res[r]
+        _assert_pop_equal(sp, bp)
+        _assert_logbook_equal(slb, blb)
+        np.testing.assert_array_equal(np.asarray(sh.fitness),
+                                      np.asarray(bh.fitness))
+
+
+def test_multirun_mu_comma_lambda_bit_identity():
+    tb, pops, keys = _toolbox(), _pops(), _keys(base=60)
+    res = multirun("ea_mu_comma_lambda", tb, keys, pops, [6, 4, 6],
+                   HYPER, mu=24, lambda_=24)
+    for r, ngen in enumerate([6, 4, 6]):
+        sp, slb, sh = algorithms.ea_mu_comma_lambda(
+            keys[r], pops[r], tb, 24, 24, HYPER[r]["cxpb"],
+            HYPER[r]["mutpb"], ngen)
+        bp, blb, bh = res[r]
+        _assert_pop_equal(sp, bp)
+        _assert_logbook_equal(slb, blb)
+
+
+def test_multirun_cma_bit_identity():
+    """The CMA ask-tell path: per-run sigma through the initial state,
+    mixed ngen; full strategy-state pytree pinned bitwise (this is the
+    family whose covariance update exposed the masked-stepping fusion
+    hazard — the shadow-carry construction is what keeps it exact)."""
+    strat = cma.Strategy(centroid=[3.0] * 6, sigma=0.5, lambda_=12)
+    tb = Toolbox()
+    tb.register("evaluate", lambda g: (g ** 2).sum(-1))
+    tb.register("generate", strat.generate)
+    tb.register("update", strat.update)
+    states = [strat.initial_state(sigma=s) for s in (0.3, 0.5, 0.9)]
+    keys = _keys(base=7)
+    ngens = [8, 5, 3]
+    res = multirun("ea_generate_update", tb, keys, states, ngens,
+                   segment_len=3, spec=strat.spec,
+                   state_template=states[0], halloffame_size=2)
+    for r in range(3):
+        st, slb, sh = algorithms.ea_generate_update(
+            keys[r], states[r], tb, ngens[r], spec=strat.spec,
+            halloffame_size=2)
+        bt, blb, bh = res[r]
+        for la, lb in zip(jax.tree_util.tree_leaves(st),
+                          jax.tree_util.tree_leaves(bt)):
+            np.testing.assert_array_equal(np.asarray(la),
+                                          np.asarray(lb))
+        _assert_logbook_equal(slb, blb)
+        np.testing.assert_array_equal(np.asarray(sh.fitness),
+                                      np.asarray(bh.fitness))
+
+
+def test_multirun_pack_fresh_matches_lane_init():
+    """The vectorized admission path (pack_fresh) and the
+    lane-at-a-time path (lane_init + pack) build bit-identical
+    batches — the scheduler uses the latter, the bench the former."""
+    tb, pops, keys = _toolbox(), _pops(), _keys(base=80)
+    eng1 = MultiRunEngine("ea_simple", tb)
+    lanes = [eng1.lane_init(keys[r], pops[r], 5, HYPER[0])
+             for r in range(3)]
+    b1 = eng1.pack(lanes, n_lanes=4, horizon=8)
+    eng2 = MultiRunEngine("ea_simple", tb)
+    b2 = eng2.pack_fresh(keys, pops, 5, HYPER[0], n_lanes=4,
+                         horizon=8)
+    for k in ("carry", "gen", "ngen", "keys", "hyper", "record0"):
+        for la, lb in zip(jax.tree_util.tree_leaves(b1[k]),
+                          jax.tree_util.tree_leaves(b2[k])):
+            np.testing.assert_array_equal(np.asarray(la),
+                                          np.asarray(lb))
+
+
+# ------------------------------------- batched telemetry parity ----
+
+def test_batched_meter_probe_rows_match_solo(tmp_path):
+    """The vmapped Meter/probe carry (DiversityProbe + FitnessProbe)
+    decodes to per-run rows IDENTICAL to each solo run's journal rows
+    for the same seeds — per-run telemetry survives batching."""
+    tb, pops, keys = _toolbox(), _pops(), _keys()
+    NGEN = 6
+    probes = lambda: (DiversityProbe(sample=16), FitnessProbe())
+
+    solo_rows = []
+    for r in range(3):
+        path = str(tmp_path / f"solo{r}.jsonl")
+        with RunTelemetry(path) as tel:
+            algorithms.ea_simple(keys[r], pops[r], tb, 0.5, 0.2, NGEN,
+                                 telemetry=tel, probes=probes())
+        solo_rows.append([e for e in read_journal(path)
+                          if e.get("kind") == "meter"])
+
+    with RunTelemetry(str(tmp_path / "batch.jsonl")) as tel:
+        eng = MultiRunEngine("ea_simple", tb, telemetry=tel,
+                             probes=probes())
+        lanes = [eng.lane_init(keys[r], pops[r], NGEN,
+                               {"cxpb": 0.5, "mutpb": 0.2})
+                 for r in range(3)]
+        batch = eng.pack(lanes, n_lanes=4, horizon=8)
+        segs = []
+        while not eng.done(batch).all():
+            batch, seg = eng.advance(batch, 4)
+            segs.append(seg)
+        for r in range(3):
+            rows = eng.lane_meter_rows(segs, r, lane=lanes[r])
+            srows = solo_rows[r]
+            assert len(rows) == len(srows) == NGEN + 1
+            for got, want in zip(rows, srows):
+                want = {k: v for k, v in want.items()
+                        if k not in ("kind", "t")}
+                assert set(got) == set(want)
+                for k in got:
+                    assert got[k] == want[k], (r, got.get("gen"), k)
+
+
+def test_multirun_rejects_streaming_telemetry(tmp_path):
+    tb = _toolbox()
+    with RunTelemetry(str(tmp_path / "j.jsonl"), stream=True) as tel:
+        with pytest.raises(ValueError, match="stream"):
+            MultiRunEngine("ea_simple", tb, telemetry=tel)
+
+
+# --------------------------------------------- bucket lattice ----
+
+def test_pad_pow2():
+    assert [pad_pow2(n) for n in (1, 2, 3, 5, 8, 9)] == \
+        [1, 2, 4, 8, 8, 16]
+    assert pad_pow2(9, cap=8) == 8
+    with pytest.raises(ValueError):
+        pad_pow2(0)
+
+
+def test_bucket_key_separates_shapes_and_programs():
+    tb = _toolbox()
+    pops = _pops(1) + [init_population(
+        jax.random.key(9), 24, ops.bernoulli_genome(32),
+        FitnessSpec((1.0,)))]
+    mk = lambda pop, fam="ea_simple", prog="p", **kw: Job(
+        tenant_id="x", family=fam, toolbox=tb, key=jax.random.key(0),
+        init=pop, ngen=5, program=prog, **kw)
+    base = bucket_key(mk(pops[0]))
+    assert bucket_key(mk(pops[0])) == base          # same config
+    assert bucket_key(mk(pops[1])) != base          # genome length
+    assert bucket_key(mk(pops[0], prog="q")) != base  # program tag
+    assert bucket_key(mk(pops[0], fam="ea_mu_plus_lambda", mu=8,
+                         lambda_=16)) != base       # family
+    assert bucket_key(mk(pops[0], halloffame_size=2)) != base
+
+
+# ------------------------------------------------- scheduler ----
+
+def _jobs(tb, n=4, ngen=5, **kw):
+    jobs = []
+    for i in range(n):
+        pop = init_population(jax.random.key(i), 16,
+                              ops.bernoulli_genome(12),
+                              FitnessSpec((1.0,)))
+        jobs.append(Job(tenant_id=f"t{i}", family="ea_simple",
+                        toolbox=tb, key=jax.random.key(100 + i),
+                        init=pop, ngen=ngen,
+                        hyper={"cxpb": 0.5, "mutpb": 0.2},
+                        program="onemax", **kw))
+    return jobs
+
+
+def test_scheduler_eviction_resume_bit_identity(tmp_path):
+    """Contention (4 tenants, 2 lanes, quantum 1) forces checkpoint
+    eviction and swap-in resume; every tenant's result must still be
+    bit-identical to its solo run, the journal must show the swap
+    ledger, and every meter row must carry its tenant_id."""
+    tb = _toolbox()
+    jobs = _jobs(tb)
+    with Scheduler(str(tmp_path), max_lanes=2, segment_len=3,
+                   fair_quantum=1) as sched:
+        for j in jobs:
+            sched.submit(j)
+        results = sched.run()
+
+    assert set(results) == {j.tenant_id for j in jobs}
+    for j in jobs:
+        sp, slb, _ = algorithms.ea_simple(
+            j.key, j.init, tb, 0.5, 0.2, j.ngen)
+        bp, blb, _ = results[j.tenant_id]
+        _assert_pop_equal(sp, bp)
+        _assert_logbook_equal(slb, blb)
+
+    rows = read_journal(str(tmp_path / "journal.jsonl"))
+    kinds = [e.get("kind") for e in rows]
+    assert kinds.count("tenant_finished") == len(jobs)
+    assert "tenant_evicted" in kinds and "tenant_resumed" in kinds
+    meters = [e for e in rows if e.get("kind") == "meter"]
+    assert meters and all("tenant_id" in e for e in meters)
+    # per-tenant isolation on disk: each tenant's checkpoints live
+    # under its own run dir with its id stamped in the meta
+    for j in jobs[:2]:
+        d = tmp_path / "tenants" / j.tenant_id / "ckpt"
+        if d.exists() and any(d.iterdir()):
+            from deap_tpu.support.checkpoint import (
+                Checkpointer, checkpoint_meta)
+            ck = Checkpointer(str(d))
+            meta = ck.meta()
+            assert meta["tenant_id"] == j.tenant_id
+            with pytest.raises(ValueError):
+                checkpoint_meta(ck.path_for(ck.latest_step()),
+                                tenant_id="intruder")
+
+
+def test_scheduler_health_early_stop_frees_slot(tmp_path):
+    """A tenant whose HealthMonitor trips ``zero_improvement`` with
+    early_stop is finished at the segment boundary (status stopped,
+    partial logbook), freeing its lane; co-tenants are untouched."""
+    tb = Toolbox()
+    # constant fitness: best never improves, stagnation fires
+    tb.register("evaluate",
+                lambda g: jnp.zeros(g.shape[0], jnp.float32))
+    tb.register("mate", ops.cx_two_point)
+    tb.register("mutate", ops.mut_flip_bit, indpb=0.1)
+    tb.register("select", ops.sel_tournament, tournsize=3)
+    jobs = _jobs(tb, n=2, ngen=9)
+    jobs[0].health = HealthMonitor(stagnation_window=2,
+                                   early_stop=("zero_improvement",))
+    with Scheduler(str(tmp_path), max_lanes=2, segment_len=3) as sched:
+        for j in jobs:
+            sched.submit(j)
+        results = sched.run()
+        stopped = sched.tenants["t0"]
+        other = sched.tenants["t1"]
+    assert stopped.status == "stopped"
+    assert stopped.stopped_at is not None and stopped.stopped_at < 9
+    assert other.status == "finished" and other.gen == 9
+    # the stopped tenant's partial logbook covers exactly its gens
+    _, lb, _ = results["t0"]
+    assert len(lb) == stopped.stopped_at + 1
+    rows = read_journal(str(tmp_path / "journal.jsonl"))
+    alarms = [e for e in rows if e.get("kind") == "alarm"]
+    assert alarms and all(e["tenant_id"] == "t0" for e in alarms)
+
+
+def test_scheduler_two_buckets_round_robin(tmp_path):
+    """A GA bucket and a CMA bucket coexist: distinct compiled
+    programs, both finish, results bit-identical to solo."""
+    tb = _toolbox()
+    strat = cma.Strategy(centroid=[2.0] * 4, sigma=0.4, lambda_=8)
+    tbc = Toolbox()
+    tbc.register("evaluate", lambda g: (g ** 2).sum(-1))
+    tbc.register("generate", strat.generate)
+    tbc.register("update", strat.update)
+    ga = _jobs(tb, n=1, ngen=4)[0]
+    cj = Job(tenant_id="cma0", family="ea_generate_update",
+             toolbox=tbc, key=jax.random.key(5),
+             init=strat.initial_state(sigma=0.7), ngen=4,
+             spec=strat.spec, program="sphere")
+    with Scheduler(str(tmp_path), max_lanes=2, segment_len=2) as sched:
+        sched.submit(ga)
+        sched.submit(cj)
+        results = sched.run()
+    assert set(results) == {"t0", "cma0"}
+    st, slb, _ = algorithms.ea_generate_update(
+        cj.key, cj.init, tbc, 4, spec=strat.spec)
+    bt, blb, _ = results["cma0"]
+    for la, lb_ in zip(jax.tree_util.tree_leaves(st),
+                       jax.tree_util.tree_leaves(bt)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb_))
+    _assert_logbook_equal(slb, blb)
+
+
+def test_scheduler_prewarm_journals_per_bucket(tmp_path):
+    tb = _toolbox()
+    jobs = _jobs(tb, n=2)
+    with Scheduler(str(tmp_path), max_lanes=2, segment_len=3) as sched:
+        warmed = prewarm(sched, jobs)
+        assert warmed == 1  # both jobs share one bucket
+    rows = read_journal(str(tmp_path / "journal.jsonl"))
+    pw = [e for e in rows if e.get("kind") == "prewarm"]
+    assert len(pw) == 1
+    assert pw[0]["family"] == "ea_simple" and pw[0]["lanes"] == 2
+    assert pw[0]["compile_s"] > 0
+
+
+def test_tenant_checkpoint_cannot_cross_restore(tmp_path):
+    """Two tenants writing into the SAME directory (misconfiguration):
+    the tenant-filtered restore walks past the other tenant's newer
+    checkpoint instead of handing it over."""
+    from deap_tpu.support.checkpoint import Checkpointer
+    ck = Checkpointer(str(tmp_path / "shared"))
+    ck.save(3, {"who": "a"}, meta={"tenant_id": "A"})
+    ck.save(5, {"who": "b"}, meta={"tenant_id": "B"})
+    step, state = ck.restore_latest(tenant_id="A")
+    assert (step, state["who"]) == (3, "a")
+    step, state = ck.restore_latest(tenant_id="B")
+    assert (step, state["who"]) == (5, "b")
+    assert ck.restore_latest(tenant_id="C") is None
+    # unfiltered restore keeps its original semantics: newest valid
+    assert ck.restore_latest()[0] == 5
