@@ -1090,6 +1090,236 @@ def resilience_overhead_lines(out_path: str = "BENCH_RESILIENCE.json",
     return rows
 
 
+# --------------------------------------------------------- mesh bench ----
+#
+# The sharding-plan acceptance measurement (ISSUE 8): on a forced
+# 8-virtual-device CPU mesh, (1) the island epoch driven by the
+# pmap-era shard_map/ppermute path vs the SAME epoch as a
+# plan-compiled global program (migration lowered to resharding by the
+# partitioner) — paired same-session, the pjit path gated >= 0.95x;
+# (2) the donate_argnums row — one jitted ea_simple generation step at
+# pop=100k driven carry-to-carry with and without donation, plus the
+# proof the generation-step copy is gone (the donated carry's buffers
+# are consumed in place: deleted after the call, their bytes counted);
+# (3) the CMA serving bucket's batched-eigh pair — the vmapped lane
+# update with LAPACK eigh (serial per-lane loop) vs the pure-XLA
+# Jacobi solver that vectorises across lanes (the eigh-loop bound on
+# the committed 3.0x CMA serving number).
+#
+# Runs as a CHILD process (bench.py --mesh re-execs with XLA_FLAGS
+# forcing the virtual device count, which must be set before jax
+# initialises).
+
+MESH_DEVICES = 8
+MESH_ISLANDS = 8
+MESH_EPOCHS = 3
+MESH_FREQ = 2
+MESH_REPS = 3
+MESH_DON_GENS = 20
+MESH_EIGH_LANES = 1024   # the BENCH_SERVING CMA bucket scale
+MESH_EIGH_DIM = 8
+MESH_EIGH_NGEN = 10
+
+
+def mesh_lines(out_path: str = "BENCH_MESH.json") -> list:
+    import gc
+
+    from deap_tpu.algorithms import make_ea_simple_step
+    from deap_tpu.core.population import init_population as _initpop
+    from deap_tpu.parallel import (ShardingPlan, island_init,
+                                   make_island_step, population_mesh,
+                                   shard_population)
+    from deap_tpu.serving.multirun import MultiRunEngine
+    from deap_tpu.strategies import cma as _cma
+
+    jax.config.update("jax_platforms", "cpu")
+    n_dev = len(jax.devices())
+    if n_dev < MESH_DEVICES:
+        raise SystemExit(
+            f"mesh bench needs {MESH_DEVICES} devices, found {n_dev} — "
+            "run via `bench.py --mesh` (the parent sets XLA_FLAGS)")
+    env = _env_fingerprint("cpu")
+    env["n_devices"] = n_dev
+    rows = []
+
+    # ---- (1) island epoch: shard_map ("pmap-era") vs plan (pjit) ----
+    tb = _toolbox()
+    island_size = POP // MESH_ISLANDS
+    pops0 = island_init(jax.random.key(5), MESH_ISLANDS, island_size,
+                        ops.bernoulli_genome(LENGTH),
+                        FitnessSpec((1.0,)))
+    pops0 = jax.vmap(lambda p: evaluate_invalid(p, tb.evaluate))(pops0)
+
+    mesh = population_mesh(MESH_DEVICES, ("island",))
+    step_sm = make_island_step(tb, 0.5, 0.2, freq=MESH_FREQ, mig_k=8,
+                               mesh=mesh)
+    pops_sm0 = shard_population(pops0, mesh, "island")
+    plan_i = ShardingPlan.for_islands(MESH_DEVICES, donate=False)
+    step_pj = make_island_step(tb, 0.5, 0.2, freq=MESH_FREQ, mig_k=8,
+                               plan=plan_i)
+    pops_pj0 = plan_i.place(pops0)
+
+    def epochs(step, p):
+        for e in range(MESH_EPOCHS):
+            p = step(jax.random.fold_in(jax.random.key(9), e), p)
+        sync(p.fitness)
+
+    epochs(step_sm, pops_sm0)  # compile + warm, both paths
+    epochs(step_pj, pops_pj0)
+    t_sm, t_pj = [], []
+    for _ in range(MESH_REPS):  # interleaved: contention hits both
+        t0 = time.perf_counter()
+        epochs(step_sm, pops_sm0)
+        t_sm.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        epochs(step_pj, pops_pj0)
+        t_pj.append(time.perf_counter() - t0)
+    eps = MESH_EPOCHS
+    for name, times in (("shardmap", sorted(t_sm)),
+                        ("pjit", sorted(t_pj))):
+        med = times[len(times) // 2]
+        rows.append({
+            "metric": f"island_pop100k_{name}_epochs_per_sec",
+            "value": round(eps / med, 3), "unit": "epochs/sec",
+            "backend": "cpu", "pop": POP, "islands": MESH_ISLANDS,
+            "freq": MESH_FREQ, "epochs": eps,
+            "n_samples": len(times),
+            "best": round(eps / times[0], 3),
+            "spread_pct": round(100 * (times[-1] - times[0]) / med, 1),
+            "env": env})
+    ratio = min(t_sm) / min(t_pj)  # >1 means pjit faster
+    rows.append({
+        "metric": "mesh_pjit_vs_shardmap_ratio",
+        "value": round(ratio, 3), "unit": "x", "threshold": 0.95,
+        "estimator": "min_of_reps", "env": env})
+    del pops_sm0, pops_pj0, pops0
+    gc.collect()
+
+    # ---- (2) donation: the generation-step copy eliminated ----
+    tb2, pop100k = _setup()
+    plan_p = ShardingPlan.for_population(MESH_DEVICES)  # donate=True
+    # the PLAN-threaded step: its with_sharding_constraint pins the
+    # output population to the input's layout, which is what lets XLA
+    # alias the donated carry at all (an unconstrained step's output
+    # sharding drifts and the donation is silently unusable)
+    step = make_ea_simple_step(tb2, 0.5, 0.2, plan=plan_p)
+    jit_nodon = jax.jit(step)
+    jit_don = plan_p.compile(step, donate_argnums=(0,), label="donate")
+    key = jax.random.key(11)
+
+    def drive(jitted):
+        carry = (plan_p.place(pop100k), None)
+        for g in range(MESH_DON_GENS):
+            carry, _ = jitted(carry, jax.random.fold_in(key, g))
+        sync(carry[0].fitness)
+        return carry
+
+    drive(jit_nodon)  # compile + warm
+    drive(jit_don)
+    # proof of in-place aliasing: the donated carry's buffers are
+    # consumed by the call — count the bytes that stopped being copied
+    probe = (plan_p.place(pop100k), None)
+    leaves = jax.tree_util.tree_leaves(probe)
+    jit_don(probe, key)
+    donated_bytes = sum(
+        l.nbytes for l in leaves
+        if isinstance(l, jax.Array) and l.is_deleted())
+    t_nod, t_don = [], []
+    for _ in range(MESH_REPS):
+        t0 = time.perf_counter()
+        drive(jit_nodon)
+        t_nod.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        drive(jit_don)
+        t_don.append(time.perf_counter() - t0)
+    for name, times in (("nodonate", sorted(t_nod)),
+                        ("donate", sorted(t_don))):
+        med = times[len(times) // 2]
+        rows.append({
+            "metric": f"ea_step_pop100k_{name}_generations_per_sec",
+            "value": round(MESH_DON_GENS / med, 3), "unit": "gens/sec",
+            "backend": "cpu", "pop": POP, "gens": MESH_DON_GENS,
+            "n_samples": len(times),
+            "best": round(MESH_DON_GENS / times[0], 3),
+            "spread_pct": round(100 * (times[-1] - times[0]) / med, 1),
+            "env": env})
+    rows.append({
+        "metric": "mesh_donation",
+        "value": round(min(t_nod) / min(t_don), 3), "unit": "x",
+        "donated_mb": round(donated_bytes / 1e6, 2),
+        "copy_eliminated": donated_bytes > 0,
+        "estimator": "min_of_reps", "env": env})
+    del pop100k
+    gc.collect()
+
+    # ---- (3) CMA serving bucket: batched eigh (lapack vs jacobi) ----
+    eigh_times = {}
+    for impl in ("lapack", "jacobi"):
+        strat = _cma.Strategy(centroid=[2.0] * MESH_EIGH_DIM,
+                              sigma=0.3, lambda_=MESH_EIGH_DIM,
+                              eigh_impl=impl)
+        tbc = Toolbox()
+        tbc.register("evaluate", lambda g: (g ** 2).sum(-1))
+        tbc.register("generate", strat.generate)
+        tbc.register("update", strat.update)
+        eng = MultiRunEngine("ea_generate_update", tbc,
+                             spec=strat.spec,
+                             state_template=strat.initial_state())
+        keys = jnp.stack([jax.random.key(300 + i)
+                          for i in range(MESH_EIGH_LANES)])
+        inits = [strat.initial_state(sigma=0.2 + 0.01 * i)
+                 for i in range(MESH_EIGH_LANES)]
+        batch0 = eng.pack_fresh(keys, inits, ngen=MESH_EIGH_NGEN)
+
+        def adv():
+            b, _ = eng.advance(batch0, MESH_EIGH_NGEN)
+            sync(b["gen"])
+
+        adv()  # compile + warm
+        ts = []
+        for _ in range(MESH_REPS):
+            t0 = time.perf_counter()
+            adv()
+            ts.append(time.perf_counter() - t0)
+        eigh_times[impl] = sorted(ts)
+        med = eigh_times[impl][len(ts) // 2]
+        lane_gens = MESH_EIGH_LANES * MESH_EIGH_NGEN
+        rows.append({
+            "metric": f"cma_serving_eigh_{impl}_lane_gens_per_sec",
+            "value": round(lane_gens / med, 1), "unit": "gens/sec",
+            "backend": "cpu", "lanes": MESH_EIGH_LANES,
+            "dim": MESH_EIGH_DIM, "ngen": MESH_EIGH_NGEN,
+            "n_samples": len(ts),
+            "best": round(lane_gens / eigh_times[impl][0], 1),
+            "spread_pct": round(
+                100 * (eigh_times[impl][-1] - eigh_times[impl][0])
+                / med, 1),
+            "env": env})
+    rows.append({
+        "metric": "cma_serving_batched_eigh_speedup_x",
+        "value": round(min(eigh_times["lapack"])
+                       / min(eigh_times["jacobi"]), 3),
+        "unit": "x", "estimator": "min_of_reps",
+        "lanes": MESH_EIGH_LANES, "dim": MESH_EIGH_DIM, "env": env})
+
+    if out_path:
+        payload = {
+            "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "env": env,
+            "config": {"pop": POP, "length": LENGTH,
+                       "devices": MESH_DEVICES,
+                       "islands": MESH_ISLANDS, "freq": MESH_FREQ,
+                       "epochs": MESH_EPOCHS, "reps": MESH_REPS,
+                       "donate_gens": MESH_DON_GENS,
+                       "eigh_lanes": MESH_EIGH_LANES,
+                       "eigh_dim": MESH_EIGH_DIM},
+            "tail": "\n".join(json.dumps(r) for r in rows),
+        }
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=1)
+    return rows
+
+
 def _journal_probe_run(tel, tb, pop):
     """--journal satellite: a short probed headline-config run so the
     journal carries per-generation probe rows (search-dynamics
@@ -1556,6 +1786,36 @@ if __name__ == "__main__":
                else "BENCH_SERVING.json")
         for row in serving_lines(out):
             print(json.dumps(row), flush=True)
+    elif "--mesh-child" in sys.argv:
+        # the re-exec'd worker: XLA_FLAGS already forces the virtual
+        # device count (set by the parent below, before jax init)
+        out = sys.argv[sys.argv.index("--mesh-child") + 1]
+        for row in mesh_lines(out):
+            print(json.dumps(row), flush=True)
+    elif "--mesh" in sys.argv:
+        # the sharding-plan acceptance measurement (ISSUE 8): paired
+        # shard_map-vs-pjit island rows, the donate_argnums row, and
+        # the CMA batched-eigh pair on a forced 8-virtual-device CPU
+        # mesh — committed as BENCH_MESH.json; bench_report.py
+        # --tripwire gates pjit >= 0.95x shard_map and the donation
+        # row. Re-execs itself: the virtual device count only takes
+        # effect when XLA_FLAGS is set before jax initialises.
+        import subprocess
+
+        i = sys.argv.index("--mesh")
+        nxt = sys.argv[i + 1] if i + 1 < len(sys.argv) else None
+        out = (nxt if nxt and not nxt.startswith("--")
+               else "BENCH_MESH.json")
+        child_env = dict(os.environ)
+        flags = [f for f in child_env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append("--xla_force_host_platform_device_count="
+                     f"{MESH_DEVICES}")
+        child_env["XLA_FLAGS"] = " ".join(flags)
+        child_env["JAX_PLATFORMS"] = "cpu"
+        raise SystemExit(subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--mesh-child",
+             out], env=child_env).returncode)
     elif "--coldstart-child" in sys.argv:
         _coldstart_child(
             sys.argv[sys.argv.index("--coldstart-child") + 1])

@@ -355,6 +355,60 @@ def serving_tripwire(gates=None) -> int:
     return tripped
 
 
+#: the pjit path must hold at least this fraction of the shard_map
+#: path's throughput (same-session island pair, bench.py --mesh)
+MESH_PJIT_FLOOR = 0.95
+
+
+def mesh_tripwire(floor: float = MESH_PJIT_FLOOR) -> int:
+    """The sharding-plan gate (ISSUE 8): the latest BENCH_MESH*.json
+    must show (1) the plan-compiled (pjit) island epoch at or above
+    ``floor`` × its shard_map pair — same session, live-vs-live — and
+    (2) the ``donate_argnums`` row present with the generation-step
+    copy actually eliminated (donated bytes > 0). Returns the number
+    of tripped rows."""
+    files = sorted(glob.glob(os.path.join(HERE, "BENCH_MESH*.json")))
+    if not files:
+        print("mesh tripwire: no committed BENCH_MESH*.json yet")
+        return 0
+    rows = _bench_rows(files[-1])
+    print(f"\n## Mesh plan ({os.path.basename(files[-1])})\n")
+    tripped = 0
+    ratio = rows.get("mesh_pjit_vs_shardmap_ratio")
+    if ratio is None or not isinstance(ratio.get("value"), (int, float)):
+        print("- mesh_pjit_vs_shardmap_ratio: **missing**")
+        tripped += 1
+    else:
+        ok = ratio["value"] >= floor
+        print(f"- pjit vs shard_map island epochs: {ratio['value']}x "
+              f"(floor {floor}x) "
+              + ("ok" if ok else "**REGRESSION** (the plan path is "
+                 "slower than the pmap-era path it replaces)"))
+        tripped += 0 if ok else 1
+    don = rows.get("mesh_donation")
+    if don is None:
+        print("- mesh_donation: **missing** (the donate_argnums row "
+              "is part of the acceptance)")
+        tripped += 1
+    else:
+        ok = bool(don.get("copy_eliminated")) and \
+            don.get("donated_mb", 0) > 0
+        print(f"- donation: {don.get('donated_mb', 0)} MB of "
+              f"generation-step carry aliased in place, "
+              f"{don.get('value')}x vs no-donation "
+              + ("ok" if ok else "**REGRESSION** (donation no longer "
+                 "eliminates the generation-step copy)"))
+        tripped += 0 if ok else 1
+    eigh = rows.get("cma_serving_batched_eigh_speedup_x")
+    if eigh is not None and isinstance(eigh.get("value"), (int, float)):
+        print(f"- CMA serving batched eigh (jacobi vs lapack, "
+              f"{eigh.get('lanes')} lanes, dim {eigh.get('dim')}): "
+              f"{eigh['value']}x (context row, ungated)")
+    if len(files) >= 2:
+        tripped += _diff_rows(files[-2], files[-1], TRIPWIRE_THRESHOLD)
+    return tripped
+
+
 def tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
     """Diff the two most recent committed ``BENCH_r*.json`` files and
     flag regressions; then the gp_symbreg paired rows
@@ -376,6 +430,7 @@ def tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
     tripped += resilience_tripwire()
     tripped += fusion_tripwire()
     tripped += serving_tripwire()
+    tripped += mesh_tripwire()
     return tripped
 
 

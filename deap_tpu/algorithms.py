@@ -391,10 +391,48 @@ def _pop_loop_init(pop: Population, toolbox, halloffame_size: int,
 # comparisons, never shapes, so the multi-run serving engine
 # (deap_tpu/serving/multirun.py) can vmap one step over N independent
 # runs with per-run hyperparameters and stay bit-identical per lane.
+#
+# Mesh axis: every factory also accepts a ``plan``
+# (:class:`deap_tpu.parallel.ShardingPlan`): the step pins the
+# outgoing population to the plan's layout (``with_sharding_constraint``
+# on the ``pop`` mesh axis) so the XLA partitioner keeps the population
+# sharded across generation boundaries instead of replicating it after
+# the selection gather. Sharding is layout, not semantics — a
+# plan-compiled loop computes bit-identical results on ANY mesh size
+# (tests/test_sharding_plan.py), which is what makes elastic resume
+# possible.
+
+
+def _retain(plan, tree):
+    """A safe-to-read-later copy of a pytree that is about to enter a
+    donated carry (the gen-0 meter state feeds both the scan carry and
+    the post-scan journal assembly): donation deletes the original's
+    buffers, the copy survives. Free when nothing is donated."""
+    if plan is None or not getattr(plan, "donate", False) or tree is None:
+        return tree
+    return jax.tree_util.tree_map(
+        lambda a: jnp.copy(a) if isinstance(a, jax.Array) else a, tree)
+
+
+def _run_scan(plan, label: str, step, carry, xs):
+    """Scan ``step`` over ``xs`` — directly, or (with a plan) through
+    the plan's pjit-preferred compile wrapper with the carry DONATED:
+    the generation-step buffers alias in place instead of being copied
+    (``bench.py --mesh`` measures the donation row). The carry handed
+    in here is always internally constructed (``plan.place`` fresh
+    copies / hof_init / meter.init), so donation can never delete a
+    caller-owned array."""
+    if plan is None:
+        return lax.scan(step, carry, xs)
+    runner = plan.compile(lambda c, x: lax.scan(step, c, x),
+                          donate_argnums=(0,), label=label)
+    return runner(carry, xs)
+
 
 def make_ea_simple_step(toolbox, cxpb: float, mutpb: float,
                         stats: Optional[Statistics] = None,
-                        telemetry=None, fused="auto") -> Callable:
+                        telemetry=None, fused="auto",
+                        plan=None) -> Callable:
     """The eaSimple generation step: select n → varAnd → evaluate
     invalid → replace (algorithms.py:163-181). ``fused`` (see
     :func:`var_and`) collapses select-gather + crossover + mutation
@@ -412,6 +450,8 @@ def make_ea_simple_step(toolbox, cxpb: float, mutpb: float,
                       sel_idx=idx)
         nevals = jnp.sum(~off.valid)
         off = evaluate_invalid(off, toolbox.evaluate)
+        if plan is not None:
+            off = plan.constrain(off)
         if hof is not None:
             new_hof = hof_update(hof, off)
         else:
@@ -433,7 +473,7 @@ def make_ea_simple_step(toolbox, cxpb: float, mutpb: float,
 def ea_simple(key: jax.Array, pop: Population, toolbox, cxpb: float,
               mutpb: float, ngen: int, stats: Optional[Statistics] = None,
               halloffame_size: int = 0, verbose: bool = False,
-              telemetry=None, probes=(), fused="auto",
+              telemetry=None, probes=(), fused="auto", plan=None,
               ) -> Tuple[Population, Logbook, Optional[HallOfFame]]:
     """The canonical generational GA (algorithms.py:85-189).
 
@@ -444,10 +484,16 @@ def ea_simple(key: jax.Array, pop: Population, toolbox, cxpb: float,
     probes (:mod:`deap_tpu.telemetry.probes`) to that meter. Results
     are unchanged either way. ``fused`` (see :func:`var_and`) picks the
     variation-plane execution — bit-identical results in every mode.
+    ``plan`` (a :class:`deap_tpu.parallel.ShardingPlan`) shards the
+    population over the plan's mesh and compiles the scan with the
+    carry donated — same results bit-exactly, on as many devices as
+    the plan holds.
     """
     tel = telemetry
     _check_probes(probes, tel)
     kscan = key
+    if plan is not None:
+        pop = plan.place(pop)
     pop, hof, record0 = _pop_loop_init(pop, toolbox, halloffame_size,
                                        stats)
     if tel is not None:
@@ -458,16 +504,18 @@ def ea_simple(key: jax.Array, pop: Population, toolbox, cxpb: float,
                                pop, jnp.int32(0))
 
     step = make_ea_simple_step(toolbox, cxpb, mutpb, stats, tel,
-                               fused=fused)
+                               fused=fused, plan=plan)
 
     if tel is None:
-        (pop, hof), records = lax.scan(step, (pop, hof),
-                                       jax.random.split(kscan, ngen))
+        (pop, hof), records = _run_scan(
+            plan, "ea_simple", step, (pop, hof),
+            jax.random.split(kscan, ngen))
     else:
-        (pop, hof, _), (records, mrows) = lax.scan(
-            step, (pop, hof, mstate0),
+        initial = _retain(plan, mstate0)
+        (pop, hof, _), (records, mrows) = _run_scan(
+            plan, "ea_simple", step, (pop, hof, mstate0),
             (jax.random.split(kscan, ngen), jnp.arange(1, ngen + 1)))
-        tel.end_run("ea_simple", stacked_meter=mrows, initial=mstate0,
+        tel.end_run("ea_simple", stacked_meter=mrows, initial=initial,
                     ngen=ngen)
     logbook = _build_logbook(record0, records, stats)
     if verbose:
@@ -497,7 +545,8 @@ def _build_logbook(record0, records, stats) -> Logbook:
 def make_ea_mu_plus_lambda_step(toolbox, mu: int, lambda_: int,
                                 cxpb: float, mutpb: float,
                                 stats: Optional[Statistics] = None,
-                                telemetry=None, fused="auto") -> Callable:
+                                telemetry=None, fused="auto",
+                                plan=None) -> Callable:
     """The (μ + λ) generation step: varOr → evaluate invalid → select μ
     from the parent+offspring union (algorithms.py:248-337)."""
     tel = telemetry
@@ -515,6 +564,8 @@ def make_ea_mu_plus_lambda_step(toolbox, mu: int, lambda_: int,
         pool = concat([pop, off])
         idx = toolbox.select(k_sel, pool.wvalues, mu)
         new_pop = gather(pool, idx)
+        if plan is not None:
+            new_pop = plan.constrain(new_pop)
         new_hof = hof_update(hof, off) if hof is not None else None
         rec = {"nevals": nevals, **_maybe_stats(stats, new_pop)}
         if tel is None:
@@ -533,7 +584,7 @@ def ea_mu_plus_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
                       lambda_: int, cxpb: float, mutpb: float, ngen: int,
                       stats: Optional[Statistics] = None,
                       halloffame_size: int = 0, verbose: bool = False,
-                      telemetry=None, probes=(), fused="auto",
+                      telemetry=None, probes=(), fused="auto", plan=None,
                       ) -> Tuple[Population, Logbook, Optional[HallOfFame]]:
     """(μ + λ) evolution (algorithms.py:248-337): parents survive into the
     selection pool."""
@@ -541,6 +592,8 @@ def ea_mu_plus_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
     tel = telemetry
     _check_probes(probes, tel)
     kscan = key
+    if plan is not None:
+        pop = plan.place(pop)
     pop, hof, record0 = _pop_loop_init(pop, toolbox, halloffame_size,
                                        stats)
     if tel is not None:
@@ -551,17 +604,20 @@ def ea_mu_plus_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
                                pop, jnp.int32(0))
 
     step = make_ea_mu_plus_lambda_step(toolbox, mu, lambda_, cxpb,
-                                       mutpb, stats, tel, fused=fused)
+                                       mutpb, stats, tel, fused=fused,
+                                       plan=plan)
 
     if tel is None:
-        (pop, hof), records = lax.scan(step, (pop, hof),
-                                       jax.random.split(kscan, ngen))
+        (pop, hof), records = _run_scan(
+            plan, "ea_mu_plus_lambda", step, (pop, hof),
+            jax.random.split(kscan, ngen))
     else:
-        (pop, hof, _), (records, mrows) = lax.scan(
-            step, (pop, hof, mstate0),
+        initial = _retain(plan, mstate0)
+        (pop, hof, _), (records, mrows) = _run_scan(
+            plan, "ea_mu_plus_lambda", step, (pop, hof, mstate0),
             (jax.random.split(kscan, ngen), jnp.arange(1, ngen + 1)))
         tel.end_run("ea_mu_plus_lambda", stacked_meter=mrows,
-                    initial=mstate0, ngen=ngen)
+                    initial=initial, ngen=ngen)
     logbook = _build_logbook(record0, records, stats)
     if verbose:
         print(logbook.stream)
@@ -571,7 +627,8 @@ def ea_mu_plus_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
 def make_ea_mu_comma_lambda_step(toolbox, mu: int, lambda_: int,
                                  cxpb: float, mutpb: float,
                                  stats: Optional[Statistics] = None,
-                                 telemetry=None, fused="auto") -> Callable:
+                                 telemetry=None, fused="auto",
+                                 plan=None) -> Callable:
     """The (μ, λ) generation step: varOr → evaluate invalid → select μ
     from the offspring only (algorithms.py:340-437)."""
     tel = telemetry
@@ -588,6 +645,8 @@ def make_ea_mu_comma_lambda_step(toolbox, mu: int, lambda_: int,
         off = evaluate_invalid(off, toolbox.evaluate)
         idx = toolbox.select(k_sel, off.wvalues, mu)
         new_pop = gather(off, idx)
+        if plan is not None:
+            new_pop = plan.constrain(new_pop)
         new_hof = hof_update(hof, off) if hof is not None else None
         rec = {"nevals": nevals, **_maybe_stats(stats, new_pop)}
         if tel is None:
@@ -603,7 +662,7 @@ def ea_mu_comma_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
                        lambda_: int, cxpb: float, mutpb: float, ngen: int,
                        stats: Optional[Statistics] = None,
                        halloffame_size: int = 0, verbose: bool = False,
-                       telemetry=None, probes=(), fused="auto",
+                       telemetry=None, probes=(), fused="auto", plan=None,
                        ) -> Tuple[Population, Logbook, Optional[HallOfFame]]:
     """(μ, λ) evolution (algorithms.py:340-437): only offspring survive."""
     assert lambda_ >= mu, "lambda must be greater or equal to mu."
@@ -611,6 +670,8 @@ def ea_mu_comma_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
     tel = telemetry
     _check_probes(probes, tel)
     kscan = key
+    if plan is not None:
+        pop = plan.place(pop)
     pop, hof, record0 = _pop_loop_init(pop, toolbox, halloffame_size,
                                        stats)
     if tel is not None:
@@ -621,17 +682,20 @@ def ea_mu_comma_lambda(key: jax.Array, pop: Population, toolbox, mu: int,
                                pop, jnp.int32(0))
 
     step = make_ea_mu_comma_lambda_step(toolbox, mu, lambda_, cxpb,
-                                        mutpb, stats, tel, fused=fused)
+                                        mutpb, stats, tel, fused=fused,
+                                        plan=plan)
 
     if tel is None:
-        (pop, hof), records = lax.scan(step, (pop, hof),
-                                       jax.random.split(kscan, ngen))
+        (pop, hof), records = _run_scan(
+            plan, "ea_mu_comma_lambda", step, (pop, hof),
+            jax.random.split(kscan, ngen))
     else:
-        (pop, hof, _), (records, mrows) = lax.scan(
-            step, (pop, hof, mstate0),
+        initial = _retain(plan, mstate0)
+        (pop, hof, _), (records, mrows) = _run_scan(
+            plan, "ea_mu_comma_lambda", step, (pop, hof, mstate0),
             (jax.random.split(kscan, ngen), jnp.arange(1, ngen + 1)))
         tel.end_run("ea_mu_comma_lambda", stacked_meter=mrows,
-                    initial=mstate0, ngen=ngen)
+                    initial=initial, ngen=ngen)
     logbook = _build_logbook(record0, records, stats)
     if verbose:
         print(logbook.stream)
@@ -660,7 +724,7 @@ def _generate_update_init(toolbox, state: Any, spec: FitnessSpec,
 
 def make_ea_generate_update_step(toolbox, spec: FitnessSpec, lam: int,
                                  stats: Optional[Statistics] = None,
-                                 telemetry=None) -> Callable:
+                                 telemetry=None, plan=None) -> Callable:
     """The ask-tell generation step: generate → evaluate → update
     (algorithms.py:440-503); carry ``(state, hof[, mstate])``."""
     tel = telemetry
@@ -676,6 +740,11 @@ def make_ea_generate_update_step(toolbox, spec: FitnessSpec, lam: int,
             genomes=genomes, fitness=values,
             valid=jnp.ones(lam, bool), spec=spec)
         new_state = toolbox.update(state, genomes, values)
+        if plan is not None:
+            # strategy states are small: the leaf rule replicates them
+            # (odd dims) — the pin mostly keeps the partitioner from
+            # inventing a layout that churns between generations
+            new_state = plan.constrain(new_state)
         new_hof = hof_update(hof, pop) if hof is not None else None
         rec = {"nevals": jnp.asarray(lam), **_maybe_stats(stats, pop)}
         if tel is None:
@@ -711,7 +780,7 @@ def ea_generate_update(key: jax.Array, state: Any, toolbox, ngen: int,
                        spec: FitnessSpec,
                        stats: Optional[Statistics] = None,
                        halloffame_size: int = 0, verbose: bool = False,
-                       telemetry=None, probes=(), fused="auto",
+                       telemetry=None, probes=(), fused="auto", plan=None,
                        ) -> Tuple[Any, Logbook, Optional[HallOfFame]]:
     """Ask-tell loop (algorithms.py:440-503) driving CMA-ES/PBIL/EMNA-style
     strategies:
@@ -727,6 +796,8 @@ def ea_generate_update(key: jax.Array, state: Any, toolbox, ngen: int,
     same program.
     """
     del fused  # no variation plane in the ask-tell loop (see docstring)
+    if plan is not None:
+        state = plan.place(state)
     lam, hof = _generate_update_init(toolbox, state, spec,
                                      halloffame_size)
     tel = telemetry
@@ -736,14 +807,16 @@ def ea_generate_update(key: jax.Array, state: Any, toolbox, ngen: int,
                       probes=probes, ngen=ngen, lambda_=lam)
         mstate0 = tel.meter.init()
 
-    step = make_ea_generate_update_step(toolbox, spec, lam, stats, tel)
+    step = make_ea_generate_update_step(toolbox, spec, lam, stats, tel,
+                                        plan=plan)
 
     if tel is None:
-        (state, hof), records = lax.scan(step, (state, hof),
-                                         jax.random.split(key, ngen))
+        (state, hof), records = _run_scan(
+            plan, "ea_generate_update", step, (state, hof),
+            jax.random.split(key, ngen))
     else:
-        (state, hof, _), (records, mrows) = lax.scan(
-            step, (state, hof, mstate0),
+        (state, hof, _), (records, mrows) = _run_scan(
+            plan, "ea_generate_update", step, (state, hof, mstate0),
             (jax.random.split(key, ngen), jnp.arange(ngen)))
         tel.end_run("ea_generate_update", stacked_meter=mrows, gen0=0,
                     ngen=ngen)

@@ -199,7 +199,7 @@ def make_gp_loop(pset: PrimitiveSet, max_len: int, evaluate: Callable, *,
                  mut_min: int = 0, mut_max: int = 2,
                  mut_width: Optional[int] = None,
                  compaction: str = "auto",
-                 telemetry=None, probes=()) -> Callable:
+                 telemetry=None, probes=(), plan=None) -> Callable:
     """Build ``run(key, genomes, ngen) -> result`` — the host-dispatch
     eaSimple-shaped GP loop (tournament selection, adjacent-pair
     one-point crossover at ``cxpb``, uniform subtree mutation at
@@ -217,6 +217,15 @@ def make_gp_loop(pset: PrimitiveSet, max_len: int, evaluate: Callable, *,
     kept as the parity oracle). The result dict
     carries the final population + depth arrays, the best individual,
     and the reference-comparable ``nevals`` per generation.
+
+    ``plan`` (a :class:`deap_tpu.parallel.ShardingPlan`) shards the
+    population arrays (genomes/depths/fitness rows) over the plan's
+    mesh: the jitted select/variation programs partition across
+    devices and the grouped-dispatch evaluator receives row-sharded
+    sub-populations. Results are bit-identical to the unsharded loop
+    (sharding is layout, not semantics — pinned in
+    ``tests/test_sharding_plan.py``); the per-generation placement pin
+    re-uses buffers already laid out correctly.
 
     ``telemetry``/``probes``: the host-dispatch counterpart of the
     scanned loops' instrumentation — one decoded ``meter`` row per
@@ -462,8 +471,13 @@ def make_gp_loop(pset: PrimitiveSet, max_len: int, evaluate: Callable, *,
                   # device path's compaction runs without a host sync
                   host_fetch_bytes_per_gen=(
                       12 if _device_compaction else n // 2 + n))
+        if plan is not None:
+            genomes = plan.place(genomes, fresh=False)
         depths = depths_of(genomes)
         fit = evaluate(genomes)
+        if plan is not None:
+            depths = plan.place(depths, fresh=False)
+            fit = plan.place(fit, fresh=False)
         state = {"gen": 0, "genomes": genomes, "depths": depths,
                  "fit": fit, "nevals": [n], "stopped_at": None,
                  "mstate": None}
@@ -508,6 +522,13 @@ def make_gp_loop(pset: PrimitiveSet, max_len: int, evaluate: Callable, *,
             state["best_genome"] = jax.tree_util.tree_map(
                 lambda a: a[best_i], genomes)
             state["best_fitness"] = float(fit[best_i])
+        if plan is not None:
+            # re-pin the carried arrays to the plan between host
+            # dispatches (scatters can hand back replicated layouts);
+            # an already-correct leaf passes through without a copy
+            genomes = plan.place(genomes, fresh=False)
+            depths = plan.place(depths, fresh=False)
+            fit = plan.place(fit, fresh=False)
         state.update(gen=gen, genomes=genomes, depths=depths, fit=fit)
         if tel is not None:
             state["mstate"] = _measure(state["mstate"], ne, genomes,
@@ -542,6 +563,7 @@ def make_gp_loop(pset: PrimitiveSet, max_len: int, evaluate: Callable, *,
     run.flags_compact = flags_compact
     run.compaction = compaction
     run.depths_of = depths_of
+    run.plan = plan
     run.init_state = init_state     # segmented driving (resilience)
     run.advance = advance
     run.finalize = finalize

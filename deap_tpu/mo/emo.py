@@ -73,7 +73,7 @@ _ND_EXACT_IMPLS = ("staircase", "sweep", "dc")
 def nd_rank(w: jnp.ndarray, max_rank: Optional[int] = None,
             impl: str = "auto", cover_k: Optional[int] = None,
             fallback: str = "none",
-            return_peels: bool = False) -> jnp.ndarray:
+            return_peels: bool = False, plan=None) -> jnp.ndarray:
     """Non-domination rank per row (0 = first front).
 
     Deb's fast non-dominated sort (emo.py:53-117) re-expressed as
@@ -127,6 +127,15 @@ def nd_rank(w: jnp.ndarray, max_rank: Optional[int] = None,
     covered_stop = n if cover_k is None else min(cover_k, n)
     if fallback not in ("none", "count"):
         raise ValueError(f"unknown nd_rank fallback {fallback!r}")
+    if plan is not None:
+        # population sharding for the nd-sort (the mesh-native plan of
+        # deap_tpu.parallel): pin the [n, m] weighted values to the
+        # plan's row layout so the pairwise passes (matrix / the
+        # prefix-streamed [n, block] slabs) partition their query rows
+        # across the mesh. Layout only — ranks are bit-identical to
+        # the unsharded call (tests/test_sharding_plan.py). Works both
+        # eagerly and under an enclosing plan-compiled selector.
+        w = plan.constrain(w)
     if impl == "auto":
         # bi-objective: the O(n log n) staircase beats any
         # O(fronts·n²) peeling at scale — and it is the path that fits

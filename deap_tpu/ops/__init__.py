@@ -62,6 +62,7 @@ from deap_tpu.ops.kernels import (
     nd_rank_tiled,
     strengths_tiled,
 )
+from deap_tpu.ops.linalg import eigh_jacobi
 from deap_tpu.ops.variation import (
     VariationPlan,
     apply_variation,

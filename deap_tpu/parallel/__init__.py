@@ -1,4 +1,10 @@
-from deap_tpu.parallel.mesh import population_mesh, shard_population
+from deap_tpu.parallel.mesh import (
+    population_mesh,
+    shard_population,
+    sharding_fallback,
+    sharding_mode,
+)
+from deap_tpu.parallel.plan import ShardingPlan
 from deap_tpu.parallel.migration import mig_ring, mig_ring_collective, migRing
 from deap_tpu.parallel.island import IslandState, island_init, make_island_step
 from deap_tpu.parallel.multihost import (
@@ -15,6 +21,9 @@ from deap_tpu.parallel.genome_shard import (
 )
 
 __all__ = [
+    "ShardingPlan",
+    "sharding_mode",
+    "sharding_fallback",
     "initialize",
     "is_distributed",
     "global_population_mesh",

@@ -53,10 +53,22 @@ def genome_mesh(n_pop_shards: Optional[int] = None,
                            shape=(n_pop_shards, n_genome_shards))
 
 
-def shard_genomes(genomes: jnp.ndarray, mesh: Mesh) -> jnp.ndarray:
+def _plan_mesh(mesh) -> Mesh:
+    """Accept either a raw :class:`Mesh` or a
+    :class:`deap_tpu.parallel.ShardingPlan` (whose mesh is used) — the
+    genome axis rides the same plan object the population loops
+    consume."""
+    return mesh.mesh if hasattr(mesh, "mesh") else mesh
+
+
+def shard_genomes(genomes: jnp.ndarray, mesh) -> jnp.ndarray:
     """Place a ``[n, L]`` genome matrix with rows over ``pop`` and the
-    feature axis over ``genome``."""
-    return jax.device_put(genomes, NamedSharding(mesh, P("pop", "genome")))
+    feature axis over ``genome``. ``mesh`` may be a
+    :class:`~deap_tpu.parallel.ShardingPlan`."""
+    mesh = _plan_mesh(mesh)
+    with span("genome_shard/reshard"):
+        return jax.device_put(genomes,
+                              NamedSharding(mesh, P("pop", "genome")))
 
 
 #: collective used per ``combine`` mode — one place, so the profiling
@@ -68,7 +80,7 @@ _COMBINE_COLLECTIVES = {
 }
 
 
-def make_sharded_evaluator(partial_eval: Callable, mesh: Mesh,
+def make_sharded_evaluator(partial_eval: Callable, mesh,
                            combine: str = "sum") -> Callable:
     """Build ``evaluate(genomes [n, L]) -> f32[n]`` that runs
     ``partial_eval`` on each device's genome *slice* and reduces across
@@ -87,6 +99,7 @@ def make_sharded_evaluator(partial_eval: Callable, mesh: Mesh,
     """
     if combine not in _COMBINE_COLLECTIVES:
         raise ValueError(combine)
+    mesh = _plan_mesh(mesh)
     cname, collective = _COMBINE_COLLECTIVES[combine]
 
     def local(genomes):

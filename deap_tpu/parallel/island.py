@@ -27,7 +27,7 @@ from deap_tpu.algorithms import evaluate_invalid, var_and
 from deap_tpu.core.fitness import FitnessSpec
 from deap_tpu.core.population import Population, gather, init_population
 from deap_tpu.ops.selection import sel_best
-from deap_tpu.parallel.mesh import axis_size, shard_map
+from deap_tpu.parallel.mesh import axis_size, shard_map, sharding_fallback
 from deap_tpu.support.profiling import span
 
 IslandState = Population  # demes stacked on the leading axis
@@ -109,11 +109,29 @@ def make_island_step(toolbox, cxpb: float, mutpb: float, freq: int,
                      mig_k: int, mesh: Optional[Mesh] = None,
                      axis_name: str = "island",
                      selection: Callable = sel_best,
-                     telemetry=None, probes=()):
+                     telemetry=None, probes=(), plan=None,
+                     donate: bool = False):
     """Build ``step(key, pops) -> pops``: ``freq`` local generations then
     one ring migration (the reference's FREQ-generation epoch,
     onemax_island_scoop.py:64-67). Jit-compatible; pass a ``mesh`` to run
     each deme on its own mesh slice.
+
+    ``plan`` (a :class:`deap_tpu.parallel.ShardingPlan`, mutually
+    exclusive with ``mesh``) selects the **mesh-native** formulation:
+    the epoch is ONE global jitted program whose stacked-deme tensor is
+    sharded over the plan's axis, and migration is plain
+    :func:`~deap_tpu.parallel.migration.mig_ring` over the deme axis —
+    the XLA partitioner turns the emigrant roll into a
+    collective-permute, i.e. migration becomes *resharding under one
+    jitted program* instead of hand-written ``ppermute`` choreography.
+    Because the program is global, its results are bit-identical to
+    the single-device path on ANY mesh size — the property elastic
+    resume relies on (checkpoint at n=8, resume at n=4/n=1;
+    ``tests/test_sharding_plan.py``). ``donate=True`` additionally
+    donates the ``pops`` (and meter) carry per epoch — the caller must
+    not reuse the argument after the call. On a jax without pjit-plan
+    support the builder falls back to the ``mesh``/shard_map path with
+    a journaled ``sharding_fallback`` event.
 
     With ``telemetry`` (a :class:`deap_tpu.telemetry.RunTelemetry`) the
     returned step is ``step(key, pops, mstate) -> (pops, mstate)``: a
@@ -154,7 +172,36 @@ def make_island_step(toolbox, cxpb: float, mutpb: float, freq: int,
                 jnp.sum(jnp.where(pops.valid, w0, 0.0)),
                 jnp.sum(pops.valid.astype(jnp.float32)))
 
-    if mesh is None:
+    if plan is not None:
+        if mesh is not None:
+            raise ValueError("pass either mesh= (shard_map path) or "
+                             "plan= (pjit path), not both")
+        if plan.mode != "pjit":
+            # loud, journaled degradation: the explicit shard_map ring
+            # still runs the sharded program, just without the
+            # partitioner-owned single-program formulation
+            sharding_fallback(
+                "make_island_step",
+                "pjit plan unavailable; selecting the shard_map path",
+                n_devices=plan.describe()["n_devices"])
+            mesh, axis_name, plan = plan.mesh, plan.axis, None
+
+    if plan is not None:
+        # mesh-native path: the SAME global program as the mesh-None
+        # branch (mig_ring's deme-axis roll IS the migration), with the
+        # stacked-deme tensor pinned to the plan's layout so the
+        # partitioner shards demes across devices and lowers the roll
+        # to a collective-permute. No hand-written collectives remain.
+        def pjit_epoch(key, pops):
+            pops = plan.constrain(pops)
+            out = epoch(key, pops, partial(_migrate_local, k=mig_k,
+                                           selection=selection))
+            return plan.constrain(out)
+
+        base = pjit_epoch
+        base_tel = lambda key, pops: (
+            lambda out: (out, _local_stats(out)))(pjit_epoch(key, pops))
+    elif mesh is None:
         base = lambda key, pops: epoch(
             key, pops, partial(_migrate_local, k=mig_k, selection=selection))
         base_tel = lambda key, pops: (
@@ -193,6 +240,10 @@ def make_island_step(toolbox, cxpb: float, mutpb: float, freq: int,
             raise ValueError("probes= requires telemetry= (a "
                              "RunTelemetry): probe state rides the "
                              "telemetry Meter carry")
+        if plan is not None:
+            return plan.compile(base,
+                                donate_argnums=(1,) if donate else (),
+                                label="island_step")
         return jax.jit(base)
 
     meter = tel.meter
@@ -220,4 +271,8 @@ def make_island_step(toolbox, cxpb: float, mutpb: float, freq: int,
         mstate = tel.apply_probe(mstate, pop=_flatten_demes(pops))
         return pops, mstate
 
+    if plan is not None:
+        return plan.compile(instrumented,
+                            donate_argnums=(1, 2) if donate else (),
+                            label="island_step")
     return jax.jit(instrumented)

@@ -19,11 +19,74 @@ Axes convention:
 
 from __future__ import annotations
 
+import inspect
 from typing import Callable, Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deap_tpu.support.profiling import span
+
+
+# ------------------------------------------------- plan-mode selection ----
+#
+# The NamedSharding/pjit sharding *plan* (deap_tpu.parallel.plan) needs
+# three capabilities from the installed jax: NamedSharding itself,
+# jit-level buffer donation (``donate_argnums``), and an in-jit layout
+# pin (``with_sharding_constraint``). All three exist on the pinned
+# jax 0.4.37; on a jax where any is missing the plan must fall back to
+# the explicit shard_map path — LOUDLY (a journaled ``sharding_fallback``
+# event), never by silently computing the unsharded program.
+
+#: cached mode — [None] until first probe; tests pin e.g. ["shard_map"]
+#: to exercise the fallback selection without faking a jax install
+_MODE_CACHE: list = [None]
+
+
+def _detect_sharding_mode() -> str:
+    try:
+        from jax.sharding import NamedSharding as _NS  # noqa: F401
+    except Exception:
+        return "shard_map"
+    if not hasattr(jax.lax, "with_sharding_constraint"):
+        return "shard_map"
+    try:
+        if "donate_argnums" not in inspect.signature(jax.jit).parameters:
+            return "shard_map"
+    except (TypeError, ValueError):
+        pass  # builtins without signatures: assume the documented API
+    return "pjit"
+
+
+def sharding_mode() -> str:
+    """``'pjit'`` when the installed jax can run the NamedSharding plan
+    (the preferred path — one global program, the XLA partitioner owns
+    the collectives, ``donate_argnums`` honoured); ``'shard_map'`` when
+    it cannot and plan consumers must select their explicit
+    shard_map/ppermute formulation instead."""
+    if _MODE_CACHE[0] is None:
+        _MODE_CACHE[0] = _detect_sharding_mode()
+    return _MODE_CACHE[0]
+
+
+_FALLBACK_SEEN: set = set()
+
+
+def sharding_fallback(where: str, reason: str, **ctx) -> None:
+    """Journal a loud ``sharding_fallback`` event: a plan consumer could
+    not take the pjit path and selected a degraded formulation instead.
+    Deduplicated per (where, reason) so a fallback taken inside a loop
+    does not flood the journal — but never silent: the first occurrence
+    always lands in every open journal."""
+    key = (where, reason)
+    if key in _FALLBACK_SEEN:
+        return
+    _FALLBACK_SEEN.add(key)
+    from deap_tpu.telemetry.journal import broadcast
+
+    broadcast("sharding_fallback", where=where, reason=reason,
+              mode=sharding_mode(), **ctx)
 
 
 def axis_size(axis_name: str):
@@ -85,6 +148,7 @@ def shard_population(pop, mesh: Mesh, axis: str = "pop"):
     sharding = NamedSharding(mesh, P(axis))
 
     def place(x):
-        return jax.device_put(x, sharding)
+        with span("mesh/reshard"):
+            return jax.device_put(x, sharding)
 
     return jax.tree_util.tree_map(place, pop)

@@ -1,0 +1,261 @@
+"""ShardingPlan — the one mesh-native sharding plan every loop consumes.
+
+The pmap/shard_map-era layout of this package made each loop family
+hand-roll its own collectives: ``genome_shard`` wrapped its evaluator in
+``shard_map`` + ``psum``, ``island`` choreographed ``ppermute`` rings,
+and checkpoints were welded to the mesh they were written on. This
+module replaces that with the idiom peer JAX systems converged on
+(SNIPPETS.md [2]/[3]): a single *plan* object that owns
+
+- **Mesh construction** — one :class:`jax.sharding.Mesh` with named
+  axes (``"pop"`` for data-parallel populations, ``"island"`` for
+  deme-per-slice island runs, ``"genome"`` for feature-axis sharding);
+- **PartitionSpec helpers** — per-leaf :class:`NamedSharding` built by
+  a divisibility rule (leading axis sharded over the plan axis when it
+  divides evenly; scalars, PRNG keys and odd-sized leaves replicated),
+  so a whole carry pytree (population + hall of fame + meter state)
+  gets a consistent layout from one call;
+- **a pjit-preferred compile wrapper** — :meth:`compile` is
+  ``jax.jit`` with ``donate_argnums``: the generation-step buffers are
+  *donated* instead of copied (XLA aliases the carry in and out — the
+  per-step population copy disappears, see ``bench.py --mesh``), and
+  the XLA partitioner — not hand-written collectives — inserts
+  whatever communication the global program needs. On a jax without
+  NamedSharding/jit-donation support the plan degrades to the
+  explicit shard_map formulations, journaled loudly as
+  ``sharding_fallback`` (see :func:`deap_tpu.parallel.mesh
+  .sharding_mode`).
+
+Because a plan-compiled program is a *global* program (sharding is
+layout, not semantics), its results are bit-identical across mesh
+sizes — the property that makes **elastic resume** cheap: a checkpoint
+written on an n=8 mesh (per-shard leaf layout, checkpoint format v3)
+restores onto an n=4 or n=1 plan through one :meth:`place` reshard step
+and the run continues bit-exactly (``tests/test_sharding_plan.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deap_tpu.parallel.mesh import (population_mesh, sharding_fallback,
+                                    sharding_mode)
+from deap_tpu.support.profiling import span
+
+__all__ = ["ShardingPlan"]
+
+
+def _is_prng_key(leaf: Any) -> bool:
+    try:
+        return isinstance(leaf, jax.Array) and jnp.issubdtype(
+            leaf.dtype, jax.dtypes.prng_key)
+    except Exception:
+        return False
+
+
+class ShardingPlan:
+    """One sharding plan: mesh + spec helpers + compile wrapper.
+
+    :param mesh: a prebuilt :class:`~jax.sharding.Mesh`; default is a
+        1-D mesh over all local devices named ``axis``.
+    :param axis: the mesh axis the *leading data axis* (population rows
+        or stacked demes) shards over — ``"pop"`` for the scan loops,
+        ``"island"`` for island runs.
+    :param donate: honour ``donate_argnums`` in :meth:`compile`
+        (default True). Callers that must re-read an argument after the
+        call (parity oracles, retries from in-memory state) pass
+        ``donate=False`` or compile without donation.
+
+    Typical use::
+
+        plan = ShardingPlan.for_population()        # all devices
+        pop, logbook, hof = ea_simple(key, pop, tb, .5, .2, 100,
+                                      plan=plan)
+        # or: ResilientRun(dir, plan=plan).ea_simple(...)
+    """
+
+    def __init__(self, mesh: Optional[Mesh] = None, *, axis: str = "pop",
+                 donate: bool = True):
+        if mesh is None:
+            mesh = population_mesh(axis_names=(axis,))
+        if axis not in mesh.axis_names:
+            raise ValueError(f"plan axis {axis!r} not in mesh axes "
+                             f"{mesh.axis_names}")
+        self.mesh = mesh
+        self.axis = axis
+        self.donate = bool(donate)
+        self.mode = sharding_mode()
+        if self.mode != "pjit":
+            sharding_fallback(
+                "ShardingPlan", "jax lacks NamedSharding/jit-donation "
+                "support; plan consumers select their shard_map paths")
+
+    # ------------------------------------------------------ constructors ----
+
+    @classmethod
+    def for_population(cls, n_devices: Optional[int] = None,
+                       **kwargs) -> "ShardingPlan":
+        """1-D ``("pop",)`` plan over the first ``n_devices`` devices
+        (default: all)."""
+        return cls(population_mesh(n_devices, axis_names=("pop",)),
+                   axis="pop", **kwargs)
+
+    @classmethod
+    def for_islands(cls, n_devices: Optional[int] = None,
+                    **kwargs) -> "ShardingPlan":
+        """1-D ``("island",)`` plan: stacked demes, one slice per
+        device."""
+        return cls(population_mesh(n_devices, axis_names=("island",)),
+                   axis="island", **kwargs)
+
+    @property
+    def n_shards(self) -> int:
+        return self.mesh.shape[self.axis]
+
+    # ------------------------------------------------------ spec helpers ----
+
+    def spec(self, *axes: Optional[str]) -> P:
+        """A :class:`PartitionSpec` over this plan's mesh axes."""
+        return P(*axes)
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    @property
+    def row_sharding(self) -> NamedSharding:
+        """Leading-axis sharding over the plan axis — the population /
+        stacked-deme layout."""
+        return NamedSharding(self.mesh, P(self.axis))
+
+    def leaf_sharding(self, leaf: Any) -> NamedSharding:
+        """The plan's layout for one leaf: leading axis sharded over
+        the plan axis when it divides evenly, replicated otherwise
+        (scalars, PRNG key arrays, hall-of-fame rows smaller than the
+        mesh, strategy-state vectors). The rule is deliberately
+        value-free — layout can never change what a global program
+        computes, only where it computes it."""
+        shape = getattr(leaf, "shape", None)
+        if (shape is None or len(shape) == 0 or _is_prng_key(leaf)
+                or shape[0] == 0 or shape[0] % self.n_shards != 0):
+            return self.replicated
+        return self.row_sharding
+
+    def tree_shardings(self, tree: Any) -> Any:
+        """Per-leaf :class:`NamedSharding` pytree for ``tree`` (the
+        ``in_shardings`` shape of the plan, SNIPPETS.md [2])."""
+        return jax.tree_util.tree_map(self.leaf_sharding, tree)
+
+    # --------------------------------------------------------- placement ----
+
+    def place(self, tree: Any, fresh: Optional[bool] = None) -> Any:
+        """Reshard ``tree`` onto this plan — the elastic-resume step: a
+        restored (or caller-supplied) state pytree is committed to this
+        plan's mesh leaf-by-leaf per :meth:`leaf_sharding`.
+
+        ``fresh`` (default: ``self.donate``) guarantees the returned
+        leaves are *new* buffers even when ``device_put`` would have
+        aliased an already-correctly-placed input — required before
+        handing the tree to a donating :meth:`compile` call, which
+        deletes its argument buffers (the caller's array must survive).
+        """
+        if self.mode != "pjit":
+            sharding_fallback("ShardingPlan.place",
+                              "no NamedSharding support: placement "
+                              "skipped, arrays stay where they are")
+            return tree
+        if fresh is None:
+            fresh = self.donate
+
+        def put(leaf):
+            if not isinstance(leaf, (jax.Array, np.ndarray, jnp.ndarray)):
+                return leaf
+            with span("plan/reshard"):
+                out = jax.device_put(leaf, self.leaf_sharding(leaf))
+                if fresh and isinstance(leaf, jax.Array):
+                    # device_put may ALIAS the source buffer even when
+                    # it returns a new Array object (e.g. the device-0
+                    # replica of a replicated placement reuses the
+                    # committed input buffer) — a later donation would
+                    # then delete the caller's array out from under
+                    # them. One explicit copy per run entry buys the
+                    # guarantee; ``fresh=False`` skips it.
+                    out = jnp.copy(out)
+            return out
+
+        return jax.tree_util.tree_map(put, tree)
+
+    # alias: a Population is just a state pytree to the plan
+    shard_population = place
+    place_state = place
+
+    def constrain(self, tree: Any) -> Any:
+        """In-jit layout pin: ``with_sharding_constraint`` per leaf (the
+        same divisibility rule as :meth:`place`), used by the step
+        factories to keep the population sharded across generation
+        boundaries instead of letting the partitioner replicate it
+        after a gather. No-op (journaled) on the fallback path."""
+        if self.mode != "pjit":
+            sharding_fallback("ShardingPlan.constrain",
+                              "no with_sharding_constraint: layout "
+                              "left to the partitioner")
+            return tree
+
+        def pin(leaf):
+            if not isinstance(leaf, (jax.Array, jnp.ndarray)) and not (
+                    hasattr(leaf, "shape") and hasattr(leaf, "dtype")):
+                return leaf
+            with span("plan/constrain"):
+                return jax.lax.with_sharding_constraint(
+                    leaf, self.leaf_sharding(leaf))
+
+        return jax.tree_util.tree_map(pin, tree)
+
+    # ------------------------------------------------------------ compile ----
+
+    def compile(self, fn: Callable, *, donate_argnums: Tuple[int, ...] = (),
+                static_argnums=(), static_argnames=None,
+                label: str = "plan") -> Callable:
+        """The pjit-preferred compile wrapper (SNIPPETS.md [3]): on the
+        pjit path this is ``jax.jit`` with ``donate_argnums`` — the
+        partitioner owns the collectives (sharding flows in from the
+        :meth:`place`-committed arguments) and donated generation-step
+        buffers alias in-place instead of being copied. On the fallback
+        path the function still compiles, but without donation and
+        without sharding — journaled as ``sharding_fallback`` so the
+        degradation is never silent."""
+        kwargs = {}
+        if static_argnums:
+            kwargs["static_argnums"] = static_argnums
+        if static_argnames is not None:
+            kwargs["static_argnames"] = static_argnames
+        if self.mode != "pjit":
+            sharding_fallback(f"ShardingPlan.compile[{label}]",
+                              "pjit path unavailable: compiling "
+                              "without sharding or donation")
+            return jax.jit(fn, **kwargs)
+        if donate_argnums and self.donate:
+            kwargs["donate_argnums"] = donate_argnums
+        return jax.jit(fn, **kwargs)
+
+    # --------------------------------------------------------- metadata ----
+
+    def describe(self) -> dict:
+        """Mesh metadata stamped into checkpoint ``meta`` so a restore
+        can tell (and journal) when it is an *elastic* resume onto a
+        different mesh than the one the checkpoint was written on."""
+        return {"axes": list(self.mesh.axis_names),
+                "shape": [int(s) for s in self.mesh.devices.shape],
+                "axis": self.axis,
+                "n_devices": int(self.mesh.devices.size)}
+
+    def __repr__(self) -> str:
+        shape = dict(zip(self.mesh.axis_names,
+                         self.mesh.devices.shape))
+        return (f"ShardingPlan(axis={self.axis!r}, mesh={shape}, "
+                f"mode={self.mode!r}, donate={self.donate})")

@@ -212,7 +212,7 @@ class _ScanLoopSpec(_LoopSpec):
 
     def __init__(self, algorithm: str, step, key, carry, ngen: int,
                  telemetry, stats, record0=None, mstate0=None,
-                 gen_offset: int = 1, build_result=None):
+                 gen_offset: int = 1, build_result=None, plan=None):
         self.algorithm = algorithm
         self.step = step
         self.key = key
@@ -224,18 +224,28 @@ class _ScanLoopSpec(_LoopSpec):
         self.mstate0 = mstate0
         self.gen_offset = gen_offset  # pop loops journal gens 1..ngen,
         self.build_result = build_result  # ask-tell 0..ngen-1
+        self.plan = plan
         # one jitted scan shared by every segment: an eager lax.scan
         # would re-trace per segment call (measured ~300 ms/segment at
         # pop=100k); under jit the executable is cached per xs shape —
         # two shapes total (full segment + short tail), bit-identical
-        # output either way
-        self._scan = jax.jit(
-            lambda carry, xs: lax.scan(self.step, carry, xs))
+        # output either way. With a plan, the scan goes through the
+        # pjit-preferred compile wrapper and the carry is DONATED —
+        # the per-segment population copy disappears (bench.py --mesh)
+        scan_fn = lambda carry, xs: lax.scan(self.step, carry, xs)
+        if plan is not None:
+            self._scan = plan.compile(scan_fn, donate_argnums=(0,),
+                                      label=f"resilient_{algorithm}")
+        else:
+            self._scan = jax.jit(scan_fn)
 
     def init(self) -> Dict[str, Any]:
+        # the gen-0 meter state doubles as the first element of the
+        # donated carry: keep a safe copy for the post-run journal
+        mstate0 = algos._retain(self.plan, self.mstate0)
         return {"gen": 0, "key": self.key, "carry": self.carry0,
                 "records": [], "mrows": [], "record0": self.record0,
-                "mstate0": self.mstate0}
+                "mstate0": mstate0}
 
     def on_resume(self, state) -> None:
         """Adapt the restored carry to THIS driver's telemetry
@@ -255,6 +265,12 @@ class _ScanLoopSpec(_LoopSpec):
             state["mrows"] = []
             state["mstate0"] = self.mstate0 if self.mstate0 is not None \
                 else fresh
+        if self.plan is not None:
+            # the elastic reshard step: a checkpoint written on any
+            # mesh re-commits to THIS process's plan (possibly a
+            # different device count) — values are untouched, the
+            # global program computes the same bits on the new layout
+            state["carry"] = self.plan.place(state["carry"])
 
     def segment(self, state, lo, hi):
         if self.ngen:
@@ -301,19 +317,24 @@ class _GPLoopSpec(_LoopSpec):
 
     algorithm = "gp_loop"
 
-    def __init__(self, loop_run, key, genomes, ngen: int):
+    def __init__(self, loop_run, key, genomes, ngen: int, plan=None):
         if getattr(loop_run, "init_state", None) is None:
             raise TypeError("gp_loop needs a run built by make_gp_loop")
         self.run = loop_run
         self.key = key
         self.genomes = genomes
         self.ngen = int(ngen)
+        self.plan = plan
 
     def init(self):
         gp = self.run.init_state(self.key, self.genomes, self.ngen)
         return {"gen": gp["gen"], "key": self.key, "gp": gp}
 
     def on_resume(self, state):
+        if self.plan is not None:
+            for k in ("genomes", "depths", "fit"):
+                state["gp"][k] = self.plan.place(state["gp"][k],
+                                                fresh=False)
         if self.run.begin_telemetry is not None:
             n = int(jnp.asarray(state["gp"]["fit"]).shape[0])
             self.run.begin_telemetry(self.ngen, n)
@@ -436,6 +457,12 @@ class ResilientRun:
         run resumes from (``restore_latest(tenant_id=...)``), so
         co-located or mis-pointed tenant directories can never
         cross-restore (see ``docs/advanced/serving.md``).
+    :param plan: a :class:`deap_tpu.parallel.ShardingPlan` — the run
+        executes mesh-natively (population sharded, segment scans
+        donated) and checkpoints become **elastic**: per-shard v3
+        leaves stamped with the writer's mesh, re-placed on THIS plan
+        at resume, bit-exactly, even when the device counts differ
+        (``docs/advanced/sharding.md``).
     """
 
     def __init__(self, checkpoints, *, segment_len: int = 10,
@@ -445,7 +472,8 @@ class ResilientRun:
                  handle_signals: bool = True,
                  double_buffer: bool = True, fault_plan=None,
                  run_id: Optional[str] = None,
-                 tenant_id: Optional[str] = None):
+                 tenant_id: Optional[str] = None,
+                 plan=None):
         if isinstance(checkpoints, Checkpointer):
             self.ckpt = checkpoints
         else:
@@ -470,6 +498,14 @@ class ResilientRun:
         # checkpoint directory resumes nothing instead of resuming
         # someone else's run
         self.tenant_id = tenant_id
+        # mesh-native sharding plan (deap_tpu.parallel.ShardingPlan):
+        # populations are placed on the plan's mesh, segment scans
+        # compile through the plan's donating wrapper, checkpoints
+        # store per-shard leaves (format v3) stamped with the mesh, and
+        # resume re-places the restored state on THIS plan — which may
+        # have a different device count than the writer's (elastic
+        # resume; journaled as ``elastic_resume``)
+        self.plan = plan
         self.preempt_requested = False
         self._preempt_signum: Optional[int] = None
         self.resumed_from: Optional[str] = None
@@ -482,7 +518,7 @@ class ResilientRun:
         tel = self._begin_pop("ea_simple", probes, ngen=ngen,
                               n=pop.size, cxpb=cxpb, mutpb=mutpb)
         step = algos.make_ea_simple_step(toolbox, cxpb, mutpb, stats,
-                                         tel)
+                                         tel, plan=self.plan)
         return self._drive_pop("ea_simple", step, key, pop, toolbox,
                                ngen, stats, halloffame_size, tel)
 
@@ -494,7 +530,8 @@ class ResilientRun:
                               mu=mu, lambda_=lambda_, cxpb=cxpb,
                               mutpb=mutpb)
         step = algos.make_ea_mu_plus_lambda_step(
-            toolbox, mu, lambda_, cxpb, mutpb, stats, tel)
+            toolbox, mu, lambda_, cxpb, mutpb, stats, tel,
+            plan=self.plan)
         return self._drive_pop("ea_mu_plus_lambda", step, key, pop,
                                toolbox, ngen, stats, halloffame_size,
                                tel)
@@ -507,13 +544,16 @@ class ResilientRun:
                               mu=mu, lambda_=lambda_, cxpb=cxpb,
                               mutpb=mutpb)
         step = algos.make_ea_mu_comma_lambda_step(
-            toolbox, mu, lambda_, cxpb, mutpb, stats, tel)
+            toolbox, mu, lambda_, cxpb, mutpb, stats, tel,
+            plan=self.plan)
         return self._drive_pop("ea_mu_comma_lambda", step, key, pop,
                                toolbox, ngen, stats, halloffame_size,
                                tel)
 
     def ea_generate_update(self, key, state, toolbox, ngen, spec, *,
                            stats=None, halloffame_size=0, probes=()):
+        if self.plan is not None:
+            state = self.plan.place(state)
         lam, hof = algos._generate_update_init(toolbox, state, spec,
                                                halloffame_size)
         tel = self.telemetry
@@ -525,7 +565,8 @@ class ResilientRun:
                           ngen=ngen, lambda_=lam, resilient=True)
             mstate0 = tel.meter.init()
         step = algos.make_ea_generate_update_step(toolbox, spec, lam,
-                                                  stats, tel)
+                                                  stats, tel,
+                                                  plan=self.plan)
         carry0 = ((state, hof) if tel is None
                   else (state, hof, mstate0))
 
@@ -536,13 +577,15 @@ class ResilientRun:
 
         loop = _ScanLoopSpec("ea_generate_update", step, key, carry0,
                              ngen, tel, stats, mstate0=mstate0,
-                             gen_offset=0, build_result=build_result)
+                             gen_offset=0, build_result=build_result,
+                             plan=self.plan)
         return self._drive(loop, ngen)
 
     def gp_loop(self, loop_run, key, genomes, ngen):
         """Drive a :func:`deap_tpu.gp.loop.make_gp_loop` engine in
         segments; returns its usual result dict."""
-        return self._drive(_GPLoopSpec(loop_run, key, genomes, ngen),
+        return self._drive(_GPLoopSpec(loop_run, key, genomes, ngen,
+                                       plan=self.plan),
                            ngen)
 
     def island_run(self, step, key, pops, n_epochs, *,
@@ -552,7 +595,13 @@ class ResilientRun:
         step for ``n_epochs`` (epoch keys ``fold_in(key, epoch)``).
         Returns final pops — ``(pops, mstate)`` when the step was built
         with telemetry. ``reshard`` re-applies device placement to a
-        restored population (mesh runs)."""
+        restored population (mesh runs); with a ``plan`` it defaults to
+        the plan's own placement, which is what makes the restore
+        *elastic* — the step must then be built with the same plan."""
+        if reshard is None and self.plan is not None:
+            reshard = self.plan.place
+        if self.plan is not None:
+            pops = self.plan.place(pops)
         return self._drive(
             _IslandSpec(step, key, pops, n_epochs,
                         telemetry=self.telemetry, reshard=reshard,
@@ -571,6 +620,8 @@ class ResilientRun:
 
     def _drive_pop(self, algorithm, step, key, pop, toolbox, ngen,
                    stats, halloffame_size, tel):
+        if self.plan is not None:
+            pop = self.plan.place(pop)
         pop, hof, record0 = algos._pop_loop_init(pop, toolbox,
                                                  halloffame_size, stats)
         mstate0 = None
@@ -588,7 +639,8 @@ class ResilientRun:
 
         loop = _ScanLoopSpec(algorithm, step, key, carry0, ngen, tel,
                              stats, record0=record0, mstate0=mstate0,
-                             gen_offset=1, build_result=build_result)
+                             gen_offset=1, build_result=build_result,
+                             plan=self.plan)
         return self._drive(loop, ngen)
 
     # ----------------------------------------------------------- the drive ----
@@ -608,6 +660,8 @@ class ResilientRun:
     def _drive(self, spec: _LoopSpec, total: int):
         total = int(total)
         resumed = self.ckpt.restore_latest(tenant_id=self.tenant_id)
+        cur_mesh = (self.plan.describe() if self.plan is not None
+                    else None)
         if resumed is not None:
             step0, state = resumed
             meta = state.get("_resilience", {})
@@ -617,10 +671,19 @@ class ResilientRun:
                     f"{meta.get('algorithm')!r} run; refusing to resume "
                     f"it as {spec.algorithm!r}")
             self.resumed_from = meta.get("run_id")
+            saved_mesh = meta.get("mesh")
             spec.on_resume(state)
             self._journal_event("resumed", algorithm=spec.algorithm,
                                 step=step0,
                                 resumed_from=self.resumed_from)
+            if saved_mesh != cur_mesh and (saved_mesh or cur_mesh):
+                # the checkpoint was written on a different mesh than
+                # this process runs: the reshard in on_resume makes the
+                # resume ELASTIC — journal it so the timeline shows
+                # where the device count changed
+                self._journal_event(
+                    "elastic_resume", algorithm=spec.algorithm,
+                    step=step0, from_mesh=saved_mesh, to_mesh=cur_mesh)
         else:
             state = spec.init()
             state["_resilience"] = {"algorithm": spec.algorithm,
@@ -630,8 +693,14 @@ class ResilientRun:
                                 algorithm=spec.algorithm, ngen=total,
                                 segment_len=self.segment_len)
         state["_resilience"]["run_id"] = self.run_id
+        state["_resilience"]["mesh"] = cur_mesh
 
-        writer = AsyncCheckpointWriter() if self.double_buffer else None
+        # donated carries are rewritten in place by the NEXT segment's
+        # compute: the snapshot must be materialised on the driver
+        # thread before that dispatch, not read asynchronously under it
+        writer = (AsyncCheckpointWriter(
+            materialize=self.plan is not None and self.plan.donate)
+            if self.double_buffer else None)
         try:
             with self._signals():
                 gen = int(state["gen"])
@@ -688,6 +757,13 @@ class ResilientRun:
                 return spec.segment(state, lo, hi)
             except Exception as exc:
                 kind = classify_error(exc)
+                if kind is not None and self._state_buffers_lost(state):
+                    # a donating plan dispatched the segment before it
+                    # failed: the pre-segment carry buffers are gone,
+                    # so an in-memory retry would read deleted arrays —
+                    # fail fatally (a re-invocation resumes from the
+                    # last checkpoint instead)
+                    kind = None
                 if kind is None or attempt >= self.retry.max_retries:
                     self._journal_event(
                         "segment_failed", algorithm=spec.algorithm,
@@ -707,6 +783,21 @@ class ResilientRun:
                     **({"action": action} if action else {}))
                 self.retry.sleep(delay)
                 attempt += 1
+
+    def _state_buffers_lost(self, state) -> bool:
+        """True when a donating plan already consumed (deleted) any of
+        the in-memory state's device buffers — retrying from that state
+        is impossible; the run must fail to its checkpoint instead."""
+        if self.plan is None or not self.plan.donate:
+            return False
+        for leaf in jax.tree_util.tree_leaves(state):
+            if isinstance(leaf, jax.Array):
+                try:
+                    if leaf.is_deleted():
+                        return True
+                except Exception:
+                    pass
+        return False
 
     # ------------------------------------------------------------- signals ----
 

@@ -157,6 +157,27 @@ class MultiRunEngine:
                     "template for λ/hof inference)")
             self.lam, self._hof0 = algos._generate_update_init(
                 toolbox, state_template, spec, self.halloffame_size)
+            # eigh-loop bound (ROADMAP item 1): this engine vmaps the
+            # strategy update across lanes, and LAPACK eigh batches as
+            # a SERIAL per-lane loop — Strategy(eigh_impl='jacobi')
+            # keeps the eigendecomposition vectorised across lanes
+            # (the accelerator-backend formulation; on CPU the LAPACK
+            # loop measured faster at small dim — bench.py --mesh
+            # commits the pair). Journal a loud hint when a
+            # LAPACK-eigh CMA strategy lands in a batched bucket.
+            upd = getattr(toolbox, "update", None)
+            strat = getattr(getattr(upd, "func", upd), "__self__", None)
+            if getattr(strat, "eigh_impl", None) == "lapack":
+                from deap_tpu.telemetry.journal import broadcast
+                broadcast(
+                    "serving_eigh_hint", family=family,
+                    dim=getattr(strat, "dim", None),
+                    hint="CMA bucket uses eigh_impl='lapack': the "
+                         "vmapped eigendecomposition loops per lane; "
+                         "Strategy(eigh_impl='jacobi') keeps it "
+                         "vectorised across lanes (the accelerator "
+                         "path — on CPU at small dim the LAPACK loop "
+                         "measured faster, see BENCH_MESH.json)")
         else:
             if family != "ea_simple" and (mu is None or lambda_ is None):
                 raise ValueError(f"{family} needs mu= and lambda_=")

@@ -74,7 +74,8 @@ class Strategy:
     def __init__(self, centroid, sigma: float, lambda_: Optional[int] = None,
                  mu: Optional[int] = None, weights: str = "superlinear",
                  cmatrix=None, spec: FitnessSpec = FitnessSpec((-1.0,)),
-                 eigen_gap: int = 1, **params):
+                 eigen_gap: int = 1, eigh_impl: str = "lapack",
+                 **params):
         """``eigen_gap`` is Hansen's lazy eigenupdate: recompute the
         eigenbasis (B, diagD) only every ``eigen_gap`` generations,
         sampling and the ps path using the stale basis in between —
@@ -83,7 +84,24 @@ class Strategy:
         decomposition dominates (it is the largest op in the update
         on accelerators). Default 1 recomputes every generation like
         the reference's update loop (cma.py:123-171), keeping
-        benchmark comparisons loop-for-loop honest."""
+        benchmark comparisons loop-for-loop honest.
+
+        ``eigh_impl`` picks the covariance eigendecomposition:
+        ``'lapack'`` (default — ``jnp.linalg.eigh``, exact parity with
+        the reference trajectory pins) or ``'jacobi'``
+        (:func:`deap_tpu.ops.linalg.eigh_jacobi`, a pure-XLA
+        fixed-sweep solver). Under the multi-run serving engine
+        (:mod:`deap_tpu.serving.multirun`), which vmaps this strategy's
+        update across tenant lanes, LAPACK's batching rule is a serial
+        per-lane loop — ``'jacobi'`` keeps the eigendecomposition
+        vectorised ACROSS lanes (the eigh-loop bound on the committed
+        3.0× CMA serving number), and is the only formulation on
+        backends without LAPACK (TPU). Measured on CPU the serial
+        LAPACK loop still wins at dim 8 (``bench.py --mesh``, 0.57×)
+        — hence the lapack default there. The two solvers are not
+        bit-identical to each other, so a bucket must use one
+        consistently; solo==batched bit-identity holds within either
+        (``tests/test_sharding_plan.py``)."""
         self._centroid0 = np.asarray(centroid, np.float32)
         self.dim = int(self._centroid0.shape[0])
         self._sigma0 = float(sigma)
@@ -98,6 +116,15 @@ class Strategy:
             raise ValueError(
                 f"eigen_gap must be an integer >= 1, got {eigen_gap!r}")
         self.eigen_gap = int(eigen_gap)
+        if eigh_impl not in ("lapack", "jacobi"):
+            raise ValueError(f"unknown eigh_impl {eigh_impl!r} "
+                             "(expected 'lapack' or 'jacobi')")
+        self.eigh_impl = eigh_impl
+        if eigh_impl == "jacobi":
+            from deap_tpu.ops.linalg import eigh_jacobi
+            self._eigh = eigh_jacobi
+        else:
+            self._eigh = jnp.linalg.eigh
         self._compute_params(mu, weights, params)
 
     def _compute_params(self, mu, rweights, params):
@@ -133,7 +160,7 @@ class Strategy:
         per compiled bucket) across tenants whose runs differ only in
         these initial-state knobs (deap_tpu/serving/)."""
         C = jnp.asarray(self._cmatrix0)
-        evals, B = jnp.linalg.eigh(C)
+        evals, B = self._eigh(C)
         c0 = (self._centroid0 if centroid is None
               else np.asarray(centroid, np.float32))
         if c0.shape != (self.dim,):
@@ -201,7 +228,7 @@ class Strategy:
             (jnp.linalg.norm(ps) / self.chiN - 1.0) * self.cs / self.damps)
 
         def fresh_basis(_):
-            evals, B = jnp.linalg.eigh(C)
+            evals, B = self._eigh(C)
             return B, jnp.sqrt(jnp.maximum(evals, 1e-30))
 
         if self.eigen_gap == 1:

@@ -40,10 +40,16 @@ import jax.numpy as jnp
 import numpy as np
 
 _PRNG_TAG = "__prng_key__"
+_SHARD_TAG = "__sharded_leaf__"
 
 #: payload format written by :func:`save_state`; bump when the layout
-#: changes (restore keeps reading every older version)
-FORMAT_VERSION = 2
+#: changes (restore keeps reading every older version).
+#: v3: mesh-partitioned array leaves are stored in a **per-shard
+#: layout** — one (index, bytes) entry per distinct shard instead of a
+#: gathered monolith — so a checkpoint written on an n=8 mesh carries
+#: its own partitioning and restores onto ANY mesh size (the elastic
+#: resume of deap_tpu.parallel.plan: reassemble + one reshard step).
+FORMAT_VERSION = 3
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -69,16 +75,78 @@ def _key_impl_name(key: jax.Array) -> str:
     return name if isinstance(name, str) else str(spec)
 
 
+def _is_partitioned(leaf: jax.Array) -> bool:
+    """True when the array is actually split over devices (not merely
+    multi-device replicated) and every shard is addressable from this
+    process — the case the per-shard v3 layout captures."""
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is None:
+        return False
+    try:
+        if sharding.is_fully_replicated:
+            return False
+        return bool(leaf.is_fully_addressable)
+    except Exception:
+        return False
+
+
+def _shard_index_bounds(index, shape) -> tuple:
+    """Normalise a shard's index (a tuple of slices) to
+    ``((start, stop), ...)`` ints — stable to pickle, trivially
+    re-applied on restore."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start, stop, step = sl.indices(dim)
+        if step != 1:
+            raise ValueError(f"non-unit shard stride {step}")
+        out.append((int(start), int(stop)))
+    return tuple(out)
+
+
 def _pack_leaf(leaf: Any) -> Any:
+    if isinstance(leaf, dict) and (_PRNG_TAG in leaf or _SHARD_TAG in leaf):
+        return leaf  # already packed (AsyncCheckpointWriter materialize)
     if isinstance(leaf, jax.Array) and jnp.issubdtype(leaf.dtype, jax.dtypes.prng_key):
         return {_PRNG_TAG: _key_impl_name(leaf),
                 "data": np.asarray(jax.random.key_data(leaf))}
     if isinstance(leaf, jax.Array):
+        if _is_partitioned(leaf):
+            # per-shard leaf layout (format v3): one entry per distinct
+            # shard index, replicas deduplicated — the checkpoint
+            # records the partitioning instead of gathering it away,
+            # and restore reassembles on ANY mesh size
+            shards, seen = [], set()
+            for s in leaf.addressable_shards:
+                idx = _shard_index_bounds(s.index, leaf.shape)
+                if idx in seen:
+                    continue
+                seen.add(idx)
+                shards.append((idx, np.asarray(s.data)))
+            return {_SHARD_TAG: True, "shape": tuple(leaf.shape),
+                    "dtype": np.dtype(leaf.dtype).str, "shards": shards}
         return np.asarray(leaf)
     return leaf
 
 
 def _unpack_leaf(leaf: Any) -> Any:
+    if isinstance(leaf, dict) and _SHARD_TAG in leaf:
+        shape = tuple(leaf["shape"])
+        arr = np.empty(shape, np.dtype(leaf["dtype"]))
+        covered = 0
+        for idx, data in leaf["shards"]:
+            arr[tuple(slice(a, b) for a, b in idx)] = data
+            extent = 1
+            for a, b in idx:
+                extent *= max(b - a, 0)
+            covered += extent
+        if covered != arr.size:
+            raise ValueError(
+                f"sharded leaf covers {covered} of {arr.size} elements "
+                "— shard set incomplete")
+        # uncommitted single-device on return: the caller's reshard
+        # step (ShardingPlan.place / ResilientRun plan=) re-commits it
+        # to whatever mesh the resumed process runs on
+        return jnp.asarray(arr)
     if isinstance(leaf, dict) and _PRNG_TAG in leaf:
         impl = leaf[_PRNG_TAG]
         # version-1 files written under jax versions whose key_impl
@@ -416,7 +484,16 @@ class AsyncCheckpointWriter:
     checkpoint intact, exactly as with synchronous saves.
     """
 
-    def __init__(self):
+    def __init__(self, materialize: bool = False):
+        """``materialize=True`` packs every leaf to host memory ON the
+        caller's thread before the worker starts (the per-shard v3
+        layout is preserved — :func:`_pack_leaf` is idempotent in
+        :func:`save_state`). Required when the next segment's compile
+        DONATES the state buffers (``ShardingPlan`` runs): a donated
+        buffer is reused in place by the next computation, so an
+        asynchronous read of it would race — the synchronous pack costs
+        one D2H copy per segment, amortised over the segment."""
+        self.materialize = bool(materialize)
         self._thread: Optional[threading.Thread] = None
         self._exc: Optional[BaseException] = None
         self.last_path: Optional[str] = None
@@ -428,12 +505,15 @@ class AsyncCheckpointWriter:
         submit finished."""
         self.wait()
         leaves, treedef = jax.tree_util.tree_flatten(state)
-        for leaf in leaves:
-            if isinstance(leaf, jax.Array):
-                try:
-                    leaf.copy_to_host_async()
-                except Exception:
-                    pass  # a prefetch hint only; np.asarray still works
+        if self.materialize:
+            leaves = [_pack_leaf(l) for l in leaves]
+        else:
+            for leaf in leaves:
+                if isinstance(leaf, jax.Array):
+                    try:
+                        leaf.copy_to_host_async()
+                    except Exception:
+                        pass  # a prefetch hint only; np.asarray works
 
         def work():
             try:

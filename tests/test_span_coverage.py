@@ -7,6 +7,13 @@ wall-time aggregates when it is not — the n=8 weak-scaling cliff
 investigation depends on them). A new collective added without a span
 would silently rot that coverage; this AST walk makes the omission a
 test failure instead.
+
+The mesh-native ShardingPlan widened the set: on the pjit path the
+explicit collectives disappear into the partitioner, and the ops that
+move or pin data across the mesh are the *resharding* ops instead —
+``device_put`` (plan placement / elastic-resume reshard) and
+``with_sharding_constraint`` (in-jit layout pins). Those carry the
+same attribution duty, so they sit in the same gate.
 """
 
 import ast
@@ -17,9 +24,12 @@ import deap_tpu.parallel as parallel_pkg
 #: call names that issue (or dispatch to) a collective. ``collective``
 #: covers genome_shard's table-dispatched psum/pmean/pmax call site —
 #: the function reference lives in _COMBINE_COLLECTIVES, the call goes
-#: through a local name.
+#: through a local name. ``device_put``/``with_sharding_constraint``
+#: are the ShardingPlan's resharding ops — data movement the pjit
+#: path performs instead of explicit collectives.
 COLLECTIVE_CALLS = {"psum", "pmean", "pmax", "ppermute", "all_gather",
-                    "all_to_all", "collective"}
+                    "all_to_all", "collective", "device_put",
+                    "with_sharding_constraint"}
 
 PARALLEL_DIR = os.path.dirname(os.path.abspath(parallel_pkg.__file__))
 
@@ -90,6 +100,22 @@ def test_every_parallel_collective_is_span_wrapped():
         "collectives without a named profiling span (add `with "
         "span(\"<module>/<collective>\"):` — see genome_shard.py):\n"
         + "\n".join(violations))
+
+
+def test_plan_resharding_ops_are_span_wrapped():
+    """The plan's resharding ops actually exist under the gate (the
+    widened COLLECTIVE_CALLS set must be exercising real call sites,
+    not vacuously passing): plan.py wraps its device_put and
+    with_sharding_constraint in plan/* spans."""
+    path = os.path.join(PARALLEL_DIR, "plan.py")
+    with open(path) as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    names = set()
+    for node, parents in _collective_calls(tree):
+        assert _span_wrapped(node, parents)
+        names.add(_call_name(node))
+    assert "device_put" in names
+    assert "with_sharding_constraint" in names
 
 
 def test_genome_shard_span_names_cover_every_combine_mode():
