@@ -1090,6 +1090,212 @@ def resilience_overhead_lines(out_path: str = "BENCH_RESILIENCE.json",
     return rows
 
 
+# -------------------------------------------------------- costs bench ----
+#
+# The observability-layer acceptance measurement (ISSUE 9): the
+# headline OneMax config (pop=100k) driven by ResilientRun over a
+# donating ShardingPlan, (a) with NO observability vs (b) with the
+# FULL third layer enabled — ProgramObservatory (per-program
+# cost/memory analysis at the AOT seam), the serving metrics registry,
+# and the flight recorder (one traced segment per trace_every +
+# device-memory snapshots at every boundary). Bit-identity against the
+# untouched monolithic scan is asserted BEFORE timing; the paired rows
+# are min-of-reps interleaved same-session (the probe-bench protocol)
+# and bench_report.py --tripwire gates the overhead <= 3% and requires
+# nonzero aliased (donated) bytes on every donating generation-step
+# program profile.
+#
+# Cadence note: the profiler costs ~10% of wall time WHILE tracing, so
+# the flight-recorder tax is trace duty-cycle times that. The measured
+# config traces 1 segment in 8 (25 of 200 gens, 12.5% duty; production
+# cadences are sparser still) — that is what "flight recorder at
+# trace_every cadence" costs, as opposed to running the whole run
+# under the profiler (trace_every=1, ~10%, never the shipped default).
+
+COSTS_NGEN = 200
+COSTS_SEGMENT = 25
+COSTS_TRACE_EVERY = 8
+
+
+def _costs_program_rows(profiles, env) -> list:
+    """One committed row per distinct program label: flops / bytes
+    accessed / compile seconds / donated alias bytes — the per-program
+    attribution the tripwire audits. Of a label's profiles (one per
+    input signature) the COLD one is committed — later signatures of
+    the same program dedup inside XLA's compile cache and report
+    millisecond compiles that say nothing about the program."""
+    by_label = {}
+    for p in profiles:
+        prev = by_label.get(p["label"])
+        if prev is None or p.get("compile_s", 0) > prev.get("compile_s", 0):
+            by_label[p["label"]] = p
+    rows = []
+    for label in sorted(by_label):
+        p = by_label[label]
+        safe = label.replace("/", "_").replace(":", "_")
+        rows.append({
+            "metric": f"program_cost_{safe}",
+            "value": p.get("flops"), "unit": "flops",
+            "bytes_accessed": p.get("bytes_accessed"),
+            "compile_s": p.get("compile_s"),
+            "argument_bytes": p.get("argument_bytes"),
+            "output_bytes": p.get("output_bytes"),
+            "temp_bytes": p.get("temp_bytes"),
+            "aliased_bytes": p.get("aliased_bytes"),
+            "donating": bool(p.get("donating")),
+            "hlo_hash": p.get("hlo_hash"),
+            "env": env,
+        })
+    return rows
+
+
+def costs_lines(out_path: str = "BENCH_COSTS.json") -> list:
+    import shutil
+    import tempfile
+
+    import numpy as np
+    from jax import lax as _lax
+
+    from deap_tpu.algorithms import _pop_loop_init, make_ea_simple_step
+    from deap_tpu.parallel import ShardingPlan
+    from deap_tpu.resilience import ResilientRun
+    from deap_tpu.resilience.engine import _ScanLoopSpec
+    from deap_tpu.strategies import cma
+    from deap_tpu.telemetry import ProgramObservatory
+    from deap_tpu.telemetry.metrics import MetricsRegistry
+
+    jax.config.update("jax_platforms", "cpu")
+    tb, pop0 = _setup()
+    key = jax.random.key(90)
+    plan = ShardingPlan.for_population()
+    step = make_ea_simple_step(tb, 0.5, 0.2, plan=plan)
+    pop_placed = plan.place(pop0)
+    pop, hof, record0 = _pop_loop_init(pop_placed, tb, 0, None)
+    # the donated carry is consumed per drive: rebuild it fresh per run
+    make_carry = lambda: (plan.place(pop), hof)
+
+    # the untouched-loop oracle: one monolithic scan, no plan, no
+    # segmenting, no observability — the bit-identity reference
+    plain_step = make_ea_simple_step(tb, 0.5, 0.2)
+    oracle_carry, _ = _lax.scan(plain_step, (pop, hof),
+                                jax.random.split(key, COSTS_NGEN))
+    oracle = np.asarray(oracle_carry[0].genomes)
+
+    ckdir = tempfile.mkdtemp(prefix="bench_costs_")
+    # ONE spec across reps and both sides: its jitted/AOT segment
+    # executables compile once (see resilience_overhead_lines)
+    spec = _ScanLoopSpec(
+        "ea_simple", step, key, make_carry(), COSTS_NGEN, None, None,
+        record0=record0, build_result=lambda st, recs: st["carry"][0],
+        plan=plan)
+
+    registry = MetricsRegistry()
+    observatory = ProgramObservatory()
+
+    def run_off():
+        res = ResilientRun(os.path.join(ckdir, "off"),
+                           segment_len=COSTS_SEGMENT, keep=2, plan=plan)
+        res.ckpt.clear()
+        spec.carry0 = make_carry()
+        out = res._drive(spec, COSTS_NGEN)
+        sync(out.fitness)
+        return out
+
+    def run_on():
+        # the FULL third layer: program observatory + metrics +
+        # flight recorder (trace every other segment, device-memory
+        # snapshot at every boundary)
+        with observatory:
+            res = ResilientRun(os.path.join(ckdir, "on"),
+                               segment_len=COSTS_SEGMENT, keep=2,
+                               plan=plan, metrics=registry,
+                               trace_every=COSTS_TRACE_EVERY,
+                               trace_dir=os.path.join(ckdir, "flight"))
+            res.ckpt.clear()
+            spec.carry0 = make_carry()
+            out = res._drive(spec, COSTS_NGEN)
+            sync(out.fitness)
+            return out
+
+    try:
+        off_pop = run_off()  # compile + warm
+        on_pop = run_on()
+        # acceptance: full observability is bit-identical to the
+        # untouched monolithic loop
+        for name, got in (("observability_off", off_pop),
+                          ("observability_on", on_pop)):
+            assert np.array_equal(np.asarray(got.genomes), oracle), \
+                f"{name} diverged from the untouched monolithic scan"
+        t_off, t_on = [], []
+        for _ in range(RES_REPS):
+            t0 = time.perf_counter()
+            run_off()
+            t_off.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            run_on()
+            t_on.append(time.perf_counter() - t0)
+        t_off, t_on = sorted(t_off), sorted(t_on)
+
+        # a second donating generation-step program for the
+        # per-program table: the CMA ask-tell loop (context rows)
+        strat = cma.Strategy(centroid=[0.0] * 16, sigma=0.5,
+                             lambda_=64)
+        ctb = Toolbox()
+        ctb.register("evaluate",
+                     lambda g: -jnp.sum(g ** 2, -1).astype(jnp.float32))
+        ctb.register("generate", strat.generate)
+        ctb.register("update", strat.update)
+        with observatory:
+            res = ResilientRun(os.path.join(ckdir, "cma"),
+                               segment_len=COSTS_SEGMENT, plan=plan)
+            res.ea_generate_update(jax.random.key(7),
+                                   strat.initial_state(), ctb,
+                                   COSTS_NGEN, spec=strat.spec)
+    finally:
+        shutil.rmtree(ckdir, ignore_errors=True)
+
+    env = _env_fingerprint("cpu")
+    rows = []
+    for name, times in (("observability_off", t_off),
+                        ("observability_on", t_on)):
+        med = times[len(times) // 2]
+        row = {
+            "metric": f"onemax_pop100k_{name}_generations_per_sec",
+            "value": round(COSTS_NGEN / med, 3), "unit": "gens/sec",
+            "backend": "cpu", "pop": POP, "ngen": COSTS_NGEN,
+            "n_samples": len(times),
+            "best": round(COSTS_NGEN / times[0], 3),
+            "spread_pct": round(100 * (times[-1] - times[0]) / med, 1),
+            "env": env,
+        }
+        if name == "observability_on":
+            row.update(segment_len=COSTS_SEGMENT,
+                       trace_every=COSTS_TRACE_EVERY,
+                       n_programs=len(observatory.profiles),
+                       metrics="registry+flight_recorder+observatory")
+        rows.append(row)
+    rows.append({
+        "metric": "onemax_pop100k_observability_overhead_pct",
+        "value": round(100 * (t_on[0] - t_off[0]) / t_off[0], 2),
+        "unit": "pct", "threshold_pct": 3.0,
+        "estimator": "min_of_reps", "segment_len": COSTS_SEGMENT,
+        "trace_every": COSTS_TRACE_EVERY, "env": env,
+    })
+    rows.extend(_costs_program_rows(observatory.profiles, env))
+    if out_path:
+        payload = {
+            "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "env": env,
+            "config": {"pop": POP, "length": LENGTH, "ngen": COSTS_NGEN,
+                       "segment_len": COSTS_SEGMENT, "reps": RES_REPS,
+                       "trace_every": COSTS_TRACE_EVERY},
+            "tail": "\n".join(json.dumps(r) for r in rows),
+        }
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=1)
+    return rows
+
+
 # --------------------------------------------------------- mesh bench ----
 #
 # The sharding-plan acceptance measurement (ISSUE 8): on a forced
@@ -1823,6 +2029,21 @@ if __name__ == "__main__":
         # the compile-cache cold-start metric alone (ROADMAP item 5):
         # time_to_first_generation, empty vs populated persistent cache
         for row in coldstart_lines():
+            print(json.dumps(row), flush=True)
+    elif "--costs" in sys.argv:
+        # the observability-layer acceptance measurement (ISSUE 9):
+        # headline config with the full third layer off vs on
+        # (program observatory + metrics registry + flight recorder),
+        # bit-identity asserted first, plus one committed
+        # program_cost_* row per compiled program with
+        # flops/bytes/compile-time/donated-alias-bytes — committed as
+        # BENCH_COSTS.json; bench_report.py --tripwire gates overhead
+        # <= 3% and nonzero aliasing on donating programs
+        i = sys.argv.index("--costs")
+        nxt = sys.argv[i + 1] if i + 1 < len(sys.argv) else None
+        out = (nxt if nxt and not nxt.startswith("--")
+               else "BENCH_COSTS.json")
+        for row in costs_lines(out):
             print(json.dumps(row), flush=True)
     elif "--resilience" in sys.argv:
         # the resilience acceptance measurement: monolithic scan vs

@@ -355,6 +355,73 @@ def serving_tripwire(gates=None) -> int:
     return tripped
 
 
+#: fractional full-observability overhead beyond which the costs pair
+#: trips (observatory + metrics + flight recorder vs bare segmented
+#: run, same session, pop=100k)
+COSTS_OVERHEAD_THRESHOLD = 0.03
+
+
+def costs_tripwire(threshold: float = COSTS_OVERHEAD_THRESHOLD) -> int:
+    """The observability-layer gate (ISSUE 9). The latest
+    BENCH_COSTS*.json must show (1) the full third layer (program
+    observatory + serving metrics + flight recorder) within
+    ``threshold`` of its observability-off pair — same session,
+    bit-identity asserted before timing — and (2) **every** donating
+    generation-step program's ``memory_analysis`` reporting nonzero
+    aliased (donated) bytes: the PR 8 donation contract audited per
+    program on every committed run, not once by the mesh bench.
+    Returns the number of tripped rows."""
+    files = sorted(glob.glob(os.path.join(HERE, "BENCH_COSTS*.json")))
+    if not files:
+        print("costs tripwire: no committed BENCH_COSTS*.json yet")
+        return 0
+    rows = _bench_rows(files[-1])
+    tripped = 0
+    print(f"\n## Observability costs ({os.path.basename(files[-1])})\n")
+    ov = rows.get("onemax_pop100k_observability_overhead_pct")
+    off = rows.get("onemax_pop100k_observability_off_generations_per_sec")
+    on = rows.get("onemax_pop100k_observability_on_generations_per_sec")
+    if ov is not None and isinstance(ov.get("value"), (int, float)):
+        overhead = ov["value"] / 100.0
+        ok = overhead <= threshold
+        pair = ""
+        if off and on:
+            pair = (f"on {on['value']} vs off {off['value']} gens/s "
+                    f"({on.get('n_programs', '?')} programs profiled, "
+                    f"trace_every={on.get('trace_every', '?')}), ")
+        print(f"- {pair}same session: {100 * overhead:+.2f}% overhead "
+              + ("ok" if ok else f"**REGRESSION** (> {threshold:.0%} — "
+                 "the observability layer got expensive)"))
+        tripped += 0 if ok else 1
+    else:
+        print("- observability overhead row missing")
+        tripped += 1
+    programs = {k: r for k, r in rows.items()
+                if k.startswith("program_cost_")}
+    if not programs:
+        print("- no program_cost_* rows committed — the per-program "
+              "attribution is part of the acceptance")
+        tripped += 1
+    donating = {k: r for k, r in programs.items() if r.get("donating")}
+    if programs and not donating:
+        print("- no donating program rows — the donation-contract "
+              "audit has nothing to check")
+        tripped += 1
+    for k, r in sorted(donating.items()):
+        aliased = r.get("aliased_bytes")
+        ok = isinstance(aliased, (int, float)) and aliased > 0
+        print(f"- {k}: flops={r.get('value')} "
+              f"bytes={r.get('bytes_accessed')} "
+              f"compile={r.get('compile_s')}s aliased={aliased} "
+              + ("ok" if ok else "**REGRESSION** (donating program "
+                 "shows ZERO aliased bytes — the generation-step copy "
+                 "is back)"))
+        tripped += 0 if ok else 1
+    if len(files) >= 2:
+        tripped += _diff_rows(files[-2], files[-1], TRIPWIRE_THRESHOLD)
+    return tripped
+
+
 #: the pjit path must hold at least this fraction of the shard_map
 #: path's throughput (same-session island pair, bench.py --mesh)
 MESH_PJIT_FLOOR = 0.95
@@ -431,6 +498,7 @@ def tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
     tripped += fusion_tripwire()
     tripped += serving_tripwire()
     tripped += mesh_tripwire()
+    tripped += costs_tripwire()
     return tripped
 
 

@@ -229,19 +229,31 @@ class ShardingPlan:
         path the function still compiles, but without donation and
         without sharding — journaled as ``sharding_fallback`` so the
         degradation is never silent."""
+        from deap_tpu.telemetry import costs
+
         kwargs = {}
         if static_argnums:
             kwargs["static_argnums"] = static_argnums
         if static_argnames is not None:
             kwargs["static_argnames"] = static_argnames
+        donating = False
         if self.mode != "pjit":
             sharding_fallback(f"ShardingPlan.compile[{label}]",
                               "pjit path unavailable: compiling "
                               "without sharding or donation")
-            return jax.jit(fn, **kwargs)
-        if donate_argnums and self.donate:
+        elif donate_argnums and self.donate:
             kwargs["donate_argnums"] = donate_argnums
-        return jax.jit(fn, **kwargs)
+            donating = True
+        # the AOT seam: with a ProgramObservatory active, every program
+        # this plan compiles is profiled (cost/memory analysis, compile
+        # time, HLO fingerprint → `program_profile` journal events, the
+        # donation contract proven per program) — a no-op None check
+        # per call otherwise
+        return costs.instrument(
+            jax.jit(fn, **kwargs), label=f"plan/{label}",
+            static_argnums=tuple(static_argnums or ()),
+            static_argnames=tuple(static_argnames or ()),
+            donating=donating)
 
     # --------------------------------------------------------- metadata ----
 

@@ -59,6 +59,7 @@ Deterministic fault plans for proving all of this live in
 
 from __future__ import annotations
 
+import os
 import signal
 import threading
 import time
@@ -168,6 +169,34 @@ def quarantine_non_finite(evaluate: Callable,
     return wrapped
 
 
+# ----------------------------------------------------- serving metrics ----
+
+def _resolve_metrics(metrics):
+    from deap_tpu.telemetry.metrics import resolve_registry
+    return resolve_registry(metrics)
+
+
+class _ResilienceInstruments:
+    """The engine's Prometheus instruments, declared once per
+    registry (create-or-get semantics make re-declaration safe)."""
+
+    def __init__(self, registry):
+        self.segment_s = registry.histogram(
+            "deap_resilience_segment_seconds",
+            "wall seconds per executed segment", labels=("algorithm",))
+        self.checkpoint_s = registry.histogram(
+            "deap_resilience_checkpoint_seconds",
+            "wall seconds submitting/writing a boundary checkpoint",
+            labels=("algorithm",))
+        self.retries = registry.counter(
+            "deap_resilience_retries_total",
+            "transient segment retries", labels=("algorithm", "kind"))
+        self.preemptions = registry.counter(
+            "deap_resilience_preemptions_total",
+            "honoured SIGTERM/SIGINT preemptions",
+            labels=("algorithm",))
+
+
 # ------------------------------------------------------------- driver ----
 
 def _concat_stacked(parts):
@@ -231,13 +260,18 @@ class _ScanLoopSpec(_LoopSpec):
         # two shapes total (full segment + short tail), bit-identical
         # output either way. With a plan, the scan goes through the
         # pjit-preferred compile wrapper and the carry is DONATED —
-        # the per-segment population copy disappears (bench.py --mesh)
+        # the per-segment population copy disappears (bench.py --mesh).
+        # Both paths pass the costs.instrument AOT seam: an active
+        # ProgramObservatory profiles every segment program
+        # (`program_profile` journal events, hlo_drift alarms)
         scan_fn = lambda carry, xs: lax.scan(self.step, carry, xs)
         if plan is not None:
             self._scan = plan.compile(scan_fn, donate_argnums=(0,),
                                       label=f"resilient_{algorithm}")
         else:
-            self._scan = jax.jit(scan_fn)
+            from deap_tpu.telemetry import costs
+            self._scan = costs.instrument(
+                jax.jit(scan_fn), label=f"resilient_{algorithm}")
 
     def init(self) -> Dict[str, Any]:
         # the gen-0 meter state doubles as the first element of the
@@ -463,6 +497,25 @@ class ResilientRun:
         leaves stamped with the writer's mesh, re-placed on THIS plan
         at resume, bit-exactly, even when the device counts differ
         (``docs/advanced/sharding.md``).
+    :param trace_every: the **flight recorder** cadence: every k-th
+        segment executes inside a real ``jax.profiler.trace`` capture
+        written under ``trace_dir`` (one xplane trace per captured
+        segment, journaled as ``flight_trace``), and every segment
+        boundary journals a ``device_memory`` event — live device
+        bytes by platform plus a ``jax.profiler
+        .device_memory_profile`` pprof snapshot on the traced
+        boundaries — so the HBM trajectory and a device timeline
+        exist for any long run *after the fact*. ``None`` (default)
+        disables both; tracing changes no computed result
+        (``tests/test_costs.py`` pins it).
+    :param trace_dir: flight-recorder artifact directory (default
+        ``<checkpoint dir>/flight``).
+    :param metrics: a :class:`~deap_tpu.telemetry.metrics
+        .MetricsRegistry` (or ``True`` for the process default):
+        segment/checkpoint wall seconds, retry and preemption counts
+        are recorded as Prometheus instruments
+        (``deap_resilience_*``) for the ``/metrics`` endpoint.
+        ``None`` (default) records nothing.
     """
 
     def __init__(self, checkpoints, *, segment_len: int = 10,
@@ -473,7 +526,8 @@ class ResilientRun:
                  double_buffer: bool = True, fault_plan=None,
                  run_id: Optional[str] = None,
                  tenant_id: Optional[str] = None,
-                 plan=None):
+                 plan=None, trace_every: Optional[int] = None,
+                 trace_dir: Optional[str] = None, metrics=None):
         if isinstance(checkpoints, Checkpointer):
             self.ckpt = checkpoints
         else:
@@ -506,6 +560,19 @@ class ResilientRun:
         # have a different device count than the writer's (elastic
         # resume; journaled as ``elastic_resume``)
         self.plan = plan
+        # flight recorder: every k-th segment runs under a real
+        # profiler trace; every boundary journals a device-memory
+        # sample — artifacts land under trace_dir, the journal carries
+        # their paths (see the trace_every docstring above)
+        if trace_every is not None and int(trace_every) < 1:
+            raise ValueError("trace_every must be >= 1")
+        self.trace_every = int(trace_every) if trace_every else None
+        self.trace_dir = (str(trace_dir) if trace_dir is not None
+                          else os.path.join(self.ckpt.directory,
+                                            "flight"))
+        self._metrics = _resolve_metrics(metrics)
+        self._minst = (_ResilienceInstruments(self._metrics)
+                       if self._metrics is not None else None)
         self.preempt_requested = False
         self._preempt_signum: Optional[int] = None
         self.resumed_from: Optional[str] = None
@@ -704,14 +771,23 @@ class ResilientRun:
         try:
             with self._signals():
                 gen = int(state["gen"])
+                seg_i = 0  # segments executed by THIS drive — the
+                #            flight-recorder cadence counter
                 while gen < total and not spec.stop_requested(state):
                     hi = min(gen + self.segment_len, total)
                     self._fault("segment_start", lo=gen, hi=hi)
-                    state = self._run_segment(spec, state, gen, hi)
+                    t_seg = time.perf_counter()
+                    state = self._flight_segment(spec, state, gen, hi,
+                                                 seg_i)
+                    seg_s = time.perf_counter() - t_seg
+                    if self._minst is not None:
+                        self._minst.segment_s.observe(
+                            seg_s, algorithm=spec.algorithm)
                     self._fault("segment_end", lo=gen, hi=hi)
                     meta = dict(state["_resilience"], step=hi)
                     if self.tenant_id is not None:
                         meta["tenant_id"] = self.tenant_id
+                    t_ck = time.perf_counter()
                     if writer is not None:
                         # double-buffered: snapshot now, write in the
                         # background; submit() first drains the PREVIOUS
@@ -721,16 +797,25 @@ class ResilientRun:
                                              meta=meta)
                     else:
                         path = self.ckpt.save(hi, state, meta=meta)
+                    if self._minst is not None:
+                        self._minst.checkpoint_s.observe(
+                            time.perf_counter() - t_ck,
+                            algorithm=spec.algorithm)
                     self.last_step = hi
                     self._journal_event("segment",
                                         algorithm=spec.algorithm,
                                         lo=gen, hi=hi, path=path,
                                         async_save=writer is not None)
+                    self._record_memory(hi, seg_i)
                     self._fault("saved", lo=gen, hi=hi, path=path)
                     gen = hi
+                    seg_i += 1
                     if self.preempt_requested:
                         if writer is not None:
                             writer.wait()  # durable before we claim so
+                        if self._minst is not None:
+                            self._minst.preemptions.inc(
+                                algorithm=spec.algorithm)
                         self._journal_event(
                             "preempted", algorithm=spec.algorithm,
                             step=gen, signum=self._preempt_signum)
@@ -747,6 +832,59 @@ class ResilientRun:
                         "checkpoint_write_failed", error=repr(e)[:300])
             raise
         return spec.finalize(state)
+
+    # ---------------------------------------------------- flight recorder ----
+
+    def _flight_segment(self, spec, state, lo, hi, seg_i: int):
+        """Run one segment, inside a real ``jax.profiler.trace``
+        capture when the flight-recorder cadence says so. The traced
+        segment is synced before the capture closes (dispatch is
+        async — an unsynced exit would truncate the device timeline);
+        syncing forces completion but changes no computed value."""
+        if self.trace_every is None or seg_i % self.trace_every:
+            return self._run_segment(spec, state, lo, hi)
+        from deap_tpu.support.profiling import sync
+
+        tdir = os.path.join(self.trace_dir, f"seg_{lo:06d}")
+        try:
+            os.makedirs(tdir, exist_ok=True)
+            tracer = jax.profiler.trace(tdir)
+            tracer.__enter__()
+        except Exception as e:
+            # a wedged profiler must never take down the run it
+            # observes: journal, run the segment untraced
+            self._journal_event("flight_trace_error",
+                                error=repr(e)[:200])
+            return self._run_segment(spec, state, lo, hi)
+        try:
+            state = self._run_segment(spec, state, lo, hi)
+            sync([leaf for leaf in jax.tree_util.tree_leaves(state)
+                  if isinstance(leaf, jax.Array)
+                  and not leaf.is_deleted()])
+        finally:
+            try:
+                tracer.__exit__(None, None, None)
+            except Exception:
+                pass
+        self._journal_event("flight_trace", algorithm=spec.algorithm,
+                            lo=lo, hi=hi, dir=tdir)
+        return state
+
+    def _record_memory(self, step: int, seg_i: int) -> None:
+        """Boundary device-memory sample (flight recorder only): live
+        bytes by platform every boundary, plus the full
+        ``device_memory_profile`` pprof blob on traced boundaries."""
+        if self.trace_every is None:
+            return
+        from deap_tpu.support.profiling import device_memory_snapshot
+
+        path = None
+        if seg_i % self.trace_every == 0:
+            os.makedirs(self.trace_dir, exist_ok=True)
+            path = os.path.join(self.trace_dir,
+                                f"mem_{step:06d}.pprof.gz")
+        snap = device_memory_snapshot(path)
+        self._journal_event("device_memory", step=step, **snap)
 
     def _run_segment(self, spec, state, lo, hi):
         attempt = 0
@@ -774,6 +912,9 @@ class ResilientRun:
                 action = None
                 if self.degrade_cb is not None:
                     action = self.degrade_cb(kind, exc)
+                if self._minst is not None:
+                    self._minst.retries.inc(algorithm=spec.algorithm,
+                                            kind=kind)
                 delay = self.retry.delay(attempt)
                 self._journal_event(
                     "degraded", algorithm=spec.algorithm, lo=lo, hi=hi,

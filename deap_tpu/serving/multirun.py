@@ -183,8 +183,15 @@ class MultiRunEngine:
                 raise ValueError(f"{family} needs mu= and lambda_=")
         self._key_impl: Optional[str] = None
         # one jitted segment program, cached per (lanes, horizon, k)
-        # shape triple — the bucket lattice keeps that set small
-        self._advance = jax.jit(self._segment, static_argnames=("k",))
+        # shape triple — the bucket lattice keeps that set small. The
+        # costs.instrument wrapper is the AOT observability seam: with
+        # a ProgramObservatory active every bucket program's
+        # cost/memory analysis journals as a `program_profile` event
+        from deap_tpu.telemetry import costs
+        self._advance = costs.instrument(
+            jax.jit(self._segment, static_argnames=("k",)),
+            label=f"serving/{family}/advance",
+            static_argnames=("k",))
         # jitted batch-admission programs (pack_fresh): stable function
         # identity per engine so repeated fresh admissions hit the jit
         # cache instead of re-tracing

@@ -40,6 +40,8 @@ from deap_tpu.serving.multirun import MultiRunEngine
 from deap_tpu.serving.tenant import Job, Tenant, bucket_key, pad_pow2
 from deap_tpu.support.compilecache import enable_compile_cache
 from deap_tpu.telemetry.meter import Meter
+from deap_tpu.telemetry.metrics import (MetricsServer, resolve_registry,
+                                        serve_metrics)
 from deap_tpu.telemetry.run import RunTelemetry
 
 __all__ = ["Scheduler", "prewarm"]
@@ -50,6 +52,9 @@ class _Bucket:
 
     def __init__(self, key, engine: MultiRunEngine):
         self.key = key
+        # the bucket's metric/journal label: family + program digest —
+        # short, stable, and readable on a Grafana legend
+        self.label = f"{key[0]}:{str(key[1])[:10]}"
         self.engine = engine
         self.queue: List[Tenant] = []
         self.residents: List[Tenant] = []
@@ -59,6 +64,48 @@ class _Bucket:
     @property
     def runnable(self) -> bool:
         return bool(self.queue) or bool(self.residents)
+
+
+class _ServingInstruments:
+    """The scheduler's Prometheus instruments — the per-bucket /
+    per-tenant SLO surface ``/metrics`` exports (create-or-get, so
+    several schedulers can share one registry)."""
+
+    def __init__(self, registry):
+        self.queue_depth = registry.gauge(
+            "deap_serving_queue_depth",
+            "jobs waiting for a lane, per bucket", labels=("bucket",))
+        self.occupancy = registry.gauge(
+            "deap_serving_lane_occupancy",
+            "fraction of max_lanes holding a resident tenant",
+            labels=("bucket",))
+        self.queue_wait_s = registry.histogram(
+            "deap_serving_queue_wait_seconds",
+            "seconds from submission/eviction to (re)admission",
+            labels=("bucket",))
+        self.segment_s = registry.histogram(
+            "deap_serving_segment_seconds",
+            "wall seconds per scheduler segment (advance + drain sync)",
+            labels=("bucket",))
+        self.admissions = registry.counter(
+            "deap_serving_admissions_total",
+            "fresh tenant admissions", labels=("bucket",))
+        self.evictions = registry.counter(
+            "deap_serving_evictions_total",
+            "tenants evicted past their fairness quantum",
+            labels=("bucket",))
+        self.resumes = registry.counter(
+            "deap_serving_resumes_total",
+            "tenants resumed from their checkpoint swap unit",
+            labels=("bucket",))
+        self.finished = registry.counter(
+            "deap_serving_tenants_finished_total",
+            "tenants that completed (or early-stopped)",
+            labels=("bucket",))
+        self.tenant_gens = registry.gauge(
+            "deap_serving_tenant_gens_per_sec",
+            "per-tenant generations/second over the last segment",
+            labels=("tenant_id",))
 
 
 class Scheduler:
@@ -86,6 +133,15 @@ class Scheduler:
         lifecycle events are journaled.
     :param compile_cache: path → :func:`enable_compile_cache` before
         the first compile (persistent across processes).
+    :param metrics: the SLO metrics surface — ``True`` (default)
+        records per-bucket queue depth / occupancy / queue-wait /
+        segment latency and per-tenant gens/s into the process
+        :class:`~deap_tpu.telemetry.metrics.MetricsRegistry`
+        (``deap_serving_*`` instruments; expose them with
+        :meth:`serve_metrics` or the module-level
+        :func:`deap_tpu.telemetry.serve_metrics`). Pass a registry to
+        isolate, ``None``/``False`` to disable. Host-side counters
+        only — nothing rides the compiled programs.
     """
 
     def __init__(self, root: str, *, max_lanes: int = 8,
@@ -94,7 +150,8 @@ class Scheduler:
                  checkpoint_every: Optional[int] = 1,
                  telemetry: bool = True,
                  compile_cache: Optional[str] = None,
-                 journal_fsync_every: Optional[int] = None):
+                 journal_fsync_every: Optional[int] = None,
+                 metrics=True):
         self.root = str(root)
         os.makedirs(self.root, exist_ok=True)
         if compile_cache:
@@ -108,6 +165,10 @@ class Scheduler:
         self.journal = RunJournal(
             os.path.join(self.root, "journal.jsonl"),
             fsync_every=journal_fsync_every)
+        self.metrics = resolve_registry(metrics)
+        self._minst = (_ServingInstruments(self.metrics)
+                       if self.metrics is not None else None)
+        self._metrics_server: Optional[MetricsServer] = None
         self.buckets: Dict[Any, _Bucket] = {}
         self.tenants: Dict[str, Tenant] = {}
         self._boundaries = 0
@@ -137,6 +198,9 @@ class Scheduler:
         self.journal.event("job_submitted", tenant_id=tenant.id,
                            family=job.family, ngen=int(job.ngen),
                            bucket=repr(bkey[:2]))
+        if self._minst is not None:
+            self._minst.queue_depth.set(len(bucket.queue),
+                                        bucket=bucket.label)
         return tenant.id
 
     def _make_engine(self, job: Job) -> MultiRunEngine:
@@ -210,10 +274,11 @@ class Scheduler:
         if bucket is None:
             return False
         self._repack(bucket)
+        t0 = time.perf_counter()
         batch, seg = bucket.engine.advance(bucket.batch,
                                            self.segment_len)
         bucket.batch = batch
-        self._drain_boundary(bucket, seg)
+        self._drain_boundary(bucket, seg, t_start=t0)
         return True
 
     def run(self, max_steps: Optional[int] = None) -> Dict[str, tuple]:
@@ -227,11 +292,27 @@ class Scheduler:
         return {t.id: t.result for t in self.tenants.values()
                 if t.result is not None}
 
+    def serve_metrics(self, host: str = "127.0.0.1", port: int = 0):
+        """Expose this scheduler's registry at ``/metrics`` on a
+        daemon thread (stdlib ``http.server``); returns the
+        :class:`~deap_tpu.telemetry.metrics.MetricsServer` (``.url``
+        is the scrape target). Closed with the scheduler."""
+        if self.metrics is None:
+            raise ValueError("Scheduler was built with metrics "
+                             "disabled; nothing to serve")
+        if self._metrics_server is None:
+            self._metrics_server = serve_metrics(self.metrics,
+                                                 host=host, port=port)
+        return self._metrics_server
+
     def close(self) -> None:
         self.journal.summary(
             tenants=len(self.tenants),
             finished=sum(t.done for t in self.tenants.values()))
         self.journal.close()
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+            self._metrics_server = None
 
     def __enter__(self) -> "Scheduler":
         return self
@@ -274,25 +355,41 @@ class Scheduler:
                     bucket.residents.remove(t)
                     bucket.queue.append(t)
                     changed = True
+                    if self._minst is not None:
+                        self._minst.evictions.inc(bucket=bucket.label)
 
         # admission — resume from checkpoint or fresh-init
         while bucket.queue and len(bucket.residents) < self.max_lanes:
             t = bucket.queue.pop(0)
+            if self._minst is not None:
+                self._minst.queue_wait_s.observe(
+                    max(0.0, time.monotonic() - t.enqueued_at),
+                    bucket=bucket.label)
             if t.has_checkpoint:
                 t.restore(eng)
                 self.journal.event("tenant_resumed", tenant_id=t.id,
                                    gen=t.gen)
+                if self._minst is not None:
+                    self._minst.resumes.inc(bucket=bucket.label)
             else:
                 t.lane = eng.lane_init(t.job.key, t.job.init,
                                        t.job.ngen, t.job.hyper)
                 self.journal.event("tenant_admitted", tenant_id=t.id,
                                    ngen=int(t.job.ngen))
+                if self._minst is not None:
+                    self._minst.admissions.inc(bucket=bucket.label)
                 for row in eng.lane_meter_rows((), 0, lane=t.lane):
                     self._journal_row(t, row)
             t.status = Tenant.RUNNING
             t.segments_resident = 0
             bucket.residents.append(t)
             changed = True
+        if self._minst is not None:
+            self._minst.queue_depth.set(len(bucket.queue),
+                                        bucket=bucket.label)
+            self._minst.occupancy.set(
+                len(bucket.residents) / self.max_lanes,
+                bucket=bucket.label)
 
         if changed and bucket.residents:
             lanes = []
@@ -311,13 +408,20 @@ class Scheduler:
                 self.journal.event("alarm", tenant_id=tenant.id,
                                    **alarm)
 
-    def _drain_boundary(self, bucket: _Bucket, seg: Dict[str, Any]
-                        ) -> None:
+    def _drain_boundary(self, bucket: _Bucket, seg: Dict[str, Any],
+                        t_start: Optional[float] = None) -> None:
         """The per-segment host sync: rows → tenants/journal/health,
-        completion, checkpoints."""
+        completion, checkpoints — plus the segment's SLO sample
+        (latency, per-tenant gens/s, queue/occupancy) into the metrics
+        registry and one ``slo`` journal event."""
         eng = bucket.engine
         self._boundaries += 1
         gens = np.asarray(bucket.batch["gen"])
+        # materialising `gens` is the segment's completion barrier —
+        # wall time from advance() dispatch to here is the segment SLO
+        seg_s = (time.perf_counter() - t_start
+                 if t_start is not None else None)
+        gens_advanced = 0
         finished: List[Tenant] = []
         for t in list(bucket.residents):
             i = t.slot
@@ -329,6 +433,11 @@ class Scheduler:
                                            gen_start=gen_before):
                 self._journal_row(t, row)
             t.gen = int(gens[i])
+            gens_advanced += t.gen - gen_before
+            if self._minst is not None and seg_s:
+                self._minst.tenant_gens.set(
+                    round((t.gen - gen_before) / seg_s, 3),
+                    tenant_id=t.id)
             t.segments_resident += 1
             t.lane = eng.unpack(bucket.batch, i)
             health = t.job.health
@@ -344,6 +453,8 @@ class Scheduler:
                 self.journal.event(
                     "tenant_finished", tenant_id=t.id, gen=t.gen,
                     status=t.status)
+                if self._minst is not None:
+                    self._minst.finished.inc(bucket=bucket.label)
                 finished.append(t)
             elif self.checkpoint_every and \
                     self._boundaries % self.checkpoint_every == 0:
@@ -359,6 +470,28 @@ class Scheduler:
             family=eng.family, lanes=int(len(gens)),
             residents=len(bucket.residents) + len(finished),
             finished=[t.id for t in finished])
+        # the boundary's SLO sample: one journal row (the report's
+        # scheduler-SLO timeline) and the Prometheus instruments
+        occupancy = len(bucket.residents) / self.max_lanes
+        slo: Dict[str, Any] = {
+            "bucket": bucket.label, "lanes": int(len(gens)),
+            "residents": len(bucket.residents),
+            "queue_depth": len(bucket.queue),
+            "occupancy": round(occupancy, 4),
+            "gens_advanced": int(gens_advanced),
+        }
+        if seg_s is not None:
+            slo["segment_s"] = round(seg_s, 6)
+            if seg_s > 0:
+                slo["gens_per_sec"] = round(gens_advanced / seg_s, 3)
+        self.journal.event("slo", **slo)
+        if self._minst is not None:
+            if seg_s is not None:
+                self._minst.segment_s.observe(seg_s,
+                                              bucket=bucket.label)
+            self._minst.queue_depth.set(len(bucket.queue),
+                                        bucket=bucket.label)
+            self._minst.occupancy.set(occupancy, bucket=bucket.label)
 
 
 def prewarm(scheduler: Scheduler, jobs: Iterable[Job],

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -143,6 +144,10 @@ class Tenant:
         self.result: Optional[tuple] = None
         self.stopped_at: Optional[int] = None
         self.has_checkpoint = False
+        # when this tenant last joined the queue (submission or
+        # eviction) — the scheduler's queue-wait SLO histogram reads
+        # it at admission; monotonic, so NTP steps can't skew SLOs
+        self.enqueued_at = time.monotonic()
         self._ckpt: Optional[Checkpointer] = None
 
     @property
@@ -194,3 +199,4 @@ class Tenant:
         self.lane = None          # swap unit is on disk
         self.record_chunks = []   # rolled into the checkpoint
         self.segments_resident = 0
+        self.enqueued_at = time.monotonic()

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import random
 import time
 from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
@@ -36,7 +37,8 @@ import jax
 
 __all__ = ["trace", "annotate", "span", "timed_generations",
            "timed_phases", "sync", "SpanRecorder", "set_span_recorder",
-           "get_span_recorder"]
+           "get_span_recorder", "device_memory_snapshot",
+           "live_buffer_bytes"]
 
 
 def trace(log_dir: str, **kwargs):
@@ -63,24 +65,40 @@ class SpanRecorder:
     time per call.
 
     Aggregates feed the run journal
-    (``deap_tpu.telemetry.RunJournal.spans``). A bounded reservoir
-    (``max_samples`` per name) backs the percentiles; count/total stay
-    exact past the bound.
+    (``deap_tpu.telemetry.RunJournal.spans``). A bounded **uniform
+    reservoir** (Vitter's algorithm R, ``max_samples`` per name) backs
+    the percentiles: past the bound each new sample replaces a random
+    held one with probability ``max_samples / count``, so the reservoir
+    stays a uniform sample of the whole run — p50/p99/max keep moving
+    on long runs instead of freezing on the first 4096 spans.
+    count/total/mean are exact regardless (never sampled). The
+    replacement RNG is seeded per recorder (``seed``), so identical
+    span streams aggregate identically.
     """
 
-    def __init__(self, max_samples: int = 4096):
+    def __init__(self, max_samples: int = 4096, seed: int = 0):
         self.max_samples = int(max_samples)
         self._samples: Dict[str, list] = {}
         self._count: Dict[str, int] = {}
         self._total: Dict[str, float] = {}
+        self._max: Dict[str, float] = {}
+        self._rng = random.Random(seed)
         self._prev: Optional["SpanRecorder"] = None
 
     def record(self, name: str, seconds: float) -> None:
-        self._count[name] = self._count.get(name, 0) + 1
+        n = self._count.get(name, 0) + 1
+        self._count[name] = n
         self._total[name] = self._total.get(name, 0.0) + seconds
+        self._max[name] = max(self._max.get(name, seconds), seconds)
         bucket = self._samples.setdefault(name, [])
         if len(bucket) < self.max_samples:
             bucket.append(seconds)
+        else:
+            # algorithm R: keep each of the n samples seen so far with
+            # equal probability max_samples / n
+            j = self._rng.randrange(n)
+            if j < self.max_samples:
+                bucket[j] = seconds
 
     def aggregates(self) -> Dict[str, Dict[str, float]]:
         """``{name: {count, total_s, mean_s, p50_s, p99_s, max_s}}``."""
@@ -93,7 +111,9 @@ class SpanRecorder:
                 m = len(samples)
                 agg["p50_s"] = samples[(m - 1) // 2]
                 agg["p99_s"] = samples[min(m - 1, int(0.99 * (m - 1)))]
-                agg["max_s"] = samples[-1]
+                # max is tracked exactly — the reservoir may have
+                # evicted the worst sample
+                agg["max_s"] = self._max[name]
             out[name] = agg
         return out
 
@@ -185,6 +205,43 @@ def sync(tree: Any) -> Any:
             jax.device_get(jax.numpy.ravel(leaf)[:1])
         break
     return tree
+
+
+def live_buffer_bytes() -> Dict[str, int]:
+    """Bytes of live device arrays by platform (``jax.live_arrays``) —
+    the cheap HBM-trajectory sample the flight recorder journals at
+    segment boundaries. Counts each array's global ``nbytes`` once;
+    deleted (donated-consumed) arrays are skipped."""
+    out: Dict[str, int] = {}
+    for arr in jax.live_arrays():
+        try:
+            if arr.is_deleted():
+                continue
+            platform = arr.devices().pop().platform
+            out[platform] = out.get(platform, 0) + int(arr.nbytes)
+        except Exception:
+            continue
+    return out
+
+
+def device_memory_snapshot(path: Optional[str] = None) -> Dict[str, Any]:
+    """One device-memory observation: live-array bytes per platform
+    (always), plus — when ``path`` is given — the full
+    ``jax.profiler.device_memory_profile()`` pprof protobuf written to
+    that file for offline ``pprof``/XProf analysis. Returns a
+    JSON-able dict (the flight recorder journals it verbatim as a
+    ``device_memory`` event)."""
+    snap: Dict[str, Any] = {"live_bytes": live_buffer_bytes()}
+    if path is not None:
+        try:
+            blob = jax.profiler.device_memory_profile()
+            with open(path, "wb") as fh:
+                fh.write(blob)
+            snap["profile_path"] = str(path)
+            snap["profile_bytes"] = len(blob)
+        except Exception as e:  # profile support varies per backend
+            snap["profile_error"] = repr(e)[:200]
+    return snap
 
 
 def timed_phases(phases: dict, reps: int = 3) -> dict:

@@ -16,6 +16,16 @@ Three planes (see docs/advanced/telemetry.md):
    per name (count/total/p50/p99) into the journal — the per-collective
    ``genome_shard/*`` spans yield numbers even with no xplane capture.
 
+The third observability layer (after the journal/meter and the probes)
+adds the **program and serving planes**:
+:mod:`~deap_tpu.telemetry.costs` — the :class:`ProgramObservatory`
+profiling every AOT-compiled program (flops/bytes, memory + donation
+aliasing, compile time, HLO fingerprint, ``hlo_drift`` alarms) — and
+:mod:`~deap_tpu.telemetry.metrics` — a stdlib-only host metrics
+registry (counters/gauges/histograms) exported as Prometheus text via
+:func:`metrics_text` / :func:`serve_metrics`, fed by the serving
+scheduler and the resilience engine.
+
 On top of the pipes, :mod:`~deap_tpu.telemetry.probes` is the
 evolution-specific *content*: jit-safe population probes (diversity,
 selection pressure, landscape stats, front quality) threaded through
@@ -30,6 +40,11 @@ structured machine-readable run telemetry either. This subsystem is
 opt-in everywhere and changes no computed result when enabled.
 """
 
+from deap_tpu.telemetry.costs import (
+    ProgramObservatory,
+    observatory,
+    profile_compiled,
+)
 from deap_tpu.telemetry.journal import (
     RunJournal,
     broadcast,
@@ -38,6 +53,12 @@ from deap_tpu.telemetry.journal import (
     toolbox_fingerprint,
 )
 from deap_tpu.telemetry.meter import Meter, MeterState
+from deap_tpu.telemetry.metrics import (
+    MetricsRegistry,
+    get_registry,
+    metrics_text,
+    serve_metrics,
+)
 from deap_tpu.telemetry.probes import (
     PROBE_REGISTRY,
     DiversityProbe,
@@ -57,8 +78,10 @@ from deap_tpu.telemetry.run import RunTelemetry, strategy_probe
 __all__ = [
     "Meter",
     "MeterState",
+    "MetricsRegistry",
     "PROBE_REGISTRY",
     "Probe",
+    "ProgramObservatory",
     "DiversityProbe",
     "TreeDiversityProbe",
     "FitnessProbe",
@@ -72,8 +95,13 @@ __all__ = [
     "compose_probes",
     "environment_fingerprint",
     "exact_hypervolume",
+    "get_registry",
+    "metrics_text",
+    "observatory",
+    "profile_compiled",
     "read_journal",
     "register_probe",
+    "serve_metrics",
     "strategy_probe",
     "toolbox_fingerprint",
 ]

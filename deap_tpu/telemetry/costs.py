@@ -1,0 +1,328 @@
+"""Program cost/memory observatory — what did XLA actually build?
+
+Every per-generation claim this stack makes ultimately rests on
+compiled XLA programs nobody can see: donation aliasing is asserted by
+one bench row, retraces surface as wall-time cliffs, and cross-backend
+comparisons (the Speed-Benchmarking-of-GP-frameworks critique,
+PAPERS.md) are meaningless without per-program cost attribution. This
+module intercepts the AOT seam every important program already goes
+through — ``ShardingPlan.compile``, the resilience engine's segment
+scan, the serving engine's batched advance — and records, per compiled
+program:
+
+- ``cost_analysis()`` — flops and bytes accessed (the roofline
+  numerator per program, not per wall-clock anecdote);
+- ``memory_analysis()`` — argument/output/temp bytes and **aliased
+  (donated) bytes**: the PR 8 donation contract proven per program on
+  every run, instead of once by ``bench.py --mesh``;
+- compile wall seconds and an **HLO fingerprint** (sha1 of the lowered
+  StableHLO text — deterministic for an identical program).
+
+Each record is journaled as a ``program_profile`` event. The
+fingerprint registry also catches the silent-retrace regression class:
+when the same ``(label, input signature)`` compiles again to a
+*different* HLO hash or cost, the observatory raises an ``hlo_drift``
+alarm through the :class:`~deap_tpu.telemetry.probes.HealthMonitor`
+(and journals it) — a shape-stable closure change that re-specialises a
+program mid-run becomes an alarm, not an unexplained wall-time cliff.
+
+Mechanically, :func:`instrument` wraps a jit-compiled callable: while
+an observatory is **active** (``with ProgramObservatory(...):``), calls
+route through an explicit ``.lower()`` → ``.compile()`` cache keyed on
+the concrete input signature (tree structure, per-leaf
+shape/dtype/sharding — at least as strict as jit's own cache), so the
+executed program is the *same* executable jit would have built: results
+are bit-identical, pinned by ``tests/test_costs.py``. With no active
+observatory the wrapper is a single ``None`` check and a tail call —
+the instrumented seams cost nothing when the observatory is off.
+
+Usage::
+
+    from deap_tpu.telemetry import ProgramObservatory
+
+    with ProgramObservatory(journal=tel.journal, health=monitor) as obs:
+        res = ResilientRun(ckdir, plan=plan, telemetry=tel)
+        pop, logbook, hof = res.ea_simple(key, pop, tb, .5, .2, 100)
+    obs.profiles   # one dict per compiled program (also journaled)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = ["ProgramObservatory", "instrument", "observatory",
+           "profile_compiled"]
+
+#: the active observatory — one slot, module-global (the seams that
+#: instrument their programs are constructed far from the run driver)
+_ACTIVE: list = [None]
+
+
+def observatory() -> Optional["ProgramObservatory"]:
+    """The currently active observatory, or None."""
+    return _ACTIVE[0]
+
+
+def _leaf_descriptor(leaf: Any) -> Tuple:
+    """A hashable signature for one argument leaf, at least as strict
+    as jit's own cache key: arrays by shape/dtype/sharding (a committed
+    array re-placed differently must re-lower — the compiled executable
+    is layout-specific), everything else by repr."""
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:
+        sharding = getattr(leaf, "sharding", None)
+        return (tuple(shape), str(dtype),
+                repr(sharding) if sharding is not None else "host")
+    return ("py", repr(leaf))
+
+
+def _hlo_fingerprint(lowered: Any) -> str:
+    """sha1 of the lowered StableHLO text — deterministic for an
+    identical traced program, different the moment a closure or shape
+    change alters what XLA is asked to build."""
+    return hashlib.sha1(
+        lowered.as_text().encode("utf-8", "replace")).hexdigest()[:16]
+
+
+def _cost_dict(compiled: Any) -> Dict[str, float]:
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    out = {}
+    for key, name in (("flops", "flops"),
+                      ("bytes accessed", "bytes_accessed"),
+                      ("optimal_seconds", "optimal_seconds")):
+        v = ca.get(key) if hasattr(ca, "get") else None
+        if isinstance(v, (int, float)):
+            out[name] = float(v)
+    return out
+
+
+def _memory_dict(compiled: Any) -> Dict[str, int]:
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return {}
+    out = {}
+    for attr, name in (("argument_size_in_bytes", "argument_bytes"),
+                       ("output_size_in_bytes", "output_bytes"),
+                       ("temp_size_in_bytes", "temp_bytes"),
+                       ("alias_size_in_bytes", "aliased_bytes"),
+                       ("generated_code_size_in_bytes", "code_bytes")):
+        v = getattr(ma, attr, None)
+        if isinstance(v, int):
+            out[name] = v
+    return out
+
+
+class ProgramObservatory:
+    """Collects per-program compile profiles and drift alarms.
+
+    :param journal: a :class:`~deap_tpu.telemetry.journal.RunJournal`
+        to write ``program_profile`` / ``alarm`` events into; default
+        broadcasts into every open journal (the ResilientRun pattern —
+        subsystem seams must not depend on holding a journal).
+    :param health: a :class:`~deap_tpu.telemetry.probes.HealthMonitor`;
+        HLO drift fires its ``hlo_drift`` alarm (recorded, counted
+        toward ``early_stop``, ``on_alarm`` invoked). Without one the
+        drift still lands in the journal as an ``alarm`` event.
+    :param on_profile: optional callback receiving each profile dict —
+        the bench harness hook.
+
+    Entering the context installs this observatory as the process-wide
+    active one (instrumented seams check the active slot at call time);
+    exiting restores the previous. :attr:`profiles` accumulates one
+    dict per compiled program; :attr:`drifts` the drift alarms.
+    """
+
+    def __init__(self, journal=None, health=None,
+                 on_profile: Optional[Callable] = None):
+        self.journal = journal
+        self.health = health
+        self.on_profile = on_profile
+        self.profiles: List[Dict[str, Any]] = []
+        self.drifts: List[Dict[str, Any]] = []
+        #: (label, signature) -> (hlo_hash, flops, bytes_accessed)
+        self._fingerprints: Dict[Tuple, Tuple] = {}
+        self._prev: Optional[ProgramObservatory] = None
+
+    # ---------------------------------------------------------- lifecycle ----
+
+    def __enter__(self) -> "ProgramObservatory":
+        self._prev = _ACTIVE[0]
+        _ACTIVE[0] = self
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _ACTIVE[0] = self._prev
+        self._prev = None
+
+    # ------------------------------------------------------------ plumbing ----
+
+    def _journal(self, kind: str, **payload) -> None:
+        if self.journal is not None:
+            self.journal.event(kind, **payload)
+        else:
+            from deap_tpu.telemetry.journal import broadcast
+            broadcast(kind, **payload)
+
+    # ------------------------------------------------------------- record ----
+
+    def record(self, label: str, lowered: Any, compiled: Any,
+               compile_s: float, signature: Any = None,
+               donating: bool = False) -> Dict[str, Any]:
+        """Profile one freshly compiled program: journal its
+        ``program_profile`` row and run drift detection against any
+        earlier compile of the same ``(label, signature)``."""
+        profile: Dict[str, Any] = {
+            "label": str(label),
+            "hlo_hash": _hlo_fingerprint(lowered),
+            "compile_s": round(float(compile_s), 6),
+            "donating": bool(donating),
+        }
+        profile.update(_cost_dict(compiled))
+        profile.update(_memory_dict(compiled))
+        self.profiles.append(profile)
+        self._journal("program_profile", **profile)
+        if self.on_profile is not None:
+            self.on_profile(profile)
+
+        key = (profile["label"], signature)
+        seen = self._fingerprints.get(key)
+        fp = (profile["hlo_hash"], profile.get("flops"),
+              profile.get("bytes_accessed"))
+        if seen is not None and seen != fp:
+            self._drift(profile, seen, fp)
+        self._fingerprints[key] = fp
+        return profile
+
+    def record_error(self, label: str, exc: BaseException) -> None:
+        self._journal("program_profile_error", label=str(label),
+                      error=repr(exc)[:300])
+
+    def _drift(self, profile: Dict[str, Any], seen: Tuple, fp: Tuple
+               ) -> None:
+        """The same (label, signature) compiled to a different program:
+        the silent-retrace regression class, surfaced as an alarm."""
+        detail = {
+            "program": profile["label"],
+            "prev_hlo_hash": seen[0], "hlo_hash": fp[0],
+            "prev_flops": seen[1], "flops": fp[1],
+            "prev_bytes_accessed": seen[2], "bytes_accessed": fp[2],
+        }
+        if self.health is not None:
+            alarm = self.health.program_drift(**detail)
+        else:
+            alarm = {"alarm": "hlo_drift", "gen": None, **detail}
+        self.drifts.append(alarm)
+        self._journal("alarm", **alarm)
+
+
+# --------------------------------------------------------- instrumenting ----
+
+def profile_compiled(label: str, lowered: Any, compiled: Any,
+                     compile_s: float, signature: Any = None,
+                     donating: bool = False) -> Optional[Dict[str, Any]]:
+    """Record an externally AOT-compiled program (a caller that already
+    drives ``.lower()``/``.compile()`` itself — the bench harness) into
+    the active observatory, if any."""
+    obs = _ACTIVE[0]
+    if obs is None:
+        return None
+    return obs.record(label, lowered, compiled, compile_s,
+                      signature=signature, donating=donating)
+
+
+class _InstrumentedFunction:
+    """The wrapper :func:`instrument` returns. Inactive observatory →
+    one None-check and a tail call into the wrapped jit. Active →
+    explicit ``.lower().compile()`` with a per-signature executable
+    cache (bit-identical: the executable is the one jit would build),
+    each compile profiled and drift-checked."""
+
+    def __init__(self, fn: Callable, label: str,
+                 static_argnums: Tuple[int, ...] = (),
+                 static_argnames: Tuple[str, ...] = (),
+                 donating: bool = False):
+        self._fn = fn
+        self.label = str(label)
+        self._static_argnums = tuple(int(i) for i in static_argnums)
+        self._static_argnames = tuple(str(n) for n in static_argnames)
+        self._donating = bool(donating)
+        self._cache: Dict[Tuple, Any] = {}
+        self._broken = False
+
+    def __getattr__(self, name):
+        # .lower / .clear_cache / __wrapped__ still reach the jit
+        return getattr(self._fn, name)
+
+    def _signature(self, args, kwargs) -> Tuple:
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        if any(isinstance(leaf, jax.core.Tracer) for leaf in leaves):
+            # called under an enclosing trace (inlined into a larger
+            # program): there is no standalone executable to profile —
+            # bypass the AOT path for this call only
+            raise TypeError("traced arguments")
+        return (str(treedef),
+                tuple(_leaf_descriptor(leaf) for leaf in leaves))
+
+    def _strip_static(self, args, kwargs):
+        """The compiled executable is specialised to its static
+        arguments and is called WITHOUT them."""
+        if self._static_argnums:
+            args = tuple(a for i, a in enumerate(args)
+                         if i not in self._static_argnums)
+        if self._static_argnames:
+            kwargs = {k: v for k, v in kwargs.items()
+                      if k not in self._static_argnames}
+        return args, kwargs
+
+    def __call__(self, *args, **kwargs):
+        obs = _ACTIVE[0]
+        if obs is None or self._broken:
+            return self._fn(*args, **kwargs)
+        try:
+            sig = self._signature(args, kwargs)
+        except Exception:
+            return self._fn(*args, **kwargs)
+        compiled = self._cache.get(sig)
+        if compiled is None:
+            try:
+                t0 = time.perf_counter()
+                lowered = self._fn.lower(*args, **kwargs)
+                compiled = lowered.compile()
+                compile_s = time.perf_counter() - t0
+            except Exception as exc:
+                # an exotic argument the AOT path can't take: profile
+                # nothing, run the program — observability must never
+                # take down the run it observes
+                self._broken = True
+                obs.record_error(self.label, exc)
+                return self._fn(*args, **kwargs)
+            obs.record(self.label, lowered, compiled, compile_s,
+                       signature=sig, donating=self._donating)
+            self._cache[sig] = compiled
+        call_args, call_kwargs = self._strip_static(args, kwargs)
+        return compiled(*call_args, **call_kwargs)
+
+
+def instrument(fn: Callable, label: str,
+               static_argnums: Tuple[int, ...] = (),
+               static_argnames=None,
+               donating: bool = False) -> Callable:
+    """Wrap a jit-compiled callable so the active observatory profiles
+    every program it compiles (see :class:`_InstrumentedFunction`).
+    ``static_argnums``/``static_argnames`` must mirror the jit's own —
+    the compiled executable is called without its statics. ``donating``
+    tags the profile rows (the donation-contract audit keys on it)."""
+    return _InstrumentedFunction(
+        fn, label, static_argnums=static_argnums,
+        static_argnames=tuple(static_argnames or ()), donating=donating)

@@ -2,7 +2,11 @@
 
 The host-events plane of the telemetry subsystem: one append-only JSONL
 file per run, each line ``{"t": <secs since open>, "kind": ..., ...}``.
-Kinds written by this module and the algorithm integrations:
+``t`` deltas are measured on the **monotonic** clock (an NTP step
+mid-run can never yield backwards timestamps); the wall-clock epoch of
+the open lands in the header as ``wall_start``, so ``wall_start + t``
+dates any row. Kinds written by this module and the algorithm
+integrations:
 
 - ``header`` — backend / device / jax-version / process fingerprint,
   plus an optional toolbox fingerprint (which operators, bound args).
@@ -142,7 +146,12 @@ class RunJournal:
         self.run_id = run_id or hex(int(time.time() * 1e6))[2:]
         self.fsync_every = int(fsync_every) if fsync_every else None
         self._rows_since_sync = 0
-        self._t0 = time.time()
+        # row `t` deltas come from the monotonic clock: an NTP step
+        # mid-run must never produce backwards/negative timestamps.
+        # The wall-clock epoch at open is kept separately and written
+        # into the header (`wall_start`) so rows remain datable.
+        self._t0 = time.monotonic()
+        self.wall_start = time.time()
         # rows arrive from the main thread AND background writers (the
         # async checkpoint worker broadcasts checkpoint events): one
         # lock keeps lines whole
@@ -161,7 +170,7 @@ class RunJournal:
     def _write(self, kind: str, payload: Dict[str, Any]) -> None:
         if self._closed:
             return
-        line = {"t": round(time.time() - self._t0, 6), "kind": kind}
+        line = {"t": round(time.monotonic() - self._t0, 6), "kind": kind}
         line.update(payload)
         with self._write_lock:
             if self._closed:
@@ -180,6 +189,7 @@ class RunJournal:
                **extra: Any) -> None:
         payload: Dict[str, Any] = {
             "run_id": self.run_id,
+            "wall_start": round(self.wall_start, 6),
             "env": environment_fingerprint(init_backend),
             "monitoring": self._monitoring,
         }

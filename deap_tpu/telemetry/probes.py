@@ -643,6 +643,11 @@ class HealthMonitor:
       ``improvement_eps`` for ``stagnation_window`` consecutive rows
       (uses the row's ``stagnation_age`` when a FitnessProbe provides
       it, otherwise tracks ``best`` itself). Re-arms after improvement.
+    - ``hlo_drift`` — not row-driven: the
+      :class:`~deap_tpu.telemetry.costs.ProgramObservatory` calls
+      :meth:`program_drift` when the same (program label, input
+      signature) recompiles to a different HLO hash or cost — the
+      silent-retrace regression class, promoted to an alarm.
 
     ``early_stop`` names alarm kinds (or ``True`` for all) that set
     :attr:`stop_requested` — host-driven loops (the GP engine, island
@@ -653,7 +658,7 @@ class HealthMonitor:
 
     #: every alarm kind this monitor can emit (report/tests key on it)
     ALARM_KINDS = ("non_finite", "clone_spike", "premature_convergence",
-                   "zero_improvement")
+                   "zero_improvement", "hlo_drift")
 
     def __init__(self, *, nan_check: bool = True,
                  clone_rate_max: Optional[float] = None,
@@ -694,6 +699,15 @@ class HealthMonitor:
         if self.on_alarm is not None:
             self.on_alarm(alarm)
         return alarm
+
+    def program_drift(self, gen=None, **detail) -> dict:
+        """Fire the ``hlo_drift`` alarm — called by the
+        :class:`~deap_tpu.telemetry.costs.ProgramObservatory` when a
+        (program, signature) pair recompiles to a different HLO hash
+        or cost. Not a row tripwire: compile events, not meter rows,
+        drive it. Honours ``early_stop``/``on_alarm`` like every other
+        kind."""
+        return self._fire("hlo_drift", gen, **detail)
 
     def _clone_rate(self, row) -> Optional[float]:
         v = row.get(self.clone_key)

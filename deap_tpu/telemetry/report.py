@@ -164,6 +164,137 @@ def _tenant_sections(events: List[Dict[str, Any]], out: List[str]
     return True
 
 
+def _fmt_bytes(n: Any) -> str:
+    if not isinstance(n, (int, float)):
+        return "?"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return (f"{n:.0f} {unit}" if unit == "B"
+                    else f"{n:.2f} {unit}")
+        n /= 1024
+    return "?"
+
+
+def _program_table(events: List[Dict[str, Any]], out: List[str]
+                   ) -> None:
+    """The program-observatory plane: one row per ``program_profile``
+    event — what XLA actually built (flops / bytes / compile time) and
+    whether the donation contract held (aliased bytes)."""
+    profiles = [e for e in events if e.get("kind") == "program_profile"]
+    if not profiles:
+        return
+    out.append("")
+    out.append(f"## Programs ({len(profiles)} compiled)")
+    out.append("")
+    out.append("| program | hlo | flops | bytes accessed | "
+               "aliased (donated) | compile s |")
+    out.append("|---|---|---|---|---|---|")
+    for p in profiles:
+        flops = p.get("flops")
+        byt = p.get("bytes_accessed")
+        aliased = p.get("aliased_bytes")
+        don = " ▲ donating but 0 aliased" if (
+            p.get("donating") and not aliased) else ""
+        out.append(
+            f"| {p.get('label')} | {str(p.get('hlo_hash'))[:8]} | "
+            f"{_fmt(flops) if flops is not None else '?'} | "
+            f"{_fmt_bytes(byt)} | {_fmt_bytes(aliased)}{don} | "
+            f"{_fmt(p.get('compile_s'))} |")
+    errors = [e for e in events
+              if e.get("kind") == "program_profile_error"]
+    for e in errors:
+        out.append(f"- ▲ profile failed for {e.get('label')}: "
+                   f"{e.get('error')}")
+    drift = [e for e in events if e.get("kind") == "alarm"
+             and e.get("alarm") == "hlo_drift"]
+    for e in drift:
+        out.append(f"- ▲ **hlo_drift**: {e.get('program')} recompiled "
+                   f"{e.get('prev_hlo_hash')} → {e.get('hlo_hash')} "
+                   "(same input signature — silent retrace regression)")
+
+
+def _slo_section(events: List[Dict[str, Any]], out: List[str]) -> None:
+    """Scheduler SLO timeline from the per-boundary ``slo`` samples:
+    queue depth / occupancy / gens-per-sec sparklines per bucket plus
+    the eviction ledger."""
+    slos = [e for e in events if e.get("kind") == "slo"]
+    if not slos:
+        return
+    buckets: Dict[str, List[Dict[str, Any]]] = {}
+    for e in slos:
+        buckets.setdefault(str(e.get("bucket", "?")), []).append(e)
+    out.append("")
+    out.append("## Scheduler SLO (per segment boundary)")
+    for name in sorted(buckets):
+        rows = buckets[name]
+        out.append("")
+        out.append(f"### bucket {name} ({len(rows)} segments)")
+        for metric, label in (("queue_depth", "queue depth"),
+                              ("occupancy", "occupancy"),
+                              ("gens_per_sec", "gens/s")):
+            vals = [e.get(metric) for e in rows
+                    if isinstance(e.get(metric), (int, float))]
+            if vals:
+                out.append(f"{label.ljust(12)} {sparkline(vals)}  "
+                           f"min={_fmt(min(vals))} "
+                           f"max={_fmt(max(vals))} "
+                           f"last={_fmt(vals[-1])}")
+        waits = [e.get("segment_s") for e in rows
+                 if isinstance(e.get("segment_s"), (int, float))]
+        if waits:
+            s = sorted(waits)
+            out.append(
+                f"segment wall  p50={_fmt(s[(len(s) - 1) // 2])}s "
+                f"p99={_fmt(s[min(len(s) - 1, int(0.99 * (len(s) - 1)))])}s"
+                f" max={_fmt(s[-1])}s")
+    evicted = [e for e in events if e.get("kind") == "tenant_evicted"]
+    resumed = [e for e in events if e.get("kind") == "tenant_resumed"]
+    if evicted or resumed:
+        out.append("")
+        out.append(f"- swap ledger: {len(evicted)} eviction(s), "
+                   f"{len(resumed)} resume(s)")
+        for e in evicted[:10]:
+            out.append(f"  - gen {e.get('gen')}: {e.get('tenant_id')} "
+                       "evicted (checkpoint swap unit)")
+
+
+def _memory_section(events: List[Dict[str, Any]], out: List[str]
+                    ) -> None:
+    """Flight-recorder device-memory trajectory: live device bytes per
+    boundary as a sparkline, plus the captured trace/pprof artifact
+    paths."""
+    mems = [e for e in events if e.get("kind") == "device_memory"]
+    traces = [e for e in events if e.get("kind") == "flight_trace"]
+    if not mems and not traces:
+        return
+    out.append("")
+    out.append("## Flight recorder")
+    if mems:
+        vals, steps = [], []
+        for e in mems:
+            live = e.get("live_bytes")
+            if isinstance(live, dict):
+                vals.append(sum(v for v in live.values()
+                                if isinstance(v, (int, float))))
+                steps.append(e.get("step"))
+        if vals:
+            out.append(
+                f"device memory  {sparkline(vals)}  "
+                f"min={_fmt_bytes(min(vals))} "
+                f"max={_fmt_bytes(max(vals))} "
+                f"last={_fmt_bytes(vals[-1])} "
+                f"({len(vals)} boundary snapshots, steps "
+                f"{steps[0]}–{steps[-1]})")
+        pprofs = [e.get("profile_path") for e in mems
+                  if e.get("profile_path")]
+        if pprofs:
+            out.append(f"- {len(pprofs)} pprof snapshot(s), first: "
+                       f"{pprofs[0]}")
+    for e in traces:
+        out.append(f"- xplane trace of segment [{e.get('lo')}, "
+                   f"{e.get('hi')}): {e.get('dir')}")
+
+
 def render_report(path: str, lines: Optional[List[str]] = None) -> str:
     """The full report as one string (also returned line-by-line into
     ``lines`` when given — bench_report prints as it renders)."""
@@ -225,8 +356,12 @@ def render_report(path: str, lines: Optional[List[str]] = None) -> str:
     # ----------------------------------------- multi-tenant journals ----
     if _tenant_sections(events, out):
         # per-tenant blocks replace the single-run meter/alarm
-        # sections (which would interleave tenants); the summary
-        # still applies to the scheduler process as a whole
+        # sections (which would interleave tenants); the scheduler-
+        # wide planes (SLO timeline, compiled programs, flight
+        # recorder) and the summary still apply to the process
+        _slo_section(events, out)
+        _program_table(events, out)
+        _memory_section(events, out)
         summary = next((e for e in reversed(events)
                         if e.get("kind") == "summary"), None)
         if summary is not None:

@@ -1,0 +1,316 @@
+"""Program cost/memory observatory + flight recorder contracts.
+
+Four pins:
+
+1. **Bit-identity** — the full third observability layer (program
+   observatory + metrics registry + flight recorder at trace_every
+   cadence) produces populations/logbooks identical to the untouched
+   loop: the AOT-compiled executable IS the program jit would build.
+2. **Program profiles** — every compiled segment program journals a
+   ``program_profile`` event with flops/bytes, memory analysis and an
+   HLO fingerprint; donating (plan-compiled) programs show **nonzero
+   aliased bytes** — the PR 8 donation contract proven per program.
+3. **hlo_drift** — recompiling the same (label, input signature) to a
+   different HLO (a silent retrace: same shapes, changed closure)
+   fires the HealthMonitor ``hlo_drift`` alarm and journals it.
+4. **Flight recorder** — ``ResilientRun(trace_every=k)`` leaves xplane
+   trace dirs and pprof memory snapshots under the run dir and
+   journals ``flight_trace`` / ``device_memory`` events.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deap_tpu import algorithms, ops
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import init_population
+from deap_tpu.core.toolbox import Toolbox
+from deap_tpu.parallel import ShardingPlan
+from deap_tpu.resilience import ResilientRun
+from deap_tpu.telemetry import (ProgramObservatory, RunJournal,
+                                observatory, read_journal)
+from deap_tpu.telemetry.costs import instrument
+from deap_tpu.telemetry.metrics import MetricsRegistry
+from deap_tpu.telemetry.probes import HealthMonitor
+
+NGEN = 10
+SEG = 4  # not dividing NGEN: exercises the short-tail program too
+
+
+def _toolbox(indpb=0.1):
+    tb = Toolbox()
+    tb.register("evaluate", lambda g: g.sum(-1).astype(jnp.float32))
+    tb.register("mate", ops.cx_two_point)
+    tb.register("mutate", ops.mut_flip_bit, indpb=indpb)
+    tb.register("select", ops.sel_tournament, tournsize=3)
+    return tb
+
+
+def _pop(seed=0, n=64, length=16):
+    return init_population(jax.random.key(seed), n,
+                           ops.bernoulli_genome(length),
+                           FitnessSpec((1.0,)))
+
+
+# ------------------------------------------------------- bit identity ----
+
+def test_full_observability_layer_bit_identical(tmp_path):
+    tb = _toolbox()
+    pop = _pop()
+    key = jax.random.key(42)
+    ref_pop, ref_log, _ = algorithms.ea_simple(key, pop, tb, 0.5, 0.2,
+                                               NGEN)
+
+    jpath = str(tmp_path / "run.jsonl")
+    reg = MetricsRegistry()
+    with RunJournal(jpath) as journal:
+        with ProgramObservatory(journal=journal) as obs:
+            res = ResilientRun(str(tmp_path / "ck"), segment_len=SEG,
+                               trace_every=2, metrics=reg)
+            got_pop, got_log, _ = res.ea_simple(key, pop, tb, 0.5, 0.2,
+                                                NGEN)
+
+    np.testing.assert_array_equal(np.asarray(ref_pop.genomes),
+                                  np.asarray(got_pop.genomes))
+    np.testing.assert_array_equal(np.asarray(ref_pop.fitness),
+                                  np.asarray(got_pop.fitness))
+    assert len(ref_log) == len(got_log)
+    for ra, rb in zip(ref_log, got_log):
+        for k in ra:
+            np.testing.assert_array_equal(np.asarray(ra[k]),
+                                          np.asarray(rb[k]))
+
+    # the layer observed itself into the journal
+    rows = read_journal(jpath)
+    kinds = {e.get("kind") for e in rows}
+    assert "program_profile" in kinds
+    assert "flight_trace" in kinds
+    assert "device_memory" in kinds
+    # two xs shapes (full segment + short tail) → >= 2 programs
+    profiles = [e for e in rows if e.get("kind") == "program_profile"]
+    assert len(profiles) >= 2
+    for p in profiles:
+        assert p["label"] == "resilient_ea_simple"
+        assert isinstance(p.get("hlo_hash"), str) and p["hlo_hash"]
+        assert p.get("compile_s", 0) > 0
+        assert isinstance(p.get("flops"), (int, float))
+    assert len({p["hlo_hash"] for p in profiles}) >= 2
+    # no drift: distinct signatures are legitimate distinct programs
+    assert not obs.drifts
+    # the metrics registry saw the segments
+    assert "deap_resilience_segment_seconds_bucket" in reg.metrics_text()
+
+
+def test_flight_recorder_artifacts(tmp_path):
+    tb = _toolbox()
+    with ProgramObservatory():
+        res = ResilientRun(str(tmp_path / "ck"), segment_len=SEG,
+                           trace_every=2)
+        res.ea_simple(jax.random.key(1), _pop(), tb, 0.5, 0.2, NGEN)
+    flight = str(tmp_path / "ck" / "flight")
+    assert os.path.isdir(flight)
+    entries = sorted(os.listdir(flight))
+    # 3 segments (4+4+2), trace_every=2 → traces of segments 0 and 2
+    assert [e for e in entries if e.startswith("seg_")]
+    assert [e for e in entries if e.startswith("mem_")
+            and e.endswith(".pprof.gz")]
+    # every traced segment dir holds a real xplane capture
+    for seg in (e for e in entries if e.startswith("seg_")):
+        found = []
+        for root, _dirs, files in os.walk(os.path.join(flight, seg)):
+            found.extend(files)
+        assert found, f"empty trace dir {seg}"
+
+
+def test_trace_every_validation(tmp_path):
+    with pytest.raises(ValueError):
+        ResilientRun(str(tmp_path / "ck"), trace_every=0)
+
+
+# -------------------------------------------------- donation contract ----
+
+def test_donating_program_reports_aliased_bytes(tmp_path):
+    tb = _toolbox()
+    plan = ShardingPlan.for_population(1)
+    with ProgramObservatory() as obs:
+        res = ResilientRun(str(tmp_path / "ck"), segment_len=SEG,
+                           plan=plan)
+        got, _, _ = res.ea_simple(jax.random.key(3), _pop(), tb, 0.5,
+                                  0.2, NGEN)
+    ref, _, _ = algorithms.ea_simple(jax.random.key(3), _pop(), tb,
+                                     0.5, 0.2, NGEN)
+    np.testing.assert_array_equal(np.asarray(ref.genomes),
+                                  np.asarray(got.genomes))
+    donating = [p for p in obs.profiles if p["donating"]]
+    assert donating, "plan-compiled segment programs must tag donating"
+    for p in donating:
+        assert p.get("aliased_bytes", 0) > 0, (
+            "donating generation-step program shows zero aliased "
+            f"bytes: {p}")
+
+
+# ---------------------------------------------------------- hlo drift ----
+
+def test_hlo_drift_alarm_fires_on_forced_retrace(tmp_path):
+    """The silent-retrace regression: same label, same input
+    signature, different program (a changed closure — here a mutated
+    toolbox operator) → hlo_drift through the HealthMonitor and the
+    journal."""
+    jpath = str(tmp_path / "drift.jsonl")
+    mon = HealthMonitor(early_stop=("hlo_drift",))
+    x = jnp.arange(8.0)
+    with RunJournal(jpath) as journal:
+        with ProgramObservatory(journal=journal, health=mon) as obs:
+            f1 = instrument(jax.jit(lambda v: v * 2.0), "gen_step")
+            f1(x)
+            # the "retrace": a rebuilt program under the SAME label
+            # with the SAME signature but different math
+            f2 = instrument(jax.jit(lambda v: v * 3.0), "gen_step")
+            f2(x)
+    assert len(obs.profiles) == 2
+    assert len(obs.drifts) == 1
+    drift = obs.drifts[0]
+    assert drift["alarm"] == "hlo_drift"
+    assert drift["program"] == "gen_step"
+    assert drift["prev_hlo_hash"] != drift["hlo_hash"]
+    # HealthMonitor recorded it and honoured early_stop
+    assert mon.alarms and mon.alarms[0]["alarm"] == "hlo_drift"
+    assert mon.stop_requested
+    assert "hlo_drift" in HealthMonitor.ALARM_KINDS
+    rows = read_journal(jpath)
+    alarms = [e for e in rows if e.get("kind") == "alarm"]
+    assert alarms and alarms[0]["alarm"] == "hlo_drift"
+
+
+def test_no_drift_for_identical_recompile():
+    """The same program rebuilt identically is NOT drift."""
+    x = jnp.arange(8.0)
+    with ProgramObservatory() as obs:
+        instrument(jax.jit(lambda v: v * 2.0), "stable")(x)
+        instrument(jax.jit(lambda v: v * 2.0), "stable")(x)
+    assert len(obs.profiles) == 2
+    assert not obs.drifts
+
+
+def test_distinct_signatures_are_not_drift():
+    """A new input shape is a legitimate new program, never drift."""
+    with ProgramObservatory() as obs:
+        f = instrument(jax.jit(lambda v: v + 1), "shapes")
+        f(jnp.arange(8.0))
+        f(jnp.arange(16.0))
+    assert len(obs.profiles) == 2
+    assert not obs.drifts
+
+
+# ----------------------------------------------------- wrapper hygiene ----
+
+def test_inactive_observatory_is_passthrough():
+    assert observatory() is None
+    calls = []
+    jitted = jax.jit(lambda v: v * 2)
+    f = instrument(jitted, "idle")
+    out = f(jnp.arange(4.0))
+    np.testing.assert_array_equal(np.asarray(out), [0.0, 2.0, 4.0, 6.0])
+    assert not calls
+    # attribute passthrough: the AOT entry points still reachable
+    assert f.lower is jitted.lower
+
+
+def test_signature_cache_compiles_once_per_shape():
+    with ProgramObservatory() as obs:
+        f = instrument(jax.jit(lambda v: v * 2), "cached")
+        for _ in range(4):
+            f(jnp.arange(8.0))
+    assert len(obs.profiles) == 1
+
+
+def test_static_args_stripped_for_compiled_call():
+    with ProgramObservatory() as obs:
+        f = instrument(
+            jax.jit(lambda v, k: v[:k], static_argnames=("k",)),
+            "static", static_argnames=("k",))
+        out = f(jnp.arange(8.0), k=3)
+        np.testing.assert_array_equal(np.asarray(out), [0.0, 1.0, 2.0])
+        out = f(jnp.arange(8.0), k=5)  # new static value → new program
+        assert out.shape == (5,)
+    assert len(obs.profiles) == 2
+
+
+def test_instrumented_callable_under_enclosing_trace():
+    """Invoked inside another jit there is no standalone executable:
+    the wrapper must inline transparently and profile nothing."""
+    with ProgramObservatory() as obs:
+        inner = instrument(jax.jit(lambda v: v * 2), "inner")
+        outer = jax.jit(lambda v: inner(v) + 1)
+        out = outer(jnp.arange(4.0))
+        np.testing.assert_array_equal(np.asarray(out),
+                                      [1.0, 3.0, 5.0, 7.0])
+        # the enclosing-trace bypass must not poison later top-level
+        # calls: those still profile
+        inner(jnp.arange(4.0))
+    assert [p["label"] for p in obs.profiles] == ["inner"]
+
+
+def test_broken_aot_path_falls_back(tmp_path):
+    """A callable without .lower must not break under observation —
+    journal the error, keep executing."""
+    jpath = str(tmp_path / "err.jsonl")
+    with RunJournal(jpath) as journal:
+        with ProgramObservatory(journal=journal):
+            f = instrument(lambda v: v * 2, "plainfn")
+            assert f(3) == 6
+            assert f(4) == 8  # broken flag short-circuits thereafter
+    rows = read_journal(jpath)
+    assert any(e.get("kind") == "program_profile_error" for e in rows)
+
+
+# ------------------------------------------------------ report planes ----
+
+def test_report_renders_observability_planes(tmp_path):
+    """--health renders the program cost table, the scheduler SLO
+    summary and the device-memory sparkline from the new journal
+    kinds."""
+    from deap_tpu.telemetry import report
+
+    jpath = str(tmp_path / "obs.jsonl")
+    with RunJournal(jpath) as j:
+        j.header(init_backend=False)
+        j.event("program_profile", label="plan/resilient_ea_simple",
+                hlo_hash="abcd1234ef", compile_s=1.25, donating=True,
+                flops=1e9, bytes_accessed=4.2e8, argument_bytes=1000,
+                output_bytes=1000, temp_bytes=64, aliased_bytes=960)
+        j.event("program_profile", label="serving/ea_simple/advance",
+                hlo_hash="ffff000011", compile_s=0.5, donating=False,
+                flops=2e6, bytes_accessed=1e6, aliased_bytes=0)
+        for i in range(4):
+            j.event("slo", bucket="ea_simple:onemax", lanes=2,
+                    residents=2, queue_depth=2 - i // 2,
+                    occupancy=1.0, gens_advanced=6,
+                    segment_s=0.1 + 0.01 * i, gens_per_sec=60.0 - i)
+            j.event("device_memory", step=3 * (i + 1),
+                    live_bytes={"cpu": 1000 + 100 * i})
+        j.event("flight_trace", lo=0, hi=3, dir="/tmp/fl/seg_000000")
+        j.event("tenant_evicted", tenant_id="t1", gen=3)
+        j.event("alarm", alarm="hlo_drift",
+                program="plan/resilient_ea_simple",
+                prev_hlo_hash="abcd1234ef", hlo_hash="deadbeef00",
+                prev_flops=1e9, flops=2e9,
+                prev_bytes_accessed=4.2e8, bytes_accessed=8e8)
+        j.summary()
+    text = report.render_report(jpath)
+    assert "## Programs (2 compiled)" in text
+    assert "plan/resilient_ea_simple" in text
+    assert "MiB" in text  # bytes humanised
+    assert "## Scheduler SLO" in text
+    assert "queue depth" in text and "occupancy" in text
+    assert "gens/s" in text
+    assert "p50=" in text and "p99=" in text
+    assert "## Flight recorder" in text
+    assert "device memory" in text
+    assert "xplane trace of segment [0, 3)" in text
+    assert "hlo_drift" in text and "silent retrace" in text
+    assert "1 eviction(s)" in text

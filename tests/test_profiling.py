@@ -2,6 +2,7 @@
 barrier, span wall-time recording, and host-timed generation loop
 (SURVEY.md §5.1 parity)."""
 
+import os
 import time
 
 import jax
@@ -170,3 +171,69 @@ def test_timed_generations_progresses_state():
     assert [g for g, _, _ in states] == [0, 1, 2]
     assert int(states[-1][1]) == 3
     assert all(dt >= 0 for _, _, dt in states)
+
+
+def test_span_recorder_reservoir_turnover_past_bound():
+    """The percentile reservoir must be a uniform sample of the WHOLE
+    stream, not first-N truncation: samples recorded after the bound
+    must be able to displace early ones, while count/total/mean/max
+    stay exact."""
+    rec = SpanRecorder(max_samples=64, seed=7)
+    n = 2000
+    # a stream whose values equal their index: early = small values
+    for i in range(n):
+        rec.record("s", float(i))
+    agg = rec.aggregates()
+    assert agg["s"]["count"] == n
+    assert agg["s"]["total_s"] == float(sum(range(n)))
+    assert agg["s"]["mean_s"] == agg["s"]["total_s"] / n
+    # max is exact even if the reservoir evicted it
+    assert agg["s"]["max_s"] == float(n - 1)
+    bucket = rec._samples["s"]
+    assert len(bucket) == 64
+    # turnover: with first-N truncation every sample would be < 64;
+    # a uniform reservoir of 2000 values holds mostly post-bound ones
+    assert sum(1 for v in bucket if v >= 64) > 32
+    # p50 of a uniform sample over [0, 2000) sits near 1000 — under
+    # first-N truncation it would be ~32 (frozen forever)
+    assert 500 <= agg["s"]["p50_s"] <= 1500
+    assert agg["s"]["p99_s"] > 1500
+
+
+def test_span_recorder_reservoir_deterministic_per_seed():
+    def fill(seed):
+        rec = SpanRecorder(max_samples=16, seed=seed)
+        for i in range(500):
+            rec.record("x", float(i))
+        return list(rec._samples["x"])
+
+    assert fill(3) == fill(3)
+    assert fill(3) != fill(4)
+
+
+def test_span_recorder_below_bound_keeps_every_sample():
+    rec = SpanRecorder(max_samples=128)
+    for i in range(100):
+        rec.record("all", float(i))
+    assert rec._samples["all"] == [float(i) for i in range(100)]
+    agg = rec.aggregates()
+    assert agg["all"]["count"] == 100
+    assert agg["all"]["p99_s"] == 98.0  # index int(.99 * 99)
+    assert agg["all"]["max_s"] == 99.0
+
+
+def test_device_memory_snapshot(tmp_path):
+    from deap_tpu.support.profiling import (device_memory_snapshot,
+                                            live_buffer_bytes)
+
+    keep = jnp.ones((256, 256), jnp.float32)  # noqa: F841 (live buffer)
+    live = live_buffer_bytes()
+    assert sum(live.values()) >= keep.nbytes
+    path = str(tmp_path / "mem.pprof.gz")
+    snap = device_memory_snapshot(path)
+    assert snap["live_bytes"] == live or snap["live_bytes"]
+    # the pprof blob landed (or the backend said why)
+    if "profile_path" in snap:
+        assert os.path.getsize(path) == snap["profile_bytes"] > 0
+    else:
+        assert "profile_error" in snap

@@ -341,3 +341,42 @@ def test_read_journal_skips_malformed_lines(tmp_path):
         fh.write('{"kind": "summary"}\n')
     events = read_journal(path)
     assert [e["kind"] for e in events] == ["header", "summary"]
+
+
+def test_journal_timestamps_survive_wall_clock_step(tmp_path,
+                                                    monkeypatch):
+    """Row `t` deltas come from time.monotonic(): an NTP step (wall
+    clock jumping backwards mid-run) must never yield backwards or
+    negative `t`; the wall-clock epoch stays available in the header
+    as `wall_start`."""
+    import deap_tpu.telemetry.journal as journal_mod
+
+    path = str(tmp_path / "ntp.jsonl")
+    j = RunJournal(path)
+    j.header(init_backend=False)
+    j.event("before_step", i=0)
+
+    # the NTP step: wall clock jumps 1h into the past. monotonic is
+    # untouched (it cannot go backwards, by definition).
+    real_time = journal_mod.time.time
+
+    class _SteppedTime:
+        monotonic = staticmethod(journal_mod.time.monotonic)
+
+        @staticmethod
+        def time():
+            return real_time() - 3600.0
+
+    monkeypatch.setattr(journal_mod, "time", _SteppedTime)
+    j.event("after_step", i=1)
+    j.event("after_step", i=2)
+    j.close()
+
+    rows = read_journal(path)
+    ts = [e["t"] for e in rows]
+    assert all(t >= 0 for t in ts), f"negative t after NTP step: {ts}"
+    assert ts == sorted(ts), f"non-monotonic t after NTP step: {ts}"
+    header = rows[0]
+    assert header["kind"] == "header"
+    # wall_start documents the open's epoch (pre-step wall clock)
+    assert abs(header["wall_start"] - real_time()) < 120.0
