@@ -355,6 +355,71 @@ def serving_tripwire(gates=None) -> int:
     return tripped
 
 
+#: max service-vs-in-process wall overhead (percent) for the 1k-tenant
+#: socket run (bench.py --service, BENCH_SERVICE.json)
+SERVICE_OVERHEAD_PCT = 10.0
+
+
+def service_tripwire(max_overhead_pct: float = SERVICE_OVERHEAD_PCT
+                     ) -> int:
+    """The network-service gate (ISSUE 11). The latest
+    BENCH_SERVICE*.json must show (1) the 1k-tenant real-socket run
+    within ``max_overhead_pct`` of the same jobs through the Scheduler
+    in-process, (2) per-tenant results **bit-identical** across the
+    socket (equal wire digests for every tenant), and (3) the bursty
+    autoscaler-on run both *acting* (lane-changing
+    ``autoscale_decision`` events in its journal) and *helping*
+    (queue-wait p99 at or better than the autoscaler-off run).
+    Returns the number of tripped rows."""
+    files = sorted(glob.glob(os.path.join(HERE, "BENCH_SERVICE*.json")))
+    if not files:
+        print("service tripwire: no committed BENCH_SERVICE*.json yet")
+        return 0
+    rows = _bench_rows(files[-1])
+    print(f"\n## Network service ({os.path.basename(files[-1])})\n")
+    tripped = 0
+
+    ov = rows.get("service_vs_inprocess_overhead_pct")
+    if ov is not None and isinstance(ov.get("value"), (int, float)):
+        ok = ov["value"] <= max_overhead_pct
+        print(f"- socket-vs-inprocess overhead: {ov['value']:+.2f}% "
+              + ("ok" if ok else f"**REGRESSION** (> "
+                 f"{max_overhead_pct:.0f}% — the front end got "
+                 "expensive)"))
+        tripped += 0 if ok else 1
+    else:
+        print("- service overhead row missing")
+        tripped += 1
+
+    bit = rows.get("service_bit_identical")
+    if bit is not None and bit.get("value") is True:
+        print(f"- per-tenant wire digests: bit-identical over "
+              f"{bit.get('tenants_compared', '?')} tenants ok")
+    else:
+        print("- **REGRESSION**: service results are NOT bit-identical "
+              "to in-process (or the row is missing) — the transport "
+              "is changing numerics")
+        tripped += 1
+
+    imp = rows.get("service_autoscale_queue_wait_p99_improvement_x")
+    on = rows.get("service_autoscale_on_queue_wait_p99_s")
+    n_lane_moves = len((on or {}).get("lane_decisions") or [])
+    if imp is None or not isinstance(imp.get("value"), (int, float)):
+        print("- autoscale p99-improvement row missing")
+        tripped += 1
+    else:
+        ok = imp["value"] >= 1.0 and n_lane_moves >= 1
+        print(f"- autoscaler: p99 improvement {imp['value']}x with "
+              f"{n_lane_moves} lane decisions "
+              f"({imp.get('autoscale_decisions', '?')} total) "
+              + ("ok" if ok else "**REGRESSION** (the control loop "
+                 "stopped acting or stopped helping)"))
+        tripped += 0 if ok else 1
+    if len(files) >= 2:
+        tripped += _diff_rows(files[-2], files[-1], TRIPWIRE_THRESHOLD)
+    return tripped
+
+
 #: fractional full-observability overhead beyond which the costs pair
 #: trips (observatory + metrics + flight recorder vs bare segmented
 #: run, same session, pop=100k)
@@ -497,6 +562,7 @@ def tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
     tripped += resilience_tripwire()
     tripped += fusion_tripwire()
     tripped += serving_tripwire()
+    tripped += service_tripwire()
     tripped += mesh_tripwire()
     tripped += costs_tripwire()
     return tripped

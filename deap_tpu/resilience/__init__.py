@@ -16,6 +16,7 @@ same call resumes from the newest valid checkpoint with bit-identical
 results to an uninterrupted run.
 """
 
+from deap_tpu.resilience.drain import DrainSignal
 from deap_tpu.resilience.engine import (
     QUARANTINE_PENALTY,
     Preempted,
@@ -38,6 +39,7 @@ from deap_tpu.resilience.faultinject import (
 )
 
 __all__ = [
+    "DrainSignal",
     "QUARANTINE_PENALTY",
     "Preempted",
     "ResilientRun",

@@ -14,9 +14,16 @@ Two planes on top of the core loops (ROADMAP item 1):
   :func:`enable_compile_cache`), segment-cadence execution, and
   per-tenant eviction/resume with crash-consistent checkpoints as the
   swap unit.
+- :mod:`deap_tpu.serving.service` — the **network service plane**:
+  a stdlib HTTP/JSON front end (driver-thread queue handoff, bearer
+  auth + per-token quotas, NDJSON per-segment streaming, graceful
+  SIGTERM drain) with the :mod:`~deap_tpu.serving.autoscale` control
+  loop closing the SLO feedback path, and the stdlib
+  :mod:`~deap_tpu.serving.client`.
 
 See ``docs/advanced/serving.md`` for the job model, the bucket
-lattice, eviction semantics and the bit-identity contract.
+lattice, eviction semantics, the bit-identity contract and the
+service wire protocol.
 """
 
 from deap_tpu.serving.multirun import FAMILIES, MultiRunEngine, multirun
@@ -26,14 +33,32 @@ from deap_tpu.serving.tenant import (
     bucket_key,
     pad_pow2,
 )
-from deap_tpu.serving.scheduler import Scheduler, prewarm
+from deap_tpu.serving.scheduler import (
+    Scheduler,
+    SchedulerBusyError,
+    prewarm,
+)
+from deap_tpu.serving.autoscale import (
+    AutoscaleConfig,
+    AutoscaleDecision,
+    AutoscalePolicy,
+)
+from deap_tpu.serving.service import EvolutionService
+from deap_tpu.serving.client import ServiceClient, ServiceError
 from deap_tpu.support.compilecache import enable_compile_cache
 
 __all__ = [
+    "AutoscaleConfig",
+    "AutoscaleDecision",
+    "AutoscalePolicy",
+    "EvolutionService",
     "FAMILIES",
     "Job",
     "MultiRunEngine",
     "Scheduler",
+    "SchedulerBusyError",
+    "ServiceClient",
+    "ServiceError",
     "Tenant",
     "bucket_key",
     "enable_compile_cache",

@@ -1,0 +1,237 @@
+"""Stdlib service client — submit/stream/fetch against the RPC front end.
+
+The consumer half of :mod:`deap_tpu.serving.service`'s wire protocol.
+Like :mod:`~deap_tpu.telemetry.metrics` and ``telemetry/report.py``,
+this module imports **nothing heavier than numpy** (for the byte-exact
+array codec in :mod:`~deap_tpu.serving.wire`): a box that submits jobs
+and reads results must never initialise an XLA backend. One client per
+thread — it holds a single keep-alive ``http.client`` connection.
+
+::
+
+    from deap_tpu.serving.client import ServiceClient
+
+    c = ServiceClient(service_url, token="s3cret")
+    tid = c.submit("onemax", params={"seed": 7, "ngen": 40})
+    for ev in c.stream(tid):          # NDJSON per-segment events
+        print(ev["event"], ev.get("gen"))
+    res = c.result(tid, wait=True)    # wire-encoded result pytree
+    leaves = c.decode_leaves(res)     # numpy arrays, byte-exact
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import sys
+import urllib.parse
+from typing import Any, Dict, Iterator, List, Optional
+
+if "deap_tpu" in sys.modules:
+    from deap_tpu.serving import wire
+else:
+    # standalone load (no deap_tpu in the process — e.g. a submit box
+    # that must never initialise jax): pull the codec in by file path
+    # instead of importing the package, whose __init__ imports jax.
+    # tests/test_service.py pins the no-jax guarantee in a subprocess.
+    import importlib.util as _ilu
+    import os as _os
+
+    _spec = _ilu.spec_from_file_location(
+        "_deap_tpu_serving_wire_standalone",
+        _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                      "wire.py"))
+    wire = _ilu.module_from_spec(_spec)
+    _spec.loader.exec_module(wire)
+
+__all__ = ["ServiceClient", "ServiceError"]
+
+
+class ServiceError(RuntimeError):
+    """A non-2xx response from the service (``.code`` holds the HTTP
+    status; 401/403 auth, 404 unknown, 429 quota, 503 draining)."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(f"HTTP {code}: {message}")
+        self.code = code
+
+
+class ServiceClient:
+    def __init__(self, base_url: str, token: Optional[str] = None,
+                 timeout: float = 600.0):
+        u = urllib.parse.urlparse(base_url)
+        self.host = u.hostname or "127.0.0.1"
+        self.port = u.port or 80
+        self.token = token
+        self.timeout = timeout
+        self._conn: Optional[http.client.HTTPConnection] = None
+
+    # ------------------------------------------------------- plumbing ----
+
+    def _headers(self) -> Dict[str, str]:
+        h = {"Content-Type": "application/json"}
+        if self.token:
+            h["Authorization"] = f"Bearer {self.token}"
+        return h
+
+    def _connect(self) -> http.client.HTTPConnection:
+        if self._conn is None:
+            self._conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout)
+        return self._conn
+
+    def _request(self, method: str, path: str,
+                 body: Optional[dict] = None) -> Any:
+        conn = self._connect()
+        try:
+            conn.request(method, path,
+                         body=(json.dumps(body).encode()
+                               if body is not None else None),
+                         headers=self._headers())
+            resp = conn.getresponse()
+            data = resp.read()
+        except (http.client.HTTPException, ConnectionError, OSError):
+            # stale keep-alive (server restarted): one reconnect
+            self.close()
+            conn = self._connect()
+            conn.request(method, path,
+                         body=(json.dumps(body).encode()
+                               if body is not None else None),
+                         headers=self._headers())
+            resp = conn.getresponse()
+            data = resp.read()
+        try:
+            payload = json.loads(data) if data else {}
+        except json.JSONDecodeError:
+            payload = {"error": data.decode("utf-8", "replace")[:200]}
+        if resp.status >= 400:
+            raise ServiceError(resp.status,
+                               payload.get("error", resp.reason))
+        payload["_status"] = resp.status
+        return payload
+
+    def close(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            finally:
+                self._conn = None
+
+    def __enter__(self) -> "ServiceClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------ API ----
+
+    def healthz(self) -> Dict[str, Any]:
+        try:
+            return self._request("GET", "/healthz")
+        except ServiceError as e:
+            if e.code == 503:
+                return {"status": "draining", "_status": 503}
+            raise
+
+    def metrics_text(self) -> str:
+        conn = self._connect()
+        conn.request("GET", "/metrics", headers=self._headers())
+        resp = conn.getresponse()
+        body = resp.read().decode("utf-8")
+        if resp.status >= 400:
+            raise ServiceError(resp.status, body[:200])
+        return body
+
+    def submit(self, problem: str, params: Optional[dict] = None,
+               tenant_id: Optional[str] = None) -> str:
+        body: Dict[str, Any] = {"problem": problem,
+                                "params": params or {}}
+        if tenant_id is not None:
+            body["tenant_id"] = str(tenant_id)
+        return self._request("POST", "/v1/jobs", body)["tenant_id"]
+
+    def submit_many(self, jobs: List[dict]) -> List[str]:
+        """Batch submit: ``jobs`` is a list of
+        ``{"problem", "params", "tenant_id"?}`` specs; one HTTP round
+        trip, returns the tenant ids in order."""
+        return self._request("POST", "/v1/jobs",
+                             {"jobs": jobs})["tenant_ids"]
+
+    def results_many(self, tenant_ids: List[str], wait: bool = True,
+                     timeout: Optional[float] = None
+                     ) -> Dict[str, Dict[str, Any]]:
+        """Batch result fetch: ``{tenant_id: status-dict}`` (each with
+        ``result`` once finished); with ``wait`` the long-poll
+        deadline is shared across the batch."""
+        ids = ",".join(urllib.parse.quote(t) for t in tenant_ids)
+        path = f"/v1/results?ids={ids}"
+        if wait:
+            t = timeout if timeout is not None else self.timeout
+            path += f"&wait=1&timeout={t}"
+        return self._request("GET", path)["results"]
+
+    def status(self, tenant_id: str) -> Dict[str, Any]:
+        return self._request(
+            "GET", f"/v1/jobs/{urllib.parse.quote(tenant_id)}")
+
+    def result(self, tenant_id: str, wait: bool = True,
+               timeout: Optional[float] = None) -> Dict[str, Any]:
+        """The job's status dict; once finished it carries ``result``
+        (the wire-encoded pytree: ``treedef``/``leaves``/``digest``).
+        ``wait=True`` long-polls until done/drained."""
+        path = f"/v1/jobs/{urllib.parse.quote(tenant_id)}/result"
+        if wait:
+            t = timeout if timeout is not None else self.timeout
+            path += f"?wait=1&timeout={t}"
+        return self._request("GET", path)
+
+    def drain(self) -> Dict[str, Any]:
+        return self._request("POST", "/v1/drain")
+
+    def stream(self, tenant_id: str) -> Iterator[Dict[str, Any]]:
+        """Yield the job's NDJSON events (``status`` → ``segment``* →
+        terminal ``finished``/``stopped``/``drained``) as dicts. Uses
+        a dedicated connection (the stream holds it until the
+        terminal event)."""
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout)
+        try:
+            conn.request(
+                "GET",
+                f"/v1/jobs/{urllib.parse.quote(tenant_id)}/stream",
+                headers=self._headers())
+            resp = conn.getresponse()
+            if resp.status >= 400:
+                body = resp.read()
+                try:
+                    msg = json.loads(body).get("error", "")
+                except json.JSONDecodeError:
+                    msg = body.decode("utf-8", "replace")[:200]
+                raise ServiceError(resp.status, msg)
+            buf = b""
+            while True:
+                chunk = resp.read1(65536)
+                if not chunk:
+                    break
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if line.strip():
+                        yield json.loads(line)
+        finally:
+            conn.close()
+
+    # ------------------------------------------------------- decoding ----
+
+    @staticmethod
+    def decode_leaves(result_payload: Dict[str, Any]) -> List[Any]:
+        """The byte-exact numpy leaves of a :meth:`result` payload."""
+        return [wire.unpack(leaf)
+                for leaf in result_payload["result"]["leaves"]]
+
+    @staticmethod
+    def decode_records(segment_event: Dict[str, Any]) -> Any:
+        """Decode a ``segment`` stream event's ``records`` block back
+        into numpy arrays (``None`` when the segment carried none)."""
+        rec = segment_event.get("records")
+        return None if rec is None else wire.unpack(rec)

@@ -24,15 +24,24 @@ read (``bench.py --coldstart``).
 
 Single-device, single-thread by design — the loop is a *cadence*, not
 a server; an RPC front end calls :meth:`Scheduler.submit` /
-:meth:`Scheduler.step` on its own schedule. Every future scaling PR
-(mesh sharding, TPU relay windows) slots in below ``advance``.
+:meth:`Scheduler.step` on its own schedule, **from one thread**. The
+scheduler is guarded, not locked: concurrent entry from a second
+thread raises :class:`SchedulerBusyError` instead of corrupting bucket
+state, and once a front end declares its driver thread
+(:meth:`Scheduler.bind_driver` — the
+:class:`~deap_tpu.serving.service.EvolutionService` queue-handoff
+contract), any mutating call from another thread is rejected outright.
+Every future scaling PR (mesh sharding, TPU relay windows) slots in
+below ``advance``.
 """
 
 from __future__ import annotations
 
+import contextlib
 import os
+import threading
 import time
-from typing import Any, Dict, Iterable, List, Optional, Sequence
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
 
@@ -44,13 +53,24 @@ from deap_tpu.telemetry.metrics import (MetricsServer, resolve_registry,
                                         serve_metrics)
 from deap_tpu.telemetry.run import RunTelemetry
 
-__all__ = ["Scheduler", "prewarm"]
+__all__ = ["Scheduler", "SchedulerBusyError", "prewarm"]
+
+
+class SchedulerBusyError(RuntimeError):
+    """A mutating :class:`Scheduler` call entered from a second thread
+    while another call was in flight (or from a non-driver thread after
+    :meth:`Scheduler.bind_driver`). The scheduler's bucket state is a
+    single-threaded data structure by contract — raising here is what
+    keeps a misbehaving front end from corrupting it. Route the call
+    through the owning driver thread (the
+    :class:`~deap_tpu.serving.service.EvolutionService` command queue
+    is exactly that handoff)."""
 
 
 class _Bucket:
     """One shape bucket: its engine, admission queue and residency."""
 
-    def __init__(self, key, engine: MultiRunEngine):
+    def __init__(self, key, engine: MultiRunEngine, max_lanes: int):
         self.key = key
         # the bucket's metric/journal label: family + program digest —
         # short, stable, and readable on a Grafana legend
@@ -60,6 +80,9 @@ class _Bucket:
         self.residents: List[Tenant] = []
         self.batch: Optional[Dict[str, Any]] = None
         self.horizon = 1
+        # per-bucket lane budget — the autoscaler's actuator
+        # (pad_pow2'd; starts at the scheduler default)
+        self.max_lanes = int(max_lanes)
 
     @property
     def runnable(self) -> bool:
@@ -151,7 +174,9 @@ class Scheduler:
                  telemetry: bool = True,
                  compile_cache: Optional[str] = None,
                  journal_fsync_every: Optional[int] = None,
-                 metrics=True):
+                 metrics=True,
+                 resume_tenants: bool = False,
+                 boundary_cb: Optional[Callable] = None):
         self.root = str(root)
         os.makedirs(self.root, exist_ok=True)
         if compile_cache:
@@ -161,6 +186,15 @@ class Scheduler:
         self.fair_quantum = fair_quantum
         self.checkpoint_every = checkpoint_every
         self.telemetry = bool(telemetry)
+        #: re-admit a tenant id whose run dir already holds a
+        #: checkpoint by RESUMING it (the restart half of a service
+        #: drain) instead of starting from generation 0
+        self.resume_tenants = bool(resume_tenants)
+        #: optional host hook called at every segment boundary with
+        #: ``(bucket_label, updates)`` where updates is a list of
+        #: per-tenant dicts (tenant, gen_before, gen, chunk, finished)
+        #: — the service's streaming fan-out point
+        self.boundary_cb = boundary_cb
         from deap_tpu.telemetry.journal import RunJournal
         self.journal = RunJournal(
             os.path.join(self.root, "journal.jsonl"),
@@ -173,12 +207,57 @@ class Scheduler:
         self.tenants: Dict[str, Tenant] = {}
         self._boundaries = 0
         self._rr: List[Any] = []  # round-robin bucket order
+        self._spill: set = set()  # tenant ids to swap out at the
+        #                           next boundary (autoscaler pressure)
+        # single-threaded-contract guard: RLock so the owner re-enters
+        # (run → step), non-blocking so a second thread gets a loud
+        # SchedulerBusyError instead of silently corrupted buckets
+        self._guard = threading.RLock()
+        self._driver_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------ thread contract ----
+
+    def bind_driver(self,
+                    thread: Optional[threading.Thread] = None) -> None:
+        """Declare ``thread`` (default: the calling thread) the owner:
+        from now on every mutating call from any OTHER thread raises
+        :class:`SchedulerBusyError` immediately — the lock-owner
+        assertion behind the service's queue-handoff contract."""
+        self._driver_thread = thread or threading.current_thread()
+
+    @contextlib.contextmanager
+    def _exclusive(self, op: str):
+        cur = threading.current_thread()
+        if self._driver_thread is not None and \
+                cur is not self._driver_thread:
+            raise SchedulerBusyError(
+                f"Scheduler.{op} called from thread {cur.name!r} but "
+                f"the scheduler is bound to driver thread "
+                f"{self._driver_thread.name!r}; enqueue the request to "
+                "the driver instead (see serving/service.py)")
+        if not self._guard.acquire(blocking=False):
+            raise SchedulerBusyError(
+                f"Scheduler.{op} called concurrently from thread "
+                f"{cur.name!r} while another scheduler call is in "
+                "flight; the scheduler is single-threaded by contract "
+                "— serialise calls through one driver thread")
+        try:
+            yield
+        finally:
+            self._guard.release()
 
     # -------------------------------------------------------- admission ----
 
     def submit(self, job: Job) -> str:
         """Queue a job; returns its tenant id. Jobs with equal bucket
-        keys share one compiled program (see :func:`bucket_key`)."""
+        keys share one compiled program (see :func:`bucket_key`).
+        Single-threaded by contract: a concurrent call from a second
+        thread raises :class:`SchedulerBusyError` (see
+        :meth:`bind_driver`)."""
+        with self._exclusive("submit"):
+            return self._submit(job)
+
+    def _submit(self, job: Job) -> str:
         if job.tenant_id in self.tenants:
             raise ValueError(f"tenant id {job.tenant_id!r} already "
                              "submitted")
@@ -188,10 +267,16 @@ class Scheduler:
         bkey = bucket_key(job)
         bucket = self.buckets.get(bkey)
         if bucket is None:
-            bucket = _Bucket(bkey, self._make_engine(job))
+            bucket = _Bucket(bkey, self._make_engine(job),
+                             self.max_lanes)
             self.buckets[bkey] = bucket
             self._rr.append(bkey)
         tenant = Tenant(job, self.root)
+        if self.resume_tenants and tenant.probe_checkpoint():
+            # the restart half of a service drain: this tenant id left
+            # a tenant-stamped checkpoint behind — admission resumes it
+            self.journal.event("tenant_checkpoint_found",
+                               tenant_id=tenant.id)
         self.tenants[tenant.id] = tenant
         bucket.queue.append(tenant)
         bucket.horizon = max(bucket.horizon, pad_pow2(int(job.ngen)))
@@ -227,6 +312,11 @@ class Scheduler:
         enabled this is a disk read after the first process. Journals
         one ``prewarm`` event per (bucket, lane-count); returns the
         number of programs warmed."""
+        with self._exclusive("prewarm"):
+            return self._prewarm(jobs, lane_counts)
+
+    def _prewarm(self, jobs: Iterable[Job],
+                 lane_counts: Optional[Sequence[int]] = None) -> int:
         counts = (tuple(int(c) for c in lane_counts) if lane_counts
                   else (pad_pow2(self.max_lanes),))
         warmed = 0
@@ -238,7 +328,8 @@ class Scheduler:
             seen.add(bkey)
             bucket = self.buckets.get(bkey)
             if bucket is None:
-                bucket = _Bucket(bkey, self._make_engine(job))
+                bucket = _Bucket(bkey, self._make_engine(job),
+                                 self.max_lanes)
                 self.buckets[bkey] = bucket
                 self._rr.append(bkey)
             horizon = pad_pow2(int(job.ngen))
@@ -270,16 +361,24 @@ class Scheduler:
         (round-robin), ensure its batch is packed (admitting /
         resuming / evicting at this boundary), advance one segment,
         drain the boundary. Returns False when nothing is runnable."""
-        bucket = self._next_bucket()
-        if bucket is None:
-            return False
-        self._repack(bucket)
-        t0 = time.perf_counter()
-        batch, seg = bucket.engine.advance(bucket.batch,
-                                           self.segment_len)
-        bucket.batch = batch
-        self._drain_boundary(bucket, seg, t_start=t0)
-        return True
+        with self._exclusive("step"):
+            bucket = self._next_bucket()
+            if bucket is None:
+                return False
+            self._repack(bucket)
+            if not bucket.residents:
+                return True  # everything spilled; next round readmits
+            t0 = time.perf_counter()
+            batch, seg = bucket.engine.advance(bucket.batch,
+                                               self.segment_len)
+            bucket.batch = batch
+            self._drain_boundary(bucket, seg, t_start=t0)
+            return True
+
+    @property
+    def runnable(self) -> bool:
+        """Any bucket has queued or resident tenants left."""
+        return any(b.runnable for b in self.buckets.values())
 
     def run(self, max_steps: Optional[int] = None) -> Dict[str, tuple]:
         """Drive :meth:`step` until every submitted job finished (or
@@ -330,16 +429,45 @@ class Scheduler:
                 return self.buckets[bkey]
         return None
 
+    def _evict(self, bucket: _Bucket, t: Tenant, reason: str) -> None:
+        path = t.checkpoint(bucket.engine)
+        self.journal.event("tenant_evicted", tenant_id=t.id, gen=t.gen,
+                           path=path, reason=reason)
+        t.evict()
+        bucket.residents.remove(t)
+        bucket.queue.append(t)
+        if self._minst is not None:
+            self._minst.evictions.inc(bucket=bucket.label)
+
     def _repack(self, bucket: _Bucket) -> None:
-        """Boundary admission control: evict over-quantum residents
-        when jobs queue, fill free lanes from the queue, and (re)pack
-        the batch only when residency changed."""
+        """Boundary admission control: spill requested/surplus
+        residents, evict over-quantum residents when jobs queue, fill
+        free lanes from the queue, and (re)pack the batch only when
+        residency changed."""
         eng = bucket.engine
         changed = bucket.batch is None
 
+        # requested spills (autoscaler pressure relief) — checkpoint
+        # and park regardless of the fairness quantum
+        if self._spill:
+            for t in [t for t in bucket.residents
+                      if t.id in self._spill]:
+                self._evict(bucket, t, reason="spill")
+                self._spill.discard(t.id)
+                changed = True
+
+        # lane-budget shrink (autoscaler scale-down): surplus
+        # residents swap out, longest-resident first
+        over = len(bucket.residents) - bucket.max_lanes
+        if over > 0:
+            for t in sorted(bucket.residents,
+                            key=lambda t: -t.segments_resident)[:over]:
+                self._evict(bucket, t, reason="scale_down")
+                changed = True
+
         # eviction — only under contention, only past the quantum
         if bucket.queue and self.fair_quantum is not None:
-            free = self.max_lanes - len(bucket.residents)
+            free = bucket.max_lanes - len(bucket.residents)
             want = len(bucket.queue) - free
             if want > 0:
                 victims = sorted(
@@ -347,35 +475,31 @@ class Scheduler:
                      if t.segments_resident >= self.fair_quantum),
                     key=lambda t: -t.segments_resident)[:want]
                 for t in victims:
-                    path = t.checkpoint(eng)
-                    self.journal.event(
-                        "tenant_evicted", tenant_id=t.id, gen=t.gen,
-                        path=path)
-                    t.evict()
-                    bucket.residents.remove(t)
-                    bucket.queue.append(t)
+                    self._evict(bucket, t, reason="fair_quantum")
                     changed = True
-                    if self._minst is not None:
-                        self._minst.evictions.inc(bucket=bucket.label)
 
         # admission — resume from checkpoint or fresh-init
-        while bucket.queue and len(bucket.residents) < self.max_lanes:
+        while bucket.queue and len(bucket.residents) < bucket.max_lanes:
             t = bucket.queue.pop(0)
+            # the queue-wait SLO sample: exact seconds in the journal
+            # row (bucket-resolution in the Prometheus histogram)
+            wait_s = max(0.0, time.monotonic() - t.enqueued_at)
             if self._minst is not None:
-                self._minst.queue_wait_s.observe(
-                    max(0.0, time.monotonic() - t.enqueued_at),
-                    bucket=bucket.label)
+                self._minst.queue_wait_s.observe(wait_s,
+                                                 bucket=bucket.label)
             if t.has_checkpoint:
                 t.restore(eng)
                 self.journal.event("tenant_resumed", tenant_id=t.id,
-                                   gen=t.gen)
+                                   gen=t.gen,
+                                   wait_s=round(wait_s, 4))
                 if self._minst is not None:
                     self._minst.resumes.inc(bucket=bucket.label)
             else:
                 t.lane = eng.lane_init(t.job.key, t.job.init,
                                        t.job.ngen, t.job.hyper)
                 self.journal.event("tenant_admitted", tenant_id=t.id,
-                                   ngen=int(t.job.ngen))
+                                   ngen=int(t.job.ngen),
+                                   wait_s=round(wait_s, 4))
                 if self._minst is not None:
                     self._minst.admissions.inc(bucket=bucket.label)
                 for row in eng.lane_meter_rows((), 0, lane=t.lane):
@@ -388,7 +512,7 @@ class Scheduler:
             self._minst.queue_depth.set(len(bucket.queue),
                                         bucket=bucket.label)
             self._minst.occupancy.set(
-                len(bucket.residents) / self.max_lanes,
+                len(bucket.residents) / bucket.max_lanes,
                 bucket=bucket.label)
 
         if changed and bucket.residents:
@@ -397,7 +521,7 @@ class Scheduler:
                 t.slot = slot
                 lanes.append(t.lane)
             bucket.batch = eng.pack(
-                lanes, n_lanes=pad_pow2(len(lanes), self.max_lanes),
+                lanes, n_lanes=pad_pow2(len(lanes), bucket.max_lanes),
                 horizon=bucket.horizon)
 
     def _journal_row(self, tenant: Tenant, row: dict) -> None:
@@ -423,6 +547,7 @@ class Scheduler:
                  if t_start is not None else None)
         gens_advanced = 0
         finished: List[Tenant] = []
+        updates: List[Dict[str, Any]] = []
         for t in list(bucket.residents):
             i = t.slot
             gen_before = t.gen
@@ -459,6 +584,9 @@ class Scheduler:
             elif self.checkpoint_every and \
                     self._boundaries % self.checkpoint_every == 0:
                 t.checkpoint(eng)
+            updates.append({"tenant": t, "gen_before": gen_before,
+                            "gen": t.gen, "chunk": chunk,
+                            "finished": t in finished})
         if finished:
             for t in finished:
                 bucket.residents.remove(t)
@@ -472,7 +600,7 @@ class Scheduler:
             finished=[t.id for t in finished])
         # the boundary's SLO sample: one journal row (the report's
         # scheduler-SLO timeline) and the Prometheus instruments
-        occupancy = len(bucket.residents) / self.max_lanes
+        occupancy = len(bucket.residents) / bucket.max_lanes
         slo: Dict[str, Any] = {
             "bucket": bucket.label, "lanes": int(len(gens)),
             "residents": len(bucket.residents),
@@ -492,6 +620,81 @@ class Scheduler:
             self._minst.queue_depth.set(len(bucket.queue),
                                         bucket=bucket.label)
             self._minst.occupancy.set(occupancy, bucket=bucket.label)
+        if self.boundary_cb is not None:
+            self.boundary_cb(bucket.label, updates)
+
+    # ----------------------------------------- control-plane surface ----
+    # (the autoscaler's sensors and actuators, and the drain hook —
+    # all single-threaded: call from the driver thread only)
+
+    def _bucket_by(self, which) -> _Bucket:
+        if which in self.buckets:
+            return self.buckets[which]
+        for b in self.buckets.values():
+            if b.label == which:
+                return b
+        raise KeyError(f"no bucket {which!r}")
+
+    def set_bucket_lanes(self, which, n_lanes: int) -> int:
+        """Set one bucket's lane budget (pad_pow2'd, >= 1) — the
+        autoscaler's actuator. Growing takes effect at the next
+        boundary's admission; shrinking below current residency swaps
+        the surplus out (checkpoint as swap unit, ``scale_down``
+        eviction reason). Returns the applied (padded) count."""
+        with self._exclusive("set_bucket_lanes"):
+            bucket = self._bucket_by(which)
+            bucket.max_lanes = pad_pow2(max(1, int(n_lanes)))
+            return bucket.max_lanes
+
+    def request_spill(self, tenant_id: str) -> None:
+        """Mark a resident tenant for swap-out at the next boundary of
+        its bucket (checkpoint → queue tail), regardless of the
+        fairness quantum — the autoscaler's pressure-relief actuator."""
+        with self._exclusive("request_spill"):
+            if tenant_id not in self.tenants:
+                raise KeyError(f"unknown tenant {tenant_id!r}")
+            self._spill.add(tenant_id)
+
+    def slo_snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Per-bucket control-plane sensor read: queue depth, lane
+        budget/residency/occupancy, queue-wait p99 (bucket-resolution,
+        from the metrics histogram when enabled) and the resident
+        tenants' ``(id, segments_resident)`` idle candidates — exactly
+        the inputs :class:`deap_tpu.serving.autoscale.AutoscalePolicy`
+        decides on."""
+        with self._exclusive("slo_snapshot"):
+            snap: Dict[str, Dict[str, Any]] = {}
+            for b in self.buckets.values():
+                wait_p99 = None
+                if self._minst is not None:
+                    wait_p99 = self._minst.queue_wait_s.quantile(
+                        0.99, bucket=b.label)
+                snap[b.label] = {
+                    "queue_depth": len(b.queue),
+                    "residents": len(b.residents),
+                    "lanes": b.max_lanes,
+                    "occupancy": len(b.residents) / b.max_lanes,
+                    "queue_wait_p99": wait_p99,
+                    "idle": tuple((t.id, t.segments_resident)
+                                  for t in b.residents),
+                }
+            return snap
+
+    def checkpoint_all(self) -> List[str]:
+        """Checkpoint every resident tenant (tenant-stamped v2/v3
+        meta) — the graceful-drain hook: after the in-flight segment
+        finished, this persists every running tenant so a restarted
+        scheduler (``resume_tenants=True``) resumes them bit-exactly.
+        Queued-never-started tenants need no checkpoint (a fresh
+        admission is deterministic). Returns the checkpointed tenant
+        ids."""
+        with self._exclusive("checkpoint_all"):
+            saved = []
+            for b in self.buckets.values():
+                for t in b.residents:
+                    t.checkpoint(b.engine)
+                    saved.append(t.id)
+            return saved
 
 
 def prewarm(scheduler: Scheduler, jobs: Iterable[Job],

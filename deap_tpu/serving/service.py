@@ -1,0 +1,812 @@
+"""Network service plane — the RPC front end over the ask-tell scheduler.
+
+PR 7 built the multi-tenant :class:`~deap_tpu.serving.scheduler.
+Scheduler` and PR 9 gave it SLO instruments, but submit/result still
+meant calling Python methods in-process. This module is the missing
+half of the "millions of users" story (ROADMAP item 1): a
+**stdlib-only** HTTP front end (``http.server.ThreadingHTTPServer`` +
+JSON — no new dependency) that serves evolution as a network service,
+with an autoscaling control loop closing the SLO feedback path and a
+graceful drain that reuses the resilience plane's checkpoint machinery.
+
+**The queue handoff.** The scheduler is a single-threaded data
+structure by contract (:class:`~deap_tpu.serving.scheduler.
+SchedulerBusyError`); an HTTP server is many threads by construction.
+The service resolves this with one **driver thread** that owns the
+scheduler outright (``Scheduler.bind_driver``): front-end request
+threads never touch it — they enqueue commands onto a
+``queue.Queue`` and read a driver-maintained **mirror** of job state
+(status/gen/result, updated only by the driver, read under a lock).
+Submissions round-trip through the queue (the reply carries the tenant
+id); status/result/stream reads are pure mirror reads. The scheduler
+therefore runs exactly as it does in-process — same admission order,
+same segment cadence — which is what makes the service's per-tenant
+results **bit-identical** to in-process runs (``bench.py --service``
+gates on the wire digest).
+
+**The wire protocol** (all JSON; newline-delimited on streams):
+
+====================================  =================================
+``POST /v1/jobs``                     submit ``{"problem", "params",
+                                      "tenant_id"?}`` → ``{"tenant_id"}``
+``GET /v1/jobs/<id>``                 status ``{"status", "gen", "ngen"}``
+``GET /v1/jobs/<id>/result[?wait=1]`` the wire-encoded result pytree
+                                      (``serving.wire``: byte-exact
+                                      arrays + digest)
+``GET /v1/jobs/<id>/stream``          NDJSON per-segment events until a
+                                      terminal event
+``GET /healthz``                      liveness (``ok`` / ``draining``)
+``GET /metrics``                      the scheduler's Prometheus
+                                      registry (same text as
+                                      ``serve_metrics`` — one port
+                                      serves both planes)
+``POST /v1/drain``                    begin graceful drain
+====================================  =================================
+
+**Problems, not pickles.** A network client cannot ship a toolbox;
+the server is constructed with a registry of named **problem
+factories** (``problems={"onemax": factory}``), each mapping a params
+dict to a :class:`~deap_tpu.serving.tenant.Job`. Clients submit
+``(problem, params)``; the server owns the program. Equal factories →
+equal bucket keys → shared compiled programs across tenants, exactly
+as in-process.
+
+**Auth & quotas.** ``tokens={token: {"tenant": name, "max_jobs": n}}``
+enables bearer-token auth: requests carry ``Authorization: Bearer
+<token>``; a token sees only its own jobs; ``max_jobs`` bounds its
+in-flight jobs (HTTP 429 past it). Rejections journal an
+``auth_rejected`` event. *Within* the scheduler, fairness between
+admitted tenants stays the existing ``fair_quantum`` eviction — quotas
+bound admission, the quantum bounds residency.
+
+**Autoscaling.** Every driver iteration (``autoscale_every``-th) reads
+``Scheduler.slo_snapshot()`` (queue depth, queue-wait p99, occupancy —
+the PR 9 instruments) into an :class:`~deap_tpu.serving.autoscale.
+AutoscalePolicy`; applied decisions — lane-budget changes
+(``set_bucket_lanes``), predicted-lattice prewarms
+(``Scheduler.prewarm`` under the persistent compile cache) and
+pressure spills (``request_spill``) — each journal an
+``autoscale_decision`` event.
+
+**Graceful drain.** On SIGTERM (:class:`deap_tpu.resilience.drain.
+DrainSignal` — the resilience plane's signal pattern) or
+``POST /v1/drain``: new submissions get 503, the in-flight segment
+finishes, every resident tenant is checkpointed (tenant-stamped meta —
+``Scheduler.checkpoint_all``), a ``service_drain`` event is journaled,
+streams receive a terminal ``drained`` event, and the process may
+exit. A new service over the same root resumes every drained tenant
+bit-exactly on resubmission (``Scheduler(resume_tenants=True)``) —
+pinned against an uninterrupted run by ``tests/test_service.py``.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import queue
+import threading
+import time
+import urllib.parse
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from deap_tpu.serving import wire
+from deap_tpu.serving.autoscale import AutoscaleConfig, AutoscalePolicy
+from deap_tpu.serving.scheduler import Scheduler
+from deap_tpu.serving.tenant import Job, bucket_key
+
+__all__ = ["EvolutionService", "SERVICE_JOURNAL_KINDS"]
+
+#: journal kinds this module writes (documented in the
+#: docs/advanced/telemetry.md kind table; drift-gated by
+#: tests/test_service.py)
+SERVICE_JOURNAL_KINDS = ("service_request", "service_drain",
+                         "autoscale_decision", "auth_rejected")
+
+
+class _HttpError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+class _JobView:
+    """The driver-maintained mirror of one job, readable by any
+    front-end thread under the service lock. The driver writes; HTTP
+    threads read — never the scheduler's own Tenant objects. The
+    result is held raw and wire-encoded **lazily on the requesting
+    thread** (cached), so a thousand finishing tenants never serialise
+    base64 on the driver's critical path."""
+
+    __slots__ = ("tenant_id", "problem", "token", "status", "gen",
+                 "ngen", "error", "done", "_raw", "_encoded",
+                 "_enc_lock")
+
+    def __init__(self, tenant_id: str, problem: str, token: str):
+        self.tenant_id = tenant_id
+        self.problem = problem
+        self.token = token
+        self.status = "submitted"
+        self.gen = 0
+        self.ngen: Optional[int] = None
+        self.error: Optional[str] = None
+        self.done = threading.Event()
+        self._raw: Any = None
+        self._encoded: Optional[Dict[str, Any]] = None
+        self._enc_lock = threading.Lock()
+
+    def set_result(self, raw: Any) -> None:
+        self._raw = raw
+
+    def result_payload(self) -> Optional[Dict[str, Any]]:
+        if self._raw is None:
+            return None
+        with self._enc_lock:
+            if self._encoded is None:
+                self._encoded = wire.pack_result(self._raw)
+            return self._encoded
+
+    def as_dict(self) -> Dict[str, Any]:
+        out = {"tenant_id": self.tenant_id, "problem": self.problem,
+               "status": self.status, "gen": self.gen,
+               "ngen": self.ngen}
+        if self.error is not None:
+            out["error"] = self.error
+        return out
+
+
+class EvolutionService:
+    """Serve a :class:`Scheduler` over a loopback/LAN socket.
+
+    :param root: scheduler root (journal + per-tenant run dirs); a
+        restarted service over the same root resumes drained tenants.
+    :param problems: ``{name: factory}`` where
+        ``factory(tenant_id, params) -> Job`` builds the job
+        server-side (the factory owns toolbox/key/init construction,
+        so identical submissions are bit-reproducible).
+    :param tokens: ``{token: {"tenant": str, "max_jobs": int|None}}``
+        bearer auth + per-token in-flight quota; ``None`` = open.
+    :param autoscale: ``True`` (default policy) /
+        :class:`AutoscalePolicy` / ``None`` (off).
+    :param autoscale_every: driver steps between autoscale ticks.
+    :param step_hook: optional ``hook(step_count)`` run on the driver
+        thread after every scheduler step — the deterministic
+        fault-injection seam (drain-mid-segment tests, bursty-load
+        generators) in the spirit of ``resilience/faultinject.py``.
+    :param scheduler_kwargs: forwarded to :class:`Scheduler`
+        (``max_lanes``, ``segment_len``, ``fair_quantum``,
+        ``metrics``, ``compile_cache``, …).
+    """
+
+    def __init__(self, root: str,
+                 problems: Dict[str, Callable[[str, dict], Job]], *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 tokens: Optional[Dict[str, dict]] = None,
+                 autoscale=None, autoscale_every: int = 1,
+                 step_hook: Optional[Callable[[int], None]] = None,
+                 **scheduler_kwargs):
+        self.root = str(root)
+        self.problems = dict(problems)
+        self.tokens = dict(tokens) if tokens else None
+        if autoscale is True:
+            autoscale = AutoscalePolicy(AutoscaleConfig())
+        self.policy: Optional[AutoscalePolicy] = autoscale or None
+        self.autoscale_every = max(1, int(autoscale_every))
+        self.step_hook = step_hook
+        scheduler_kwargs.setdefault("resume_tenants", True)
+        self.scheduler = Scheduler(self.root,
+                                   boundary_cb=self._on_boundary,
+                                   **scheduler_kwargs)
+        self.journal = self.scheduler.journal
+
+        self._lock = threading.Lock()
+        # job factories run eager array ops; dozens of request threads
+        # dispatching eagerly at once contend on the runtime — bound
+        # the concurrency (2 builders keeps construction overlapped
+        # with the driver without thrashing it)
+        self._build_sem = threading.Semaphore(2)
+        self._views: Dict[str, _JobView] = {}
+        self._subs: Dict[str, List[queue.Queue]] = {}
+        self._cmds: "queue.Queue" = queue.Queue()
+        self._seq = 0
+        self._steps = 0
+        self._rep_jobs: Dict[str, Job] = {}   # driver-thread only
+        self._drain_req = threading.Event()
+        self._drained = threading.Event()
+        self._closed = False
+
+        self._driver = threading.Thread(target=self._drive,
+                                        name="deap-tpu-service-driver",
+                                        daemon=True)
+        self._httpd = _ServiceHTTPServer((host, port), self)
+        self.host, self.port = self._httpd.server_address[:2]
+        self.url = f"http://{self.host}:{self.port}"
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="deap-tpu-service-http", daemon=True)
+        self._driver.start()
+        self._http_thread.start()
+        self.journal.event("service_request", route="start",
+                           url=self.url,
+                           problems=sorted(self.problems),
+                           auth=self.tokens is not None,
+                           autoscale=self.policy is not None)
+
+    # ----------------------------------------------------- lifecycle ----
+
+    @property
+    def draining(self) -> bool:
+        return self._drain_req.is_set()
+
+    def drain(self, wait: bool = True,
+              timeout: Optional[float] = None) -> bool:
+        """Begin graceful drain: refuse new submissions, finish the
+        in-flight segment, checkpoint every resident tenant, journal
+        ``service_drain``, end streams. Safe to call from any thread —
+        including a signal handler (``wait=False`` there). Returns
+        True once drained (always True when ``wait=False``... check
+        :attr:`drained`)."""
+        self._drain_req.set()
+        self._cmds.put(("wake",))
+        if wait:
+            return self._drained.wait(timeout)
+        return True
+
+    @property
+    def drained(self) -> bool:
+        return self._drained.is_set()
+
+    def install_signal_handlers(self):
+        """Install a SIGTERM/SIGINT → :meth:`drain` handler (main
+        thread only); returns the :class:`~deap_tpu.resilience.drain.
+        DrainSignal` so the caller can uninstall it."""
+        from deap_tpu.resilience.drain import DrainSignal
+        ds = DrainSignal(lambda signum: self.drain(wait=False))
+        ds.install()
+        return ds
+
+    def close(self, timeout: float = 30.0) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.drain(wait=True, timeout=timeout)
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._http_thread.join(timeout=5)
+        self._driver.join(timeout=timeout)
+
+    def __enter__(self) -> "EvolutionService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ----------------------------------------------------- the driver ----
+
+    def _drive(self) -> None:
+        sched = self.scheduler
+        sched.bind_driver()
+        try:
+            while not self._drain_req.is_set():
+                runnable = sched.runnable
+                n = self._pump_commands(block=not runnable)
+                # admission grace: while submissions are streaming in,
+                # give the queue a few 10 ms windows before stepping —
+                # rapid-fire submits land in ONE repack at a warmed
+                # lattice point instead of compiling a 1-lane program
+                # for the first arrival (measured: a 2.1 s stall)
+                grace = 0
+                while n and grace < 5 and not self._drain_req.is_set():
+                    time.sleep(0.01)
+                    n = self._pump_commands(block=False)
+                    grace += 1
+                if self._drain_req.is_set():
+                    break
+                if sched.runnable:
+                    sched.step()
+                    self._steps += 1
+                    if self.step_hook is not None:
+                        self.step_hook(self._steps)
+                    if self._steps % self.autoscale_every == 0:
+                        self._autoscale_tick()
+            # ------------------------------------------- graceful drain
+            self._pump_commands(block=False)
+            saved = sched.checkpoint_all()
+            open_views = []
+            with self._lock:
+                for v in self._views.values():
+                    if not v.done.is_set():
+                        v.status = "drained"
+                        open_views.append(v)
+            self.journal.event(
+                "service_drain",
+                checkpointed=sorted(saved),
+                open_tenants=sorted(v.tenant_id for v in open_views),
+                steps=self._steps)
+            for v in open_views:
+                self._publish(v.tenant_id,
+                              {"event": "drained",
+                               "tenant_id": v.tenant_id, "gen": v.gen})
+                self._publish(v.tenant_id, None)
+                v.done.set()
+        finally:
+            try:
+                sched.close()
+            finally:
+                self._drained.set()
+
+    def _pump_commands(self, block: bool) -> int:
+        try:
+            cmd = self._cmds.get(timeout=0.05) if block \
+                else self._cmds.get_nowait()
+        except queue.Empty:
+            return 0
+        n = 0
+        while True:
+            self._apply(cmd)
+            n += 1
+            try:
+                cmd = self._cmds.get_nowait()
+            except queue.Empty:
+                return n
+
+    def _apply(self, cmd: Tuple) -> None:
+        if cmd[0] == "wake":
+            return
+        if cmd[0] == "submit":
+            _, job, problem = cmd
+            self._apply_submit(job, problem)
+        elif cmd[0] == "submit_many":
+            for job, problem in cmd[1]:
+                self._apply_submit(job, problem)
+
+    def _apply_submit(self, job: Job, problem: str) -> None:
+        # admission is ASYNCHRONOUS: the front end already built the
+        # Job (factories run on request threads — they must be
+        # thread-safe pure constructors), ACKed, and registered the
+        # view; the driver only performs the single-threaded scheduler
+        # mutation. Scheduler-side errors surface through the mirror
+        # (status "failed") and the stream's terminal event.
+        tid = job.tenant_id
+        with self._lock:
+            view = self._views[tid]
+        try:
+            self.scheduler.submit(job)
+        except Exception as e:
+            view.error = f"{type(e).__name__}: {e}"
+            view.status = "failed"
+            view.done.set()
+            self.journal.event("service_request", route="submit",
+                               tenant_id=tid, problem=problem,
+                               error=view.error)
+            self._publish(tid, {"event": "failed", "tenant_id": tid,
+                                "error": view.error})
+            self._publish(tid, None)
+            return
+        bucket = self.scheduler.buckets[bucket_key(job)]
+        self._rep_jobs.setdefault(bucket.label, job)
+        tenant = self.scheduler.tenants[tid]
+        view.status = ("resuming" if tenant.has_checkpoint
+                       else "queued")
+        self.journal.event("service_request", route="submit",
+                           tenant_id=tid, problem=problem,
+                           resume=tenant.has_checkpoint)
+
+    # boundary fan-out: runs on the driver thread inside step()
+    def _on_boundary(self, bucket_label: str,
+                     updates: List[Dict[str, Any]]) -> None:
+        for u in updates:
+            t = u["tenant"]
+            with self._lock:
+                view = self._views.get(t.id)
+                has_subs = bool(self._subs.get(t.id))
+            if view is None:
+                continue
+            view.gen = u["gen"]
+            ev = {"event": "segment", "tenant_id": t.id,
+                  "bucket": bucket_label,
+                  "gen_from": u["gen_before"], "gen": u["gen"]}
+            if has_subs and u["chunk"] is not None:
+                # the per-segment results: this segment's logbook
+                # record rows, byte-exact on the wire
+                ev["records"] = wire.pack(u["chunk"])
+            self._publish(t.id, ev)
+            if u["finished"]:
+                view.set_result(t.result)
+                view.status = t.status
+                view.done.set()
+                self._publish(t.id, {"event": t.status,
+                                     "tenant_id": t.id,
+                                     "gen": u["gen"]})
+                self._publish(t.id, None)
+
+    def _autoscale_tick(self) -> None:
+        if self.policy is None:
+            return
+        sched = self.scheduler
+        snap = sched.slo_snapshot()
+        decision = self.policy.decide(snap)
+        if not decision:
+            return
+        for label, n in decision.lane_counts.items():
+            before = snap[label]["lanes"]
+            applied = sched.set_bucket_lanes(label, n)
+            self.journal.event(
+                "autoscale_decision", action="lanes", bucket=label,
+                lanes_from=before, lanes_to=applied,
+                reason=decision.reasons.get(label, ""),
+                queue_depth=snap[label]["queue_depth"],
+                queue_wait_p99=snap[label]["queue_wait_p99"])
+        for label, n in decision.prewarm:
+            job = self._rep_jobs.get(label)
+            if job is None:
+                continue
+            # compile the predicted lattice point in the BACKGROUND:
+            # XLA compilation releases the GIL, so the driver keeps
+            # stepping while the program the next scale-up needs is
+            # built — a prewarm on the driver thread measured as a
+            # multi-second admission stall under burst load. The
+            # worker touches only the engine's jit caches (thread-safe
+            # in jax), never scheduler state.
+            threading.Thread(
+                target=self._background_prewarm, args=(label, n),
+                name=f"deap-tpu-prewarm-{n}", daemon=True).start()
+        for tid in decision.spill:
+            try:
+                sched.request_spill(tid)
+            except KeyError:
+                continue
+            self.journal.event("autoscale_decision", action="spill",
+                               tenant_id=tid)
+
+    def _background_prewarm(self, label: str, n_lanes: int) -> None:
+        """Compile one (bucket, lane-count) lattice point off the
+        driver thread. Reads the bucket's engine/horizon once and runs
+        an inactive dummy batch through the jitted segment — pure
+        compile-cache population, no scheduler state touched."""
+        import numpy as np
+        try:
+            bucket = self.scheduler._bucket_by(label)
+        except KeyError:
+            return
+        eng, horizon = bucket.engine, bucket.horizon
+        job = self._rep_jobs.get(label)
+        if job is None:
+            return
+        t0 = time.perf_counter()
+        try:
+            lane = eng.lane_init(job.key, job.init, job.ngen,
+                                 job.hyper)
+            probe = eng.pack([lane], n_lanes=n_lanes, horizon=horizon)
+            probe["ngen"] = np.zeros_like(np.asarray(probe["ngen"]))
+            eng.advance(probe, self.scheduler.segment_len)
+        except Exception as e:
+            self.journal.event("autoscale_decision", action="prewarm",
+                               bucket=label, lanes=n_lanes,
+                               error=f"{type(e).__name__}: {e}")
+            return
+        self.journal.event(
+            "autoscale_decision", action="prewarm", bucket=label,
+            lanes=n_lanes, background=True,
+            compile_s=round(time.perf_counter() - t0, 4))
+
+    # ------------------------------------------------- pub/sub plumbing ----
+
+    def _subscribe(self, tid: str) -> "queue.Queue":
+        q: "queue.Queue" = queue.Queue()
+        with self._lock:
+            self._subs.setdefault(tid, []).append(q)
+        return q
+
+    def _unsubscribe(self, tid: str, q: "queue.Queue") -> None:
+        with self._lock:
+            subs = self._subs.get(tid, [])
+            if q in subs:
+                subs.remove(q)
+            if not subs:
+                self._subs.pop(tid, None)
+
+    def _publish(self, tid: str, event: Optional[dict]) -> None:
+        with self._lock:
+            subs = list(self._subs.get(tid, []))
+        for q in subs:
+            q.put(event)
+
+    # ----------------------------------------------------- HTTP surface ----
+
+    def _auth(self, headers) -> Tuple[str, dict]:
+        """Returns (token, info); raises :class:`_HttpError` (and
+        journals ``auth_rejected``) on missing/unknown tokens."""
+        if self.tokens is None:
+            return "", {}
+        auth = headers.get("Authorization", "")
+        token = auth[7:] if auth.startswith("Bearer ") else ""
+        if not token:
+            self.journal.event("auth_rejected", reason="missing_token")
+            raise _HttpError(401, "missing bearer token")
+        info = self.tokens.get(token)
+        if info is None:
+            self.journal.event("auth_rejected", reason="unknown_token")
+            raise _HttpError(403, "unknown token")
+        return token, info
+
+    def _check_quota(self, token: str, info: dict,
+                     n_new: int = 1) -> None:
+        max_jobs = info.get("max_jobs") if info else None
+        if max_jobs is None:
+            return
+        with self._lock:
+            active = sum(1 for v in self._views.values()
+                         if v.token == token and not v.done.is_set())
+        if active + n_new > int(max_jobs):
+            self.journal.event(
+                "auth_rejected", reason="quota",
+                tenant=info.get("tenant"), max_jobs=int(max_jobs),
+                active=active)
+            raise _HttpError(429,
+                             f"quota exceeded: {active} in-flight + "
+                             f"{n_new} new jobs > max_jobs={max_jobs}")
+
+    def _view_for(self, tid: str, token: str) -> _JobView:
+        with self._lock:
+            view = self._views.get(tid)
+        if view is None:
+            raise _HttpError(404, f"unknown tenant {tid!r}")
+        if self.tokens is not None and view.token != token:
+            self.journal.event("auth_rejected", reason="foreign_tenant",
+                               tenant_id=tid)
+            raise _HttpError(403, "tenant belongs to another token")
+        return view
+
+    def _build_one(self, spec: dict, token: str, info: dict):
+        problem = spec.get("problem")
+        if problem not in self.problems:
+            raise _HttpError(404, f"unknown problem {problem!r} "
+                                  f"(have: {sorted(self.problems)})")
+        tid = spec.get("tenant_id")
+        if tid is None:
+            with self._lock:
+                self._seq += 1
+                prefix = (info.get("tenant", "job")
+                          if info else "job")
+                tid = f"{prefix}-{self._seq}"
+        tid = str(tid)
+        # build the Job HERE, on the request thread: factories are
+        # pure constructors (seed → arrays), so clients construct jobs
+        # off the driver's critical path — moving this to the driver
+        # measured ~2.7 s of serial admission stall at 1k tenants.
+        # Construction errors report synchronously; the semaphore
+        # bounds concurrent eager dispatch. tenant_id collisions are
+        # re-checked at registration.
+        try:
+            with self._build_sem:
+                job = self.problems[problem](
+                    tid, dict(spec.get("params") or {}))
+        except Exception as e:
+            raise _HttpError(400, f"{type(e).__name__}: {e}")
+        if job.tenant_id != tid:
+            raise _HttpError(400,
+                             f"problem factory {problem!r} returned "
+                             f"tenant id {job.tenant_id!r}, expected "
+                             f"{tid!r}")
+        view = _JobView(tid, problem, token)
+        view.ngen = int(job.ngen)
+        return job, view, problem
+
+    def _handle_submit(self, body: dict, token: str, info: dict
+                       ) -> Tuple[int, dict]:
+        """Single (``{"problem", "params", "tenant_id"?}``) or batch
+        (``{"jobs": [spec, ...]}``) submission — the batch form costs
+        one HTTP round trip for N jobs, which matters when the client
+        and server share cores."""
+        if self.draining:
+            raise _HttpError(503, "service is draining")
+        specs = body.get("jobs")
+        batch = specs is not None
+        if not batch:
+            specs = [body]
+        if not isinstance(specs, list) or not specs:
+            raise _HttpError(400, '"jobs" must be a non-empty list')
+        self._check_quota(token, info, n_new=len(specs))
+        built = [self._build_one(s, token, info) for s in specs]
+        with self._lock:
+            dup = [j.tenant_id for j, _, _ in built
+                   if j.tenant_id in self._views]
+            if dup:
+                raise _HttpError(409, f"tenant id(s) {dup} already "
+                                      "submitted")
+            for job, view, _ in built:
+                self._views[job.tenant_id] = view
+        # async admission: ACK now, the driver applies at its next
+        # command pump — a request thread never waits out a segment
+        self._cmds.put(("submit_many",
+                        [(job, problem) for job, _, problem in built]))
+        if self._drained.is_set():
+            # lost race with a concurrent drain: the driver's final
+            # pump may never see this command — fail the views loudly
+            for _, view, _ in built:
+                view.status = "drained"
+                view.done.set()
+        tids = [job.tenant_id for job, _, _ in built]
+        if batch:
+            return 200, {"tenant_ids": tids, "status": "submitted"}
+        return 200, {"tenant_id": tids[0], "status": "submitted"}
+
+    def handle(self, method: str, path: str, headers, body: bytes
+               ) -> Tuple[int, str, bytes, bool]:
+        """Route one request; returns (code, content-type, body,
+        stream?) — ``stream`` means the caller takes over the socket
+        (NDJSON). Front-end threads only: never touches the
+        scheduler."""
+        parsed = urllib.parse.urlparse(path)
+        route = parsed.path.rstrip("/") or "/"
+        qs = urllib.parse.parse_qs(parsed.query)
+        if route == "/healthz" and method == "GET":
+            code = 200 if not self.draining else 503
+            return code, "application/json", json.dumps({
+                "status": "draining" if self.draining else "ok",
+                "jobs": len(self._views),
+                "problems": sorted(self.problems)}).encode(), False
+        if route == "/metrics" and method == "GET":
+            # the unified serving surface: the same registry text
+            # serve_metrics() exposes, on the service's own port
+            reg = self.scheduler.metrics
+            text = reg.metrics_text() if reg is not None else ""
+            return 200, ("text/plain; version=0.0.4; charset=utf-8"), \
+                text.encode(), False
+        token, info = self._auth(headers)
+        if route == "/v1/jobs" and method == "POST":
+            payload = json.loads(body or b"{}")
+            code, out = self._handle_submit(payload, token, info)
+            return code, "application/json", \
+                json.dumps(out).encode(), False
+        if route == "/v1/drain" and method == "POST":
+            self.journal.event("service_request", route="drain")
+            self.drain(wait=False)
+            return 200, "application/json", b'{"draining": true}', False
+        if route == "/v1/results" and method == "GET":
+            # batch result fetch: one request, N tenants — the
+            # long-poll deadline is shared across the batch
+            ids = [i for i in qs.get("ids", [""])[0].split(",") if i]
+            if not ids:
+                raise _HttpError(400, "ids=<tid,[tid...]> required")
+            views = [self._view_for(urllib.parse.unquote(tid), token)
+                     for tid in ids]
+            if qs.get("wait", ["0"])[0] not in ("0", ""):
+                deadline = time.monotonic() + float(
+                    qs.get("timeout", ["300"])[0])
+                for v in views:
+                    v.done.wait(max(0.0,
+                                    deadline - time.monotonic()))
+            out = {}
+            for v in views:
+                entry = v.as_dict()
+                payload = (v.result_payload()
+                           if v.done.is_set() else None)
+                if payload is not None:
+                    entry["result"] = payload
+                out[v.tenant_id] = entry
+            return 200, "application/json", \
+                json.dumps({"results": out}).encode(), False
+        if route.startswith("/v1/jobs/") and method == "GET":
+            parts = route.split("/")[3:]
+            tid = urllib.parse.unquote(parts[0])
+            sub = parts[1] if len(parts) > 1 else ""
+            view = self._view_for(tid, token)
+            if sub == "":
+                return 200, "application/json", \
+                    json.dumps(view.as_dict()).encode(), False
+            if sub == "result":
+                if qs.get("wait", ["0"])[0] not in ("0", ""):
+                    timeout = float(qs.get("timeout", ["300"])[0])
+                    view.done.wait(timeout)
+                if not view.done.is_set():
+                    return 202, "application/json", \
+                        json.dumps(view.as_dict()).encode(), False
+                out = view.as_dict()
+                payload = view.result_payload()
+                if payload is not None:
+                    out["result"] = payload
+                return 200, "application/json", \
+                    json.dumps(out).encode(), False
+            if sub == "stream":
+                return 200, "application/x-ndjson", b"", True
+        raise _HttpError(404, f"no route {method} {route}")
+
+    def stream_events(self, tid: str, token: str, write_line) -> None:
+        """Drive one NDJSON stream: status line first, then every
+        published event until the terminal sentinel (or service
+        close). Runs on the request thread; reads only the mirror."""
+        view = self._view_for(tid, token)
+        q = self._subscribe(tid)
+        try:
+            write_line({"event": "status", **view.as_dict()})
+            if view.done.is_set():
+                # finished before we subscribed: emit the terminal
+                # event directly from the mirror
+                write_line({"event": view.status,
+                            "tenant_id": tid, "gen": view.gen})
+                return
+            while True:
+                try:
+                    ev = q.get(timeout=0.5)
+                except queue.Empty:
+                    if self._drained.is_set() or self._closed:
+                        return
+                    continue
+                if ev is None:
+                    return
+                write_line(ev)
+        finally:
+            self._unsubscribe(tid, q)
+
+
+class _ServiceHTTPServer(http.server.ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr, service: EvolutionService):
+        self.service = service
+        super().__init__(addr, _Handler)
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    @property
+    def svc(self) -> EvolutionService:
+        return self.server.service  # type: ignore[attr-defined]
+
+    def log_message(self, *args):  # requests are journal rows, not logs
+        pass
+
+    def _respond(self, code: int, ctype: str, payload: bytes) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _dispatch(self, method: str) -> None:
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else b""
+            try:
+                code, ctype, payload, stream = self.svc.handle(
+                    method, self.path, self.headers, body)
+            except _HttpError as e:
+                self._respond(e.code, "application/json", json.dumps(
+                    {"error": e.message}).encode())
+                return
+            except json.JSONDecodeError as e:
+                self._respond(400, "application/json", json.dumps(
+                    {"error": f"bad JSON body: {e}"}).encode())
+                return
+            if not stream:
+                self._respond(code, ctype, payload)
+                return
+            # NDJSON stream: no Content-Length; the connection closes
+            # when the stream ends (HTTP/1.1 read-until-close)
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Connection", "close")
+            self.end_headers()
+
+            def write_line(ev: dict) -> None:
+                self.wfile.write(json.dumps(ev).encode() + b"\n")
+                self.wfile.flush()
+
+            parsed = urllib.parse.urlparse(self.path)
+            tid = urllib.parse.unquote(parsed.path.rstrip("/")
+                                       .split("/")[3])
+            token, _ = self.svc._auth(self.headers)
+            self.svc.stream_events(tid, token, write_line)
+            self.close_connection = True
+        except (BrokenPipeError, ConnectionResetError):
+            self.close_connection = True
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        self._dispatch("GET")
+
+    def do_POST(self):  # noqa: N802
+        self._dispatch("POST")

@@ -175,6 +175,26 @@ class Tenant:
         self.has_checkpoint = True
         return path
 
+    def probe_checkpoint(self) -> bool:
+        """True when this tenant's run dir already holds a checkpoint
+        stamped with its id (a prior process checkpointed it — e.g. a
+        service drain). Sets ``has_checkpoint`` so admission resumes
+        instead of fresh-initialising. Fresh tenants take the stat-only
+        fast path — probing must not cost a Checkpointer (mkdir +
+        listdir) per admission at 1k tenants/submission burst."""
+        if not os.path.isdir(os.path.join(self.run_dir, "ckpt")):
+            return False
+        from deap_tpu.support.checkpoint import checkpoint_meta
+        for step in reversed(self.ckpt.steps()):
+            try:
+                meta = checkpoint_meta(self.ckpt.path_for(step)) or {}
+            except Exception:
+                continue
+            if meta.get("tenant_id") == self.id:
+                self.has_checkpoint = True
+                return True
+        return False
+
     def restore(self, engine) -> None:
         """Load the newest valid checkpoint *for this tenant* back into
         the in-memory lane/records (the resume half of the swap)."""

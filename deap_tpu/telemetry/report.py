@@ -258,6 +258,46 @@ def _slo_section(events: List[Dict[str, Any]], out: List[str]) -> None:
                        "evicted (checkpoint swap unit)")
 
 
+def _service_section(events: List[Dict[str, Any]], out: List[str]
+                     ) -> None:
+    """Service-plane timeline: the autoscaler's applied decisions
+    (lane moves, prewarms, spills), the auth-rejection tally, and the
+    graceful-drain ledger."""
+    decisions = [e for e in events
+                 if e.get("kind") == "autoscale_decision"]
+    rejections = [e for e in events
+                  if e.get("kind") == "auth_rejected"]
+    drains = [e for e in events if e.get("kind") == "service_drain"]
+    if not (decisions or rejections or drains):
+        return
+    out.append("")
+    out.append("## Service plane")
+    if decisions:
+        lanes = [e for e in decisions if e.get("action") == "lanes"]
+        pw = [e for e in decisions if e.get("action") == "prewarm"]
+        sp = [e for e in decisions if e.get("action") == "spill"]
+        out.append(f"- autoscaler: {len(lanes)} lane move(s), "
+                   f"{len(pw)} prewarm(s), {len(sp)} spill(s)")
+        for e in lanes[:10]:
+            out.append(f"  - t={e.get('t')}s {e.get('bucket')}: "
+                       f"{e.get('lanes_from')} → {e.get('lanes_to')} "
+                       f"lanes (queue={e.get('queue_depth')}, "
+                       f"wait_p99={_fmt(e.get('queue_wait_p99'))})")
+    if rejections:
+        reasons: Dict[str, int] = {}
+        for e in rejections:
+            r = str(e.get("reason", "?"))
+            reasons[r] = reasons.get(r, 0) + 1
+        out.append("- auth rejections: " + ", ".join(
+            f"{k}×{v}" for k, v in sorted(reasons.items())))
+    for e in drains:
+        out.append(f"- drain at t={e.get('t')}s: "
+                   f"{len(e.get('checkpointed', []))} tenant(s) "
+                   f"checkpointed, "
+                   f"{len(e.get('open_tenants', []))} stream(s) "
+                   "notified")
+
+
 def _memory_section(events: List[Dict[str, Any]], out: List[str]
                     ) -> None:
     """Flight-recorder device-memory trajectory: live device bytes per
@@ -360,6 +400,7 @@ def render_report(path: str, lines: Optional[List[str]] = None) -> str:
         # wide planes (SLO timeline, compiled programs, flight
         # recorder) and the summary still apply to the process
         _slo_section(events, out)
+        _service_section(events, out)
         _program_table(events, out)
         _memory_section(events, out)
         summary = next((e for e in reversed(events)

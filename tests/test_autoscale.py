@@ -1,0 +1,164 @@
+"""Autoscaler decision function — pure unit tests, no sockets, no jax.
+
+``deap_tpu/serving/autoscale.py`` is deliberately a pure decision
+module (synthetic metric snapshots in → lane counts / prewarm set /
+spill list out), so its control behaviour — above all the hysteresis
+that keeps an oscillating queue from flapping the lane budget — is
+testable without a scheduler, a socket, or an XLA backend. The module
+is loaded by file path here (like ``telemetry/report.py``'s no-jax
+pin) and its import surface is AST-gated to the standard library.
+"""
+
+import ast
+import importlib.util
+import os
+import sys
+
+AUTOSCALE_PY = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "deap_tpu", "serving", "autoscale.py")
+
+_spec = importlib.util.spec_from_file_location("_autoscale_standalone",
+                                               AUTOSCALE_PY)
+autoscale = importlib.util.module_from_spec(_spec)
+# dataclasses resolve string annotations through sys.modules — the
+# standalone module must be registered before exec
+sys.modules["_autoscale_standalone"] = autoscale
+_spec.loader.exec_module(autoscale)
+
+AutoscaleConfig = autoscale.AutoscaleConfig
+AutoscalePolicy = autoscale.AutoscalePolicy
+
+
+def snap(queue=0, occ=0.0, lanes=8, p99=None, idle=()):
+    return {"b": {"queue_depth": queue, "occupancy": occ,
+                  "lanes": lanes, "queue_wait_p99": p99,
+                  "residents": int(occ * lanes), "idle": idle}}
+
+
+def policy(**kw):
+    return AutoscalePolicy(AutoscaleConfig(**kw))
+
+
+def test_module_imports_stdlib_only():
+    """The decision function must stay runnable on a box with no jax:
+    every import in autoscale.py is standard library."""
+    with open(AUTOSCALE_PY) as fh:
+        tree = ast.parse(fh.read())
+    mods = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            mods |= {a.name.split(".")[0] for a in node.names}
+        elif isinstance(node, ast.ImportFrom) and node.level == 0:
+            mods.add((node.module or "").split(".")[0])
+    allowed = set(sys.stdlib_module_names)
+    assert mods <= allowed, f"non-stdlib imports: {mods - allowed}"
+
+
+def test_scale_up_needs_consecutive_pressure():
+    p = policy(up_after=2, max_lanes=64)
+    assert not p.decide(snap(queue=3, occ=1.0, lanes=8)).lane_counts
+    d = p.decide(snap(queue=3, occ=1.0, lanes=8))
+    assert d.lane_counts == {"b": 16}
+    assert "scale_up" in d.reasons["b"]
+
+
+def test_wait_p99_alone_triggers_pressure():
+    p = policy(up_after=2, wait_p99_high=0.5)
+    p.decide(snap(queue=0, occ=0.9, lanes=4, p99=2.0))
+    d = p.decide(snap(queue=0, occ=0.9, lanes=4, p99=2.0))
+    assert d.lane_counts == {"b": 8}
+
+
+def test_no_flapping_on_oscillating_queue_depth():
+    """A queue that alternates burst/empty every observation never
+    accumulates `up_after` consecutive pressured reads — the lane
+    budget must not move, in either direction, over many cycles."""
+    p = policy(up_after=2, down_after=3)
+    for i in range(40):
+        pressured = i % 2 == 0
+        d = p.decide(snap(queue=5 if pressured else 0,
+                          occ=1.0 if pressured else 0.9, lanes=8))
+        assert not d.lane_counts, (i, d)
+        assert not d.spill
+
+
+def test_cooldown_blocks_back_to_back_scale_ups():
+    p = policy(up_after=2, cooldown=2, max_lanes=64)
+    p.decide(snap(queue=3, lanes=8))
+    assert p.decide(snap(queue=3, lanes=8)).lane_counts == {"b": 16}
+    # pressure persists, but the bucket is cooling down
+    assert not p.decide(snap(queue=3, lanes=16)).lane_counts
+    assert not p.decide(snap(queue=3, lanes=16)).lane_counts
+    # cooldown over: two more pressured reads scale again
+    p.decide(snap(queue=3, lanes=16))
+    assert p.decide(snap(queue=3, lanes=16)).lane_counts == {"b": 32}
+
+
+def test_scale_up_clamps_to_max_lanes():
+    p = policy(up_after=1, max_lanes=16)
+    assert p.decide(snap(queue=9, occ=0.5,
+                         lanes=8)).lane_counts == {"b": 16}
+    p2 = policy(up_after=1, max_lanes=16)
+    assert not p2.decide(snap(queue=9, occ=0.5,
+                              lanes=16)).lane_counts
+
+
+def test_scale_down_needs_sustained_idleness_and_floor():
+    p = policy(down_after=3, min_lanes=4, cooldown=0)
+    for _ in range(2):
+        assert not p.decide(snap(queue=0, occ=0.2,
+                                 lanes=16)).lane_counts
+    d = p.decide(snap(queue=0, occ=0.2, lanes=16))
+    assert d.lane_counts == {"b": 8}
+    assert "scale_down" in d.reasons["b"]
+    # at the floor: never below min_lanes
+    p2 = policy(down_after=1, min_lanes=4, cooldown=0)
+    assert not p2.decide(snap(queue=0, occ=0.0, lanes=4)).lane_counts
+
+
+def test_busy_but_not_pressured_is_not_idle():
+    p = policy(down_after=1, cooldown=0)
+    # full lanes, empty queue: healthy steady state, leave it alone
+    assert not p.decide(snap(queue=0, occ=1.0, lanes=8)).lane_counts
+
+
+def test_prewarm_predicts_next_lattice_point_once():
+    p = policy(up_after=3, prewarm_ahead=True)
+    d1 = p.decide(snap(queue=2, lanes=8))
+    assert d1.prewarm == [("b", 16)]       # predicted ahead of need
+    assert not d1.lane_counts              # ...before the scale-up
+    d2 = p.decide(snap(queue=2, lanes=8))
+    assert not d2.prewarm                  # predicted only once
+    d3 = p.decide(snap(queue=2, lanes=8))
+    assert d3.lane_counts == {"b": 16}
+
+
+def test_spill_idle_tenants_at_lane_ceiling():
+    p = policy(up_after=1, max_lanes=8, spill_idle_segments=4)
+    idle = (("t-old", 9), ("t-young", 1), ("t-mid", 5))
+    d = p.decide(snap(queue=1, occ=1.0, lanes=8, idle=idle))
+    # at max lanes + full occupancy: longest-resident spillables go,
+    # bounded by the queue depth
+    assert d.spill == ["t-old"]
+    assert "spill" in d.reasons["b"]
+    # below the idle threshold nothing is spillable
+    p2 = policy(up_after=1, max_lanes=8, spill_idle_segments=4)
+    d2 = p2.decide(snap(queue=2, occ=1.0, lanes=8,
+                        idle=(("t-young", 1),)))
+    assert not d2.spill
+
+
+def test_buckets_are_independent():
+    p = policy(up_after=2)
+    two = {**snap(queue=3, lanes=8),
+           "quiet": {"queue_depth": 0, "occupancy": 0.1, "lanes": 8,
+                     "queue_wait_p99": None, "idle": ()}}
+    p.decide(two)
+    d = p.decide(two)
+    assert set(d.lane_counts) == {"b"}   # quiet bucket untouched
+
+
+def test_decision_truthiness():
+    p = policy()
+    assert not p.decide(snap())          # empty decision is falsy

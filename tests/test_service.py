@@ -1,0 +1,411 @@
+"""Network service plane — RPC front end, drain, auth, wire parity.
+
+The acceptance bar of ``deap_tpu/serving/service.py``: a job submitted
+over a real loopback socket must return a result **bit-identical** to
+the same job run through the :class:`Scheduler` in-process (the wire
+codec transports raw array bytes, and the digest makes the comparison
+one string equal); SIGTERM drains gracefully (in-flight segment
+finishes, residents checkpoint tenant-stamped, ``service_drain``
+journals) and a restarted service resumes every drained tenant
+bit-exactly against an uninterrupted run. Plus the satellites: bearer
+auth + per-token quotas (``auth_rejected`` journaling), the unified
+``/metrics`` + ``/healthz`` port, the scheduler's
+:class:`SchedulerBusyError` thread contract, the journal-kind doc
+drift gate and the client's no-jax pin.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deap_tpu import ops
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import init_population
+from deap_tpu.core.toolbox import Toolbox
+from deap_tpu.serving import (
+    EvolutionService,
+    Job,
+    Scheduler,
+    SchedulerBusyError,
+    ServiceClient,
+    ServiceError,
+)
+from deap_tpu.serving.service import SERVICE_JOURNAL_KINDS
+from deap_tpu.serving.wire import pack, result_digest, unpack
+from deap_tpu.strategies import cma
+from deap_tpu.telemetry import read_journal
+from deap_tpu.telemetry.metrics import MetricsRegistry, serve_metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _onemax_toolbox():
+    tb = Toolbox()
+    tb.register("evaluate", lambda g: g.sum(-1).astype(jnp.float32))
+    tb.register("mate", ops.cx_two_point)
+    tb.register("mutate", ops.mut_flip_bit, indpb=0.1)
+    tb.register("select", ops.sel_tournament, tournsize=3)
+    return tb
+
+
+_TB = _onemax_toolbox()
+_STRAT = cma.Strategy(centroid=[2.0] * 4, sigma=0.4, lambda_=8)
+_TBC = Toolbox()
+_TBC.register("evaluate", lambda g: (g ** 2).sum(-1))
+_TBC.register("generate", _STRAT.generate)
+_TBC.register("update", _STRAT.update)
+
+
+def _onemax_job(tid, params):
+    seed = int(params.get("seed", 0))
+    pop = init_population(jax.random.key(seed), 16,
+                          ops.bernoulli_genome(12), FitnessSpec((1.0,)))
+    return Job(tenant_id=tid, family="ea_simple", toolbox=_TB,
+               key=jax.random.key(1000 + seed), init=pop,
+               ngen=int(params.get("ngen", 6)),
+               hyper={"cxpb": 0.5, "mutpb": 0.2}, program="onemax")
+
+
+def _sphere_job(tid, params):
+    seed = int(params.get("seed", 0))
+    return Job(tenant_id=tid, family="ea_generate_update",
+               toolbox=_TBC, key=jax.random.key(5000 + seed),
+               init=_STRAT.initial_state(
+                   sigma=float(params.get("sigma", 0.7))),
+               ngen=int(params.get("ngen", 6)), spec=_STRAT.spec,
+               program="sphere")
+
+
+PROBLEMS = {"onemax": _onemax_job, "sphere": _sphere_job}
+
+
+def _inprocess_digests(root, jobs):
+    """The same jobs through the Scheduler directly — the bit-identity
+    reference the service must match."""
+    with Scheduler(str(root), max_lanes=2, segment_len=2) as sched:
+        for j in jobs:
+            sched.submit(j)
+        results = sched.run()
+    return {tid: result_digest(res) for tid, res in results.items()}
+
+
+# ------------------------------------------------- wire codec ----
+
+def test_wire_roundtrip_bit_exact():
+    rng = np.random.default_rng(0)
+    payload = {
+        "f32": rng.standard_normal(7).astype(np.float32),
+        "f64": np.array([np.nan, -np.inf, 1e-300]),
+        "bools": np.array([True, False]),
+        "nested": (np.arange(5, dtype=np.int8), "text", 3, None),
+    }
+    back = unpack(json.loads(json.dumps(pack(payload))))
+    assert isinstance(back["nested"], tuple)
+    for a, b in [(payload["f32"], back["f32"]),
+                 (payload["f64"], back["f64"]),
+                 (payload["bools"], back["bools"]),
+                 (payload["nested"][0], back["nested"][0])]:
+        assert a.dtype == b.dtype and a.shape == b.shape
+        assert a.tobytes() == b.tobytes()
+
+
+def test_result_digest_separates_runs():
+    j1 = _onemax_job("a", {"seed": 1})
+    j2 = _onemax_job("a", {"seed": 2})
+    assert result_digest((j1.init,)) != result_digest((j2.init,))
+    assert result_digest((j1.init,)) == result_digest((j1.init,))
+
+
+# ------------------------------------------------- e2e service ----
+
+def test_service_e2e_bit_identical_to_inprocess(tmp_path):
+    """Mixed-family jobs from two concurrent client threads through a
+    real loopback socket: streamed per-segment results arrive, and
+    every tenant's wire digest equals the same job run through the
+    Scheduler in-process."""
+    specs = [("onemax", {"seed": 3, "ngen": 6}, "ga0"),
+             ("onemax", {"seed": 4, "ngen": 4}, "ga1"),
+             ("sphere", {"seed": 1, "ngen": 6}, "cma0")]
+    ref = _inprocess_digests(
+        tmp_path / "ref",
+        [PROBLEMS[p](tid, params) for p, params, tid in specs])
+
+    got = {}
+    stream_events = {}
+    errors = []
+
+    def client_thread(my_specs, do_stream):
+        try:
+            c = ServiceClient(svc.url)
+            if do_stream:  # per-job routes + NDJSON streaming
+                tids = [c.submit(p, params=params, tenant_id=tid)
+                        for p, params, tid in my_specs]
+                stream_events[tids[0]] = list(c.stream(tids[0]))
+                for tid in tids:
+                    res = c.result(tid, wait=True, timeout=120)
+                    assert res["status"] == "finished", res
+                    got[tid] = res["result"]["digest"]
+            else:  # the batch routes: one round trip each way
+                tids = c.submit_many(
+                    [{"problem": p, "params": params,
+                      "tenant_id": tid}
+                     for p, params, tid in my_specs])
+                assert tids == [tid for _, _, tid in my_specs]
+                for tid, entry in c.results_many(
+                        tids, wait=True, timeout=120).items():
+                    assert entry["status"] == "finished", entry
+                    got[tid] = entry["result"]["digest"]
+        except Exception as e:  # surface in the main thread
+            errors.append(e)
+
+    with EvolutionService(str(tmp_path / "svc"), PROBLEMS,
+                          max_lanes=2, segment_len=2,
+                          metrics=MetricsRegistry()) as svc:
+        t1 = threading.Thread(
+            target=client_thread, args=(specs[:2], True))
+        t2 = threading.Thread(
+            target=client_thread, args=(specs[2:], False))
+        t1.start(); t2.start()
+        t1.join(timeout=300); t2.join(timeout=300)
+    assert not errors, errors
+    assert got == ref  # bit-identical across the socket
+
+    evs = stream_events["ga0"]
+    kinds = [e["event"] for e in evs]
+    assert kinds[0] == "status" and kinds[-1] == "finished"
+    segs = [e for e in evs if e["event"] == "segment"]
+    assert segs and segs[-1]["gen"] == 6
+    # per-segment results decode to this segment's logbook rows
+    rec = ServiceClient.decode_records(segs[0])
+    assert rec is not None and "nevals" in rec
+    assert len(rec["nevals"]) == segs[0]["gen"] - segs[0]["gen_from"]
+
+    rows = read_journal(str(tmp_path / "svc" / "journal.jsonl"))
+    kinds = {r.get("kind") for r in rows}
+    assert {"service_request", "job_submitted",
+            "tenant_finished"} <= kinds
+
+
+def test_service_sigterm_drain_restart_bit_exact(tmp_path):
+    """SIGTERM mid-run: the in-flight segment finishes, the resident
+    tenant checkpoints (tenant-stamped), ``service_drain`` journals,
+    the stream ends with a ``drained`` event — and a restarted service
+    over the same root resumes the tenant to a result bit-identical to
+    an uninterrupted run."""
+    NGEN = 12
+    ref = _inprocess_digests(
+        tmp_path / "ref", [_onemax_job("tA", {"seed": 3,
+                                              "ngen": NGEN})])["tA"]
+
+    def kill_after_first_segment(step):
+        # deterministic mid-run preemption: one segment (gen=2 of 12)
+        # completed, then a REAL SIGTERM; wait for the main-thread
+        # handler to register the drain before releasing the driver,
+        # so exactly one segment ran
+        if step == 1:
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert svc._drain_req.wait(30)
+
+    root = str(tmp_path / "svc")
+    svc = EvolutionService(root, PROBLEMS, max_lanes=2, segment_len=2,
+                          metrics=MetricsRegistry(),
+                          step_hook=kill_after_first_segment)
+    ds = svc.install_signal_handlers()
+    try:
+        c = ServiceClient(svc.url)
+        c.submit("onemax", params={"seed": 3, "ngen": NGEN},
+                 tenant_id="tA")
+        events = [ev["event"] for ev in c.stream("tA")]
+        assert svc._drained.wait(60)
+        assert events[-1] == "drained"
+        assert "segment" in events
+        res = c.result("tA", wait=False)
+        assert res["status"] == "drained" and "result" not in res
+        with pytest.raises(ServiceError) as ei:
+            c.submit("onemax", params={"seed": 9})
+        assert ei.value.code == 503  # draining refuses admissions
+    finally:
+        ds.uninstall()
+        svc.close()
+
+    rows = read_journal(os.path.join(root, "journal.jsonl"))
+    drains = [r for r in rows if r.get("kind") == "service_drain"]
+    assert len(drains) == 1 and drains[0]["checkpointed"] == ["tA"]
+    from deap_tpu.support.checkpoint import Checkpointer
+    ck = Checkpointer(os.path.join(root, "tenants", "tA", "ckpt"))
+    assert ck.meta()["tenant_id"] == "tA"
+
+    # restart over the same root; resubmitting the same job resumes
+    with EvolutionService(root, PROBLEMS, max_lanes=2,
+                          segment_len=2,
+                          metrics=MetricsRegistry()) as svc2:
+        c2 = ServiceClient(svc2.url)
+        c2.submit("onemax", params={"seed": 3, "ngen": NGEN},
+                  tenant_id="tA")
+        res = c2.result("tA", wait=True, timeout=120)
+    assert res["status"] == "finished"
+    assert res["result"]["digest"] == ref
+    rows2 = read_journal(os.path.join(root, "journal.jsonl"))
+    kinds = [r.get("kind") for r in rows2]
+    assert "tenant_checkpoint_found" in kinds
+    assert "tenant_resumed" in kinds
+
+
+def test_service_auth_quota_and_isolation(tmp_path):
+    tokens = {"alice-key": {"tenant": "alice", "max_jobs": 1},
+              "bob-key": {"tenant": "bob"}}
+    with EvolutionService(str(tmp_path), PROBLEMS, tokens=tokens,
+                          max_lanes=2, segment_len=2,
+                          metrics=MetricsRegistry()) as svc:
+        # missing / unknown tokens
+        with pytest.raises(ServiceError) as ei:
+            ServiceClient(svc.url).submit("onemax")
+        assert ei.value.code == 401
+        with pytest.raises(ServiceError) as ei:
+            ServiceClient(svc.url, token="wrong").submit("onemax")
+        assert ei.value.code == 403
+        # /healthz and /metrics stay open (liveness + Prometheus)
+        assert ServiceClient(svc.url).healthz()["status"] == "ok"
+        ServiceClient(svc.url).metrics_text()
+
+        alice = ServiceClient(svc.url, token="alice-key")
+        bob = ServiceClient(svc.url, token="bob-key")
+        tid = alice.submit("onemax", params={"seed": 1, "ngen": 8},
+                           tenant_id="alice-job")
+        # quota: alice has max_jobs=1 in flight
+        with pytest.raises(ServiceError) as ei:
+            alice.submit("onemax", params={"seed": 2})
+        assert ei.value.code == 429
+        # isolation: bob cannot read alice's job
+        with pytest.raises(ServiceError) as ei:
+            bob.status("alice-job")
+        assert ei.value.code == 403
+        assert alice.result(tid, wait=True,
+                            timeout=120)["status"] == "finished"
+        # quota freed after completion
+        tid2 = alice.submit("onemax", params={"seed": 2, "ngen": 4})
+        assert tid2.startswith("alice-")
+        alice.result(tid2, wait=True, timeout=120)
+    rows = read_journal(str(tmp_path / "journal.jsonl"))
+    reasons = {r.get("reason") for r in rows
+               if r.get("kind") == "auth_rejected"}
+    assert {"missing_token", "unknown_token", "quota",
+            "foreign_tenant"} <= reasons
+
+
+def test_service_unified_metrics_port(tmp_path):
+    """Satellite: the service port serves the scheduler's registry at
+    /metrics (plus /healthz liveness) — and serve_metrics() on the
+    same registry still works standalone, returning identical
+    families."""
+    reg = MetricsRegistry()
+    with EvolutionService(str(tmp_path), PROBLEMS, max_lanes=2,
+                          segment_len=2, metrics=reg) as svc:
+        c = ServiceClient(svc.url)
+        tid = c.submit("onemax", params={"seed": 1, "ngen": 4})
+        c.result(tid, wait=True, timeout=120)
+        text = c.metrics_text()
+        assert "deap_serving_queue_depth" in text
+        assert "deap_serving_tenants_finished_total" in text
+        assert c.healthz()["status"] == "ok"
+        with serve_metrics(reg) as standalone:
+            import urllib.request
+            body = urllib.request.urlopen(standalone.url,
+                                          timeout=10).read().decode()
+        def families(t):
+            return {line.split()[2] for line in t.splitlines()
+                    if line.startswith("# TYPE")}
+        assert families(body) == families(text)
+
+
+# ------------------------------------------ scheduler thread contract ----
+
+def test_scheduler_busy_error_concurrent_entry(tmp_path):
+    """A second thread entering the scheduler mid-call gets a loud
+    SchedulerBusyError instead of corrupting bucket state."""
+    sched = Scheduler(str(tmp_path), max_lanes=2)
+    caught = []
+
+    def intruder():
+        try:
+            sched.submit(_onemax_job("x", {}))
+        except SchedulerBusyError as e:
+            caught.append(e)
+
+    with sched._exclusive("step"):  # the driver is "inside a call"
+        t = threading.Thread(target=intruder)
+        t.start()
+        t.join(timeout=30)
+    assert len(caught) == 1
+    assert "single-threaded by contract" in str(caught[0])
+    # the guard is reentrant for its owner: run() -> step() nests
+    sched.submit(_onemax_job("y", {"ngen": 2}))
+    sched.run()
+    sched.close()
+
+
+def test_scheduler_busy_error_non_driver_thread(tmp_path):
+    """After bind_driver, mutating calls from any other thread are
+    rejected outright — the service's queue-handoff contract."""
+    sched = Scheduler(str(tmp_path), max_lanes=2)
+    done = threading.Event()
+
+    def driver():
+        sched.bind_driver()
+        done.set()
+
+    t = threading.Thread(target=driver, name="drv")
+    t.start(); t.join(timeout=30)
+    assert done.is_set()
+    with pytest.raises(SchedulerBusyError, match="bound to driver"):
+        sched.submit(_onemax_job("z", {}))
+    with pytest.raises(SchedulerBusyError):
+        sched.step()
+    sched.close()
+
+
+# ----------------------------------------------------- drift gates ----
+
+def test_service_journal_kinds_documented():
+    """Every service-plane journal kind appears in the telemetry.md
+    kind table — same drift contract as the probe catalogue."""
+    doc = os.path.join(REPO, "docs", "advanced", "telemetry.md")
+    with open(doc) as fh:
+        text = fh.read()
+    assert SERVICE_JOURNAL_KINDS  # the gate must gate something
+    for kind in SERVICE_JOURNAL_KINDS:
+        assert f"`{kind}`" in text, (
+            f"journal kind {kind!r} undocumented in "
+            "docs/advanced/telemetry.md")
+
+
+def test_client_imports_without_jax():
+    """A submit/scrape box must never initialise an XLA backend: the
+    stdlib client (and the wire codec it pulls in) load standalone
+    with jax never entering sys.modules."""
+    client_py = os.path.join(REPO, "deap_tpu", "serving", "client.py")
+    code = (
+        "import importlib.util, sys\n"
+        f"spec = importlib.util.spec_from_file_location("
+        f"'client_standalone', {client_py!r})\n"
+        "mod = importlib.util.module_from_spec(spec)\n"
+        "spec.loader.exec_module(mod)\n"
+        "c = mod.ServiceClient('http://127.0.0.1:1')\n"
+        "payload = mod.wire.pack({'a': __import__('numpy')"
+        ".arange(3)})\n"
+        "assert mod.wire.unpack(payload)['a'].tolist() == [0, 1, 2]\n"
+        "assert 'jax' not in sys.modules, 'client pulled in jax'\n"
+        "assert 'deap_tpu' not in sys.modules\n"
+        "print('NOJAX_OK')\n")
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "NOJAX_OK" in out.stdout
