@@ -1332,6 +1332,91 @@ def service_lines(out_path: str = "BENCH_SERVICE.json") -> list:
     return rows
 
 
+# ------------------------------- service chaos plane (ISSUE 12) ----
+
+CHAOS_N = 200               # live retrying tenants under the kill
+CHAOS_NGEN = 24
+CHAOS_SEG = 3
+CHAOS_LANES = 64
+CHAOS_KILL_STEP = 6         # driver step the child SIGKILLs itself at
+CHAOS_CLIENTS = 8
+#: recovery-wall budget for the chaos_tripwire gate (kill → last
+#: tenant converged on the restarted service; includes the child's
+#: cold start + WAL replay + re-admission compiles on one CPU core)
+CHAOS_RECOVERY_BUDGET_S = 120.0
+
+
+def service_chaos_lines(out_path: str = "BENCH_CHAOS.json") -> list:
+    """The fault-tolerance acceptance measurement (ISSUE 12): a child
+    service process ``SIGKILL``s itself mid-run (deterministic
+    ``KillServiceAt`` fault) under ``CHAOS_N`` live tenants driven by
+    concurrent retrying clients (jittered backoff + idempotency keys);
+    a supervisor restarts it over the same root (admission-WAL replay
+    + checkpoint resume). Committed gates: **zero lost jobs**, **100%
+    wire-digest identity** against an uninterrupted in-process run,
+    and recovery wall time within ``CHAOS_RECOVERY_BUDGET_S``."""
+    import shutil
+    import tempfile
+
+    from deap_tpu.serving import chaos
+
+    envfp = _env_fingerprint("cpu")
+    work = tempfile.mkdtemp(prefix="deap_chaos_bench_")
+    specs = chaos.chaos_specs(CHAOS_N, ngen=CHAOS_NGEN)
+
+    t0 = time.perf_counter()
+    ref = chaos.reference_digests(os.path.join(work, "ref"), specs,
+                                  segment_len=CHAOS_SEG,
+                                  max_lanes=CHAOS_LANES)
+    ref_s = time.perf_counter() - t0
+
+    out = chaos.run_chaos(
+        os.path.join(work, "svc"), n_tenants=CHAOS_N, ngen=CHAOS_NGEN,
+        kill_at_step=CHAOS_KILL_STEP, segment_len=CHAOS_SEG,
+        max_lanes=CHAOS_LANES, clients=CHAOS_CLIENTS,
+        converge_timeout_s=900)
+    identical = sum(1 for tid, d in out["digests"].items()
+                    if ref.get(tid) == d)
+    shutil.rmtree(work, ignore_errors=True)
+
+    cfg = {"tenants": CHAOS_N, "ngen": CHAOS_NGEN,
+           "segment_len": CHAOS_SEG, "lanes": CHAOS_LANES,
+           "clients": CHAOS_CLIENTS, "kill_at_step": CHAOS_KILL_STEP}
+    rows = [
+        {"metric": "chaos_kill_delivered",
+         "value": out["kill_rc"] == -9, "unit": "bool",
+         "kill_rc": out["kill_rc"], **cfg, "env": envfp},
+        {"metric": "chaos_lost_jobs",
+         "value": len(out["lost"]), "unit": "jobs", "gate": "== 0",
+         "lost": out["lost"][:20], **cfg, "env": envfp},
+        {"metric": "chaos_digest_identity_frac",
+         "value": round(identical / CHAOS_N, 6), "unit": "frac",
+         "gate": "== 1.0", "identical": identical,
+         "compared": len(out["digests"]), **cfg, "env": envfp},
+        {"metric": "chaos_recovery_seconds",
+         "value": out["recovery_s"], "unit": "seconds",
+         "gate": f"<= {CHAOS_RECOVERY_BUDGET_S:.0f}",
+         "note": "kill -> last tenant converged on the restarted "
+                 "service (cold start + WAL replay + resume included)",
+         **cfg, "env": envfp},
+        {"metric": "chaos_wall_seconds",
+         "value": out["wall_s"], "unit": "seconds",
+         "client_errors": out["client_errors"],
+         "reference_inprocess_s": round(ref_s, 3), **cfg,
+         "env": envfp},
+    ]
+    if out_path:
+        payload = {
+            "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "env": envfp,
+            "config": cfg,
+            "tail": "\n".join(json.dumps(r) for r in rows),
+        }
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=1)
+    return rows
+
+
 # ---------------------------------- resilience overhead (pop=100k) ----
 
 #: headline config length for the paired segmented-vs-monolithic rows
@@ -2349,6 +2434,19 @@ if __name__ == "__main__":
         out = (nxt if nxt and not nxt.startswith("--")
                else "BENCH_SERVING.json")
         for row in serving_lines(out):
+            print(json.dumps(row), flush=True)
+    elif "--service-chaos" in sys.argv:
+        # the fault-tolerance acceptance measurement (ISSUE 12): a
+        # child service SIGKILLed mid-run under 200 live retrying
+        # tenants, supervisor restart over the same root — committed
+        # as BENCH_CHAOS.json; bench_report.py --tripwire gates zero
+        # lost jobs / 100% digest identity / bounded recovery wall
+        jax.config.update("jax_platforms", "cpu")
+        i = sys.argv.index("--service-chaos")
+        nxt = sys.argv[i + 1] if i + 1 < len(sys.argv) else None
+        out = (nxt if nxt and not nxt.startswith("--")
+               else "BENCH_CHAOS.json")
+        for row in service_chaos_lines(out):
             print(json.dumps(row), flush=True)
     elif "--service" in sys.argv:
         # the network-service acceptance measurement (ISSUE 11): 1k

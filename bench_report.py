@@ -420,6 +420,74 @@ def service_tripwire(max_overhead_pct: float = SERVICE_OVERHEAD_PCT
     return tripped
 
 
+#: recovery-wall budget (seconds) for the chaos gate: kill → last
+#: tenant converged on the restarted service (child cold start + WAL
+#: replay + checkpoint resume on one CPU core) — matches
+#: bench.CHAOS_RECOVERY_BUDGET_S
+CHAOS_RECOVERY_BUDGET_S = 120.0
+
+
+def chaos_tripwire(budget_s: float = CHAOS_RECOVERY_BUDGET_S) -> int:
+    """The fault-tolerance gate (ISSUE 12). The latest
+    BENCH_CHAOS*.json — a mid-run ``kill -9`` of the service under 200
+    live retrying tenants, supervisor restart over the same root —
+    must show (1) the kill actually delivered, (2) **zero lost jobs**,
+    (3) **100% wire-digest identity** against the uninterrupted
+    in-process run, and (4) recovery wall time within ``budget_s``.
+    Returns the number of tripped rows. (No cross-file wall-clock
+    diff: recovery time is box-load noisy; the fixed budget is the
+    contract.)"""
+    files = sorted(glob.glob(os.path.join(HERE, "BENCH_CHAOS*.json")))
+    if not files:
+        print("chaos tripwire: no committed BENCH_CHAOS*.json yet")
+        return 0
+    rows = _bench_rows(files[-1])
+    print(f"\n## Service chaos ({os.path.basename(files[-1])})\n")
+    tripped = 0
+
+    kill = rows.get("chaos_kill_delivered")
+    if kill is None or kill.get("value") is not True:
+        print("- **REGRESSION**: the kill never fired (rc="
+              f"{(kill or {}).get('kill_rc')}) — the run proved "
+              "nothing")
+        tripped += 1
+    else:
+        print(f"- kill -9 delivered at driver step "
+              f"{kill.get('kill_at_step', '?')} ok")
+
+    lost = rows.get("chaos_lost_jobs")
+    if lost is None or lost.get("value") != 0:
+        print(f"- **REGRESSION**: {(lost or {}).get('value', '?')} "
+              "job(s) lost across the kill/restart (gate: 0) — the "
+              "WAL/idempotency/resume chain is leaking work")
+        tripped += 1
+    else:
+        print(f"- lost jobs: 0 of {lost.get('tenants', '?')} ok")
+
+    ident = rows.get("chaos_digest_identity_frac")
+    if ident is None or ident.get("value") != 1.0:
+        print(f"- **REGRESSION**: digest identity "
+              f"{(ident or {}).get('value', '?')} (gate: 1.0) — "
+              "recovery is changing numerics")
+        tripped += 1
+    else:
+        print(f"- wire digests: {ident.get('identical', '?')}/"
+              f"{ident.get('compared', '?')} bit-identical to the "
+              "uninterrupted run ok")
+
+    rec = rows.get("chaos_recovery_seconds")
+    if rec is None or not isinstance(rec.get("value"), (int, float)):
+        print("- recovery-seconds row missing")
+        tripped += 1
+    else:
+        ok = rec["value"] <= budget_s
+        print(f"- recovery wall: {rec['value']}s (budget "
+              f"{budget_s:.0f}s) " + ("ok" if ok else
+              "**REGRESSION** (restart recovery got slow)"))
+        tripped += 0 if ok else 1
+    return tripped
+
+
 #: fractional full-observability overhead beyond which the costs pair
 #: trips (observatory + metrics + flight recorder vs bare segmented
 #: run, same session, pop=100k)
@@ -563,6 +631,7 @@ def tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
     tripped += fusion_tripwire()
     tripped += serving_tripwire()
     tripped += service_tripwire()
+    tripped += chaos_tripwire()
     tripped += mesh_tripwire()
     tripped += costs_tripwire()
     return tripped

@@ -27,13 +27,18 @@ from deap_tpu.resilience.engine import (
 )
 from deap_tpu.resilience.faultinject import (
     CorruptCheckpoint,
+    DelaySegment,
+    DropResponse,
     FailSegments,
     Fault,
     FaultPlan,
     InjectedCrash,
+    InjectedDrop,
     InjectedTransient,
     KillAt,
+    KillServiceAt,
     PreemptAt,
+    TornWAL,
     corrupt_file,
     nan_inject_evaluate,
 )
@@ -47,13 +52,18 @@ __all__ = [
     "classify_error",
     "quarantine_non_finite",
     "CorruptCheckpoint",
+    "DelaySegment",
+    "DropResponse",
     "FailSegments",
     "Fault",
     "FaultPlan",
     "InjectedCrash",
+    "InjectedDrop",
     "InjectedTransient",
     "KillAt",
+    "KillServiceAt",
     "PreemptAt",
+    "TornWAL",
     "corrupt_file",
     "nan_inject_evaluate",
 ]

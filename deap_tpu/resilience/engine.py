@@ -70,6 +70,9 @@ import jax.numpy as jnp
 from jax import lax
 
 from deap_tpu import algorithms as algos
+# RetryPolicy lives in the stdlib-only deap_tpu.resilience.retry so the
+# no-jax service client can reuse the policy; re-exported here unchanged.
+from deap_tpu.resilience.retry import RetryPolicy
 from deap_tpu.support.checkpoint import AsyncCheckpointWriter, Checkpointer
 
 __all__ = ["Preempted", "RetryPolicy", "ResilientRun", "classify_error",
@@ -113,21 +116,6 @@ def classify_error(exc: BaseException) -> Optional[str]:
     return None
 
 
-class RetryPolicy:
-    """Bounded exponential backoff for transient segment failures."""
-
-    def __init__(self, max_retries: int = 2, backoff_s: float = 0.05,
-                 backoff_factor: float = 2.0, max_backoff_s: float = 5.0,
-                 sleep: Callable[[float], None] = time.sleep):
-        self.max_retries = int(max_retries)
-        self.backoff_s = float(backoff_s)
-        self.backoff_factor = float(backoff_factor)
-        self.max_backoff_s = float(max_backoff_s)
-        self.sleep = sleep
-
-    def delay(self, attempt: int) -> float:
-        return min(self.backoff_s * self.backoff_factor ** attempt,
-                   self.max_backoff_s)
 
 
 # --------------------------------------------------- non-finite guard ----

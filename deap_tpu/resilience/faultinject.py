@@ -29,18 +29,43 @@ Catalogue:
 - :func:`nan_inject_evaluate` — wrap an evaluator so chosen rows come
   back NaN, exercising the quarantine wrapper and the ``non_finite``
   alarm.
+
+Service-shaped faults (ISSUE 12) — fired by the
+:class:`~deap_tpu.serving.service.EvolutionService` ``fault_plan``
+event stream (``step`` after every driver iteration, ``boundary``
+inside the segment drain, ``http_response`` before a response is
+written, ``wal_append`` after an admission-WAL record lands):
+
+- :class:`DropResponse` — the network loses a response: the handler
+  raises :class:`InjectedDrop`, the service closes the connection
+  without replying — the client must retry, and only an idempotency
+  key keeps the retry from admitting a twin job.
+- :class:`DelaySegment` — wedge the driver thread for ``delay_s`` at a
+  chosen step, the deterministic stand-in for a hung segment; the
+  watchdog must notice (``driver_stall``), flip ``/healthz`` to 503
+  and re-arm when the driver recovers.
+- :class:`KillServiceAt` — ``SIGKILL`` this process at a chosen driver
+  step or boundary: the real crash the admission WAL + checkpoint
+  recovery path exists for. Only meaningful in a child process (the
+  chaos harness, :mod:`deap_tpu.serving.chaos`).
+- :class:`TornWAL` — tear the tail off the admission WAL right after a
+  record lands (then optionally ``SIGKILL``), emulating a power cut
+  mid-append; replay must drop exactly the torn (never-ACKed) record.
 """
 
 from __future__ import annotations
 
 import os
 import signal
+import time
 from typing import Any, List, Optional
 
 import jax.numpy as jnp
 
-__all__ = ["InjectedCrash", "InjectedTransient", "Fault", "FaultPlan",
-           "KillAt", "PreemptAt", "CorruptCheckpoint", "FailSegments",
+__all__ = ["InjectedCrash", "InjectedTransient", "InjectedDrop",
+           "Fault", "FaultPlan", "KillAt", "PreemptAt",
+           "CorruptCheckpoint", "FailSegments", "DropResponse",
+           "DelaySegment", "KillServiceAt", "TornWAL",
            "nan_inject_evaluate", "corrupt_file"]
 
 
@@ -53,6 +78,12 @@ class InjectedTransient(RuntimeError):
     """A simulated infrastructure error whose message carries a
     transient marker (``RESOURCE_EXHAUSTED`` etc.) so
     :func:`~deap_tpu.resilience.engine.classify_error` retries it."""
+
+
+class InjectedDrop(RuntimeError):
+    """A simulated lost response: the service's HTTP handler catches
+    this and closes the connection without writing a reply — the
+    client-visible shape of a network partition mid-response."""
 
 
 class Fault:
@@ -190,6 +221,97 @@ class FailSegments(Fault):
             raise InjectedTransient(
                 f"{self.marker}: injected transient failure "
                 f"(attempt {ctx['attempt']})")
+
+
+# ---------------------------------------------- service-shaped faults ----
+
+
+class DropResponse(Fault):
+    """Drop the response of the next ``times`` requests whose route
+    contains ``route_substr`` — fired on the service's
+    ``http_response`` event *after* the request was processed, so the
+    server-side effect (an accepted job, a durable WAL record) stands
+    while the client never learns of it. The retry that follows is
+    exactly the duplicate-submit case idempotency keys exist for."""
+
+    def __init__(self, route_substr: str, times: int = 1):
+        super().__init__()
+        self.route_substr = str(route_substr)
+        self.times = int(times)
+
+    def fire(self, event: str, **ctx) -> None:
+        if event == "http_response" and self.fired < self.times \
+                and self.route_substr in str(ctx.get("route", "")):
+            self.fired += 1
+            raise InjectedDrop(
+                f"injected response drop on {ctx.get('route')} "
+                f"(#{self.fired}/{self.times})")
+
+
+class DelaySegment(Fault):
+    """Wedge the driver thread for ``delay_s`` seconds at driver step
+    ``step`` (event ``step``, or ``boundary`` with ``event='boundary'``)
+    — the deterministic hung-segment stand-in the watchdog must
+    detect and, once the sleep returns, recover from."""
+
+    def __init__(self, step: int, delay_s: float, event: str = "step"):
+        super().__init__()
+        self.step = int(step)
+        self.delay_s = float(delay_s)
+        self.event = str(event)
+
+    def fire(self, event: str, **ctx) -> None:
+        if event == self.event and not self.fired \
+                and int(ctx.get("step", -1)) >= self.step:
+            self.fired += 1
+            time.sleep(self.delay_s)
+
+
+class KillServiceAt(Fault):
+    """``SIGKILL`` this process at driver step ``step`` (or at a
+    segment ``boundary`` with ``event='boundary'`` — mid-drain, after
+    compute but amid bookkeeping: the worst window). No handler runs,
+    no drain happens, nothing flushes — recovery is entirely the
+    admission WAL + checkpoint replay path. Use inside a chaos-harness
+    child process only (:mod:`deap_tpu.serving.chaos`)."""
+
+    def __init__(self, step: int, event: str = "step",
+                 signum: int = signal.SIGKILL):
+        super().__init__()
+        self.step = int(step)
+        self.event = str(event)
+        self.signum = signum
+
+    def fire(self, event: str, **ctx) -> None:
+        if event == self.event and not self.fired \
+                and int(ctx.get("step", -1)) >= self.step:
+            self.fired += 1
+            os.kill(os.getpid(), self.signum)
+
+
+class TornWAL(Fault):
+    """After the ``seq``-th admission-WAL append, tear ``nbytes`` off
+    the log's tail (a power cut mid-append) and — default — raise
+    :class:`InjectedCrash` so the submit that wrote the record never
+    ACKs. The restarted WAL must self-heal the tear and replay
+    everything *except* the torn record."""
+
+    def __init__(self, seq: int, nbytes: int = 7,
+                 then_crash: bool = True):
+        super().__init__()
+        self.seq = int(seq)
+        self.nbytes = int(nbytes)
+        self.then_crash = then_crash
+
+    def fire(self, event: str, **ctx) -> None:
+        if event == "wal_append" and not self.fired \
+                and int(ctx.get("seq", -1)) >= self.seq:
+            self.fired += 1
+            corrupt_file(ctx["path"], mode="truncate",
+                         offset=-self.nbytes)
+            if self.then_crash:
+                raise InjectedCrash(
+                    f"injected crash after tearing {ctx['path']}")
 
 
 def nan_inject_evaluate(evaluate, rows: Any):

@@ -1,0 +1,56 @@
+"""RetryPolicy — bounded, optionally jittered exponential backoff.
+
+Factored out of :mod:`deap_tpu.resilience.engine` (which re-exports it
+unchanged) into a **stdlib-only** module so the no-jax halves of the
+service plane can reuse the exact same policy object: the
+:class:`~deap_tpu.serving.client.ServiceClient` honours the server's
+``Retry-After`` on 429/503 and backs off on connection errors with
+this policy, and a submit box must never initialise an XLA backend
+just to compute a backoff schedule (the same constraint that keeps
+``serving/wire.py`` and ``telemetry/metrics.py`` import-light).
+
+Jitter: retries synchronised across hundreds of clients re-collide on
+every attempt (the thundering-herd failure mode of a service restart);
+``jitter=0.5`` spreads each delay uniformly over ``[delay*(1-j),
+delay*(1+j)]`` using the policy's own seeded ``random.Random`` — the
+schedule stays deterministic per (seed, attempt sequence), which is
+what lets chaos tests replay exact retry timelines.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional
+
+__all__ = ["RetryPolicy"]
+
+
+class RetryPolicy:
+    """Bounded exponential backoff for transient failures.
+
+    ``delay(attempt)`` is ``backoff_s * backoff_factor**attempt``
+    clamped to ``max_backoff_s``, spread by ``jitter`` (fraction, 0 =
+    deterministic). ``sleep`` is injectable so tests never wait."""
+
+    def __init__(self, max_retries: int = 2, backoff_s: float = 0.05,
+                 backoff_factor: float = 2.0, max_backoff_s: float = 5.0,
+                 sleep: Callable[[float], None] = time.sleep,
+                 jitter: float = 0.0, seed: Optional[int] = 0):
+        self.max_retries = int(max_retries)
+        self.backoff_s = float(backoff_s)
+        self.backoff_factor = float(backoff_factor)
+        self.max_backoff_s = float(max_backoff_s)
+        self.sleep = sleep
+        self.jitter = float(jitter)
+        self._rng = random.Random(seed)
+
+    def delay(self, attempt: int) -> float:
+        base = min(self.backoff_s * self.backoff_factor ** attempt,
+                   self.max_backoff_s)
+        if not self.jitter:
+            return base
+        lo = base * (1.0 - self.jitter)
+        hi = base * (1.0 + self.jitter)
+        return min(lo + (hi - lo) * self._rng.random(),
+                   self.max_backoff_s)

@@ -45,9 +45,11 @@ from deap_tpu.serving.autoscale import (
 )
 from deap_tpu.serving.service import EvolutionService
 from deap_tpu.serving.client import ServiceClient, ServiceError
+from deap_tpu.serving.wal import AdmissionWAL
 from deap_tpu.support.compilecache import enable_compile_cache
 
 __all__ = [
+    "AdmissionWAL",
     "AutoscaleConfig",
     "AutoscaleDecision",
     "AutoscalePolicy",

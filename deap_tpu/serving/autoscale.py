@@ -66,6 +66,14 @@ class AutoscaleConfig:
     cooldown: int = 2
     #: a resident this many segments old is spillable under pressure
     spill_idle_segments: int = 4
+    #: when the snapshot carries the true idleness signal
+    #: (``gens_since_interaction``, the third element of each ``idle``
+    #: tuple), a resident is spillable only after this many
+    #: generations without a client interaction — mid-job residents
+    #: whose clients are long-polling (gens-idle ~0) are never
+    #: spilled, no matter how long they have held a lane (the
+    #: spill-thrash fix: residency age alone spilled busy tenants)
+    spill_idle_gens: int = 1
     #: emit a prewarm target for the next lattice point as soon as
     #: pressure is first observed (one step ahead of the scale-up)
     prewarm_ahead: bool = True
@@ -179,11 +187,21 @@ class AutoscalePolicy:
                         ctl.over = 0
                     elif float(stats.get("occupancy", 0.0)) >= 1.0:
                         # at the lane ceiling with a queue: relieve
-                        # pressure by spilling long-resident tenants
+                        # pressure by spilling genuinely idle tenants
+                        # — gens-since-interaction first (a parked
+                        # ask-tell tenant nobody polls), residency age
+                        # as the tie-break / legacy 2-tuple fallback
+                        def _spillable(t):
+                            if t[1] < cfg.spill_idle_segments:
+                                return False
+                            return (len(t) < 3
+                                    or t[2] >= cfg.spill_idle_gens)
+
                         spillable = sorted(
                             (t for t in stats.get("idle", ())
-                             if t[1] >= cfg.spill_idle_segments),
-                            key=lambda t: -t[1])
+                             if _spillable(t)),
+                            key=lambda t: (-(t[2] if len(t) > 2
+                                             else t[1]), -t[1]))
                         take = spillable[:int(stats["queue_depth"])]
                         if take:
                             d.spill.extend(t[0] for t in take)

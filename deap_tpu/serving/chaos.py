@@ -1,0 +1,351 @@
+"""Network chaos harness — kill -9 / restart the service under live load.
+
+The proof rig for the ISSUE 12 fault-tolerance layer. Everything the
+admission WAL, idempotency keys, checkpoint resume and client retry
+policy promise is one sentence: *a ``SIGKILL`` of the service process,
+at the worst moment, under live concurrent client traffic, loses no
+job and changes no bit of any tenant's result*. This module makes that
+sentence executable:
+
+- a **child entry point** (``python -m deap_tpu.serving.chaos``) runs
+  an :class:`~deap_tpu.serving.service.EvolutionService` with a
+  deterministic :class:`~deap_tpu.resilience.faultinject.KillServiceAt`
+  fault plan — the kill fires at an exact driver step (or mid-boundary),
+  replayable run after run;
+- :func:`run_chaos` is the **parent harness**: spawn the child, drive
+  ``clients`` concurrent threads of retrying
+  :class:`~deap_tpu.serving.client.ServiceClient`\\ s (jittered
+  :class:`~deap_tpu.resilience.retry.RetryPolicy`, idempotency keys on
+  every submit), detect the kill, respawn the service over the same
+  root (WAL replay + checkpoint resume), and keep the same clients
+  retrying until every tenant converged;
+- :func:`reference_digests` runs the *same* jobs through the
+  :class:`~deap_tpu.serving.scheduler.Scheduler` in-process,
+  uninterrupted — the PR 11 wire digest makes "chaos run ==
+  uninterrupted run" one string compare per tenant.
+
+Consumed by ``tests/test_service_chaos.py`` (``-m chaos``) and
+``bench.py --service-chaos`` (``BENCH_CHAOS.json``, gated by
+``bench_report.py --tripwire``'s ``chaos_tripwire``: zero lost jobs,
+100% digest identity, bounded recovery time).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["chaos_problems", "reference_digests", "run_chaos",
+           "child_main"]
+
+#: default job shape: tiny pops, enough generations that a mid-run
+#: kill lands with tenants in every state (queued / resident /
+#: checkpointed / finished)
+CHAOS_JOB = dict(pop=16, length=32, ngen=12)
+
+
+def chaos_problems():
+    """The harness's problem registry: per-tenant seeded OneMax jobs
+    that are bit-reproducible from ``(tenant_id, params)`` alone —
+    the WAL-replay determinism contract, and what lets the restarted
+    service recompute a lost tenant to the identical digest."""
+    import jax
+    import jax.numpy as jnp
+
+    from deap_tpu import ops
+    from deap_tpu.core.fitness import FitnessSpec
+    from deap_tpu.core.population import init_population
+    from deap_tpu.core.toolbox import Toolbox
+    from deap_tpu.serving.tenant import Job
+
+    tb = Toolbox()
+    tb.register("evaluate", lambda g: g.sum(-1).astype(jnp.float32))
+    tb.register("mate", ops.cx_two_point)
+    tb.register("mutate", ops.mut_flip_bit, indpb=0.05)
+    tb.register("select", ops.sel_tournament, tournsize=3)
+    spec = FitnessSpec((1.0,))
+
+    def onemax(tid, params):
+        seed = int(params["seed"])
+        pop = init_population(
+            jax.random.key(seed),
+            int(params.get("pop", CHAOS_JOB["pop"])),
+            ops.bernoulli_genome(
+                int(params.get("length", CHAOS_JOB["length"]))),
+            spec)
+        return Job(tenant_id=tid, family="ea_simple", toolbox=tb,
+                   key=jax.random.key(20_000 + seed), init=pop,
+                   ngen=int(params.get("ngen", CHAOS_JOB["ngen"])),
+                   hyper={"cxpb": 0.5, "mutpb": 0.2},
+                   program="chaos_onemax")
+
+    return {"onemax": onemax}
+
+
+def chaos_specs(n: int, ngen: Optional[int] = None) -> List[Tuple[str, dict]]:
+    """``n`` job specs ``(tenant_id, params)`` on the harness shape."""
+    params = dict(CHAOS_JOB)
+    if ngen is not None:
+        params["ngen"] = int(ngen)
+    return [(f"c{i:04d}", {"seed": i, **params}) for i in range(n)]
+
+
+def reference_digests(root: str, specs: Sequence[Tuple[str, dict]], *,
+                      segment_len: int = 2, max_lanes: int = 8
+                      ) -> Dict[str, str]:
+    """The uninterrupted in-process run — the bit-identity reference
+    every chaos survivor must match."""
+    from deap_tpu.serving.scheduler import Scheduler
+    from deap_tpu.serving.wire import result_digest
+
+    onemax = chaos_problems()["onemax"]
+    with Scheduler(str(root), max_lanes=max_lanes,
+                   segment_len=segment_len, fair_quantum=None,
+                   checkpoint_every=0, telemetry=False,
+                   metrics=False) as sched:
+        for tid, params in specs:
+            sched.submit(onemax(tid, params))
+        results = sched.run()
+    return {tid: result_digest(r) for tid, r in results.items()}
+
+
+# -------------------------------------------------------- child side ----
+
+def child_main(argv: Optional[Sequence[str]] = None) -> None:
+    """``python -m deap_tpu.serving.chaos`` — one service process,
+    optionally scheduled to SIGKILL itself at an exact driver step.
+    Writes ``<ready>`` (atomic rename) with the bound URL once
+    serving; exits cleanly after a SIGTERM drain."""
+    p = argparse.ArgumentParser()
+    p.add_argument("--root", required=True)
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--ready", required=True)
+    p.add_argument("--kill-at", type=int, default=None)
+    p.add_argument("--kill-event", default="step",
+                   choices=("step", "boundary"))
+    p.add_argument("--segment-len", type=int, default=2)
+    p.add_argument("--max-lanes", type=int, default=8)
+    p.add_argument("--max-pending", type=int, default=0)
+    p.add_argument("--watchdog-s", type=float, default=0.0)
+    p.add_argument("--platform", default="cpu")
+    args = p.parse_args(argv)
+
+    if args.platform:
+        # the harness is a CPU rig by default (the test tier runs with
+        # no accelerator); pass --platform '' to serve on hardware
+        os.environ.setdefault("JAX_PLATFORMS", args.platform)
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+
+    from deap_tpu.resilience.faultinject import FaultPlan, KillServiceAt
+    from deap_tpu.serving.service import EvolutionService
+
+    plan = None
+    if args.kill_at is not None:
+        plan = FaultPlan([KillServiceAt(args.kill_at,
+                                        event=args.kill_event)])
+    svc = EvolutionService(
+        args.root, chaos_problems(), port=args.port,
+        fault_plan=plan,
+        max_pending=(args.max_pending or None),
+        watchdog_s=(args.watchdog_s or None),
+        max_lanes=args.max_lanes, segment_len=args.segment_len,
+        fair_quantum=None, checkpoint_every=1, telemetry=False,
+        metrics=False)
+    ds = svc.install_signal_handlers()
+    tmp = args.ready + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(svc.url)
+    os.replace(tmp, args.ready)
+    try:
+        while not svc.drained:
+            time.sleep(0.05)
+    finally:
+        ds.uninstall()
+        svc.close()
+
+
+# ------------------------------------------------------- parent side ----
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_child(root: str, port: int, ready: str, *,
+                 kill_at: Optional[int], kill_event: str,
+                 segment_len: int, max_lanes: int,
+                 max_pending: Optional[int],
+                 python: str) -> subprocess.Popen:
+    try:
+        os.remove(ready)
+    except FileNotFoundError:
+        pass
+    cmd = [python, "-m", "deap_tpu.serving.chaos",
+           "--root", root, "--port", str(port), "--ready", ready,
+           "--segment-len", str(segment_len),
+           "--max-lanes", str(max_lanes),
+           "--max-pending", str(max_pending or 0)]
+    if kill_at is not None:
+        cmd += ["--kill-at", str(kill_at), "--kill-event", kill_event]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.Popen(cmd, env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+
+
+def _wait_ready(proc: subprocess.Popen, ready: str,
+                timeout: float = 120.0) -> str:
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if os.path.exists(ready):
+            with open(ready) as fh:
+                url = fh.read().strip()
+            if url:
+                return url
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"chaos child exited rc={proc.returncode} before ready")
+        time.sleep(0.05)
+    raise RuntimeError("chaos child never became ready")
+
+
+def run_chaos(root: str, *, n_tenants: int = 8,
+              ngen: Optional[int] = None,
+              kill_at_step: Optional[int] = 4,
+              kill_event: str = "step",
+              segment_len: int = 2, max_lanes: int = 8,
+              clients: int = 4, max_pending: Optional[int] = None,
+              converge_timeout_s: float = 300.0,
+              python: str = sys.executable) -> Dict[str, Any]:
+    """The kill/restart acceptance run. Returns::
+
+        {"digests": {tid: digest}, "lost": [tid...],
+         "kill_rc": -9, "recovery_s": float,
+         "client_errors": int, "wall_s": float}
+
+    ``recovery_s`` is wall time from the child's death to the last
+    tenant converging on the restarted service; ``lost`` is every
+    tenant that never produced a result within ``converge_timeout_s``
+    (the chaos pin requires it empty).
+    """
+    from deap_tpu.serving.client import RetryPolicy, ServiceClient
+
+    os.makedirs(root, exist_ok=True)
+    port = _free_port()
+    ready = os.path.join(root, "ready.url")
+    specs = chaos_specs(n_tenants, ngen=ngen)
+    url = f"http://127.0.0.1:{port}"
+
+    proc = _spawn_child(root, port, ready, kill_at=kill_at_step,
+                        kill_event=kill_event,
+                        segment_len=segment_len, max_lanes=max_lanes,
+                        max_pending=max_pending, python=python)
+    _wait_ready(proc, ready)
+
+    kill_info: Dict[str, Any] = {"rc": None, "t": None, "proc2": None}
+
+    def supervise():
+        # the kill fires inside the child; the parent's job is to see
+        # it die and restart the service over the same root — the
+        # supervisor a real deployment provides
+        proc.wait()
+        kill_info["rc"] = proc.returncode
+        kill_info["t"] = time.monotonic()
+        p2 = _spawn_child(root, port, ready, kill_at=None,
+                          kill_event=kill_event,
+                          segment_len=segment_len,
+                          max_lanes=max_lanes,
+                          max_pending=max_pending, python=python)
+        kill_info["proc2"] = p2
+        _wait_ready(p2, ready)
+
+    sup = None
+    if kill_at_step is not None:
+        sup = threading.Thread(target=supervise, daemon=True)
+        sup.start()
+
+    digests: Dict[str, str] = {}
+    dig_lock = threading.Lock()
+    errors = [0]
+    stop_at = time.monotonic() + converge_timeout_s
+    per = (len(specs) + clients - 1) // clients
+    t0 = time.monotonic()
+
+    def drive(ci: int):
+        chunk = specs[ci * per:(ci + 1) * per]
+        if not chunk:
+            return
+        # jittered backoff, seeded per client: deterministic schedule,
+        # de-synchronised across the fleet
+        retry = RetryPolicy(max_retries=4, backoff_s=0.1,
+                            backoff_factor=2.0, max_backoff_s=1.0,
+                            jitter=0.5, seed=1000 + ci)
+        c = ServiceClient(url, timeout=30, retry=retry)
+        pending = {tid: {"problem": "onemax", "params": params,
+                         "tenant_id": tid,
+                         "idempotency_key": f"key-{tid}"}
+                   for tid, params in chunk}
+        while pending and time.monotonic() < stop_at:
+            try:
+                # idempotent re-offer of everything unresolved: live
+                # tenants map back via their keys, tenants the restart
+                # no longer knows (finished pre-kill, result unfetched)
+                # are re-admitted and recomputed deterministically
+                c.submit_many(list(pending.values()))
+                got = c.results_many(sorted(pending), wait=True,
+                                     timeout=5)
+            except Exception:
+                errors[0] += 1
+                c.close()
+                time.sleep(0.2)
+                continue
+            for tid, entry in got.items():
+                res = entry.get("result")
+                if res is not None:
+                    with dig_lock:
+                        digests[tid] = res["digest"]
+                    pending.pop(tid, None)
+        c.close()
+
+    threads = [threading.Thread(target=drive, args=(i,))
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=converge_timeout_s + 60)
+    wall_s = time.monotonic() - t0
+    done_t = time.monotonic()
+
+    # graceful teardown of whichever child is serving now
+    live = kill_info["proc2"] or proc
+    if live.poll() is None:
+        live.terminate()   # SIGTERM → drain → clean exit
+        try:
+            live.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            live.kill()
+
+    lost = sorted(tid for tid, _ in specs if tid not in digests)
+    recovery_s = (done_t - kill_info["t"]
+                  if kill_info["t"] is not None else None)
+    return {"digests": digests, "lost": lost,
+            "kill_rc": kill_info["rc"],
+            "recovery_s": (round(recovery_s, 3)
+                           if recovery_s is not None else None),
+            "client_errors": errors[0],
+            "wall_s": round(wall_s, 3)}
+
+
+if __name__ == "__main__":
+    child_main()

@@ -29,41 +29,76 @@ from typing import Any, Dict, Iterator, List, Optional
 
 if "deap_tpu" in sys.modules:
     from deap_tpu.serving import wire
+    from deap_tpu.resilience.retry import RetryPolicy
 else:
     # standalone load (no deap_tpu in the process — e.g. a submit box
-    # that must never initialise jax): pull the codec in by file path
-    # instead of importing the package, whose __init__ imports jax.
-    # tests/test_service.py pins the no-jax guarantee in a subprocess.
+    # that must never initialise jax): pull the codec and the retry
+    # policy in by file path instead of importing the package, whose
+    # __init__ imports jax. tests/test_service.py pins the no-jax
+    # guarantee in a subprocess.
     import importlib.util as _ilu
     import os as _os
 
-    _spec = _ilu.spec_from_file_location(
-        "_deap_tpu_serving_wire_standalone",
-        _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
-                      "wire.py"))
-    wire = _ilu.module_from_spec(_spec)
-    _spec.loader.exec_module(wire)
+    def _load(name: str, *relpath: str):
+        spec = _ilu.spec_from_file_location(
+            name,
+            _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                          *relpath))
+        mod = _ilu.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
 
-__all__ = ["ServiceClient", "ServiceError"]
+    wire = _load("_deap_tpu_serving_wire_standalone", "wire.py")
+    RetryPolicy = _load("_deap_tpu_resilience_retry_standalone",
+                        _os.pardir, "resilience", "retry.py").RetryPolicy
+
+__all__ = ["ServiceClient", "ServiceError", "RetryPolicy"]
 
 
 class ServiceError(RuntimeError):
     """A non-2xx response from the service (``.code`` holds the HTTP
-    status; 401/403 auth, 404 unknown, 429 quota, 503 draining)."""
+    status; 401/403 auth, 404 unknown, 429 quota/overload — then
+    ``.retry_after`` carries the server's Retry-After seconds — 503
+    draining, 504 deadline exceeded)."""
 
-    def __init__(self, code: int, message: str):
+    def __init__(self, code: int, message: str,
+                 retry_after: Optional[float] = None,
+                 payload: Optional[Dict[str, Any]] = None):
         super().__init__(f"HTTP {code}: {message}")
         self.code = code
+        self.retry_after = retry_after
+        self.payload = payload or {}
+
+
+#: HTTP statuses a retrying client may safely re-attempt: 429 is an
+#: explicit "come back later" (load shed / quota) and 503 a draining /
+#: restarting service. 504 (deadline exceeded) is FINAL by design and
+#: anything else is the caller's bug, not the network's.
+RETRYABLE_STATUSES = (429, 503)
 
 
 class ServiceClient:
+    """One service connection, optionally self-healing.
+
+    ``retry=RetryPolicy(...)`` turns on transparent retries: connection
+    errors (a killed/restarting service) back off on the policy's
+    jittered exponential schedule, and 429/503 responses honour the
+    server's ``Retry-After`` (never less than the policy's own delay).
+    Retrying a **submit** is only safe with an idempotency key — the
+    first attempt may have been durably accepted while its response
+    was lost; the key maps the retry back to the same tenant
+    (``idempotent_replay``). Without ``retry`` the behaviour is the
+    PR 11 one: a single reconnect attempt on a stale keep-alive."""
+
     def __init__(self, base_url: str, token: Optional[str] = None,
-                 timeout: float = 600.0):
+                 timeout: float = 600.0,
+                 retry: Optional[RetryPolicy] = None):
         u = urllib.parse.urlparse(base_url)
         self.host = u.hostname or "127.0.0.1"
         self.port = u.port or 80
         self.token = token
         self.timeout = timeout
+        self.retry = retry
         self._conn: Optional[http.client.HTTPConnection] = None
 
     # ------------------------------------------------------- plumbing ----
@@ -80,35 +115,62 @@ class ServiceClient:
                 self.host, self.port, timeout=self.timeout)
         return self._conn
 
+    def _request_once(self, method: str, path: str,
+                      body: Optional[dict] = None):
+        conn = self._connect()
+        conn.request(method, path,
+                     body=(json.dumps(body).encode()
+                           if body is not None else None),
+                     headers=self._headers())
+        resp = conn.getresponse()
+        return resp, resp.read()
+
     def _request(self, method: str, path: str,
                  body: Optional[dict] = None) -> Any:
-        conn = self._connect()
-        try:
-            conn.request(method, path,
-                         body=(json.dumps(body).encode()
-                               if body is not None else None),
-                         headers=self._headers())
-            resp = conn.getresponse()
-            data = resp.read()
-        except (http.client.HTTPException, ConnectionError, OSError):
-            # stale keep-alive (server restarted): one reconnect
-            self.close()
-            conn = self._connect()
-            conn.request(method, path,
-                         body=(json.dumps(body).encode()
-                               if body is not None else None),
-                         headers=self._headers())
-            resp = conn.getresponse()
-            data = resp.read()
-        try:
-            payload = json.loads(data) if data else {}
-        except json.JSONDecodeError:
-            payload = {"error": data.decode("utf-8", "replace")[:200]}
-        if resp.status >= 400:
-            raise ServiceError(resp.status,
-                               payload.get("error", resp.reason))
-        payload["_status"] = resp.status
-        return payload
+        attempt = 0
+        max_retries = (self.retry.max_retries
+                       if self.retry is not None else 1)
+        while True:
+            retry_after = None
+            try:
+                resp, data = self._request_once(method, path, body)
+            except (http.client.HTTPException, ConnectionError,
+                    OSError):
+                # stale keep-alive or a killed/restarting service:
+                # reconnect and (with a policy) back off jittered
+                self.close()
+                if attempt >= max_retries:
+                    raise
+                if self.retry is not None:
+                    self.retry.sleep(self.retry.delay(attempt))
+                attempt += 1
+                continue
+            try:
+                payload = json.loads(data) if data else {}
+            except json.JSONDecodeError:
+                payload = {"error":
+                           data.decode("utf-8", "replace")[:200]}
+            if resp.status >= 400:
+                ra = resp.getheader("Retry-After")
+                try:
+                    retry_after = float(ra) if ra else None
+                except ValueError:
+                    retry_after = None
+                if self.retry is not None \
+                        and resp.status in RETRYABLE_STATUSES \
+                        and attempt < max_retries:
+                    delay = self.retry.delay(attempt)
+                    if retry_after is not None:
+                        delay = max(delay, retry_after)
+                    self.retry.sleep(delay)
+                    attempt += 1
+                    continue
+                raise ServiceError(resp.status,
+                                   payload.get("error", resp.reason),
+                                   retry_after=retry_after,
+                                   payload=payload)
+            payload["_status"] = resp.status
+            return payload
 
     def close(self) -> None:
         if self._conn is not None:
@@ -130,7 +192,10 @@ class ServiceClient:
             return self._request("GET", "/healthz")
         except ServiceError as e:
             if e.code == 503:
-                return {"status": "draining", "_status": 503}
+                # draining or stalled: the body says which
+                return {"status": e.payload.get("status", "draining"),
+                        **{k: v for k, v in e.payload.items()
+                           if k != "status"}, "_status": 503}
             raise
 
     def metrics_text(self) -> str:
@@ -143,17 +208,30 @@ class ServiceClient:
         return body
 
     def submit(self, problem: str, params: Optional[dict] = None,
-               tenant_id: Optional[str] = None) -> str:
+               tenant_id: Optional[str] = None,
+               idempotency_key: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> str:
+        """Submit one job. ``idempotency_key`` makes the submit safe
+        to retry (a duplicate key maps to the already-accepted
+        tenant); ``deadline_s`` bounds how long the job may wait for
+        admission — past it the service drops the job and result
+        polls return 504."""
         body: Dict[str, Any] = {"problem": problem,
                                 "params": params or {}}
         if tenant_id is not None:
             body["tenant_id"] = str(tenant_id)
+        if idempotency_key is not None:
+            body["idempotency_key"] = str(idempotency_key)
+        if deadline_s is not None:
+            body["deadline_s"] = float(deadline_s)
         return self._request("POST", "/v1/jobs", body)["tenant_id"]
 
     def submit_many(self, jobs: List[dict]) -> List[str]:
-        """Batch submit: ``jobs`` is a list of
-        ``{"problem", "params", "tenant_id"?}`` specs; one HTTP round
-        trip, returns the tenant ids in order."""
+        """Batch submit: ``jobs`` is a list of ``{"problem",
+        "params", "tenant_id"?, "idempotency_key"?, "deadline_s"?}``
+        specs; one HTTP round trip, returns the tenant ids in order.
+        With a retrying client, give every spec an idempotency key —
+        a retried batch then maps back onto the accepted tenants."""
         return self._request("POST", "/v1/jobs",
                              {"jobs": jobs})["tenant_ids"]
 

@@ -282,7 +282,8 @@ class Scheduler:
         bucket.horizon = max(bucket.horizon, pad_pow2(int(job.ngen)))
         self.journal.event("job_submitted", tenant_id=tenant.id,
                            family=job.family, ngen=int(job.ngen),
-                           bucket=repr(bkey[:2]))
+                           bucket=repr(bkey[:2]),
+                           **self._rid(tenant))
         if self._minst is not None:
             self._minst.queue_depth.set(len(bucket.queue),
                                         bucket=bucket.label)
@@ -421,6 +422,13 @@ class Scheduler:
 
     # ---------------------------------------------------------- internals ----
 
+    @staticmethod
+    def _rid(tenant: Tenant) -> Dict[str, str]:
+        """The tenant's submitting request id as journal-row kwargs —
+        empty for in-process submits, so rows stay clean."""
+        rid = getattr(tenant.job, "request_id", None)
+        return {"request_id": rid} if rid else {}
+
     def _next_bucket(self) -> Optional[_Bucket]:
         for _ in range(len(self._rr)):
             bkey = self._rr.pop(0)
@@ -491,7 +499,8 @@ class Scheduler:
                 t.restore(eng)
                 self.journal.event("tenant_resumed", tenant_id=t.id,
                                    gen=t.gen,
-                                   wait_s=round(wait_s, 4))
+                                   wait_s=round(wait_s, 4),
+                                   **self._rid(t))
                 if self._minst is not None:
                     self._minst.resumes.inc(bucket=bucket.label)
             else:
@@ -499,7 +508,8 @@ class Scheduler:
                                        t.job.ngen, t.job.hyper)
                 self.journal.event("tenant_admitted", tenant_id=t.id,
                                    ngen=int(t.job.ngen),
-                                   wait_s=round(wait_s, 4))
+                                   wait_s=round(wait_s, 4),
+                                   **self._rid(t))
                 if self._minst is not None:
                     self._minst.admissions.inc(bucket=bucket.label)
                 for row in eng.lane_meter_rows((), 0, lane=t.lane):
@@ -577,7 +587,7 @@ class Scheduler:
                     t.status = Tenant.FINISHED
                 self.journal.event(
                     "tenant_finished", tenant_id=t.id, gen=t.gen,
-                    status=t.status)
+                    status=t.status, **self._rid(t))
                 if self._minst is not None:
                     self._minst.finished.inc(bucket=bucket.label)
                 finished.append(t)
@@ -659,9 +669,14 @@ class Scheduler:
         """Per-bucket control-plane sensor read: queue depth, lane
         budget/residency/occupancy, queue-wait p99 (bucket-resolution,
         from the metrics histogram when enabled) and the resident
-        tenants' ``(id, segments_resident)`` idle candidates — exactly
-        the inputs :class:`deap_tpu.serving.autoscale.AutoscalePolicy`
-        decides on."""
+        tenants' ``(id, segments_resident, gens_since_interaction)``
+        idle candidates — exactly the inputs
+        :class:`deap_tpu.serving.autoscale.AutoscalePolicy` decides
+        on. The third element is the true idleness signal: how many
+        generations a tenant has advanced since a client last touched
+        it — the spill actuator prefers genuinely parked ask-tell
+        tenants over mid-job residents whose clients are long-polling
+        (the BENCH_SERVICE bursty-pair spill-thrash fix)."""
         with self._exclusive("slo_snapshot"):
             snap: Dict[str, Dict[str, Any]] = {}
             for b in self.buckets.values():
@@ -675,7 +690,8 @@ class Scheduler:
                     "lanes": b.max_lanes,
                     "occupancy": len(b.residents) / b.max_lanes,
                     "queue_wait_p99": wait_p99,
-                    "idle": tuple((t.id, t.segments_resident)
+                    "idle": tuple((t.id, t.segments_resident,
+                                   t.gens_since_interaction)
                                   for t in b.residents),
                 }
             return snap

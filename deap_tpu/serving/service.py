@@ -24,18 +24,63 @@ same segment cadence — which is what makes the service's per-tenant
 results **bit-identical** to in-process runs (``bench.py --service``
 gates on the wire digest).
 
-**The wire protocol** (all JSON; newline-delimited on streams):
+**The fault-tolerance layer** (ISSUE 12 — the full catalogue, what the
+client sees and what gets journaled, is the "Failure model" table in
+``docs/advanced/serving.md``):
+
+- *Durable admission.* Every accepted job is recorded in a
+  crash-consistent **admission WAL** (:class:`~deap_tpu.serving.wal.
+  AdmissionWAL` — CRC-framed records, fsync **before** the submit
+  ACK) so a ``kill -9`` between accept and the driver's admission
+  loses nothing: a restarted service replays every accepted-not-done
+  record (rebuilding jobs from the problem registry — journaled
+  ``wal_replay``), and tenants that already ran resume from their
+  checkpoints. Client-supplied **idempotency keys** make submit
+  retries safe: a duplicate key maps to the same tenant (journaled
+  ``idempotent_replay``) instead of admitting a twin.
+- *Deadlines.* A submit may carry ``deadline_s``; a request already
+  expired at the front end gets 504 immediately, and a command whose
+  deadline expires while queued is dropped by the driver **before**
+  it reaches the scheduler (journaled ``deadline_exceeded``; result
+  polls for that tenant return 504).
+- *Load shedding.* ``max_pending`` bounds in-flight jobs and the
+  command queue is bounded with it: past the bound, submits get
+  **429 + Retry-After** — never a hang, never a 500 — journaled
+  ``load_shed``. The stdlib client honours Retry-After with jittered
+  exponential backoff (``resilience.retry.RetryPolicy``).
+- *Driver watchdog.* With ``watchdog_s`` set, a monitor thread
+  journals ``driver_stall`` (with the driver thread's stack) when no
+  progress heartbeat lands within the budget, fires the
+  HealthMonitor ``driver_stall`` alarm, flips ``/healthz`` to 503
+  and — opt-in ``watchdog_exit`` — exits the process so a supervisor
+  restarts into the WAL/checkpoint recovery path. Re-arms (and
+  journals recovery) when the driver comes back.
+- *Fault injection.* ``fault_plan`` fires deterministic
+  service-shaped faults (:class:`~deap_tpu.resilience.faultinject.
+  DropResponse` / ``DelaySegment`` / ``KillServiceAt`` / ``TornWAL``)
+  at the driver-step, segment-boundary, response-write and WAL-append
+  seams — the chaos harness (:mod:`deap_tpu.serving.chaos`,
+  ``tests/test_service_chaos.py``) kills and restarts the service
+  under live retrying load and pins bit-identical final digests.
+
+**The wire protocol** (all JSON; newline-delimited on streams; every
+response echoes an ``X-Request-Id`` — client-supplied or generated —
+that is threaded through the journal for end-to-end tracing):
 
 ====================================  =================================
 ``POST /v1/jobs``                     submit ``{"problem", "params",
-                                      "tenant_id"?}`` → ``{"tenant_id"}``
+                                      "tenant_id"?, "idempotency_key"?,
+                                      "deadline_s"?}`` →
+                                      ``{"tenant_id"}``
 ``GET /v1/jobs/<id>``                 status ``{"status", "gen", "ngen"}``
 ``GET /v1/jobs/<id>/result[?wait=1]`` the wire-encoded result pytree
                                       (``serving.wire``: byte-exact
-                                      arrays + digest)
+                                      arrays + digest); 504 when the
+                                      job's deadline expired
 ``GET /v1/jobs/<id>/stream``          NDJSON per-segment events until a
                                       terminal event
-``GET /healthz``                      liveness (``ok`` / ``draining``)
+``GET /healthz``                      liveness (``ok`` / ``draining`` /
+                                      ``stalled``)
 ``GET /metrics``                      the scheduler's Prometheus
                                       registry (same text as
                                       ``serve_metrics`` — one port
@@ -49,7 +94,8 @@ factories** (``problems={"onemax": factory}``), each mapping a params
 dict to a :class:`~deap_tpu.serving.tenant.Job`. Clients submit
 ``(problem, params)``; the server owns the program. Equal factories →
 equal bucket keys → shared compiled programs across tenants, exactly
-as in-process.
+as in-process. Factories being pure functions of ``(tenant_id,
+params)`` is also what makes WAL replay deterministic.
 
 **Auth & quotas.** ``tokens={token: {"tenant": name, "max_jobs": n}}``
 enables bearer-token auth: requests carry ``Authorization: Bearer
@@ -60,8 +106,9 @@ admitted tenants stays the existing ``fair_quantum`` eviction — quotas
 bound admission, the quantum bounds residency.
 
 **Autoscaling.** Every driver iteration (``autoscale_every``-th) reads
-``Scheduler.slo_snapshot()`` (queue depth, queue-wait p99, occupancy —
-the PR 9 instruments) into an :class:`~deap_tpu.serving.autoscale.
+``Scheduler.slo_snapshot()`` (queue depth, queue-wait p99, occupancy,
+per-resident gens-since-interaction — the PR 9 instruments plus the
+ISSUE 12 idleness signal) into an :class:`~deap_tpu.serving.autoscale.
 AutoscalePolicy`; applied decisions — lane-budget changes
 (``set_bucket_lanes``), predicted-lattice prewarms
 (``Scheduler.prewarm`` under the persistent compile cache) and
@@ -74,25 +121,30 @@ DrainSignal` — the resilience plane's signal pattern) or
 finishes, every resident tenant is checkpointed (tenant-stamped meta —
 ``Scheduler.checkpoint_all``), a ``service_drain`` event is journaled,
 streams receive a terminal ``drained`` event, and the process may
-exit. A new service over the same root resumes every drained tenant
-bit-exactly on resubmission (``Scheduler(resume_tenants=True)``) —
-pinned against an uninterrupted run by ``tests/test_service.py``.
+exit. A new service over the same root replays the WAL and resumes
+every drained tenant bit-exactly (``Scheduler(resume_tenants=True)``)
+— pinned against an uninterrupted run by ``tests/test_service.py``.
 """
 
 from __future__ import annotations
 
 import http.server
 import json
+import os
 import queue
+import sys
 import threading
 import time
+import traceback
 import urllib.parse
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from deap_tpu.resilience.faultinject import InjectedDrop
 from deap_tpu.serving import wire
 from deap_tpu.serving.autoscale import AutoscaleConfig, AutoscalePolicy
 from deap_tpu.serving.scheduler import Scheduler
 from deap_tpu.serving.tenant import Job, bucket_key
+from deap_tpu.serving.wal import AdmissionWAL
 
 __all__ = ["EvolutionService", "SERVICE_JOURNAL_KINDS"]
 
@@ -100,14 +152,19 @@ __all__ = ["EvolutionService", "SERVICE_JOURNAL_KINDS"]
 #: docs/advanced/telemetry.md kind table; drift-gated by
 #: tests/test_service.py)
 SERVICE_JOURNAL_KINDS = ("service_request", "service_drain",
-                         "autoscale_decision", "auth_rejected")
+                         "autoscale_decision", "auth_rejected",
+                         "wal_replay", "idempotent_replay",
+                         "deadline_exceeded", "load_shed",
+                         "driver_stall")
 
 
 class _HttpError(Exception):
-    def __init__(self, code: int, message: str):
+    def __init__(self, code: int, message: str,
+                 headers: Optional[Dict[str, str]] = None):
         super().__init__(message)
         self.code = code
         self.message = message
+        self.headers = headers or {}
 
 
 class _JobView:
@@ -119,10 +176,12 @@ class _JobView:
     base64 on the driver's critical path."""
 
     __slots__ = ("tenant_id", "problem", "token", "status", "gen",
-                 "ngen", "error", "done", "_raw", "_encoded",
-                 "_enc_lock")
+                 "ngen", "error", "done", "request_id", "deadline",
+                 "idempotency_key", "_raw", "_encoded", "_enc_lock")
 
-    def __init__(self, tenant_id: str, problem: str, token: str):
+    def __init__(self, tenant_id: str, problem: str, token: str,
+                 request_id: str = "", deadline: Optional[float] = None,
+                 idempotency_key: Optional[str] = None):
         self.tenant_id = tenant_id
         self.problem = problem
         self.token = token
@@ -131,6 +190,11 @@ class _JobView:
         self.ngen: Optional[int] = None
         self.error: Optional[str] = None
         self.done = threading.Event()
+        self.request_id = request_id
+        #: absolute monotonic deadline for ADMISSION (None = none):
+        #: the driver drops the submit command past it
+        self.deadline = deadline
+        self.idempotency_key = idempotency_key
         self._raw: Any = None
         self._encoded: Optional[Dict[str, Any]] = None
         self._enc_lock = threading.Lock()
@@ -158,17 +222,42 @@ class _JobView:
 class EvolutionService:
     """Serve a :class:`Scheduler` over a loopback/LAN socket.
 
-    :param root: scheduler root (journal + per-tenant run dirs); a
-        restarted service over the same root resumes drained tenants.
+    :param root: scheduler root (journal + admission WAL + per-tenant
+        run dirs); a restarted service over the same root replays the
+        WAL and resumes drained/killed tenants.
     :param problems: ``{name: factory}`` where
         ``factory(tenant_id, params) -> Job`` builds the job
         server-side (the factory owns toolbox/key/init construction,
-        so identical submissions are bit-reproducible).
+        so identical submissions are bit-reproducible — the WAL-replay
+        determinism contract).
     :param tokens: ``{token: {"tenant": str, "max_jobs": int|None}}``
         bearer auth + per-token in-flight quota; ``None`` = open.
     :param autoscale: ``True`` (default policy) /
         :class:`AutoscalePolicy` / ``None`` (off).
     :param autoscale_every: driver steps between autoscale ticks.
+    :param wal: admission WAL on/off (default on; off restores the
+        PR 11 lose-on-kill admission, for overhead comparisons only).
+    :param max_pending: bound on in-flight (not yet terminal) jobs —
+        past it, submits are shed with 429 + ``Retry-After``
+        (``load_shed`` journaled); ``None`` = unbounded. The command
+        queue is bounded alongside it.
+    :param retry_after_s: the ``Retry-After`` value (seconds) sent
+        with shed/quota 429s.
+    :param max_poll_s: server-side clamp for client-supplied long-poll
+        ``timeout=`` values (malformed values are a 400, never a 500).
+    :param watchdog_s: driver-stall budget: with no driver heartbeat
+        for this long, journal ``driver_stall`` (+ stack dump), fire
+        the HealthMonitor ``driver_stall`` alarm, flip ``/healthz`` to
+        503. ``None`` = no watchdog.
+    :param watchdog_exit: escalate a detected stall to process exit
+        (``os._exit``) so a supervisor restarts into WAL/checkpoint
+        recovery. Off by default.
+    :param health: a :class:`~deap_tpu.telemetry.probes.HealthMonitor`
+        receiving the watchdog's ``driver_stall`` alarms.
+    :param fault_plan: a :class:`~deap_tpu.resilience.faultinject.
+        FaultPlan` fired at the service's deterministic seams
+        (``step`` / ``boundary`` / ``http_response`` / ``wal_append``)
+        — the chaos-test hook.
     :param step_hook: optional ``hook(step_count)`` run on the driver
         thread after every scheduler step — the deterministic
         fault-injection seam (drain-mid-segment tests, bursty-load
@@ -183,6 +272,14 @@ class EvolutionService:
                  host: str = "127.0.0.1", port: int = 0,
                  tokens: Optional[Dict[str, dict]] = None,
                  autoscale=None, autoscale_every: int = 1,
+                 wal: bool = True,
+                 max_pending: Optional[int] = None,
+                 retry_after_s: float = 1.0,
+                 max_poll_s: float = 600.0,
+                 watchdog_s: Optional[float] = None,
+                 watchdog_exit: bool = False,
+                 health=None,
+                 fault_plan=None,
                  step_hook: Optional[Callable[[int], None]] = None,
                  **scheduler_kwargs):
         self.root = str(root)
@@ -192,6 +289,13 @@ class EvolutionService:
             autoscale = AutoscalePolicy(AutoscaleConfig())
         self.policy: Optional[AutoscalePolicy] = autoscale or None
         self.autoscale_every = max(1, int(autoscale_every))
+        self.max_pending = (int(max_pending) if max_pending else None)
+        self.retry_after_s = float(retry_after_s)
+        self.max_poll_s = float(max_poll_s)
+        self.watchdog_s = (float(watchdog_s) if watchdog_s else None)
+        self.watchdog_exit = bool(watchdog_exit)
+        self.health = health
+        self.fault_plan = fault_plan
         self.step_hook = step_hook
         scheduler_kwargs.setdefault("resume_tenants", True)
         self.scheduler = Scheduler(self.root,
@@ -207,13 +311,38 @@ class EvolutionService:
         self._build_sem = threading.Semaphore(2)
         self._views: Dict[str, _JobView] = {}
         self._subs: Dict[str, List[queue.Queue]] = {}
-        self._cmds: "queue.Queue" = queue.Queue()
+        # bounded command queue: overload surfaces as a 429 at submit
+        # time, never as an unbounded memory queue behind a wedged
+        # driver (maxsize 0 = unbounded when load shedding is off)
+        self._cmds: "queue.Queue" = queue.Queue(
+            maxsize=(max(64, 4 * self.max_pending)
+                     if self.max_pending else 0))
         self._seq = 0
+        self._rid_seq = 0
         self._steps = 0
+        self._idem: Dict[str, str] = {}   # idempotency key -> tenant
+        self._touched: set = set()        # tenant ids polled since
+        #                                   the driver's last drain of
+        #                                   the interaction set
         self._rep_jobs: Dict[str, Job] = {}   # driver-thread only
         self._drain_req = threading.Event()
         self._drained = threading.Event()
         self._closed = False
+        # watchdog state: the driver refreshes _beat at every loop
+        # iteration; the monitor compares against watchdog_s
+        self._beat = time.monotonic()
+        self._stalled = False
+        self._watch_stop = threading.Event()
+        self._exit_fn = os._exit   # injectable for tests
+
+        # ---- durable admission: open (healing any torn tail) and
+        # replay the WAL BEFORE any thread starts — recovered jobs are
+        # queued as ordinary submit commands the driver applies first
+        self.wal: Optional[AdmissionWAL] = None
+        if wal:
+            self.wal = AdmissionWAL(os.path.join(self.root,
+                                                 "admission.wal"))
+            self._replay_wal()
 
         self._driver = threading.Thread(target=self._drive,
                                         name="deap-tpu-service-driver",
@@ -226,17 +355,106 @@ class EvolutionService:
             name="deap-tpu-service-http", daemon=True)
         self._driver.start()
         self._http_thread.start()
+        self._watchdog = None
+        if self.watchdog_s:
+            self._watchdog = threading.Thread(
+                target=self._watch, name="deap-tpu-service-watchdog",
+                daemon=True)
+            self._watchdog.start()
         self.journal.event("service_request", route="start",
                            url=self.url,
                            problems=sorted(self.problems),
                            auth=self.tokens is not None,
-                           autoscale=self.policy is not None)
+                           autoscale=self.policy is not None,
+                           wal=self.wal is not None,
+                           watchdog_s=self.watchdog_s)
+
+    # ------------------------------------------------- WAL admission ----
+
+    def _replay_wal(self) -> None:
+        """Resubmit every accepted-not-done WAL record: jobs that ran
+        resume from their tenant-stamped checkpoints, jobs killed
+        before admission re-run deterministically from their problem
+        factory. Runs in ``__init__`` before the HTTP server exists,
+        so replay can never race a fresh submit for the same
+        idempotency key — the key map is complete before the first
+        request lands."""
+        state = self.wal.replay()
+        self._idem.update(state.idempotency)
+        replayed, failed = [], []
+        for tid, rec in state.pending.items():
+            problem = rec.get("problem")
+            view = _JobView(tid, str(problem), str(rec.get("token", "")),
+                            request_id=str(rec.get("request_id", "")),
+                            idempotency_key=rec.get("idempotency_key"))
+            self._views[tid] = view
+            factory = self.problems.get(problem)
+            if factory is None:
+                view.status = "failed"
+                view.error = f"unknown problem {problem!r} at replay"
+                view.done.set()
+                self._wal_done(tid, "failed")
+                failed.append(tid)
+                continue
+            try:
+                job = factory(tid, dict(rec.get("params") or {}))
+            except Exception as e:
+                view.status = "failed"
+                view.error = f"{type(e).__name__}: {e}"
+                view.done.set()
+                self._wal_done(tid, "failed")
+                failed.append(tid)
+                continue
+            job.request_id = rec.get("request_id") or None
+            view.ngen = int(job.ngen)
+            view.status = "recovered"
+            self._cmds.put(("submit", job, str(problem)))
+            replayed.append(tid)
+        if state.records or state.tear_offset is not None:
+            self.journal.event(
+                "wal_replay", records=len(state.records),
+                replayed=sorted(replayed), failed=sorted(failed),
+                idempotency_keys=len(state.idempotency),
+                torn_tail=state.tear_offset is not None)
+
+    def _wal_accept_batch(self, fresh, token: str,
+                          request_id: str) -> None:
+        """One durability point for a whole submit batch: N accept
+        records, one write, ONE fsync — the ACK follows only after
+        the last record is on disk."""
+        if self.wal is None or not fresh:
+            return
+        self.wal.append_many([
+            ("accept", dict(tenant_id=job.tenant_id, problem=problem,
+                            params=getattr(job, "_wal_params", None),
+                            idempotency_key=view.idempotency_key,
+                            request_id=request_id, token=token))
+            for job, view, problem in fresh])
+        self._fire_fault("wal_append", path=self.wal.path,
+                         seq=self.wal.n_appended)
+
+    def _wal_done(self, tenant_id: str, status: str) -> None:
+        if self.wal is not None:
+            try:
+                self.wal.append("done", tenant_id=tenant_id,
+                                status=status)
+            except ValueError:
+                pass  # closing race: the WAL replays it next start
+
+    def _fire_fault(self, event: str, **ctx) -> None:
+        if self.fault_plan is not None:
+            self.fault_plan.fire(event, **ctx)
 
     # ----------------------------------------------------- lifecycle ----
 
     @property
     def draining(self) -> bool:
         return self._drain_req.is_set()
+
+    @property
+    def stalled(self) -> bool:
+        """The watchdog's current verdict (``/healthz`` mirrors it)."""
+        return self._stalled
 
     def drain(self, wait: bool = True,
               timeout: Optional[float] = None) -> bool:
@@ -247,7 +465,10 @@ class EvolutionService:
         True once drained (always True when ``wait=False``... check
         :attr:`drained`)."""
         self._drain_req.set()
-        self._cmds.put(("wake",))
+        try:
+            self._cmds.put_nowait(("wake",))
+        except queue.Full:
+            pass  # the driver polls the drain flag regardless
         if wait:
             return self._drained.wait(timeout)
         return True
@@ -269,11 +490,14 @@ class EvolutionService:
         if self._closed:
             return
         self._closed = True
+        self._watch_stop.set()
         self.drain(wait=True, timeout=timeout)
         self._httpd.shutdown()
         self._httpd.server_close()
         self._http_thread.join(timeout=5)
         self._driver.join(timeout=timeout)
+        if self.wal is not None:
+            self.wal.close()
 
     def __enter__(self) -> "EvolutionService":
         return self
@@ -288,6 +512,8 @@ class EvolutionService:
         sched.bind_driver()
         try:
             while not self._drain_req.is_set():
+                self._beat = time.monotonic()
+                self._drain_touches()
                 runnable = sched.runnable
                 n = self._pump_commands(block=not runnable)
                 # admission grace: while submissions are streaming in,
@@ -305,6 +531,8 @@ class EvolutionService:
                 if sched.runnable:
                     sched.step()
                     self._steps += 1
+                    self._beat = time.monotonic()
+                    self._fire_fault("step", step=self._steps)
                     if self.step_hook is not None:
                         self.step_hook(self._steps)
                     if self._steps % self.autoscale_every == 0:
@@ -334,6 +562,19 @@ class EvolutionService:
                 sched.close()
             finally:
                 self._drained.set()
+
+    def _drain_touches(self) -> None:
+        """Fold the front end's interaction set into the tenants'
+        idleness clocks (the spill actuator's signal) — driver thread
+        only, so the scheduler contract holds."""
+        with self._lock:
+            if not self._touched:
+                return
+            touched, self._touched = self._touched, set()
+        for tid in touched:
+            t = self.scheduler.tenants.get(tid)
+            if t is not None:
+                t.note_interaction()
 
     def _pump_commands(self, block: bool) -> int:
         try:
@@ -370,6 +611,22 @@ class EvolutionService:
         tid = job.tenant_id
         with self._lock:
             view = self._views[tid]
+        # deadline admission control: an expired command is DROPPED
+        # here — it never reaches the scheduler; the client's result
+        # poll sees 504
+        if view.deadline is not None \
+                and time.monotonic() > view.deadline:
+            view.error = "deadline expired before admission"
+            view.status = "deadline_exceeded"
+            view.done.set()
+            self.journal.event("deadline_exceeded", tenant_id=tid,
+                               problem=problem, stage="driver",
+                               request_id=view.request_id)
+            self._wal_done(tid, "deadline_exceeded")
+            self._publish(tid, {"event": "deadline_exceeded",
+                                "tenant_id": tid})
+            self._publish(tid, None)
+            return
         try:
             self.scheduler.submit(job)
         except Exception as e:
@@ -378,7 +635,9 @@ class EvolutionService:
             view.done.set()
             self.journal.event("service_request", route="submit",
                                tenant_id=tid, problem=problem,
+                               request_id=view.request_id,
                                error=view.error)
+            self._wal_done(tid, "failed")
             self._publish(tid, {"event": "failed", "tenant_id": tid,
                                 "error": view.error})
             self._publish(tid, None)
@@ -390,11 +649,15 @@ class EvolutionService:
                        else "queued")
         self.journal.event("service_request", route="submit",
                            tenant_id=tid, problem=problem,
+                           request_id=view.request_id,
                            resume=tenant.has_checkpoint)
 
     # boundary fan-out: runs on the driver thread inside step()
     def _on_boundary(self, bucket_label: str,
                      updates: List[Dict[str, Any]]) -> None:
+        self._beat = time.monotonic()
+        self._fire_fault("boundary", step=self._steps + 1,
+                         bucket=bucket_label)
         for u in updates:
             t = u["tenant"]
             with self._lock:
@@ -414,11 +677,55 @@ class EvolutionService:
             if u["finished"]:
                 view.set_result(t.result)
                 view.status = t.status
+                self._wal_done(t.id, t.status)
                 view.done.set()
                 self._publish(t.id, {"event": t.status,
                                      "tenant_id": t.id,
                                      "gen": u["gen"]})
                 self._publish(t.id, None)
+
+    # ------------------------------------------------------ watchdog ----
+
+    def _watch(self) -> None:
+        """The driver-stall monitor: compare the driver's heartbeat
+        against ``watchdog_s``; on a stall, journal ``driver_stall``
+        with a stack dump of the driver thread, fire the HealthMonitor
+        alarm, flip ``/healthz`` to 503 and (opt-in) escalate to
+        process exit so a supervisor restarts into WAL/checkpoint
+        recovery. Re-arms — and journals the recovery — when the
+        heartbeat returns."""
+        interval = min(self.watchdog_s / 4.0, 0.5)
+        while not self._watch_stop.wait(interval):
+            if self._drain_req.is_set():
+                # drain's checkpoint_all can legitimately take long;
+                # the watchdog stands down once drain begins
+                continue
+            age = time.monotonic() - self._beat
+            if age <= self.watchdog_s:
+                if self._stalled:
+                    self._stalled = False
+                    self.journal.event("driver_stall", recovered=True,
+                                       steps=self._steps)
+                continue
+            if self._stalled:
+                continue  # already reported; wait for recovery
+            self._stalled = True
+            frames = sys._current_frames().get(self._driver.ident)
+            stack = ("".join(traceback.format_stack(frames))
+                     if frames is not None
+                     else "<driver thread not running>")
+            self.journal.event(
+                "driver_stall", stalled_s=round(age, 3),
+                steps=self._steps, budget_s=self.watchdog_s,
+                escalate=self.watchdog_exit, stack=stack[-4000:])
+            if self.health is not None:
+                self.health.driver_stall(stalled_s=round(age, 3),
+                                         steps=self._steps)
+            if self.watchdog_exit:
+                # no drain, no flush beyond the journal line above
+                # (journal writes flush per row): the recovery path is
+                # the supervisor restarting into WAL replay + resume
+                self._exit_fn(70)
 
     def _autoscale_tick(self) -> None:
         if self.policy is None:
@@ -530,22 +837,49 @@ class EvolutionService:
             raise _HttpError(403, "unknown token")
         return token, info
 
+    def _active_jobs(self, token: Optional[str] = None) -> int:
+        with self._lock:
+            return sum(1 for v in self._views.values()
+                       if not v.done.is_set()
+                       and (token is None or v.token == token))
+
     def _check_quota(self, token: str, info: dict,
                      n_new: int = 1) -> None:
         max_jobs = info.get("max_jobs") if info else None
         if max_jobs is None:
             return
-        with self._lock:
-            active = sum(1 for v in self._views.values()
-                         if v.token == token and not v.done.is_set())
+        active = self._active_jobs(token)
         if active + n_new > int(max_jobs):
             self.journal.event(
                 "auth_rejected", reason="quota",
                 tenant=info.get("tenant"), max_jobs=int(max_jobs),
                 active=active)
-            raise _HttpError(429,
-                             f"quota exceeded: {active} in-flight + "
-                             f"{n_new} new jobs > max_jobs={max_jobs}")
+            raise _HttpError(
+                429,
+                f"quota exceeded: {active} in-flight + "
+                f"{n_new} new jobs > max_jobs={max_jobs}",
+                headers={"Retry-After": self._retry_after()})
+
+    def _retry_after(self) -> str:
+        return str(max(1, int(round(self.retry_after_s))))
+
+    def _check_load(self, n_new: int, request_id: str) -> None:
+        """The load-shedding gate: past ``max_pending`` in-flight
+        jobs, submits are refused with 429 + Retry-After — the bounded
+        queue never hangs a client and never 500s."""
+        if self.max_pending is None:
+            return
+        active = self._active_jobs()
+        if active + n_new > self.max_pending:
+            self.journal.event("load_shed", active=active,
+                               new=n_new,
+                               max_pending=self.max_pending,
+                               request_id=request_id)
+            raise _HttpError(
+                429,
+                f"overloaded: {active} jobs in flight + {n_new} new "
+                f"> max_pending={self.max_pending}; retry later",
+                headers={"Retry-After": self._retry_after()})
 
     def _view_for(self, tid: str, token: str) -> _JobView:
         with self._lock:
@@ -556,7 +890,46 @@ class EvolutionService:
             self.journal.event("auth_rejected", reason="foreign_tenant",
                                tenant_id=tid)
             raise _HttpError(403, "tenant belongs to another token")
+        with self._lock:
+            self._touched.add(tid)
         return view
+
+    def _q_float(self, qs, name: str, default: float,
+                 max_value: Optional[float] = None) -> float:
+        """Parse one float query parameter defensively: malformed
+        values are a 400 (never an unhandled ValueError → 500) and the
+        result is clamped to ``[0, max_value]`` — an unclamped
+        client-supplied ``timeout=`` must not pin a request thread
+        for an arbitrary duration (service.py:677,701 pre-ISSUE 12)."""
+        raw = qs.get(name, [None])[0]
+        if raw is None or raw == "":
+            value = float(default)
+        else:
+            try:
+                value = float(raw)
+            except ValueError:
+                raise _HttpError(400, f"malformed {name}={raw!r}: "
+                                      "expected a number")
+        if value < 0.0:
+            value = 0.0
+        if max_value is not None:
+            value = min(value, float(max_value))
+        return value
+
+    def _deadline_of(self, spec: dict, headers) -> Optional[float]:
+        """The spec's admission deadline as an absolute monotonic
+        stamp (``deadline_s`` field, falling back to an
+        ``X-Deadline-S`` request header); malformed values are 400."""
+        raw = spec.get("deadline_s")
+        if raw is None:
+            raw = headers.get("X-Deadline-S")
+        if raw is None:
+            return None
+        try:
+            d = float(raw)
+        except (TypeError, ValueError):
+            raise _HttpError(400, f"malformed deadline_s={raw!r}")
+        return time.monotonic() + max(0.0, d)
 
     def _build_one(self, spec: dict, token: str, info: dict):
         problem = spec.get("problem")
@@ -578,10 +951,10 @@ class EvolutionService:
         # Construction errors report synchronously; the semaphore
         # bounds concurrent eager dispatch. tenant_id collisions are
         # re-checked at registration.
+        params = dict(spec.get("params") or {})
         try:
             with self._build_sem:
-                job = self.problems[problem](
-                    tid, dict(spec.get("params") or {}))
+                job = self.problems[problem](tid, dict(params))
         except Exception as e:
             raise _HttpError(400, f"{type(e).__name__}: {e}")
         if job.tenant_id != tid:
@@ -589,51 +962,174 @@ class EvolutionService:
                              f"problem factory {problem!r} returned "
                              f"tenant id {job.tenant_id!r}, expected "
                              f"{tid!r}")
+        # stash the raw params for the WAL accept record (replay
+        # rebuilds the job through the same factory)
+        job._wal_params = params
         view = _JobView(tid, problem, token)
         view.ngen = int(job.ngen)
         return job, view, problem
 
-    def _handle_submit(self, body: dict, token: str, info: dict
-                       ) -> Tuple[int, dict]:
-        """Single (``{"problem", "params", "tenant_id"?}``) or batch
+    def _idem_hit(self, key: Optional[str], token: str
+                  ) -> Optional[_JobView]:
+        """An existing tenant for this idempotency key (token-checked)
+        — the safe-retry path: the client's first submit may have been
+        accepted and durably WAL-logged while its response was lost."""
+        if not key:
+            return None
+        with self._lock:
+            tid = self._idem.get(str(key))
+            view = self._views.get(tid) if tid is not None else None
+        if view is None:
+            return None
+        if self.tokens is not None and view.token != token:
+            self.journal.event("auth_rejected", reason="foreign_tenant",
+                               tenant_id=view.tenant_id)
+            raise _HttpError(403, "idempotency key belongs to another "
+                                  "token")
+        return view
+
+    def _handle_submit(self, body: dict, token: str, info: dict,
+                       headers, request_id: str) -> Tuple[int, dict]:
+        """Single (``{"problem", "params", "tenant_id"?,
+        "idempotency_key"?, "deadline_s"?}``) or batch
         (``{"jobs": [spec, ...]}``) submission — the batch form costs
         one HTTP round trip for N jobs, which matters when the client
         and server share cores."""
         if self.draining:
-            raise _HttpError(503, "service is draining")
+            raise _HttpError(503, "service is draining",
+                             headers={"Retry-After": self._retry_after()})
         specs = body.get("jobs")
         batch = specs is not None
         if not batch:
             specs = [body]
         if not isinstance(specs, list) or not specs:
             raise _HttpError(400, '"jobs" must be a non-empty list')
-        self._check_quota(token, info, n_new=len(specs))
-        built = [self._build_one(s, token, info) for s in specs]
+
+        # resolve idempotent replays FIRST: retries of already-accepted
+        # jobs cost no quota, no load-shed slot, no rebuild
+        resolved: List[Optional[_JobView]] = []
+        n_new = 0
+        for s in specs:
+            if not isinstance(s, dict):
+                raise _HttpError(400, "each job spec must be an object")
+            hit = self._idem_hit(s.get("idempotency_key"), token)
+            resolved.append(hit)
+            if hit is None:
+                n_new += 1
+            else:
+                self.journal.event("idempotent_replay",
+                                   tenant_id=hit.tenant_id,
+                                   via="idempotency_key",
+                                   request_id=request_id)
+        if n_new:
+            self._check_quota(token, info, n_new=n_new)
+            self._check_load(n_new, request_id)
+
+        # deadlines: a spec already expired at the front end is 504
+        # right here — it never enters the command queue
+        deadlines = [self._deadline_of(s, headers) for s in specs]
+        now = time.monotonic()
+        for s, d, hit in zip(specs, deadlines, resolved):
+            if hit is None and d is not None and now > d:
+                self.journal.event("deadline_exceeded",
+                                   tenant_id=s.get("tenant_id"),
+                                   problem=s.get("problem"),
+                                   stage="frontend",
+                                   request_id=request_id)
+                raise _HttpError(504, "deadline expired before "
+                                      "admission")
+
+        built = []   # (job, view, problem) for the genuinely-new specs
+        for s, hit, d in zip(specs, resolved, deadlines):
+            if hit is not None:
+                continue
+            job, view, problem = self._build_one(s, token, info)
+            view.request_id = request_id
+            view.deadline = d
+            view.idempotency_key = s.get("idempotency_key")
+            built.append((job, view, problem))
         with self._lock:
-            dup = [j.tenant_id for j, _, _ in built
-                   if j.tenant_id in self._views]
+            dup = []
+            for i, (job, view, _) in enumerate(built):
+                old = self._views.get(job.tenant_id)
+                if old is None:
+                    continue
+                if old.problem == view.problem \
+                        and old.status != "failed":
+                    # tenant-id replay: the same identity resubmitted
+                    # (a post-restart client re-offering a drained/
+                    # recovered job) maps to the live view instead of
+                    # admitting a twin or 409ing the resume path
+                    built[i] = (None, old, view.problem)
+                else:
+                    dup.append(job.tenant_id)
             if dup:
                 raise _HttpError(409, f"tenant id(s) {dup} already "
                                       "submitted")
             for job, view, _ in built:
+                if job is None:
+                    continue
                 self._views[job.tenant_id] = view
-        # async admission: ACK now, the driver applies at its next
-        # command pump — a request thread never waits out a segment
-        self._cmds.put(("submit_many",
-                        [(job, problem) for job, _, problem in built]))
+                if view.idempotency_key:
+                    self._idem[str(view.idempotency_key)] = \
+                        job.tenant_id
+        fresh = [(job, view, problem) for job, view, problem in built
+                 if job is not None]
+        for i, (job, view, problem) in enumerate(built):
+            if job is None and view.status != "failed":
+                self.journal.event("idempotent_replay",
+                                   tenant_id=view.tenant_id,
+                                   via="tenant_id",
+                                   request_id=request_id)
+        for job, _, _ in fresh:
+            job.request_id = request_id
+        # durability point: every accept record is fsync'd BEFORE the
+        # ACK below — "the client heard yes" implies "a restart
+        # replays it" (one fsync for the whole batch)
+        self._wal_accept_batch(fresh, token, request_id)
+        if fresh:
+            # async admission: ACK now, the driver applies at its next
+            # command pump — a request thread never waits out a segment
+            try:
+                self._cmds.put_nowait(
+                    ("submit_many",
+                     [(job, problem) for job, _, problem in fresh]))
+            except queue.Full:
+                # bounded command queue saturated: shed — the WAL
+                # records stand, so a retry (or restart) replays them;
+                # views are withdrawn so the retry is a fresh submit
+                with self._lock:
+                    for job, view, _ in fresh:
+                        self._views.pop(job.tenant_id, None)
+                        if view.idempotency_key:
+                            self._idem.pop(str(view.idempotency_key),
+                                           None)
+                self.journal.event(
+                    "load_shed", reason="command_queue_full",
+                    new=len(fresh), request_id=request_id)
+                raise _HttpError(
+                    429, "command queue full; retry later",
+                    headers={"Retry-After": self._retry_after()})
         if self._drained.is_set():
             # lost race with a concurrent drain: the driver's final
             # pump may never see this command — fail the views loudly
-            for _, view, _ in built:
+            for job, view, _ in fresh:
                 view.status = "drained"
                 view.done.set()
-        tids = [job.tenant_id for job, _, _ in built]
+        # the response tenant ids, in spec order (replays included)
+        tids = []
+        it = iter(built)
+        for hit in resolved:
+            if hit is not None:
+                tids.append(hit.tenant_id)
+            else:
+                tids.append(next(it)[1].tenant_id)
         if batch:
             return 200, {"tenant_ids": tids, "status": "submitted"}
         return 200, {"tenant_id": tids[0], "status": "submitted"}
 
-    def handle(self, method: str, path: str, headers, body: bytes
-               ) -> Tuple[int, str, bytes, bool]:
+    def handle(self, method: str, path: str, headers, body: bytes,
+               request_id: str = "") -> Tuple[int, str, bytes, bool]:
         """Route one request; returns (code, content-type, body,
         stream?) — ``stream`` means the caller takes over the socket
         (NDJSON). Front-end threads only: never touches the
@@ -642,9 +1138,11 @@ class EvolutionService:
         route = parsed.path.rstrip("/") or "/"
         qs = urllib.parse.parse_qs(parsed.query)
         if route == "/healthz" and method == "GET":
-            code = 200 if not self.draining else 503
+            status = ("stalled" if self._stalled
+                      else "draining" if self.draining else "ok")
+            code = 200 if status == "ok" else 503
             return code, "application/json", json.dumps({
-                "status": "draining" if self.draining else "ok",
+                "status": status,
                 "jobs": len(self._views),
                 "problems": sorted(self.problems)}).encode(), False
         if route == "/metrics" and method == "GET":
@@ -657,11 +1155,13 @@ class EvolutionService:
         token, info = self._auth(headers)
         if route == "/v1/jobs" and method == "POST":
             payload = json.loads(body or b"{}")
-            code, out = self._handle_submit(payload, token, info)
+            code, out = self._handle_submit(payload, token, info,
+                                            headers, request_id)
             return code, "application/json", \
                 json.dumps(out).encode(), False
         if route == "/v1/drain" and method == "POST":
-            self.journal.event("service_request", route="drain")
+            self.journal.event("service_request", route="drain",
+                               request_id=request_id)
             self.drain(wait=False)
             return 200, "application/json", b'{"draining": true}', False
         if route == "/v1/results" and method == "GET":
@@ -673,8 +1173,9 @@ class EvolutionService:
             views = [self._view_for(urllib.parse.unquote(tid), token)
                      for tid in ids]
             if qs.get("wait", ["0"])[0] not in ("0", ""):
-                deadline = time.monotonic() + float(
-                    qs.get("timeout", ["300"])[0])
+                deadline = time.monotonic() + self._q_float(
+                    qs, "timeout", default=min(300.0, self.max_poll_s),
+                    max_value=self.max_poll_s)
                 for v in views:
                     v.done.wait(max(0.0,
                                     deadline - time.monotonic()))
@@ -698,8 +1199,14 @@ class EvolutionService:
                     json.dumps(view.as_dict()).encode(), False
             if sub == "result":
                 if qs.get("wait", ["0"])[0] not in ("0", ""):
-                    timeout = float(qs.get("timeout", ["300"])[0])
+                    timeout = self._q_float(
+                        qs, "timeout",
+                        default=min(300.0, self.max_poll_s),
+                        max_value=self.max_poll_s)
                     view.done.wait(timeout)
+                if view.status == "deadline_exceeded":
+                    return 504, "application/json", \
+                        json.dumps(view.as_dict()).encode(), False
                 if not view.done.is_set():
                     return 202, "application/json", \
                         json.dumps(view.as_dict()).encode(), False
@@ -712,6 +1219,17 @@ class EvolutionService:
             if sub == "stream":
                 return 200, "application/x-ndjson", b"", True
         raise _HttpError(404, f"no route {method} {route}")
+
+    def next_request_id(self, headers) -> str:
+        """The request's trace id: the client's ``X-Request-Id`` when
+        present, else a generated one — echoed in the response header
+        and stamped into every journal row the request touches."""
+        rid = headers.get("X-Request-Id")
+        if rid:
+            return str(rid)[:64]
+        with self._lock:
+            self._rid_seq += 1
+            return f"req-{os.getpid():x}-{self._rid_seq:x}"
 
     def stream_events(self, tid: str, token: str, write_line) -> None:
         """Drive one NDJSON stream: status line first, then every
@@ -760,35 +1278,59 @@ class _Handler(http.server.BaseHTTPRequestHandler):
     def log_message(self, *args):  # requests are journal rows, not logs
         pass
 
-    def _respond(self, code: int, ctype: str, payload: bytes) -> None:
+    def _respond(self, code: int, ctype: str, payload: bytes,
+                 extra: Optional[Dict[str, str]] = None) -> None:
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(payload)))
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(payload)
 
+    def _drop_check(self, route: str) -> bool:
+        """Fire the fault plan's ``http_response`` seam; True means
+        an injected drop — close the connection without replying
+        (the request's server-side effects stand)."""
+        try:
+            self.svc._fire_fault("http_response", route=route,
+                                 method=self.command)
+        except InjectedDrop:
+            self.close_connection = True
+            return True
+        return False
+
     def _dispatch(self, method: str) -> None:
+        rid = self.svc.next_request_id(self.headers)
         try:
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else b""
             try:
                 code, ctype, payload, stream = self.svc.handle(
-                    method, self.path, self.headers, body)
+                    method, self.path, self.headers, body, rid)
             except _HttpError as e:
+                if self._drop_check(self.path):
+                    return
                 self._respond(e.code, "application/json", json.dumps(
-                    {"error": e.message}).encode())
+                    {"error": e.message}).encode(),
+                    extra={"X-Request-Id": rid, **e.headers})
                 return
             except json.JSONDecodeError as e:
                 self._respond(400, "application/json", json.dumps(
-                    {"error": f"bad JSON body: {e}"}).encode())
+                    {"error": f"bad JSON body: {e}"}).encode(),
+                    extra={"X-Request-Id": rid})
+                return
+            if self._drop_check(self.path):
                 return
             if not stream:
-                self._respond(code, ctype, payload)
+                self._respond(code, ctype, payload,
+                              extra={"X-Request-Id": rid})
                 return
             # NDJSON stream: no Content-Length; the connection closes
             # when the stream ends (HTTP/1.1 read-until-close)
             self.send_response(code)
             self.send_header("Content-Type", ctype)
+            self.send_header("X-Request-Id", rid)
             self.send_header("Connection", "close")
             self.end_headers()
 

@@ -86,6 +86,12 @@ class Job:
     halloffame_size: int = 0
     health: Any = None
     program: Optional[str] = None
+    #: the X-Request-Id of the submitting HTTP request (None for
+    #: in-process submits) — stamped into this tenant's
+    #: ``job_submitted``/``tenant_admitted``/``tenant_finished``
+    #: journal rows so one grep reconstructs the request's full path;
+    #: deliberately NOT part of the bucket key
+    request_id: Optional[str] = None
 
 
 def _shape_sig(tree: Any) -> Tuple:
@@ -148,7 +154,26 @@ class Tenant:
         # eviction) — the scheduler's queue-wait SLO histogram reads
         # it at admission; monotonic, so NTP steps can't skew SLOs
         self.enqueued_at = time.monotonic()
+        # the generation count at the last client interaction (result
+        # poll / status / stream read) — the autoscaler's true
+        # idleness signal: a parked ask-tell tenant nobody polls
+        # accumulates gens_since_interaction, a mid-job tenant whose
+        # client is long-polling stays near zero
+        self._interact_gen = 0
         self._ckpt: Optional[Checkpointer] = None
+
+    def note_interaction(self) -> None:
+        """A client touched this tenant (poll/stream/status) — resets
+        the idleness clock. Written by the service's driver thread
+        (which drains the front end's touch set each iteration)."""
+        self._interact_gen = self.gen
+
+    @property
+    def gens_since_interaction(self) -> int:
+        """Generations advanced since a client last interacted — the
+        spill actuator's idleness signal (``slo_snapshot()`` exposes it
+        per resident)."""
+        return max(0, self.gen - self._interact_gen)
 
     @property
     def ckpt(self) -> Checkpointer:

@@ -658,7 +658,7 @@ class HealthMonitor:
 
     #: every alarm kind this monitor can emit (report/tests key on it)
     ALARM_KINDS = ("non_finite", "clone_spike", "premature_convergence",
-                   "zero_improvement", "hlo_drift")
+                   "zero_improvement", "hlo_drift", "driver_stall")
 
     def __init__(self, *, nan_check: bool = True,
                  clone_rate_max: Optional[float] = None,
@@ -708,6 +708,15 @@ class HealthMonitor:
         drive it. Honours ``early_stop``/``on_alarm`` like every other
         kind."""
         return self._fire("hlo_drift", gen, **detail)
+
+    def driver_stall(self, gen=None, **detail) -> dict:
+        """Fire the ``driver_stall`` alarm — called by the
+        :class:`~deap_tpu.serving.service.EvolutionService` watchdog
+        when the driver thread produced no progress heartbeat within
+        its budget (a hung segment / wedged backend). Like
+        ``hlo_drift``, host-event-driven rather than row-driven;
+        honours ``early_stop``/``on_alarm``."""
+        return self._fire("driver_stall", gen, **detail)
 
     def _clone_rate(self, row) -> Optional[float]:
         v = row.get(self.clone_key)

@@ -261,14 +261,25 @@ def _slo_section(events: List[Dict[str, Any]], out: List[str]) -> None:
 def _service_section(events: List[Dict[str, Any]], out: List[str]
                      ) -> None:
     """Service-plane timeline: the autoscaler's applied decisions
-    (lane moves, prewarms, spills), the auth-rejection tally, and the
-    graceful-drain ledger."""
+    (lane moves, prewarms, spills), the auth-rejection tally, the
+    graceful-drain ledger — and the ISSUE 12 fault plane: WAL
+    replays, idempotent-retry hits, deadline drops, load sheds,
+    driver stalls and the request-id trace index."""
     decisions = [e for e in events
                  if e.get("kind") == "autoscale_decision"]
     rejections = [e for e in events
                   if e.get("kind") == "auth_rejected"]
     drains = [e for e in events if e.get("kind") == "service_drain"]
-    if not (decisions or rejections or drains):
+    wal = [e for e in events if e.get("kind") == "wal_replay"]
+    idem = [e for e in events
+            if e.get("kind") == "idempotent_replay"]
+    deads = [e for e in events
+             if e.get("kind") == "deadline_exceeded"]
+    sheds = [e for e in events if e.get("kind") == "load_shed"]
+    stalls = [e for e in events if e.get("kind") == "driver_stall"]
+    traced = [e for e in events if e.get("request_id")]
+    if not (decisions or rejections or drains or wal or idem
+            or deads or sheds or stalls):
         return
     out.append("")
     out.append("## Service plane")
@@ -296,6 +307,44 @@ def _service_section(events: List[Dict[str, Any]], out: List[str]
                    f"checkpointed, "
                    f"{len(e.get('open_tenants', []))} stream(s) "
                    "notified")
+    for e in wal:
+        out.append(f"- WAL replay at t={e.get('t')}s: "
+                   f"{len(e.get('replayed', []))} tenant(s) replayed "
+                   f"of {e.get('records', '?')} record(s)"
+                   + (", torn tail healed"
+                      if e.get("torn_tail") else "")
+                   + (f", {len(e['failed'])} failed"
+                      if e.get("failed") else ""))
+    if idem or deads or sheds:
+        out.append(f"- fault plane: {len(idem)} idempotent "
+                   f"replay(s), {len(deads)} deadline drop(s), "
+                   f"{len(sheds)} load shed(s)")
+    if stalls:
+        fired = [e for e in stalls if "stalled_s" in e]
+        rec = [e for e in stalls if e.get("recovered")]
+        worst = max((e["stalled_s"] for e in fired), default=None)
+        out.append(f"- driver stalls: {len(fired)} fired / "
+                   f"{len(rec)} recovered"
+                   + (f" (worst {_fmt(worst)}s)" if worst else ""))
+        for e in fired[:3]:
+            tail = [ln for ln in str(e.get("stack", ""))
+                    .strip().splitlines() if ln.strip()]
+            out.append(f"  - t={e.get('t')}s stalled "
+                       f"{_fmt(e.get('stalled_s'))}s at step "
+                       f"{e.get('steps')}: "
+                       f"{tail[-1].strip() if tail else '?'}")
+    if traced:
+        rids: Dict[str, int] = {}
+        for e in traced:
+            r = str(e.get("request_id"))
+            rids[r] = rids.get(r, 0) + 1
+        sample = next((r for r, n in rids.items() if n > 1),
+                      next(iter(rids)))
+        path = [str(e.get("kind")) for e in traced
+                if str(e.get("request_id")) == sample]
+        out.append(f"- request tracing: {len(traced)} row(s) across "
+                   f"{len(rids)} request id(s); e.g. {sample}: "
+                   + " → ".join(path[:8]))
 
 
 def _memory_section(events: List[Dict[str, Any]], out: List[str]

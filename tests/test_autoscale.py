@@ -149,6 +149,41 @@ def test_spill_idle_tenants_at_lane_ceiling():
     assert not d2.spill
 
 
+def test_spill_prefers_gens_idle_over_residency_age():
+    """ISSUE 12 satellite: with the true idleness signal present
+    (``(tenant, segments_resident, gens_since_interaction)`` triples),
+    spills go to genuinely parked tenants in gens-idle order — not to
+    whoever has merely held a lane longest."""
+    p = policy(up_after=1, max_lanes=8, spill_idle_segments=2,
+               spill_idle_gens=4)
+    idle = (("mid-job", 9, 0),    # oldest resident, client polling it
+            ("parked", 4, 40),    # nobody has polled for 40 gens
+            ("semi", 6, 10))
+    d = p.decide(snap(queue=2, occ=1.0, lanes=8, idle=idle))
+    # gens-idle order; the mid-job resident is excluded outright
+    assert d.spill == ["parked", "semi"]
+
+
+def test_spill_never_takes_actively_polled_tenants():
+    """Mid-job residents whose clients are interacting (gens-idle 0)
+    are never spilled, no matter their residency age — the
+    spill-thrash fix for the BENCH_SERVICE bursty pair."""
+    p = policy(up_after=1, max_lanes=8, spill_idle_segments=2,
+               spill_idle_gens=1)
+    idle = (("hot1", 50, 0), ("hot2", 60, 0))
+    d = p.decide(snap(queue=3, occ=1.0, lanes=8, idle=idle))
+    assert d.spill == []
+
+
+def test_spill_legacy_pairs_still_use_residency():
+    """2-tuple snapshots (no idleness signal) keep the pre-ISSUE-12
+    residency-age behaviour."""
+    p = policy(up_after=1, max_lanes=8, spill_idle_segments=4)
+    d = p.decide(snap(queue=1, occ=1.0, lanes=8,
+                      idle=(("t-old", 9), ("t-young", 1))))
+    assert d.spill == ["t-old"]
+
+
 def test_buckets_are_independent():
     p = policy(up_after=2)
     two = {**snap(queue=3, lanes=8),

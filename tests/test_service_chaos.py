@@ -1,0 +1,512 @@
+"""Fault-tolerant service plane — WAL, idempotency, deadlines, load
+shedding, watchdog, and the kill -9 chaos pin (ISSUE 12).
+
+Two tiers in one module:
+
+- **fast**: the admission-WAL unit surface (CRC framing, torn-tail
+  self-heal), the overload pin (bounded queues shed with 429 +
+  Retry-After — never hang, never 500 — and a retrying client
+  converges), the deadline pin (expired commands are dropped with 504
+  and never reach the scheduler), the watchdog (stall detected,
+  journaled with a stack, alarmed, ``/healthz`` 503, re-armed; opt-in
+  exit escalation), dropped-response idempotent retries, WAL replay on
+  restart, request-id tracing and the long-poll ``timeout=`` hardening.
+- **chaos** (``-m chaos``, slow tier): a real ``kill -9`` of a service
+  subprocess mid-run under live concurrent retrying client load,
+  restart over the same root, and the acceptance pin — zero lost jobs,
+  every tenant's wire digest bit-identical to an uninterrupted
+  in-process run; plus checkpoint-corruption fallback during a
+  service-restart resume.
+"""
+
+import http.client
+import json
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deap_tpu import ops
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import init_population
+from deap_tpu.core.toolbox import Toolbox
+from deap_tpu.resilience import (
+    DelaySegment,
+    DropResponse,
+    FaultPlan,
+    RetryPolicy,
+    corrupt_file,
+)
+from deap_tpu.serving import (
+    AdmissionWAL,
+    EvolutionService,
+    Job,
+    Scheduler,
+    ServiceClient,
+    ServiceError,
+)
+from deap_tpu.serving.wire import result_digest
+from deap_tpu.support.checkpoint import Checkpointer
+from deap_tpu.telemetry import read_journal
+from deap_tpu.telemetry.metrics import MetricsRegistry
+from deap_tpu.telemetry.probes import HealthMonitor
+
+_TB = Toolbox()
+_TB.register("evaluate", lambda g: g.sum(-1).astype(jnp.float32))
+_TB.register("mate", ops.cx_two_point)
+_TB.register("mutate", ops.mut_flip_bit, indpb=0.1)
+_TB.register("select", ops.sel_tournament, tournsize=3)
+
+
+def _onemax_job(tid, params):
+    seed = int(params.get("seed", 0))
+    pop = init_population(jax.random.key(seed), 16,
+                          ops.bernoulli_genome(12), FitnessSpec((1.0,)))
+    return Job(tenant_id=tid, family="ea_simple", toolbox=_TB,
+               key=jax.random.key(3000 + seed), init=pop,
+               ngen=int(params.get("ngen", 4)),
+               hyper={"cxpb": 0.5, "mutpb": 0.2}, program="onemax")
+
+
+PROBLEMS = {"onemax": _onemax_job}
+
+
+def _svc_kwargs():
+    return dict(max_lanes=2, segment_len=2, metrics=MetricsRegistry())
+
+
+def _inprocess_digests(root, jobs):
+    with Scheduler(str(root), max_lanes=2, segment_len=2) as sched:
+        for j in jobs:
+            sched.submit(j)
+        results = sched.run()
+    return {tid: result_digest(res) for tid, res in results.items()}
+
+
+def _journal(root):
+    return read_journal(os.path.join(str(root), "journal.jsonl"))
+
+
+# ------------------------------------------------ WAL unit surface ----
+
+def test_wal_append_replay_roundtrip(tmp_path):
+    path = str(tmp_path / "a.wal")
+    with AdmissionWAL(path) as w:
+        w.append("accept", tenant_id="t0", problem="p", params={"s": 1},
+                 idempotency_key="k0")
+        w.append("accept", tenant_id="t1", problem="p", params={"s": 2},
+                 idempotency_key="k1")
+        w.append("done", tenant_id="t0", status="finished")
+    st = AdmissionWAL(path).replay()
+    assert st.tear_offset is None and len(st) == 3
+    # done cancels replay; idempotency survives the terminal state (a
+    # late retry of a finished job must still map to it)
+    assert set(st.pending) == {"t1"}
+    assert st.idempotency == {"k0": "t0", "k1": "t1"}
+    assert st.pending["t1"]["params"] == {"s": 2}
+
+
+def test_wal_torn_tail_self_heals(tmp_path):
+    path = str(tmp_path / "a.wal")
+    with AdmissionWAL(path) as w:
+        w.append("accept", tenant_id="t0", problem="p", params={})
+        w.append("done", tenant_id="t0", status="finished")
+        w.append("accept", tenant_id="t1", problem="p", params={},
+                 idempotency_key="k1")
+        w.append("accept", tenant_id="t2", problem="p", params={})
+    # a power cut mid-append: the final record loses its tail
+    corrupt_file(path, mode="truncate", offset=-7)
+    w2 = AdmissionWAL(path)
+    st = w2.replay()
+    # the torn record was never ACKed — dropping it loses nothing;
+    # everything before it survives intact
+    assert st.tear_offset is not None
+    assert set(st.pending) == {"t1"}
+    assert st.idempotency == {"k1": "t1"}
+    # the tear was truncated away at open: appends land on a clean
+    # line boundary and the log parses clean again
+    w2.append("accept", tenant_id="t3", problem="p", params={})
+    w2.close()
+    st3 = AdmissionWAL(path).replay()
+    assert st3.tear_offset is None
+    assert set(st3.pending) == {"t1", "t3"}
+
+
+def test_wal_interior_damage_skipped_not_fatal(tmp_path):
+    path = str(tmp_path / "a.wal")
+    with AdmissionWAL(path) as w:
+        w.append("accept", tenant_id="t0", problem="p", params={})
+        w.append("accept", tenant_id="t1", problem="p", params={})
+    # flip bytes INSIDE the first record (newline-terminated): CRC
+    # rejects it, the rest of the log still replays
+    corrupt_file(path, mode="flip", nbytes=4, offset=12)
+    st = AdmissionWAL(path).replay()
+    assert set(st.pending) == {"t1"}
+    assert st.tear_offset is None
+
+
+# ------------------------------------------------- overload pin ----
+
+def test_overload_sheds_429_with_retry_after_then_converges(tmp_path):
+    """Acceptance: with bounded queues saturated, new submits get 429 +
+    Retry-After (never hang, never 500), journaled ``load_shed``; a
+    retrying client honouring Retry-After converges once load drains."""
+    with EvolutionService(str(tmp_path), PROBLEMS, max_pending=2,
+                          retry_after_s=1.0, **_svc_kwargs()) as svc:
+        c = ServiceClient(svc.url)
+        c.submit("onemax", params={"seed": 1, "ngen": 20},
+                 tenant_id="o1")
+        c.submit("onemax", params={"seed": 2, "ngen": 20},
+                 tenant_id="o2")
+        # saturated: the third submit is shed — an explicit 429 with
+        # the server's Retry-After, not a hang and not a 500
+        with pytest.raises(ServiceError) as ei:
+            c.submit("onemax", params={"seed": 3, "ngen": 4})
+        assert ei.value.code == 429
+        assert ei.value.retry_after == 1.0
+        # a retrying client converges: backoff honours Retry-After
+        sleeps = []
+
+        def sleep(s):
+            sleeps.append(s)
+            time.sleep(min(s, 0.2))
+
+        retry = RetryPolicy(max_retries=200, backoff_s=0.05,
+                            max_backoff_s=0.5, jitter=0.5, sleep=sleep)
+        rc = ServiceClient(svc.url, retry=retry)
+        t3 = rc.submit("onemax", params={"seed": 3, "ngen": 4},
+                       idempotency_key="k3")
+        for tid in ("o1", "o2", t3):
+            res = c.result(tid, wait=True, timeout=300)
+            assert res["status"] == "finished", res
+        assert sleeps and max(sleeps) >= 1.0  # Retry-After respected
+    rows = _journal(tmp_path)
+    sheds = [r for r in rows if r.get("kind") == "load_shed"]
+    assert sheds and all(r.get("max_pending") == 2 for r in sheds
+                         if "max_pending" in r)
+
+
+# ------------------------------------------------- deadline pin ----
+
+def test_deadline_expired_at_frontend_is_504(tmp_path):
+    with EvolutionService(str(tmp_path), PROBLEMS,
+                          **_svc_kwargs()) as svc:
+        c = ServiceClient(svc.url)
+        with pytest.raises(ServiceError) as ei:
+            c.submit("onemax", params={"seed": 1, "ngen": 4},
+                     tenant_id="dead0", deadline_s=0.0)
+        assert ei.value.code == 504
+    rows = _journal(tmp_path)
+    assert any(r.get("kind") == "deadline_exceeded"
+               and r.get("stage") == "frontend" for r in rows)
+    # it never existed scheduler-side
+    assert not any(r.get("kind") == "job_submitted"
+                   and r.get("tenant_id") == "dead0" for r in rows)
+
+
+def test_deadline_expired_in_queue_dropped_before_scheduler(tmp_path):
+    """Acceptance: a command whose deadline expires while queued is
+    dropped by the driver — journaled ``deadline_exceeded``, result
+    polls return 504, and the job never reaches the scheduler."""
+    entered, release = threading.Event(), threading.Event()
+
+    def hook(step):
+        if step == 1:
+            entered.set()
+            release.wait(30)
+
+    svc = EvolutionService(str(tmp_path), PROBLEMS, step_hook=hook,
+                           **_svc_kwargs())
+    try:
+        c = ServiceClient(svc.url)
+        c.submit("onemax", params={"seed": 1, "ngen": 8},
+                 tenant_id="busy")
+        assert entered.wait(120)
+        # the driver is wedged in the hook: this command queues behind
+        # it and its deadline expires in the queue
+        c.submit("onemax", params={"seed": 2, "ngen": 4},
+                 tenant_id="late", deadline_s=0.15)
+        time.sleep(0.4)
+        release.set()
+        with pytest.raises(ServiceError) as ei:
+            c.result("late", wait=True, timeout=120)
+        assert ei.value.code == 504
+        res = c.result("busy", wait=True, timeout=300)
+        assert res["status"] == "finished"
+    finally:
+        release.set()
+        svc.close()
+    rows = _journal(tmp_path)
+    drops = [r for r in rows if r.get("kind") == "deadline_exceeded"]
+    assert any(r.get("tenant_id") == "late"
+               and r.get("stage") == "driver" for r in drops)
+    assert not any(r.get("kind") == "job_submitted"
+                   and r.get("tenant_id") == "late" for r in rows)
+
+
+# --------------------------------------------------- watchdog ----
+
+def test_watchdog_detects_stall_and_rearms(tmp_path):
+    hm = HealthMonitor()
+    plan = FaultPlan([DelaySegment(1, 1.5)])
+    with EvolutionService(str(tmp_path), PROBLEMS, watchdog_s=0.4,
+                          health=hm, fault_plan=plan,
+                          **_svc_kwargs()) as svc:
+        c = ServiceClient(svc.url)
+        tid = c.submit("onemax", params={"seed": 1, "ngen": 8})
+        deadline = time.monotonic() + 30
+        while not svc.stalled and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert svc.stalled, "watchdog never fired during the stall"
+        assert c.healthz()["status"] == "stalled"  # /healthz -> 503
+        res = c.result(tid, wait=True, timeout=300)
+        assert res["status"] == "finished"
+        # once the driver recovers, the watchdog re-arms (the tick is
+        # up to watchdog_s/4 behind the heartbeat — poll, don't race)
+        while svc.stalled and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not svc.stalled, "watchdog never re-armed"
+        assert c.healthz()["status"] == "ok"
+    rows = _journal(tmp_path)
+    stalls = [r for r in rows if r.get("kind") == "driver_stall"]
+    fired = [r for r in stalls if "stack" in r]
+    assert fired and all(r["stalled_s"] >= 0.4 for r in fired)
+    # the injected wedge's stall dump names the culprit frame (a slow
+    # first compile may legitimately trip an additional stall first)
+    assert any("faultinject" in r["stack"] for r in fired)
+    assert any(r.get("recovered") for r in stalls)
+    assert any(a["alarm"] == "driver_stall" for a in hm.alarms)
+
+
+def test_watchdog_exit_escalation_is_opt_in(tmp_path):
+    plan = FaultPlan([DelaySegment(1, 1.2)])
+    exits = []
+    svc = EvolutionService(str(tmp_path), PROBLEMS, watchdog_s=0.3,
+                           watchdog_exit=True, fault_plan=plan,
+                           **_svc_kwargs())
+    svc._exit_fn = exits.append  # capture instead of killing pytest
+    try:
+        ServiceClient(svc.url).submit("onemax",
+                                      params={"seed": 1, "ngen": 8})
+        deadline = time.monotonic() + 30
+        while not exits and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert exits == [70]
+    finally:
+        svc.close()
+    rows = _journal(tmp_path)
+    assert any(r.get("kind") == "driver_stall" and r.get("escalate")
+               for r in rows)
+
+
+# --------------------------------- idempotency & dropped responses ----
+
+def test_dropped_response_retry_is_idempotent(tmp_path):
+    """The network eats the submit ACK after the job was durably
+    accepted: the retry (same idempotency key) maps back to the same
+    tenant — one admission, no twin."""
+    plan = FaultPlan([DropResponse("/v1/jobs", times=1)])
+    with EvolutionService(str(tmp_path), PROBLEMS, fault_plan=plan,
+                          **_svc_kwargs()) as svc:
+        retry = RetryPolicy(max_retries=4, backoff_s=0.05)
+        c = ServiceClient(svc.url, retry=retry)
+        tid = c.submit("onemax", params={"seed": 9, "ngen": 4},
+                       tenant_id="drop0", idempotency_key="kd")
+        assert tid == "drop0"
+        res = c.result(tid, wait=True, timeout=300)
+        assert res["status"] == "finished"
+    rows = _journal(tmp_path)
+    submits = [r for r in rows if r.get("kind") == "job_submitted"
+               and r.get("tenant_id") == "drop0"]
+    assert len(submits) == 1  # exactly one admission
+    assert any(r.get("kind") == "idempotent_replay"
+               and r.get("tenant_id") == "drop0" for r in rows)
+
+
+def test_wal_replay_recovers_unacked_jobs_and_dedups_keys(tmp_path):
+    """A forged crash artifact: accept records whose process died
+    before admission. A fresh service over the root replays them —
+    and a concurrent fresh submit for the same key (the client that
+    never saw its ACK, retrying into the restart) maps to the
+    recovered tenant instead of admitting a twin."""
+    root = tmp_path / "svc"
+    os.makedirs(root)
+    specs = [("w0", {"seed": 5, "ngen": 4}, "kw0"),
+             ("w1", {"seed": 6, "ngen": 4}, "kw1")]
+    wal = AdmissionWAL(os.path.join(root, "admission.wal"))
+    for tid, params, key in specs:
+        wal.append("accept", tenant_id=tid, problem="onemax",
+                   params=params, idempotency_key=key,
+                   request_id="r-crashed", token="")
+    wal.close()
+    ref = _inprocess_digests(
+        tmp_path / "ref",
+        [_onemax_job(tid, p) for tid, p, _ in specs])
+
+    with EvolutionService(str(root), PROBLEMS, **_svc_kwargs()) as svc:
+        c = ServiceClient(svc.url)
+        # the replay-vs-fresh-submit race for the same key: the key
+        # map is rebuilt before the HTTP server exists, so this must
+        # resolve to the recovered tenant
+        assert c.submit("onemax", params={"seed": 5, "ngen": 4},
+                        idempotency_key="kw0") == "w0"
+        for tid, _, _ in specs:
+            res = c.result(tid, wait=True, timeout=300)
+            assert res["status"] == "finished", res
+            assert res["result"]["digest"] == ref[tid]
+    rows = _journal(root)
+    replays = [r for r in rows if r.get("kind") == "wal_replay"]
+    assert replays and replays[0]["replayed"] == ["w0", "w1"]
+    assert any(r.get("kind") == "idempotent_replay"
+               and r.get("tenant_id") == "w0" for r in rows)
+
+
+# -------------------------------------- satellite: request tracing ----
+
+def test_request_id_threads_through_journal(tmp_path):
+    with EvolutionService(str(tmp_path), PROBLEMS,
+                          **_svc_kwargs()) as svc:
+        conn = http.client.HTTPConnection(svc.host, svc.port,
+                                          timeout=60)
+        conn.request("POST", "/v1/jobs",
+                     body=json.dumps({
+                         "problem": "onemax",
+                         "params": {"seed": 2, "ngen": 4},
+                         "tenant_id": "rid0"}),
+                     headers={"Content-Type": "application/json",
+                              "X-Request-Id": "trace-42"})
+        resp = conn.getresponse()
+        assert resp.getheader("X-Request-Id") == "trace-42"  # echoed
+        assert resp.status == 200
+        resp.read()
+        conn.close()
+        c = ServiceClient(svc.url)
+        # requests without the header get a generated id back
+        c2 = http.client.HTTPConnection(svc.host, svc.port, timeout=60)
+        c2.request("GET", "/v1/jobs/rid0")
+        r2 = c2.getresponse()
+        assert r2.getheader("X-Request-Id", "").startswith("req-")
+        r2.read()
+        c2.close()
+        assert c.result("rid0", wait=True,
+                        timeout=300)["status"] == "finished"
+    rows = _journal(tmp_path)
+    traced = [r for r in rows if r.get("request_id") == "trace-42"]
+    kinds = {r.get("kind") for r in traced}
+    # one grep over the id reconstructs the request's full path
+    assert {"service_request", "job_submitted", "tenant_admitted",
+            "tenant_finished"} <= kinds
+
+
+# --------------------------- satellite: long-poll param hardening ----
+
+def test_timeout_param_malformed_is_400_and_clamped(tmp_path):
+    with EvolutionService(str(tmp_path), PROBLEMS, max_poll_s=0.5,
+                          **_svc_kwargs()) as svc:
+        c = ServiceClient(svc.url)
+        tid = c.submit("onemax", params={"seed": 1, "ngen": 200},
+                       tenant_id="long0")
+        # malformed timeout: 400, never an unhandled ValueError -> 500
+        with pytest.raises(ServiceError) as ei:
+            c.result(tid, wait=True, timeout="bogus")
+        assert ei.value.code == 400
+        with pytest.raises(ServiceError) as ei:
+            c.results_many([tid], wait=True, timeout="1e")
+        assert ei.value.code == 400
+        # a huge client timeout cannot pin the request thread: the
+        # server clamps the long-poll to max_poll_s
+        t0 = time.monotonic()
+        res = c.result(tid, wait=True, timeout=10_000)
+        assert time.monotonic() - t0 < 30
+        assert res["_status"] == 202  # still running, poll returned
+        svc.drain(wait=True, timeout=120)
+    rows = _journal(tmp_path)
+    assert any(r.get("kind") == "service_drain" for r in rows)
+
+
+# ----------------------------- satellite: scheduler idleness signal ----
+
+def test_slo_snapshot_exposes_gens_since_interaction(tmp_path):
+    sched = Scheduler(str(tmp_path), max_lanes=2, segment_len=2)
+    sched.submit(_onemax_job("i0", {"seed": 1, "ngen": 8}))
+    sched.step()
+    snap = sched.slo_snapshot()
+    idle = next(iter(snap.values()))["idle"]
+    assert idle and all(len(t) == 3 for t in idle)
+    tid, segments, gens_idle = idle[0]
+    assert tid == "i0" and gens_idle == 2  # 2 gens, never polled
+    sched.tenants["i0"].note_interaction()
+    idle2 = next(iter(sched.slo_snapshot().values()))["idle"]
+    assert idle2[0][2] == 0  # the interaction reset the idleness clock
+    sched.run()
+    sched.close()
+
+
+# --------------------------------------------------- chaos tier ----
+
+@pytest.mark.chaos
+def test_kill9_restart_bit_identical_under_live_load(tmp_path):
+    """THE acceptance pin: ``kill -9`` mid-run under concurrent
+    retrying client load (idempotency keys), supervisor restart over
+    the same root (WAL replay + checkpoint resume), zero lost jobs and
+    every tenant's wire digest bit-identical to an uninterrupted
+    in-process run."""
+    from deap_tpu.serving import chaos
+
+    specs = chaos.chaos_specs(8)
+    ref = chaos.reference_digests(str(tmp_path / "ref"), specs,
+                                  segment_len=2, max_lanes=8)
+    out = chaos.run_chaos(str(tmp_path / "svc"), n_tenants=8,
+                          kill_at_step=3, segment_len=2, max_lanes=8,
+                          clients=4, converge_timeout_s=420)
+    assert out["kill_rc"] == -9, out       # SIGKILL actually landed
+    assert out["lost"] == [], out          # zero lost jobs
+    assert out["digests"] == ref           # bit-identical, every tenant
+    rows = _journal(tmp_path / "svc")      # the restarted journal
+    assert any(r.get("kind") == "wal_replay" for r in rows)
+    assert any(r.get("kind") in ("tenant_resumed", "tenant_admitted")
+               for r in rows)
+
+
+@pytest.mark.chaos
+def test_restart_resume_falls_back_past_corrupt_checkpoint(tmp_path):
+    """``CheckpointCorruptError`` during a service-restart resume: the
+    newest checkpoint is damaged after a drain; the restart falls back
+    to the previous verified-good step and still converges to the
+    uninterrupted digest."""
+    NGEN = 12
+    root = str(tmp_path / "svc")
+    ref = _inprocess_digests(
+        tmp_path / "ref", [_onemax_job("tA", {"seed": 3,
+                                              "ngen": NGEN})])["tA"]
+
+    def drain_at(step):
+        if step == 3:
+            svc.drain(wait=False)
+
+    svc = EvolutionService(root, PROBLEMS, step_hook=drain_at,
+                           **_svc_kwargs())
+    c = ServiceClient(svc.url)
+    c.submit("onemax", params={"seed": 3, "ngen": NGEN},
+             tenant_id="tA", idempotency_key="ka")
+    assert svc._drained.wait(300)
+    svc.close()
+
+    ck = Checkpointer(os.path.join(root, "tenants", "tA", "ckpt"))
+    steps = ck.steps()
+    assert len(steps) >= 2, steps  # need an older step to fall back to
+    corrupt_file(ck.path_for(steps[-1]), mode="flip")
+
+    with EvolutionService(root, PROBLEMS, **_svc_kwargs()) as svc2:
+        c2 = ServiceClient(svc2.url)
+        # WAL replay already resubmitted tA — no client action needed
+        res = c2.result("tA", wait=True, timeout=300)
+        assert res["status"] == "finished"
+        assert res["result"]["digest"] == ref
+    rows = _journal(root)
+    kinds = {r.get("kind") for r in rows}
+    assert "wal_replay" in kinds
+    assert {"checkpoint_corrupt", "checkpoint_fallback"} & kinds
