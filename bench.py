@@ -974,6 +974,209 @@ def serving_lines(out_path: str = "BENCH_SERVING.json") -> list:
     return rows
 
 
+# --------------------- batched GP generations serving (ISSUE 14) ----
+
+#: the GP serving scenario: N small symbolic-regression tenants, each
+#: an independent run packed on the run axis of ONE union-mask scan
+GP_SERVING = dict(tenants=64, pop=64, max_len=32, points=64, ngen=16)
+GP_SERVING_ISLAND = dict(tenants=16, n_islands=4, island_size=16,
+                         freq=2, mig_k=2, length=12, ngen=8)
+
+
+def gp_serving_lines(out_path: str = "BENCH_GP_SERVING.json") -> list:
+    """The batched-GP serving acceptance measurement (ISSUE 14): N
+    symbolic-regression tenants through ONE run-axis scan
+    (:class:`deap_tpu.serving.GpMultiRunEngine`) vs the SAME N jobs
+    run sequentially through the solo host-dispatch loop — min-of-reps
+    both sides — plus the island-epoch pair
+    (:class:`deap_tpu.serving.IslandMultiRunEngine` vs a pre-jitted
+    solo epoch driver) and a same-session solo ``bench_gp``
+    headline row (the ``--gp-race`` number must not regress while the
+    batched mode exists in the same build).
+
+    The sequential baseline is the STEELMAN: one warm
+    :func:`~deap_tpu.gp.loop.make_symbreg_loop` runner reused across
+    all tenants (its per-mask jitted parts stay cached), so the gap
+    measured is exactly the per-generation host dispatch × N the run
+    axis amortises — not retrace churn. Bit-identity of the batched
+    lanes vs solo is asserted and committed as its own row; the
+    tripwire requires it True."""
+    import numpy as np
+
+    import bench_gp
+    from deap_tpu import gp as _gp
+    from deap_tpu.gp.loop import make_symbreg_loop
+    from deap_tpu.gp.tree import make_generator
+    from deap_tpu.parallel.island import island_init, make_island_step
+    from deap_tpu.serving import (GpJobSpec, GpMultiRunEngine,
+                                  IslandJobSpec, IslandMultiRunEngine)
+
+    rows = []
+    envfp = _env_fingerprint("cpu")
+
+    # ------------------------------------------- symbreg GP bucket ----
+    n, ngen = GP_SERVING["tenants"], GP_SERVING["ngen"]
+    pop, ml, pts = (GP_SERVING["pop"], GP_SERVING["max_len"],
+                    GP_SERVING["points"])
+    pset = _gp.math_set(n_args=1)
+    X = jnp.linspace(-1.0, 1.0, pts, endpoint=False)[:, None]
+    y = X[:, 0] ** 3 + X[:, 0] ** 2 + X[:, 0]
+    tree_gen = make_generator(pset, ml, 1, 3, "full")
+    founders = [jax.vmap(tree_gen)(
+        jax.random.split(jax.random.key(i), pop)) for i in range(n)]
+    keys = [jax.random.key(9000 + i) for i in range(n)]
+    hyper = {"cxpb": 0.5, "mutpb": 0.2}
+
+    solo = make_symbreg_loop(pset, ml, X, y, cxpb=0.5, mutpb=0.2)
+    # two warm trajectories: distinct growth paths hit different
+    # mask-lattice classes before any timed rep (bench_gp protocol)
+    solo(keys[0], founders[0], ngen)
+    solo(keys[1], founders[1], ngen)
+
+    def run_sequential():
+        for i in range(n):
+            solo(keys[i], founders[i], ngen)
+
+    spec = GpJobSpec(pset=pset, max_len=ml, X=X, y=y)
+    eng = GpMultiRunEngine(spec)
+
+    def run_batched():
+        b = eng.pack_fresh(keys, founders, ngen, hyper)
+        b, _ = eng.advance(b, ngen)
+        sync(b["carry"]["genomes"]["nodes"])
+
+    seq_s = _serving_min_of_reps(run_sequential, reps=2)
+    bat_s = _serving_min_of_reps(run_batched)
+
+    # bit-identity: every batched lane vs its solo run, full results
+    solo_res = [solo(keys[i], founders[i], ngen) for i in range(n)]
+    b = eng.pack_fresh(keys, founders, ngen, hyper)
+    b, seg = eng.advance(b, ngen)
+    bat_res = [eng.lane_result(eng.unpack(b, i),
+                               eng.lane_records((seg,), i))
+               for i in range(n)]
+
+    def _eq(a, c):
+        return jax.tree_util.tree_all(jax.tree_util.tree_map(
+            lambda u, v: bool(np.array_equal(np.asarray(u),
+                                             np.asarray(v))), a, c))
+
+    bit = all(
+        _eq({k: s[k] for k in ("genomes", "depths", "fitness",
+                               "best_genome")},
+            {k: r[k] for k in ("genomes", "depths", "fitness",
+                               "best_genome")})
+        and s["nevals"] == r["nevals"]
+        and s["best_fitness"] == r["best_fitness"]
+        for s, r in zip(solo_res, bat_res))
+
+    total = n * ngen
+    rows += [
+        {"metric": "gp_serving_symbreg_64_sequential_gens_per_sec",
+         "value": round(total / seq_s, 1), "unit": "gens/sec",
+         "tenants": n, "seconds": round(seq_s, 4),
+         "baseline": ("steelman (one warm make_symbreg_loop reused, "
+                      "zero retraces)"),
+         "pop": pop, "max_len": ml, "points": pts, "ngen": ngen,
+         "env": envfp},
+        {"metric": "gp_serving_symbreg_64_batched_gens_per_sec",
+         "value": round(total / bat_s, 1), "unit": "gens/sec",
+         "tenants": n, "seconds": round(bat_s, 4),
+         "pop": pop, "max_len": ml, "points": pts, "ngen": ngen,
+         "env": envfp},
+        {"metric": "gp_serving_symbreg_64_batched_vs_sequential_x",
+         "value": round(seq_s / bat_s, 2), "unit": "x", "env": envfp},
+        {"metric": "gp_serving_bit_identical", "value": bool(bit),
+         "unit": "bool", "lanes_checked": n, "env": envfp},
+    ]
+
+    # ------------------------------------------- island-epoch pair ----
+    ni, epochs = (GP_SERVING_ISLAND["tenants"],
+                  GP_SERVING_ISLAND["ngen"])
+    isl, size = (GP_SERVING_ISLAND["n_islands"],
+                 GP_SERVING_ISLAND["island_size"])
+    freq, mig_k = GP_SERVING_ISLAND["freq"], GP_SERVING_ISLAND["mig_k"]
+    tb = Toolbox()
+    tb.register("evaluate", lambda g: g.sum(-1).astype(jnp.float32))
+    tb.register("mate", ops.cx_two_point)
+    tb.register("mutate", ops.mut_flip_bit, indpb=0.1)
+    tb.register("select", ops.sel_tournament, tournsize=3)
+    inits = [island_init(jax.random.key(i), isl, size,
+                         ops.bernoulli_genome(
+                             GP_SERVING_ISLAND["length"]),
+                         FitnessSpec((1.0,))) for i in range(ni)]
+    ikeys = [jax.random.key(7000 + i) for i in range(ni)]
+    istep = make_island_step(tb, 0.5, 0.2, freq, mig_k)
+
+    def solo_island(key, pops):
+        # the solo epoch driver's exact fold_in(key, epoch) schedule,
+        # rolled into one jitted program — the steelman again
+        def body(pops, e):
+            return istep(jax.random.fold_in(key, e), pops), None
+        pops, _ = lax.scan(body, pops, jnp.arange(epochs))
+        return pops
+
+    solo_ij = jax.jit(solo_island)
+
+    def run_sequential_i():
+        for i in range(ni):
+            out = solo_ij(ikeys[i], inits[i])
+        sync(out.fitness)
+
+    ieng = IslandMultiRunEngine(tb, IslandJobSpec(isl, size, freq,
+                                                  mig_k))
+
+    def run_batched_i():
+        b = ieng.pack_fresh(ikeys, inits, epochs,
+                            {"cxpb": 0.5, "mutpb": 0.2})
+        b, _ = ieng.advance(b, epochs)
+        sync(b["carry"]["pops"].fitness)
+
+    seq_i = _serving_min_of_reps(run_sequential_i, reps=2)
+    bat_i = _serving_min_of_reps(run_batched_i)
+    total_i = ni * epochs
+    rows += [
+        {"metric": "gp_serving_island_16_sequential_epochs_per_sec",
+         "value": round(total_i / seq_i, 1), "unit": "epochs/sec",
+         "tenants": ni, "seconds": round(seq_i, 4),
+         "baseline": "steelman (pre-jitted solo epoch scan)",
+         "n_islands": isl, "island_size": size, "freq": freq,
+         "mig_k": mig_k, "ngen": epochs, "env": envfp},
+        {"metric": "gp_serving_island_16_batched_epochs_per_sec",
+         "value": round(total_i / bat_i, 1), "unit": "epochs/sec",
+         "tenants": ni, "seconds": round(bat_i, 4),
+         "n_islands": isl, "island_size": size, "freq": freq,
+         "mig_k": mig_k, "ngen": epochs, "env": envfp},
+        {"metric": "gp_serving_island_16_batched_vs_sequential_x",
+         "value": round(seq_i / bat_i, 2), "unit": "x", "env": envfp},
+    ]
+
+    # ----------------------------- same-session solo headline row ----
+    # the --gp-race number, re-measured in THIS session: the tripwire
+    # compares it against the committed BENCH_GP.json so a batched-mode
+    # regression of the solo loop can't hide behind a stale headline
+    pset_r = _gp.math_set(n_args=1)
+    pset_r.arity_table()
+    Xr, yr = bench_gp._X_y()
+    solo_row = bench_gp.new_loop_row(pset_r, Xr, yr)
+    solo_row["env"] = envfp
+    solo_row["note"] = ("same-session solo headline "
+                        "(gp-race unregressed gate)")
+    rows.append(solo_row)
+
+    if out_path:
+        payload = {
+            "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "env": envfp,
+            "config": {"gp": GP_SERVING, "island": GP_SERVING_ISLAND,
+                       "reps": SERVING_REPS},
+            "tail": "\n".join(json.dumps(r) for r in rows),
+        }
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=1)
+    return rows
+
+
 # ------------------------------ network service plane (ISSUE 11) ----
 
 SERVICE_N = 1000            # tenants through real sockets
@@ -2434,6 +2637,20 @@ if __name__ == "__main__":
         out = (nxt if nxt and not nxt.startswith("--")
                else "BENCH_SERVING.json")
         for row in serving_lines(out):
+            print(json.dumps(row), flush=True)
+    elif "--gp-serving" in sys.argv:
+        # the batched-GP serving acceptance measurement (ISSUE 14): 64
+        # symbreg tenants through one run-axis scan vs the same 64
+        # sequentially through the solo loop (bit-identity asserted),
+        # the island-epoch pair, and a same-session solo headline row
+        # — committed as BENCH_GP_SERVING.json; bench_report.py
+        # --tripwire gates the ratio, the bit row and the solo number
+        jax.config.update("jax_platforms", "cpu")
+        i = sys.argv.index("--gp-serving")
+        nxt = sys.argv[i + 1] if i + 1 < len(sys.argv) else None
+        out = (nxt if nxt and not nxt.startswith("--")
+               else "BENCH_GP_SERVING.json")
+        for row in gp_serving_lines(out):
             print(json.dumps(row), flush=True)
     elif "--service-chaos" in sys.argv:
         # the fault-tolerance acceptance measurement (ISSUE 12): a

@@ -79,6 +79,15 @@ def _bench_rows(path: str) -> dict:
     return rows
 
 
+def _gp_race_files() -> list:
+    """Committed --gp-race files. BENCH_GP_SERVING*.json (the batched
+    serving pair, :func:`gp_serving_tripwire`) shares the BENCH_GP
+    prefix and must not shadow the race history."""
+    return sorted(
+        f for f in glob.glob(os.path.join(HERE, "BENCH_GP*.json"))
+        if not os.path.basename(f).startswith("BENCH_GP_SERVING"))
+
+
 def gp_tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
     """The gp_symbreg paired-row check. BENCH_GP.json carries the old
     scan-loop and the new specialized-loop throughputs measured
@@ -89,7 +98,7 @@ def gp_tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
     (live-vs-live, same session), and diffs consecutive committed
     BENCH_GP*.json files with the same rules as the headline
     tripwire. Returns the number of tripped rows."""
-    files = sorted(glob.glob(os.path.join(HERE, "BENCH_GP*.json")))
+    files = _gp_race_files()
     if not files:
         print("gp tripwire: no committed BENCH_GP*.json yet")
         return 0
@@ -352,6 +361,103 @@ def serving_tripwire(gates=None) -> int:
         tripped += 0 if ok else 1
     if len(files) >= 2:
         tripped += _diff_rows(files[-2], files[-1], TRIPWIRE_THRESHOLD)
+    return tripped
+
+
+#: the batched-GP serving gates (bench.py --gp-serving,
+#: BENCH_GP_SERVING.json): the run-axis engine must hold >= 2x over
+#: the steelman sequential solo loop at N=64, and the same-session
+#: solo headline must stay within 10% of the committed --gp-race
+#: number — the batched mode may not tax the solo path it shares
+#: interpreters with
+GP_SERVING_RATIO_FLOOR = 2.0
+GP_SERVING_SOLO_FLOOR = 0.9
+
+_GP_HEADLINE = "gp_symbreg_pop4096_pts256_generations_per_sec"
+
+
+def gp_serving_tripwire(ratio_floor: float = GP_SERVING_RATIO_FLOOR,
+                        solo_floor: float = GP_SERVING_SOLO_FLOOR
+                        ) -> int:
+    """The batched-GP serving gate (ISSUE 14). The latest
+    BENCH_GP_SERVING*.json must show (1) the 64-tenant symbreg batch
+    at or above ``ratio_floor``x the steelman sequential solo loop —
+    a same-session live-vs-live pair, (2) every batched lane
+    **bit-identical** to its solo run (the committed bool row — a
+    throughput win that changes numerics is a bug, not a win), and
+    (3) the same-session solo headline at or above ``solo_floor``x
+    the committed BENCH_GP.json number. Returns the number of
+    tripped rows."""
+    files = sorted(glob.glob(os.path.join(HERE,
+                                          "BENCH_GP_SERVING*.json")))
+    if not files:
+        print("gp-serving tripwire: no committed "
+              "BENCH_GP_SERVING*.json yet")
+        return 0
+    rows = _bench_rows(files[-1])
+    print(f"\n## GP serving ({os.path.basename(files[-1])})\n")
+    tripped = 0
+
+    ratio = rows.get("gp_serving_symbreg_64_batched_vs_sequential_x")
+    if ratio is not None and isinstance(ratio.get("value"),
+                                        (int, float)):
+        ok = ratio["value"] >= ratio_floor
+        print(f"- symbreg batched-vs-sequential: {ratio['value']}x "
+              f"(floor {ratio_floor}x) "
+              + ("ok" if ok else "**REGRESSION** (the run axis lost "
+                 "its edge over per-tenant host dispatch)"))
+        tripped += 0 if ok else 1
+    else:
+        print("- symbreg batched-vs-sequential row missing")
+        tripped += 1
+
+    bit = rows.get("gp_serving_bit_identical")
+    if bit is not None and bit.get("value") is True:
+        print(f"- batched lanes vs solo: bit-identical over "
+              f"{bit.get('lanes_checked', '?')} lanes ok")
+    else:
+        print("- **REGRESSION**: batched GP lanes are NOT "
+              "bit-identical to the solo loop (or the row is "
+              "missing) — the run axis is changing numerics")
+        tripped += 1
+
+    isl = rows.get("gp_serving_island_16_batched_vs_sequential_x")
+    if isl is not None and isinstance(isl.get("value"), (int, float)):
+        print(f"- island batched-vs-sequential: {isl['value']}x "
+              "(context row, ungated — the sequential side is "
+              "already one fused scan per tenant)")
+
+    def _find(rowmap, metric):
+        # rows carry an impl tag, so keys may be "metric:impl"
+        return next((rowmap[k] for k in rowmap
+                     if k == metric or k.startswith(metric + ":")),
+                    None)
+
+    solo = _find(rows, _GP_HEADLINE)
+    race = _gp_race_files()
+    committed = _find(_bench_rows(race[-1]), _GP_HEADLINE) \
+        if race else None
+    if (solo and committed
+            and isinstance(solo.get("value"), (int, float))
+            and isinstance(committed.get("value"), (int, float))
+            and committed["value"]):
+        r = solo["value"] / committed["value"]
+        ok = r >= solo_floor
+        print(f"- same-session solo headline: {solo['value']} vs "
+              f"committed {committed['value']} gens/s = {r:.2f}x "
+              f"(floor {solo_floor}x) "
+              + ("ok" if ok else "**REGRESSION** (the solo loop "
+                 "slowed down in the build that carries the batched "
+                 "mode)"))
+        tripped += 0 if ok else 1
+    else:
+        print("- solo headline pair missing (need a committed "
+              "BENCH_GP.json and the same-session row)")
+        tripped += 1
+
+    if len(files) >= 2:
+        tripped += _diff_rows(files[-2], files[-1],
+                              TRIPWIRE_THRESHOLD)
     return tripped
 
 
@@ -630,6 +736,7 @@ def tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
     tripped += resilience_tripwire()
     tripped += fusion_tripwire()
     tripped += serving_tripwire()
+    tripped += gp_serving_tripwire()
     tripped += service_tripwire()
     tripped += chaos_tripwire()
     tripped += mesh_tripwire()

@@ -242,10 +242,15 @@ def _prim_rows_builder(pset: PrimitiveSet,
     return prim_rows
 
 
-def _cached_factory(pset: PrimitiveSet, key, build: Callable) -> Callable:
+def _cached_factory(pset: PrimitiveSet, key, build: Callable,
+                    extra: Optional[dict] = None) -> Callable:
     """Return the interpreter cached under ``key`` for ``pset``, or
     build and remember it. The cache entry also pins the operator
-    count: growing the set invalidates every interpreter built on it."""
+    count: growing the set invalidates every interpreter built on it.
+    ``extra`` — additional fields for the build's journal event (the
+    batched serving engine records ``n_lanes`` and the union-mask
+    popcount here, so rebuild budgets stay auditable under a run
+    axis)."""
     entry = _INTERPRETER_CACHE.setdefault(pset, {})
     full_key = (pset.n_ops,) + key
     fn = entry.get(full_key)
@@ -260,7 +265,7 @@ def _cached_factory(pset: PrimitiveSet, key, build: Callable) -> Callable:
         # exists to surface; no-op unless a journal is open
         from deap_tpu.telemetry.journal import broadcast
         broadcast("gp_interpreter_build", key=repr(full_key),
-                  n_stale_evicted=len(stale))
+                  n_stale_evicted=len(stale), **(extra or {}))
     return fn
 
 
@@ -779,8 +784,12 @@ def _build_batch_dispatcher(pset: PrimitiveSet, max_len: int, mode: str,
         if state["journaled"] != tag:
             state["journaled"] = tag
             from deap_tpu.telemetry.journal import broadcast
+            # n_lanes=1: this dispatcher serves one population; the
+            # batched serving engine journals its own gp_dispatch rows
+            # with its lane count (same schema, auditable together)
             broadcast("gp_dispatch", mode=mode,
                       mask=[pset.primitives[i].name for i in mask],
+                      mask_popcount=len(mask), n_lanes=1,
                       **extra)
 
     def _concrete_unique(genomes, X):

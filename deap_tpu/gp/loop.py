@@ -193,57 +193,46 @@ def resolve_compaction(mode: str) -> str:
     return mode
 
 
-def make_gp_loop(pset: PrimitiveSet, max_len: int, evaluate: Callable, *,
-                 cxpb: float, mutpb: float, tournsize: int = 3,
-                 height_limit: int = 17,
-                 mut_min: int = 0, mut_max: int = 2,
-                 mut_width: Optional[int] = None,
-                 compaction: str = "auto",
-                 telemetry=None, probes=(), plan=None) -> Callable:
-    """Build ``run(key, genomes, ngen) -> result`` — the host-dispatch
-    eaSimple-shaped GP loop (tournament selection, adjacent-pair
-    one-point crossover at ``cxpb``, uniform subtree mutation at
-    ``mutpb`` with a fresh genFull(mut_min, mut_max) donor, Koza
-    ``height_limit`` keep-parent, invalid-only evaluation).
+class GpStepParts:
+    """The per-individual variation/selection machinery of the
+    host-dispatch GP loop, factored out of :func:`make_gp_loop` so the
+    batched serving engine (:class:`deap_tpu.serving.GpMultiRunEngine`)
+    vmaps the *same* traced functions over a leading run axis — the
+    construction that makes batched-vs-solo bit-identity structural
+    rather than coincidental. All members are pure and trace-safe:
 
-    ``evaluate(genomes) -> f32[n]`` maximization fitness, called
-    EAGERLY with concrete sub-populations — pair it with a
-    ``make_batch_interpreter``/``make_population_evaluator`` evaluator
-    so the live-vocab/dedup/grouped dispatch engages. ``compaction``
-    picks how the per-generation touched/cx/mut index sets are built:
-    ``'device'`` (default — jit'd prefix-sum compaction, only the three
-    counts cross to the host) or ``'host'`` (the PR-3
-    ``np.nonzero``/``np.resize`` round trip; bit-identical results,
-    kept as the parity oracle). The result dict
-    carries the final population + depth arrays, the best individual,
-    and the reference-comparable ``nevals`` per generation.
+    - ``pair_cx(key, g1, d1, g2, d2)`` — one adjacent-pair one-point
+      crossover with carried depth arrays and the Koza keep-parent
+      height limit;
+    - ``one_mut(key, g, d)`` — one uniform subtree mutation with a
+      fresh genFull donor, same depth carry and limit;
+    - ``select_idx(key, fit)`` — the tournament index draw;
+    - ``depths(g)`` — one genome's ``prefix_depths`` recomputation.
+    """
 
-    ``plan`` (a :class:`deap_tpu.parallel.ShardingPlan`) shards the
-    population arrays (genomes/depths/fitness rows) over the plan's
-    mesh: the jitted select/variation programs partition across
-    devices and the grouped-dispatch evaluator receives row-sharded
-    sub-populations. Results are bit-identical to the unsharded loop
-    (sharding is layout, not semantics — pinned in
-    ``tests/test_sharding_plan.py``); the per-generation placement pin
-    re-uses buffers already laid out correctly.
+    def __init__(self, pair_cx, one_mut, select_idx, depths, arity,
+                 expr, height_limit, tournsize):
+        self.pair_cx = pair_cx
+        self.one_mut = one_mut
+        self.select_idx = select_idx
+        self.depths = depths
+        self.arity = arity
+        self.expr = expr
+        self.height_limit = height_limit
+        self.tournsize = tournsize
 
-    ``telemetry``/``probes``: the host-dispatch counterpart of the
-    scanned loops' instrumentation — one decoded ``meter`` row per
-    generation lands in the journal as it happens (this loop has a
-    host in it anyway), probes get the selection indices and, since
-    the population is concrete here, the GP interpreter's *exact*
-    dedup count via ``host_clone_rate`` (TreeDiversityProbe prefers it
-    over its in-scan hash). Because the driver is host-side, a
-    :class:`~deap_tpu.telemetry.probes.HealthMonitor` configured with
-    ``early_stop`` genuinely stops the run (``result["stopped_at"]``
-    records the generation). Telemetry changes no computed result."""
+
+def make_gp_step_parts(pset: PrimitiveSet, max_len: int, *,
+                       tournsize: int = 3, height_limit: int = 17,
+                       mut_min: int = 0, mut_max: int = 2,
+                       mut_width: Optional[int] = None) -> GpStepParts:
+    """Build the :class:`GpStepParts` for one (pset, max_len, knobs)
+    configuration — the shared kernel of the solo host-dispatch loop
+    and the batched multi-run engine."""
     arity = pset.arity_table()
     mut_width = mut_width or min(max_len, 32)
     expr = make_generator(pset, mut_width, mut_min, mut_max, "full")
     ML = max_len
-
-    depths_of = jax.jit(jax.vmap(
-        lambda g: prefix_depths(g["nodes"], g["length"], arity)))
 
     def pair_cx(key, g1, d1, g2, d2):
         k1, k2 = jax.random.split(key)
@@ -292,11 +281,72 @@ def make_gp_loop(pset: PrimitiveSet, max_len: int, evaluate: Callable, *,
         dd = jnp.where(bad, d, dd)
         return c, dd
 
+    def select_idx(key, fit):
+        n = fit.shape[0]
+        return ops.sel_tournament(key, fit[:, None], n,
+                                  tournsize=tournsize)
+
+    def depths(g):
+        return prefix_depths(g["nodes"], g["length"], arity)
+
+    return GpStepParts(pair_cx, one_mut, select_idx, depths, arity,
+                       expr, height_limit, tournsize)
+
+
+def make_gp_loop(pset: PrimitiveSet, max_len: int, evaluate: Callable, *,
+                 cxpb: float, mutpb: float, tournsize: int = 3,
+                 height_limit: int = 17,
+                 mut_min: int = 0, mut_max: int = 2,
+                 mut_width: Optional[int] = None,
+                 compaction: str = "auto",
+                 telemetry=None, probes=(), plan=None) -> Callable:
+    """Build ``run(key, genomes, ngen) -> result`` — the host-dispatch
+    eaSimple-shaped GP loop (tournament selection, adjacent-pair
+    one-point crossover at ``cxpb``, uniform subtree mutation at
+    ``mutpb`` with a fresh genFull(mut_min, mut_max) donor, Koza
+    ``height_limit`` keep-parent, invalid-only evaluation).
+
+    ``evaluate(genomes) -> f32[n]`` maximization fitness, called
+    EAGERLY with concrete sub-populations — pair it with a
+    ``make_batch_interpreter``/``make_population_evaluator`` evaluator
+    so the live-vocab/dedup/grouped dispatch engages. ``compaction``
+    picks how the per-generation touched/cx/mut index sets are built:
+    ``'device'`` (default — jit'd prefix-sum compaction, only the three
+    counts cross to the host) or ``'host'`` (the PR-3
+    ``np.nonzero``/``np.resize`` round trip; bit-identical results,
+    kept as the parity oracle). The result dict
+    carries the final population + depth arrays, the best individual,
+    and the reference-comparable ``nevals`` per generation.
+
+    ``plan`` (a :class:`deap_tpu.parallel.ShardingPlan`) shards the
+    population arrays (genomes/depths/fitness rows) over the plan's
+    mesh: the jitted select/variation programs partition across
+    devices and the grouped-dispatch evaluator receives row-sharded
+    sub-populations. Results are bit-identical to the unsharded loop
+    (sharding is layout, not semantics — pinned in
+    ``tests/test_sharding_plan.py``); the per-generation placement pin
+    re-uses buffers already laid out correctly.
+
+    ``telemetry``/``probes``: the host-dispatch counterpart of the
+    scanned loops' instrumentation — one decoded ``meter`` row per
+    generation lands in the journal as it happens (this loop has a
+    host in it anyway), probes get the selection indices and, since
+    the population is concrete here, the GP interpreter's *exact*
+    dedup count via ``host_clone_rate`` (TreeDiversityProbe prefers it
+    over its in-scan hash). Because the driver is host-side, a
+    :class:`~deap_tpu.telemetry.probes.HealthMonitor` configured with
+    ``early_stop`` genuinely stops the run (``result["stopped_at"]``
+    records the generation). Telemetry changes no computed result."""
+    parts = make_gp_step_parts(
+        pset, max_len, tournsize=tournsize, height_limit=height_limit,
+        mut_min=mut_min, mut_max=mut_max, mut_width=mut_width)
+    pair_cx, one_mut = parts.pair_cx, parts.one_mut
+
+    depths_of = jax.jit(jax.vmap(parts.depths))
+
     @jax.jit
     def select(key, genomes, depths, fit):
-        n = fit.shape[0]
-        idx = ops.sel_tournament(key, fit[:, None], n,
-                                 tournsize=tournsize)
+        idx = parts.select_idx(key, fit)
         return (jax.tree_util.tree_map(lambda a: a[idx], genomes),
                 depths[idx], fit[idx], idx)
 

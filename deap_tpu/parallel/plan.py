@@ -80,14 +80,22 @@ class ShardingPlan:
     """
 
     def __init__(self, mesh: Optional[Mesh] = None, *, axis: str = "pop",
-                 donate: bool = True):
+                 axis2: Optional[str] = None, donate: bool = True):
         if mesh is None:
-            mesh = population_mesh(axis_names=(axis,))
+            mesh = population_mesh(
+                axis_names=(axis,) if axis2 is None else (axis, axis2))
         if axis not in mesh.axis_names:
             raise ValueError(f"plan axis {axis!r} not in mesh axes "
                              f"{mesh.axis_names}")
+        if axis2 is not None and axis2 not in mesh.axis_names:
+            raise ValueError(f"plan axis2 {axis2!r} not in mesh axes "
+                             f"{mesh.axis_names}")
         self.mesh = mesh
         self.axis = axis
+        #: optional second data axis: rank>=2 leaves whose first two
+        #: dims divide the (axis, axis2) mesh tile shard over BOTH —
+        #: the ("run", "island") layout for batched island serving
+        self.axis2 = axis2
         self.donate = bool(donate)
         self.mode = sharding_mode()
         if self.mode != "pjit":
@@ -113,9 +121,37 @@ class ShardingPlan:
         return cls(population_mesh(n_devices, axis_names=("island",)),
                    axis="island", **kwargs)
 
+    @classmethod
+    def for_island_runs(cls, n_runs: Optional[int] = None,
+                        n_devices: Optional[int] = None,
+                        **kwargs) -> "ShardingPlan":
+        """2-D ``("run", "island")`` plan for the batched island engine:
+        the run axis of :class:`deap_tpu.serving.gp_multirun.
+        IslandMultiRunEngine` shards over ``"run"``, each run's stacked
+        demes over ``"island"``. ``n_runs`` is the run-axis mesh extent
+        (must divide the device count; default: all devices on the run
+        axis, islands replicated per device). The layout rule stays
+        value-free — a lane's epoch program is the same global program
+        whatever the tile shape."""
+        devices = jax.devices()
+        total = len(devices) if n_devices is None else int(n_devices)
+        r = total if n_runs is None else int(n_runs)
+        if r < 1 or total % r != 0:
+            raise ValueError(f"n_runs={r} must divide the device "
+                             f"count {total}")
+        mesh = population_mesh(total,
+                               axis_names=("run", "island"),
+                               shape=(r, total // r))
+        return cls(mesh, axis="run", axis2="island", **kwargs)
+
     @property
     def n_shards(self) -> int:
         return self.mesh.shape[self.axis]
+
+    @property
+    def n_shards2(self) -> int:
+        return (self.mesh.shape[self.axis2]
+                if self.axis2 is not None else 1)
 
     # ------------------------------------------------------ spec helpers ----
 
@@ -137,13 +173,18 @@ class ShardingPlan:
         """The plan's layout for one leaf: leading axis sharded over
         the plan axis when it divides evenly, replicated otherwise
         (scalars, PRNG key arrays, hall-of-fame rows smaller than the
-        mesh, strategy-state vectors). The rule is deliberately
-        value-free — layout can never change what a global program
-        computes, only where it computes it."""
+        mesh, strategy-state vectors). With ``axis2`` set (the 2-D
+        ``("run", "island")`` preset), rank>=2 leaves whose first TWO
+        dims divide the mesh tile shard over both axes. The rule is
+        deliberately value-free — layout can never change what a
+        global program computes, only where it computes it."""
         shape = getattr(leaf, "shape", None)
         if (shape is None or len(shape) == 0 or _is_prng_key(leaf)
                 or shape[0] == 0 or shape[0] % self.n_shards != 0):
             return self.replicated
+        if (self.axis2 is not None and len(shape) >= 2
+                and shape[1] > 0 and shape[1] % self.n_shards2 == 0):
+            return NamedSharding(self.mesh, P(self.axis, self.axis2))
         return self.row_sharding
 
     def tree_shardings(self, tree: Any) -> Any:
@@ -263,7 +304,7 @@ class ShardingPlan:
         different mesh than the one the checkpoint was written on."""
         return {"axes": list(self.mesh.axis_names),
                 "shape": [int(s) for s in self.mesh.devices.shape],
-                "axis": self.axis,
+                "axis": self.axis, "axis2": self.axis2,
                 "n_devices": int(self.mesh.devices.size)}
 
     def __repr__(self) -> str:

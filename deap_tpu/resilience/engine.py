@@ -24,7 +24,9 @@ Three planes:
   :meth:`~ResilientRun.ea_mu_comma_lambda`,
   :meth:`~ResilientRun.ea_generate_update`, the host-dispatch
   :meth:`~ResilientRun.gp_loop`, the epoch-driven
-  :meth:`~ResilientRun.island_run`). SIGTERM/SIGINT set a flag; the
+  :meth:`~ResilientRun.island_run`, and the batched
+  :meth:`~ResilientRun.multirun` that checkpoints a whole packed
+  run-axis batch as one state). SIGTERM/SIGINT set a flag; the
   in-flight segment finishes, the state is saved, a ``preempted`` event
   is journaled and :class:`Preempted` raised — the caller exits cleanly
   and the next invocation resumes where it stopped.
@@ -430,6 +432,66 @@ class _IslandSpec(_LoopSpec):
         return state["pops"], state["mstate"]
 
 
+class _EngineBatchSpec(_LoopSpec):
+    """A packed :class:`deap_tpu.serving.multirun.MultiRunEngine`
+    batch (any family, including the GP and island run-axis engines)
+    driven in checkpointed segments: the whole batch — every lane's
+    carry, shadow, keys and per-lane record chunks — is ONE state
+    pytree, so a preempted N-lane sweep resumes all N lanes from one
+    boundary, bit-exactly."""
+
+    def __init__(self, engine, keys, inits, ngens, hypers):
+        self.algorithm = f"multirun_{engine.family}"
+        self.engine = engine
+        self.keys = list(keys)
+        self.inits = list(inits)
+        self.ngens = ngens
+        self.hypers = hypers
+        self.n = len(self.keys)
+        self.horizon = max(ngens) if ngens else 0
+
+    def init(self):
+        eng = self.engine
+        lanes = [eng.lane_init(k, p, g, h)
+                 for k, p, g, h in zip(self.keys, self.inits,
+                                       self.ngens, self.hypers)]
+        batch = eng.pack(lanes, n_lanes=self.n, horizon=self.horizon)
+        return {"gen": 0, "batch": batch,
+                "records": [[] for _ in range(self.n)]}
+
+    def on_resume(self, state):
+        # re-pack the restored lanes through the engine so engine-side
+        # pack hooks run on the concrete state (the GP engine grows its
+        # union mask from the restored genomes; scan-family engines
+        # round-trip unchanged) — the same unpack→pack path the
+        # scheduler's evict/resume uses, pinned bit-exact by
+        # tests/test_serving.py
+        eng = self.engine
+        n_real = int(state["batch"].get("n_real", self.n))
+        lanes = [eng.unpack(state["batch"], i) for i in range(n_real)]
+        state["batch"] = eng.pack(lanes, n_lanes=self.n,
+                                  horizon=self.horizon)
+
+    def segment(self, state, lo, hi):
+        batch, seg = self.engine.advance(state["batch"], hi - lo)
+        for i in range(self.n):
+            chunk = self.engine.lane_records((seg,), i)
+            if chunk is not None:
+                state["records"][i] = state["records"][i] + [chunk]
+        state["batch"] = batch
+        state["gen"] = hi
+        return state
+
+    def finalize(self, state):
+        eng = self.engine
+        return [eng.lane_result(eng.unpack(state["batch"], i),
+                                eng.concat_records(state["records"][i]))
+                for i in range(self.n)]
+
+    def stop_requested(self, state):
+        return bool(self.engine.done(state["batch"]).all())
+
+
 class ResilientRun:
     """Segmented, checkpointed, signal-aware driver for every loop
     family (see the module docstring). One instance drives one logical
@@ -662,6 +724,28 @@ class ResilientRun:
                         telemetry=self.telemetry, reshard=reshard,
                         record_rows=record_rows),
             n_epochs)
+
+    def multirun(self, engine, keys, inits, ngen, hyper=None):
+        """Drive a packed :class:`deap_tpu.serving.multirun
+        .MultiRunEngine` batch — any family, including the GP
+        (:class:`~deap_tpu.serving.gp_multirun.GpMultiRunEngine`) and
+        island run-axis engines — in checkpointed segments. ``ngen``
+        and ``hyper`` broadcast like
+        :func:`deap_tpu.serving.multirun.multirun`'s; returns the same
+        per-lane solo-format result list, bit-identically, with the
+        whole batch checkpointed as one state at every boundary."""
+        n = len(keys)
+        if len(inits) != n:
+            raise ValueError("len(inits) != len(keys)")
+        ngens = [int(g) for g in
+                 (ngen if isinstance(ngen, (list, tuple))
+                  else [ngen] * n)]
+        hypers = (list(hyper) if isinstance(hyper, (list, tuple))
+                  else [hyper] * n)
+        if len(ngens) != n or len(hypers) != n:
+            raise ValueError("ngen/hyper lists must match len(keys)")
+        spec = _EngineBatchSpec(engine, keys, inits, ngens, hypers)
+        return self._drive(spec, max(ngens) if ngens else 0)
 
     # -------------------------------------------------------- pop plumbing ----
 

@@ -27,6 +27,12 @@ service wire protocol.
 """
 
 from deap_tpu.serving.multirun import FAMILIES, MultiRunEngine, multirun
+from deap_tpu.serving.gp_multirun import (
+    GpJobSpec,
+    GpMultiRunEngine,
+    IslandJobSpec,
+    IslandMultiRunEngine,
+)
 from deap_tpu.serving.tenant import (
     Job,
     Tenant,
@@ -55,6 +61,10 @@ __all__ = [
     "AutoscalePolicy",
     "EvolutionService",
     "FAMILIES",
+    "GpJobSpec",
+    "GpMultiRunEngine",
+    "IslandJobSpec",
+    "IslandMultiRunEngine",
     "Job",
     "MultiRunEngine",
     "Scheduler",

@@ -1,0 +1,827 @@
+"""Device-resident batched GP generations + island epochs — the run
+axis for the two host-driven families (ROADMAP item 2).
+
+The four scan families ride :class:`deap_tpu.serving.MultiRunEngine`
+because their whole run is already one ``lax.scan``. The GP
+host-dispatch loop (:mod:`deap_tpu.gp.loop`) and the island epoch
+driver could not be batched that way: the GP loop round-trips through
+the host every generation (live-vocab masks, index compaction,
+dispatch), and islands are driven one ``fold_in(key, epoch)`` step at a
+time. This module closes the gap with two engines that speak the same
+lane/batch/segment protocol the scheduler already serves:
+
+- :class:`GpMultiRunEngine` — N independent GP runs through ONE jitted
+  ``lax.scan``. The per-generation program is the *same* variation
+  machinery the solo loop dispatches (:func:`gp.loop.make_gp_step_parts`
+  — shared closures, not copies), vmapped over a leading run axis, with
+  the compacted invalid-only evaluation replaced by a full-width
+  where-select (duplicated work, zero host round trips — the waste
+  model in docs/advanced/gp_interpreter.md). Live-vocab specialization
+  survives batching through a **union-mask fixpoint**: the engine
+  carries one monotone opcode mask covering every lane; a ``presence``
+  bitvector accumulated on device over the segment records which
+  opcodes the post-variation populations actually contained, and a
+  segment whose presence escapes the mask is *replayed* from the
+  retained input batch under the grown mask. Masks only grow, so total
+  replays over an engine's lifetime are bounded by ``n_ops`` — the same
+  lattice bound the solo dispatcher journals.
+- :class:`IslandMultiRunEngine` — N island runs, each lane the exact
+  solo :func:`deap_tpu.parallel.make_island_step` program (built inside
+  the lane trace so per-lane cxpb/mutpb enter as tracers), keyed
+  ``fold_in(base_key, epoch)`` exactly as the solo epoch driver does.
+
+Correctness contract — **per-lane bit-identity to the solo drivers**
+(populations, depth arrays, fitness, best individual, nevals), pinned
+by ``tests/test_gp_serving.py`` across mixed-ngen / typed / ERC-heavy /
+ADF lanes. The construction: per-lane base key + ``fold_in(key, gen)``
+is stateless in the generation index (the solo loops' own property),
+the vmapped step IS the solo step, full-width variation selected by the
+same Bernoulli draws computes byte-identical offspring (crossover keys
+derive from the pair id, mutation keys from the row id — duplicates
+and non-drawn rows are ``where``-discarded, and everything outside the
+evaluator is integer/gather/PRNG arithmetic), and a finished lane's
+state latches into a shadow carry (the PR 7 masked-stepping scheme —
+see :meth:`MultiRunEngine._segment` for why the mask must hang off the
+recurrence rather than feed back into it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deap_tpu import algorithms as algos
+from deap_tpu.core.population import Population
+from deap_tpu.gp.interpreter import _cached_factory, _traced_batch, _used_ops
+from deap_tpu.gp.loop import make_gp_step_parts
+from deap_tpu.gp.pset import PrimitiveSet
+from deap_tpu.parallel.island import make_island_step
+from deap_tpu.serving.multirun import (MultiRunEngine, _tree_index,
+                                       _tree_stack, _tree_where)
+from deap_tpu.support.checkpoint import _key_impl_name
+
+__all__ = ["GpJobSpec", "GpMultiRunEngine", "IslandJobSpec",
+           "IslandMultiRunEngine"]
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class GpJobSpec:
+    """Everything program-relevant about one GP serving bucket.
+
+    Two evaluation modes:
+
+    - **symbreg** (``evaluate=None``): negative-MSE fitness of each
+      genome on ``(X, y)`` through the mask-specialized traced
+      interpreter — the batched counterpart of
+      :func:`deap_tpu.gp.loop.make_symbreg_loop` (whose grouped+dedup
+      dispatch is bit-identical per row, pinned by
+      tests/test_gp_dispatch.py).
+    - **custom** (``evaluate`` given): ``evaluate(genomes) ->
+      f32[rows]`` over a flattened row batch, trace-safe, and
+      **row-independent** (each row's fitness must not depend on the
+      other rows — the property that makes full-width in-scan
+      evaluation bit-equal to the solo loop's touched-rows-only
+      dispatch). It must also be **bit-stable under jit**: the solo
+      loop calls it eagerly, the batch calls it inside a traced scan,
+      so an evaluator that re-specializes on concrete inputs (e.g. a
+      mask-specialized interpreter) breaks bit-identity — wrap those
+      as ``specialize="none"`` instead. Mask specialization is bypassed (the engine cannot
+      see inside a black-box evaluator), so no replay loop runs. This
+      is how ADF-flavoured or typed losses ride the batch.
+    """
+
+    pset: PrimitiveSet
+    max_len: int
+    X: Any = None
+    y: Any = None
+    tournsize: int = 3
+    height_limit: int = 17
+    mut_min: int = 0
+    mut_max: int = 2
+    mut_width: Optional[int] = None
+    evaluate: Optional[Callable] = None
+    name: str = "symbreg"
+
+    def __post_init__(self):
+        if self.evaluate is None and (self.X is None or self.y is None):
+            raise ValueError("GpJobSpec needs X= and y= (symbreg mode) "
+                             "or a custom evaluate=")
+
+    def static_key(self) -> Tuple:
+        """The shape/program-static tuple that joins the bucket key."""
+        return (self.name, int(self.max_len), int(self.tournsize),
+                int(self.height_limit), int(self.mut_min),
+                int(self.mut_max),
+                None if self.mut_width is None else int(self.mut_width),
+                self.pset.n_ops, self.pset.vocab,
+                self.evaluate is not None)
+
+    def fingerprint(self) -> str:
+        """Content digest over the primitive roster, the loop statics
+        and the dataset — the GP analogue of ``toolbox_fingerprint``
+        for :func:`deap_tpu.serving.tenant.bucket_key`."""
+        h = hashlib.sha1()
+        for p in self.pset.primitives:
+            h.update(f"{p.name}/{p.arity};".encode())
+        h.update(repr((self.pset.n_args, self.pset.n_consts,
+                       self.pset.has_erc, self.pset.vocab)
+                      + self.static_key()).encode())
+        if self.evaluate is not None:
+            h.update(repr(getattr(self.evaluate, "__qualname__",
+                                  repr(self.evaluate))).encode())
+        if self.X is not None:
+            h.update(np.ascontiguousarray(
+                np.asarray(self.X, np.float32)).tobytes())
+            h.update(np.ascontiguousarray(
+                np.asarray(self.y, np.float32)).tobytes())
+        return h.hexdigest()
+
+
+@dataclasses.dataclass(frozen=True)
+class IslandJobSpec:
+    """One island serving bucket's topology — everything that shapes
+    the epoch program besides the toolbox (which rides the Job)."""
+
+    n_islands: int
+    island_size: int
+    freq: int
+    mig_k: int
+
+    def static_key(self) -> Tuple:
+        return (int(self.n_islands), int(self.island_size),
+                int(self.freq), int(self.mig_k))
+
+
+def _bwhere(m, a, b):
+    """``jnp.where`` with a leading-axes mask broadcast against the
+    value ranks (pair/row masks vs genome leaves)."""
+    return jnp.where(m.reshape(m.shape + (1,) * (a.ndim - m.ndim)), a, b)
+
+
+class _RunAxisEngine(MultiRunEngine):
+    """Shared plumbing for the two fold_in-keyed engines: lanes carry
+    their raw BASE key data (the solo drivers re-derive per-step keys
+    as ``fold_in(key, gen)``, stateless in the generation index) rather
+    than the scan families' pre-split key horizon, so :meth:`pack`
+    ignores the bucket horizon and :meth:`unpack` never trims keys.
+    Inherits the segment-boundary decode helpers (``_lane_rows``,
+    ``lane_records``, ``lane_meter_rows``, ``concat_records``) and
+    :meth:`done` from :class:`MultiRunEngine` unchanged."""
+
+    #: filled by subclasses
+    hyper_names: Tuple[str, ...] = ("cxpb", "mutpb")
+
+    def _init_common(self, family: str, toolbox, telemetry) -> None:
+        self.family = family
+        self.toolbox = toolbox
+        self.mu = self.lambda_ = None
+        self.stats = None
+        self.tel = telemetry
+        self.probes = ()
+        self.halloffame_size = 0
+        self._key_impl: Optional[str] = None
+        if self.tel is not None and getattr(self.tel, "stream", False):
+            raise ValueError(
+                "multirun: telemetry stream=True is unsupported "
+                "(per-lane debug callbacks interleave); decode rows at "
+                "segment boundaries instead")
+
+    # ------------------------------------------------------- validation ----
+
+    def _check_hyper(self, hyper) -> Dict[str, jnp.ndarray]:
+        hyper = dict(hyper or {})
+        missing = [h for h in self.hyper_names if h not in hyper]
+        if missing:
+            raise ValueError(f"{self.family} lane needs hyper {missing}")
+        extra = [h for h in hyper if h not in self.hyper_names]
+        if extra:
+            raise ValueError(f"{self.family} takes no hyper {extra}")
+        return {h: jnp.float32(hyper[h]) for h in self.hyper_names}
+
+    def _check_key(self, key) -> None:
+        impl = _key_impl_name(key)
+        if self._key_impl is None:
+            self._key_impl = impl
+        elif impl != self._key_impl:
+            raise ValueError(f"lane key impl {impl!r} != bucket impl "
+                             f"{self._key_impl!r}")
+
+    # ------------------------------------------------------ pack/unpack ----
+
+    def pack(self, lanes: Sequence[Dict[str, Any]], n_lanes: int,
+             horizon: int) -> Dict[str, Any]:
+        """Stack lane states into ``n_lanes`` slots. ``horizon`` is
+        accepted for scheduler-protocol compatibility and ignored —
+        these lanes carry one base key each, not a per-generation key
+        array, so there is nothing to pad to a horizon."""
+        if not lanes:
+            raise ValueError("pack needs at least one lane")
+        if len(lanes) > n_lanes:
+            raise ValueError(f"{len(lanes)} lanes > {n_lanes} slots")
+        lanes = [self._on_pack_lane(lane) for lane in lanes]
+        dummy = {**lanes[0], "gen": jnp.int32(0), "ngen": jnp.int32(0)}
+        padded = list(lanes) + [dummy] * (n_lanes - len(lanes))
+        stacked = _tree_stack(padded)
+        batch = {"carry": stacked["carry"], "shadow": stacked["carry"],
+                 "gen": stacked["gen"], "ngen": stacked["ngen"],
+                 "keys": stacked["keys"], "hyper": stacked["hyper"],
+                 "record0": stacked["record0"],
+                 "mstate0": stacked["mstate0"], "n_real": len(lanes)}
+        return self._finish_batch(batch, n_lanes)
+
+    def _on_pack_lane(self, lane: Dict[str, Any]) -> Dict[str, Any]:
+        return lane
+
+    def _finish_batch(self, batch: Dict[str, Any],
+                      n_lanes: int) -> Dict[str, Any]:
+        return batch
+
+    def unpack(self, batch: Dict[str, Any], i: int) -> Dict[str, Any]:
+        """Lane ``i`` back out — carry read from the SHADOW (the frozen
+        completion state of a finished lane); the base key needs no
+        horizon trim."""
+        lane = {k: _tree_index(batch[k], i)
+                for k in ("gen", "ngen", "keys", "hyper", "record0",
+                          "mstate0")}
+        lane["carry"] = _tree_index(batch["shadow"], i)
+        return lane
+
+    def advance(self, batch: Dict[str, Any], k: int):
+        return self._advance(batch, k=int(k))
+
+
+# ------------------------------------------------------------------- GP ----
+
+
+class GpMultiRunEngine(_RunAxisEngine):
+    """N GP runs through one jitted scan, bit-identical per lane to the
+    solo host-dispatch loop (:func:`deap_tpu.gp.loop.make_gp_loop`).
+
+    Lifecycle mirrors :class:`MultiRunEngine`::
+
+        eng = GpMultiRunEngine(spec)            # spec: GpJobSpec
+        lanes = [eng.lane_init(key_r, genomes_r, ngen_r,
+                               {"cxpb": .5, "mutpb": .1}) for ...]
+        batch = eng.pack(lanes, n_lanes=8, horizon=64)
+        batch, seg = eng.advance(batch, k=10)
+        result = eng.lane_result(eng.unpack(batch, i),
+                                 eng.lane_records([seg], i))
+
+    ``lane_result`` returns the solo loop's finalize dict (genomes /
+    depths / fitness / best_genome / best_fitness / nevals /
+    stopped_at).
+
+    **Union-mask fixpoint** (symbreg mode): every lane's evaluation
+    runs under ONE opcode mask — the monotone union of every opcode the
+    engine has ever seen. Mutation donors can introduce any opcode mid
+    segment, so the segment accumulates a ``presence`` bitvector on
+    device (post-variation genomes of ACTIVE lanes only) and
+    :meth:`advance` re-runs the segment from the retained input batch
+    whenever presence escaped the mask. A trajectory accepted under a
+    covering mask never evaluated an out-of-mask opcode, hence is
+    bit-exact to the full-vocabulary program (which per row equals the
+    solo loop's grouped+dedup dispatch — tests/test_gp_dispatch.py);
+    mask growth is monotone, so lifetime replays are bounded by
+    ``n_ops``, journaled as ``gp_dispatch``/``gp_interpreter_build``
+    events carrying ``n_lanes`` and ``mask_popcount``.
+    """
+
+    def __init__(self, spec: GpJobSpec, *, telemetry=None, probes=(),
+                 stats=None, halloffame_size: int = 0):
+        if probes:
+            raise ValueError("GP batched lanes take no probes= (probe "
+                             "context needs a Population; GP lanes "
+                             "carry raw genome tensors)")
+        if stats is not None or halloffame_size:
+            raise ValueError("GP lanes carry their own best-individual "
+                             "tracking; stats=/halloffame_size= do not "
+                             "apply")
+        self._init_common("gp", None, telemetry)
+        self.spec = spec
+        self.gen_offset = 1
+        self._parts = make_gp_step_parts(
+            spec.pset, spec.max_len, tournsize=spec.tournsize,
+            height_limit=spec.height_limit, mut_min=spec.mut_min,
+            mut_max=spec.mut_max, mut_width=spec.mut_width)
+        self._track = spec.evaluate is None
+        self._n_ops = spec.pset.n_ops
+        self._mask: Tuple[int, ...] = ()
+        self._n: Optional[int] = None
+        self._n_lanes = 0
+        self._seg_cache: Dict[Any, Callable] = {}
+        self._fresh_cache: Dict[Any, Callable] = {}
+        self._journaled: Any = None
+        if self._track:
+            self._X = jnp.asarray(spec.X, jnp.float32)
+            self._y = jnp.asarray(spec.y, jnp.float32)
+        if self.tel is not None:
+            self.tel.begin_run("multirun/gp", None,
+                               declare=algos._tel_declare, serving=True)
+
+    # ---------------------------------------------------- mask plumbing ----
+
+    def _mask_key(self):
+        return self._mask if self._track else None
+
+    def _grow_mask(self, used: Sequence[int]) -> None:
+        if not self._track:
+            return
+        new = tuple(sorted(set(self._mask) | set(int(u) for u in used)))
+        if new != self._mask:
+            self._mask = new
+        self._journal_dispatch()
+
+    def _journal_dispatch(self) -> None:
+        """``gp_dispatch`` with the batching dimensions (satellite: the
+        mask-lattice rebuild budget stays auditable under a run axis).
+        Tag-deduplicated like the solo dispatcher's journal."""
+        if not self._track:
+            return
+        tag = (self._mask, self._n_lanes)
+        if self._journaled == tag:
+            return
+        self._journaled = tag
+        from deap_tpu.telemetry.journal import broadcast
+        broadcast("gp_dispatch", mode="batched",
+                  mask=[self.spec.pset.primitives[i].name
+                        for i in self._mask],
+                  mask_popcount=len(self._mask),
+                  n_lanes=self._n_lanes)
+
+    def _eval_rows_for(self, mask) -> Callable:
+        """``f(flat_genomes) -> f32[rows]`` under ``mask`` — the traced
+        evaluator every lane's rows flatten into (one population-level
+        ``max(length)`` bound, which must stay unbatched, is why the
+        eval sits OUTSIDE the lane vmap)."""
+        spec = self.spec
+        if spec.evaluate is not None:
+            return spec.evaluate
+        interp = _cached_factory(
+            spec.pset, ("gpserve", spec.max_len, mask),
+            lambda: _traced_batch(spec.pset, spec.max_len, "scan", mask),
+            extra={"n_lanes": self._n_lanes,
+                   "mask_popcount": len(mask)})
+        X, y = self._X, self._y
+
+        def eval_rows(genomes):
+            preds = interp(genomes, X)
+            return -jnp.mean((preds - y[None, :]) ** 2, axis=1)
+
+        return eval_rows
+
+    # -------------------------------------------------------- admission ----
+
+    def _learn_n(self, genomes) -> int:
+        n = int(np.asarray(genomes["length"]).shape[-1])
+        if self._n is None:
+            self._n = n
+        elif n != self._n:
+            raise ValueError(f"lane population size {n} != bucket "
+                             f"size {self._n}")
+        return n
+
+    def _fresh_fn(self, mask) -> Callable:
+        """Jitted vectorized gen-0: founder depths + fitness + best for
+        a whole ``[R, n, ...]`` admission batch in one program."""
+        fn = self._fresh_cache.get(mask)
+        if fn is not None:
+            return fn
+        parts, tel = self._parts, self.tel
+        eval_rows = self._eval_rows_for(mask)
+
+        def fresh(genomes):
+            R, n = genomes["length"].shape
+            depths = jax.vmap(jax.vmap(parts.depths))(genomes)
+            flat = jax.tree_util.tree_map(
+                lambda a: a.reshape((R * n,) + a.shape[2:]), genomes)
+            fit = eval_rows(flat).reshape(R, n)
+
+            def one(g_r, d_r, f_r):
+                bi = jnp.argmax(f_r)
+                out = {"genomes": g_r, "depths": d_r, "fit": f_r,
+                       "best_genome": jax.tree_util.tree_map(
+                           lambda a: a[bi], g_r),
+                       "best_fit": f_r[bi]}
+                if tel is not None:
+                    m = tel.meter
+                    ms = m.inc(m.init(), "nevals", n)
+                    ms = m.set(ms, "best", jnp.max(f_r))
+                    ms = m.set(ms, "mean", jnp.mean(f_r))
+                    ms = m.set(ms, "evaluated_frac", 1.0)
+                    out["mstate"] = ms
+                return out
+
+            return jax.vmap(one)(genomes, depths, fit)
+
+        fn = jax.jit(fresh)
+        self._fresh_cache[mask] = fn
+        return fn
+
+    def lane_init(self, key, init, ngen: int,
+                  hyper: Optional[Dict[str, float]] = None
+                  ) -> Dict[str, Any]:
+        """One lane from a solo job spec: ``init`` is the founder
+        genome batch ``{"nodes": [n, ML], "consts": [n, ML],
+        "length": [n]}``. Runs the solo loop's gen-0 protocol (founder
+        evaluation, best seeding) and returns the checkpointable lane
+        dict — the scheduler's swap unit."""
+        ngen = int(ngen)
+        if ngen < 1:
+            raise ValueError("ngen must be >= 1")
+        hyper_arr = self._check_hyper(hyper)
+        self._check_key(key)
+        n = self._learn_n(init)
+        if self._track:
+            self._grow_mask(_used_ops(self._n_ops,
+                                      np.asarray(init["nodes"]),
+                                      np.asarray(init["length"])))
+        c = self._fresh_fn(self._mask_key())(
+            jax.tree_util.tree_map(lambda a: jnp.asarray(a)[None], init))
+        carry = _tree_index(c, 0)
+        lane: Dict[str, Any] = {
+            "gen": jnp.int32(0), "ngen": jnp.int32(ngen),
+            "keys": jax.random.key_data(key), "hyper": hyper_arr,
+            "record0": {"nevals": jnp.int32(n)},
+            "mstate0": carry.get("mstate"),
+        }
+        lane["carry"] = carry
+        return lane
+
+    def pack_fresh(self, keys, inits, ngen: int,
+                   hyper: Optional[Dict[str, Any]] = None,
+                   *, n_lanes: Optional[int] = None,
+                   horizon: Optional[int] = None) -> Dict[str, Any]:
+        """Vectorized admission: the gen-0 protocol of a whole batch of
+        FRESH same-``ngen`` jobs as ONE jitted program (founder depths,
+        flattened founder evaluation, per-lane best) — O(1) host round
+        trips however many tenants arrive. Bit-identical per lane to
+        the lane-at-a-time path."""
+        ngen = int(ngen)
+        if ngen < 1:
+            raise ValueError("ngen must be >= 1")
+        if isinstance(keys, (list, tuple)):
+            keys = jnp.stack(keys)
+        R = int(keys.shape[0])
+        n_lanes = R if n_lanes is None else int(n_lanes)
+        if R > n_lanes:
+            raise ValueError("batch exceeds n_lanes")
+        self._check_key(keys)
+        if isinstance(inits, (list, tuple)):
+            inits = _tree_stack(inits)
+        self._learn_n(_tree_index(inits, 0))
+        self._n_lanes = max(self._n_lanes, n_lanes)
+        if self._track:
+            self._grow_mask(_used_ops(
+                self._n_ops,
+                np.asarray(inits["nodes"]).reshape(
+                    -1, inits["nodes"].shape[-1]),
+                np.asarray(inits["length"]).reshape(-1)))
+        carry = self._fresh_fn(self._mask_key())(inits)
+        hyper = dict(hyper or {})
+        missing = [h for h in self.hyper_names if h not in hyper]
+        if missing:
+            raise ValueError(f"{self.family} needs hyper {missing}")
+        hyper_arr = {
+            h: jnp.broadcast_to(jnp.asarray(hyper[h], jnp.float32), (R,))
+            for h in self.hyper_names}
+        batch = {"carry": carry, "shadow": carry,
+                 "gen": jnp.zeros(R, jnp.int32),
+                 "ngen": jnp.full(R, ngen, jnp.int32),
+                 "keys": jax.vmap(jax.random.key_data)(keys),
+                 "hyper": hyper_arr,
+                 "record0": {"nevals": jnp.full(R, self._n, jnp.int32)},
+                 "mstate0": carry.get("mstate"), "n_real": R}
+        if n_lanes > R:
+            grow = lambda a: jnp.concatenate(
+                [a, jnp.broadcast_to(a[:1],
+                                     (n_lanes - R,) + a.shape[1:])])
+            for k in ("carry", "shadow", "gen", "keys", "hyper",
+                      "record0", "mstate0"):
+                batch[k] = jax.tree_util.tree_map(grow, batch[k])
+            batch["ngen"] = jnp.concatenate(
+                [batch["ngen"], jnp.zeros(n_lanes - R, jnp.int32)])
+        return self._finish_batch(batch, n_lanes)
+
+    def _on_pack_lane(self, lane: Dict[str, Any]) -> Dict[str, Any]:
+        # a checkpoint-restored lane may carry opcodes this (fresh)
+        # engine's mask has never seen — grow from the concrete carry
+        # before the batch compiles, exactly once per repack
+        if self._track:
+            g = lane["carry"]["genomes"]
+            self._grow_mask(_used_ops(self._n_ops, np.asarray(g["nodes"]),
+                                      np.asarray(g["length"])))
+            self._learn_n(g)
+        return lane
+
+    def _finish_batch(self, batch: Dict[str, Any],
+                      n_lanes: int) -> Dict[str, Any]:
+        self._n_lanes = max(self._n_lanes, n_lanes)
+        presence = np.zeros(self._n_ops + 1, bool)
+        if self._track and self._mask:
+            presence[list(self._mask)] = True
+        batch["presence"] = jnp.asarray(presence)
+        self._journal_dispatch()
+        return batch
+
+    # ---------------------------------------------------------- segment ----
+
+    def _segment_for(self, mask) -> Callable:
+        fn = self._seg_cache.get(mask)
+        if fn is not None:
+            return fn
+        from deap_tpu.telemetry import costs
+        fn = costs.instrument(
+            jax.jit(self._build_segment(mask), static_argnames=("k",)),
+            label="serving/gp/advance", static_argnames=("k",))
+        self._seg_cache[mask] = fn
+        return fn
+
+    def _build_segment(self, mask) -> Callable:
+        parts, tel = self._parts, self.tel
+        n_ops, track = self._n_ops, self._track
+        eval_rows = self._eval_rows_for(mask)
+        impl = self._key_impl
+
+        def lane_pre(kd, gen_r, hyper_r, lc):
+            """Select + full-width vary for one lane — the solo loop's
+            exact key schedule (advance(): gen+1, fold_in, select,
+            draw, pair-id cx keys, row-id mut keys on post-cx rows)."""
+            key = jax.random.wrap_key_data(kd, impl=impl)
+            k = jax.random.fold_in(key, gen_r + 1)
+            k_sel, k_var = jax.random.split(k)
+            idx = parts.select_idx(k_sel, lc["fit"])
+            genomes = jax.tree_util.tree_map(lambda a: a[idx],
+                                             lc["genomes"])
+            depths = lc["depths"][idx]
+            fit = lc["fit"][idx]
+            n = fit.shape[0]
+            k_draw, k_cx, k_mut = jax.random.split(k_var, 3)
+            k_pair, k_ind = jax.random.split(k_draw)
+            do_cx = jax.random.bernoulli(k_pair, hyper_r["cxpb"],
+                                         (n // 2,))
+            do_mut = jax.random.bernoulli(k_ind, hyper_r["mutpb"], (n,))
+            touched = do_mut
+            if n // 2:
+                pp = jnp.arange(n // 2)
+                rows_e, rows_o = pp * 2, pp * 2 + 1
+                ck = jax.vmap(lambda i: jax.random.fold_in(k_cx, i))(pp)
+                g_e = jax.tree_util.tree_map(lambda a: a[rows_e], genomes)
+                g_o = jax.tree_util.tree_map(lambda a: a[rows_o], genomes)
+                c1, dd1, c2, dd2 = jax.vmap(parts.pair_cx)(
+                    ck, g_e, depths[rows_e], g_o, depths[rows_o])
+                # non-drawn pairs where-revert to their parents: the
+                # drawn rows' offspring are byte-identical to the solo
+                # compacted dispatch (same fold_in(k_cx, pair) keys)
+                c1 = jax.tree_util.tree_map(
+                    lambda a, b: _bwhere(do_cx, a, b), c1, g_e)
+                c2 = jax.tree_util.tree_map(
+                    lambda a, b: _bwhere(do_cx, a, b), c2, g_o)
+                dd1 = _bwhere(do_cx, dd1, depths[rows_e])
+                dd2 = _bwhere(do_cx, dd2, depths[rows_o])
+                genomes = jax.tree_util.tree_map(
+                    lambda a, s1, s2: a.at[rows_e].set(s1)
+                                       .at[rows_o].set(s2),
+                    genomes, c1, c2)
+                depths = depths.at[rows_e].set(dd1).at[rows_o].set(dd2)
+                touched = touched | jnp.zeros(n, bool) \
+                    .at[: 2 * (n // 2)].set(jnp.repeat(do_cx, 2))
+            mk = jax.vmap(lambda i: jax.random.fold_in(k_mut, i))(
+                jnp.arange(n))
+            m_g, m_d = jax.vmap(parts.one_mut)(mk, genomes, depths)
+            genomes = jax.tree_util.tree_map(
+                lambda a, s: _bwhere(do_mut, s, a), genomes, m_g)
+            depths = _bwhere(do_mut, m_d, depths)
+            return genomes, depths, fit, touched
+
+        def lane_post(lc, genomes_r, depths_r, fit_r, ne_r):
+            n = fit_r.shape[0]
+            bi = jnp.argmax(fit_r)
+            better = fit_r[bi] > lc["best_fit"]
+            out = {"genomes": genomes_r, "depths": depths_r,
+                   "fit": fit_r,
+                   "best_genome": jax.tree_util.tree_map(
+                       lambda a, b: jnp.where(better, a[bi], b),
+                       genomes_r, lc["best_genome"]),
+                   "best_fit": jnp.where(better, fit_r[bi],
+                                         lc["best_fit"])}
+            if tel is not None:
+                m = tel.meter
+                ms = m.inc(lc["mstate"], "nevals", ne_r)
+                ms = m.set(ms, "best", jnp.max(fit_r))
+                ms = m.set(ms, "mean", jnp.mean(fit_r))
+                ms = m.set(ms, "evaluated_frac", ne_r / n)
+                out["mstate"] = ms
+            return out
+
+        def segment(batch, k: int):
+            keys, ngen, hyper = (batch["keys"], batch["ngen"],
+                                 batch["hyper"])
+
+            def body(carry_t, _):
+                lane_carry, shadow, gen, presence = carry_t
+                active = gen < ngen
+                genomes, depths, fit_sel, touched = jax.vmap(lane_pre)(
+                    keys, gen, hyper, lane_carry)
+                R, n = touched.shape
+                flat = jax.tree_util.tree_map(
+                    lambda a: a.reshape((R * n,) + a.shape[2:]), genomes)
+                # ONE flattened eval for every lane's full population —
+                # max(length) is a population reduction and must stay
+                # unbatched; untouched rows where-revert below, so the
+                # redundant flops never reach a result (the waste
+                # model: full-width eval buys zero per-gen host syncs)
+                w = eval_rows(flat).reshape(R, n)
+                fit = jnp.where(touched, w, fit_sel)
+                ne = jnp.sum(touched, axis=1).astype(jnp.int32)
+                lane_carry = jax.vmap(lane_post)(
+                    lane_carry, genomes, depths, fit, ne)
+                if track:
+                    live = (jnp.arange(flat["nodes"].shape[1])[None, :]
+                            < flat["length"][:, None]) \
+                        & (flat["nodes"] < n_ops) \
+                        & jnp.repeat(active, n)[:, None]
+                    ids = jnp.where(live, flat["nodes"], n_ops)
+                    presence = presence.at[ids.ravel()].max(
+                        jnp.ones(ids.size, bool))
+                shadow = jax.vmap(_tree_where)(active, lane_carry,
+                                               shadow)
+                ys = (({"nevals": ne}, lane_carry["mstate"])
+                      if tel is not None else {"nevals": ne})
+                return ((lane_carry, shadow,
+                         gen + active.astype(gen.dtype), presence),
+                        (ys, active))
+
+            (lane_carry, shadow, gen, presence), (ys, active) = lax.scan(
+                body, (batch["carry"], batch["shadow"], batch["gen"],
+                       batch["presence"]), None, length=k)
+            return ({**batch, "carry": lane_carry, "shadow": shadow,
+                     "gen": gen, "presence": presence},
+                    {"ys": ys, "active": active})
+
+        return segment
+
+    def advance(self, batch: Dict[str, Any], k: int):
+        """One segment of ``k`` generations — with the union-mask
+        fixpoint replay: run under the current mask from the RETAINED
+        input batch, host-read the presence bitvector, and replay under
+        the grown mask whenever a mutation donor escaped it. Each
+        rejection strictly grows the (monotone) mask, so the loop — and
+        the engine's lifetime replay count — is bounded by ``n_ops``."""
+        k = int(k)
+        if not self._track:
+            return self._segment_for(None)(batch, k=k)
+        for _ in range(self._n_ops + 1):
+            out, seg = self._segment_for(self._mask)(batch, k=k)
+            used = np.nonzero(
+                np.asarray(out["presence"])[: self._n_ops])[0]
+            if set(int(u) for u in used) <= set(self._mask):
+                return out, seg
+            self._grow_mask(used)
+        raise AssertionError(
+            "union-mask fixpoint failed to converge (mask grows "
+            "strictly per replay and is bounded by n_ops)")
+
+    # ------------------------------------------------------------ decode ----
+
+    def lane_result(self, lane: Dict[str, Any], records: Any):
+        """The solo loop's finalize dict, assembled from the lane carry
+        and the accumulated per-generation ``nevals`` rows — the same
+        keys :func:`deap_tpu.gp.loop.make_gp_loop`'s ``run`` returns."""
+        carry = lane["carry"]
+        nevals = [int(np.asarray(lane["record0"]["nevals"]))]
+        if records is not None:
+            nevals += [int(x) for x in np.asarray(records["nevals"])]
+        return {"genomes": carry["genomes"], "depths": carry["depths"],
+                "fitness": carry["fit"],
+                "best_genome": carry["best_genome"],
+                "best_fitness": float(np.asarray(carry["best_fit"])),
+                "nevals": nevals, "stopped_at": None}
+
+
+# -------------------------------------------------------------- islands ----
+
+
+class IslandMultiRunEngine(_RunAxisEngine):
+    """N island runs through one jitted scan — each lane IS the solo
+    :func:`~deap_tpu.parallel.make_island_step` epoch program (built
+    inside the lane trace so per-lane cxpb/mutpb enter as vmap-lane
+    tracers), keyed ``fold_in(base_key, epoch)`` exactly like the solo
+    epoch driver (``resilience._IslandSpec.segment``). The stacked-deme
+    tensor gains a leading run axis; migration stays the deme-axis ring
+    roll inside the one global program. ``lane_result`` returns the
+    final stacked :class:`Population`, bit-identical to driving the
+    solo step epoch by epoch."""
+
+    def __init__(self, toolbox, spec: IslandJobSpec, *, telemetry=None,
+                 probes=()):
+        if probes:
+            raise ValueError("island batched lanes take no probes= "
+                             "(per-lane probe rows are the Meter "
+                             "built-ins)")
+        self._init_common("island", toolbox, telemetry)
+        self.spec = spec
+        self.gen_offset = 0  # epoch rows are 0-indexed, no gen-0 row
+        if self.tel is not None:
+            self.tel.begin_run("multirun/island", toolbox, serving=True)
+            # land the meter declarations (idempotent on re-declare)
+            # before any meter.init(): jit is lazy, nothing compiles
+            make_island_step(toolbox, 0.5, 0.2, spec.freq, spec.mig_k,
+                             telemetry=self.tel)
+        from deap_tpu.telemetry import costs
+        self._advance = costs.instrument(
+            jax.jit(self._segment, static_argnames=("k",)),
+            label="serving/island/advance", static_argnames=("k",))
+
+    def lane_init(self, key, init, ngen: int,
+                  hyper: Optional[Dict[str, float]] = None
+                  ) -> Dict[str, Any]:
+        """``init`` is the stacked island :class:`Population`
+        (``[n_islands, island_size, ...]`` leaves, e.g. from
+        :func:`~deap_tpu.parallel.island_init`); ``ngen`` counts
+        epochs."""
+        ngen = int(ngen)
+        if ngen < 1:
+            raise ValueError("ngen must be >= 1")
+        hyper_arr = self._check_hyper(hyper)
+        self._check_key(key)
+        if not isinstance(init, Population):
+            raise TypeError("island lane init must be a stacked "
+                            f"Population, got {type(init).__name__}")
+        shape = tuple(init.valid.shape[:2])
+        want = (self.spec.n_islands, self.spec.island_size)
+        if shape != want:
+            raise ValueError(f"island lane shape {shape} != bucket "
+                             f"topology {want}")
+        carry: Dict[str, Any] = {"pops": init}
+        if self.tel is not None:
+            carry["mstate"] = self.tel.meter.init()
+        return {"gen": jnp.int32(0), "ngen": jnp.int32(ngen),
+                "keys": jax.random.key_data(key), "hyper": hyper_arr,
+                "record0": None, "mstate0": None, "carry": carry}
+
+    def pack_fresh(self, keys, inits, ngen: int,
+                   hyper: Optional[Dict[str, Any]] = None,
+                   *, n_lanes: Optional[int] = None,
+                   horizon: Optional[int] = None) -> Dict[str, Any]:
+        """Vectorized admission for same-``ngen`` island jobs: island
+        gen-0 has no protocol to run (founders are evaluated inside
+        the first epoch's first generation), so this is a pure stack —
+        still one host dispatch for the whole batch."""
+        if isinstance(keys, (list, tuple)):
+            keys = list(keys)
+        else:
+            keys = [keys[i] for i in range(int(keys.shape[0]))]
+        if isinstance(inits, (list, tuple)):
+            inits = list(inits)
+        else:
+            inits = [_tree_index(inits, i) for i in range(len(keys))]
+        lanes = [self.lane_init(k, p, ngen, hyper)
+                 for k, p in zip(keys, inits)]
+        return self.pack(lanes, n_lanes=n_lanes or len(lanes),
+                         horizon=horizon or int(ngen))
+
+    def _segment(self, batch: Dict[str, Any], k: int):
+        keys, ngen, hyper = batch["keys"], batch["ngen"], batch["hyper"]
+        spec, tb, tel = self.spec, self.toolbox, self.tel
+        impl = self._key_impl
+
+        def lane_step(kd, gen_r, hyper_r, lc):
+            key = jax.random.wrap_key_data(kd, impl=impl)
+            kk = jax.random.fold_in(key, gen_r)
+            # the solo step factory, instantiated under the lane trace
+            # so this lane's traced hyper close over it — meter
+            # declarations are idempotent, jit-under-trace inlines
+            step = make_island_step(tb, hyper_r["cxpb"],
+                                    hyper_r["mutpb"], spec.freq,
+                                    spec.mig_k, telemetry=tel)
+            if tel is None:
+                return {"pops": step(kk, lc["pops"])}
+            pops, ms = step(kk, lc["pops"], lc["mstate"])
+            return {"pops": pops, "mstate": ms}
+
+        def body(carry_t, _):
+            lane_carry, shadow, gen = carry_t
+            active = gen < ngen
+            lane_carry = jax.vmap(lane_step)(keys, gen, hyper,
+                                             lane_carry)
+            shadow = jax.vmap(_tree_where)(active, lane_carry, shadow)
+            ys = (({}, lane_carry["mstate"]) if tel is not None else {})
+            return ((lane_carry, shadow,
+                     gen + active.astype(gen.dtype)), (ys, active))
+
+        (lane_carry, shadow, gen), (ys, active) = lax.scan(
+            body, (batch["carry"], batch["shadow"], batch["gen"]),
+            None, length=k)
+        return ({**batch, "carry": lane_carry, "shadow": shadow,
+                 "gen": gen}, {"ys": ys, "active": active})
+
+    def lane_result(self, lane: Dict[str, Any], records: Any):
+        """The final stacked island :class:`Population` — what the solo
+        epoch driver holds after its last epoch."""
+        return lane["carry"]["pops"]

@@ -61,9 +61,10 @@ from deap_tpu.support.checkpoint import _key_impl_name
 
 __all__ = ["MultiRunEngine", "FAMILIES", "multirun"]
 
-#: the loop families the run axis covers (the GP host-dispatch loop and
-#: the island epoch driver stay host-driven — their run axis is future
-#: work, tracked on the ROADMAP)
+#: the scan-loop families THIS engine's run axis covers; the GP
+#: host-dispatch loop and the island epoch driver ride the same
+#: lane/batch/segment protocol through
+#: :mod:`deap_tpu.serving.gp_multirun` ("gp" / "island" families)
 FAMILIES = ("ea_simple", "ea_mu_plus_lambda", "ea_mu_comma_lambda",
             "ea_generate_update")
 

@@ -129,6 +129,13 @@ class _ServingInstruments:
             "deap_serving_tenant_gens_per_sec",
             "per-tenant generations/second over the last segment",
             labels=("tenant_id",))
+        # family-labelled residency: GP / island / scan-family lanes
+        # are distinguishable on /metrics without touching the label
+        # tuples of the instruments above (create-or-get pins them)
+        self.family_residents = registry.gauge(
+            "deap_serving_family_residents",
+            "resident tenants per bucket, labelled by engine family",
+            labels=("bucket", "family"))
 
 
 class Scheduler:
@@ -264,6 +271,10 @@ class Scheduler:
         if job.family in ("ea_mu_plus_lambda", "ea_mu_comma_lambda") \
                 and (job.mu is None or job.lambda_ is None):
             raise ValueError(f"{job.family} job needs mu/lambda_")
+        if job.family == "gp" and job.spec is None:
+            raise ValueError("gp job needs spec= (a GpJobSpec)")
+        if job.family == "island" and job.spec is None:
+            raise ValueError("island job needs spec= (an IslandJobSpec)")
         bkey = bucket_key(job)
         bucket = self.buckets.get(bkey)
         if bucket is None:
@@ -294,6 +305,18 @@ class Scheduler:
         if self.telemetry:
             tel = RunTelemetry(self.journal, meter=Meter(),
                                spans=False, init_backend=False)
+        if job.family == "gp":
+            from deap_tpu.serving.gp_multirun import GpMultiRunEngine
+            return GpMultiRunEngine(
+                job.spec, telemetry=tel, probes=job.probes,
+                stats=job.stats,
+                halloffame_size=job.halloffame_size)
+        if job.family == "island":
+            from deap_tpu.serving.gp_multirun import \
+                IslandMultiRunEngine
+            return IslandMultiRunEngine(
+                job.toolbox, job.spec, telemetry=tel,
+                probes=job.probes)
         kwargs: Dict[str, Any] = {}
         if job.family == "ea_generate_update":
             kwargs.update(spec=job.spec, state_template=job.init)
@@ -524,6 +547,9 @@ class Scheduler:
             self._minst.occupancy.set(
                 len(bucket.residents) / bucket.max_lanes,
                 bucket=bucket.label)
+            self._minst.family_residents.set(
+                len(bucket.residents), bucket=bucket.label,
+                family=eng.family)
 
         if changed and bucket.residents:
             lanes = []
@@ -630,6 +656,9 @@ class Scheduler:
             self._minst.queue_depth.set(len(bucket.queue),
                                         bucket=bucket.label)
             self._minst.occupancy.set(occupancy, bucket=bucket.label)
+            self._minst.family_residents.set(
+                len(bucket.residents), bucket=bucket.label,
+                family=eng.family)
         if self.boundary_cb is not None:
             self.boundary_cb(bucket.label, updates)
 
@@ -685,6 +714,7 @@ class Scheduler:
                     wait_p99 = self._minst.queue_wait_s.quantile(
                         0.99, bucket=b.label)
                 snap[b.label] = {
+                    "family": b.engine.family,
                     "queue_depth": len(b.queue),
                     "residents": len(b.residents),
                     "lanes": b.max_lanes,

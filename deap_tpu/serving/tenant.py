@@ -101,7 +101,20 @@ def _shape_sig(tree: Any) -> Tuple:
 
 def bucket_key(job: Job) -> Tuple:
     """The hashable bucket a job is admitted into: jobs with equal keys
-    run through one compiled multi-run program."""
+    run through one compiled multi-run program. GP jobs carry no
+    toolbox — their program identity is the ``GpJobSpec`` fingerprint
+    (primitive roster + loop statics + dataset), and the spec's static
+    tuple joins the shape signature so two psets with equal vocab but
+    different rosters never share a mask-specialized program. Island
+    jobs append their topology (n_islands/island_size/freq/mig_k) —
+    the coordinates that shape the stacked-deme program."""
+    if job.family == "gp":
+        program = job.program
+        if program is None:
+            program = job.spec.fingerprint()
+        shapes = (("gp",) + job.spec.static_key(), _shape_sig(job.init))
+        return (job.family, program, shapes, job.mu, job.lambda_,
+                (), (), int(job.halloffame_size))
     program = job.program
     if program is None:
         program = toolbox_fingerprint(job.toolbox)["digest"]
@@ -112,8 +125,11 @@ def bucket_key(job: Job) -> Tuple:
                   _shape_sig(job.init.extras))
     else:
         weights = (tuple(job.spec.weights)
-                   if job.spec is not None else None)
+                   if job.spec is not None and job.family != "island"
+                   else None)
         shapes = (("state", weights), _shape_sig(job.init))
+    if job.family == "island":
+        shapes = shapes + (("island",) + job.spec.static_key(),)
     stats_fields = (tuple(job.stats.fields)
                     if job.stats is not None else ())
     probe_types = tuple(type(p).__name__ for p in job.probes)

@@ -106,14 +106,19 @@ def _tenant_sections(events: List[Dict[str, Any]], out: List[str]
     """Multi-tenant serving journals: group meter/alarm/lifecycle rows
     by ``tenant_id`` and render one per-tenant block (metric
     sparklines + that tenant's alarm timeline), plus the scheduler's
-    admission/eviction ledger. Returns True when the journal was
-    multi-tenant (the caller then skips the single-run sections that
-    would interleave tenants)."""
+    admission/eviction ledger. Tenant blocks are grouped by loop
+    **family** (from the ``job_submitted`` rows) so GP / island /
+    scan-family lanes read as separate cohorts. Returns True when the
+    journal was multi-tenant (the caller then skips the single-run
+    sections that would interleave tenants)."""
     tenants: Dict[str, List[Dict[str, Any]]] = {}
+    families: Dict[str, str] = {}
     for e in events:
         tid = e.get("tenant_id")
         if tid is not None:
             tenants.setdefault(str(tid), []).append(e)
+            if e.get("kind") == "job_submitted" and "family" in e:
+                families[str(tid)] = str(e["family"])
     if not tenants:
         return False
 
@@ -129,38 +134,50 @@ def _tenant_sections(events: List[Dict[str, Any]], out: List[str]
 
     out.append("")
     out.append(f"## Tenants ({len(tenants)})")
+    by_family: Dict[str, List[str]] = {}
     for tid in sorted(tenants):
-        rows = tenants[tid]
-        out.append("")
-        out.append(f"### tenant {tid}")
-        life = {k: sum(1 for e in rows if e.get("kind") == k)
-                for k in ("tenant_admitted", "tenant_evicted",
-                          "tenant_resumed", "tenant_finished")}
-        fin = next((e for e in rows
-                    if e.get("kind") == "tenant_finished"), None)
-        bits = [f"evicted×{life['tenant_evicted']}"
-                if life["tenant_evicted"] else None,
-                f"resumed×{life['tenant_resumed']}"
-                if life["tenant_resumed"] else None]
-        status = (f"{fin.get('status', 'finished')} at gen "
-                  f"{fin.get('gen')}" if fin else "in flight")
-        out.append("- " + ", ".join([status] + [b for b in bits if b]))
-        series = _meter_series(rows)
-        if series:
-            width = max(len(k) for k in series)
-            for name in sorted(series):
-                vals = [v for _, v in series[name]]
-                out.append(f"{name.ljust(width)}  {sparkline(vals)}  "
-                           f"min={_fmt(min(vals))} "
-                           f"max={_fmt(max(vals))} "
-                           f"last={_fmt(vals[-1])}")
-        alarms = [e for e in rows if e.get("kind") == "alarm"]
-        for a in alarms:
-            detail = ", ".join(
-                f"{k}={_fmt(v)}" for k, v in a.items()
-                if k not in ("kind", "t", "alarm", "gen", "tenant_id"))
-            out.append(f"- gen {a.get('gen')} ▲ **{a.get('alarm')}**"
-                       + (f" ({detail})" if detail else ""))
+        by_family.setdefault(families.get(tid, "?"), []).append(tid)
+    for family in sorted(by_family):
+        if len(by_family) > 1 or family != "?":
+            out.append("")
+            out.append(f"### family {family} "
+                       f"({len(by_family[family])} tenant(s))")
+        for tid in by_family[family]:
+            rows = tenants[tid]
+            out.append("")
+            out.append(f"#### tenant {tid}")
+            life = {k: sum(1 for e in rows if e.get("kind") == k)
+                    for k in ("tenant_admitted", "tenant_evicted",
+                              "tenant_resumed", "tenant_finished")}
+            fin = next((e for e in rows
+                        if e.get("kind") == "tenant_finished"), None)
+            bits = [f"evicted×{life['tenant_evicted']}"
+                    if life["tenant_evicted"] else None,
+                    f"resumed×{life['tenant_resumed']}"
+                    if life["tenant_resumed"] else None]
+            status = (f"{fin.get('status', 'finished')} at gen "
+                      f"{fin.get('gen')}" if fin else "in flight")
+            out.append("- " + ", ".join(
+                [status] + [b for b in bits if b]))
+            series = _meter_series(rows)
+            if series:
+                width = max(len(k) for k in series)
+                for name in sorted(series):
+                    vals = [v for _, v in series[name]]
+                    out.append(
+                        f"{name.ljust(width)}  {sparkline(vals)}  "
+                        f"min={_fmt(min(vals))} "
+                        f"max={_fmt(max(vals))} "
+                        f"last={_fmt(vals[-1])}")
+            alarms = [e for e in rows if e.get("kind") == "alarm"]
+            for a in alarms:
+                detail = ", ".join(
+                    f"{k}={_fmt(v)}" for k, v in a.items()
+                    if k not in ("kind", "t", "alarm", "gen",
+                                 "tenant_id"))
+                out.append(
+                    f"- gen {a.get('gen')} ▲ **{a.get('alarm')}**"
+                    + (f" ({detail})" if detail else ""))
     return True
 
 

@@ -215,6 +215,50 @@ def test_mask_lattice_bounds_rebuilds(tmp_path):
     assert len(builds) <= 3, builds
     assert dispatches and set(dispatches[-1]["mask"]) >= {"add", "sub",
                                                           "mul"}
+    # the batching dimensions ride every dispatch row: the solo
+    # dispatcher is the n_lanes=1 point of the same budget ledger
+    assert all(d["n_lanes"] == 1 for d in dispatches), dispatches
+    assert all(d["mask_popcount"] == len(d["mask"]) for d in dispatches)
+
+
+def test_batched_engine_journals_lane_dims(tmp_path):
+    """The run-axis GP engine journals its union-mask rebuilds under
+    the same ``gp_dispatch``/``gp_interpreter_build`` kinds, stamped
+    with its lane count and union-mask popcount — the mask-lattice
+    rebuild budget stays auditable under batching."""
+    from deap_tpu.serving.gp_multirun import GpJobSpec, GpMultiRunEngine
+    from deap_tpu.telemetry.journal import RunJournal, read_journal
+
+    ps = gp.math_set(n_args=1)
+    ps.arity_table()
+    X = np.linspace(-1.0, 1.0, 7, dtype=np.float32)[:, None]
+    y = (X[:, 0] ** 2).astype(np.float32)
+    gen = gp.gen_half_and_half(ps, 24, 1, 2)
+
+    def founders(seed):
+        return jax.vmap(gen)(jax.random.split(jax.random.key(seed), 8))
+
+    path = tmp_path / "j.jsonl"
+    with RunJournal(str(path)) as journal:
+        journal.header(init_backend=False)
+        eng = GpMultiRunEngine(GpJobSpec(pset=ps, max_len=24, X=X, y=y))
+        batch = eng.pack_fresh(
+            jnp.stack([jax.random.key(0), jax.random.key(1)]),
+            [founders(0), founders(1)], 3,
+            {"cxpb": 0.5, "mutpb": 0.2}, n_lanes=2)
+        eng.advance(batch, 3)
+    events = read_journal(str(path))
+    disp = [e for e in events if e.get("kind") == "gp_dispatch"]
+    builds = [e for e in events
+              if e.get("kind") == "gp_interpreter_build"]
+    assert disp and all(d["mode"] == "batched" for d in disp)
+    assert all(d["n_lanes"] == 2 for d in disp), disp
+    assert all(d["mask_popcount"] == len(d["mask"]) for d in disp)
+    # every evaluator (re)build inside the engine carries the lane
+    # count; monotone mask union bounds them by n_ops
+    assert builds and all("n_lanes" in b and "mask_popcount" in b
+                          for b in builds)
+    assert len(builds) <= ps.n_ops
 
 
 def test_grouped_schedule_chunks_pure(pset):

@@ -1,0 +1,375 @@
+"""Batched GP generations through the serving plane — bit-identity.
+
+The acceptance bar of ``deap_tpu/serving/gp_multirun.py``: N GP runs
+packed on a leading run axis (one jitted scan, union-mask specialized
+evaluation, per-lane fold_in key schedules) must be **bit-identical**
+per lane to the solo host-dispatch loop (``gp/loop.py``), across the
+matrix the tentpole names — mixed ngen × ERC-heavy × typed-flavoured
+(bool vocabulary) × ADF lanes — plus the island run-axis engine vs the
+solo epoch driver, the Scheduler end-to-end (eviction/resume included)
+and the ResilientRun segmented driver with a mid-run resume.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deap_tpu import gp, ops
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.toolbox import Toolbox
+from deap_tpu.gp.loop import make_gp_loop, make_symbreg_loop
+from deap_tpu.gp.pset import bool_set, math_set
+from deap_tpu.gp.tree import make_generator
+from deap_tpu.parallel.island import island_init, make_island_step
+from deap_tpu.resilience.engine import ResilientRun
+from deap_tpu.serving import (
+    GpJobSpec,
+    GpMultiRunEngine,
+    IslandJobSpec,
+    IslandMultiRunEngine,
+    Job,
+    Scheduler,
+)
+
+ML = 32
+N = 24
+P = 12
+
+
+def _tree_eq(a, b):
+    return jax.tree_util.tree_all(jax.tree_util.tree_map(
+        lambda x, z: bool(np.array_equal(np.asarray(x), np.asarray(z))),
+        a, b))
+
+
+def _assert_gp_result_equal(solo, batched, label=""):
+    for k in ("genomes", "depths", "fitness", "best_genome"):
+        assert _tree_eq(solo[k], batched[k]), f"{label}: {k} differs"
+    assert solo["nevals"] == batched["nevals"], label
+    assert solo["best_fitness"] == batched["best_fitness"], label
+
+
+def _founders(pset, seed, n=N, max_len=ML, depth=3):
+    gen = make_generator(pset, max_len, 1, depth, "full")
+    ks = jax.random.split(jax.random.key(seed), n)
+    return jax.vmap(gen)(ks)
+
+
+def _symbreg_data(n_points=P):
+    X = np.linspace(-1, 1, n_points).reshape(n_points, 1) \
+        .astype(np.float32)
+    y = (X[:, 0] ** 2 + X[:, 0]).astype(np.float32)
+    return X, y
+
+
+def _run_batched(eng, keys, inits, ngens, hypers, segment_len=3,
+                 n_lanes=None):
+    """Drive the engine the way the scheduler does — lane_init, pack
+    into a padded slot count, segmented advance, per-lane decode."""
+    n = len(keys)
+    lanes = [eng.lane_init(k, g0, ng, h)
+             for k, g0, ng, h in zip(keys, inits, ngens, hypers)]
+    batch = eng.pack(lanes, n_lanes=n_lanes or n, horizon=max(ngens))
+    segs = []
+    while not eng.done(batch)[:n].all():
+        batch, seg = eng.advance(batch, segment_len)
+        segs.append(seg)
+    return [eng.lane_result(eng.unpack(batch, i),
+                            eng.lane_records(segs, i))
+            for i in range(n)]
+
+
+# ------------------------------------------------ symbreg / mixed ngen ----
+
+def test_gp_batched_mixed_ngen_bit_identity():
+    """Mixed-ngen lanes (the completion latch + uneven masks) against
+    the solo symbreg loop — the tentpole's core contract. math_set
+    carries an ERC, so ephemeral sampling rides every lane."""
+    pset = math_set(n_args=1)
+    X, y = _symbreg_data()
+    solo = make_symbreg_loop(pset, ML, X, y, cxpb=0.5, mutpb=0.2)
+    ngens = [7, 4, 7, 2]
+    solo_res = [solo(jax.random.key(100 + i), _founders(pset, i), ng)
+                for i, ng in enumerate(ngens)]
+
+    spec = GpJobSpec(pset=pset, max_len=ML, X=X, y=y)
+    eng = GpMultiRunEngine(spec)
+    out = _run_batched(
+        eng, [jax.random.key(100 + i) for i in range(4)],
+        [_founders(pset, i) for i in range(4)], ngens,
+        [{"cxpb": 0.5, "mutpb": 0.2}] * 4,
+        n_lanes=6)  # 2 padding slots: inactive lanes must stay no-ops
+    for i in range(4):
+        _assert_gp_result_equal(solo_res[i], out[i], f"lane {i}")
+
+
+def test_gp_batched_erc_heavy_bit_identity():
+    """ERC-heavy lanes: high mutpb + deep donor trees hammer the
+    ephemeral sampler and the mutation donor vocabulary — the path
+    that forces union-mask replays."""
+    pset = math_set(n_args=1, erc_low=-2.0, erc_high=2.0)
+    X, y = _symbreg_data()
+    solo = make_symbreg_loop(pset, ML, X, y, cxpb=0.3, mutpb=0.6,
+                             mut_max=3)
+    solo_res = [solo(jax.random.key(7 + i), _founders(pset, 50 + i), 5)
+                for i in range(2)]
+
+    spec = GpJobSpec(pset=pset, max_len=ML, X=X, y=y, mut_max=3)
+    eng = GpMultiRunEngine(spec)
+    out = _run_batched(
+        eng, [jax.random.key(7 + i) for i in range(2)],
+        [_founders(pset, 50 + i) for i in range(2)], [5, 5],
+        [{"cxpb": 0.3, "mutpb": 0.6}] * 2, segment_len=2)
+    for i in range(2):
+        _assert_gp_result_equal(solo_res[i], out[i], f"erc lane {i}")
+
+
+# ------------------------------------------- typed-flavoured (bool) ----
+
+def test_gp_batched_bool_vocab_custom_eval_bit_identity():
+    """The typed-problem formulation (bool vocabulary, even-parity
+    target) through the custom-``evaluate`` mode: the engine and the
+    solo loop share ONE trace-safe row-independent evaluator, so
+    bit-identity isolates the key-schedule/variation mirroring."""
+    pset = bool_set(n_args=2)
+    interp = gp.make_batch_interpreter(pset, 24, mode="scan",
+                                       dedup=False)
+    X = jnp.asarray([[0, 0], [0, 1], [1, 0], [1, 1]], jnp.float32)
+    y = jnp.asarray([0, 1, 1, 0], jnp.float32)  # XOR / even parity
+
+    def evaluate(genomes):
+        preds = interp(genomes, X)
+        return -jnp.mean((preds - y[None, :]) ** 2, axis=1)
+
+    solo = make_gp_loop(pset, 24, evaluate, cxpb=0.5, mutpb=0.3)
+    solo_res = [solo(jax.random.key(31 + i),
+                     _founders(pset, 80 + i, max_len=24), 5)
+                for i in range(2)]
+
+    spec = GpJobSpec(pset=pset, max_len=24, evaluate=evaluate,
+                     name="parity")
+    eng = GpMultiRunEngine(spec)
+    out = _run_batched(
+        eng, [jax.random.key(31 + i) for i in range(2)],
+        [_founders(pset, 80 + i, max_len=24) for i in range(2)],
+        [5, 5], [{"cxpb": 0.5, "mutpb": 0.3}] * 2, segment_len=2)
+    for i in range(2):
+        _assert_gp_result_equal(solo_res[i], out[i], f"bool lane {i}")
+
+
+# --------------------------------------------------------- ADF lanes ----
+
+def test_gp_batched_adf_lanes_bit_identity():
+    """ADF-flavoured lanes: the MAIN branch evolves (its pset carries
+    the ADF0 call op) while a frozen defined-function branch rides
+    inside a shared row-independent evaluator built on the masked ADF
+    batch interpreter — the documented way ADF trees join the batch."""
+    main = gp.PrimitiveSet("MAIN", 1)
+    main.add_primitive(jnp.add, 2, "add")
+    main.add_primitive(jnp.multiply, 2, "mul")
+    main.add_adf("ADF0", 1, branch=1)
+    sub = gp.PrimitiveSet("ADF0", 1)
+    sub.add_primitive(jnp.subtract, 2, "sub")
+    sub.add_primitive(jnp.cos, 1, "cos")
+    branches = [(main, 24), (sub, 16)]
+    # specialize="none": a shared custom evaluator must compute the
+    # same bits eagerly (solo loop) and under trace (batched scan) —
+    # the mask-specialized interpreter re-specializes on whatever
+    # concrete sub-batch the solo loop hands it, which is exactly the
+    # bit-instability the custom-evaluate contract rules out
+    adf_interp = gp.make_adf_batch_interpreter(branches,
+                                               specialize="none")
+    # one frozen ADF0 body shared by every row: cos(ARG0)
+    sub_gen = make_generator(sub, 16, 1, 2, "full")
+    sub_g = sub_gen(jax.random.key(999))
+    X = jnp.linspace(-1.0, 1.0, 9)[:, None]
+    y = jnp.cos(X[:, 0]) * X[:, 0]
+
+    def evaluate(genomes):
+        rows = genomes["nodes"].shape[0]
+        sub_b = jax.tree_util.tree_map(
+            lambda a: jnp.broadcast_to(a, (rows,) + a.shape), sub_g)
+        preds = adf_interp((genomes, sub_b), X)
+        # max-abs (Chebyshev) loss: elementwise ops + a max reduction
+        # are bit-stable under any fusion order — a mean's summation
+        # can reassociate between the eager (solo) and traced (batch)
+        # compilations of the same evaluator and break bit-identity
+        return -jnp.max(jnp.abs(preds - y[None, :]), axis=1)
+
+    solo = make_gp_loop(main, 24, evaluate, cxpb=0.5, mutpb=0.2)
+    solo_res = [solo(jax.random.key(61 + i),
+                     _founders(main, 90 + i, max_len=24), 4)
+                for i in range(2)]
+
+    spec = GpJobSpec(pset=main, max_len=24, evaluate=evaluate,
+                     name="adf")
+    eng = GpMultiRunEngine(spec)
+    out = _run_batched(
+        eng, [jax.random.key(61 + i) for i in range(2)],
+        [_founders(main, 90 + i, max_len=24) for i in range(2)],
+        [4, 4], [{"cxpb": 0.5, "mutpb": 0.2}] * 2, segment_len=2)
+    for i in range(2):
+        _assert_gp_result_equal(solo_res[i], out[i], f"adf lane {i}")
+
+
+# ----------------------------------------------------- island run axis ----
+
+def _island_toolbox():
+    tb = Toolbox()
+    tb.register("evaluate", lambda g: g.sum(-1).astype(jnp.float32))
+    tb.register("mate", ops.cx_two_point)
+    tb.register("mutate", ops.mut_flip_bit, indpb=0.1)
+    tb.register("select", ops.sel_tournament, tournsize=3)
+    return tb
+
+
+def _island_init(seed, n_islands=4, island_size=16):
+    return island_init(jax.random.key(seed), n_islands, island_size,
+                       ops.bernoulli_genome(12), FitnessSpec((1.0,)))
+
+
+def test_island_run_axis_vs_solo_epoch_bit_identity():
+    """N island runs (per-lane cxpb/mutpb, mixed epoch budgets) on the
+    run axis vs the solo ``make_island_step`` epoch driver keyed
+    ``fold_in(key, epoch)`` — migration ring, tournament, everything."""
+    tb = _island_toolbox()
+    spec = IslandJobSpec(n_islands=4, island_size=16, freq=2, mig_k=2)
+    ngens = [5, 3, 5]
+    hypers = [{"cxpb": 0.5, "mutpb": 0.2}, {"cxpb": 0.7, "mutpb": 0.1},
+              {"cxpb": 0.5, "mutpb": 0.2}]
+    solo_pops = []
+    for i, ng in enumerate(ngens):
+        step = make_island_step(tb, hypers[i]["cxpb"],
+                                hypers[i]["mutpb"], 2, 2)
+        pops = _island_init(i)
+        key = jax.random.key(100 + i)
+        for epoch in range(ng):
+            pops = step(jax.random.fold_in(key, epoch), pops)
+        solo_pops.append(pops)
+
+    eng = IslandMultiRunEngine(tb, spec)
+    out = _run_batched(
+        eng, [jax.random.key(100 + i) for i in range(3)],
+        [_island_init(i) for i in range(3)], ngens, hypers,
+        segment_len=2, n_lanes=4)
+    for i, s in enumerate(solo_pops):
+        assert _tree_eq((s.genomes, s.fitness, s.valid),
+                        (out[i].genomes, out[i].fitness, out[i].valid)), \
+            f"island lane {i} diverged from the solo epoch driver"
+
+
+# --------------------------------------------- Scheduler end-to-end ----
+
+def test_scheduler_gp_island_eviction_resume_bit_identity(tmp_path):
+    """GP and island jobs through the Scheduler — including forced
+    eviction/resume (3 GP tenants on 2 lanes, fair_quantum=1) — must
+    return results bit-identical to solo, expose the job family in
+    ``slo_snapshot()`` and in the family-labelled residents gauge."""
+    pset = math_set(n_args=1)
+    X, y = _symbreg_data(16)
+    solo = make_symbreg_loop(pset, ML, X, y, cxpb=0.5, mutpb=0.2)
+    ngens = [13, 9, 13]
+    founders = [_founders(pset, i, n=32) for i in range(3)]
+    solo_res = [solo(jax.random.key(100 + i), founders[i], ng)
+                for i, ng in enumerate(ngens)]
+    spec = GpJobSpec(pset=pset, max_len=ML, X=X, y=y)
+
+    tb = _island_toolbox()
+    ispec = IslandJobSpec(n_islands=4, island_size=16, freq=2, mig_k=2)
+    ingens = [7, 5]
+    ihyp = [{"cxpb": 0.5, "mutpb": 0.2}, {"cxpb": 0.7, "mutpb": 0.1}]
+    solo_pops = []
+    for i, ng in enumerate(ingens):
+        step = make_island_step(tb, ihyp[i]["cxpb"], ihyp[i]["mutpb"],
+                                2, 2)
+        pops = _island_init(i)
+        key = jax.random.key(200 + i)
+        for epoch in range(ng):
+            pops = step(jax.random.fold_in(key, epoch), pops)
+        solo_pops.append(pops)
+
+    sched = Scheduler(str(tmp_path), max_lanes=2, segment_len=4,
+                      fair_quantum=1)
+    gp_ids = [sched.submit(Job(
+        tenant_id=f"gp{i}", family="gp", toolbox=None,
+        key=jax.random.key(100 + i), init=founders[i], ngen=ng,
+        hyper={"cxpb": 0.5, "mutpb": 0.2}, spec=spec))
+        for i, ng in enumerate(ngens)]
+    isl_ids = [sched.submit(Job(
+        tenant_id=f"isl{i}", family="island", toolbox=tb,
+        key=jax.random.key(200 + i), init=_island_init(i), ngen=ng,
+        hyper=ihyp[i], spec=ispec))
+        for i, ng in enumerate(ingens)]
+    results = sched.run()
+
+    for i, jid in enumerate(gp_ids):
+        _assert_gp_result_equal(solo_res[i], results[jid], f"gp{i}")
+    for i, jid in enumerate(isl_ids):
+        s, r = solo_pops[i], results[jid]
+        assert _tree_eq((s.genomes, s.fitness, s.valid),
+                        (r.genomes, r.fitness, r.valid)), f"isl{i}"
+    snap = sched.slo_snapshot()
+    assert sorted({row["family"] for row in snap.values()}) \
+        == ["gp", "island"]
+    text = sched.metrics.metrics_text()
+    assert "deap_serving_family_residents" in text
+    assert 'family="gp"' in text and 'family="island"' in text
+    sched.close()
+
+
+def test_scheduler_rejects_gp_island_jobs_without_spec(tmp_path):
+    with Scheduler(str(tmp_path)) as sched:
+        with pytest.raises(ValueError, match="spec"):
+            sched.submit(Job(tenant_id="g", family="gp", toolbox=None,
+                             key=jax.random.key(0), init={}, ngen=2,
+                             hyper={"cxpb": 0.5, "mutpb": 0.2}))
+        with pytest.raises(ValueError, match="spec"):
+            sched.submit(Job(tenant_id="i", family="island",
+                             toolbox=_island_toolbox(),
+                             key=jax.random.key(0), init={}, ngen=2,
+                             hyper={"cxpb": 0.5, "mutpb": 0.2}))
+
+
+# ------------------------------------------- ResilientRun.multirun ----
+
+def test_resilient_multirun_gp_segmented_and_resumed(tmp_path):
+    """The batched driver under ResilientRun: a packed GP batch
+    checkpointed at segment boundaries finishes bit-identical to solo,
+    and a FRESH engine resuming the batch from a mid-run checkpoint
+    (union mask regrown from the restored genomes) stays bit-exact."""
+    pset = math_set(n_args=1)
+    X, y = _symbreg_data()
+    solo = make_symbreg_loop(pset, ML, X, y, cxpb=0.5, mutpb=0.2)
+    ngens = [8, 5]
+    keys = [jax.random.key(40 + i) for i in range(2)]
+    inits = [_founders(pset, i) for i in range(2)]
+    solo_res = [solo(keys[i], inits[i], ng)
+                for i, ng in enumerate(ngens)]
+    spec = GpJobSpec(pset=pset, max_len=ML, X=X, y=y)
+    hyper = {"cxpb": 0.5, "mutpb": 0.2}
+
+    res = ResilientRun(str(tmp_path / "a"), segment_len=3)
+    out = res.multirun(GpMultiRunEngine(spec), keys, inits, ngens,
+                       hyper=hyper)
+    for i in range(2):
+        _assert_gp_result_equal(solo_res[i], out[i], f"seg lane {i}")
+
+    # mid-run checkpoint written by one engine, resumed by ANOTHER
+    from deap_tpu.resilience.engine import _EngineBatchSpec
+    root2 = str(tmp_path / "b")
+    res2 = ResilientRun(root2, segment_len=3)
+    sp = _EngineBatchSpec(GpMultiRunEngine(spec), keys, inits, ngens,
+                          [hyper] * 2)
+    st = sp.init()
+    st["_resilience"] = {"algorithm": sp.algorithm,
+                         "run_id": "partial", "ngen": max(ngens)}
+    st = sp.segment(st, 0, 3)
+    res2.ckpt.save(3, st, meta=dict(st["_resilience"], step=3))
+    res3 = ResilientRun(root2, segment_len=3)
+    out2 = res3.multirun(GpMultiRunEngine(spec), keys, inits, ngens,
+                         hyper=hyper)
+    assert res3.resumed_from == "partial"
+    for i in range(2):
+        _assert_gp_result_equal(solo_res[i], out2[i],
+                                f"resumed lane {i}")

@@ -409,3 +409,115 @@ def test_client_imports_without_jax():
                          capture_output=True, text=True, timeout=120)
     assert out.returncode == 0, out.stderr
     assert "NOJAX_OK" in out.stdout
+
+
+# ------------------------------------- satellite: GP tenants ----
+# The service plane has only ever carried scan-family jobs; these pin
+# the two host-visible service behaviours — the idleness/spill
+# actuator and admission-WAL replay — with a batched-GP tenant.
+
+from deap_tpu.gp.pset import math_set as _math_set
+from deap_tpu.gp.tree import make_generator as _make_generator
+from deap_tpu.serving import GpJobSpec
+
+_GP_PSET = _math_set(n_args=1)
+_GP_ML = 24
+_GP_X = np.linspace(-1, 1, 12).reshape(12, 1).astype(np.float32)
+_GP_Y = (_GP_X[:, 0] ** 2 + _GP_X[:, 0]).astype(np.float32)
+_GP_SPEC = GpJobSpec(pset=_GP_PSET, max_len=_GP_ML, X=_GP_X, y=_GP_Y)
+
+
+def _gp_founders(seed, n=16):
+    gen = _make_generator(_GP_PSET, _GP_ML, 1, 3, "full")
+    return jax.vmap(gen)(jax.random.split(jax.random.key(seed), n))
+
+
+def _gp_job(tid, params):
+    seed = int(params.get("seed", 0))
+    return Job(tenant_id=tid, family="gp", toolbox=None,
+               key=jax.random.key(3000 + seed),
+               init=_gp_founders(seed),
+               ngen=int(params.get("ngen", 8)),
+               hyper={"cxpb": 0.5, "mutpb": 0.2}, spec=_GP_SPEC,
+               program="gp_symbreg")
+
+
+GP_PROBLEMS = {**PROBLEMS, "gp_symbreg": _gp_job}
+
+
+def test_gp_tenant_idleness_and_spill(tmp_path):
+    """``note_interaction()`` drives a GP tenant's idleness clock and
+    ``request_spill`` swaps it out (checkpoint → queue tail) at the
+    next boundary — then the run still finishes bit-identical to an
+    unspilled one."""
+    ref = _inprocess_digests(tmp_path / "ref",
+                             [_gp_job("g0", {"seed": 4, "ngen": 10})])
+    sched = Scheduler(str(tmp_path / "run"), max_lanes=2,
+                      segment_len=2)
+    sched.submit(_gp_job("g0", {"seed": 4, "ngen": 10}))
+    sched.step()
+    snap = sched.slo_snapshot()
+    row = next(iter(snap.values()))
+    assert row["family"] == "gp"
+    tid, segments, gens_idle = row["idle"][0]
+    assert tid == "g0" and gens_idle == 2  # 2 gens, never polled
+    sched.tenants["g0"].note_interaction()
+    assert next(iter(sched.slo_snapshot().values()))["idle"][0][2] == 0
+
+    # spill at the next boundary: evicted + checkpointed, then resumes
+    sched.request_spill("g0")
+    sched.step()
+    assert sched.tenants["g0"].has_checkpoint
+    results = sched.run()
+    sched.close()
+    assert result_digest(results["g0"]) == ref["g0"]
+    rows = read_journal(os.path.join(str(tmp_path / "run"),
+                                     "journal.jsonl"))
+    assert any(r.get("kind") == "tenant_evicted"
+               and r.get("reason") == "spill" for r in rows)
+
+
+def test_gp_tenant_wal_replay_bit_exact(tmp_path):
+    """Admission-WAL replay with a GP tenant: drain mid-run, restart
+    the service over the same root WITHOUT resubmitting — the WAL
+    readmits the job, the checkpoint resumes it, and the result is
+    bit-identical to an uninterrupted in-process run."""
+    NGEN = 10
+    ref = _inprocess_digests(
+        tmp_path / "ref",
+        [_gp_job("gA", {"seed": 6, "ngen": NGEN})])["gA"]
+
+    def kill_after_first_segment(step):
+        if step == 1:
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert svc._drain_req.wait(30)
+
+    root = str(tmp_path / "svc")
+    svc = EvolutionService(root, GP_PROBLEMS, max_lanes=2,
+                           segment_len=2,
+                           metrics=MetricsRegistry(),
+                           step_hook=kill_after_first_segment)
+    ds = svc.install_signal_handlers()
+    try:
+        c = ServiceClient(svc.url)
+        c.submit("gp_symbreg", params={"seed": 6, "ngen": NGEN},
+                 tenant_id="gA")
+        assert svc._drained.wait(120)
+        res = c.result("gA", wait=False)
+        assert res["status"] == "drained" and "result" not in res
+    finally:
+        ds.uninstall()
+        svc.close()
+
+    # restart, NO resubmission: the WAL replay is the only admission
+    with EvolutionService(root, GP_PROBLEMS, max_lanes=2,
+                          segment_len=2,
+                          metrics=MetricsRegistry()) as svc2:
+        c2 = ServiceClient(svc2.url)
+        res = c2.result("gA", wait=True, timeout=300)
+    assert res["status"] == "finished"
+    assert res["result"]["digest"] == ref
+    rows = read_journal(os.path.join(root, "journal.jsonl"))
+    kinds = [r.get("kind") for r in rows]
+    assert "wal_replay" in kinds
+    assert "tenant_resumed" in kinds
