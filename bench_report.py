@@ -715,6 +715,68 @@ def mesh_tripwire(floor: float = MESH_PJIT_FLOOR) -> int:
     return tripped
 
 
+#: fractional overhead beyond which the sampled-tracing pair trips
+#: (trace_sample=0.1 vs tracing off, same session, 1k-tenant socket
+#: config, interleaved min-of-reps)
+TRACING_OVERHEAD_THRESHOLD = 0.03
+
+
+def tracing_tripwire(threshold: float = TRACING_OVERHEAD_THRESHOLD) -> int:
+    """The tracing-plane gate (ISSUE 15). The latest
+    BENCH_TRACING*.json must show (1) the sampled arm
+    (``trace_sample=0.1``) within ``threshold`` of the tracing-off arm
+    — same session, interleaved min-of-reps at the 1k-tenant socket
+    config — and (2) all three arms (off / sampled / always-on)
+    producing bit-identical per-tenant wire digests: spans observe the
+    run, they never steer it. The always-on overhead row is printed
+    for context but ungated. Returns the number of tripped rows."""
+    files = sorted(glob.glob(os.path.join(HERE, "BENCH_TRACING*.json")))
+    if not files:
+        print("tracing tripwire: no committed BENCH_TRACING*.json yet")
+        return 0
+    rows = _bench_rows(files[-1])
+    tripped = 0
+    print(f"\n## Tracing overhead ({os.path.basename(files[-1])})\n")
+    ov = rows.get("tracing_sampled_overhead_pct")
+    off = rows.get("tracing_off_seconds")
+    sam = rows.get("tracing_sampled_seconds")
+    if ov is not None and isinstance(ov.get("value"), (int, float)):
+        overhead = ov["value"] / 100.0
+        ok = overhead <= threshold
+        pair = ""
+        if off and sam:
+            pair = (f"sampled {sam['value']}s vs off {off['value']}s "
+                    f"({off.get('tenants', '?')} tenants, "
+                    f"{off.get('clients', '?')} clients), ")
+        print(f"- {pair}same session: {100 * overhead:+.2f}% overhead "
+              + ("ok" if ok else f"**REGRESSION** (> {threshold:.0%} — "
+                 "sampled tracing got expensive)"))
+        tripped += 0 if ok else 1
+    else:
+        print("- tracing_sampled_overhead_pct row missing")
+        tripped += 1
+    alw = rows.get("tracing_always_overhead_pct")
+    if alw is not None and isinstance(alw.get("value"), (int, float)):
+        print(f"- always-on arm: {alw['value']:+.2f}% overhead "
+              "(context row, ungated)")
+    bit = rows.get("tracing_bit_identical")
+    if bit is None:
+        print("- tracing_bit_identical: **missing** (the bit-identity "
+              "row is part of the acceptance)")
+        tripped += 1
+    else:
+        ok = bool(bit.get("value"))
+        print(f"- bit identity across off/sampled/always: "
+              f"{bit.get('value')} "
+              f"({bit.get('tenants_compared', '?')} tenants) "
+              + ("ok" if ok else "**REGRESSION** (a traced run "
+                 "diverged — spans are steering the evolution)"))
+        tripped += 0 if ok else 1
+    if len(files) >= 2:
+        tripped += _diff_rows(files[-2], files[-1], TRIPWIRE_THRESHOLD)
+    return tripped
+
+
 def tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
     """Diff the two most recent committed ``BENCH_r*.json`` files and
     flag regressions; then the gp_symbreg paired rows
@@ -741,6 +803,7 @@ def tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
     tripped += chaos_tripwire()
     tripped += mesh_tripwire()
     tripped += costs_tripwire()
+    tripped += tracing_tripwire()
     return tripped
 
 

@@ -76,6 +76,7 @@ from deap_tpu import algorithms as algos
 # no-jax service client can reuse the policy; re-exported here unchanged.
 from deap_tpu.resilience.retry import RetryPolicy
 from deap_tpu.support.checkpoint import AsyncCheckpointWriter, Checkpointer
+from deap_tpu.telemetry import tracing
 
 __all__ = ["Preempted", "RetryPolicy", "ResilientRun", "classify_error",
            "quarantine_non_finite", "QUARANTINE_PENALTY"]
@@ -849,12 +850,23 @@ class ResilientRun:
                     hi = min(gen + self.segment_len, total)
                     self._fault("segment_start", lo=gen, hi=hi)
                     t_seg = time.perf_counter()
+                    self._last_trace_dir = None
                     state = self._flight_segment(spec, state, gen, hi,
                                                  seg_i)
                     seg_s = time.perf_counter() - t_seg
                     if self._minst is not None:
                         self._minst.segment_s.observe(
                             seg_s, algorithm=spec.algorithm)
+                    # trace-plane segment span (no-op outside a traced
+                    # request); a flight-recorded segment links its
+                    # xplane dir so the waterfall points straight at
+                    # the device timeline
+                    tracing.emit_current(
+                        "segment.run", seg_s, phase="device",
+                        lo=gen, hi=hi,
+                        algorithm=spec.algorithm,
+                        links=([{"xplane_dir": self._last_trace_dir}]
+                               if self._last_trace_dir else None))
                     self._fault("segment_end", lo=gen, hi=hi)
                     meta = dict(state["_resilience"], step=hi)
                     if self.tenant_id is not None:
@@ -869,10 +881,17 @@ class ResilientRun:
                                              meta=meta)
                     else:
                         path = self.ckpt.save(hi, state, meta=meta)
+                    ck_s = time.perf_counter() - t_ck
                     if self._minst is not None:
                         self._minst.checkpoint_s.observe(
-                            time.perf_counter() - t_ck,
-                            algorithm=spec.algorithm)
+                            ck_s, algorithm=spec.algorithm)
+                    # for async saves this is the snapshot+drain cost
+                    # on the driver; the background write lands as its
+                    # own checkpoint.flush span from the writer thread
+                    tracing.emit_current("checkpoint", ck_s,
+                                         phase="checkpoint",
+                                         step=hi,
+                                         async_save=writer is not None)
                     self.last_step = hi
                     self._journal_event("segment",
                                         algorithm=spec.algorithm,
@@ -940,6 +959,7 @@ class ResilientRun:
                 pass
         self._journal_event("flight_trace", algorithm=spec.algorithm,
                             lo=lo, hi=hi, dir=tdir)
+        self._last_trace_dir = tdir  # span-link target for the drive
         return state
 
     def _record_memory(self, step: int, seg_i: int) -> None:

@@ -133,6 +133,10 @@ def child_main(argv: Optional[Sequence[str]] = None) -> None:
     p.add_argument("--max-lanes", type=int, default=8)
     p.add_argument("--max-pending", type=int, default=0)
     p.add_argument("--watchdog-s", type=float, default=0.0)
+    p.add_argument("--trace-sample", type=float, default=None,
+                   help="enable the tracing plane at this sample rate"
+                        " (lifecycle spans always on); omitted ="
+                        " tracing off")
     p.add_argument("--platform", default="cpu")
     args = p.parse_args(argv)
 
@@ -157,7 +161,7 @@ def child_main(argv: Optional[Sequence[str]] = None) -> None:
         watchdog_s=(args.watchdog_s or None),
         max_lanes=args.max_lanes, segment_len=args.segment_len,
         fair_quantum=None, checkpoint_every=1, telemetry=False,
-        metrics=False)
+        metrics=False, trace_sample=args.trace_sample)
     ds = svc.install_signal_handlers()
     tmp = args.ready + ".tmp"
     with open(tmp, "w") as fh:
@@ -185,7 +189,9 @@ def _spawn_child(root: str, port: int, ready: str, *,
                  kill_at: Optional[int], kill_event: str,
                  segment_len: int, max_lanes: int,
                  max_pending: Optional[int],
-                 python: str) -> subprocess.Popen:
+                 python: str,
+                 trace_sample: Optional[float] = None
+                 ) -> subprocess.Popen:
     try:
         os.remove(ready)
     except FileNotFoundError:
@@ -197,6 +203,8 @@ def _spawn_child(root: str, port: int, ready: str, *,
            "--max-pending", str(max_pending or 0)]
     if kill_at is not None:
         cmd += ["--kill-at", str(kill_at), "--kill-event", kill_event]
+    if trace_sample is not None:
+        cmd += ["--trace-sample", str(trace_sample)]
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
     return subprocess.Popen(cmd, env=env,
@@ -227,6 +235,7 @@ def run_chaos(root: str, *, n_tenants: int = 8,
               segment_len: int = 2, max_lanes: int = 8,
               clients: int = 4, max_pending: Optional[int] = None,
               converge_timeout_s: float = 300.0,
+              trace_sample: Optional[float] = None,
               python: str = sys.executable) -> Dict[str, Any]:
     """The kill/restart acceptance run. Returns::
 
@@ -250,7 +259,8 @@ def run_chaos(root: str, *, n_tenants: int = 8,
     proc = _spawn_child(root, port, ready, kill_at=kill_at_step,
                         kill_event=kill_event,
                         segment_len=segment_len, max_lanes=max_lanes,
-                        max_pending=max_pending, python=python)
+                        max_pending=max_pending, python=python,
+                        trace_sample=trace_sample)
     _wait_ready(proc, ready)
 
     kill_info: Dict[str, Any] = {"rc": None, "t": None, "proc2": None}
@@ -266,7 +276,8 @@ def run_chaos(root: str, *, n_tenants: int = 8,
                           kill_event=kill_event,
                           segment_len=segment_len,
                           max_lanes=max_lanes,
-                          max_pending=max_pending, python=python)
+                          max_pending=max_pending, python=python,
+                          trace_sample=trace_sample)
         kill_info["proc2"] = p2
         _wait_ready(p2, ready)
 

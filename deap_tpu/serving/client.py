@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import os
 import sys
 import urllib.parse
 from typing import Any, Dict, Iterator, List, Optional
@@ -30,6 +31,7 @@ from typing import Any, Dict, Iterator, List, Optional
 if "deap_tpu" in sys.modules:
     from deap_tpu.serving import wire
     from deap_tpu.resilience.retry import RetryPolicy
+    from deap_tpu.telemetry import tracing
 else:
     # standalone load (no deap_tpu in the process — e.g. a submit box
     # that must never initialise jax): pull the codec and the retry
@@ -45,12 +47,18 @@ else:
             _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
                           *relpath))
         mod = _ilu.module_from_spec(spec)
+        # register BEFORE exec: dataclass processing (tracing's
+        # TraceContext) resolves string annotations through
+        # sys.modules[cls.__module__]
+        sys.modules[name] = mod
         spec.loader.exec_module(mod)
         return mod
 
     wire = _load("_deap_tpu_serving_wire_standalone", "wire.py")
     RetryPolicy = _load("_deap_tpu_resilience_retry_standalone",
                         _os.pardir, "resilience", "retry.py").RetryPolicy
+    tracing = _load("_deap_tpu_telemetry_tracing_standalone",
+                    _os.pardir, "telemetry", "tracing.py")
 
 __all__ = ["ServiceClient", "ServiceError", "RetryPolicy"]
 
@@ -100,13 +108,31 @@ class ServiceClient:
         self.timeout = timeout
         self.retry = retry
         self._conn: Optional[http.client.HTTPConnection] = None
+        self._rid_seq = 0
 
     # ------------------------------------------------------- plumbing ----
 
-    def _headers(self) -> Dict[str, str]:
+    def next_request_id(self) -> str:
+        """A fresh client-generated request id. One id per *logical*
+        request: retries inside :meth:`_request` reuse it, so a
+        retried submit stays one trace server-side."""
+        self._rid_seq += 1
+        return f"req-cl-{os.getpid():x}-{self._rid_seq:x}"
+
+    def _headers(self, request_id: Optional[str] = None
+                 ) -> Dict[str, str]:
         h = {"Content-Type": "application/json"}
         if self.token:
             h["Authorization"] = f"Bearer {self.token}"
+        if request_id:
+            # W3C trace propagation alongside the request id: both
+            # derive deterministically from the id, so the server
+            # (and a WAL-replaying restart of it) lands on the same
+            # trace without the client holding any tracing state
+            h["X-Request-Id"] = request_id
+            h["traceparent"] = tracing.format_traceparent(
+                tracing.trace_id_for(request_id),
+                tracing.span_id_for(request_id, "client"))
         return h
 
     def _connect(self) -> http.client.HTTPConnection:
@@ -116,24 +142,27 @@ class ServiceClient:
         return self._conn
 
     def _request_once(self, method: str, path: str,
-                      body: Optional[dict] = None):
+                      body: Optional[dict] = None,
+                      request_id: Optional[str] = None):
         conn = self._connect()
         conn.request(method, path,
                      body=(json.dumps(body).encode()
                            if body is not None else None),
-                     headers=self._headers())
+                     headers=self._headers(request_id))
         resp = conn.getresponse()
         return resp, resp.read()
 
     def _request(self, method: str, path: str,
                  body: Optional[dict] = None) -> Any:
         attempt = 0
+        rid = self.next_request_id()
         max_retries = (self.retry.max_retries
                        if self.retry is not None else 1)
         while True:
             retry_after = None
             try:
-                resp, data = self._request_once(method, path, body)
+                resp, data = self._request_once(method, path, body,
+                                                request_id=rid)
             except (http.client.HTTPException, ConnectionError,
                     OSError):
                 # stale keep-alive or a killed/restarting service:

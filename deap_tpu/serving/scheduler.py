@@ -48,9 +48,10 @@ import numpy as np
 from deap_tpu.serving.multirun import MultiRunEngine
 from deap_tpu.serving.tenant import Job, Tenant, bucket_key, pad_pow2
 from deap_tpu.support.compilecache import enable_compile_cache
+from deap_tpu.telemetry import tracing
 from deap_tpu.telemetry.meter import Meter
-from deap_tpu.telemetry.metrics import (MetricsServer, resolve_registry,
-                                        serve_metrics)
+from deap_tpu.telemetry.metrics import (MetricsServer, phase_histogram,
+                                        resolve_registry, serve_metrics)
 from deap_tpu.telemetry.run import RunTelemetry
 
 __all__ = ["Scheduler", "SchedulerBusyError", "prewarm"]
@@ -172,6 +173,19 @@ class Scheduler:
         :func:`deap_tpu.telemetry.serve_metrics`). Pass a registry to
         isolate, ``None``/``False`` to disable. Host-side counters
         only — nothing rides the compiled programs.
+    :param trace_sample: distributed-tracing knob. ``None`` (default)
+        → tracing off, the zero-overhead path. A float in [0, 1] →
+        a :class:`~deap_tpu.telemetry.tracing.Tracer` bound to the
+        scheduler journal: per-segment detail spans (queue wait →
+        admission → segment[i] → checkpoint) emit as ``trace_span``
+        rows for the sampled fraction of traces; the terminal
+        ``finished`` span is always on. With metrics on, every span
+        with a phase observes ``deap_service_phase_seconds{phase=...}``
+        regardless of the sampling decision. ``1.0`` is the
+        full-fidelity latency-investigation mode: it additionally
+        activates a :class:`~deap_tpu.telemetry.costs.
+        ProgramObservatory` so bucket compiles land in the waterfall
+        as HLO-linked ``compile`` spans.
     """
 
     def __init__(self, root: str, *, max_lanes: int = 8,
@@ -183,7 +197,8 @@ class Scheduler:
                  journal_fsync_every: Optional[int] = None,
                  metrics=True,
                  resume_tenants: bool = False,
-                 boundary_cb: Optional[Callable] = None):
+                 boundary_cb: Optional[Callable] = None,
+                 trace_sample: Optional[float] = None):
         self.root = str(root)
         os.makedirs(self.root, exist_ok=True)
         if compile_cache:
@@ -209,6 +224,39 @@ class Scheduler:
         self.metrics = resolve_registry(metrics)
         self._minst = (_ServingInstruments(self.metrics)
                        if self.metrics is not None else None)
+        #: distributed-tracing plane: ``trace_sample=None`` (default)
+        #: keeps tracing fully off — today's zero-overhead path; a
+        #: float in [0,1] enables the Tracer (lifecycle spans always
+        #: on, detail spans sampled per trace at that rate). Spans
+        #: land in this journal as ``trace_span`` rows and — when
+        #: metrics are on — observe the per-phase latency histogram.
+        self.trace_sample = trace_sample
+        self._observatory = None
+        if trace_sample is None:
+            self.tracer = None
+        else:
+            phase_obs = None
+            if self.metrics is not None:
+                hist = phase_histogram(self.metrics)
+                phase_obs = lambda phase, s: hist.observe(s, phase=phase)
+            self.tracer = tracing.Tracer(journal=self.journal,
+                                         sample=float(trace_sample),
+                                         phase_observe=phase_obs)
+            # FULL-FIDELITY tracing (sample >= 1.0, the latency-
+            # investigation mode) also activates the program
+            # observatory so every bucket compile journals a
+            # `program_profile` (trace ids stamp into it, the compile
+            # span links the HLO hash into the waterfall). Sampled
+            # production tracing does NOT: an active observatory
+            # switches every instrumented program to the explicit
+            # AOT lower/compile path, which skips jit's C++ dispatch
+            # fastpath on EVERY call — a per-step tax the sampled
+            # tripwire (bench.py --tracing, <= 3%) would flag.
+            if float(trace_sample) >= 1.0:
+                from deap_tpu.telemetry.costs import ProgramObservatory
+                self._observatory = ProgramObservatory(
+                    journal=self.journal)
+                self._observatory.__enter__()
         self._metrics_server: Optional[MetricsServer] = None
         self.buckets: Dict[Any, _Bucket] = {}
         self.tenants: Dict[str, Tenant] = {}
@@ -392,9 +440,18 @@ class Scheduler:
             self._repack(bucket)
             if not bucket.residents:
                 return True  # everything spilled; next round readmits
+            # ambient trace context for the segment: the batch is
+            # shared, so compiles/span-bridge rows inside advance()
+            # are attributed to a representative tenant (the first
+            # resident with a request id) — per-tenant device time is
+            # emitted exactly in _drain_boundary
+            rep_ctx = next((c for c in map(self._tctx,
+                                           bucket.residents)
+                            if c is not None), None)
             t0 = time.perf_counter()
-            batch, seg = bucket.engine.advance(bucket.batch,
-                                               self.segment_len)
+            with tracing.use(rep_ctx):
+                batch, seg = bucket.engine.advance(bucket.batch,
+                                                   self.segment_len)
             bucket.batch = batch
             self._drain_boundary(bucket, seg, t_start=t0)
             return True
@@ -429,6 +486,9 @@ class Scheduler:
         return self._metrics_server
 
     def close(self) -> None:
+        if self._observatory is not None:
+            self._observatory.__exit__(None, None, None)
+            self._observatory = None
         self.journal.summary(
             tenants=len(self.tenants),
             finished=sum(t.done for t in self.tenants.values()))
@@ -452,6 +512,43 @@ class Scheduler:
         rid = getattr(tenant.job, "request_id", None)
         return {"request_id": rid} if rid else {}
 
+    def _tctx(self, tenant: Tenant):
+        """The tenant's trace context (derived from its submitting
+        request id — the same derivation a restarted process makes, so
+        traces stitch across kill -9), or ``None`` when tracing is off
+        or the tenant was submitted in-process without a request id."""
+        if self.tracer is None:
+            return None
+        rid = getattr(tenant.job, "request_id", None)
+        if not rid:
+            return None
+        return self.tracer.context_for(rid)
+
+    def _tspan(self, tenant: Tenant, name: str, dur_s: float,
+               phase: Optional[str] = None, always: bool = False,
+               **attrs: Any) -> None:
+        """Emit one tenant span parented on the request's
+        deterministic root span. Per-segment detail respects the
+        sampling knob (the phase histogram still observes every one);
+        only terminal lifecycle events pass ``always=True`` — at 1k
+        tenants the detail spans are ~10 journal rows per tenant, and
+        journalling all of them regardless of ``trace_sample`` is
+        exactly the overhead the sampled tripwire exists to catch."""
+        ctx = self._tctx(tenant)
+        if ctx is None:
+            return
+        self.tracer.emit(name, dur_s, ctx=ctx, phase=phase,
+                         always=always, tenant_id=tenant.id, **attrs)
+
+    def _checkpoint_traced(self, engine, tenant: Tenant,
+                           name: str) -> str:
+        """Checkpoint a tenant and account the write to its trace."""
+        t0 = time.perf_counter()
+        path = tenant.checkpoint(engine)
+        self._tspan(tenant, name, time.perf_counter() - t0,
+                    phase="checkpoint", gen=tenant.gen)
+        return path
+
     def _next_bucket(self) -> Optional[_Bucket]:
         for _ in range(len(self._rr)):
             bkey = self._rr.pop(0)
@@ -461,9 +558,10 @@ class Scheduler:
         return None
 
     def _evict(self, bucket: _Bucket, t: Tenant, reason: str) -> None:
-        path = t.checkpoint(bucket.engine)
+        path = self._checkpoint_traced(bucket.engine, t,
+                                       "checkpoint.evict")
         self.journal.event("tenant_evicted", tenant_id=t.id, gen=t.gen,
-                           path=path, reason=reason)
+                           path=path, reason=reason, **self._rid(t))
         t.evict()
         bucket.residents.remove(t)
         bucket.queue.append(t)
@@ -477,6 +575,8 @@ class Scheduler:
         residency changed."""
         eng = bucket.engine
         changed = bucket.batch is None
+        repack_t0 = time.perf_counter()
+        newly_resident: List[Tenant] = []
 
         # requested spills (autoscaler pressure relief) — checkpoint
         # and park regardless of the fairness quantum
@@ -518,6 +618,11 @@ class Scheduler:
             if self._minst is not None:
                 self._minst.queue_wait_s.observe(wait_s,
                                                  bucket=bucket.label)
+            # detail span (sampled): time queued before this admission
+            # (re-queued evictees get one span per wait)
+            self._tspan(t, "queue.wait", wait_s, phase="queue_wait",
+                        resumed=bool(t.has_checkpoint))
+            newly_resident.append(t)
             if t.has_checkpoint:
                 t.restore(eng)
                 self.journal.event("tenant_resumed", tenant_id=t.id,
@@ -559,6 +664,15 @@ class Scheduler:
             bucket.batch = eng.pack(
                 lanes, n_lanes=pad_pow2(len(lanes), bucket.max_lanes),
                 horizon=bucket.horizon)
+        if newly_resident:
+            # admission/pack cost, attributed to every tenant admitted
+            # at this boundary (the repack is one shared host step, so
+            # each span carries the whole elapsed time — an upper
+            # bound per tenant, exact for the boundary)
+            pack_s = time.perf_counter() - repack_t0
+            for t in newly_resident:
+                self._tspan(t, "admit.pack", pack_s, phase="admission",
+                            bucket=bucket.label)
 
     def _journal_row(self, tenant: Tenant, row: dict) -> None:
         self.journal.event("meter", tenant_id=tenant.id, **row)
@@ -599,6 +713,13 @@ class Scheduler:
                 self._minst.tenant_gens.set(
                     round((t.gen - gen_before) / seg_s, 3),
                     tenant_id=t.id)
+            if seg_s is not None:
+                # detail span (sampled): this tenant's segment share
+                # (device time is batched — the wall seconds are the
+                # segment's; gen_before/gen delimit the lane's work)
+                self._tspan(t, "segment", seg_s, phase="device",
+                            gen_before=gen_before, gen=t.gen,
+                            bucket=bucket.label)
             t.segments_resident += 1
             t.lane = eng.unpack(bucket.batch, i)
             health = t.job.health
@@ -614,12 +735,15 @@ class Scheduler:
                 self.journal.event(
                     "tenant_finished", tenant_id=t.id, gen=t.gen,
                     status=t.status, **self._rid(t))
+                # instant lifecycle span marking the terminal state
+                self._tspan(t, "finished", 0.0, gen=t.gen,
+                            status=t.status, always=True)
                 if self._minst is not None:
                     self._minst.finished.inc(bucket=bucket.label)
                 finished.append(t)
             elif self.checkpoint_every and \
                     self._boundaries % self.checkpoint_every == 0:
-                t.checkpoint(eng)
+                self._checkpoint_traced(eng, t, "checkpoint")
             updates.append({"tenant": t, "gen_before": gen_before,
                             "gen": t.gen, "chunk": chunk,
                             "finished": t in finished})
@@ -738,7 +862,8 @@ class Scheduler:
             saved = []
             for b in self.buckets.values():
                 for t in b.residents:
-                    t.checkpoint(b.engine)
+                    self._checkpoint_traced(b.engine, t,
+                                            "checkpoint.drain")
                     saved.append(t.id)
             return saved
 

@@ -128,6 +128,7 @@ every drained tenant bit-exactly (``Scheduler(resume_tenants=True)``)
 
 from __future__ import annotations
 
+import contextlib
 import http.server
 import json
 import os
@@ -145,6 +146,7 @@ from deap_tpu.serving.autoscale import AutoscaleConfig, AutoscalePolicy
 from deap_tpu.serving.scheduler import Scheduler
 from deap_tpu.serving.tenant import Job, bucket_key
 from deap_tpu.serving.wal import AdmissionWAL
+from deap_tpu.telemetry import tracing
 
 __all__ = ["EvolutionService", "SERVICE_JOURNAL_KINDS"]
 
@@ -155,7 +157,7 @@ SERVICE_JOURNAL_KINDS = ("service_request", "service_drain",
                          "autoscale_decision", "auth_rejected",
                          "wal_replay", "idempotent_replay",
                          "deadline_exceeded", "load_shed",
-                         "driver_stall")
+                         "driver_stall", "trace_span")
 
 
 class _HttpError(Exception):
@@ -264,7 +266,10 @@ class EvolutionService:
         generators) in the spirit of ``resilience/faultinject.py``.
     :param scheduler_kwargs: forwarded to :class:`Scheduler`
         (``max_lanes``, ``segment_len``, ``fair_quantum``,
-        ``metrics``, ``compile_cache``, …).
+        ``metrics``, ``compile_cache``, ``trace_sample`` — the
+        distributed-tracing knob: spans from the HTTP front end, the
+        WAL fsync, the command queue and the scheduler lifecycle all
+        land in the scheduler journal as ``trace_span`` rows, …).
     """
 
     def __init__(self, root: str,
@@ -410,6 +415,17 @@ class EvolutionService:
             view.status = "recovered"
             self._cmds.put(("submit", job, str(problem)))
             replayed.append(tid)
+            # stitch the recovered job back onto its original trace:
+            # the request id in the WAL record derives the same
+            # trace id the pre-kill process used, and the replay
+            # span parents on the request's deterministic root span
+            # — one waterfall across the restart, no orphans
+            tr = self.scheduler.tracer
+            if tr is not None and job.request_id:
+                tr.emit("request.replay", 0.0,
+                        ctx=tr.context_for(job.request_id),
+                        phase="replay", always=True, tenant_id=tid,
+                        problem=str(problem))
         if state.records or state.tear_offset is not None:
             self.journal.event(
                 "wal_replay", records=len(state.records),
@@ -444,6 +460,44 @@ class EvolutionService:
     def _fire_fault(self, event: str, **ctx) -> None:
         if self.fault_plan is not None:
             self.fault_plan.fire(event, **ctx)
+
+    # ------------------------------------------------------- tracing ----
+
+    def trace_context(self, request_id: str,
+                      traceparent: Optional[str] = None):
+        """The request's :class:`~deap_tpu.telemetry.tracing.
+        TraceContext` (honouring an incoming ``traceparent`` header),
+        or ``None`` when the scheduler was built without
+        ``trace_sample``."""
+        tr = self.scheduler.tracer
+        if tr is None:
+            return None
+        return tr.context_for(request_id, traceparent)
+
+    def _tspan(self, name: str, **kw):
+        """A tracer span bound to the ambient request context — a
+        no-op context manager when tracing is off or the caller is
+        outside a traced request."""
+        tr = self.scheduler.tracer
+        if tr is None or tracing.current() is None:
+            return contextlib.nullcontext()
+        return tr.span(name, **kw)
+
+    def _result_payload(self, view: _JobView):
+        """``view.result_payload()`` with the first (cache-filling)
+        wire encode timed into the *submitting* request's trace — a
+        later poll pays the encode, so the span joins the trace that
+        owns the tenant, not the poll's."""
+        tr = self.scheduler.tracer
+        if tr is None or not view.request_id \
+                or view._encoded is not None or view._raw is None:
+            return view.result_payload()
+        t0 = time.perf_counter()
+        payload = view.result_payload()
+        tr.emit("wire.encode", time.perf_counter() - t0,
+                ctx=tr.context_for(view.request_id),
+                phase="wire_encode", tenant_id=view.tenant_id)
+        return payload
 
     # ----------------------------------------------------- lifecycle ----
 
@@ -598,10 +652,14 @@ class EvolutionService:
             _, job, problem = cmd
             self._apply_submit(job, problem)
         elif cmd[0] == "submit_many":
+            # 3-tuples are WAL-replay era commands with no enqueue
+            # stamp; fresh submits carry one for the cmd.queue span
+            t_enq = cmd[2] if len(cmd) > 2 else None
             for job, problem in cmd[1]:
-                self._apply_submit(job, problem)
+                self._apply_submit(job, problem, t_enq=t_enq)
 
-    def _apply_submit(self, job: Job, problem: str) -> None:
+    def _apply_submit(self, job: Job, problem: str,
+                      t_enq: Optional[float] = None) -> None:
         # admission is ASYNCHRONOUS: the front end already built the
         # Job (factories run on request threads — they must be
         # thread-safe pure constructors), ACKed, and registered the
@@ -611,6 +669,13 @@ class EvolutionService:
         tid = job.tenant_id
         with self._lock:
             view = self._views[tid]
+        # the command-queue handoff latency (front-end ACK → driver
+        # pickup) as a detail span — sampled, per tenant
+        tr = self.scheduler.tracer
+        if tr is not None and t_enq is not None and view.request_id:
+            tr.emit("cmd.queue", max(0.0, time.monotonic() - t_enq),
+                    ctx=tr.context_for(view.request_id),
+                    tenant_id=tid)
         # deadline admission control: an expired command is DROPPED
         # here — it never reaches the scheduler; the client's result
         # poll sees 504
@@ -763,8 +828,11 @@ class EvolutionService:
                 sched.request_spill(tid)
             except KeyError:
                 continue
+            t = sched.tenants.get(tid)
             self.journal.event("autoscale_decision", action="spill",
-                               tenant_id=tid)
+                               tenant_id=tid,
+                               **(sched._rid(t) if t is not None
+                                  else {}))
 
     def _background_prewarm(self, label: str, n_lanes: int) -> None:
         """Compile one (bucket, lane-count) lattice point off the
@@ -1040,14 +1108,16 @@ class EvolutionService:
                                       "admission")
 
         built = []   # (job, view, problem) for the genuinely-new specs
-        for s, hit, d in zip(specs, resolved, deadlines):
-            if hit is not None:
-                continue
-            job, view, problem = self._build_one(s, token, info)
-            view.request_id = request_id
-            view.deadline = d
-            view.idempotency_key = s.get("idempotency_key")
-            built.append((job, view, problem))
+        with self._tspan("submit.build", phase="build",
+                         n_jobs=n_new):
+            for s, hit, d in zip(specs, resolved, deadlines):
+                if hit is not None:
+                    continue
+                job, view, problem = self._build_one(s, token, info)
+                view.request_id = request_id
+                view.deadline = d
+                view.idempotency_key = s.get("idempotency_key")
+                built.append((job, view, problem))
         with self._lock:
             dup = []
             for i, (job, view, _) in enumerate(built):
@@ -1086,14 +1156,20 @@ class EvolutionService:
         # durability point: every accept record is fsync'd BEFORE the
         # ACK below — "the client heard yes" implies "a restart
         # replays it" (one fsync for the whole batch)
-        self._wal_accept_batch(fresh, token, request_id)
+        wal_cm = (self._tspan("wal.fsync", phase="wal_fsync",
+                              always=True, n_jobs=len(fresh))
+                  if self.wal is not None and fresh
+                  else contextlib.nullcontext())
+        with wal_cm:
+            self._wal_accept_batch(fresh, token, request_id)
         if fresh:
             # async admission: ACK now, the driver applies at its next
             # command pump — a request thread never waits out a segment
             try:
                 self._cmds.put_nowait(
                     ("submit_many",
-                     [(job, problem) for job, _, problem in fresh]))
+                     [(job, problem) for job, _, problem in fresh],
+                     time.monotonic()))
             except queue.Full:
                 # bounded command queue saturated: shed — the WAL
                 # records stand, so a retry (or restart) replays them;
@@ -1155,8 +1231,16 @@ class EvolutionService:
         token, info = self._auth(headers)
         if route == "/v1/jobs" and method == "POST":
             payload = json.loads(body or b"{}")
-            code, out = self._handle_submit(payload, token, info,
-                                            headers, request_id)
+            # the request's ROOT span: deterministic id derived from
+            # the request id, so post-restart replay spans can parent
+            # onto it without the original row (always on — the
+            # waterfall's spine). A client traceparent, if any, is
+            # already the ambient context and becomes its parent.
+            with self._tspan("request",
+                             span_id=tracing.root_span_id(request_id),
+                             always=True, route="/v1/jobs"):
+                code, out = self._handle_submit(payload, token, info,
+                                                headers, request_id)
             return code, "application/json", \
                 json.dumps(out).encode(), False
         if route == "/v1/drain" and method == "POST":
@@ -1182,7 +1266,7 @@ class EvolutionService:
             out = {}
             for v in views:
                 entry = v.as_dict()
-                payload = (v.result_payload()
+                payload = (self._result_payload(v)
                            if v.done.is_set() else None)
                 if payload is not None:
                     entry["result"] = payload
@@ -1211,7 +1295,7 @@ class EvolutionService:
                     return 202, "application/json", \
                         json.dumps(view.as_dict()).encode(), False
                 out = view.as_dict()
-                payload = view.result_payload()
+                payload = self._result_payload(view)
                 if payload is not None:
                     out["result"] = payload
                 return 200, "application/json", \
@@ -1302,35 +1386,45 @@ class _Handler(http.server.BaseHTTPRequestHandler):
 
     def _dispatch(self, method: str) -> None:
         rid = self.svc.next_request_id(self.headers)
+        # trace propagation: a client traceparent continues the
+        # client's trace; otherwise (with tracing on) the context
+        # derives deterministically from the request id. Echoed in the
+        # response so the client can correlate either way.
+        tctx = self.svc.trace_context(rid,
+                                      self.headers.get("traceparent"))
+        ids = {"X-Request-Id": rid}
+        if tctx is not None:
+            ids["traceparent"] = tctx.traceparent()
         try:
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else b""
             try:
-                code, ctype, payload, stream = self.svc.handle(
-                    method, self.path, self.headers, body, rid)
+                with tracing.use(tctx):
+                    code, ctype, payload, stream = self.svc.handle(
+                        method, self.path, self.headers, body, rid)
             except _HttpError as e:
                 if self._drop_check(self.path):
                     return
                 self._respond(e.code, "application/json", json.dumps(
                     {"error": e.message}).encode(),
-                    extra={"X-Request-Id": rid, **e.headers})
+                    extra={**ids, **e.headers})
                 return
             except json.JSONDecodeError as e:
                 self._respond(400, "application/json", json.dumps(
                     {"error": f"bad JSON body: {e}"}).encode(),
-                    extra={"X-Request-Id": rid})
+                    extra=ids)
                 return
             if self._drop_check(self.path):
                 return
             if not stream:
-                self._respond(code, ctype, payload,
-                              extra={"X-Request-Id": rid})
+                self._respond(code, ctype, payload, extra=ids)
                 return
             # NDJSON stream: no Content-Length; the connection closes
             # when the stream ends (HTTP/1.1 read-until-close)
             self.send_response(code)
             self.send_header("Content-Type", ctype)
-            self.send_header("X-Request-Id", rid)
+            for k, v in ids.items():
+                self.send_header(k, v)
             self.send_header("Connection", "close")
             self.end_headers()
 

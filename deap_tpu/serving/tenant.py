@@ -212,6 +212,12 @@ class Tenant:
                  "family": engine.family}
         m = {"tenant_id": self.id, "gen": self.gen,
              "ngen": int(self.job.ngen), **(meta or {})}
+        # the submitting request id rides the meta so checkpoint
+        # save/restore journal rows stamp it (request-path grep +
+        # the trace view's checkpoint spans)
+        rid = getattr(self.job, "request_id", None)
+        if rid and "request_id" not in m:
+            m["request_id"] = rid
         path = self.ckpt.save(self.gen, state, meta=m)
         self.has_checkpoint = True
         return path

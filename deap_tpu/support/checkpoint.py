@@ -32,6 +32,7 @@ import pickle
 import re
 import shutil
 import threading
+import time
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -208,9 +209,15 @@ def save_state(path: str, state: Any, meta: Optional[Dict[str, Any]] = None,
         os.fsync(f.fileno())
     os.replace(tmp, path)
     _fsync_dir(path)
-    # surface the write in any open run journal (no-op otherwise)
+    # surface the write in any open run journal (no-op otherwise);
+    # tenant-stamped metas also stamp the row with tenant/request id
+    # so one grep over the id finds the request's checkpoint writes
     from deap_tpu.telemetry.journal import broadcast
-    broadcast("checkpoint", path=path, bytes=os.path.getsize(path))
+    ids = {k: payload["meta"][k]
+           for k in ("tenant_id", "request_id")
+           if payload["meta"].get(k)}
+    broadcast("checkpoint", path=path, bytes=os.path.getsize(path),
+              **ids)
 
 
 def _load_payload(path: str) -> Any:
@@ -417,6 +424,7 @@ class Checkpointer:
         last_error: Optional[CheckpointCorruptError] = None
         for s in reversed(steps):
             path = self._path(s)
+            meta: Dict[str, Any] = {}
             try:
                 if tenant_id is not None:
                     meta = checkpoint_meta(path)
@@ -437,6 +445,15 @@ class Checkpointer:
             if s != steps[-1]:
                 broadcast("checkpoint_fallback", path=path, step=s,
                           skipped=[x for x in steps if x > s])
+            # the restore row, tenant/request-stamped when the file's
+            # meta carries the ids (read for free on the tenant-
+            # filtered path) — the read-side mirror of the
+            # ``checkpoint`` save row, so one grep over a request id
+            # shows both halves of every swap/resume
+            broadcast("checkpoint_restore", path=path, step=s,
+                      **{k: meta[k]
+                         for k in ("tenant_id", "request_id")
+                         if meta.get(k)})
             return s, state
         if tenant_id is not None and last_error is None:
             return None  # only foreign-tenant files present
@@ -515,12 +532,32 @@ class AsyncCheckpointWriter:
                     except Exception:
                         pass  # a prefetch hint only; np.asarray works
 
+        # capture the caller's trace context NOW — contextvars do not
+        # cross into the worker thread, and the flush span belongs to
+        # the request whose segment scheduled it
+        from deap_tpu.telemetry import tracing
+        trace_ctx = tracing.current()
+
         def work():
+            t0 = time.perf_counter()
             try:
                 snap = jax.tree_util.tree_unflatten(treedef, leaves)
                 self.last_path = ckpt.save(step, snap, meta=meta)
             except BaseException as e:  # surfaced at the next wait()
                 self._exc = e
+                return
+            if trace_ctx is not None:
+                from deap_tpu.telemetry.journal import broadcast
+                row = dict(name="checkpoint.flush", phase="checkpoint",
+                           dur_s=round(time.perf_counter() - t0, 6),
+                           trace_id=trace_ctx.trace_id,
+                           span_id=tracing.new_span_id(),
+                           parent_id=trace_ctx.span_id, step=int(step))
+                if trace_ctx.request_id is not None:
+                    row["request_id"] = trace_ctx.request_id
+                if meta and meta.get("tenant_id"):
+                    row["tenant_id"] = meta["tenant_id"]
+                broadcast("trace_span", **row)
 
         self._thread = threading.Thread(
             target=work, name="deap-tpu-ckpt-writer", daemon=True)

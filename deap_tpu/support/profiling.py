@@ -30,6 +30,7 @@ from __future__ import annotations
 import contextlib
 import functools
 import random
+import threading
 import time
 from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
@@ -74,6 +75,15 @@ class SpanRecorder:
     count/total/mean are exact regardless (never sampled). The
     replacement RNG is seeded per recorder (``seed``), so identical
     span streams aggregate identically.
+
+    Aggregation is thread-safe: the serving front end's request
+    threads and the autoscaler's background prewarm thread run
+    ``span(...)`` blocks concurrently with the driver, so
+    :meth:`record`'s read-modify-write of the count/total/max dicts
+    and the reservoir (whose algorithm-R branch is an index-then-
+    assign pair) runs under one lock; :meth:`aggregates` takes the
+    same lock so a mid-update snapshot can never pair a new count
+    with an old total.
     """
 
     def __init__(self, max_samples: int = 4096, seed: int = 0):
@@ -83,38 +93,41 @@ class SpanRecorder:
         self._total: Dict[str, float] = {}
         self._max: Dict[str, float] = {}
         self._rng = random.Random(seed)
+        self._lock = threading.Lock()
         self._prev: Optional["SpanRecorder"] = None
 
     def record(self, name: str, seconds: float) -> None:
-        n = self._count.get(name, 0) + 1
-        self._count[name] = n
-        self._total[name] = self._total.get(name, 0.0) + seconds
-        self._max[name] = max(self._max.get(name, seconds), seconds)
-        bucket = self._samples.setdefault(name, [])
-        if len(bucket) < self.max_samples:
-            bucket.append(seconds)
-        else:
-            # algorithm R: keep each of the n samples seen so far with
-            # equal probability max_samples / n
-            j = self._rng.randrange(n)
-            if j < self.max_samples:
-                bucket[j] = seconds
+        with self._lock:
+            n = self._count.get(name, 0) + 1
+            self._count[name] = n
+            self._total[name] = self._total.get(name, 0.0) + seconds
+            self._max[name] = max(self._max.get(name, seconds), seconds)
+            bucket = self._samples.setdefault(name, [])
+            if len(bucket) < self.max_samples:
+                bucket.append(seconds)
+            else:
+                # algorithm R: keep each of the n samples seen so far
+                # with equal probability max_samples / n
+                j = self._rng.randrange(n)
+                if j < self.max_samples:
+                    bucket[j] = seconds
 
     def aggregates(self) -> Dict[str, Dict[str, float]]:
         """``{name: {count, total_s, mean_s, p50_s, p99_s, max_s}}``."""
         out: Dict[str, Dict[str, float]] = {}
-        for name, n in self._count.items():
-            total = self._total[name]
-            samples = sorted(self._samples.get(name, ()))
-            agg = {"count": n, "total_s": total, "mean_s": total / n}
-            if samples:
-                m = len(samples)
-                agg["p50_s"] = samples[(m - 1) // 2]
-                agg["p99_s"] = samples[min(m - 1, int(0.99 * (m - 1)))]
-                # max is tracked exactly — the reservoir may have
-                # evicted the worst sample
-                agg["max_s"] = self._max[name]
-            out[name] = agg
+        with self._lock:
+            for name, n in self._count.items():
+                total = self._total[name]
+                samples = sorted(self._samples.get(name, ()))
+                agg = {"count": n, "total_s": total, "mean_s": total / n}
+                if samples:
+                    m = len(samples)
+                    agg["p50_s"] = samples[(m - 1) // 2]
+                    agg["p99_s"] = samples[min(m - 1, int(0.99 * (m - 1)))]
+                    # max is tracked exactly — the reservoir may have
+                    # evicted the worst sample
+                    agg["max_s"] = self._max[name]
+                out[name] = agg
         return out
 
     def __enter__(self) -> "SpanRecorder":
@@ -130,6 +143,19 @@ class SpanRecorder:
 # inside shard_map bodies during tracing, where thread-locals tied to
 # the caller would be invisible.
 _RECORDER: list = [None]
+
+# Lazily-resolved telemetry.tracing module — cached to keep span()'s
+# hot path one list-index when the bridge is active, and to avoid an
+# import cycle at module load (tracing is stdlib-only but lives in the
+# telemetry package).
+_TRACING: list = [None]
+
+
+def _tracing_mod():
+    if _TRACING[0] is None:
+        from deap_tpu.telemetry import tracing as _tr
+        _TRACING[0] = _tr
+    return _TRACING[0]
 
 
 def set_span_recorder(rec: Optional[SpanRecorder]) -> Optional[SpanRecorder]:
@@ -163,7 +189,14 @@ def span(name: str):
         with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
             yield
     finally:
-        rec.record(name, time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        rec.record(name, dt)
+        # bridge into the distributed-tracing plane: when the caller
+        # is inside a request's trace context, the recorded span also
+        # lands in the waterfall (sampled — these are detail spans)
+        tr = _tracing_mod()
+        if tr.current() is not None:
+            tr.emit_current(f"span:{name}", dt)
 
 
 def annotate(name: str) -> Callable:

@@ -52,6 +52,8 @@ import hashlib
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from deap_tpu.telemetry import tracing
+
 __all__ = ["ProgramObservatory", "instrument", "observatory",
            "profile_compiled"]
 
@@ -188,6 +190,17 @@ class ProgramObservatory:
         }
         profile.update(_cost_dict(compiled))
         profile.update(_memory_dict(compiled))
+        # compiles that happen while serving a traced request carry
+        # the trace/span ids, linking the HLO cost row into the
+        # request's waterfall — and the compile itself becomes an
+        # always-on span (a recompile on the hot path is exactly what
+        # a latency investigation needs to see)
+        ids = tracing.current_ids()
+        if ids:
+            profile.update(ids)
+            tracing.emit_current("compile", compile_s, phase="compile",
+                                 always=True, label=profile["label"],
+                                 hlo_hash=profile["hlo_hash"])
         self.profiles.append(profile)
         self._journal("program_profile", **profile)
         if self.on_profile is not None:

@@ -156,6 +156,23 @@ class RunJournal:
         # async checkpoint worker broadcasts checkpoint events): one
         # lock keeps lines whole
         self._write_lock = threading.Lock()
+        # opening "w" truncates: a restart over the same root (WAL
+        # replay after kill -9) must not destroy the previous journal
+        # — the pre-kill half of a request's trace lives there. Rotate
+        # a non-empty predecessor to `<path>.N` (next free integer);
+        # fixed-path readers still see the newest journal, and the
+        # trace assembler reads the rotated siblings to stitch one
+        # waterfall across the restart.
+        self.rotated_from: Optional[str] = None
+        try:
+            if os.path.getsize(self.path) > 0:
+                n = 1
+                while os.path.exists("%s.%d" % (self.path, n)):
+                    n += 1
+                self.rotated_from = "%s.%d" % (self.path, n)
+                os.replace(self.path, self.rotated_from)
+        except OSError:
+            pass
         self._fh = open(self.path, "w")
         self._steady: Optional[str] = None
         self.n_compiles = 0
@@ -321,4 +338,20 @@ def read_journal(path: str, strict: bool = False) -> JournalRows:
                 else:
                     out.tear_offset = offset
         offset += len(raw) + 1
+    return out
+
+
+def journal_generations(path: str) -> List[str]:
+    """All generations of a journal path, oldest first: the rotated
+    predecessors ``<path>.1``, ``<path>.2``, … (created by
+    :class:`RunJournal` when a restart reopened the same path), then
+    the live file itself. Only paths that exist are returned — the
+    common single-generation case yields ``[path]``."""
+    out: List[str] = []
+    n = 1
+    while os.path.exists("%s.%d" % (path, n)):
+        out.append("%s.%d" % (path, n))
+        n += 1
+    if os.path.exists(path):
+        out.append(path)
     return out

@@ -48,7 +48,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "MetricsServer", "get_registry", "metrics_text",
-           "serve_metrics"]
+           "phase_histogram", "serve_metrics"]
 
 #: default histogram bucket bounds (seconds) — spans sub-ms host work
 #: to multi-minute compiles; ``+Inf`` is implicit
@@ -374,6 +374,23 @@ def resolve_registry(spec) -> Optional[MetricsRegistry]:
         raise TypeError(f"metrics= expects a MetricsRegistry, True or "
                         f"None, got {type(spec).__name__}")
     return spec
+
+
+def phase_histogram(registry: Optional[MetricsRegistry] = None
+                    ) -> Histogram:
+    """Declare (or fetch) the per-phase request-latency histogram
+    ``deap_service_phase_seconds{phase=...}`` on ``registry`` (default:
+    the process registry). The tracing plane's metrics face: every
+    emitted span with a phase label observes here, generalizing the
+    autoscaler's queue-wait signal to all phases (see
+    ``telemetry/tracing.py`` ``PHASES`` for the label vocabulary)."""
+    reg = registry if registry is not None else get_registry()
+    return reg.histogram(
+        "deap_service_phase_seconds",
+        "Per-phase request latency from the tracing plane "
+        "(queue_wait, wal_fsync, admission, compile, device, "
+        "checkpoint, wire_encode, replay, build).",
+        labels=("phase",))
 
 
 def metrics_text(registry: Optional[MetricsRegistry] = None) -> str:

@@ -27,12 +27,13 @@ import os
 import sys
 from typing import Any, Dict, List, Optional
 
-__all__ = ["render_report", "sparkline", "main"]
+__all__ = ["render_report", "render_trace", "sparkline", "main"]
 
 _SPARK = "▁▂▃▄▅▆▇█"
 _MAX_SPARK = 48  # terminal budget per series
 
 _journal_mod = None
+_tracing_mod = None
 
 
 def _journal():
@@ -49,6 +50,25 @@ def _journal():
         spec.loader.exec_module(mod)
         _journal_mod = mod
     return _journal_mod
+
+
+def _tracing():
+    """tracing.py loaded standalone — same no-jax guarantee as
+    :func:`_journal` (tracing.py is pure stdlib)."""
+    global _tracing_mod
+    if _tracing_mod is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tracing.py")
+        spec = importlib.util.spec_from_file_location(
+            "_deap_tpu_tracing_standalone", path)
+        mod = importlib.util.module_from_spec(spec)
+        # dataclass processing resolves cls.__module__ through
+        # sys.modules — register before exec (stdlib-only, so this
+        # pulls nothing else in)
+        sys.modules[spec.name] = mod
+        spec.loader.exec_module(mod)
+        _tracing_mod = mod
+    return _tracing_mod
 
 
 def sparkline(values: List[float], width: int = _MAX_SPARK) -> str:
@@ -587,14 +607,174 @@ def render_report(path: str, lines: Optional[List[str]] = None) -> str:
     return "\n".join(out)
 
 
+# ------------------------------------------------- trace waterfall ----
+
+_BAR_WIDTH = 40  # terminal budget for the waterfall gutter
+
+
+def _trace_groups(path: str):
+    """All generations of the journal at ``path`` (rotated ``.N``
+    predecessors from kill-9 restarts, oldest first, then the live
+    file) parsed into ``(header_row_or_None, rows)`` pairs — the
+    shape :func:`tracing.assemble_trace` stitches across."""
+    jm = _journal()
+    groups = []
+    for p in jm.journal_generations(path):
+        rows = jm.read_journal(p, strict=False)
+        header = next((e for e in rows
+                       if e.get("kind") == "header"), None)
+        groups.append((header, rows))
+    return groups
+
+
+def _resolve_request_id(groups, ident: str) -> Optional[str]:
+    """``--trace`` accepts either a request id or a tenant id; tenant
+    ids resolve through the ``job_submitted``/``trace_span`` rows that
+    carry both."""
+    for _, rows in groups:
+        for e in rows:
+            if e.get("request_id") == ident:
+                return ident
+    for _, rows in groups:
+        for e in rows:
+            if (e.get("tenant_id") == ident and e.get("request_id")):
+                return str(e["request_id"])
+    return None
+
+
+def _waterfall(spans: List[Dict[str, Any]], out: List[str]) -> None:
+    lo = min(s["start"] for s in spans)
+    hi = max(s["end"] for s in spans)
+    total = max(hi - lo, 1e-9)
+    name_w = max(len(str(s.get("name", "?"))) for s in spans)
+    for s in spans:
+        a = int((s["start"] - lo) / total * _BAR_WIDTH)
+        b = int((s["end"] - lo) / total * _BAR_WIDTH)
+        b = max(b, a + 1)
+        bar = " " * a + "█" * (b - a) + " " * (_BAR_WIDTH - b)
+        extra = []
+        if s.get("phase"):
+            extra.append(str(s["phase"]))
+        if s.get("tenant_id"):
+            extra.append(f"tenant={s['tenant_id']}")
+        if s.get("hlo_hash"):
+            extra.append(f"hlo={str(s['hlo_hash'])[:8]}")
+        if s.get("gen") is not None:
+            extra.append(f"gen={s['gen']}")
+        if s.get("synthetic"):
+            extra.append("synthetic")
+        for link in s.get("links") or []:
+            if isinstance(link, dict) and link.get("xplane_dir"):
+                extra.append(f"xplane={link['xplane_dir']}")
+        out.append(
+            f"{str(s.get('name', '?')).ljust(name_w)} |{bar}| "
+            f"+{s['start'] - lo:8.3f}s {s.get('dur_s', 0.0):9.4f}s"
+            + (f"  ({', '.join(extra)})" if extra else ""))
+
+
+def render_trace(path: str, ident: str,
+                 perfetto_out: Optional[str] = None) -> str:
+    """The span waterfall for one request (or tenant) id, stitched
+    across every generation of the journal at ``path`` — the
+    ``report.py --trace`` view. With ``perfetto_out`` the assembled
+    spans are also written as Chrome/Perfetto trace-event JSON."""
+    tr = _tracing()
+    if os.path.isdir(path):
+        path = os.path.join(path, "journal.jsonl")
+    groups = _trace_groups(path)
+    out: List[str] = []
+    rid = _resolve_request_id(groups, ident)
+    if rid is None:
+        return (f"no journal row carries request or tenant id "
+                f"{ident!r} under {path} — was the service started "
+                "with trace_sample set?")
+    trace = tr.assemble_trace(groups, tr.trace_id_for(rid))
+    spans = trace["spans"]
+    if not spans:
+        return (f"request {rid}: no trace_span rows for trace "
+                f"{trace['trace_id']} — was trace_sample set?")
+
+    out.append(f"# Trace {trace['trace_id']}")
+    out.append("")
+    out.append(f"- request id: {rid}")
+    if ident != rid:
+        out.append(f"- resolved from tenant id: {ident}")
+    if len(groups) > 1:
+        out.append(f"- stitched across {len(groups)} journal "
+                   "generation(s) (restart/rotation)")
+    lo = min(s["start"] for s in spans)
+    hi = max(s["end"] for s in spans)
+    out.append(f"- {len(spans)} span(s), {hi - lo:.3f}s end to end")
+    if trace["orphans"]:
+        out.append(f"- ▲ {len(trace['orphans'])} orphan span(s) "
+                   "(parent row missing — lost journal generation?)")
+    out.append("")
+    out.append("## Waterfall")
+    out.append("")
+    _waterfall(spans, out)
+
+    # per-phase latency decomposition: where the request's wall time
+    # actually went (phases overlap the root span, so the column sums
+    # against the end-to-end wall, not to it)
+    phases: Dict[str, List[float]] = {}
+    for s in spans:
+        if s.get("phase"):
+            phases.setdefault(str(s["phase"]), []).append(
+                float(s.get("dur_s", 0.0) or 0.0))
+    if phases:
+        out.append("")
+        out.append("## Phase latency")
+        out.append("")
+        out.append("| phase | spans | total s | % of wall |")
+        out.append("|---|---|---|---|")
+        order = list(getattr(tr, "PHASES", ())) + sorted(
+            k for k in phases if k not in getattr(tr, "PHASES", ()))
+        wall = max(hi - lo, 1e-9)
+        for ph in order:
+            if ph not in phases:
+                continue
+            tot = sum(phases[ph])
+            out.append(f"| {ph} | {len(phases[ph])} | {tot:.4f} | "
+                       f"{100.0 * tot / wall:.1f}% |")
+
+    if perfetto_out:
+        tr.write_perfetto(perfetto_out, spans)
+        out.append("")
+        out.append(f"- perfetto export: {perfetto_out} "
+                   "(open at ui.perfetto.dev)")
+    return "\n".join(out)
+
+
 def main(argv=None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
+    argv = list(sys.argv[1:] if argv is None else argv)
+    trace_id = perfetto = None
+    if "--trace" in argv:
+        i = argv.index("--trace")
+        if i + 1 >= len(argv):
+            print("usage: report.py --trace <request-id|tenant-id> "
+                  "[--perfetto out.json] <journal.jsonl|run-dir>",
+                  file=sys.stderr)
+            return 2
+        trace_id = argv[i + 1]
+        del argv[i:i + 2]
+    if "--perfetto" in argv:
+        i = argv.index("--perfetto")
+        if i + 1 >= len(argv):
+            print("--perfetto needs an output path", file=sys.stderr)
+            return 2
+        perfetto = argv[i + 1]
+        del argv[i:i + 2]
     paths = [a for a in argv if not a.startswith("-")]
     if not paths:
-        print("usage: report.py <journal.jsonl> [...]", file=sys.stderr)
+        print("usage: report.py [--trace <request-id|tenant-id> "
+              "[--perfetto out.json]] <journal.jsonl> [...]",
+              file=sys.stderr)
         return 2
     for p in paths:
-        print(render_report(p))
+        if trace_id is not None:
+            print(render_trace(p, trace_id, perfetto_out=perfetto))
+        else:
+            print(render_report(p))
     return 0
 
 

@@ -237,3 +237,40 @@ def test_device_memory_snapshot(tmp_path):
         assert os.path.getsize(path) == snap["profile_bytes"] > 0
     else:
         assert "profile_error" in snap
+
+
+def test_span_recorder_thread_safe_under_hammer():
+    """Concurrent request threads all record into one installed
+    recorder (the service's profile of use) — counts must be exact
+    and aggregation must not tear while recording continues."""
+    import threading
+
+    N_THREADS, N_SPANS = 8, 300
+    with SpanRecorder(max_samples=128) as rec:
+        stop = threading.Event()
+
+        def reader():
+            # aggregate concurrently with recording: must never raise
+            # (RuntimeError: dict changed size) nor see torn stats
+            while not stop.is_set():
+                for stats in rec.aggregates().values():
+                    assert stats["count"] >= 1
+
+        def writer(i):
+            for k in range(N_SPANS):
+                with span(f"hammer/{i}"):
+                    pass
+
+        threads = [threading.Thread(target=writer, args=(i,))
+                   for i in range(N_THREADS)]
+        rd = threading.Thread(target=reader)
+        rd.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stop.set()
+        rd.join()
+    agg = rec.aggregates()
+    for i in range(N_THREADS):
+        assert agg[f"hammer/{i}"]["count"] == N_SPANS

@@ -510,3 +510,79 @@ def test_restart_resume_falls_back_past_corrupt_checkpoint(tmp_path):
     kinds = {r.get("kind") for r in rows}
     assert "wal_replay" in kinds
     assert {"checkpoint_corrupt", "checkpoint_fallback"} & kinds
+
+
+@pytest.mark.chaos
+def test_kill9_trace_stitches_across_restart(tmp_path):
+    """Trace continuity through kill -9: the restarted service rotates
+    the journal, WAL replay re-derives every trace id from the
+    persisted request id, and a killed-then-resumed tenant's spans
+    assemble into ONE trace across both journal generations — the
+    resume span parented on the (deterministic) root, no orphans."""
+    from deap_tpu.serving import chaos
+    from deap_tpu.telemetry import tracing
+    from deap_tpu.telemetry.journal import journal_generations
+
+    root = str(tmp_path / "svc")
+    out = chaos.run_chaos(root, n_tenants=8, kill_at_step=3,
+                          segment_len=2, max_lanes=8, clients=4,
+                          converge_timeout_s=420, trace_sample=1.0)
+    assert out["kill_rc"] == -9, out
+    assert out["lost"] == [], out
+
+    path = os.path.join(root, "journal.jsonl")
+    gens = journal_generations(path)
+    assert len(gens) >= 2, gens       # pre-kill + post-restart
+    groups, per_gen_spans = [], []
+    for p in gens:
+        rows = read_journal(p, strict=False)
+        hdr = next((r for r in rows if r.get("kind") == "header"),
+                   None)
+        groups.append((hdr, rows))
+        per_gen_spans.append([r for r in rows
+                              if r.get("kind") == "trace_span"])
+
+    # a tenant the restart replayed out of the WAL, whose spans exist
+    # in BOTH generations (killed mid-flight, then resumed)
+    replay_rows = [r for _, rows in groups for r in rows
+                   if r.get("kind") == "wal_replay"]
+    replayed = {t for r in replay_rows for t in r.get("replayed", [])}
+    assert replayed
+    pre = {s.get("tenant_id") for s in per_gen_spans[0]}
+    post = {s.get("tenant_id") for s in per_gen_spans[-1]}
+    both = sorted((replayed & pre & post) - {None})
+    assert both, (replayed, pre, post)
+    tid = both[0]
+
+    # every row of that tenant carries the one WAL-persisted request
+    # id → the one deterministic trace id
+    rids = {s["request_id"] for g in per_gen_spans for s in g
+            if s.get("tenant_id") == tid and s.get("request_id")}
+    assert len(rids) == 1, rids
+    rid = rids.pop()
+    trace_id = tracing.trace_id_for(rid)
+    tenant_traces = {s["trace_id"] for g in per_gen_spans for s in g
+                     if s.get("tenant_id") == tid}
+    assert tenant_traces == {trace_id}
+
+    # the restarted journal carries the replay span, parented on the
+    # deterministic root span id — no row from the old process needed
+    replays = [s for s in per_gen_spans[-1]
+               if s["name"] == "request.replay"
+               and s.get("trace_id") == trace_id]
+    assert replays
+    assert replays[0]["parent_id"] == tracing.root_span_id(rid)
+
+    # assembled across generations: one waterfall, no orphan spans,
+    # spans from both sides of the kill
+    trace = tracing.assemble_trace(groups, trace_id)
+    assert trace["orphans"] == []
+    names = {s["name"] for s in trace["spans"]}
+    assert "request.replay" in names
+    assert "segment" in names
+    n_pre = sum(1 for s in per_gen_spans[0]
+                if s.get("trace_id") == trace_id)
+    n_post = sum(1 for s in per_gen_spans[-1]
+                 if s.get("trace_id") == trace_id)
+    assert n_pre >= 1 and n_post >= 1
+    assert len(trace["spans"]) >= n_pre + n_post

@@ -1,0 +1,475 @@
+"""Distributed tracing plane — ids, propagation, stitching, rendering.
+
+The acceptance bar of ``deap_tpu/telemetry/tracing.py``: one
+``trace_id`` threads a request from the client socket to the device
+program, every id derives deterministically from the request id (the
+cross-restart stitching mechanism — no coordination, no propagation
+state), a torn journal tail can never split a trace in two, and
+``report.py --trace`` renders the waterfall without jax in the
+process. The service end-to-end test drives a real loopback socket
+and asserts the span spine (queue wait → WAL fsync → admission →
+compile → segments → checkpoint → wire encode) lands in the journal
+with one trace id and a resolvable parent chain.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from deap_tpu.telemetry import tracing
+from deap_tpu.telemetry.journal import (RunJournal, broadcast,
+                                        journal_generations,
+                                        read_journal)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPORT = os.path.join(REPO, "deap_tpu", "telemetry", "report.py")
+
+
+# ------------------------------------------------------------- ids ----
+
+def test_deterministic_ids_stable():
+    assert tracing.trace_id_for("req-1") == tracing.trace_id_for("req-1")
+    assert tracing.trace_id_for("req-1") != tracing.trace_id_for("req-2")
+    assert len(tracing.trace_id_for("req-1")) == 32
+    assert len(tracing.span_id_for("req-1", "request")) == 16
+    assert (tracing.root_span_id("req-1")
+            == tracing.span_id_for("req-1", "request"))
+    assert (tracing.span_id_for("req-1", "client")
+            != tracing.span_id_for("req-1", "request"))
+    assert len(tracing.new_span_id()) == 16
+    assert tracing.new_span_id() != tracing.new_span_id()
+
+
+def test_traceparent_roundtrip_and_malformed():
+    tid = tracing.trace_id_for("req-7")
+    sid = tracing.span_id_for("req-7", "client")
+    hdr = tracing.format_traceparent(tid, sid, sampled=True)
+    assert tracing.parse_traceparent(hdr) == (tid, sid, True)
+    hdr0 = tracing.format_traceparent(tid, sid, sampled=False)
+    assert tracing.parse_traceparent(hdr0) == (tid, sid, False)
+    # malformed / absent / all-zero (W3C: invalid) all parse to None
+    for bad in (None, "", "garbage", "00-xyz-abc-01",
+                f"00-{'0' * 32}-{sid}-01", f"00-{tid}-{'0' * 16}-01",
+                hdr + "-extra"):
+        assert tracing.parse_traceparent(bad) is None
+
+
+def test_sampling_deterministic_and_bounded():
+    tr = tracing.Tracer(sample=0.5)
+    ids = [tracing.trace_id_for(f"req-{i}") for i in range(400)]
+    first = [tr.sampled(t) for t in ids]
+    assert first == [tr.sampled(t) for t in ids]  # deterministic
+    rate = sum(first) / len(first)
+    assert 0.35 < rate < 0.65
+    assert all(tracing.Tracer(sample=1.0).sampled(t) for t in ids)
+    assert not any(tracing.Tracer(sample=0.0).sampled(t) for t in ids)
+
+
+def test_context_for_honours_traceparent():
+    tr = tracing.Tracer(sample=1.0)
+    # no header: both ids derive from the request id
+    ctx = tr.context_for("req-9")
+    assert ctx.trace_id == tracing.trace_id_for("req-9")
+    assert ctx.span_id == tracing.root_span_id("req-9")
+    # a valid header wins — its trace continues, its span parents
+    hdr = tracing.format_traceparent("ab" * 16, "cd" * 8)
+    ctx2 = tr.context_for("req-9", hdr)
+    assert ctx2.trace_id == "ab" * 16
+    assert ctx2.span_id == "cd" * 8
+    # a malformed header falls back to derivation
+    ctx3 = tr.context_for("req-9", "not-a-traceparent")
+    assert ctx3.trace_id == ctx.trace_id
+
+
+def test_ambient_context_use_and_ids():
+    assert tracing.current() is None
+    assert tracing.current_ids() == {}
+    ctx = tracing.TraceContext("aa" * 16, "bb" * 8, request_id="r1")
+    with tracing.use(ctx):
+        assert tracing.current() is ctx
+        ids = tracing.current_ids()
+        assert ids == {"trace_id": "aa" * 16, "span_id": "bb" * 8,
+                       "request_id": "r1"}
+        child = ctx.child()
+        assert child.trace_id == ctx.trace_id
+        assert child.span_id != ctx.span_id
+    assert tracing.current() is None
+    with tracing.use(None):          # None is a no-op
+        assert tracing.current() is None
+
+
+# -------------------------------------------------------- emission ----
+
+class _Sink:
+    def __init__(self):
+        self.rows = []
+
+    def event(self, kind, **payload):
+        self.rows.append(dict(kind=kind, **payload))
+
+
+def test_tracer_emit_nulls_self_parent_and_observes_phase():
+    sink = _Sink()
+    seen = []
+    tr = tracing.Tracer(journal=sink, sample=1.0,
+                        phase_observe=lambda ph, s: seen.append(ph))
+    ctx = tr.context_for("req-3")
+    # the root span's id IS the ambient span id — parent must null
+    tr.emit("request", 0.5, ctx=ctx,
+            span_id=tracing.root_span_id("req-3"), always=True)
+    tr.emit("wal.fsync", 0.01, ctx=ctx, phase="wal_fsync", always=True)
+    root, child = sink.rows
+    assert root["parent_id"] is None
+    assert child["parent_id"] == tracing.root_span_id("req-3")
+    assert child["request_id"] == "req-3"
+    assert seen == ["wal_fsync"]
+
+
+def test_tracer_span_installs_child_context():
+    sink = _Sink()
+    tr = tracing.Tracer(journal=sink, sample=1.0)
+    ctx = tr.context_for("req-4")
+    with tracing.use(ctx):
+        with tr.span("outer", always=True) as child:
+            assert tracing.current() is child
+            tr.emit("inner", 0.001, always=True)
+    inner, outer = sink.rows
+    assert inner["parent_id"] == outer["span_id"]
+    assert outer["parent_id"] == ctx.span_id is not None
+    assert {inner["trace_id"], outer["trace_id"]} == {ctx.trace_id}
+
+
+def test_sampled_out_trace_keeps_lifecycle_spans_only():
+    sink = _Sink()
+    tr = tracing.Tracer(journal=sink, sample=0.0)
+    ctx = tr.context_for("req-5")
+    assert ctx.sampled is False
+    tr.emit("detail", 0.1, ctx=ctx)                 # dropped
+    tr.emit("queue.wait", 0.1, ctx=ctx, always=True)  # lifecycle
+    assert [r["name"] for r in sink.rows] == ["queue.wait"]
+
+
+def test_emit_current_honours_ambient_and_sampling(tmp_path):
+    j = RunJournal(str(tmp_path / "j.jsonl"))
+    try:
+        tracing.emit_current("nothing", 0.1)   # no ambient ctx: no row
+        ctx = tracing.TraceContext("aa" * 16, "bb" * 8,
+                                   request_id="r", sampled=False)
+        with tracing.use(ctx):
+            tracing.emit_current("detail", 0.1)           # sampled out
+            tracing.emit_current("spine", 0.1, always=True)
+    finally:
+        j.close()
+    rows = [r for r in read_journal(str(tmp_path / "j.jsonl"))
+            if r.get("kind") == "trace_span"]
+    assert [r["name"] for r in rows] == ["spine"]
+    assert rows[0]["parent_id"] == "bb" * 8
+
+
+# ------------------------------------------- rotation + stitching ----
+
+def test_journal_rotation_preserves_generations(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    j1 = RunJournal(path)
+    j1.event("trace_span", name="before", trace_id="t" * 32,
+             span_id="a" * 16, parent_id=None, dur_s=0.1)
+    j1.close()
+    j2 = RunJournal(path)   # same path: the restart case
+    assert j2.rotated_from == path + ".1"
+    j2.event("trace_span", name="after", trace_id="t" * 32,
+             span_id="b" * 16, parent_id="a" * 16, dur_s=0.1)
+    j2.close()
+    gens = journal_generations(path)
+    assert gens == [path + ".1", path]
+    names = [r["name"] for p in gens for r in read_journal(p)
+             if r.get("kind") == "trace_span"]
+    assert names == ["before", "after"]
+
+
+def _groups(path):
+    out = []
+    for p in journal_generations(path):
+        rows = read_journal(p, strict=False)
+        hdr = next((r for r in rows if r.get("kind") == "header"), None)
+        out.append((hdr, rows))
+    return out
+
+
+def test_assemble_trace_rebases_across_generations():
+    rid = "req-x"
+    tid = tracing.trace_id_for(rid)
+    root = tracing.root_span_id(rid)
+    g1 = ({"kind": "header", "wall_start": 100.0},
+          [{"kind": "trace_span", "name": "request", "t": 5.0,
+            "dur_s": 5.0, "trace_id": tid, "span_id": root,
+            "parent_id": None, "request_id": rid}])
+    g2 = ({"kind": "header", "wall_start": 110.0},
+          [{"kind": "trace_span", "name": "request.replay", "t": 1.0,
+            "dur_s": 0.0, "trace_id": tid,
+            "span_id": "c" * 16, "parent_id": root,
+            "request_id": rid},
+           {"kind": "other", "t": 2.0}])
+    trace = tracing.assemble_trace([g1, g2], tid)
+    assert [s["name"] for s in trace["spans"]] == ["request",
+                                                   "request.replay"]
+    # rebased onto one wall axis: 100+5-5=100, then 110+1
+    assert trace["spans"][0]["start"] == pytest.approx(100.0)
+    assert trace["spans"][1]["start"] == pytest.approx(111.0)
+    assert trace["orphans"] == []
+    assert trace["root"]["name"] == "request"
+
+
+def test_assemble_trace_synthesizes_lost_root_and_flags_orphans():
+    rid = "req-y"
+    tid = tracing.trace_id_for(rid)
+    rows = [{"kind": "trace_span", "name": "segment", "t": 2.0,
+             "dur_s": 1.0, "trace_id": tid, "span_id": "d" * 16,
+             "parent_id": tracing.root_span_id(rid),
+             "request_id": rid},
+            {"kind": "trace_span", "name": "stray", "t": 3.0,
+             "dur_s": 0.5, "trace_id": tid, "span_id": "e" * 16,
+             "parent_id": "f" * 16, "request_id": rid}]
+    trace = tracing.assemble_trace([(None, rows)], tid)
+    root = trace["root"]
+    assert root["synthetic"] is True
+    assert root["span_id"] == tracing.root_span_id(rid)
+    # the segment span parents onto the synthesized root; the stray's
+    # parent resolves nowhere
+    assert trace["orphans"] == ["e" * 16]
+
+
+def test_torn_tail_never_splits_a_trace(tmp_path):
+    """kill -9 mid-write: read_journal(strict=False) drops the torn
+    last line; every surviving span still carries the one
+    deterministic trace id (satellite: trace continuity)."""
+    path = str(tmp_path / "journal.jsonl")
+    j = RunJournal(path)
+    tr = tracing.Tracer(journal=j, sample=1.0)
+    ctx = tr.context_for("req-torn")
+    for i in range(5):
+        tr.emit(f"segment", 0.1, ctx=ctx, phase="device",
+                always=True, gen=i)
+    j.close()
+    with open(path, "ab") as fh:          # torn tail: half a row
+        fh.write(b'{"kind": "trace_span", "name": "half", "trace')
+    rows = read_journal(path, strict=False)
+    assert rows.tear_offset is not None
+    spans = [r for r in rows if r.get("kind") == "trace_span"]
+    assert len(spans) == 5
+    assert {s["trace_id"] for s in spans} \
+        == {tracing.trace_id_for("req-torn")}
+    trace = tracing.assemble_trace(
+        [(None, rows)], tracing.trace_id_for("req-torn"))
+    assert len(trace["spans"]) == 6       # 5 + synthesized root
+    assert trace["orphans"] == []
+
+
+# -------------------------------------------------------- perfetto ----
+
+def test_perfetto_events_shapes(tmp_path):
+    spans = [{"kind": "trace_span", "name": "segment", "start": 1.0,
+              "end": 1.5, "dur_s": 0.5, "trace_id": "t" * 32,
+              "span_id": "a" * 16, "parent_id": None,
+              "tenant_id": "t0", "t": 1.5},
+             {"kind": "trace_span", "name": "finished", "start": 1.5,
+              "end": 1.5, "dur_s": 0.0, "trace_id": "t" * 32,
+              "span_id": "b" * 16, "parent_id": "a" * 16, "t": 1.5}]
+    ev = tracing.perfetto_events(spans)
+    assert ev[0]["ph"] == "X" and ev[0]["dur"] == pytest.approx(5e5)
+    assert ev[0]["ts"] == pytest.approx(1e6)
+    assert ev[0]["tid"] == "t0"
+    assert ev[1]["ph"] == "i"             # zero-duration → instant
+    out = str(tmp_path / "trace.json")
+    tracing.write_perfetto(out, spans)
+    payload = json.load(open(out))
+    assert len(payload["traceEvents"]) == 2
+
+
+# ---------------------------------------- report.py --trace, no jax ----
+
+def _make_traced_journal(root):
+    """A handcrafted service-shaped journal with one request's spans."""
+    path = os.path.join(root, "journal.jsonl")
+    j = RunJournal(path)
+    tr = tracing.Tracer(journal=j, sample=1.0)
+    rid = "req-cl-abc-1"
+    ctx = tr.context_for(rid)
+    j.event("job_submitted", tenant_id="t0", family="ea_simple",
+            request_id=rid)
+    tr.emit("request", 0.9, ctx=ctx,
+            span_id=tracing.root_span_id(rid), always=True)
+    for name, phase, dur in (("queue.wait", "queue_wait", 0.01),
+                             ("wal.fsync", "wal_fsync", 0.002),
+                             ("admit.pack", "admission", 0.2),
+                             ("compile", "compile", 0.4),
+                             ("segment", "device", 0.3),
+                             ("checkpoint", "checkpoint", 0.005),
+                             ("wire.encode", "wire_encode", 0.001)):
+        tr.emit(name, dur, ctx=ctx, phase=phase, always=True,
+                tenant_id="t0")
+    j.close()
+    return path, rid
+
+
+def test_report_trace_renders_waterfall_without_jax(tmp_path):
+    """report.py --trace in a clean subprocess: the waterfall and the
+    per-phase table render, tenant-id resolution works, the Perfetto
+    export writes — and jax never enters sys.modules (the report's
+    laptop/CI triage guarantee extends to the new path)."""
+    path, rid = _make_traced_journal(str(tmp_path))
+    perfetto = str(tmp_path / "out.json")
+    code = (
+        "import sys, runpy\n"
+        f"sys.argv = ['report.py', '--trace', 't0', "
+        f"'--perfetto', {perfetto!r}, {path!r}]\n"
+        f"runpy.run_path({REPORT!r}, run_name='__main__')\n"
+        "assert 'jax' not in sys.modules, 'trace report imported jax'\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    out = r.stdout
+    assert tracing.trace_id_for(rid) in out
+    assert f"request id: {rid}" in out
+    assert "resolved from tenant id: t0" in out
+    for name in ("queue.wait", "wal.fsync", "admit.pack", "compile",
+                 "segment", "checkpoint", "wire.encode"):
+        assert name in out
+    assert "Phase latency" in out and "queue_wait" in out
+    assert len(json.load(open(perfetto))["traceEvents"]) == 8
+
+
+def test_report_trace_unknown_id_degrades_gracefully(tmp_path):
+    path, _ = _make_traced_journal(str(tmp_path))
+    from deap_tpu.telemetry.report import render_trace
+    msg = render_trace(path, "no-such-id")
+    assert "no journal row" in msg
+
+
+# ------------------------------------- checkpoint row stamping ----
+
+def test_checkpoint_rows_stamp_request_and_tenant_ids(tmp_path):
+    """checkpoint saves broadcast with request_id/tenant_id, and a
+    successful restore broadcasts a ``checkpoint_restore`` row with
+    the same stamps (the formerly-unstamped journal rows)."""
+    from deap_tpu.support.checkpoint import Checkpointer
+    j = RunJournal(str(tmp_path / "j.jsonl"))
+    try:
+        ck = Checkpointer(str(tmp_path / "ck"), keep=2)
+        ck.save(3, {"x": 1},
+                meta={"tenant_id": "t9", "request_id": "req-cl-z-1"})
+        got = ck.restore_latest(tenant_id="t9")
+        assert got is not None and got[0] == 3
+    finally:
+        j.close()
+    rows = read_journal(str(tmp_path / "j.jsonl"))
+    save = next(r for r in rows if r.get("kind") == "checkpoint")
+    assert save["tenant_id"] == "t9"
+    assert save["request_id"] == "req-cl-z-1"
+    restore = next(r for r in rows
+                   if r.get("kind") == "checkpoint_restore")
+    assert restore["tenant_id"] == "t9"
+    assert restore["request_id"] == "req-cl-z-1"
+    assert restore["step"] == 3
+
+
+# ----------------------------------------------- service end-to-end ----
+
+@pytest.mark.slow
+def test_service_end_to_end_trace(tmp_path):
+    """One job over a real loopback socket with ``trace_sample=1.0``:
+    the full span spine lands in the journal under one trace id
+    derived from the client's request id, parents resolve, the
+    compile span links its ``program_profile`` HLO hash, and the
+    per-phase histogram exports on the metrics registry."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_service import PROBLEMS
+
+    from deap_tpu.serving import EvolutionService, ServiceClient
+    from deap_tpu.telemetry.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    with EvolutionService(str(tmp_path), PROBLEMS, max_lanes=2,
+                          segment_len=2, trace_sample=1.0,
+                          metrics=reg) as svc:
+        with ServiceClient(svc.url) as c:
+            tid = c.submit("onemax", {"seed": 3, "ngen": 6},
+                           tenant_id="t0")
+            res = c.result(tid, wait=True, timeout=120)
+            assert res["status"] == "finished"
+
+    rows = read_journal(os.path.join(str(tmp_path), "journal.jsonl"),
+                        strict=False)
+    spans = [r for r in rows if r.get("kind") == "trace_span"]
+    names = {s["name"] for s in spans}
+    assert {"request", "submit.build", "wal.fsync", "queue.wait",
+            "admit.pack", "compile", "segment", "checkpoint",
+            "finished", "wire.encode"} <= names
+
+    # one trace, derived from the client's generated request id
+    rid = next(s["request_id"] for s in spans if s.get("request_id"))
+    assert rid.startswith("req-cl-")
+    assert {s["trace_id"] for s in spans} \
+        == {tracing.trace_id_for(rid)}
+
+    # the parent chain resolves — no orphans, root is the HTTP request
+    hdr = next(r for r in rows if r.get("kind") == "header")
+    trace = tracing.assemble_trace([(hdr, rows)],
+                                   tracing.trace_id_for(rid))
+    assert trace["orphans"] == []
+    assert trace["root"]["name"] == "request"
+    assert not trace["root"].get("synthetic")
+
+    # compile spans link the observatory's HLO hash both ways
+    compile_span = next(s for s in spans if s["name"] == "compile")
+    profiles = [r for r in rows if r.get("kind") == "program_profile"]
+    assert profiles and all(p.get("trace_id") for p in profiles)
+    assert compile_span["hlo_hash"] in {p["hlo_hash"] for p in profiles}
+
+    # phase histogram exported
+    text = reg.metrics_text()
+    assert "deap_service_phase_seconds" in text
+    assert 'phase="device"' in text
+
+
+@pytest.mark.slow
+def test_autoscale_spill_decision_stamps_request_id(tmp_path):
+    """An autoscaler spill that targets a tenant journals the
+    submitting request id (the formerly-unstamped
+    ``autoscale_decision`` row)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_service import PROBLEMS
+
+    from deap_tpu.serving import EvolutionService, ServiceClient
+    from deap_tpu.serving.autoscale import AutoscaleDecision
+
+    class SpillT0:
+        def __init__(self):
+            self.fired = False
+
+        def decide(self, snap):
+            if self.fired:
+                return AutoscaleDecision()
+            self.fired = True
+            return AutoscaleDecision(spill=["t0"])
+
+    with EvolutionService(str(tmp_path), PROBLEMS, max_lanes=2,
+                          segment_len=2, trace_sample=1.0,
+                          autoscale=SpillT0(),
+                          autoscale_every=1) as svc:
+        with ServiceClient(svc.url) as c:
+            c.submit("onemax", {"seed": 5, "ngen": 8}, tenant_id="t0")
+            res = c.result("t0", wait=True, timeout=120)
+            assert res["status"] == "finished"
+
+    rows = read_journal(os.path.join(str(tmp_path), "journal.jsonl"),
+                        strict=False)
+    spills = [r for r in rows if r.get("kind") == "autoscale_decision"
+              and r.get("action") == "spill"]
+    assert spills
+    assert all(str(s.get("request_id", "")).startswith("req-cl-")
+               for s in spills)
