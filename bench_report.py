@@ -777,6 +777,81 @@ def tracing_tripwire(threshold: float = TRACING_OVERHEAD_THRESHOLD) -> int:
     return tripped
 
 
+TUNING_WINNER_THRESHOLD_X = 0.95
+TUNING_WARM_THRESHOLD_PCT = 1.0
+
+
+def tuning_tripwire() -> int:
+    """The dispatch-tuner gate (ISSUE 16), over the latest committed
+    BENCH_TUNING*.json: (1) per probed knob, the tuned winner must be
+    within 5% of the fastest static candidate (``value`` =
+    fastest/winner >= 0.95 — 1.0 on a fresh probe by construction;
+    the gate guards replayed or hand-edited caches) AND the probe's
+    identity check must have passed (``bitwise``, or ``tolerance``
+    for the eigh pair) — a fast-but-wrong winner is a correctness
+    bug, not a perf win; (2) the warm-cache amortisation row: a fresh
+    session's resolves of every probed key must cost <= 1% of one
+    headline GP run. Knob rows without timings (cache/env-only knobs
+    that did not probe) are exempt from (1). Returns the number of
+    tripped rows."""
+    files = sorted(glob.glob(os.path.join(HERE, "BENCH_TUNING*.json")))
+    if not files:
+        print("tuning tripwire: no committed BENCH_TUNING*.json yet")
+        return 0
+    rows = _bench_rows(files[-1])
+    tripped = 0
+    print(f"\n## Dispatch tuning ({os.path.basename(files[-1])})\n")
+    probe_rows = {m: r for m, r in rows.items()
+                  if m.startswith("tuning_") and m.endswith("_probe")}
+    if not probe_rows:
+        print("- no tuning_*_probe rows (the probe sweep is part of "
+              "the acceptance)")
+        tripped += 1
+    for metric, row in sorted(probe_rows.items()):
+        knob = metric[len("tuning_"):-len("_probe")]
+        val = row.get("value")
+        winner = row.get("winner")
+        identity = row.get("identity")
+        if not row.get("timings"):
+            print(f"- {knob}: winner {winner!r} (no probe timings — "
+                  "cache/env rung, exempt)")
+            continue
+        ok_speed = (isinstance(val, (int, float))
+                    and val >= TUNING_WINNER_THRESHOLD_X)
+        ok_ident = identity in ("bitwise", "tolerance")
+        note = ""
+        spd = row.get("speedup_vs_default_x")
+        if isinstance(spd, (int, float)) and spd > 1.0:
+            note = f", {spd}x over the static default"
+        print(f"- {knob}: winner {winner!r} at {val}x of fastest "
+              f"static, identity {identity!r}{note} "
+              + ("ok" if ok_speed and ok_ident else
+                 "**REGRESSION** ("
+                 + ("slower than a static candidate it had measured"
+                    if not ok_speed else
+                    "identity check did not pass — the winner is "
+                    "not trusted") + ")"))
+        tripped += 0 if (ok_speed and ok_ident) else 1
+    warm = rows.get("tuning_warm_overhead_pct")
+    if warm is None:
+        print("- tuning_warm_overhead_pct row missing (the "
+              "amortisation half is part of the acceptance)")
+        tripped += 1
+    elif isinstance(warm.get("value"), (int, float)):
+        ok = warm["value"] <= TUNING_WARM_THRESHOLD_PCT
+        print(f"- warm-cache resolves: {warm.get('warm_resolve_s')}s "
+              f"for {warm.get('n_keys', '?')} keys = "
+              f"{warm['value']}% of one {warm.get('headline', '?')} "
+              "run " + ("ok" if ok else
+                        f"**REGRESSION** (> "
+                        f"{TUNING_WARM_THRESHOLD_PCT}% — the cache "
+                        "read stopped amortising)"))
+        tripped += 0 if ok else 1
+    if len(files) >= 2:
+        tripped += _diff_rows(files[-2], files[-1], TRIPWIRE_THRESHOLD)
+    return tripped
+
+
 def tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
     """Diff the two most recent committed ``BENCH_r*.json`` files and
     flag regressions; then the gp_symbreg paired rows
@@ -804,6 +879,7 @@ def tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
     tripped += mesh_tripwire()
     tripped += costs_tripwire()
     tripped += tracing_tripwire()
+    tripped += tuning_tripwire()
     return tripped
 
 

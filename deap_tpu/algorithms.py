@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from deap_tpu import tuning
 from deap_tpu.core.population import Population, concat, gather
 from deap_tpu.core.fitness import FitnessSpec
 from deap_tpu.ops import variation as _variation
@@ -93,12 +94,20 @@ def _journal_dispatch(**payload) -> None:
     broadcast("variation_dispatch", **payload)
 
 
-def _resolve_fused(fused, toolbox, genomes, op: str):
+def _resolve_fused(fused, toolbox, genomes, op: str, probe_fns=None):
     """Resolve a ``fused=`` request to ``(mode, plan)`` where mode is
     ``None`` (unfused), ``'xla'`` or ``'kernel'``; journals the
     decision. ``'auto'`` silently falls back when the configuration is
     not fused-capable; an explicit ``'xla'``/``'kernel'`` raises
-    instead of silently computing something slower than asked for."""
+    instead of silently computing something slower than asked for.
+
+    ``'auto'`` additionally routes through the dispatch tuner
+    (:func:`deap_tpu.tuning.resolve`, knob ``fused``, candidates
+    ``unfused``/``fused_xla``/``fused_kernel``): ``probe_fns`` — a
+    zero-arg builder of candidate probe closures, supplied by
+    var_and/var_or when the inputs are concrete — lets the tuner race
+    the real variation pass and persist the winner; without a tuner
+    the static pick below is today's behaviour unchanged."""
     if fused in (False, None, "off"):
         _journal_dispatch(op=op, path="unfused", reason="disabled")
         return None, None
@@ -129,6 +138,27 @@ def _resolve_fused(fused, toolbox, genomes, op: str):
             mode = "xla"
             reason = (f"{jax.default_backend()} backend "
                       "(interpret-mode kernel fallback declined)")
+        if mode == "kernel" and leaf.dtype not in _KERNEL_EXACT_DTYPES:
+            mode = "xla"
+            reason = f"dtype {leaf.dtype} outside the kernel's exact set"
+        names = ["unfused", "fused_xla"] + (
+            ["fused_kernel"] if mode == "kernel" else [])
+        candidates = dict.fromkeys(names)
+        if probe_fns is not None:
+            built = probe_fns()
+            candidates = {name: built.get(name) for name in names}
+        n, L = leaf.shape
+        choice = tuning.resolve(
+            "fused",
+            bucket=(op, tuning.shape_bucket(n), tuning.shape_bucket(L),
+                    str(leaf.dtype)),
+            default=f"fused_{mode}", candidates=candidates,
+            check="bitwise", program=op)
+        if choice == "unfused":
+            _journal_dispatch(op=op, path="unfused", reason="tuned")
+            return None, None
+        if choice != f"fused_{mode}":
+            mode, reason = choice[len("fused_"):], "tuned"
     if mode == "kernel" and leaf.dtype not in _KERNEL_EXACT_DTYPES:
         if fused == "kernel":
             raise ValueError(
@@ -140,6 +170,24 @@ def _resolve_fused(fused, toolbox, genomes, op: str):
                       mate=plan.mate_name, mutate=plan.mut_name,
                       mut_kind=plan.mut_kind)
     return mode, plan
+
+
+def _variation_probe_fns(fused, key, pop, run):
+    """Candidate probe-closure builder for the tuner's ``fused`` knob:
+    each candidate re-runs the whole variation pass with that path
+    forced (``run(f)`` recurses into var_and/var_or with an explicit
+    ``fused=f``, which bypasses the tuner — no recursion). Returns
+    None when probing is impossible: explicit ``fused=``, no tuner,
+    or traced inputs."""
+    if fused not in ("auto", True) or tuning.active_tuner() is None \
+            or not tuning.is_concrete(key, pop):
+        return None
+
+    def path(f):
+        return lambda: jax.tree_util.tree_leaves(run(f))
+
+    return lambda: {"unfused": path(False), "fused_xla": path("xla"),
+                    "fused_kernel": path("kernel")}
 
 
 def _apply_fused(mode: str, g, src, partner, cx_row, lo, hi, mut_row,
@@ -179,7 +227,12 @@ def var_and(key: jax.Array, pop: Population, toolbox, cxpb: float,
     ``var_and(k, gather(pop, idx), tb, ...)`` with the parent gather
     fused into the variation pass instead of materialised.
     """
-    mode, plan = _resolve_fused(fused, toolbox, pop.genomes, "var_and")
+    probe = _variation_probe_fns(
+        fused, key, pop,
+        lambda f: var_and(key, pop, toolbox, cxpb, mutpb, fused=f,
+                          sel_idx=sel_idx))
+    mode, plan = _resolve_fused(fused, toolbox, pop.genomes, "var_and",
+                                probe_fns=probe)
     if mode is None:
         if sel_idx is not None:
             pop = gather(pop, sel_idx)
@@ -268,7 +321,12 @@ def var_or(key: jax.Array, pop: Population, toolbox, lambda_: int,
     one-pass apply — bit-identical to this composition.
     """
     _check_cx_mut(cxpb, mutpb)
-    mode, plan = _resolve_fused(fused, toolbox, pop.genomes, "var_or")
+    probe = _variation_probe_fns(
+        fused, key, pop,
+        lambda f: var_or(key, pop, toolbox, lambda_, cxpb, mutpb,
+                         fused=f))
+    mode, plan = _resolve_fused(fused, toolbox, pop.genomes, "var_or",
+                                probe_fns=probe)
     if mode is not None:
         g = _variation.single_genome_leaf(pop.genomes)
         base_idx, j, choice_cx, lo, hi, choice_mut, mask, arg = (

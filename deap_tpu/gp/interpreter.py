@@ -671,8 +671,21 @@ def make_batch_interpreter(pset: PrimitiveSet, max_len: int,
 
     All modes/specializations return bit-identical results (pinned by
     tests/test_gp_dispatch.py); pick by measurement — BENCH_GP.json
-    holds the per-component deltas measured by ``bench.py --gp-race``.
+    holds the per-component deltas measured by ``bench.py --gp-race``,
+    and ``mode='auto'`` asks the dispatch tuner
+    (:func:`deap_tpu.tuning.resolve`: ``DEAP_TPU_TUNE_GP_MODE`` env →
+    cached probe winner → ``'scan'``; probing itself happens where a
+    training set is in hand — :func:`deap_tpu.gp.loop
+    .resolve_gp_mode`).
     """
+    if mode == "auto":
+        from deap_tpu import tuning
+
+        mode = tuning.resolve(
+            "gp_mode", bucket=(tuning.shape_bucket(max_len),),
+            default="scan",
+            candidates={"scan": None, "sweep": None, "grouped": None},
+            check=None, program="gp_interpreter")
     if mode not in ("scan", "sweep", "grouped"):
         raise ValueError(f"unknown interpreter mode {mode!r}")
     if specialize not in ("auto", "none"):

@@ -175,7 +175,29 @@ def make_compaction_pipelines(cxpb: float, mutpb: float):
     return host_fn, device_fn
 
 
-def resolve_compaction(mode: str) -> str:
+def _compaction_probe_fns(n: int):
+    """Race the two compaction pipelines on a representative mask:
+    host = flag fetch + ``np.nonzero``, device = jitted prefix-sum
+    pack + count fetch. Both produce the same ascending index list
+    (the loops' bit-identity pin, tests/test_gp_compaction.py), so the
+    probe compares ``idx[:count]`` bitwise."""
+    import jax as _jax
+
+    flags = _jax.random.bernoulli(_jax.random.key(0), 0.5, (n,))
+    flags_np = np.asarray(flags)
+    compact = _jax.jit(compact_indices, static_argnums=1)
+
+    def host():
+        return np.nonzero(flags_np)[0].astype(np.int32)
+
+    def device():
+        idx, count = compact(flags, n)
+        return np.asarray(idx)[: int(count)]
+
+    return {"host": host, "device": device}
+
+
+def resolve_compaction(mode: str, n: Optional[int] = None) -> str:
     """``'auto'`` → the measured winner per backend: ``'device'`` on
     accelerators (the host round trip is a real transfer+sync there,
     and the prefix-sum compaction stays on device), ``'host'`` on the
@@ -184,10 +206,26 @@ def resolve_compaction(mode: str) -> str:
     measured host/device at pop=1k..100k on this box's CPU, the host
     pipeline wins at every size (1.1-4x), so auto never picks a slower
     path. Both modes are bit-identical (tests/test_gp_compaction.py).
+
+    The static split is now the *default* rung of the dispatch
+    tuner's ladder (:func:`deap_tpu.tuning.resolve`): with a tuner
+    active, 'auto' short-probes both pipelines at ``n`` (or a
+    representative 4096 when the loop builds before the population
+    size is known) and persists the winner per backend;
+    ``DEAP_TPU_TUNE_COMPACTION=host|device`` overrides either way.
     """
     if mode == "auto":
         import jax as _jax
-        return "host" if _jax.default_backend() == "cpu" else "device"
+
+        from deap_tpu import tuning
+
+        static = "host" if _jax.default_backend() == "cpu" else "device"
+        candidates = {"host": None, "device": None}
+        if tuning.active_tuner() is not None:
+            candidates = _compaction_probe_fns(int(n) if n else 4096)
+        return tuning.resolve("compaction", bucket=(), default=static,
+                              candidates=candidates, check="bitwise",
+                              program="gp_loop")
     if mode not in ("device", "host"):
         raise ValueError(f"unknown compaction mode {mode!r}")
     return mode
@@ -622,6 +660,46 @@ def make_gp_loop(pset: PrimitiveSet, max_len: int, evaluate: Callable, *,
     return run
 
 
+def _gp_mode_probe_fns(pset: PrimitiveSet, max_len: int, X,
+                       probe_pop: int):
+    """Race the three batch-interpreter modes on a small generated
+    population over the actual training points. All modes are
+    bit-identical (tests/test_gp_dispatch.py), so the probe compares
+    the prediction matrices bitwise before trusting a timing."""
+    gen = make_generator(pset, max_len, 1, 2, "half_and_half")
+    keys = jax.random.split(jax.random.key(0), probe_pop)
+    genomes = jax.block_until_ready(jax.vmap(gen)(keys))
+
+    def make(m):
+        def fn():
+            interp = make_batch_interpreter(pset, max_len, mode=m)
+            return np.asarray(interp(genomes, X))
+        return fn
+
+    return {m: make(m) for m in ("scan", "sweep", "grouped")}
+
+
+def resolve_gp_mode(pset: PrimitiveSet, max_len: int, X, *,
+                    default: str = "grouped",
+                    probe_pop: int = 64) -> str:
+    """``mode='auto'`` for the GP batch interpreter, resolved through
+    the dispatch tuner's env / cache / probe / static ladder
+    (:func:`deap_tpu.tuning.resolve`). This is the call site with a
+    training set in hand, so it is where the probe actually runs;
+    :func:`make_batch_interpreter` resolves the same knob cache-only.
+    """
+    from deap_tpu import tuning
+
+    names = ("scan", "sweep", "grouped")
+    candidates = dict.fromkeys(names)
+    if tuning.active_tuner() is not None and tuning.is_concrete(X):
+        candidates = _gp_mode_probe_fns(pset, max_len, X, probe_pop)
+    return tuning.resolve(
+        "gp_mode", bucket=(tuning.shape_bucket(max_len),),
+        default=default, candidates=candidates, check="bitwise",
+        program="gp_interpreter")
+
+
 def make_symbreg_loop(pset: PrimitiveSet, max_len: int, X, y, *,
                       cxpb: float = 0.5, mutpb: float = 0.1,
                       mode: str = "grouped", chunk: int = DEFAULT_CHUNK,
@@ -630,7 +708,12 @@ def make_symbreg_loop(pset: PrimitiveSet, max_len: int, X, y, *,
                       **loop_kwargs) -> Callable:
     """The canonical symbolic-regression configuration of
     :func:`make_gp_loop`: negative-MSE fitness through the specialized
-    batch interpreter (``mode='grouped'`` + dedup by default)."""
+    batch interpreter (``mode='grouped'`` + dedup by default;
+    ``mode='auto'`` probes scan/sweep/grouped through the dispatch
+    tuner, falling back to 'grouped' — the measured CPU winner — when
+    tuning is off)."""
+    if mode == "auto":
+        mode = resolve_gp_mode(pset, max_len, X, default="grouped")
     interp = make_batch_interpreter(pset, max_len, mode=mode,
                                     chunk=chunk, dedup=dedup,
                                     points_tile=points_tile)

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from deap_tpu import tuning
 from deap_tpu.core.fitness import dominates
 from deap_tpu.mo.ndsort import nd_rank_prefix, nd_rank_sweep3
 
@@ -68,6 +69,81 @@ ND_SWEEP_THRESHOLD = 16384
 #: for them and ``fallback='count'`` degrades gracefully to the exact
 #: ranks themselves (strictly better than dominance counts).
 _ND_EXACT_IMPLS = ("staircase", "sweep", "dc")
+
+
+def _nd_static_auto(n: int, nobj: int, backend: str) -> str:
+    """The static 'auto' heuristic — CPU-measured thresholds
+    (docs/advanced/ndsort.md), each overridable via
+    ``DEAP_TPU_TUNE_ND_{PREFIX,SWEEP,TILED}_THRESHOLD``.
+
+    Bi-objective: the O(n log n) staircase beats any O(fronts·n²)
+    peeling at scale — and it is the path that fits n ≫ 50k on a CPU
+    host (the [n, n] matrix would be gigabytes; the tiled kernel needs
+    a real TPU core). On a CPU host it wins from tiny n (measured 2×
+    at n=64, 300× at n=4096, 3500× at n=8192). For M ≥ 3 the same
+    logic picks between the prefix-streamed chain reduction
+    (front-count-free O(n²·m), wins from n ≈ 512 on CPU) and — at
+    M = 3 — the linearithmic Fenwick sweep once its scan outruns the
+    O(n²) reduction (measured crossover n ≈ 12-16k; 129× over matrix
+    peeling at n = 50k). On accelerators (TPU/GPU) the matrix is one
+    fused parallel op while sequential scans pay per-step latency, so
+    the static pick keeps the matrix/tiled split there — which is
+    exactly what the dispatch tuner exists to re-measure on chip."""
+    prefix_thr = tuning.int_env("ND_PREFIX_THRESHOLD",
+                                ND_PREFIX_THRESHOLD)
+    sweep_thr = tuning.int_env("ND_SWEEP_THRESHOLD", ND_SWEEP_THRESHOLD)
+    tiled_thr = tuning.int_env("ND_TILED_THRESHOLD", ND_TILED_THRESHOLD)
+    if nobj == 2 and (n >= tiled_thr
+                      or (backend == "cpu" and n >= 64)):
+        return "staircase"
+    if nobj == 3 and backend == "cpu" and n >= sweep_thr:
+        return "sweep"
+    if nobj >= 3 and backend == "cpu" and n >= prefix_thr:
+        return "dc"
+    # off-TPU the tiled kernel runs under the Pallas interpreter and
+    # is slower than the matrix path, so the static pick only
+    # switches on TPU
+    return ("tiled" if (backend == "tpu" and n >= tiled_thr)
+            else "matrix")
+
+
+def _nd_candidates(n: int, nobj: int, backend: str):
+    """The impls worth racing at this shape: the exact impls for this
+    M plus the matrix baseline (and the tiled kernel where it can
+    win). All return bit-identical full ranks (tests/test_ndsort*)."""
+    names = ["matrix"]
+    if backend == "tpu" and n >= tuning.int_env("ND_TILED_THRESHOLD",
+                                                ND_TILED_THRESHOLD):
+        names.append("tiled")
+    if nobj == 2:
+        names.append("staircase")
+    if nobj == 3:
+        names.append("sweep")
+    if nobj >= 3:
+        names.append("dc")
+    return names
+
+
+def _resolve_nd_impl(w, n: int, plan) -> str:
+    """``impl='auto'`` through the dispatch tuner's env / cache /
+    probe / static ladder. Probes race full exact ranks on the actual
+    ``w`` (bit-identity asserted); under jit tracing or with a
+    sharding plan the ladder stops at the cache."""
+    backend = jax.default_backend()
+    nobj = int(w.shape[1])
+    static = _nd_static_auto(n, nobj, backend)
+    names = _nd_candidates(n, nobj, backend)
+    candidates = dict.fromkeys(names)
+    if (len(names) > 1 and plan is None
+            and tuning.active_tuner() is not None
+            and tuning.is_concrete(w)):
+        candidates = {
+            name: (lambda name=name: nd_rank(w, impl=name))
+            for name in names}
+    return tuning.resolve(
+        "nd_impl", bucket=(nobj, tuning.shape_bucket(n)),
+        default=static, candidates=candidates, check="bitwise",
+        program="nd_rank")
 
 
 def nd_rank(w: jnp.ndarray, max_rank: Optional[int] = None,
@@ -137,40 +213,7 @@ def nd_rank(w: jnp.ndarray, max_rank: Optional[int] = None,
         # eagerly and under an enclosing plan-compiled selector.
         w = plan.constrain(w)
     if impl == "auto":
-        # bi-objective: the O(n log n) staircase beats any
-        # O(fronts·n²) peeling at scale — and it is the path that fits
-        # n ≫ 50k on a CPU host (the [n, n] matrix would be gigabytes;
-        # the tiled kernel needs a real TPU core). On a CPU host it
-        # wins from tiny n (measured 2× at n=64, 300× at n=4096,
-        # 3500× at n=8192). For M ≥ 3 the same logic picks between the
-        # prefix-streamed chain reduction (front-count-free O(n²·m),
-        # wins from n ≈ 512 on CPU) and — at M = 3 — the linearithmic
-        # Fenwick sweep once its scan outruns the O(n²) reduction
-        # (measured crossover n ≈ 12-16k; 129× over matrix peeling at
-        # n = 50k, docs/advanced/ndsort.md). On accelerators
-        # (TPU/GPU) the matrix is one fused parallel op while
-        # sequential scans pay per-step latency, so 'auto' keeps the
-        # matrix/tiled split there pending on-chip measurement —
-        # 'sweep'/'dc' remain available explicitly (dc's cross step
-        # already streams through the Pallas dominance kernels).
-        backend = jax.default_backend()
-        nobj = w.shape[1]
-        if nobj == 2 and (n >= ND_TILED_THRESHOLD
-                          or (backend == "cpu" and n >= 64)):
-            impl = "staircase"
-        elif (nobj == 3 and backend == "cpu"
-                and n >= ND_SWEEP_THRESHOLD):
-            impl = "sweep"
-        elif (nobj >= 3 and backend == "cpu"
-                and n >= ND_PREFIX_THRESHOLD):
-            impl = "dc"
-        else:
-            # off-TPU the tiled kernel runs under the Pallas
-            # interpreter and is slower than the matrix path, so
-            # 'auto' only switches on TPU
-            impl = ("tiled" if (backend == "tpu"
-                                and n >= ND_TILED_THRESHOLD)
-                    else "matrix")
+        impl = _resolve_nd_impl(w, n, plan)
     if impl in _ND_EXACT_IMPLS:
         # exact full ranks are free here, so a ``fallback='count'``
         # caller — who asked for a well-ordered ranking past the peel

@@ -254,7 +254,18 @@ def nd_rank_prefix(w: jnp.ndarray, max_rank: Optional[int] = None,
         ranks = jnp.zeros(0, jnp.int32)
         return (ranks, jnp.int32(0)) if return_peels else ranks
     if cross == "auto":
-        cross = "pallas" if jax.default_backend() == "tpu" else "xla"
+        from deap_tpu import tuning
+
+        static = "pallas" if jax.default_backend() == "tpu" else "xla"
+        # cache/env only here: nd_rank's tuner probe times the whole
+        # dc pass, so the cross step is tuned through its caller —
+        # this knob is the backend-local escape hatch
+        # (DEAP_TPU_TUNE_ND_CROSS) plus any bench-recorded winner
+        cross = tuning.resolve(
+            "nd_cross", bucket=(),
+            default=static,
+            candidates={"xla": None, "pallas": None},
+            check=None, program="nd_rank_prefix")
     if cross not in ("xla", "pallas"):
         raise ValueError(f"unknown nd_rank_prefix cross {cross!r}")
 

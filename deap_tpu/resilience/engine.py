@@ -583,6 +583,15 @@ class ResilientRun:
             self.ckpt = checkpoints
         else:
             self.ckpt = Checkpointer(str(checkpoints), keep=keep)
+        if segment_len == "auto":
+            # dispatch-tuner ladder (env DEAP_TPU_TUNE_SEGMENT_LEN →
+            # cached winner → 10); the winner itself is probed and
+            # persisted out of band by ``bench.py --tuning``'s
+            # segment-length sweep, since an inline probe would need a
+            # whole segmented run in hand
+            from deap_tpu import tuning
+            segment_len = tuning.resolve_int("segment_len", default=10,
+                                             program="resilient_scan")
         if segment_len < 1:
             raise ValueError("segment_len must be >= 1")
         self.segment_len = int(segment_len)

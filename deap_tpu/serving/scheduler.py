@@ -46,6 +46,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
 import numpy as np
 
 from deap_tpu.serving.multirun import MultiRunEngine
+from deap_tpu import tuning
 from deap_tpu.serving.tenant import Job, Tenant, bucket_key, pad_pow2
 from deap_tpu.support.compilecache import enable_compile_cache
 from deap_tpu.telemetry import tracing
@@ -204,6 +205,11 @@ class Scheduler:
         if compile_cache:
             enable_compile_cache(compile_cache)
         self.max_lanes = int(max_lanes)
+        if segment_len == "auto":
+            # env DEAP_TPU_TUNE_SEGMENT_LEN → tuning-cache winner
+            # (probed by bench.py --tuning) → the static 10
+            segment_len = tuning.resolve_int("segment_len", default=10,
+                                             program="scheduler")
         self.segment_len = int(segment_len)
         self.fair_quantum = fair_quantum
         self.checkpoint_every = checkpoint_every
@@ -330,6 +336,8 @@ class Scheduler:
                              self.max_lanes)
             self.buckets[bkey] = bucket
             self._rr.append(bkey)
+            if job.family == "gp":
+                self._tune_gp_admission(bucket, job)
         tenant = Tenant(job, self.root)
         if self.resume_tenants and tenant.probe_checkpoint():
             # the restart half of a service drain: this tenant id left
@@ -347,6 +355,60 @@ class Scheduler:
             self._minst.queue_depth.set(len(bucket.queue),
                                         bucket=bucket.label)
         return tenant.id
+
+    def _tune_gp_admission(self, bucket: _Bucket, job: Job) -> None:
+        """The dispatch tuner's headline consumer: batched vs solo GP
+        admission, decided at first bucket creation.
+
+        PR 14 made the union-mask batched engine the static choice —
+        measured faster per tenant on this CPU, a guess elsewhere
+        (the union-mask's over-evaluation cost is backend- and
+        vocabulary-dependent). With a tuner active, this probes one
+        ``segment_len``-generation segment with the bucket's actual
+        spec at full lane width vs a single lane (the prewarm pattern:
+        fresh ``lane_init`` from the job, tenant state untouched),
+        normalises to per-lane-segment cost, bit-checks lane 0 across
+        both (the engine's structural batched==solo identity), and —
+        when solo wins — routes the bucket through ``max_lanes=1``,
+        the autoscaler's own actuator. The probe compiles the 1-lane
+        program; the full-width compile would have happened at first
+        admission anyway. Journaled as ``tuning_decision`` (and the
+        winner persists, so the next process routes without probing);
+        stale winners evicted when the bucket's program drifts
+        (``hlo_drift`` → :func:`deap_tpu.tuning.note_hlo_drift`)."""
+        lanes = pad_pow2(self.max_lanes)
+        if lanes <= 1:
+            return
+        if (tuning.active_tuner() is None
+                and tuning.env_override("gp_batch") is None):
+            return
+        eng = bucket.engine
+        horizon = max(bucket.horizon, pad_pow2(int(job.ngen)))
+        candidates = {"batched": None, "solo": None}
+        if tuning.active_tuner() is not None:
+            lane = eng.lane_init(job.key, job.init, job.ngen,
+                                 job.hyper)
+
+            def probe(n_lanes):
+                def fn():
+                    batch = eng.pack([lane] * n_lanes,
+                                     n_lanes=n_lanes, horizon=horizon)
+                    out, _ = eng.advance(batch, self.segment_len)
+                    return eng.unpack(out, 0)
+                return fn
+
+            candidates = {"batched": (probe(lanes), float(lanes)),
+                          "solo": (probe(1), 1.0)}
+        choice = tuning.resolve(
+            "gp_batch",
+            bucket=(str(bucket.key[0]), str(bucket.key[1])[:16],
+                    lanes, self.segment_len),
+            default="batched", candidates=candidates, check="bitwise",
+            program=bucket.label)
+        if choice == "solo":
+            bucket.max_lanes = 1
+            self.journal.event("tuned_admission", bucket=bucket.label,
+                               choice=choice, max_lanes=1)
 
     def _make_engine(self, job: Job) -> MultiRunEngine:
         tel = None

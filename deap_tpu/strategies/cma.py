@@ -57,6 +57,44 @@ class CMAState:
         return self.diagD[-1] / self.diagD[0]
 
 
+def _resolve_eigh_impl(dim: int) -> str:
+    """``eigh_impl='auto'`` through the dispatch tuner: race LAPACK
+    against the Jacobi sweeps on a representative SPD matrix of this
+    dimension. The two solvers are *not* bit-identical, so — unlike
+    every other knob — the probe cross-checks by reconstruction
+    residual (``‖B·diag(d)·Bᵀ − C‖ ≤ 1e-3·‖C‖``, both bases must
+    reconstruct C) instead of bitwise equality, and 'auto' is opt-in
+    rather than the constructor default ('lapack' keeps exact parity
+    with the reference trajectory pins)."""
+    from deap_tpu import tuning
+
+    candidates = {"lapack": None, "jacobi": None}
+    check: object = None
+    if tuning.active_tuner() is not None:
+        from deap_tpu.ops.linalg import eigh_jacobi
+
+        key = jax.random.key(0)
+        A = jax.random.normal(key, (dim, dim), jnp.float32)
+        C = A @ A.T / dim + jnp.eye(dim, dtype=jnp.float32)
+        lapack = jax.jit(jnp.linalg.eigh)
+        jacobi = jax.jit(eigh_jacobi)
+        candidates = {"lapack": lambda: lapack(C),
+                      "jacobi": lambda: jacobi(C)}
+
+        def check(results):
+            norm = float(jnp.linalg.norm(C))
+            for d, B in results.values():
+                resid = B @ jnp.diag(d) @ B.T - C
+                if float(jnp.linalg.norm(resid)) > 1e-3 * norm:
+                    return False
+            return True
+
+    return tuning.resolve(
+        "eigh_impl", bucket=(tuning.shape_bucket(dim),),
+        default="lapack", candidates=candidates, check=check,
+        program="cma_eigh")
+
+
 class Strategy:
     """Hansen CMA-ES (cma.py:30-205). Parameter defaults follow the
     reference's table (cma.py:41-78): lambda_ = 4 + 3 ln N, mu = λ/2,
@@ -116,9 +154,11 @@ class Strategy:
             raise ValueError(
                 f"eigen_gap must be an integer >= 1, got {eigen_gap!r}")
         self.eigen_gap = int(eigen_gap)
+        if eigh_impl == "auto":
+            eigh_impl = _resolve_eigh_impl(self.dim)
         if eigh_impl not in ("lapack", "jacobi"):
             raise ValueError(f"unknown eigh_impl {eigh_impl!r} "
-                             "(expected 'lapack' or 'jacobi')")
+                             "(expected 'lapack', 'jacobi' or 'auto')")
         self.eigh_impl = eigh_impl
         if eigh_impl == "jacobi":
             from deap_tpu.ops.linalg import eigh_jacobi

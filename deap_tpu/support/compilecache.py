@@ -92,6 +92,18 @@ def enable_compile_cache(path: Optional[str] = None,
     return resolved
 
 
+def sibling_cache_dir() -> Optional[str]:
+    """Directory for sibling caches that should live — and be wiped —
+    together with the compiled executables. The dispatch tuning cache
+    (:mod:`deap_tpu.tuning`) stores its probe winners here when the
+    compile cache is enabled: the two artifacts that make a process
+    warm-start (compiled programs, and the measured dispatch choices
+    that select between them) stay one directory. None when the
+    compile cache is off (the tuning cache then falls back to
+    ``$DEAP_TPU_TUNING_CACHE`` or ``~/.cache/deap_tpu``)."""
+    return _enabled_path
+
+
 def enable_from_env(var: str = ENV_VAR) -> Optional[str]:
     """Enable the cache iff ``$DEAP_TPU_COMPILE_CACHE`` names a
     directory; returns the resolved path (or ``None``). The bench

@@ -235,6 +235,14 @@ class ProgramObservatory:
             alarm = {"alarm": "hlo_drift", "gen": None, **detail}
         self.drifts.append(alarm)
         self._journal("alarm", **alarm)
+        # a drifted program invalidates its measured dispatch winners:
+        # the tuning cache's timings belonged to the old HLO
+        # (journaled per eviction as ``tuning_invalidation``)
+        try:
+            from deap_tpu import tuning
+            tuning.note_hlo_drift(profile["label"])
+        except Exception:
+            pass
 
 
 # --------------------------------------------------------- instrumenting ----

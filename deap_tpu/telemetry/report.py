@@ -421,6 +421,49 @@ def _memory_section(events: List[Dict[str, Any]], out: List[str]
                    f"{e.get('hi')}): {e.get('dir')}")
 
 
+def _tuning_section(events: List[Dict[str, Any]], out: List[str]
+                    ) -> None:
+    """Tuning ledger — the dispatch tuner's journaled decisions
+    (``tuning_decision``: per-key winner, decision source, probe cost,
+    cache hits) and any drift evictions (``tuning_invalidation`` — a
+    program recompiled to a different HLO, so its measured winners
+    were discarded). Rendered for solo and multi-tenant journals
+    alike: a stale or identity-failed dispatch choice is a
+    whole-process property."""
+    decisions = [e for e in events if e.get("kind") == "tuning_decision"]
+    evictions = [e for e in events
+                 if e.get("kind") == "tuning_invalidation"]
+    if not decisions and not evictions:
+        return
+    out.append("")
+    out.append("## Tuning ledger")
+    out.append("")
+    last: Dict[tuple, Dict[str, Any]] = {}
+    hits: Dict[tuple, int] = {}
+    for e in decisions:
+        key = (str(e.get("knob", "?")), str(e.get("bucket", "")))
+        last[key] = e
+        if e.get("cache_hit"):
+            hits[key] = hits.get(key, 0) + 1
+    out.append("| knob | bucket | winner | source | probe s "
+               "| cache hits |")
+    out.append("|---|---|---|---|---|---|")
+    for key in sorted(last):
+        e = last[key]
+        probe = e.get("probe_s")
+        out.append(f"| {key[0]} | {key[1] or '—'} "
+                   f"| {e.get('winner', '?')} | {e.get('source', '?')} "
+                   f"| {_fmt(probe) if probe is not None else '—'} "
+                   f"| {hits.get(key, 0)} |")
+    failed = [e for e in decisions if e.get("identity") == "failed"]
+    if failed:
+        out.append(f"- ▲ {len(failed)} probe(s) failed the candidate "
+                   "identity check — static default kept")
+    for e in evictions:
+        out.append(f"- drift eviction: {e.get('key')} (program "
+                   f"{e.get('program')}, {e.get('reason')})")
+
+
 def render_report(path: str, lines: Optional[List[str]] = None) -> str:
     """The full report as one string (also returned line-by-line into
     ``lines`` when given — bench_report prints as it renders)."""
@@ -478,6 +521,9 @@ def render_report(path: str, lines: Optional[List[str]] = None) -> str:
         if fallbacks:
             out.append(f"  - ▲ {len(fallbacks)} fused-plane fallback(s):"
                        f" {fallbacks[0].get('reason')}")
+
+    # ------------------------------------------------- tuning ledger ----
+    _tuning_section(events, out)
 
     # ----------------------------------------- multi-tenant journals ----
     if _tenant_sections(events, out):

@@ -1,0 +1,16 @@
+"""Self-tuning dispatch runtime — probe-and-persist winner selection
+for every static dispatch knob (see :mod:`deap_tpu.tuning.tuner` for
+the protocol and docs/advanced/tuning.md for the knob table)."""
+
+from deap_tpu.tuning.cache import CACHE_FORMAT, TuningCache, default_dir
+from deap_tpu.tuning.tuner import (DispatchTuner, KNOBS, active_tuner,
+                                   disable, enable, env_override,
+                                   int_env, is_concrete, note_hlo_drift,
+                                   resolve, resolve_int, shape_bucket)
+
+__all__ = [
+    "CACHE_FORMAT", "TuningCache", "default_dir", "DispatchTuner",
+    "KNOBS", "active_tuner", "disable", "enable", "env_override",
+    "int_env", "is_concrete", "note_hlo_drift", "resolve",
+    "resolve_int", "shape_bucket",
+]

@@ -1,0 +1,432 @@
+"""Probe-and-persist dispatch tuner.
+
+Every hardcoded dispatch guess in the codebase — the nd-sort impl
+thresholds, the GP interpreter mode, host-vs-device compaction, the
+CMA eigh solver, fused-vs-unfused variation, serving ``segment_len``,
+and the Scheduler's batched-vs-solo GP admission — was measured on one
+CPU.  On a new backend those numbers are guesses.  This module closes
+the loop: at first use of a tunable decision point on a given
+``(backend, device_kind, knob, shape-bucket)`` key the
+:class:`DispatchTuner` *short-probes* the candidate implementations —
+warm each (the compile), time min-of-reps, assert bit-identity between
+candidates before trusting either (the ``bench_gp.suite_gps`` probe
+protocol, generalised) — picks the measured winner, persists it in the
+:class:`~deap_tpu.tuning.cache.TuningCache` next to the compile cache,
+and journals the decision as a ``tuning_decision`` event.
+
+Decision ladder (first match wins), implemented by :func:`resolve`:
+
+1. ``DEAP_TPU_TUNE_<KNOB>`` env var — the explicit escape hatch,
+   honoured even when the tuner is disabled.
+2. Tuning-cache hit (tuner enabled) — a prior process probed this key.
+3. Short probe (tuner enabled, call site can probe — concrete inputs,
+   not under jit tracing) — measure, persist, journal.
+4. The static heuristic default — exactly the pre-tuner behaviour.
+
+The tuner is **off by default** (``DEAP_TPU_TUNE=1`` or
+:func:`enable` opts in), so every existing code path, test pin and
+benchmark keeps today's static behaviour bit-for-bit until a user asks
+for measured dispatch.  Correctness never rides on the probe: every
+candidate set is either bit-identical by construction (pinned by the
+existing parity suites) or cross-checked by a tolerance predicate
+(``eigh``), and an identity failure falls back to the static default
+and journals the failure instead of trusting a fast wrong answer.
+
+Stale entries are evicted by the cost observatory's ``hlo_drift``
+alarm (:func:`note_hlo_drift`, wired in ``telemetry/costs.py``) and by
+the cache-format / jax-version stamp (``cache.py``).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+from deap_tpu.tuning.cache import CACHE_FORMAT, TuningCache
+
+#: master switch: truthy value auto-enables a process-wide tuner
+ENV_ENABLE = "DEAP_TPU_TUNE"
+
+#: per-knob override prefix: ``DEAP_TPU_TUNE_<KNOB>`` (knob upper-cased)
+ENV_PREFIX = "DEAP_TPU_TUNE_"
+
+#: the tunable decision points: knob -> (candidate values, static default
+#: description).  The single source for the docs table and the health
+#: report's ledger; candidate sets marked '*' are cross-checked by
+#: tolerance instead of bitwise (see docs/advanced/tuning.md).
+KNOBS = {
+    "nd_impl": (("matrix", "tiled", "staircase", "sweep", "dc"),
+                "backend/n/nobj threshold matrix (mo/emo.py)"),
+    "nd_cross": (("xla", "pallas"),
+                 "'pallas' on TPU, 'xla' elsewhere (cache/env only)"),
+    "gp_mode": (("scan", "sweep", "grouped"),
+                "'grouped' in make_symbreg_loop, 'scan' elsewhere"),
+    "compaction": (("host", "device"),
+                   "'host' on CPU, 'device' on accelerators"),
+    "eigh_impl": (("lapack", "jacobi"),
+                  "'lapack' (tolerance-checked*, not bitwise)"),
+    "fused": (("unfused", "fused_xla", "fused_kernel"),
+              "fused when capable: kernel on TPU, XLA elsewhere"),
+    "segment_len": (None, "10 (cache/env only; probed by bench --tuning)"),
+    "gp_batch": (("batched", "solo"),
+                 "'batched' (union-mask multi-tenant lanes)"),
+}
+
+_ACTIVE: list = [None]
+_ENV_CHECKED: list = [False]
+
+#: (knob, bucket) pairs already journaled this process — decisions are
+#: journaled once per key, not once per call (nd_rank runs every
+#: generation; the ledger wants decisions, not a heartbeat)
+_SEEN: set = set()
+
+
+# ----------------------------------------------------------- env plumbing ----
+
+def _truthy(value: Optional[str]) -> bool:
+    return bool(value) and value.strip().lower() in ("1", "on", "true",
+                                                     "yes")
+
+
+def env_override(knob: str) -> Optional[str]:
+    """The ``DEAP_TPU_TUNE_<KNOB>`` escape hatch, or None."""
+    value = os.environ.get(ENV_PREFIX + knob.upper())
+    if value is None or not value.strip():
+        return None
+    return value.strip()
+
+
+def int_env(name: str, default: int) -> int:
+    """Integer threshold override ``DEAP_TPU_TUNE_<NAME>`` (the
+    ``ND_*_THRESHOLD`` family), falling back to ``default`` on unset
+    or unparseable values."""
+    value = os.environ.get(ENV_PREFIX + name.upper())
+    if value is None or not value.strip():
+        return default
+    try:
+        return int(value)
+    except ValueError:
+        return default
+
+
+# ------------------------------------------------------------- activation ----
+
+def enable(cache_dir: Optional[str] = None, *, reps: int = 2,
+           strict_identity: bool = False) -> "DispatchTuner":
+    """Install a process-wide tuner (idempotent per call — a second
+    call replaces the first, dropping its session memo)."""
+    tuner = DispatchTuner(cache_dir, reps=reps,
+                          strict_identity=strict_identity)
+    _ACTIVE[0] = tuner
+    _ENV_CHECKED[0] = True
+    return tuner
+
+
+def disable() -> None:
+    """Remove the active tuner; also blocks the ``DEAP_TPU_TUNE`` env
+    auto-enable for the rest of the process (tests use this to pin
+    static behaviour regardless of environment)."""
+    _ACTIVE[0] = None
+    _ENV_CHECKED[0] = True
+
+
+def active_tuner() -> Optional["DispatchTuner"]:
+    """The installed tuner, auto-creating one on first call when
+    ``DEAP_TPU_TUNE`` is truthy. None == every decision point uses its
+    static default (today's behaviour)."""
+    tuner = _ACTIVE[0]
+    if tuner is not None:
+        return tuner
+    if not _ENV_CHECKED[0]:
+        _ENV_CHECKED[0] = True
+        if _truthy(os.environ.get(ENV_ENABLE)):
+            _ACTIVE[0] = DispatchTuner()
+            return _ACTIVE[0]
+    return None
+
+
+def _reset_for_tests() -> None:
+    """Forget activation latches and journal dedup (test isolation)."""
+    _ACTIVE[0] = None
+    _ENV_CHECKED[0] = False
+    _SEEN.clear()
+
+
+# ------------------------------------------------------------- inspection ----
+
+def is_concrete(*trees: Any) -> bool:
+    """True when no leaf of any pytree is a jax tracer — probing (and
+    any timing at all) is only meaningful on concrete values; under a
+    ``jit`` trace the decision ladder stops at the cache."""
+    import jax
+
+    for tree in trees:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if isinstance(leaf, jax.core.Tracer):
+                return False
+    return True
+
+
+def shape_bucket(n: int) -> int:
+    """Pow-2 ceiling — the shape-bucket component of tuning keys, so a
+    pop of 4000 and 4096 share one probed winner."""
+    n = max(int(n), 1)
+    return 1 << (n - 1).bit_length()
+
+
+def _journal(knob: str, bucket: Tuple, **payload: Any) -> None:
+    from deap_tpu.telemetry.journal import broadcast
+
+    broadcast("tuning_decision", knob=knob,
+              bucket="/".join(str(b) for b in bucket), **payload)
+
+
+def _journal_once(knob: str, bucket: Tuple, **payload: Any) -> None:
+    token = (knob, tuple(bucket), payload.get("source"),
+             payload.get("winner"))
+    if token in _SEEN:
+        return
+    _SEEN.add(token)
+    _journal(knob, bucket, **payload)
+
+
+# ------------------------------------------------------------ the tuner ----
+
+class DispatchTuner:
+    """Probe-and-persist winner selection for one process.
+
+    ``reps`` is the min-of-reps timing count after the warm-up call
+    (which pays the compile and is excluded). ``strict_identity=True``
+    turns an identity failure into a raise instead of a journaled
+    fallback — the test suite's setting."""
+
+    def __init__(self, cache: Any = None, *, reps: int = 2,
+                 strict_identity: bool = False):
+        self.cache = (cache if isinstance(cache, TuningCache)
+                      else TuningCache(cache))
+        self.reps = max(int(reps), 1)
+        self.strict_identity = bool(strict_identity)
+        #: key -> winner, the in-process memo (one probe / file read
+        #: per key per process)
+        self._session: Dict[str, str] = {}
+
+    # ------------------------------------------------------------- keys ----
+
+    def stamp(self) -> Dict[str, Any]:
+        import jax
+
+        return {"format": CACHE_FORMAT, "jax": jax.__version__}
+
+    def key_for(self, knob: str, bucket: Sequence[Any]) -> str:
+        import jax
+
+        device = jax.devices()[0]
+        parts = [jax.default_backend(),
+                 str(getattr(device, "device_kind", "unknown")).replace(
+                     " ", "_"),
+                 str(knob)] + [str(b) for b in bucket]
+        return "/".join(parts)
+
+    # ---------------------------------------------------------- deciding ----
+
+    def decide(self, knob: str, *, bucket: Tuple, default: str,
+               candidates: Optional[Dict[str, Any]] = None,
+               check: Any = "bitwise",
+               program: Optional[str] = None) -> str:
+        """Cache → probe → static, returning the winning candidate
+        name. ``candidates`` maps name -> zero-arg probe fn, ``(fn,
+        weight)`` (timing divided by ``weight`` — the batched-vs-solo
+        per-lane normalisation), or ``None`` when this call site
+        cannot probe (tracing, missing inputs)."""
+        key = self.key_for(knob, bucket)
+        memo = self._session.get(key)
+        if memo is not None:
+            return memo
+        names = tuple(candidates) if candidates else ()
+        entry = self.cache.get(key, stamp=self.stamp())
+        if entry is not None and (not names
+                                  or entry.get("winner") in names):
+            winner = str(entry["winner"])
+            _journal_once(knob, bucket, source="cache", winner=winner,
+                          default=default, cache_hit=True,
+                          probe_s=entry.get("probe_s"),
+                          program=program)
+            self._session[key] = winner
+            return winner
+        probeable = bool(candidates) and all(
+            callable(c[0] if isinstance(c, tuple) else c)
+            for c in candidates.values())
+        if not probeable:
+            # not memoised: a later call with concrete inputs on the
+            # same key should still get its chance to probe
+            _journal_once(knob, bucket, source="static", winner=default,
+                          default=default, cache_hit=False,
+                          program=program)
+            return default
+        winner, timings, probe_s, identity = self._probe(candidates,
+                                                         check)
+        if winner is None or identity == "failed":
+            reason = ("identity" if identity == "failed"
+                      else "all candidates failed")
+            if identity == "failed" and self.strict_identity:
+                raise AssertionError(
+                    f"tuning probe for {knob!r} {bucket!r}: candidates "
+                    "disagree — refusing to pick a winner")
+            _journal(knob, bucket, source="static", winner=default,
+                     default=default, cache_hit=False, timings=timings,
+                     probe_s=round(probe_s, 6), identity=identity,
+                     reason=reason, program=program)
+            self._session[key] = default
+            return default
+        self.record(knob, bucket, winner, timings=timings,
+                    probe_s=probe_s, identity=identity, program=program,
+                    default=default)
+        return winner
+
+    def record(self, knob: str, bucket: Tuple, winner: str, *,
+               timings: Dict[str, Optional[float]], probe_s: float,
+               identity: str = "bitwise",
+               program: Optional[str] = None,
+               default: Optional[str] = None) -> None:
+        """Persist + journal a measured decision (the tail of
+        :meth:`decide`; also the entry point for external probes like
+        ``bench.py --tuning``'s segment-length sweep)."""
+        key = self.key_for(knob, bucket)
+        self.cache.put(key, {
+            "winner": winner,
+            "timings": {k: (round(v, 6) if v is not None else None)
+                        for k, v in timings.items()},
+            "probe_s": round(float(probe_s), 6),
+            "identity": identity,
+            "program": program,
+            "stamp": self.stamp(),
+            "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        })
+        _journal(knob, bucket, source="probe", winner=winner,
+                 default=default, cache_hit=False, timings=timings,
+                 probe_s=round(float(probe_s), 6), identity=identity,
+                 program=program)
+        self._session[key] = winner
+
+    # ----------------------------------------------------------- probing ----
+
+    def _probe(self, candidates: Dict[str, Any], check: Any):
+        import jax
+
+        t0 = time.perf_counter()
+        timings: Dict[str, Optional[float]] = {}
+        results: Dict[str, Any] = {}
+        for name, cand in candidates.items():
+            fn, weight = (cand if isinstance(cand, tuple)
+                          else (cand, 1.0))
+            try:
+                results[name] = jax.block_until_ready(fn())  # warm
+                best = float("inf")
+                for _ in range(self.reps):
+                    t1 = time.perf_counter()
+                    jax.block_until_ready(fn())
+                    best = min(best, time.perf_counter() - t1)
+                timings[name] = best / float(weight)
+            except Exception:
+                # a candidate that cannot run must never win — and a
+                # broken probe must never break the caller
+                results.pop(name, None)
+                timings[name] = None
+        probe_s = time.perf_counter() - t0
+        live = {k: v for k, v in timings.items() if v is not None}
+        if not live:
+            return None, timings, probe_s, "skipped"
+        identity = self._check_identity(results, check)
+        winner = min(live, key=live.get)
+        return winner, timings, probe_s, identity
+
+    @staticmethod
+    def _check_identity(results: Dict[str, Any], check: Any) -> str:
+        """'bitwise' / 'tolerance' / 'failed' / 'skipped'."""
+        if check is None or len(results) < 2:
+            return "skipped"
+        if callable(check):
+            try:
+                return "tolerance" if check(results) else "failed"
+            except Exception:
+                return "failed"
+        import jax
+        import numpy as np
+
+        ref_leaves = None
+        for res in results.values():
+            leaves = [np.asarray(leaf)
+                      for leaf in jax.tree_util.tree_leaves(res)]
+            if ref_leaves is None:
+                ref_leaves = leaves
+                continue
+            if len(leaves) != len(ref_leaves):
+                return "failed"
+            for a, b in zip(ref_leaves, leaves):
+                if (a.shape != b.shape or a.dtype != b.dtype
+                        or a.tobytes() != b.tobytes()):
+                    return "failed"
+        return "bitwise"
+
+
+# ----------------------------------------------------------- entry points ----
+
+def resolve(knob: str, *, bucket: Tuple = (), default: str,
+            candidates: Optional[Dict[str, Any]] = None,
+            check: Any = "bitwise",
+            program: Optional[str] = None) -> str:
+    """The one call every tunable decision point makes — the env /
+    cache / probe / static ladder (module docstring). Returns a
+    candidate name; the caller maps it onto its own dispatch."""
+    env = env_override(knob)
+    if env is not None:
+        names = tuple(candidates) if candidates else ()
+        if names and env not in names:
+            raise ValueError(
+                f"{ENV_PREFIX}{knob.upper()}={env!r} is not a valid "
+                f"candidate here (expected one of {sorted(names)})")
+        _journal_once(knob, bucket, source="env", winner=env,
+                      default=default, cache_hit=False, program=program)
+        return env
+    tuner = active_tuner()
+    if tuner is None:
+        return default
+    return tuner.decide(knob, bucket=bucket, default=default,
+                        candidates=candidates, check=check,
+                        program=program)
+
+
+def resolve_int(knob: str, *, bucket: Tuple = (), default: int,
+                program: Optional[str] = None) -> int:
+    """:func:`resolve` for integer-valued knobs (``segment_len``):
+    env / cache / static — never probed inline (an integer knob has no
+    candidate closures at the call site; ``bench.py --tuning`` probes
+    and persists it out of band via :meth:`DispatchTuner.record`)."""
+    winner = resolve(knob, bucket=bucket, default=str(int(default)),
+                     candidates=None, check=None, program=program)
+    try:
+        value = int(winner)
+    except (TypeError, ValueError):
+        return int(default)
+    return value if value >= 1 else int(default)
+
+
+def note_hlo_drift(program: str) -> int:
+    """Evict every tuning entry recorded against observatory label
+    ``program`` — called from ``ProgramObservatory._drift`` when the
+    same (label, signature) recompiles to a different HLO hash. The
+    measured winner belonged to the old program; re-probe. Returns the
+    eviction count (0 when no tuner is active)."""
+    tuner = active_tuner()
+    if tuner is None:
+        return 0
+    evicted = tuner.cache.evict_program(str(program))
+    if evicted:
+        from deap_tpu.telemetry.journal import broadcast
+
+        for key in evicted:
+            tuner._session.pop(key, None)
+            broadcast("tuning_invalidation", key=key,
+                      program=str(program), reason="hlo_drift")
+    return len(evicted)

@@ -1,0 +1,536 @@
+"""Self-tuning dispatch runtime — the probe-and-persist contract.
+
+The acceptance bar of ``deap_tpu/tuning``: probe winners round-trip
+through the JSON cache across processes (the cache file itself staying
+stdlib-readable), the invalidation ladder works (format stamp, jax
+stamp, ``hlo_drift`` eviction), a warm cache replays the same decision
+without re-probing, the env escape hatches override everything — and,
+the load-bearing pin, tuned dispatch is **bit-identical** to every
+forced-static dispatch at every decision point (nd_rank, the GP
+interpreter mode, compaction, fused variation, CMA eigh, the
+Scheduler's batched-vs-solo GP admission).
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deap_tpu import ops, tuning
+from deap_tpu.algorithms import evaluate_invalid, var_and
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import init_population
+from deap_tpu.core.toolbox import Toolbox
+from deap_tpu.gp.loop import make_symbreg_loop, resolve_compaction
+from deap_tpu.gp.pset import math_set
+from deap_tpu.gp.tree import make_generator
+from deap_tpu.mo.emo import _nd_static_auto, nd_rank
+from deap_tpu.resilience.engine import ResilientRun
+from deap_tpu.serving import GpJobSpec, Job, Scheduler
+from deap_tpu.serving.tenant import bucket_key
+from deap_tpu.strategies.cma import Strategy
+from deap_tpu.telemetry.costs import ProgramObservatory
+from deap_tpu.telemetry.journal import RunJournal, read_journal
+from deap_tpu.tuning import DispatchTuner, TuningCache
+from deap_tpu.tuning.cache import CACHE_FORMAT, FILENAME
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ML = 32
+N = 24
+P = 12
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tuner(tmp_path, monkeypatch):
+    """Every test gets a disabled tuner, a clean journal-dedup set, no
+    ``DEAP_TPU_TUNE*`` environment, and a private cache directory."""
+    for var in [v for v in os.environ if v.startswith("DEAP_TPU_TUNE")]:
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv(tuning.cache.ENV_DIR, str(tmp_path / "tunecache"))
+    tuning.tuner._reset_for_tests()
+    yield
+    tuning.tuner._reset_for_tests()
+
+
+def _decisions(path, knob=None):
+    rows = [r for r in read_journal(str(path))
+            if r.get("kind") == "tuning_decision"]
+    if knob is not None:
+        rows = [r for r in rows if r.get("knob") == knob]
+    return rows
+
+
+def _entries():
+    cache = TuningCache()
+    cache.refresh()
+    return cache.entries()
+
+
+def _w(n=600, nobj=3, seed=0):
+    return jax.random.normal(jax.random.key(seed), (n, nobj),
+                             jnp.float32)
+
+
+# ------------------------------------------------------ cache plumbing ----
+
+def test_cache_roundtrip_across_processes(tmp_path):
+    """A winner put by one process is read back by another — and the
+    cache module stays importable (by file path) without deap_tpu or
+    jax, the same stdlib-only contract the health report rides."""
+    cdir = str(tmp_path / "xproc")
+    parent = TuningCache(cdir)
+    parent.put("cpu/cpu/nd_impl/3/1024", {
+        "winner": "dc", "timings": {"dc": 0.001, "matrix": 0.002},
+        "probe_s": 0.1, "identity": "bitwise", "program": "nd_rank",
+        "stamp": {"format": CACHE_FORMAT, "jax": "x"},
+    })
+    cache_py = os.path.join(REPO, "deap_tpu", "tuning", "cache.py")
+    code = (
+        "import importlib.util, sys\n"
+        f"spec = importlib.util.spec_from_file_location('_tc', "
+        f"{cache_py!r})\n"
+        "mod = importlib.util.module_from_spec(spec)\n"
+        "spec.loader.exec_module(mod)\n"
+        f"cache = mod.TuningCache({cdir!r})\n"
+        "entry = cache.get('cpu/cpu/nd_impl/3/1024')\n"
+        "assert entry and entry['winner'] == 'dc', entry\n"
+        "cache.put('cpu/cpu/gp_mode/64', {'winner': 'sweep'})\n"
+        "assert 'jax' not in sys.modules\n"
+        "assert 'deap_tpu' not in sys.modules\n"
+        "print('child-ok')\n")
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "child-ok" in r.stdout
+    # the child's put merged with (not clobbered) the parent's entry
+    parent.refresh()
+    assert parent.get("cpu/cpu/gp_mode/64")["winner"] == "sweep"
+    assert parent.get("cpu/cpu/nd_impl/3/1024")["winner"] == "dc"
+
+
+def test_cache_stamp_and_format_invalidation(tmp_path):
+    cdir = str(tmp_path / "stamps")
+    cache = TuningCache(cdir)
+    stamp = {"format": CACHE_FORMAT, "jax": jax.__version__}
+    cache.put("k", {"winner": "a", "stamp": stamp})
+    assert cache.get("k", stamp=stamp)["winner"] == "a"
+    # a jax upgrade misses every old entry
+    assert cache.get("k", stamp={"format": CACHE_FORMAT,
+                                 "jax": "other"}) is None
+    # a cache-format bump discards the whole file
+    with open(cache.path) as fh:
+        doc = json.load(fh)
+    doc["format"] = CACHE_FORMAT - 1
+    with open(cache.path, "w") as fh:
+        json.dump(doc, fh)
+    fresh = TuningCache(cdir)
+    assert fresh.entries() == {}
+    # and a torn/garbage file reads as empty, never raises
+    with open(cache.path, "w") as fh:
+        fh.write("{not json")
+    assert TuningCache(cdir).entries() == {}
+
+
+# --------------------------------------------------- probe → persist ----
+
+def test_nd_probe_persists_bit_identical_winner(tmp_path):
+    """The headline ladder walk: nd_rank(impl='auto') under an active
+    tuner probes the candidate impls, persists the measured winner, and
+    the tuned ranks equal every forced-static impl bit for bit."""
+    tuning.enable()
+    w = _w()
+    jpath = tmp_path / "run.jsonl"
+    with RunJournal(str(jpath)):
+        tuned = np.asarray(nd_rank(w))
+    rows = _decisions(jpath, "nd_impl")
+    assert len(rows) == 1 and rows[0]["source"] == "probe"
+    assert rows[0]["identity"] == "bitwise"
+    entries = _entries()
+    key = [k for k in entries if "/nd_impl/" in k]
+    assert len(key) == 1 and "/3/1024" in key[0]
+    entry = entries[key[0]]
+    assert entry["winner"] == rows[0]["winner"]
+    assert entry["program"] == "nd_rank"
+    assert set(entry["timings"]) >= {"matrix", "sweep", "dc"}
+    for impl in ("matrix", "sweep", "dc"):
+        np.testing.assert_array_equal(tuned,
+                                      np.asarray(nd_rank(w, impl=impl)),
+                                      err_msg=impl)
+
+
+def test_warm_cache_replays_decision_without_reprobing(tmp_path):
+    """Probe determinism: a second 'process' (fresh tuner session over
+    the same cache dir) resolves the same winner from the cache — the
+    journal says source='cache', and no new probe timings appear."""
+    tuning.enable()
+    w = _w()
+    with RunJournal(str(tmp_path / "cold.jsonl")):
+        cold = np.asarray(nd_rank(w))
+    winner = _decisions(tmp_path / "cold.jsonl", "nd_impl")[0]["winner"]
+
+    tuning.tuner._reset_for_tests()  # forget the session memo
+    tuning.enable()
+    jpath = tmp_path / "warm.jsonl"
+    with RunJournal(str(jpath)):
+        warm = np.asarray(nd_rank(w))
+    rows = _decisions(jpath, "nd_impl")
+    assert len(rows) == 1
+    assert rows[0]["source"] == "cache" and rows[0]["cache_hit"]
+    assert rows[0]["winner"] == winner
+    np.testing.assert_array_equal(cold, warm)
+
+
+def test_decision_journaled_once_per_key(tmp_path):
+    tuning.enable()
+    w = _w()
+    jpath = tmp_path / "run.jsonl"
+    with RunJournal(str(jpath)):
+        nd_rank(w)
+        nd_rank(w)  # session memo: no second probe, no second row
+        nd_rank(_w(n=3000))  # a new shape bucket is a new decision
+    rows = _decisions(jpath, "nd_impl")
+    assert len(rows) == 2
+    assert {r["bucket"] for r in rows} == {"3/1024", "3/4096"}
+
+
+def test_tuner_off_is_bitwise_static(tmp_path):
+    """No tuner, no env: the ladder bottoms out at the static default
+    with no journal rows and no cache file — today's behaviour."""
+    jpath = tmp_path / "run.jsonl"
+    w = _w()
+    with RunJournal(str(jpath)):
+        auto = np.asarray(nd_rank(w))
+    static = _nd_static_auto(600, 3, jax.default_backend())
+    np.testing.assert_array_equal(auto,
+                                  np.asarray(nd_rank(w, impl=static)))
+    assert _decisions(jpath) == []
+    assert not os.path.exists(os.path.join(
+        os.environ[tuning.cache.ENV_DIR], FILENAME))
+
+
+def test_under_jit_ladder_stops_at_cache(tmp_path):
+    """Probing is impossible on tracers: under jit the tuner must not
+    attempt to call candidates, and the static default flows through."""
+    tuning.enable()
+    w = _w(n=256)
+
+    @jax.jit
+    def ranked(x):
+        return nd_rank(x)
+
+    tuned = np.asarray(ranked(w))
+    static = _nd_static_auto(256, 3, jax.default_backend())
+    np.testing.assert_array_equal(
+        tuned, np.asarray(nd_rank(w, impl=static)))
+    # no probe ran, so nothing was persisted for the traced call
+    assert not any("/nd_impl/" in k for k in _entries())
+
+
+# -------------------------------------------------- env escape hatches ----
+
+def test_env_override_wins_without_tuner(tmp_path, monkeypatch):
+    monkeypatch.setenv("DEAP_TPU_TUNE_ND_IMPL", "matrix")
+    w = _w()
+    jpath = tmp_path / "run.jsonl"
+    with RunJournal(str(jpath)):
+        forced = np.asarray(nd_rank(w))
+    np.testing.assert_array_equal(forced,
+                                  np.asarray(nd_rank(w, impl="matrix")))
+    rows = _decisions(jpath, "nd_impl")
+    assert rows and rows[0]["source"] == "env"
+    assert rows[0]["winner"] == "matrix"
+
+
+def test_env_override_rejects_unknown_candidate(monkeypatch):
+    monkeypatch.setenv("DEAP_TPU_TUNE_ND_IMPL", "warp_speed")
+    with pytest.raises(ValueError, match="warp_speed"):
+        nd_rank(_w(n=64))
+
+
+def test_env_int_threshold_overrides(monkeypatch):
+    # default ND_PREFIX_THRESHOLD=512 keeps n=64 nobj=4 on the matrix
+    assert _nd_static_auto(64, 4, "cpu") == "matrix"
+    monkeypatch.setenv("DEAP_TPU_TUNE_ND_PREFIX_THRESHOLD", "1")
+    assert _nd_static_auto(64, 4, "cpu") == "dc"
+    monkeypatch.setenv("DEAP_TPU_TUNE_ND_PREFIX_THRESHOLD", "junk")
+    assert _nd_static_auto(64, 4, "cpu") == "matrix"
+
+
+def test_segment_len_auto_env_and_fallbacks(tmp_path, monkeypatch):
+    monkeypatch.setenv("DEAP_TPU_TUNE_SEGMENT_LEN", "7")
+    res = ResilientRun(str(tmp_path / "ck1"), segment_len="auto")
+    assert res.segment_len == 7
+    # unparseable / non-positive env values fall back to the static 10
+    monkeypatch.setenv("DEAP_TPU_TUNE_SEGMENT_LEN", "soon")
+    assert ResilientRun(str(tmp_path / "ck2"),
+                        segment_len="auto").segment_len == 10
+    monkeypatch.setenv("DEAP_TPU_TUNE_SEGMENT_LEN", "0")
+    assert ResilientRun(str(tmp_path / "ck3"),
+                        segment_len="auto").segment_len == 10
+
+
+def test_segment_len_auto_reads_cache_winner(tmp_path):
+    """The cache/env-only integer knob: a winner recorded out of band
+    (the ``bench.py --tuning`` path) steers ``segment_len='auto'``."""
+    tuner = tuning.enable()
+    tuner.record("segment_len", (), "25",
+                 timings={"10": 0.002, "25": 0.001}, probe_s=0.1,
+                 identity="bitwise", program="resilient_scan")
+    assert ResilientRun(str(tmp_path / "ck"),
+                        segment_len="auto").segment_len == 25
+    assert Scheduler(str(tmp_path / "srv"), segment_len="auto",
+                     max_lanes=1, telemetry=False,
+                     metrics=False).segment_len == 25
+
+
+# --------------------------------------------------------- invalidation ----
+
+def test_hlo_drift_evicts_and_reprobes(tmp_path):
+    tuning.enable()
+    w = _w()
+    j1 = tmp_path / "j1.jsonl"
+    with RunJournal(str(j1)):
+        nd_rank(w)
+        assert any("/nd_impl/" in k for k in _entries())
+        evicted = tuning.note_hlo_drift("nd_rank")
+        assert evicted == 1
+        assert not any("/nd_impl/" in k for k in _entries())
+        nd_rank(w)  # the session memo was dropped too: re-probes
+    rows = read_journal(str(j1))
+    inval = [r for r in rows if r.get("kind") == "tuning_invalidation"]
+    assert len(inval) == 1 and inval[0]["reason"] == "hlo_drift"
+    assert "/nd_impl/" in inval[0]["key"]
+    probes = [r for r in _decisions(j1, "nd_impl")
+              if r["source"] == "probe"]
+    assert len(probes) == 2
+    # an unrelated program's drift evicts nothing
+    assert tuning.note_hlo_drift("some_other_program") == 0
+
+
+def test_observatory_drift_triggers_tuning_eviction(tmp_path):
+    """End-to-end invalidation: the cost observatory seeing the same
+    (label, signature) recompile to a different HLO must evict the
+    tuning entries recorded against that program label."""
+    tuner = tuning.enable()
+    tuner.record("gp_mode", (64,), "scan",
+                 timings={"scan": 0.001}, probe_s=0.1,
+                 program="gp_interpreter")
+    assert any("/gp_mode/" in k for k in _entries())
+    x = jnp.ones(4, jnp.float32)
+    lo1 = jax.jit(lambda v: v + 1).lower(x)
+    lo2 = jax.jit(lambda v: v * 3 - v).lower(x)
+    with ProgramObservatory() as obs:
+        obs.record("gp_interpreter", lo1, lo1.compile(), 0.0,
+                   signature=("sig",))
+        obs.record("gp_interpreter", lo2, lo2.compile(), 0.0,
+                   signature=("sig",))
+    assert obs.drifts, "observatory did not flag the recompile"
+    assert not any("/gp_mode/" in k for k in _entries())
+
+
+# --------------------------------------- per-decision-point identity ----
+
+def test_compaction_probe_matches_forced(tmp_path):
+    tuning.enable()
+    choice = resolve_compaction("auto", 512)
+    assert choice in ("host", "device")
+    entry = _entries().get(
+        tuning.DispatchTuner().key_for("compaction", ()))
+    assert entry is not None and entry["winner"] == choice
+    assert entry["identity"] == "bitwise"
+    assert set(entry["timings"]) == {"host", "device"}
+
+
+def test_eigh_auto_probes_with_tolerance_check(tmp_path):
+    tuning.enable()
+    auto = Strategy(np.zeros(8, np.float32), sigma=0.5,
+                    eigh_impl="auto")
+    assert auto.eigh_impl in ("lapack", "jacobi")
+    entry = _entries().get(
+        tuning.DispatchTuner().key_for("eigh_impl", (8,)))
+    assert entry is not None and entry["winner"] == auto.eigh_impl
+    # the two solvers are NOT bitwise-equal: the probe must have used
+    # the reconstruction-residual tolerance check instead
+    assert entry["identity"] == "tolerance"
+    forced = Strategy(np.zeros(8, np.float32), sigma=0.5,
+                      eigh_impl=auto.eigh_impl)
+    ga = auto.generate(jax.random.key(5), auto.initial_state())
+    gf = forced.generate(jax.random.key(5), forced.initial_state())
+    np.testing.assert_array_equal(np.asarray(ga), np.asarray(gf))
+
+
+def test_fused_variation_tuned_equals_unfused(tmp_path):
+    tuning.enable()
+    tb = Toolbox()
+    tb.register("evaluate", lambda g: g.sum(-1).astype(jnp.float32))
+    tb.register("mate", ops.cx_two_point)
+    tb.register("mutate", ops.mut_flip_bit, indpb=0.05)
+    tb.register("select", ops.sel_tournament, tournsize=3)
+    pop = evaluate_invalid(
+        init_population(jax.random.key(1), 64,
+                        ops.bernoulli_genome(23), FitnessSpec((1.0,))),
+        lambda g: g.sum(-1).astype(jnp.float32))
+    key = jax.random.key(7)
+    jpath = tmp_path / "run.jsonl"
+    with RunJournal(str(jpath)):
+        tuned = var_and(key, pop, tb, 0.5, 0.2)  # fused='auto'
+    unfused = var_and(key, pop, tb, 0.5, 0.2, fused=False)
+    for a, b in zip(jax.tree_util.tree_leaves(tuned),
+                    jax.tree_util.tree_leaves(unfused)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    rows = _decisions(jpath, "fused")
+    assert len(rows) == 1 and rows[0]["source"] == "probe"
+    assert rows[0]["identity"] == "bitwise"
+    assert rows[0]["winner"] in ("unfused", "fused_xla")
+
+
+def test_gp_mode_auto_loop_bit_identity(tmp_path):
+    """make_symbreg_loop(mode='auto') under a tuner: the mode probe
+    races the interpreters, and the resulting loop is bit-identical to
+    the same loop built with the winner forced."""
+    tuning.enable(reps=1)
+    pset = math_set(n_args=1)
+    X = np.linspace(-1, 1, P).reshape(P, 1).astype(np.float32)
+    y = (X[:, 0] ** 2 + X[:, 0]).astype(np.float32)
+    jpath = tmp_path / "run.jsonl"
+    with RunJournal(str(jpath)):
+        run_auto = make_symbreg_loop(pset, ML, X, y, mode="auto")
+    rows = _decisions(jpath, "gp_mode")
+    assert len(rows) == 1 and rows[0]["source"] == "probe"
+    winner = rows[0]["winner"]
+    assert winner in ("scan", "sweep", "grouped")
+    run_forced = make_symbreg_loop(pset, ML, X, y, mode=winner)
+    gen = make_generator(pset, ML, 1, 3, "full")
+    genomes = jax.vmap(gen)(jax.random.split(jax.random.key(3), N))
+    res_a = run_auto(jax.random.key(11), genomes, 2)
+    res_f = run_forced(jax.random.key(11), genomes, 2)
+    for k in ("genomes", "fitness", "best_genome"):
+        for a, b in zip(jax.tree_util.tree_leaves(res_a[k]),
+                        jax.tree_util.tree_leaves(res_f[k])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=k)
+    assert res_a["best_fitness"] == res_f["best_fitness"]
+
+
+# ------------------------------------------------- scheduler admission ----
+
+def _gp_job(pset, X, y, tenant="t0", seed=2, ngen=4):
+    gen = make_generator(pset, ML, 1, 3, "full")
+    founders = jax.vmap(gen)(jax.random.split(jax.random.key(seed), N))
+    return Job(tenant_id=tenant, family="gp", toolbox=None,
+               key=jax.random.key(seed), init=founders, ngen=ngen,
+               hyper={"cxpb": 0.5, "mutpb": 0.2},
+               spec=GpJobSpec(pset=pset, max_len=ML, X=X, y=y))
+
+
+def test_scheduler_admission_probe_and_solo_routing(tmp_path):
+    """The headline consumer: a cached 'solo' winner routes the bucket
+    through max_lanes=1 (the autoscaler's actuator), and a live probe
+    measures + persists a winner at first bucket creation."""
+    pset = math_set(n_args=1)
+    X = np.linspace(-1, 1, P).reshape(P, 1).astype(np.float32)
+    y = (X[:, 0] ** 2 + X[:, 0]).astype(np.float32)
+
+    # (a) cache-driven routing, no probe cost: pre-seed winner 'solo'
+    tuner = tuning.enable()
+    job = _gp_job(pset, X, y)
+    bkey = bucket_key(job)
+    tuner.record("gp_batch",
+                 (str(bkey[0]), str(bkey[1])[:16], 4, 3), "solo",
+                 timings={"solo": 0.001, "batched": 0.005},
+                 probe_s=0.1, program="seeded")
+    # fresh session over the same cache dir, so the scheduler's
+    # decision walks the (journaled) cache rung, not the session memo
+    tuning.tuner._reset_for_tests()
+    tuning.enable()
+    sched = Scheduler(str(tmp_path / "solo"), max_lanes=4,
+                      segment_len=3, telemetry=False, metrics=False)
+    sched.submit(job)
+    bucket = sched.buckets[bkey]
+    assert bucket.max_lanes == 1
+    results = sched.run()
+    assert set(results) == {"t0"}
+    rows = read_journal(os.path.join(str(tmp_path / "solo"),
+                                     "journal.jsonl"))
+    routed = [r for r in rows if r.get("kind") == "tuned_admission"]
+    assert routed and routed[0]["max_lanes"] == 1
+    cached = [r for r in rows if r.get("kind") == "tuning_decision"
+              and r.get("knob") == "gp_batch"]
+    assert cached and cached[0]["source"] == "cache"
+
+    # (b) live probe on a fresh key: different segment_len → new
+    # bucket coordinate → the probe actually runs and persists
+    tuning.tuner._reset_for_tests()
+    tuning.enable(reps=1)
+    sched2 = Scheduler(str(tmp_path / "probe"), max_lanes=4,
+                       segment_len=2, telemetry=False, metrics=False)
+    sched2.submit(_gp_job(pset, X, y, tenant="t1"))
+    rows2 = read_journal(os.path.join(str(tmp_path / "probe"),
+                                      "journal.jsonl"))
+    probed = [r for r in rows2 if r.get("kind") == "tuning_decision"
+              and r.get("knob") == "gp_batch"]
+    assert len(probed) == 1 and probed[0]["source"] == "probe"
+    assert probed[0]["identity"] == "bitwise"
+    assert set(probed[0]["timings"]) == {"batched", "solo"}
+    bucket2 = sched2.buckets[bucket_key(_gp_job(pset, X, y))]
+    expect = 1 if probed[0]["winner"] == "solo" else 4
+    assert bucket2.max_lanes == expect
+    assert set(sched2.run()) == {"t1"}
+
+
+def test_scheduler_no_tuner_no_probe(tmp_path):
+    """Tuner off: admission must not journal, probe, or touch lanes."""
+    pset = math_set(n_args=1)
+    X = np.linspace(-1, 1, P).reshape(P, 1).astype(np.float32)
+    y = (X[:, 0] ** 2 + X[:, 0]).astype(np.float32)
+    sched = Scheduler(str(tmp_path / "off"), max_lanes=4,
+                      segment_len=3, telemetry=False, metrics=False)
+    sched.submit(_gp_job(pset, X, y))
+    rows = read_journal(os.path.join(str(tmp_path / "off"),
+                                     "journal.jsonl"))
+    assert not [r for r in rows
+                if r.get("kind") in ("tuning_decision",
+                                      "tuned_admission")]
+    assert next(iter(sched.buckets.values())).max_lanes == 4
+
+
+# ------------------------------------------------------- health ledger ----
+
+def test_health_report_renders_tuning_ledger(tmp_path):
+    jpath = str(tmp_path / "run.jsonl")
+    with RunJournal(jpath) as j:
+        j.event("tuning_decision", knob="nd_impl", bucket="3/1024",
+                source="probe", winner="dc", default="matrix",
+                cache_hit=False, probe_s=0.21, identity="bitwise",
+                timings={"dc": 0.001, "matrix": 0.004},
+                program="nd_rank")
+        j.event("tuning_decision", knob="nd_impl", bucket="3/1024",
+                source="cache", winner="dc", default="matrix",
+                cache_hit=True, program="nd_rank")
+        j.event("tuning_decision", knob="fused", bucket="var_and/64",
+                source="static", winner="unfused", default="fused_xla",
+                cache_hit=False, identity="failed", reason="identity",
+                program="var_and")
+        j.event("tuning_invalidation", key="cpu/cpu/gp_mode/64",
+                program="gp_interpreter", reason="hlo_drift")
+    code = (
+        "import sys, runpy\n"
+        f"sys.argv = ['bench_report.py', '--health', {jpath!r}]\n"
+        f"runpy.run_path({os.path.join(REPO, 'bench_report.py')!r}, "
+        "run_name='__main__')\n"
+        "assert 'jax' not in sys.modules, 'ledger imported jax'\n")
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    out = r.stdout
+    assert "Tuning ledger" in out
+    assert "nd_impl" in out and "dc" in out
+    assert "identity check" in out  # the failed-identity warning
+    assert "drift eviction" in out and "gp_mode" in out
