@@ -1756,6 +1756,234 @@ def service_chaos_lines(out_path: str = "BENCH_CHAOS.json") -> list:
     return rows
 
 
+# -------------------------------- load observatory plane (ISSUE 17) ----
+
+LOADGEN_N = 40              # arrivals per gated traffic model
+LOADGEN_RATE = 20.0         # Poisson arrivals/s (compressed open loop)
+LOADGEN_JOB = dict(pop=16, length=32, ngen=12)
+LOADGEN_SEG = 3
+LOADGEN_LANES = 16
+LOADGEN_SEED = 2026         # schedule seed — committed rows must be
+#                             regenerable from (model, seed) alone
+LOADGEN_REPLAY_SPEED = 2.0  # journal replay pace multiplier
+LOADGEN_ATTR_N = 12         # arrivals per attribution arm
+LOADGEN_ATTR_DELAY_S = 3.0  # injected segment stall (the regression)
+#: open-loop pacing tolerance for the replay-fidelity gate — sleep
+#: scheduling plus per-arrival thread spawn on a busy CPU box
+LOADGEN_FIDELITY_BUDGET_S = 0.5
+
+
+def _loadgen_slos(slo_mod):
+    """The per-model gate set for the committed loadgen rows —
+    DEFAULT_SLOS thresholds recalibrated to this bench's compressed
+    open-loop burst (40 jobs in ~2 s against 16 CPU lanes queues
+    much deeper than production pacing would)."""
+    S = slo_mod.SloSpec
+    return (
+        S("admission_p99", "admission_p99", 60.0,
+          "fresh submissions admitted within 60 s at p99"),
+        S("queue_wait_p99", "queue_wait_p99", 120.0,
+          "no tenant (incl. resumes) queued over 120 s at p99"),
+        S("segment_p99", "segment_p99", 30.0,
+          "scheduler segments under 30 s at p99"),
+        S("shed_rate", "shed_rate", 0.05,
+          "under 5% of offered load shed per window"),
+        S("deadline_miss_rate", "deadline_miss_rate", 0.01,
+          "under 1% of admitted arrivals miss their deadline"),
+    )
+
+
+def loadgen_lines(out_path: str = "BENCH_LOADGEN.json") -> list:
+    """The load-observatory acceptance measurement (ISSUE 17): seeded
+    open-loop traffic models driven through real loopback sockets with
+    windowed SLO curves + gates per model, a record→replay round trip
+    (journal-reconstructed arrival process re-run at
+    ``LOADGEN_REPLAY_SPEED``× with a pacing-fidelity gate AND
+    per-tenant digest identity against the recorded run), a
+    regression-attribution demo (an injected ``segment``-seam stall
+    must be attributed to the ``segment`` phase), and the transport
+    gate: loadgen-path digests bit-identical to the same jobs through
+    the Scheduler in-process."""
+    import shutil
+    import tempfile
+
+    from deap_tpu.serving import (DiurnalTraffic, EvolutionService,
+                                  PoissonTraffic, Scheduler,
+                                  run_schedule, schedule_from_journal)
+    from deap_tpu.serving.loadgen import replay_fidelity
+    from deap_tpu.serving.wire import result_digest
+    from deap_tpu.resilience.faultinject import DelaySegment, FaultPlan
+    from deap_tpu.support.compilecache import enable_compile_cache
+    from deap_tpu.telemetry import slo as slo_mod
+    from deap_tpu.telemetry.journal import read_journal
+    from deap_tpu.telemetry.metrics import MetricsRegistry
+
+    envfp = _env_fingerprint("cpu")
+    onemax = _service_problem()
+    base_params = {k: v for k, v in LOADGEN_JOB.items()}
+
+    def problem(tid, params):
+        # loadgen arrivals share one params dict per model; the seed
+        # comes from the tenant id's numeric suffix so every tenant is
+        # a distinct, reproducible job — and a replayed tenant
+        # (``rp-<original>``) derives the SAME seed, making replay
+        # digests comparable to the recorded run's
+        p = dict(params or {})
+        p.setdefault("seed", int(tid.rsplit("-", 1)[-1]))
+        return onemax(tid, p)
+
+    work = tempfile.mkdtemp(prefix="deap_loadgen_bench_")
+    enable_compile_cache(os.path.join(work, "xla_cache"))
+    slos = _loadgen_slos(slo_mod)
+    rows = []
+
+    sched_kwargs = dict(max_lanes=LOADGEN_LANES,
+                        segment_len=LOADGEN_SEG, fair_quantum=None,
+                        checkpoint_every=0, telemetry=False)
+    warm = Scheduler(os.path.join(work, "warm"), **sched_kwargs)
+    warm.prewarm([problem("w-0-00000", base_params)],
+                 lane_counts=(4, 8, 16))
+    warm.close()
+
+    def run_model(label, model, *, schedule=None, speed=1.0,
+                  faults=None, trace=None):
+        """One traffic run on a fresh service root: open-loop drive,
+        windowed curve + journaled gates, journal rows back out."""
+        root = os.path.join(work, label)
+        svc = EvolutionService(root, {"onemax": problem},
+                               metrics=MetricsRegistry(),
+                               max_poll_s=10.0, fault_plan=faults,
+                               trace_sample=trace, **sched_kwargs)
+        sched = schedule if schedule is not None \
+            else model.schedule(seed=LOADGEN_SEED)
+        # one worker per arrival: the pacer must never block on a
+        # full pool, or the "open-loop" schedule silently degrades to
+        # closed-loop and the replay-fidelity gate measures the pool,
+        # not the pacing
+        rep = run_schedule(sched, svc.url, speed=speed,
+                           max_workers=len(sched.arrivals),
+                           poll_timeout_s=600.0, journal=svc.journal)
+        jrows = list(read_journal(os.path.join(root, "journal.jsonl")))
+        curve = slo_mod.windowed_curve(jrows, window_s=1.0)
+        gates = slo_mod.evaluate_gates(curve, slos,
+                                       journal=svc.journal,
+                                       model=sched.model, bench=label)
+        svc.close()
+        return sched, rep, jrows, curve, gates
+
+    # ---- gated traffic models: Poisson + diurnal sinusoid ----------
+    models = [
+        ("poisson", PoissonTraffic(
+            rate_per_s=LOADGEN_RATE, problem="onemax",
+            params=base_params, n=LOADGEN_N,
+            abandon_frac=0.1, abandon_range=(0.2, 1.0))),
+        ("diurnal", DiurnalTraffic(
+            base_rate=LOADGEN_RATE / 4, peak_rate=LOADGEN_RATE,
+            period_s=2.0, problem="onemax", params=base_params,
+            n=LOADGEN_N)),
+    ]
+    recorded = {}
+    for label, model in models:
+        sched, rep, jrows, curve, gates = run_model(label, model)
+        recorded[label] = (sched, rep, jrows)
+        rows.append({
+            "metric": f"loadgen_{label}_slo_green",
+            "value": all(g["ok"] for g in gates), "unit": "bool",
+            "gate": "== True", "seed": LOADGEN_SEED,
+            "arrivals": len(sched.arrivals), "counts": rep.counts,
+            "wall_s": rep.wall_s,
+            "planned_s": round(sched.duration_s, 3),
+            "gates": gates,
+            "curve": curve, **LOADGEN_JOB, "env": envfp})
+
+    # ---- transport gate: loadgen digests == in-process digests ----
+    psched, prep, pjrows = recorded["poisson"]
+    with Scheduler(os.path.join(work, "inproc"), **sched_kwargs) as s:
+        for a in psched.arrivals:
+            s.submit(problem(a.tenant_id, a.params))
+        ref = {tid: result_digest(r)
+               for tid, r in s.run().items()}
+    got = prep.digests()   # the non-abandoned overlap set
+    identical = sum(1 for tid, d in got.items() if ref.get(tid) == d)
+    rows.append({
+        "metric": "loadgen_bit_identical_frac",
+        "value": (round(identical / len(got), 6) if got else None),
+        "unit": "frac", "gate": "== 1.0", "compared": len(got),
+        "abandoned": prep.counts.get("abandoned", 0),
+        "note": "loadgen socket path vs Scheduler in-process, "
+                "non-abandoned overlap set", "env": envfp})
+
+    # ---- journal replay: reconstruct poisson's arrival process ----
+    rsched = schedule_from_journal(pjrows, "onemax",
+                                   params=base_params,
+                                   speed=LOADGEN_REPLAY_SPEED)
+    _, rrep, _, rcurve, rgates = run_model(
+        "replay", None, schedule=rsched)
+    fid = replay_fidelity(rsched, rrep.results)
+    rdig = rrep.digests()
+    rmatch = sum(1 for tid, d in rdig.items()
+                 if ref.get(tid[len("rp-"):]) == d)
+    rows.append({
+        "metric": "loadgen_replay_fidelity_s",
+        "value": fid["max_abs_err_s"], "unit": "seconds",
+        "gate": f"<= {LOADGEN_FIDELITY_BUDGET_S}",
+        "speed": LOADGEN_REPLAY_SPEED, "fidelity": fid,
+        "reconstructed": len(rsched.arrivals),
+        "recorded": len(psched.arrivals),
+        "replay_digest_identical": rmatch,
+        "replay_digests_compared": len(rdig),
+        "slo_green": all(g["ok"] for g in rgates),
+        "counts": rrep.counts, "wall_s": rrep.wall_s,
+        "note": "arrival process reconstructed from job_submitted "
+                "journal rows, re-run at 2x; digests vs the in-process "
+                "reference", "env": envfp})
+
+    # ---- attribution demo: injected segment stall names itself ----
+    attr_model = PoissonTraffic(rate_per_s=LOADGEN_RATE / 2,
+                                problem="onemax", params=base_params,
+                                n=LOADGEN_ATTR_N)
+    # discarded warm-up arm: cold compiles land INSIDE segment spans,
+    # so a cache-asymmetric base/probe pair would attribute compile
+    # warmth, not the injected stall — warm every lane count this
+    # arrival pattern packs first, then measure both arms warm
+    run_model("attr_warm", attr_model, trace=1.0)
+    _, _, base_rows, _, _ = run_model("attr_base", attr_model,
+                                      trace=1.0)
+    _, _, probe_rows, _, _ = run_model(
+        "attr_probe", attr_model, trace=1.0,
+        faults=FaultPlan([DelaySegment(2, LOADGEN_ATTR_DELAY_S,
+                                       event="segment")]))
+    att = slo_mod.attribute_regression(base_rows, probe_rows)
+    rows.append({
+        "metric": "loadgen_attribution_top_phase",
+        "value": att["top_phase"], "unit": "phase",
+        "gate": "== segment",
+        "injected_delay_s": LOADGEN_ATTR_DELAY_S,
+        "top_delta_s": att["top_delta_s"],
+        "end_to_end_delta_s": att["end_to_end_delta"],
+        "phases": att["phases"],
+        "note": "DelaySegment fired on the scheduler's in-segment "
+                "seam; the per-phase p99 diff must name the segment "
+                "phase, not just 'it got slower'", "env": envfp})
+
+    shutil.rmtree(work, ignore_errors=True)
+    cfg = {"arrivals": LOADGEN_N, "rate_per_s": LOADGEN_RATE,
+           "job": LOADGEN_JOB, "segment_len": LOADGEN_SEG,
+           "lanes": LOADGEN_LANES, "seed": LOADGEN_SEED,
+           "replay_speed": LOADGEN_REPLAY_SPEED,
+           "attr_delay_s": LOADGEN_ATTR_DELAY_S}
+    if out_path:
+        payload = {
+            "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "env": envfp,
+            "config": cfg,
+            "tail": "\n".join(json.dumps(r) for r in rows),
+        }
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=1)
+    return rows
+
+
 # ---------------------------------- resilience overhead (pop=100k) ----
 
 #: headline config length for the paired segmented-vs-monolithic rows
@@ -3025,6 +3253,20 @@ if __name__ == "__main__":
         out = (nxt if nxt and not nxt.startswith("--")
                else "BENCH_CHAOS.json")
         for row in service_chaos_lines(out):
+            print(json.dumps(row), flush=True)
+    elif "--loadgen" in sys.argv:
+        # the load-observatory acceptance measurement (ISSUE 17):
+        # seeded open-loop traffic models with windowed SLO curves +
+        # gates, journal record→replay with a pacing-fidelity gate +
+        # digest identity, and the segment-stall attribution demo —
+        # committed as BENCH_LOADGEN.json; bench_report.py --tripwire
+        # gates green SLOs / fidelity / bit-identity / "segment"
+        jax.config.update("jax_platforms", "cpu")
+        i = sys.argv.index("--loadgen")
+        nxt = sys.argv[i + 1] if i + 1 < len(sys.argv) else None
+        out = (nxt if nxt and not nxt.startswith("--")
+               else "BENCH_LOADGEN.json")
+        for row in loadgen_lines(out):
             print(json.dumps(row), flush=True)
     elif "--tracing" in sys.argv:
         # the tracing-overhead acceptance measurement (ISSUE 15): the

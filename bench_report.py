@@ -852,6 +852,96 @@ def tuning_tripwire() -> int:
     return tripped
 
 
+#: pacing-fidelity budget for the replay gate — matches
+#: bench.LOADGEN_FIDELITY_BUDGET_S
+LOADGEN_FIDELITY_BUDGET_S = 0.5
+
+
+def loadgen_tripwire(budget_s: float = LOADGEN_FIDELITY_BUDGET_S
+                     ) -> int:
+    """The load-observatory gate (ISSUE 17), over the latest committed
+    BENCH_LOADGEN*.json: (1) every gated traffic model's windowed SLO
+    curve green (``loadgen_*_slo_green`` rows), (2) the journal
+    record→replay row within the pacing-fidelity budget AND every
+    replayed digest bit-identical to the in-process reference, (3)
+    the loadgen transport path bit-identical to direct Scheduler
+    submission over the non-abandoned overlap set, and (4) the
+    regression-attribution demo naming the ``segment`` phase — the
+    whole point of the per-phase decomposition is a *named* culprit.
+    Returns the number of tripped rows."""
+    files = sorted(glob.glob(os.path.join(HERE,
+                                          "BENCH_LOADGEN*.json")))
+    if not files:
+        print("loadgen tripwire: no committed BENCH_LOADGEN*.json yet")
+        return 0
+    rows = _bench_rows(files[-1])
+    tripped = 0
+    print(f"\n## Load observatory ({os.path.basename(files[-1])})\n")
+
+    slo_rows = {m: r for m, r in rows.items()
+                if m.startswith("loadgen_") and m.endswith("_slo_green")}
+    if len(slo_rows) < 2:
+        print(f"- only {len(slo_rows)} gated traffic model(s) "
+              "committed (acceptance: >= 2)")
+        tripped += 1
+    for metric, row in sorted(slo_rows.items()):
+        model = metric[len("loadgen_"):-len("_slo_green")]
+        ok = row.get("value") is True
+        bad = [g for g in row.get("gates", []) if not g.get("ok")]
+        print(f"- {model}: {row.get('arrivals', '?')} arrival(s), "
+              f"counts {row.get('counts')} "
+              + ("— all SLO gates green ok" if ok else
+                 "**REGRESSION** (breached: "
+                 + ", ".join(f"{g['slo']}={g.get('worst')}"
+                             for g in bad) + ")"))
+        tripped += 0 if ok else 1
+
+    rep = rows.get("loadgen_replay_fidelity_s")
+    if rep is None or not isinstance(rep.get("value"), (int, float)):
+        print("- replay-fidelity row missing (journal record→replay "
+              "is part of the acceptance)")
+        tripped += 1
+    else:
+        ok_pace = rep["value"] <= budget_s
+        n_dig = rep.get("replay_digests_compared", 0)
+        ok_dig = (n_dig > 0
+                  and rep.get("replay_digest_identical") == n_dig)
+        print(f"- replay at {rep.get('speed', '?')}x: "
+              f"{rep.get('reconstructed', '?')} arrival(s) "
+              f"reconstructed, max pacing error {rep['value']}s "
+              f"(budget {budget_s}s), digests "
+              f"{rep.get('replay_digest_identical', '?')}/{n_dig} "
+              "identical to reference "
+              + ("ok" if ok_pace and ok_dig else
+                 "**REGRESSION** ("
+                 + ("replay pacing drifted" if not ok_pace else
+                    "replayed jobs diverged from the recorded run")
+                 + ")"))
+        tripped += 0 if (ok_pace and ok_dig) else 1
+
+    bit = rows.get("loadgen_bit_identical_frac")
+    if bit is None or bit.get("value") != 1.0:
+        print(f"- **REGRESSION**: loadgen-path digest identity "
+              f"{(bit or {}).get('value', '?')} (gate: 1.0) — the "
+              "load generator is changing results")
+        tripped += 1
+    else:
+        print(f"- transport: {bit.get('compared', '?')} non-abandoned "
+              "tenant(s) bit-identical to in-process ok")
+
+    att = rows.get("loadgen_attribution_top_phase")
+    if att is None or att.get("value") != "segment":
+        print(f"- **REGRESSION**: attribution named "
+              f"{(att or {}).get('value')!r} (expected 'segment') — "
+              "the injected segment stall was mis-attributed")
+        tripped += 1
+    else:
+        print(f"- attribution: injected "
+              f"{att.get('injected_delay_s', '?')}s segment stall → "
+              f"'segment' +{att.get('top_delta_s', '?')}s at p99 ok")
+    return tripped
+
+
 def tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
     """Diff the two most recent committed ``BENCH_r*.json`` files and
     flag regressions; then the gp_symbreg paired rows
@@ -880,6 +970,7 @@ def tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
     tripped += costs_tripwire()
     tripped += tracing_tripwire()
     tripped += tuning_tripwire()
+    tripped += loadgen_tripwire()
     return tripped
 
 

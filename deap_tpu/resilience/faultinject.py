@@ -63,10 +63,11 @@ from typing import Any, List, Optional
 import jax.numpy as jnp
 
 __all__ = ["InjectedCrash", "InjectedTransient", "InjectedDrop",
-           "Fault", "FaultPlan", "KillAt", "PreemptAt",
-           "CorruptCheckpoint", "FailSegments", "DropResponse",
-           "DelaySegment", "KillServiceAt", "TornWAL",
-           "nan_inject_evaluate", "corrupt_file"]
+           "InjectedReject", "Fault", "FaultPlan", "KillAt",
+           "PreemptAt", "CorruptCheckpoint", "FailSegments",
+           "DropResponse", "Reject429", "DelaySegment",
+           "KillServiceAt", "TornWAL", "nan_inject_evaluate",
+           "corrupt_file"]
 
 
 class InjectedCrash(RuntimeError):
@@ -84,6 +85,18 @@ class InjectedDrop(RuntimeError):
     """A simulated lost response: the service's HTTP handler catches
     this and closes the connection without writing a reply — the
     client-visible shape of a network partition mid-response."""
+
+
+class InjectedReject(RuntimeError):
+    """A simulated overload rejection: the service's HTTP handler
+    catches this and answers 429 + ``Retry-After`` *instead of* the
+    real response — the deterministic 429 source behind the load
+    generator's thundering-herd retry-storm model (every rejected
+    client backs off the same ``Retry-After`` and returns at once)."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
 
 
 class Fault:
@@ -248,10 +261,39 @@ class DropResponse(Fault):
                 f"(#{self.fired}/{self.times})")
 
 
+class Reject429(Fault):
+    """Answer the next ``times`` requests whose route contains
+    ``route_substr`` with 429 + ``Retry-After: retry_after_s`` —
+    fired on the service's ``http_response`` event. Like
+    :class:`DropResponse` it fires *after* processing (the request's
+    server-side effects stand), so pair it with submit idempotency
+    keys; its value is determinism — the retry storm hits exactly
+    when scheduled, independent of real load."""
+
+    def __init__(self, route_substr: str, times: int = 1,
+                 retry_after_s: float = 1.0):
+        super().__init__()
+        self.route_substr = str(route_substr)
+        self.times = int(times)
+        self.retry_after_s = float(retry_after_s)
+
+    def fire(self, event: str, **ctx) -> None:
+        if event == "http_response" and self.fired < self.times \
+                and self.route_substr in str(ctx.get("route", "")):
+            self.fired += 1
+            raise InjectedReject(
+                f"injected 429 on {ctx.get('route')} "
+                f"(#{self.fired}/{self.times})",
+                retry_after_s=self.retry_after_s)
+
+
 class DelaySegment(Fault):
     """Wedge the driver thread for ``delay_s`` seconds at driver step
-    ``step`` (event ``step``, or ``boundary`` with ``event='boundary'``)
-    — the deterministic hung-segment stand-in the watchdog must
+    ``step`` (event ``step``, ``boundary`` with ``event='boundary'``,
+    or — the regression-attribution seam — ``segment``, which the
+    service fires INSIDE the scheduler's segment-latency window so
+    the injected stall lands in the segment spans and histogram) —
+    the deterministic hung-segment stand-in the watchdog must
     detect and, once the sleep returns, recover from."""
 
     def __init__(self, step: int, delay_s: float, event: str = "step"):

@@ -50,17 +50,43 @@ from deap_tpu.serving.autoscale import (
     AutoscalePolicy,
 )
 from deap_tpu.serving.service import EvolutionService
-from deap_tpu.serving.client import ServiceClient, ServiceError
+from deap_tpu.serving.client import (
+    ClientAbandoned,
+    ServiceClient,
+    ServiceError,
+)
+from deap_tpu.serving.loadgen import (
+    Arrival,
+    DiurnalTraffic,
+    LoadgenReport,
+    ParetoMixTraffic,
+    PoissonTraffic,
+    Schedule,
+    ThunderingHerd,
+    TrafficModel,
+    replay_fidelity,
+    run_schedule,
+    schedule_from_journal,
+)
 from deap_tpu.serving.wal import AdmissionWAL
 from deap_tpu.support.compilecache import enable_compile_cache
 
 __all__ = [
     "AdmissionWAL",
+    "Arrival",
     "AutoscaleConfig",
     "AutoscaleDecision",
     "AutoscalePolicy",
+    "ClientAbandoned",
+    "DiurnalTraffic",
     "EvolutionService",
     "FAMILIES",
+    "LoadgenReport",
+    "ParetoMixTraffic",
+    "PoissonTraffic",
+    "Schedule",
+    "ThunderingHerd",
+    "TrafficModel",
     "GpJobSpec",
     "GpMultiRunEngine",
     "IslandJobSpec",
@@ -77,4 +103,7 @@ __all__ = [
     "multirun",
     "pad_pow2",
     "prewarm",
+    "replay_fidelity",
+    "run_schedule",
+    "schedule_from_journal",
 ]

@@ -24,7 +24,9 @@ from __future__ import annotations
 import http.client
 import json
 import os
+import socket
 import sys
+import threading
 import urllib.parse
 from typing import Any, Dict, Iterator, List, Optional
 
@@ -60,7 +62,18 @@ else:
     tracing = _load("_deap_tpu_telemetry_tracing_standalone",
                     _os.pardir, "telemetry", "tracing.py")
 
-__all__ = ["ServiceClient", "ServiceError", "RetryPolicy"]
+__all__ = ["ClientAbandoned", "ServiceClient", "ServiceError",
+           "RetryPolicy"]
+
+
+class ClientAbandoned(RuntimeError):
+    """Raised locally when this client's ``abandon_after_s`` fired:
+    the long-poll socket was closed mid-wait (the load generator's
+    impatient-client model). The *server* never sees an error — its
+    handler thread wakes at ``view.done`` or the ``max_poll_s`` clamp,
+    the response write fails with a caught ``BrokenPipeError``, and
+    the tenant keeps running (now idle: ``gens_since_interaction``
+    grows until the autoscaler spills it)."""
 
 
 class ServiceError(RuntimeError):
@@ -100,14 +113,25 @@ class ServiceClient:
 
     def __init__(self, base_url: str, token: Optional[str] = None,
                  timeout: float = 600.0,
-                 retry: Optional[RetryPolicy] = None):
+                 retry: Optional[RetryPolicy] = None,
+                 abandon_after_s: Optional[float] = None):
         u = urllib.parse.urlparse(base_url)
         self.host = u.hostname or "127.0.0.1"
         self.port = u.port or 80
         self.token = token
         self.timeout = timeout
         self.retry = retry
+        #: abandonment model (ISSUE 17): when set, any long-poll
+        #: request (``wait=1``) has its socket closed after this many
+        #: seconds and raises :class:`ClientAbandoned` — never
+        #: retried, the caller walked away. Seed-drawn per arrival by
+        #: the load generator (``serving/loadgen.py``).
+        self.abandon_after_s = (float(abandon_after_s)
+                                if abandon_after_s is not None
+                                else None)
         self._conn: Optional[http.client.HTTPConnection] = None
+        self._abandon_timer: Optional[threading.Timer] = None
+        self._abandoned = False
         self._rid_seq = 0
 
     # ------------------------------------------------------- plumbing ----
@@ -141,16 +165,47 @@ class ServiceClient:
                 self.host, self.port, timeout=self.timeout)
         return self._conn
 
+    def _abandon(self) -> None:
+        """The abandonment timer's target: close the live connection
+        mid-long-poll. ``shutdown`` before ``close`` — closing alone
+        doesn't wake the thread blocked in ``recv``; shutdown delivers
+        it an immediate EOF. ``_request`` sees the ``_abandoned`` flag
+        and raises :class:`ClientAbandoned` instead of retrying."""
+        self._abandoned = True
+        conn, self._conn = self._conn, None
+        if conn is not None:
+            try:
+                if conn.sock is not None:
+                    conn.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
     def _request_once(self, method: str, path: str,
                       body: Optional[dict] = None,
                       request_id: Optional[str] = None):
         conn = self._connect()
-        conn.request(method, path,
-                     body=(json.dumps(body).encode()
-                           if body is not None else None),
-                     headers=self._headers(request_id))
-        resp = conn.getresponse()
-        return resp, resp.read()
+        timer = None
+        if self.abandon_after_s is not None and "wait=1" in path:
+            timer = threading.Timer(self.abandon_after_s,
+                                    self._abandon)
+            timer.daemon = True
+            self._abandon_timer = timer
+            timer.start()
+        try:
+            conn.request(method, path,
+                         body=(json.dumps(body).encode()
+                               if body is not None else None),
+                         headers=self._headers(request_id))
+            resp = conn.getresponse()
+            return resp, resp.read()
+        finally:
+            if timer is not None:
+                timer.cancel()
+                self._abandon_timer = None
 
     def _request(self, method: str, path: str,
                  body: Optional[dict] = None) -> Any:
@@ -165,6 +220,14 @@ class ServiceClient:
                                                 request_id=rid)
             except (http.client.HTTPException, ConnectionError,
                     OSError):
+                if self._abandoned:
+                    # our own abandonment timer closed the socket —
+                    # final by design, the modelled client walked away
+                    self._abandoned = False
+                    self.close()
+                    raise ClientAbandoned(
+                        f"abandoned long-poll after "
+                        f"{self.abandon_after_s}s: {method} {path}")
                 # stale keep-alive or a killed/restarting service:
                 # reconnect and (with a policy) back off jittered
                 self.close()

@@ -1,0 +1,520 @@
+"""Seeded open-loop load generator over the service's wire surface.
+
+The load observatory's traffic plane (ISSUE 17). Every committed bench
+before this drove synchronized bursts of identical tenants — nothing
+production-shaped. This module generates **deterministic, seeded
+arrival schedules** under parameterized traffic models and replays
+them open-loop (arrivals fire at their scheduled instants regardless
+of how the service is coping — the model that actually finds
+queueing collapse) through :class:`~deap_tpu.serving.client.
+ServiceClient`:
+
+- :class:`PoissonTraffic` — memoryless arrivals at a fixed rate;
+- :class:`DiurnalTraffic` — a sinusoidally-modulated Poisson process
+  (thinning), the day/night load shape;
+- :class:`ParetoMixTraffic` — heavy-tailed job sizes (``ngen`` drawn
+  from a Pareto tail) across a weighted family mix;
+- :class:`ThunderingHerd` — a synchronized burst, for retry-storm
+  drills against injected 429s (:class:`~deap_tpu.resilience.
+  faultinject.Reject429`);
+- **client abandonment** — any model can mark a fraction of arrivals
+  with a seeded ``abandon_after_s``; their pollers close the socket
+  mid-long-poll (:class:`~deap_tpu.serving.client.ClientAbandoned`)
+  and the tenant idles server-side until spilled.
+
+Determinism contract: a schedule is a pure function of
+``(model parameters, seed)`` — no wall clock, no ambient RNG — and
+:meth:`Schedule.to_jsonl` is byte-identical across runs
+(``tests/test_loadgen.py`` pins it). Execution is wall-clock paced,
+but *what* arrives and *when it was meant to* arrive is replayable.
+
+**Journal replay**: :func:`schedule_from_journal` reconstructs the
+arrival process of any past run from its journal's ``job_submitted``
+rows (monotonic ``t`` stamps) and turns it back into a
+:class:`Schedule` — any incident or bench becomes a reproducible
+workload, replayable at 1×/N× speed against a live service.
+
+Like the client it rides on, this module never initialises an XLA
+backend: importable standalone on a submit box with no jax.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import random
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+if "deap_tpu" in sys.modules:
+    from deap_tpu.serving.client import (ClientAbandoned, RetryPolicy,
+                                         ServiceClient, ServiceError)
+else:
+    # standalone load (no-jax box): pull the client in by file path —
+    # it handles its own codec/retry/tracing standalone loads
+    import importlib.util as _ilu
+    import os as _os
+
+    _spec = _ilu.spec_from_file_location(
+        "_deap_tpu_serving_client_standalone",
+        _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                      "client.py"))
+    _client = _ilu.module_from_spec(_spec)
+    sys.modules["_deap_tpu_serving_client_standalone"] = _client
+    _spec.loader.exec_module(_client)
+    ClientAbandoned = _client.ClientAbandoned
+    RetryPolicy = _client.RetryPolicy
+    ServiceClient = _client.ServiceClient
+    ServiceError = _client.ServiceError
+
+__all__ = ["Arrival", "Schedule", "TrafficModel", "PoissonTraffic",
+           "DiurnalTraffic", "ParetoMixTraffic", "ThunderingHerd",
+           "LoadgenReport", "run_schedule", "schedule_from_journal",
+           "replay_fidelity"]
+
+
+# ------------------------------------------------------- schedule ----
+
+@dataclass(frozen=True)
+class Arrival:
+    """One scheduled submission: offset ``t`` seconds from run start,
+    the registered problem + params, a deterministic tenant id, and
+    the client-behaviour draws (abandonment, storm membership)."""
+
+    t: float
+    problem: str
+    params: Dict[str, Any]
+    tenant_id: str
+    family: str = "ea"
+    abandon_after_s: Optional[float] = None
+    storm: bool = False
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"t": round(self.t, 6), "problem": self.problem,
+             "params": self.params, "tenant_id": self.tenant_id,
+             "family": self.family,
+             "abandon_after_s": self.abandon_after_s,
+             "storm": self.storm}, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, line: str) -> "Arrival":
+        d = json.loads(line)
+        return cls(t=float(d["t"]), problem=d["problem"],
+                   params=d["params"], tenant_id=d["tenant_id"],
+                   family=d.get("family", "ea"),
+                   abandon_after_s=d.get("abandon_after_s"),
+                   storm=bool(d.get("storm", False)))
+
+
+@dataclass(frozen=True)
+class Schedule:
+    """A fully-materialized arrival process. ``to_jsonl`` is the
+    determinism surface: same model + seed → byte-identical text."""
+
+    model: str
+    seed: Optional[int]
+    arrivals: Tuple[Arrival, ...]
+
+    @property
+    def duration_s(self) -> float:
+        return self.arrivals[-1].t if self.arrivals else 0.0
+
+    def to_jsonl(self) -> str:
+        head = json.dumps({"model": self.model, "seed": self.seed,
+                           "n": len(self.arrivals)}, sort_keys=True)
+        return "\n".join([head] + [a.to_json()
+                                   for a in self.arrivals]) + "\n"
+
+    @classmethod
+    def from_jsonl(cls, text: str) -> "Schedule":
+        lines = [ln for ln in text.splitlines() if ln.strip()]
+        head = json.loads(lines[0])
+        return cls(model=head["model"], seed=head.get("seed"),
+                   arrivals=tuple(Arrival.from_json(ln)
+                                  for ln in lines[1:]))
+
+
+def _tid(model: str, seed: Optional[int], i: int) -> str:
+    return f"lg-{model}-{seed}-{i:05d}"
+
+
+class TrafficModel:
+    """Base: subclasses draw arrivals from one ``random.Random(seed)``
+    — the only entropy source; touching the wall clock or the global
+    RNG here would break the byte-identical-schedule contract."""
+
+    name = "base"
+
+    def __init__(self, problem: str, params: Optional[dict] = None,
+                 n: int = 100, abandon_frac: float = 0.0,
+                 abandon_range: Tuple[float, float] = (0.25, 2.0)):
+        self.problem = str(problem)
+        self.params = dict(params or {})
+        self.n = int(n)
+        self.abandon_frac = float(abandon_frac)
+        self.abandon_range = (float(abandon_range[0]),
+                              float(abandon_range[1]))
+
+    def _offsets(self, rng: random.Random) -> List[float]:
+        raise NotImplementedError
+
+    def _arrival(self, rng: random.Random, seed: Optional[int],
+                 i: int, t: float) -> Arrival:
+        abandon = None
+        if self.abandon_frac and rng.random() < self.abandon_frac:
+            abandon = round(rng.uniform(*self.abandon_range), 4)
+        return Arrival(t=round(t, 6), problem=self.problem,
+                       params=dict(self.params),
+                       tenant_id=_tid(self.name, seed, i),
+                       abandon_after_s=abandon)
+
+    def schedule(self, seed: int) -> Schedule:
+        rng = random.Random(int(seed))
+        arrivals = [self._arrival(rng, seed, i, t)
+                    for i, t in enumerate(self._offsets(rng))]
+        return Schedule(model=self.name, seed=int(seed),
+                        arrivals=tuple(arrivals))
+
+
+class PoissonTraffic(TrafficModel):
+    """Memoryless arrivals: exponential inter-arrival times at
+    ``rate_per_s``."""
+
+    name = "poisson"
+
+    def __init__(self, rate_per_s: float, **kw):
+        super().__init__(**kw)
+        self.rate_per_s = float(rate_per_s)
+
+    def _offsets(self, rng: random.Random) -> List[float]:
+        t, out = 0.0, []
+        for _ in range(self.n):
+            t += rng.expovariate(self.rate_per_s)
+            out.append(t)
+        return out
+
+
+class DiurnalTraffic(TrafficModel):
+    """A non-homogeneous Poisson process with sinusoidal intensity
+    (trough ``base_rate`` → crest ``peak_rate`` over ``period_s``),
+    generated by Lewis–Shedler thinning: candidates at the peak rate,
+    each kept with probability ``rate(t)/peak_rate``. The compressed
+    day/night shape every production arrival log shows."""
+
+    name = "diurnal"
+
+    def __init__(self, base_rate: float, peak_rate: float,
+                 period_s: float, **kw):
+        super().__init__(**kw)
+        if peak_rate < base_rate:
+            raise ValueError("peak_rate must be >= base_rate")
+        self.base_rate = float(base_rate)
+        self.peak_rate = float(peak_rate)
+        self.period_s = float(period_s)
+
+    def _rate(self, t: float) -> float:
+        swing = (self.peak_rate - self.base_rate) / 2.0
+        mid = self.base_rate + swing
+        return mid - swing * math.cos(2 * math.pi * t / self.period_s)
+
+    def _offsets(self, rng: random.Random) -> List[float]:
+        t, out = 0.0, []
+        while len(out) < self.n:
+            t += rng.expovariate(self.peak_rate)
+            if rng.random() <= self._rate(t) / self.peak_rate:
+                out.append(t)
+        return out
+
+
+class ParetoMixTraffic(TrafficModel):
+    """Heavy-tailed job sizes over a weighted family mix: each
+    arrival's ``ngen`` is ``ngen_min * Pareto(alpha)`` capped at
+    ``ngen_cap`` (alpha ≤ 2 → infinite-variance tails, the "one whale
+    tenant behind forty minnows" shape), drawn for a problem picked
+    from ``mix``: ``(family_tag, problem, base_params, weight)``
+    tuples spanning whatever EA/CMA/GP/island problems the target
+    service registers."""
+
+    name = "pareto_mix"
+
+    def __init__(self, rate_per_s: float,
+                 mix: Sequence[Tuple[str, str, dict, float]],
+                 alpha: float = 1.5, ngen_min: int = 10,
+                 ngen_cap: int = 640, **kw):
+        kw.setdefault("problem", mix[0][1])
+        super().__init__(**kw)
+        self.rate_per_s = float(rate_per_s)
+        self.mix = [(str(f), str(p), dict(par), float(w))
+                    for f, p, par, w in mix]
+        self.alpha = float(alpha)
+        self.ngen_min = int(ngen_min)
+        self.ngen_cap = int(ngen_cap)
+
+    def _offsets(self, rng: random.Random) -> List[float]:
+        t, out = 0.0, []
+        for _ in range(self.n):
+            t += rng.expovariate(self.rate_per_s)
+            out.append(t)
+        return out
+
+    def _arrival(self, rng, seed, i, t) -> Arrival:
+        weights = [w for _, _, _, w in self.mix]
+        fam, problem, base, _ = rng.choices(self.mix,
+                                            weights=weights)[0]
+        ngen = min(self.ngen_cap,
+                   int(self.ngen_min * rng.paretovariate(self.alpha)))
+        params = {**self.params, **base, "ngen": ngen}
+        abandon = None
+        if self.abandon_frac and rng.random() < self.abandon_frac:
+            abandon = round(rng.uniform(*self.abandon_range), 4)
+        return Arrival(t=round(t, 6), problem=problem, params=params,
+                       tenant_id=_tid(self.name, seed, i), family=fam,
+                       abandon_after_s=abandon)
+
+
+class ThunderingHerd(TrafficModel):
+    """A synchronized burst at ``at_s`` (± seeded ``jitter_s``): every
+    arrival is storm-flagged, so :func:`run_schedule` gives it a
+    retrying client — against a service injecting 429s
+    (:class:`~deap_tpu.resilience.faultinject.Reject429`) or a real
+    ``max_pending`` shed, all rejected clients honour the same
+    ``Retry-After`` and come back as one herd."""
+
+    name = "herd"
+
+    def __init__(self, at_s: float = 0.0, jitter_s: float = 0.05,
+                 **kw):
+        super().__init__(**kw)
+        self.at_s = float(at_s)
+        self.jitter_s = float(jitter_s)
+
+    def _offsets(self, rng: random.Random) -> List[float]:
+        return sorted(self.at_s + rng.uniform(0.0, self.jitter_s)
+                      for _ in range(self.n))
+
+    def _arrival(self, rng, seed, i, t) -> Arrival:
+        a = super()._arrival(rng, seed, i, t)
+        return Arrival(t=a.t, problem=a.problem, params=a.params,
+                       tenant_id=a.tenant_id, family=a.family,
+                       abandon_after_s=a.abandon_after_s, storm=True)
+
+
+# --------------------------------------------------------- replay ----
+
+def _read_rows(source) -> List[Dict[str, Any]]:
+    """Journal rows from a path (torn-tail tolerant, like
+    ``read_journal``) or pass-through from an iterable of dicts."""
+    if not isinstance(source, (str, bytes)):
+        return [r for r in source if isinstance(r, dict)]
+    rows = []
+    with open(source, "r") as fh:
+        for line in fh:
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn tail / partial write
+    return rows
+
+
+def schedule_from_journal(source, problem: str,
+                          params: Optional[dict] = None,
+                          speed: float = 1.0,
+                          use_ngen: bool = True,
+                          tenant_prefix: str = "rp-"
+                          ) -> Schedule:
+    """Reconstruct the arrival process of a recorded run from its
+    journal and return it as a replayable :class:`Schedule`.
+
+    ``job_submitted`` rows carry the scheduler-side admission instants
+    as monotonic ``t`` stamps (datable via the header's
+    ``wall_start``); their deltas ARE the recorded inter-arrival
+    process. ``speed=2.0`` replays at twice the recorded pace
+    (offsets halved). Job *content* is re-anchored to ``problem`` /
+    ``params`` (journals don't record submit params) with each row's
+    recorded ``ngen`` preserved by default — the arrival process and
+    per-job size profile of the incident, against today's problem
+    registry."""
+    rows = [r for r in _read_rows(source)
+            if r.get("kind") == "job_submitted"
+            and isinstance(r.get("t"), (int, float))]
+    if not rows:
+        return Schedule(model="replay", seed=None, arrivals=())
+    speed = float(speed)
+    if speed <= 0:
+        raise ValueError("speed must be positive")
+    rows.sort(key=lambda r: r["t"])
+    t0 = rows[0]["t"]
+    arrivals = []
+    for i, r in enumerate(rows):
+        p = dict(params or {})
+        if use_ngen and r.get("ngen") is not None:
+            p.setdefault("ngen", int(r["ngen"]))
+        arrivals.append(Arrival(
+            t=round((r["t"] - t0) / speed, 6), problem=problem,
+            params=p,
+            tenant_id=f"{tenant_prefix}{r.get('tenant_id', i)}",
+            family=str(r.get("family", "ea"))))
+    return Schedule(model="replay", seed=None,
+                    arrivals=tuple(arrivals))
+
+
+def replay_fidelity(recorded: Schedule, results:
+                    Sequence["ArrivalResult"]) -> Dict[str, Any]:
+    """How faithfully a run reproduced its schedule: per-arrival
+    absolute error between scheduled and actual submit offsets (both
+    re-anchored to their first arrival), max/mean seconds."""
+    actual = {r.tenant_id: r.submit_t for r in results
+              if r.submit_t is not None}
+    pairs = [(a.t, actual[a.tenant_id]) for a in recorded.arrivals
+             if a.tenant_id in actual]
+    if not pairs:
+        return {"n": 0, "max_abs_err_s": None, "mean_abs_err_s": None}
+    base_s = min(t for t, _ in pairs)
+    base_a = min(t for _, t in pairs)
+    errs = [abs((ta - base_a) - (ts - base_s)) for ts, ta in pairs]
+    return {"n": len(errs),
+            "max_abs_err_s": round(max(errs), 4),
+            "mean_abs_err_s": round(sum(errs) / len(errs), 4)}
+
+
+# --------------------------------------------------------- runner ----
+
+#: Job statuses after which polling stops — everything else
+#: ("queued", "running", "evicted", ...) means keep waiting.
+_TERMINAL = frozenset(
+    {"finished", "stopped", "failed", "drained", "deadline_exceeded"})
+
+
+@dataclass
+class ArrivalResult:
+    """One arrival's fate: scheduled vs actual submit offset, final
+    status (``finished`` / ``abandoned`` / ``shed`` / ``error``) and
+    the result digest when one was fetched."""
+
+    tenant_id: str
+    sched_t: float
+    submit_t: Optional[float] = None
+    status: str = "pending"
+    digest: Optional[str] = None
+    gen: Optional[int] = None
+    error: Optional[str] = None
+
+
+@dataclass
+class LoadgenReport:
+    """A run's outcome: per-arrival results + tallies."""
+
+    model: str
+    seed: Optional[int]
+    speed: float
+    wall_s: float
+    results: List[ArrivalResult] = field(default_factory=list)
+
+    @property
+    def counts(self) -> Dict[str, int]:
+        c: Dict[str, int] = {}
+        for r in self.results:
+            c[r.status] = c.get(r.status, 0) + 1
+        return c
+
+    def digests(self) -> Dict[str, str]:
+        return {r.tenant_id: r.digest for r in self.results
+                if r.digest is not None}
+
+
+def run_schedule(schedule: Schedule, base_url: str,
+                 token: Optional[str] = None, *,
+                 speed: float = 1.0,
+                 max_workers: int = 16,
+                 poll_timeout_s: float = 600.0,
+                 storm_retry: Optional[RetryPolicy] = None,
+                 journal=None) -> LoadgenReport:
+    """Replay ``schedule`` against a live service, **open-loop**: each
+    arrival fires at its scheduled offset (scaled by ``speed``)
+    whether or not earlier ones completed — a saturated service gets
+    *more* load, not a politely waiting client. Each arrival runs on
+    its own worker with its own :class:`ServiceClient` (one
+    connection per thread, the client's contract): submit (with the
+    tenant id as idempotency key — storm retries must not
+    double-admit), then long-poll the result; abandonment draws close
+    the poll socket mid-wait. With a ``journal``, the run lands as
+    one ``loadgen_run`` row next to the service's own evidence."""
+    speed = float(speed)
+    if speed <= 0:
+        raise ValueError("speed must be positive")
+    arrivals = sorted(schedule.arrivals, key=lambda a: a.t)
+    results = {a.tenant_id: ArrivalResult(a.tenant_id, a.t)
+               for a in arrivals}
+    sem = threading.Semaphore(max(1, int(max_workers)))
+    threads: List[threading.Thread] = []
+    t_run0 = time.monotonic()
+
+    def _work(a: Arrival) -> None:
+        res = results[a.tenant_id]
+        try:
+            retry = storm_retry if a.storm else None
+            with ServiceClient(base_url, token=token,
+                               timeout=poll_timeout_s, retry=retry,
+                               abandon_after_s=a.abandon_after_s
+                               ) as client:
+                res.submit_t = time.monotonic() - t_run0
+                client.submit(a.problem, params=a.params,
+                              tenant_id=a.tenant_id,
+                              idempotency_key=a.tenant_id)
+                # The service clamps each long-poll to its own
+                # max_poll_s and returns a non-terminal snapshot, so
+                # poll in a loop until a terminal status or the
+                # overall budget runs out.
+                deadline = time.monotonic() + poll_timeout_s
+                out: Dict[str, Any] = {}
+                while True:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    out = client.result(a.tenant_id, wait=True,
+                                        timeout=left)
+                    if out.get("status", "finished") in _TERMINAL:
+                        break
+                res.status = out.get("status", "pending")
+                res.gen = out.get("gen")
+                r = out.get("result") or {}
+                res.digest = r.get("digest")
+        except ClientAbandoned:
+            res.status = "abandoned"
+        except ServiceError as e:
+            res.status = "shed" if e.code == 429 else "error"
+            res.error = f"HTTP {e.code}"
+        except Exception as e:  # noqa: BLE001 — per-arrival isolation
+            res.status = "error"
+            res.error = f"{type(e).__name__}: {e}"
+        finally:
+            sem.release()
+
+    for a in arrivals:
+        # open-loop pacing: sleep to the arrival's instant, then fire
+        delay = a.t / speed - (time.monotonic() - t_run0)
+        if delay > 0:
+            time.sleep(delay)
+        sem.acquire()
+        th = threading.Thread(target=_work, args=(a,), daemon=True,
+                              name=f"loadgen-{a.tenant_id}")
+        threads.append(th)
+        th.start()
+    for th in threads:
+        th.join()
+    report = LoadgenReport(model=schedule.model, seed=schedule.seed,
+                           speed=speed,
+                           wall_s=round(time.monotonic() - t_run0, 4),
+                           results=[results[a.tenant_id]
+                                    for a in arrivals])
+    if journal is not None:
+        journal.event("loadgen_run", model=schedule.model,
+                      seed=schedule.seed, speed=speed,
+                      n_arrivals=len(arrivals),
+                      planned_s=round(schedule.duration_s / speed, 4),
+                      wall_s=report.wall_s, **report.counts)
+    return report

@@ -51,7 +51,10 @@ from deap_tpu.serving.tenant import Job, Tenant, bucket_key, pad_pow2
 from deap_tpu.support.compilecache import enable_compile_cache
 from deap_tpu.telemetry import tracing
 from deap_tpu.telemetry.meter import Meter
-from deap_tpu.telemetry.metrics import (MetricsServer, phase_histogram,
+from deap_tpu.telemetry.metrics import (MetricsServer,
+                                        SERVING_SEGMENT_BUCKETS,
+                                        SERVING_WAIT_BUCKETS,
+                                        phase_histogram,
                                         resolve_registry, serve_metrics)
 from deap_tpu.telemetry.run import RunTelemetry
 
@@ -104,14 +107,18 @@ class _ServingInstruments:
             "deap_serving_lane_occupancy",
             "fraction of max_lanes holding a resident tenant",
             labels=("bucket",))
+        # per-metric bucket overrides (ISSUE 17): BENCH_SERVICE.json
+        # measured burst queue-wait p99 at 14.2 s — DEFAULT_BUCKETS
+        # would round any windowed percentile past 10 s up to the
+        # 30 s bound; these tuples keep burst-range reads finite
         self.queue_wait_s = registry.histogram(
             "deap_serving_queue_wait_seconds",
             "seconds from submission/eviction to (re)admission",
-            labels=("bucket",))
+            labels=("bucket",), buckets=SERVING_WAIT_BUCKETS)
         self.segment_s = registry.histogram(
             "deap_serving_segment_seconds",
             "wall seconds per scheduler segment (advance + drain sync)",
-            labels=("bucket",))
+            labels=("bucket",), buckets=SERVING_SEGMENT_BUCKETS)
         self.admissions = registry.counter(
             "deap_serving_admissions_total",
             "fresh tenant admissions", labels=("bucket",))
@@ -199,6 +206,7 @@ class Scheduler:
                  metrics=True,
                  resume_tenants: bool = False,
                  boundary_cb: Optional[Callable] = None,
+                 fault_hook: Optional[Callable] = None,
                  trace_sample: Optional[float] = None):
         self.root = str(root)
         os.makedirs(self.root, exist_ok=True)
@@ -223,6 +231,15 @@ class Scheduler:
         #: per-tenant dicts (tenant, gen_before, gen, chunk, finished)
         #: — the service's streaming fan-out point
         self.boundary_cb = boundary_cb
+        #: optional ``hook(event, **ctx)`` fired at the scheduler's
+        #: deterministic fault seams — today one seam: ``"segment"``,
+        #: fired between a segment's device dispatch and its drain
+        #: barrier, i.e. INSIDE the segment-latency measurement
+        #: window. The service wires this to its fault plan so a
+        #: :class:`~deap_tpu.resilience.faultinject.DelaySegment`
+        #: with ``event="segment"`` shows up in the segment phase's
+        #: spans/histogram — the attribution-demo seam (ISSUE 17)
+        self.fault_hook = fault_hook
         from deap_tpu.telemetry.journal import RunJournal
         self.journal = RunJournal(
             os.path.join(self.root, "journal.jsonl"),
@@ -270,6 +287,16 @@ class Scheduler:
         self._rr: List[Any] = []  # round-robin bucket order
         self._spill: set = set()  # tenant ids to swap out at the
         #                           next boundary (autoscaler pressure)
+        # load counters (ISSUE 17): arrivals per bucket label plus
+        # global sheds / deadline misses. Their OWN lock, not the
+        # _exclusive guard — the service's request threads increment
+        # sheds/misses while the driver owns the scheduler, and the
+        # per-boundary `slo` journal row folds the cumulative values
+        # in so windowed rates compute from the journal alone
+        self._load_lock = threading.Lock()
+        self._arrivals: Dict[str, int] = {}
+        self._sheds = 0
+        self._deadline_misses = 0
         # single-threaded-contract guard: RLock so the owner re-enters
         # (run → step), non-blocking so a second thread gets a loud
         # SchedulerBusyError instead of silently corrupted buckets
@@ -351,6 +378,9 @@ class Scheduler:
                            family=job.family, ngen=int(job.ngen),
                            bucket=repr(bkey[:2]),
                            **self._rid(tenant))
+        with self._load_lock:
+            self._arrivals[bucket.label] = \
+                self._arrivals.get(bucket.label, 0) + 1
         if self._minst is not None:
             self._minst.queue_depth.set(len(bucket.queue),
                                         bucket=bucket.label)
@@ -515,6 +545,11 @@ class Scheduler:
                 batch, seg = bucket.engine.advance(bucket.batch,
                                                    self.segment_len)
             bucket.batch = batch
+            if self.fault_hook is not None:
+                # in-segment fault seam: between device dispatch and
+                # the drain barrier — a DelaySegment here lands inside
+                # seg_s, the segment spans and the segment histogram
+                self.fault_hook("segment", bucket=bucket.label)
             self._drain_boundary(bucket, seg, t_start=t0)
             return True
 
@@ -834,6 +869,14 @@ class Scheduler:
             slo["segment_s"] = round(seg_s, 6)
             if seg_s > 0:
                 slo["gens_per_sec"] = round(gens_advanced / seg_s, 3)
+        # cumulative load counters (ISSUE 17): journal-only consumers
+        # (loadgen SLO curves, report.py --slo) difference consecutive
+        # rows for windowed arrival/shed/deadline-miss rates — no
+        # /metrics scrape needed
+        with self._load_lock:
+            slo["arrivals"] = self._arrivals.get(bucket.label, 0)
+            slo["sheds"] = self._sheds
+            slo["deadline_misses"] = self._deadline_misses
         self.journal.event("slo", **slo)
         if self._minst is not None:
             if seg_s is not None:
@@ -880,6 +923,30 @@ class Scheduler:
                 raise KeyError(f"unknown tenant {tenant_id!r}")
             self._spill.add(tenant_id)
 
+    def note_shed(self, n: int = 1) -> None:
+        """Count ``n`` load-shed submissions (429s). Callable from ANY
+        thread — the service's request handlers shed while the driver
+        owns the scheduler, so this deliberately bypasses the
+        ``_exclusive`` contract (its own lock, touches nothing the
+        driver mutates). Folded into every per-boundary ``slo``
+        journal row and :meth:`slo_snapshot`."""
+        with self._load_lock:
+            self._sheds += int(n)
+
+    def note_deadline_miss(self, n: int = 1) -> None:
+        """Count ``n`` admission-deadline misses (504s) — same
+        any-thread contract as :meth:`note_shed`."""
+        with self._load_lock:
+            self._deadline_misses += int(n)
+
+    def load_counts(self) -> Dict[str, Any]:
+        """Cumulative load counters: ``{"arrivals": {label: n},
+        "sheds": n, "deadline_misses": n}`` — any-thread safe."""
+        with self._load_lock:
+            return {"arrivals": dict(self._arrivals),
+                    "sheds": self._sheds,
+                    "deadline_misses": self._deadline_misses}
+
     def slo_snapshot(self) -> Dict[str, Dict[str, Any]]:
         """Per-bucket control-plane sensor read: queue depth, lane
         budget/residency/occupancy, queue-wait p99 (bucket-resolution,
@@ -893,6 +960,10 @@ class Scheduler:
         tenants over mid-job residents whose clients are long-polling
         (the BENCH_SERVICE bursty-pair spill-thrash fix)."""
         with self._exclusive("slo_snapshot"):
+            with self._load_lock:
+                arrivals = dict(self._arrivals)
+                sheds = self._sheds
+                misses = self._deadline_misses
             snap: Dict[str, Dict[str, Any]] = {}
             for b in self.buckets.values():
                 wait_p99 = None
@@ -906,6 +977,9 @@ class Scheduler:
                     "lanes": b.max_lanes,
                     "occupancy": len(b.residents) / b.max_lanes,
                     "queue_wait_p99": wait_p99,
+                    "arrivals": arrivals.get(b.label, 0),
+                    "sheds": sheds,
+                    "deadline_misses": misses,
                     "idle": tuple((t.id, t.segments_resident,
                                    t.gens_since_interaction)
                                   for t in b.residents),

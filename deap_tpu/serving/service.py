@@ -140,7 +140,7 @@ import traceback
 import urllib.parse
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from deap_tpu.resilience.faultinject import InjectedDrop
+from deap_tpu.resilience.faultinject import InjectedDrop, InjectedReject
 from deap_tpu.serving import wire
 from deap_tpu.serving.autoscale import AutoscaleConfig, AutoscalePolicy
 from deap_tpu.serving.scheduler import Scheduler
@@ -258,8 +258,10 @@ class EvolutionService:
         receiving the watchdog's ``driver_stall`` alarms.
     :param fault_plan: a :class:`~deap_tpu.resilience.faultinject.
         FaultPlan` fired at the service's deterministic seams
-        (``step`` / ``boundary`` / ``http_response`` / ``wal_append``)
-        — the chaos-test hook.
+        (``step`` / ``boundary`` / ``segment`` / ``http_response`` /
+        ``wal_append``; ``segment`` fires INSIDE the scheduler's
+        segment-latency window, so a ``DelaySegment`` there is
+        attributable to the segment phase) — the chaos-test hook.
     :param step_hook: optional ``hook(step_count)`` run on the driver
         thread after every scheduler step — the deterministic
         fault-injection seam (drain-mid-segment tests, bursty-load
@@ -305,6 +307,7 @@ class EvolutionService:
         scheduler_kwargs.setdefault("resume_tenants", True)
         self.scheduler = Scheduler(self.root,
                                    boundary_cb=self._on_boundary,
+                                   fault_hook=self._sched_fault,
                                    **scheduler_kwargs)
         self.journal = self.scheduler.journal
 
@@ -460,6 +463,13 @@ class EvolutionService:
     def _fire_fault(self, event: str, **ctx) -> None:
         if self.fault_plan is not None:
             self.fault_plan.fire(event, **ctx)
+
+    def _sched_fault(self, event: str, **ctx) -> None:
+        """The scheduler's fault seam (``fault_hook``), stamped with
+        the driver step count so step-addressed faults
+        (``DelaySegment(step=n, event="segment")``) fire inside the
+        segment-latency window of a chosen step."""
+        self._fire_fault(event, step=self._steps + 1, **ctx)
 
     # ------------------------------------------------------- tracing ----
 
@@ -687,6 +697,7 @@ class EvolutionService:
             self.journal.event("deadline_exceeded", tenant_id=tid,
                                problem=problem, stage="driver",
                                request_id=view.request_id)
+            self.scheduler.note_deadline_miss()
             self._wal_done(tid, "deadline_exceeded")
             self._publish(tid, {"event": "deadline_exceeded",
                                 "tenant_id": tid})
@@ -943,6 +954,7 @@ class EvolutionService:
                                new=n_new,
                                max_pending=self.max_pending,
                                request_id=request_id)
+            self.scheduler.note_shed(n_new)
             raise _HttpError(
                 429,
                 f"overloaded: {active} jobs in flight + {n_new} new "
@@ -1104,6 +1116,7 @@ class EvolutionService:
                                    problem=s.get("problem"),
                                    stage="frontend",
                                    request_id=request_id)
+                self.scheduler.note_deadline_miss()
                 raise _HttpError(504, "deadline expired before "
                                       "admission")
 
@@ -1183,6 +1196,7 @@ class EvolutionService:
                 self.journal.event(
                     "load_shed", reason="command_queue_full",
                     new=len(fresh), request_id=request_id)
+                self.scheduler.note_shed(len(fresh))
                 raise _HttpError(
                     429, "command queue full; retry later",
                     headers={"Retry-After": self._retry_after()})
@@ -1374,13 +1388,28 @@ class _Handler(http.server.BaseHTTPRequestHandler):
 
     def _drop_check(self, route: str) -> bool:
         """Fire the fault plan's ``http_response`` seam; True means
-        an injected drop — close the connection without replying
-        (the request's server-side effects stand)."""
+        the fault already decided the response — an injected drop
+        (connection closed without replying) or an injected 429
+        (answered here with Retry-After). Either way the request's
+        server-side effects stand."""
         try:
             self.svc._fire_fault("http_response", route=route,
                                  method=self.command)
         except InjectedDrop:
             self.close_connection = True
+            return True
+        except InjectedReject as e:
+            # the loadgen's deterministic retry-storm source: every
+            # rejected client sees the same Retry-After and comes
+            # back in one herd — counted as a shed like a real 429
+            self.svc.journal.event("load_shed", reason="injected_429",
+                                   route=route)
+            self.svc.scheduler.note_shed()
+            self._respond(
+                429, "application/json",
+                json.dumps({"error": str(e)}).encode(),
+                extra={"Retry-After":
+                       str(max(1, int(round(e.retry_after_s))))})
             return True
         return False
 

@@ -54,10 +54,19 @@ from deap_tpu.telemetry.journal import (
 )
 from deap_tpu.telemetry.meter import Meter, MeterState
 from deap_tpu.telemetry.metrics import (
+    HistogramSnapshot,
     MetricsRegistry,
     get_registry,
     metrics_text,
     serve_metrics,
+)
+from deap_tpu.telemetry.slo import (
+    DEFAULT_SLOS,
+    SLO_JOURNAL_KINDS,
+    SloSpec,
+    attribute_regression,
+    evaluate_gates,
+    windowed_curve,
 )
 from deap_tpu.telemetry.probes import (
     PROBE_REGISTRY,
@@ -76,9 +85,13 @@ from deap_tpu.telemetry.probes import (
 from deap_tpu.telemetry.run import RunTelemetry, strategy_probe
 
 __all__ = [
+    "DEFAULT_SLOS",
+    "HistogramSnapshot",
     "Meter",
     "MeterState",
     "MetricsRegistry",
+    "SLO_JOURNAL_KINDS",
+    "SloSpec",
     "PROBE_REGISTRY",
     "Probe",
     "ProgramObservatory",
@@ -91,8 +104,11 @@ __all__ = [
     "QuarantineProbe",
     "RunJournal",
     "RunTelemetry",
+    "attribute_regression",
     "broadcast",
     "compose_probes",
+    "evaluate_gates",
+    "windowed_curve",
     "environment_fingerprint",
     "exact_hypervolume",
     "get_registry",

@@ -46,14 +46,44 @@ import math
 import threading
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
-__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "MetricsServer", "get_registry", "metrics_text",
-           "phase_histogram", "serve_metrics"]
+__all__ = ["Counter", "Gauge", "Histogram", "HistogramSnapshot",
+           "MetricsRegistry", "MetricsServer", "SERVING_PHASE_BUCKETS",
+           "SERVING_SEGMENT_BUCKETS", "SERVING_WAIT_BUCKETS",
+           "get_registry", "metrics_text", "phase_histogram",
+           "serve_metrics"]
 
 #: default histogram bucket bounds (seconds) — spans sub-ms host work
 #: to multi-minute compiles; ``+Inf`` is implicit
 DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+# ---- per-metric serving bucket overrides (ISSUE 17 satellite) ----
+# BENCH_SERVICE.json measured queue-wait p99 at 14.2 s under the
+# bursty pair — with DEFAULT_BUCKETS every observation past 10 s
+# collapses into the 30 s bucket and a windowed p99 reads "30.0" for
+# anything between 10.001 and 30 s. These tuples keep bucket-
+# resolution percentiles finite and useful across the measured burst
+# range (and well past it: abandoned-tenant waits can reach minutes
+# before the autoscaler spills them).
+
+#: queue-wait / admission latency (seconds): dense through the
+#: measured 10–60 s burst range, finite out to 10 minutes
+SERVING_WAIT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                        1.0, 2.5, 5.0, 10.0, 15.0, 20.0, 30.0, 45.0,
+                        60.0, 90.0, 120.0, 300.0, 600.0)
+
+#: scheduler segment wall seconds: sub-ms device steps through
+#: fault-injected multi-second stalls (DelaySegment) without
+#: saturating
+SERVING_SEGMENT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                           0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 20.0,
+                           30.0, 60.0, 120.0, 300.0)
+
+#: per-phase request latency (tracing plane): spans sub-ms WAL
+#: fsyncs to multi-minute compiles and burst queue waits
+SERVING_PHASE_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                         0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                         15.0, 30.0, 60.0, 120.0, 300.0, 600.0)
 
 _NAME_OK = frozenset(
     "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_:")
@@ -198,6 +228,56 @@ class _HistChild:
         self.n = 0
 
 
+class HistogramSnapshot:
+    """A point-in-time copy of one histogram child's cumulative state
+    — the windowed-percentile primitive (ISSUE 17).
+
+    Prometheus histograms are cumulative: ``counts``/``total``/``n``
+    only ever grow, so a quantile over the raw child mixes every
+    observation since process start. Subtracting two snapshots
+    (:meth:`delta`) yields the distribution of exactly the
+    observations that landed *between* them, and :meth:`quantile` on
+    the delta is the windowed percentile that SLO curves
+    (:mod:`deap_tpu.telemetry.slo`) gate on."""
+
+    __slots__ = ("buckets", "counts", "total", "n")
+
+    def __init__(self, buckets: Tuple[float, ...],
+                 counts: Sequence[int], total: float, n: int):
+        self.buckets = tuple(buckets)
+        self.counts = tuple(counts)
+        self.total = float(total)
+        self.n = int(n)
+
+    def delta(self, earlier: "HistogramSnapshot") -> "HistogramSnapshot":
+        """The observations between ``earlier`` and ``self`` (both
+        snapshots of the same histogram child, ``earlier`` taken
+        first)."""
+        if self.buckets != earlier.buckets:
+            raise ValueError("snapshot bucket bounds differ — not the "
+                             "same histogram")
+        return HistogramSnapshot(
+            self.buckets,
+            [a - b for a, b in zip(self.counts, earlier.counts)],
+            self.total - earlier.total, self.n - earlier.n)
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Bucket-resolution quantile over this snapshot's (or
+        delta's) observations; ``None`` when empty, ``+Inf`` past the
+        top finite bucket — same contract as
+        :meth:`Histogram.quantile`."""
+        if self.n <= 0:
+            return None
+        rank = q * self.n
+        for bound, c in zip(self.buckets, self.counts):
+            if c >= rank:
+                return bound
+        return float("inf")
+
+    def mean(self) -> Optional[float]:
+        return self.total / self.n if self.n > 0 else None
+
+
 class Histogram(_Instrument):
     """Cumulative-bucket distribution with exact sum/count. Buckets are
     upper bounds (``le``); the ``+Inf`` bucket is implicit and always
@@ -238,6 +318,27 @@ class Histogram(_Instrument):
                     return bound
             return float("inf")
 
+    def snapshot(self, **labels: str) -> HistogramSnapshot:
+        """A consistent point-in-time copy of one child's cumulative
+        state. An unobserved label set snapshots as all-zero (so
+        ``later.delta(earlier)`` works uniformly across children that
+        appear mid-window)."""
+        with self._lock:
+            key = _labels_key(self.labels, labels)
+            child = self._children.get(key)
+            if child is None:
+                return HistogramSnapshot(
+                    self.buckets, [0] * len(self.buckets), 0.0, 0)
+            return HistogramSnapshot(self.buckets, list(child.counts),
+                                     child.total, child.n)
+
+    def label_sets(self) -> List[Dict[str, str]]:
+        """The label sets observed so far — e.g. every ``phase`` the
+        tracing plane has fed ``deap_service_phase_seconds``."""
+        with self._lock:
+            return [dict(zip(self.labels, key))
+                    for key in sorted(self._children)]
+
     def samples(self):
         for key in sorted(self._children):
             child = self._children[key]
@@ -273,6 +374,16 @@ class MetricsRegistry:
                         f"metric {name!r} re-declared as {cls.__name__}"
                         f"{tuple(labels)} (was {type(inst).__name__}"
                         f"{inst.labels})")
+                want = kw.get("buckets")
+                if want is not None and isinstance(inst, Histogram) \
+                        and inst.buckets != tuple(
+                            sorted(float(b) for b in want)):
+                    # a silent bucket mismatch would make a per-metric
+                    # override a no-op — the saturation bug would
+                    # survive looking fixed
+                    raise ValueError(
+                        f"histogram {name!r} re-declared with buckets "
+                        f"{tuple(want)} (was {inst.buckets})")
                 return inst
             inst = cls(name, help, labels, self._lock, **kw)
             self._instruments[name] = inst
@@ -390,7 +501,7 @@ def phase_histogram(registry: Optional[MetricsRegistry] = None
         "Per-phase request latency from the tracing plane "
         "(queue_wait, wal_fsync, admission, compile, device, "
         "checkpoint, wire_encode, replay, build).",
-        labels=("phase",))
+        labels=("phase",), buckets=SERVING_PHASE_BUCKETS)
 
 
 def metrics_text(registry: Optional[MetricsRegistry] = None) -> str:

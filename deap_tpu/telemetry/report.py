@@ -27,13 +27,15 @@ import os
 import sys
 from typing import Any, Dict, List, Optional
 
-__all__ = ["render_report", "render_trace", "sparkline", "main"]
+__all__ = ["render_attribution", "render_report", "render_slo",
+           "render_trace", "sparkline", "main"]
 
 _SPARK = "▁▂▃▄▅▆▇█"
 _MAX_SPARK = 48  # terminal budget per series
 
 _journal_mod = None
 _tracing_mod = None
+_slo_mod = None
 
 
 def _journal():
@@ -69,6 +71,24 @@ def _tracing():
         spec.loader.exec_module(mod)
         _tracing_mod = mod
     return _tracing_mod
+
+
+def _slo():
+    """slo.py loaded standalone — same no-jax guarantee as
+    :func:`_journal` (slo.py is pure stdlib)."""
+    global _slo_mod
+    if _slo_mod is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "slo.py")
+        spec = importlib.util.spec_from_file_location(
+            "_deap_tpu_slo_standalone", path)
+        mod = importlib.util.module_from_spec(spec)
+        # dataclass processing resolves cls.__module__ through
+        # sys.modules — register before exec
+        sys.modules[spec.name] = mod
+        spec.loader.exec_module(mod)
+        _slo_mod = mod
+    return _slo_mod
 
 
 def sparkline(values: List[float], width: int = _MAX_SPARK) -> str:
@@ -293,6 +313,38 @@ def _slo_section(events: List[Dict[str, Any]], out: List[str]) -> None:
         for e in evicted[:10]:
             out.append(f"  - gen {e.get('gen')}: {e.get('tenant_id')} "
                        "evicted (checkpoint swap unit)")
+
+
+def _loadgen_section(events: List[Dict[str, Any]], out: List[str]
+                     ) -> None:
+    """Load-observatory evidence: the ``loadgen_run`` rows (one per
+    generated traffic run) and the ``slo_gate`` verdict table the run
+    journaled next to them."""
+    runs = [e for e in events if e.get("kind") == "loadgen_run"]
+    gates = [e for e in events if e.get("kind") == "slo_gate"]
+    if not (runs or gates):
+        return
+    out.append("")
+    out.append("## Load observatory")
+    for e in runs:
+        tallies = ", ".join(
+            f"{k}×{v}" for k, v in sorted(e.items())
+            if k not in ("kind", "t", "model", "seed", "speed",
+                         "n_arrivals", "planned_s", "wall_s"))
+        out.append(f"- loadgen {e.get('model')} (seed "
+                   f"{e.get('seed')}, ×{_fmt(e.get('speed', 1.0))}): "
+                   f"{e.get('n_arrivals')} arrival(s) over "
+                   f"{_fmt(e.get('wall_s'))}s "
+                   f"(planned {_fmt(e.get('planned_s'))}s)"
+                   + (f" — {tallies}" if tallies else ""))
+    if gates:
+        bad = [g for g in gates if not g.get("ok")]
+        out.append(f"- SLO gates: {len(gates) - len(bad)}/{len(gates)} "
+                   "green" + (" — **breaches:**" if bad else ""))
+        for g in bad:
+            out.append(f"  - ▲ {g.get('slo')}: worst "
+                       f"{_fmt(g.get('worst'))} > threshold "
+                       f"{_fmt(g.get('threshold'))}")
 
 
 def _service_section(events: List[Dict[str, Any]], out: List[str]
@@ -532,6 +584,7 @@ def render_report(path: str, lines: Optional[List[str]] = None) -> str:
         # wide planes (SLO timeline, compiled programs, flight
         # recorder) and the summary still apply to the process
         _slo_section(events, out)
+        _loadgen_section(events, out)
         _service_section(events, out)
         _program_table(events, out)
         _memory_section(events, out)
@@ -791,6 +844,94 @@ def render_trace(path: str, ident: str,
     return "\n".join(out)
 
 
+def _fmt_opt(v: Any) -> str:
+    return "—" if v is None else _fmt(v)
+
+
+def render_slo(path: str, window_s: float = 1.0) -> str:
+    """The windowed SLO-curve table + gate verdicts for one journal —
+    the ``report.py --slo`` view (stdlib-only, like the health
+    report)."""
+    sl = _slo()
+    if os.path.isdir(path):
+        path = os.path.join(path, "journal.jsonl")
+    events = _journal().read_journal(path)
+    curve = sl.windowed_curve(events, window_s=window_s)
+    out: List[str] = []
+    out.append(f"# SLO curves: {os.path.basename(path)}")
+    out.append("")
+    if not curve:
+        out.append("- no timestamped rows — nothing to window")
+        return "\n".join(out)
+    out.append(f"- {len(curve)} window(s) of {_fmt(window_s)}s")
+    out.append("")
+    out.append("| window | arrivals/s | shed | ddl miss | adm p99 s "
+               "| wait p99 s | seg p99 s |")
+    out.append("|---|---|---|---|---|---|---|")
+    for w in curve:
+        out.append(
+            f"| {_fmt(w['t0'])}–{_fmt(w['t1'])} "
+            f"| {_fmt(w['arrival_rate'])} "
+            f"| {_fmt(w['shed_rate'])} "
+            f"| {_fmt(w['deadline_miss_rate'])} "
+            f"| {_fmt_opt(w['admission_p99'])} "
+            f"| {_fmt_opt(w['queue_wait_p99'])} "
+            f"| {_fmt_opt(w['segment_p99'])} |")
+    out.append("")
+    out.append("## Gates (worst window vs threshold)")
+    out.append("")
+    out.append("| gate | metric | threshold | worst | verdict |")
+    out.append("|---|---|---|---|---|")
+    for g in sl.evaluate_gates(curve):
+        out.append(f"| {g['slo']} | {g['metric']} "
+                   f"| {_fmt(g['threshold'])} | {_fmt_opt(g['worst'])} "
+                   f"| {'ok' if g['ok'] else '**FAIL**'} |")
+    return "\n".join(out)
+
+
+def render_attribution(base_path: str, probe_path: str,
+                       q: float = 0.99) -> str:
+    """Per-phase regression attribution between two journals (base,
+    probe) — the two-journal form of ``report.py --slo``."""
+    sl = _slo()
+    jm = _journal()
+    paths = []
+    for p in (base_path, probe_path):
+        if os.path.isdir(p):
+            p = os.path.join(p, "journal.jsonl")
+        paths.append(p)
+    base = jm.read_journal(paths[0])
+    probe = jm.read_journal(paths[1])
+    att = sl.attribute_regression(base, probe, q=q)
+    out: List[str] = []
+    out.append(f"# Regression attribution (p{int(q * 100)}): "
+               f"{os.path.basename(paths[0])} → "
+               f"{os.path.basename(paths[1])}")
+    out.append("")
+    out.append(f"- end to end: {_fmt_opt(att['end_to_end_base'])}s → "
+               f"{_fmt_opt(att['end_to_end_probe'])}s "
+               f"(Δ {_fmt_opt(att['end_to_end_delta'])}s)")
+    if att["top_phase"]:
+        out.append(f"- **top regressing phase: {att['top_phase']} "
+                   f"+{_fmt(att['top_delta_s'])}s**")
+    else:
+        out.append("- no phase regressed")
+    if att["phases"]:
+        out.append("")
+        out.append("| phase | base s | probe s | Δ s | n base "
+                   "| n probe |")
+        out.append("|---|---|---|---|---|---|")
+        for row in att["phases"]:
+            out.append(f"| {row['phase']} | {_fmt_opt(row['base_q'])} "
+                       f"| {_fmt_opt(row['probe_q'])} "
+                       f"| {_fmt(row['delta_s'])} | {row['n_base']} "
+                       f"| {row['n_probe']} |")
+    else:
+        out.append("- no trace_span rows in either journal — run the "
+                   "service with trace_sample set")
+    return "\n".join(out)
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     trace_id = perfetto = None
@@ -810,12 +951,33 @@ def main(argv=None) -> int:
             return 2
         perfetto = argv[i + 1]
         del argv[i:i + 2]
+    slo_view = "--slo" in argv
+    if slo_view:
+        argv.remove("--slo")
+    window_s = 1.0
+    if "--window" in argv:
+        i = argv.index("--window")
+        if i + 1 >= len(argv):
+            print("--window needs a seconds value", file=sys.stderr)
+            return 2
+        window_s = float(argv[i + 1])
+        del argv[i:i + 2]
     paths = [a for a in argv if not a.startswith("-")]
     if not paths:
         print("usage: report.py [--trace <request-id|tenant-id> "
-              "[--perfetto out.json]] <journal.jsonl> [...]",
+              "[--perfetto out.json]] [--slo [--window s]] "
+              "<journal.jsonl> [...]",
               file=sys.stderr)
         return 2
+    if slo_view:
+        # one journal: windowed curves + gates; two journals:
+        # curves for each, then base → probe attribution
+        for p in paths:
+            print(render_slo(p, window_s=window_s))
+        if len(paths) == 2:
+            print()
+            print(render_attribution(paths[0], paths[1]))
+        return 0
     for p in paths:
         if trace_id is not None:
             print(render_trace(p, trace_id, perfetto_out=perfetto))

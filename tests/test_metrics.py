@@ -113,6 +113,67 @@ def test_histogram_quantile_and_values():
     assert c.value() == 3.0
 
 
+def test_histogram_snapshot_delta_windowed_quantile():
+    """Cumulative counts cannot give windowed percentiles — the
+    snapshot/delta pair can: a window's quantile comes from the delta
+    of its edge snapshots, not the all-time counts."""
+    reg = MetricsRegistry()
+    h = reg.histogram("w", buckets=(0.1, 0.25, 1.0), labels=("b",))
+    h.observe(0.05, b="x")
+    h.observe(0.05, b="x")
+    s0 = h.snapshot(b="x")
+    h.observe(0.2, b="x")
+    h.observe(0.2, b="x")
+    s1 = h.snapshot(b="x")
+    win = s1.delta(s0)
+    assert win.n == 2
+    assert win.quantile(0.5) == 0.25       # window: only the 0.2s
+    assert s1.quantile(0.5) == 0.1         # cumulative disagrees
+    assert abs(win.mean() - 0.2) < 1e-9
+    # unobserved label set → all-zero snapshot, quantile None
+    empty = h.snapshot(b="never")
+    assert empty.n == 0 and empty.quantile(0.99) is None
+    assert h.label_sets() == [{"b": "x"}]
+    # bucket-shape mismatch between snapshots is a hard error
+    other = reg.histogram("w2", buckets=(1.0, 2.0))
+    other.observe(0.5)
+    with pytest.raises(ValueError):
+        other.snapshot().delta(s0)
+    # past the top bucket the quantile saturates at +Inf
+    h.observe(50.0, b="x")
+    assert h.snapshot(b="x").quantile(1.0) == float("inf")
+
+
+def test_serving_wait_buckets_resolve_long_observations():
+    """The bucket-boundary audit (ISSUE 17): DEFAULT_BUCKETS top out
+    at 10 s, so every longer queue wait collapsed into +Inf — the
+    serving overrides must pin >10 s observations to a finite
+    bucket."""
+    from deap_tpu.telemetry.metrics import (SERVING_PHASE_BUCKETS,
+                                            SERVING_SEGMENT_BUCKETS,
+                                            SERVING_WAIT_BUCKETS)
+    reg = MetricsRegistry()
+    h = reg.histogram("wait_s", buckets=SERVING_WAIT_BUCKETS)
+    h.observe(14.2)
+    assert h.quantile(0.99) == 15.0        # finite, not +Inf
+    assert h.quantile(0.99) != float("inf")
+    for bs in (SERVING_WAIT_BUCKETS, SERVING_SEGMENT_BUCKETS,
+               SERVING_PHASE_BUCKETS):
+        assert list(bs) == sorted(bs)
+        assert max(bs) >= 120.0
+
+
+def test_histogram_redeclare_bucket_mismatch_raises():
+    """Re-declaring a histogram with different buckets silently kept
+    the first shape before the audit; now it is a hard error — two
+    call sites disagreeing on boundaries is a bug, not a preference."""
+    reg = MetricsRegistry()
+    reg.histogram("lat_s", buckets=(0.1, 1.0))
+    assert reg.histogram("lat_s", buckets=(1.0, 0.1)) is not None
+    with pytest.raises(ValueError):
+        reg.histogram("lat_s", buckets=(0.1, 2.0))
+
+
 def test_resolve_registry_convention():
     reg = MetricsRegistry()
     assert resolve_registry(None) is None

@@ -20,6 +20,7 @@ import signal
 import subprocess
 import sys
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -521,3 +522,120 @@ def test_gp_tenant_wal_replay_bit_exact(tmp_path):
     kinds = [r.get("kind") for r in rows]
     assert "wal_replay" in kinds
     assert "tenant_resumed" in kinds
+
+
+def test_client_abandonment_leaves_service_healthy(tmp_path):
+    """The loadgen's impatient-client model (ISSUE 17): a client whose
+    ``abandon_after_s`` fires mid-long-poll gets a local
+    :class:`ClientAbandoned` — the service never sees an error, the
+    tenant keeps running, and a patient client later collects the
+    bit-identical result."""
+    from deap_tpu.serving import ClientAbandoned
+
+    ref = _inprocess_digests(tmp_path / "ref",
+                             [_onemax_job("tA", {"seed": 2,
+                                                 "ngen": 12})])["tA"]
+    with EvolutionService(str(tmp_path / "svc"), PROBLEMS,
+                          max_lanes=2, segment_len=2,
+                          metrics=MetricsRegistry()) as svc:
+        impatient = ServiceClient(svc.url, abandon_after_s=0.2)
+        impatient.submit("onemax", params={"seed": 2, "ngen": 12},
+                         tenant_id="tA")
+        with pytest.raises(ClientAbandoned):
+            impatient.result("tA", wait=True, timeout=120)
+        # nobody polls an abandoned tenant: its idleness clock grows
+        # with every generation, which is exactly what makes it the
+        # autoscaler's preferred spill victim (attribute reads only —
+        # a result poll would count as an interaction and reset it)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            t = svc.scheduler.tenants.get("tA")
+            if t is not None and t.gen >= 4:
+                break
+            time.sleep(0.05)
+        assert t is not None and t.gens_since_interaction > 0
+        # the abandonment is local: the service stays responsive and
+        # the abandoned tenant runs to completion for anyone who asks
+        patient = ServiceClient(svc.url)
+        assert patient.healthz()["status"] == "ok"
+        res = patient.result("tA", wait=True, timeout=120)
+        assert res["status"] == "finished"
+        assert res["result"]["digest"] == ref
+        # non-wait requests never arm the abandon timer
+        impatient2 = ServiceClient(svc.url, abandon_after_s=0.01)
+        assert impatient2.result("tA", wait=False)["status"] \
+            == "finished"
+
+
+def test_slo_rows_carry_load_counters(tmp_path):
+    """Every per-boundary ``slo`` journal row folds in the cumulative
+    arrival / shed / deadline-miss counters (ISSUE 17) so the windowed
+    SLO curves compute from the journal alone — and
+    ``slo_snapshot()`` exposes the same counters live."""
+    with EvolutionService(str(tmp_path), PROBLEMS, max_lanes=2,
+                          segment_len=2, max_pending=1,
+                          metrics=MetricsRegistry()) as svc:
+        c = ServiceClient(svc.url)
+        c.submit("onemax", params={"seed": 1, "ngen": 8},
+                 tenant_id="tA")
+        # past max_pending: shed with 429 + Retry-After, counted
+        with pytest.raises(ServiceError) as ei:
+            c.submit("onemax", params={"seed": 2}, tenant_id="tB")
+        assert ei.value.code == 429
+        assert ei.value.retry_after is not None
+        c.result("tA", wait=True, timeout=120)
+        counts = svc.scheduler.load_counts()  # any-thread safe
+        assert counts["sheds"] == 1
+        assert sum(counts["arrivals"].values()) == 1
+        jpath = svc.journal.path
+    rows = read_journal(jpath)
+    slo = [r for r in rows if r.get("kind") == "slo"]
+    assert slo, "no slo rows journaled"
+    for r in slo:
+        assert "arrivals" in r and "sheds" in r \
+            and "deadline_misses" in r
+    # cumulative: the last row carries the final shed count
+    assert slo[-1]["sheds"] == 1
+    assert any(r.get("kind") == "load_shed" for r in rows)
+    # slo_snapshot() folds the same counters in (driverless scheduler
+    # here: with a service attached it must go through the driver)
+    with Scheduler(str(tmp_path / "snap"), max_lanes=2,
+                   segment_len=2) as s:
+        s.submit(_onemax_job("tS", {"seed": 1, "ngen": 2}))
+        s.note_shed(3)
+        s.note_deadline_miss()
+        snap = s.slo_snapshot()
+        assert snap and all(
+            b["sheds"] == 3 and b["deadline_misses"] == 1
+            and b["arrivals"] == 1 for b in snap.values())
+
+
+def test_injected_429_counts_as_shed(tmp_path):
+    """:class:`Reject429` — the loadgen's deterministic retry-storm
+    source — answers a submit with 429 + ``Retry-After`` *after* the
+    server-side effects stand: journaled ``load_shed`` with
+    ``reason='injected_429'``, counted by ``note_shed``, and the job
+    (already admitted) still finishes."""
+    from deap_tpu.resilience.faultinject import FaultPlan, Reject429
+
+    plan = FaultPlan([Reject429("/v1/jobs", times=1,
+                                retry_after_s=2.0)])
+    with EvolutionService(str(tmp_path), PROBLEMS, max_lanes=2,
+                          segment_len=2, metrics=MetricsRegistry(),
+                          fault_plan=plan) as svc:
+        c = ServiceClient(svc.url)
+        with pytest.raises(ServiceError) as ei:
+            c.submit("onemax", params={"seed": 5, "ngen": 6},
+                     tenant_id="tA", idempotency_key="k1")
+        assert ei.value.code == 429
+        assert ei.value.retry_after == 2.0
+        # single-shot: the storm hits exactly when scheduled
+        res = c.result("tA", wait=True, timeout=120)
+        assert res["status"] == "finished"
+        assert svc.scheduler.load_counts()["sheds"] == 1
+        jpath = svc.journal.path
+    rows = read_journal(jpath)
+    shed = [r for r in rows if r.get("kind") == "load_shed"]
+    assert len(shed) == 1 and shed[0]["reason"] == "injected_429"
+    slo = [r for r in rows if r.get("kind") == "slo"]
+    assert slo and slo[-1]["sheds"] == 1
