@@ -716,28 +716,97 @@ def fusion_lines(out_path: str = "BENCH_FUSION.json",
 
 # -------------------------------- compile-cache cold-start economics ----
 
-def _coldstart_child(cache_dir: str) -> None:
-    """Measure time_to_first_generation in THIS fresh process: enable
-    the persistent compile cache at ``cache_dir``, build the headline
-    generation step, and time setup→first-generation-result (the
-    latency a new serving process pays before it can do work). Prints
-    one JSON line."""
+def _coldstart_child(cache_dir: str, mode: str = "warm") -> None:
+    """Measure time_to_first_generation in THIS fresh process, split
+    into the ISSUE-18 per-phase waterfall: process import → cache open
+    → artifact deserialize / compile → first step. ``mode``:
+
+    - ``cold``/``warm`` — persistent XLA compile cache only (empty vs
+      populated ``cache_dir``); ``cold`` also POPULATES the sibling
+      artifact store so the ``artifact`` run has blobs to load;
+    - ``artifact`` — compile cache AND the executable artifact store:
+      the program deserializes (``jax.experimental.
+      serialize_executable``) instead of compiling.
+
+    Prints one JSON line with the phase dict, the total, and a sha256
+    digest of the first generation's fitness vector — the parent's
+    bit-identity gate across all three modes."""
+    import hashlib
+
+    import numpy as np
+
+    t_entry = time.perf_counter()
+    spawn_wall = float(os.environ.get("BENCH_COLDSTART_T0") or 0.0)
+    import_s = max(0.0, time.time() - spawn_wall) if spawn_wall else None
+
     jax.config.update("jax_platforms", "cpu")
+    t0 = time.perf_counter()
     _compilecache.enable(cache_dir)
+    store = None
+    if mode in ("cold", "artifact"):
+        from deap_tpu.support.artifacts import enable_artifact_store
+        store = enable_artifact_store(
+            os.path.join(cache_dir, "artifacts"))
+    cache_open_s = time.perf_counter() - t0
+
     t0 = time.perf_counter()
     tb, pop = _setup()
     run_off, _ = _fusion_steps(tb)
-    sync(run_off(jax.random.key(70), pop))
-    print(json.dumps({"time_to_first_generation_seconds":
-                      round(time.perf_counter() - t0, 4)}))
+    key = jax.random.key(70)
+    lowered = run_off.lower(key, pop)
+    setup_s = time.perf_counter() - t0
+
+    from deap_tpu.telemetry.costs import _hlo_fingerprint
+    hlo = _hlo_fingerprint(lowered)
+    deserialize_s = compile_s = 0.0
+    compiled = None
+    if store is not None:
+        t0 = time.perf_counter()
+        compiled = store.get("bench.coldstart", hlo)
+        deserialize_s = time.perf_counter() - t0
+    from_artifact = compiled is not None
+    if compiled is None:
+        t0 = time.perf_counter()
+        compiled = lowered.compile()
+        compile_s = time.perf_counter() - t0
+        if store is not None:
+            store.put("bench.coldstart", hlo, compiled)
+
+    t0 = time.perf_counter()
+    out = np.asarray(compiled(key, pop))
+    first_step_s = time.perf_counter() - t0
+
+    phases = {"cache_open": round(cache_open_s, 4),
+              "setup_lower": round(setup_s, 4),
+              "artifact_deserialize": round(deserialize_s, 4),
+              "compile": round(compile_s, 4),
+              "first_step": round(first_step_s, 4)}
+    if import_s is not None:
+        phases["process_import"] = round(import_s, 4)
+    print(json.dumps({
+        "time_to_first_generation_seconds":
+            round(time.perf_counter() - t_entry, 4),
+        "phases": phases, "mode": mode,
+        "from_artifact": from_artifact,
+        "digest": hashlib.sha256(out.tobytes()).hexdigest()}))
 
 
-def coldstart_lines() -> list:
-    """The ROADMAP-item-5 metric: ``time_to_first_generation`` for a
-    fresh process with an EMPTY persistent compile cache (cold) vs the
-    same process re-run against the now-populated cache (warm) — each
-    in its own subprocess so compilation state cannot leak. Journaled
-    as rows (and folded into BENCH_FUSION.json by ``--fusion``)."""
+def coldstart_lines(out_path: str = "BENCH_COLDSTART.json") -> list:
+    """The ROADMAP-item-5 / ISSUE-18 metric: per-phase
+    ``time_to_first_generation`` for a fresh process under three cache
+    regimes, each in its own subprocess so compilation state cannot
+    leak —
+
+    - ``cold``: empty persistent compile cache (populates both the
+      XLA cache and the executable artifact store on the way);
+    - ``warm``: the now-populated XLA compile cache, **no** artifact
+      store — the "fully-warm" baseline;
+    - ``artifact``: the artifact store active — first generation via
+      ``deserialize_and_load`` instead of a compile.
+
+    Committed as ``BENCH_COLDSTART.json``; ``bench_report.py``'s
+    ``coldstart_tripwire`` gates artifact ≤ 1.5× warm and digest
+    identity of all three modes."""
     import shutil
     import subprocess
     import tempfile
@@ -746,40 +815,77 @@ def coldstart_lines() -> list:
     me = os.path.abspath(__file__)
     env = dict(os.environ, JAX_PLATFORMS="cpu", DEAP_TPU_SKIP_PROBE="1")
     env.pop("DEAP_TPU_COMPILE_CACHE", None)  # the child gets it by arg
+    env.pop("DEAP_TPU_ARTIFACT_CACHE", None)
     results = {}
     try:
-        for phase in ("cold", "warm"):
+        for phase in ("cold", "warm", "artifact"):
+            env["BENCH_COLDSTART_T0"] = repr(time.time())
             r = subprocess.run(
-                [sys.executable, me, "--coldstart-child", cache_dir],
+                [sys.executable, me, "--coldstart-child", cache_dir,
+                 phase],
                 env=env, capture_output=True, text=True, timeout=600)
-            val = None
+            d = None
             for ln in (r.stdout or "").splitlines():
                 try:
-                    d = json.loads(ln)
+                    cand = json.loads(ln)
                 except json.JSONDecodeError:
                     continue
-                if "time_to_first_generation_seconds" in d:
-                    val = d["time_to_first_generation_seconds"]
-            if val is None:
+                if "time_to_first_generation_seconds" in cand:
+                    d = cand
+            if d is None:
                 print(f"bench: coldstart {phase} child failed; stderr "
                       f"tail: {(r.stderr or '')[-300:]}",
                       file=sys.stderr)
                 return []
-            results[phase] = val
+            results[phase] = d
     finally:
         shutil.rmtree(cache_dir, ignore_errors=True)
     envfp = _env_fingerprint("cpu")
+    ttfg = {p: results[p]["time_to_first_generation_seconds"]
+            for p in results}
     rows = [{
         "metric": f"onemax_pop100k_time_to_first_generation_{p}_seconds",
-        "value": results[p], "unit": "seconds", "backend": "cpu",
-        "pop": POP, "compile_cache": p != "cold" and "warm" or "empty",
+        "value": ttfg[p], "unit": "seconds", "backend": "cpu",
+        "pop": POP,
+        "compile_cache": "empty" if p == "cold" else "warm",
+        "artifact_store": p != "warm",
+        "from_artifact": results[p]["from_artifact"],
+        "phases": results[p]["phases"],
         "env": envfp,
-    } for p in ("cold", "warm")]
+    } for p in ("cold", "warm", "artifact")]
     rows.append({
         "metric": "onemax_pop100k_coldstart_warm_speedup_x",
-        "value": round(results["cold"] / results["warm"], 3),
+        "value": round(ttfg["cold"] / ttfg["warm"], 3),
         "unit": "x", "env": envfp,
     })
+    rows.append({
+        "metric": "coldstart_artifact_vs_warm_x",
+        "value": round(ttfg["artifact"] / ttfg["warm"], 3),
+        "unit": "x", "gate": "<= 1.5",
+        "note": "artifact-warm first generation relative to a fully-"
+                "warm (populated XLA cache) fresh process",
+        "artifact_loaded": results["artifact"]["from_artifact"],
+        "env": envfp,
+    })
+    rows.append({
+        "metric": "coldstart_artifact_digest_identical",
+        "value": (results["artifact"]["digest"]
+                  == results["cold"]["digest"]
+                  == results["warm"]["digest"]),
+        "unit": "bool", "gate": "== true",
+        "digest": results["cold"]["digest"][:16],
+        "env": envfp,
+    })
+    if out_path:
+        payload = {
+            "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "env": envfp,
+            "config": {"pop": POP, "length": LENGTH,
+                       "ngen": FUSION_NGEN},
+            "tail": "\n".join(json.dumps(r) for r in rows),
+        }
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=1)
     return rows
 
 
@@ -1680,9 +1786,15 @@ CHAOS_LANES = 64
 CHAOS_KILL_STEP = 6         # driver step the child SIGKILLs itself at
 CHAOS_CLIENTS = 8
 #: recovery-wall budget for the chaos_tripwire gate (kill → last
-#: tenant converged on the restarted service; includes the child's
-#: cold start + WAL replay + re-admission compiles on one CPU core)
-CHAOS_RECOVERY_BUDGET_S = 120.0
+#: tenant converged on the restarted service). Tightened from 120 s
+#: (pre-ISSUE-18 measured 21.4 s: cold start dominated) to 30 s now
+#: that the restarted child takes the startup fast path — executable
+#: artifact store + warm-handoff prewarm + batched WAL replay +
+#: pipelined checkpoint restore + fsync-free boundary checkpoints;
+#: measured 8.5-12.5 s across trials on the 1-core bench host (the
+#: spread is kill-position noise: how much of the run remained to
+#: recompute when the SIGKILL landed) — see BENCH_CHAOS.json
+CHAOS_RECOVERY_BUDGET_S = 30.0
 
 
 def service_chaos_lines(out_path: str = "BENCH_CHAOS.json") -> list:
@@ -1713,14 +1825,20 @@ def service_chaos_lines(out_path: str = "BENCH_CHAOS.json") -> list:
         os.path.join(work, "svc"), n_tenants=CHAOS_N, ngen=CHAOS_NGEN,
         kill_at_step=CHAOS_KILL_STEP, segment_len=CHAOS_SEG,
         max_lanes=CHAOS_LANES, clients=CHAOS_CLIENTS,
-        converge_timeout_s=900)
+        converge_timeout_s=900,
+        # the ISSUE-18 startup fast path: both children share a
+        # root-local persistent compile cache, which also enables the
+        # executable artifact store + warm-handoff manifest — the
+        # restarted child deserializes the pre-kill lattice
+        compile_cache=os.path.join(work, "cache"))
     identical = sum(1 for tid, d in out["digests"].items()
                     if ref.get(tid) == d)
     shutil.rmtree(work, ignore_errors=True)
 
     cfg = {"tenants": CHAOS_N, "ngen": CHAOS_NGEN,
            "segment_len": CHAOS_SEG, "lanes": CHAOS_LANES,
-           "clients": CHAOS_CLIENTS, "kill_at_step": CHAOS_KILL_STEP}
+           "clients": CHAOS_CLIENTS, "kill_at_step": CHAOS_KILL_STEP,
+           "compile_cache": True}
     rows = [
         {"metric": "chaos_kill_delivered",
          "value": out["kill_rc"] == -9, "unit": "bool",
@@ -1736,7 +1854,9 @@ def service_chaos_lines(out_path: str = "BENCH_CHAOS.json") -> list:
          "value": out["recovery_s"], "unit": "seconds",
          "gate": f"<= {CHAOS_RECOVERY_BUDGET_S:.0f}",
          "note": "kill -> last tenant converged on the restarted "
-                 "service (cold start + WAL replay + resume included)",
+                 "service (artifact-store cold start + warm-handoff "
+                 "prewarm + batched WAL replay + pipelined restore "
+                 "included)",
          **cfg, "env": envfp},
         {"metric": "chaos_wall_seconds",
          "value": out["wall_s"], "unit": "seconds",
@@ -3341,11 +3461,14 @@ if __name__ == "__main__":
             [sys.executable, os.path.abspath(__file__), "--mesh-child",
              out], env=child_env).returncode)
     elif "--coldstart-child" in sys.argv:
-        _coldstart_child(
-            sys.argv[sys.argv.index("--coldstart-child") + 1])
+        i = sys.argv.index("--coldstart-child")
+        mode = (sys.argv[i + 2] if i + 2 < len(sys.argv)
+                and not sys.argv[i + 2].startswith("--") else "warm")
+        _coldstart_child(sys.argv[i + 1], mode)
     elif "--coldstart" in sys.argv:
-        # the compile-cache cold-start metric alone (ROADMAP item 5):
-        # time_to_first_generation, empty vs populated persistent cache
+        # the cold-start waterfall (ROADMAP item 5 / ISSUE 18):
+        # per-phase time_to_first_generation under empty / warm-XLA /
+        # artifact-store cache regimes — committed BENCH_COLDSTART.json
         for row in coldstart_lines():
             print(json.dumps(row), flush=True)
     elif "--costs" in sys.argv:

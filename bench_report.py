@@ -527,10 +527,12 @@ def service_tripwire(max_overhead_pct: float = SERVICE_OVERHEAD_PCT
 
 
 #: recovery-wall budget (seconds) for the chaos gate: kill → last
-#: tenant converged on the restarted service (child cold start + WAL
-#: replay + checkpoint resume on one CPU core) — matches
-#: bench.CHAOS_RECOVERY_BUDGET_S
-CHAOS_RECOVERY_BUDGET_S = 120.0
+#: tenant converged on the restarted service — matches
+#: bench.CHAOS_RECOVERY_BUDGET_S. Tightened 120 → 30 by ISSUE 18:
+#: the restarted child now takes the startup fast path (executable
+#: artifact store, warm-handoff prewarm, batched WAL replay,
+#: pipelined checkpoint restore)
+CHAOS_RECOVERY_BUDGET_S = 30.0
 
 
 def chaos_tripwire(budget_s: float = CHAOS_RECOVERY_BUDGET_S) -> int:
@@ -591,6 +593,60 @@ def chaos_tripwire(budget_s: float = CHAOS_RECOVERY_BUDGET_S) -> int:
               f"{budget_s:.0f}s) " + ("ok" if ok else
               "**REGRESSION** (restart recovery got slow)"))
         tripped += 0 if ok else 1
+    return tripped
+
+
+#: artifact-warm first generation must land within this multiple of a
+#: fully-warm (populated XLA cache) fresh process — matches the gate
+#: stamped into BENCH_COLDSTART.json's coldstart_artifact_vs_warm_x row
+COLDSTART_ARTIFACT_VS_WARM_X = 1.5
+
+
+def coldstart_tripwire(max_ratio: float = COLDSTART_ARTIFACT_VS_WARM_X
+                       ) -> int:
+    """The cold-start gate (ISSUE 18). The latest
+    BENCH_COLDSTART*.json — per-phase time_to_first_generation for a
+    fresh process under empty / warm-XLA / artifact-store cache
+    regimes — must show (1) the artifact run actually loading from the
+    store, (2) artifact-warm within ``max_ratio``× the fully-warm
+    baseline, and (3) the first generation's fitness digest
+    bit-identical across all three regimes (the deserialized
+    executable IS the compiled one). Returns tripped row count."""
+    files = sorted(glob.glob(os.path.join(HERE,
+                                          "BENCH_COLDSTART*.json")))
+    if not files:
+        print("coldstart tripwire: no committed BENCH_COLDSTART*.json "
+              "yet")
+        return 0
+    rows = _bench_rows(files[-1])
+    print(f"\n## Cold start ({os.path.basename(files[-1])})\n")
+    tripped = 0
+
+    ratio = rows.get("coldstart_artifact_vs_warm_x")
+    if ratio is None or not isinstance(ratio.get("value"),
+                                       (int, float)):
+        print("- artifact-vs-warm ratio row missing")
+        tripped += 1
+    else:
+        loaded = ratio.get("artifact_loaded") is True
+        ok = ratio["value"] <= max_ratio and loaded
+        print(f"- artifact-warm first generation: {ratio['value']}x "
+              f"fully-warm (gate <= {max_ratio}x, "
+              f"loaded_from_store={loaded}) "
+              + ("ok" if ok else "**REGRESSION** (the executable "
+                 "artifact path stopped paying for itself)"))
+        tripped += 0 if ok else 1
+
+    bit = rows.get("coldstart_artifact_digest_identical")
+    if bit is None or bit.get("value") is not True:
+        print("- **REGRESSION**: first-generation digests are NOT "
+              "bit-identical across cold/warm/artifact regimes (or "
+              "the row is missing) — the artifact path is changing "
+              "numerics")
+        tripped += 1
+    else:
+        print(f"- first-generation digest identical across all three "
+              f"regimes ({bit.get('digest', '?')}…) ok")
     return tripped
 
 
@@ -966,6 +1022,7 @@ def tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
     tripped += gp_serving_tripwire()
     tripped += service_tripwire()
     tripped += chaos_tripwire()
+    tripped += coldstart_tripwire()
     tripped += mesh_tripwire()
     tripped += costs_tripwire()
     tripped += tracing_tripwire()

@@ -137,6 +137,16 @@ def child_main(argv: Optional[Sequence[str]] = None) -> None:
                    help="enable the tracing plane at this sample rate"
                         " (lifecycle spans always on); omitted ="
                         " tracing off")
+    p.add_argument("--compile-cache", default=None,
+                   help="persistent XLA compile cache directory; also "
+                        "enables the sibling executable-artifact "
+                        "store, so the restarted child deserializes "
+                        "the lattice instead of recompiling it")
+    p.add_argument("--telemetry", action="store_true",
+                   help="keep the scheduler's run journal on — the "
+                        "restarted child's startup_phase/artifact_* "
+                        "rows land in <root>/journal.jsonl for "
+                        "report.py --health's Startup ledger")
     p.add_argument("--platform", default="cpu")
     args = p.parse_args(argv)
 
@@ -160,8 +170,10 @@ def child_main(argv: Optional[Sequence[str]] = None) -> None:
         max_pending=(args.max_pending or None),
         watchdog_s=(args.watchdog_s or None),
         max_lanes=args.max_lanes, segment_len=args.segment_len,
-        fair_quantum=None, checkpoint_every=1, telemetry=False,
-        metrics=False, trace_sample=args.trace_sample)
+        fair_quantum=None, checkpoint_every=1,
+        telemetry=bool(args.telemetry),
+        metrics=False, trace_sample=args.trace_sample,
+        compile_cache=(args.compile_cache or None))
     ds = svc.install_signal_handlers()
     tmp = args.ready + ".tmp"
     with open(tmp, "w") as fh:
@@ -190,7 +202,9 @@ def _spawn_child(root: str, port: int, ready: str, *,
                  segment_len: int, max_lanes: int,
                  max_pending: Optional[int],
                  python: str,
-                 trace_sample: Optional[float] = None
+                 trace_sample: Optional[float] = None,
+                 compile_cache: Optional[str] = None,
+                 telemetry: bool = False
                  ) -> subprocess.Popen:
     try:
         os.remove(ready)
@@ -205,6 +219,10 @@ def _spawn_child(root: str, port: int, ready: str, *,
         cmd += ["--kill-at", str(kill_at), "--kill-event", kill_event]
     if trace_sample is not None:
         cmd += ["--trace-sample", str(trace_sample)]
+    if compile_cache:
+        cmd += ["--compile-cache", compile_cache]
+    if telemetry:
+        cmd += ["--telemetry"]
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
     return subprocess.Popen(cmd, env=env,
@@ -236,6 +254,8 @@ def run_chaos(root: str, *, n_tenants: int = 8,
               clients: int = 4, max_pending: Optional[int] = None,
               converge_timeout_s: float = 300.0,
               trace_sample: Optional[float] = None,
+              compile_cache: Optional[str] = None,
+              telemetry: bool = False,
               python: str = sys.executable) -> Dict[str, Any]:
     """The kill/restart acceptance run. Returns::
 
@@ -247,6 +267,14 @@ def run_chaos(root: str, *, n_tenants: int = 8,
     tenant converging on the restarted service; ``lost`` is every
     tenant that never produced a result within ``converge_timeout_s``
     (the chaos pin requires it empty).
+
+    ``compile_cache`` points both children at a shared persistent XLA
+    compile cache — which also enables the sibling executable-artifact
+    store and the warm-handoff manifest, i.e. the whole ISSUE 18
+    startup fast path: the restarted child deserializes the pre-kill
+    lattice instead of recompiling it. The committed
+    ``BENCH_CHAOS.json`` runs with a root-local cache so the ≤ 8 s
+    recovery gate measures the fast path, not a cold XLA pipeline.
     """
     from deap_tpu.serving.client import RetryPolicy, ServiceClient
 
@@ -260,7 +288,9 @@ def run_chaos(root: str, *, n_tenants: int = 8,
                         kill_event=kill_event,
                         segment_len=segment_len, max_lanes=max_lanes,
                         max_pending=max_pending, python=python,
-                        trace_sample=trace_sample)
+                        trace_sample=trace_sample,
+                        compile_cache=compile_cache,
+                        telemetry=telemetry)
     _wait_ready(proc, ready)
 
     kill_info: Dict[str, Any] = {"rc": None, "t": None, "proc2": None}
@@ -277,7 +307,9 @@ def run_chaos(root: str, *, n_tenants: int = 8,
                           segment_len=segment_len,
                           max_lanes=max_lanes,
                           max_pending=max_pending, python=python,
-                          trace_sample=trace_sample)
+                          trace_sample=trace_sample,
+                          compile_cache=compile_cache,
+                          telemetry=telemetry)
         kill_info["proc2"] = p2
         _wait_ready(p2, ready)
 

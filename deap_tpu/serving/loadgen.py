@@ -72,8 +72,8 @@ else:
 
 __all__ = ["Arrival", "Schedule", "TrafficModel", "PoissonTraffic",
            "DiurnalTraffic", "ParetoMixTraffic", "ThunderingHerd",
-           "LoadgenReport", "run_schedule", "schedule_from_journal",
-           "replay_fidelity"]
+           "LoadgenReport", "RestartPlan", "run_schedule",
+           "schedule_from_journal", "replay_fidelity"]
 
 
 # ------------------------------------------------------- schedule ----
@@ -393,7 +393,9 @@ _TERMINAL = frozenset(
 class ArrivalResult:
     """One arrival's fate: scheduled vs actual submit offset, final
     status (``finished`` / ``abandoned`` / ``shed`` / ``error``) and
-    the result digest when one was fetched."""
+    the result digest when one was fetched. ``done_t`` is the run
+    offset at which a result digest landed (the restart scenario's
+    time-to-first-result signal)."""
 
     tenant_id: str
     sched_t: float
@@ -402,6 +404,25 @@ class ArrivalResult:
     digest: Optional[str] = None
     gen: Optional[int] = None
     error: Optional[str] = None
+    done_t: Optional[float] = None
+
+
+@dataclass
+class RestartPlan:
+    """Kill-and-restart the service mid-schedule (the ISSUE 18 warm-
+    handoff drill): at run offset ``at_s`` (schedule time — scaled by
+    the runner's ``speed`` like every arrival), :func:`run_schedule`
+    calls ``restart()`` on a side thread. The callable owns the whole
+    outage — kill the process, respawn it over the same root, wait for
+    ready — and returns the (possibly new) base URL. Arrivals landing
+    during or after the outage retry against the returned URL
+    (idempotency keys make the re-offers safe), and the report gains
+    ``time_to_first_result_after_restart_s`` — exactly the
+    ``first_result`` slice the restarted service journals as its own
+    ``startup_phase`` row, measured from the client side."""
+
+    at_s: float
+    restart: Any  # Callable[[], str] — returns the post-restart URL
 
 
 @dataclass
@@ -413,6 +434,14 @@ class LoadgenReport:
     speed: float
     wall_s: float
     results: List[ArrivalResult] = field(default_factory=list)
+    #: restart drill (set when run with a :class:`RestartPlan`): run
+    #: offsets of the outage start / the service answering again, and
+    #: the first result digest landed after the restart — the
+    #: client-side mirror of the service's own ``startup_phase
+    #: first_result`` journal row
+    restart_t: Optional[float] = None
+    restart_ready_t: Optional[float] = None
+    time_to_first_result_after_restart_s: Optional[float] = None
 
     @property
     def counts(self) -> Dict[str, int]:
@@ -432,6 +461,7 @@ def run_schedule(schedule: Schedule, base_url: str,
                  max_workers: int = 16,
                  poll_timeout_s: float = 600.0,
                  storm_retry: Optional[RetryPolicy] = None,
+                 restart: Optional[RestartPlan] = None,
                  journal=None) -> LoadgenReport:
     """Replay ``schedule`` against a live service, **open-loop**: each
     arrival fires at its scheduled offset (scaled by ``speed``)
@@ -453,46 +483,100 @@ def run_schedule(schedule: Schedule, base_url: str,
     threads: List[threading.Thread] = []
     t_run0 = time.monotonic()
 
+    # restart drill state: workers read the CURRENT base url through
+    # the holder (the restart callable may move the service), and a
+    # worker that dies into the outage parks on `restart_ready` before
+    # its one retry instead of hammering a dead socket
+    url_holder = [base_url]
+    restart_marks: Dict[str, Optional[float]] = {"t": None, "ready": None}
+    restart_ready = threading.Event()
+    if restart is None:
+        restart_ready.set()
+
+    def _fire_restart(plan: RestartPlan) -> None:
+        delay = plan.at_s / speed - (time.monotonic() - t_run0)
+        if delay > 0:
+            time.sleep(delay)
+        restart_marks["t"] = time.monotonic() - t_run0
+        try:
+            url_holder[0] = plan.restart() or url_holder[0]
+        finally:
+            restart_marks["ready"] = time.monotonic() - t_run0
+            restart_ready.set()
+
     def _work(a: Arrival) -> None:
         res = results[a.tenant_id]
+        attempts = 2 if restart is not None else 1
         try:
-            retry = storm_retry if a.storm else None
-            with ServiceClient(base_url, token=token,
-                               timeout=poll_timeout_s, retry=retry,
-                               abandon_after_s=a.abandon_after_s
-                               ) as client:
-                res.submit_t = time.monotonic() - t_run0
-                client.submit(a.problem, params=a.params,
-                              tenant_id=a.tenant_id,
-                              idempotency_key=a.tenant_id)
-                # The service clamps each long-poll to its own
-                # max_poll_s and returns a non-terminal snapshot, so
-                # poll in a loop until a terminal status or the
-                # overall budget runs out.
-                deadline = time.monotonic() + poll_timeout_s
-                out: Dict[str, Any] = {}
-                while True:
-                    left = deadline - time.monotonic()
-                    if left <= 0:
-                        break
-                    out = client.result(a.tenant_id, wait=True,
-                                        timeout=left)
-                    if out.get("status", "finished") in _TERMINAL:
-                        break
-                res.status = out.get("status", "pending")
-                res.gen = out.get("gen")
-                r = out.get("result") or {}
-                res.digest = r.get("digest")
-        except ClientAbandoned:
-            res.status = "abandoned"
-        except ServiceError as e:
-            res.status = "shed" if e.code == 429 else "error"
-            res.error = f"HTTP {e.code}"
-        except Exception as e:  # noqa: BLE001 — per-arrival isolation
-            res.status = "error"
-            res.error = f"{type(e).__name__}: {e}"
+            for attempt in range(attempts):
+                retry = storm_retry if a.storm else None
+                try:
+                    with ServiceClient(url_holder[0], token=token,
+                                       timeout=poll_timeout_s,
+                                       retry=retry,
+                                       abandon_after_s=a.abandon_after_s
+                                       ) as client:
+                        res.submit_t = time.monotonic() - t_run0
+                        client.submit(a.problem, params=a.params,
+                                      tenant_id=a.tenant_id,
+                                      idempotency_key=a.tenant_id)
+                        # The service clamps each long-poll to its own
+                        # max_poll_s and returns a non-terminal
+                        # snapshot, so poll in a loop until a terminal
+                        # status or the overall budget runs out.
+                        deadline = time.monotonic() + poll_timeout_s
+                        out: Dict[str, Any] = {}
+                        while True:
+                            left = deadline - time.monotonic()
+                            if left <= 0:
+                                break
+                            out = client.result(a.tenant_id, wait=True,
+                                                timeout=left)
+                            if out.get("status",
+                                       "finished") in _TERMINAL:
+                                break
+                        res.status = out.get("status", "pending")
+                        res.gen = out.get("gen")
+                        r = out.get("result") or {}
+                        res.digest = r.get("digest")
+                        if res.digest is not None:
+                            res.done_t = time.monotonic() - t_run0
+                    if res.digest is None and res.status == "drained" \
+                            and restart is not None \
+                            and attempt + 1 < attempts:
+                        # the service checkpointed us and went down —
+                        # that IS the outage, not a final fate: park
+                        # and re-offer to the restarted service below
+                        pass
+                    else:
+                        return
+                except ClientAbandoned:
+                    res.status = "abandoned"
+                    return
+                except ServiceError as e:
+                    if e.code < 500 or attempt + 1 >= attempts:
+                        res.status = ("shed" if e.code == 429
+                                      else "error")
+                        res.error = f"HTTP {e.code}"
+                        return
+                except Exception as e:  # noqa: BLE001 — isolation
+                    if attempt + 1 >= attempts:
+                        res.status = "error"
+                        res.error = f"{type(e).__name__}: {e}"
+                        return
+                # the arrival died into the outage: wait out the
+                # respawn, then re-offer once — the tenant id IS the
+                # idempotency key, so the retry can never double-admit
+                restart_ready.wait(timeout=poll_timeout_s)
         finally:
             sem.release()
+
+    restart_thread: Optional[threading.Thread] = None
+    if restart is not None:
+        restart_thread = threading.Thread(
+            target=_fire_restart, args=(restart,), daemon=True,
+            name="loadgen-restart")
+        restart_thread.start()
 
     for a in arrivals:
         # open-loop pacing: sleep to the arrival's instant, then fire
@@ -506,15 +590,34 @@ def run_schedule(schedule: Schedule, base_url: str,
         th.start()
     for th in threads:
         th.join()
+    if restart_thread is not None:
+        restart_thread.join(timeout=poll_timeout_s)
     report = LoadgenReport(model=schedule.model, seed=schedule.seed,
                            speed=speed,
                            wall_s=round(time.monotonic() - t_run0, 4),
                            results=[results[a.tenant_id]
                                     for a in arrivals])
+    if restart_marks["t"] is not None:
+        report.restart_t = round(restart_marks["t"], 4)
+        if restart_marks["ready"] is not None:
+            report.restart_ready_t = round(restart_marks["ready"], 4)
+        after = [r.done_t for r in report.results
+                 if r.done_t is not None
+                 and r.done_t >= restart_marks["t"]]
+        if after:
+            report.time_to_first_result_after_restart_s = round(
+                min(after) - restart_marks["t"], 4)
     if journal is not None:
+        extra: Dict[str, Any] = {}
+        if report.restart_t is not None:
+            extra.update(
+                restart_t=report.restart_t,
+                restart_ready_t=report.restart_ready_t,
+                time_to_first_result_after_restart_s=(
+                    report.time_to_first_result_after_restart_s))
         journal.event("loadgen_run", model=schedule.model,
                       seed=schedule.seed, speed=speed,
                       n_arrivals=len(arrivals),
                       planned_s=round(schedule.duration_s / speed, 4),
-                      wall_s=report.wall_s, **report.counts)
+                      wall_s=report.wall_s, **report.counts, **extra)
     return report

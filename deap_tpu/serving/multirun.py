@@ -201,6 +201,19 @@ class MultiRunEngine:
             lambda keys, ngen: jax.vmap(lambda k: jax.random.key_data(
                 jax.random.split(k, ngen)))(keys),
             static_argnames=("ngen",))
+        # one-dispatch lane extraction: the boundary drain unpacks
+        # EVERY resident lane EVERY segment, and an eager per-leaf
+        # `a[i]` costs a device round-trip per leaf (~10 dispatches
+        # per lane — 2.4 s of a 200-tenant run). A single jitted
+        # gather with a *dynamic* index is one dispatch per lane and
+        # one compile per batch shape, bit-identical to the eager path
+        self._unpack_jit = jax.jit(
+            lambda sub, j: jax.tree_util.tree_map(lambda a: a[j], sub))
+        # the admission-side mirror: stacking N padded lanes into a
+        # batch is one fused program instead of an eager jnp.stack
+        # dispatch per leaf (a 64-lane repack measured ~0.2 s eager)
+        self._pack_jit = jax.jit(lambda *lanes: jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *lanes))
 
     # ------------------------------------------------------------ steps ----
 
@@ -451,7 +464,7 @@ class MultiRunEngine:
         dummy = {**padded[0], "gen": jnp.int32(0),
                  "ngen": jnp.int32(0)}
         padded += [dummy] * (n_lanes - len(padded))
-        stacked = _tree_stack(padded)
+        stacked = self._pack_jit(*padded)
         return {"carry": stacked["carry"],
                 "shadow": stacked["carry"], "gen": stacked["gen"],
                 "ngen": stacked["ngen"], "keys": stacked["keys"],
@@ -467,10 +480,10 @@ class MultiRunEngine:
         completion state for a finished one — see :meth:`_segment`).
         Key padding is trimmed back to the lane's own ``ngen`` so a
         resume into a different bucket horizon re-pads cleanly."""
-        lane = {k: _tree_index(batch[k], i)
-                for k in ("gen", "ngen", "keys", "hyper", "record0",
-                          "mstate0")}
-        lane["carry"] = _tree_index(batch["shadow"], i)
+        sub = {k: batch[k] for k in ("gen", "ngen", "keys", "hyper",
+                                     "record0", "mstate0")}
+        sub["carry"] = batch["shadow"]
+        lane = dict(self._unpack_jit(sub, jnp.int32(i)))
         lane["keys"] = lane["keys"][: int(lane["ngen"])]
         return lane
 

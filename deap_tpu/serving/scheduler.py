@@ -210,8 +210,19 @@ class Scheduler:
                  trace_sample: Optional[float] = None):
         self.root = str(root)
         os.makedirs(self.root, exist_ok=True)
+        self._artifact_store = None
         if compile_cache:
             enable_compile_cache(compile_cache)
+            # sibling executable-artifact store: restarted processes
+            # deserialize the lattice's programs instead of compiling
+            # them (docs/advanced/coldstart.md); best-effort — a store
+            # that cannot be created leaves the compile path untouched
+            try:
+                from deap_tpu.support.artifacts import \
+                    enable_artifact_store
+                self._artifact_store = enable_artifact_store()
+            except Exception:
+                self._artifact_store = None
         self.max_lanes = int(max_lanes)
         if segment_len == "auto":
             # env DEAP_TPU_TUNE_SEGMENT_LEN → tuning-cache winner
@@ -593,6 +604,9 @@ class Scheduler:
         if self._metrics_server is not None:
             self._metrics_server.close()
             self._metrics_server = None
+        if self._artifact_store is not None:
+            self._artifact_store.deactivate()
+            self._artifact_store = None
 
     def __enter__(self) -> "Scheduler":
         return self

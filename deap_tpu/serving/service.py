@@ -79,8 +79,9 @@ that is threaded through the journal for end-to-end tracing):
                                       job's deadline expired
 ``GET /v1/jobs/<id>/stream``          NDJSON per-segment events until a
                                       terminal event
-``GET /healthz``                      liveness (``ok`` / ``draining`` /
-                                      ``stalled``)
+``GET /healthz``                      liveness (``ok`` / ``warming`` /
+                                      ``draining`` / ``stalled``; only
+                                      ``ok`` answers 200)
 ``GET /metrics``                      the scheduler's Prometheus
                                       registry (same text as
                                       ``serve_metrics`` — one port
@@ -157,7 +158,23 @@ SERVICE_JOURNAL_KINDS = ("service_request", "service_drain",
                          "autoscale_decision", "auth_rejected",
                          "wal_replay", "idempotent_replay",
                          "deadline_exceeded", "load_shed",
-                         "driver_stall", "trace_span")
+                         "driver_stall", "trace_span",
+                         "startup_phase")
+
+#: file the warm-handoff lattice manifest persists to, next to the WAL
+WARM_MANIFEST_NAME = "warm_manifest.json"
+
+#: warm-manifest file format; readers skip unknown formats
+WARM_MANIFEST_FORMAT = 1
+
+#: start the driver (and thus the warm-handoff prewarm) BEFORE the
+#: WAL replay's job-factory builds, overlapping the two dominant
+#: restart phases. A measured win on multicore hosts; on a single
+#: hardware thread the two GIL-bound phases only contend, so the
+#: flag lets the restart path fall back to sequential
+_OVERLAP_REPLAY = os.environ.get(
+    "DEAP_TPU_OVERLAP_REPLAY", "").lower() in ("1", "true", "yes") \
+    or (os.cpu_count() or 1) > 1
 
 
 class _HttpError(Exception):
@@ -343,6 +360,26 @@ class EvolutionService:
         self._watch_stop = threading.Event()
         self._exit_fn = os._exit   # injectable for tests
 
+        # ---- startup ledger: phase wall-times journaled as
+        # ``startup_phase`` rows + the deap_service_startup_phase_
+        # seconds{phase} histogram (docs/advanced/coldstart.md)
+        self._t_start = time.monotonic()
+        self._startup_phases: Dict[str, float] = {}
+        self._first_result_pending = True
+        from deap_tpu.support import checkpoint as _ckpt_mod
+        self._restore_s0 = _ckpt_mod.restore_seconds_total()
+        # ---- warm handoff: the previous process's bucket-lattice
+        # manifest (problem/params/lanes/horizon per bucket), read
+        # BEFORE the driver starts; non-empty → the driver prewarms
+        # the recorded lattice before pumping any submit, and
+        # /healthz answers "warming" (503) until it finishes
+        self._warm_manifest_path = os.path.join(self.root,
+                                                WARM_MANIFEST_NAME)
+        self._warm_recorded: Dict[str, Dict[str, Any]] = {}
+        self._warm_dirty = False
+        self._warm_plan = self._read_warm_manifest()
+        self._warming = bool(self._warm_plan)
+
         # ---- durable admission: open (healing any torn tail) and
         # replay the WAL BEFORE any thread starts — recovered jobs are
         # queued as ordinary submit commands the driver applies first
@@ -350,18 +387,35 @@ class EvolutionService:
         if wal:
             self.wal = AdmissionWAL(os.path.join(self.root,
                                                  "admission.wal"))
-            self._replay_wal()
-
+        # the driver starts FIRST: with a warm manifest present it
+        # begins prewarming the recorded lattice immediately, fully
+        # overlapped with the main thread's WAL replay below (the
+        # job-factory builds) — the two dominant restart phases run
+        # concurrently instead of back to back. The replay batch is
+        # still the first submit command the driver can see: the HTTP
+        # server (the only other producer) starts after replay.
         self._driver = threading.Thread(target=self._drive,
                                         name="deap-tpu-service-driver",
                                         daemon=True)
+        if not _OVERLAP_REPLAY:
+            if self.wal is not None:
+                t0 = time.perf_counter()
+                self._replay_wal()
+                self._note_startup_phase(
+                    "wal_replay", time.perf_counter() - t0)
+        self._driver.start()
+        if _OVERLAP_REPLAY and self.wal is not None:
+            t0 = time.perf_counter()
+            self._replay_wal()
+            self._note_startup_phase(
+                "wal_replay", time.perf_counter() - t0)
+
         self._httpd = _ServiceHTTPServer((host, port), self)
         self.host, self.port = self._httpd.server_address[:2]
         self.url = f"http://{self.host}:{self.port}"
         self._http_thread = threading.Thread(
             target=self._httpd.serve_forever,
             name="deap-tpu-service-http", daemon=True)
-        self._driver.start()
         self._http_thread.start()
         self._watchdog = None
         if self.watchdog_s:
@@ -390,6 +444,7 @@ class EvolutionService:
         state = self.wal.replay()
         self._idem.update(state.idempotency)
         replayed, failed = [], []
+        batch: List[Tuple[Job, str]] = []
         for tid, rec in state.pending.items():
             problem = rec.get("problem")
             view = _JobView(tid, str(problem), str(rec.get("token", "")),
@@ -414,9 +469,10 @@ class EvolutionService:
                 failed.append(tid)
                 continue
             job.request_id = rec.get("request_id") or None
+            job._wal_params = dict(rec.get("params") or {})
             view.ngen = int(job.ngen)
             view.status = "recovered"
-            self._cmds.put(("submit", job, str(problem)))
+            batch.append((job, str(problem)))
             replayed.append(tid)
             # stitch the recovered job back onto its original trace:
             # the request id in the WAL record derives the same
@@ -429,6 +485,13 @@ class EvolutionService:
                         ctx=tr.context_for(job.request_id),
                         phase="replay", always=True, tenant_id=tid,
                         problem=str(problem))
+        if batch:
+            # ONE command for the whole recovered cohort: the driver
+            # repacks all N tenants in a single boundary instead of N
+            # one-at-a-time admissions — and a 200-tenant replay can
+            # never deadlock a bounded command queue while the driver
+            # is still busy prewarming
+            self._cmds.put(("submit_many", batch))
         if state.records or state.tear_offset is not None:
             self.journal.event(
                 "wal_replay", records=len(state.records),
@@ -459,6 +522,116 @@ class EvolutionService:
                                 status=status)
             except ValueError:
                 pass  # closing race: the WAL replays it next start
+
+    # ---------------------------------------- warm handoff + startup ----
+
+    def _note_startup_phase(self, phase: str, seconds: float) -> None:
+        """One startup-waterfall slice: journaled (``startup_phase``)
+        and observed on ``deap_service_startup_phase_seconds``."""
+        seconds = round(float(seconds), 6)
+        self._startup_phases[phase] = seconds
+        self.journal.event("startup_phase", phase=phase,
+                           seconds=seconds)
+        reg = self.scheduler.metrics
+        if reg is not None:
+            from deap_tpu.telemetry.metrics import \
+                startup_phase_histogram
+            startup_phase_histogram(reg).observe(seconds, phase=phase)
+
+    def _read_warm_manifest(self) -> List[Dict[str, Any]]:
+        """The previous process's lattice records (tolerant read: a
+        missing/torn/foreign-format manifest is an empty plan)."""
+        try:
+            with open(self._warm_manifest_path, "r") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            return []
+        if not isinstance(doc, dict) \
+                or doc.get("format") != WARM_MANIFEST_FORMAT:
+            return []
+        buckets = doc.get("buckets")
+        return [b for b in buckets if isinstance(b, dict)] \
+            if isinstance(buckets, list) else []
+
+    def _write_warm_manifest(self) -> None:
+        """Atomically persist the live lattice next to the WAL —
+        driver thread only (it owns ``_warm_recorded``)."""
+        doc = {"format": WARM_MANIFEST_FORMAT,
+               "buckets": [dict(v, label=k) for k, v in
+                           sorted(self._warm_recorded.items())]}
+        tmp = self._warm_manifest_path + ".tmp"
+        try:
+            with open(tmp, "w") as fh:
+                json.dump(doc, fh, sort_keys=True, indent=1)
+            os.replace(tmp, self._warm_manifest_path)
+            self._warm_dirty = False
+        except OSError:
+            pass  # best-effort: a missed write only costs warmth
+
+    def _record_warm_bucket(self, label: str, problem: str,
+                            params: Optional[dict], lanes: int,
+                            horizon: int) -> None:
+        """Fold one bucket observation into the warm manifest (driver
+        thread only); persists when the lattice actually changed."""
+        prev = self._warm_recorded.get(label)
+        entry = {"problem": str(problem),
+                 "params": dict(params or {}),
+                 "lanes": int(lanes), "horizon": int(horizon)}
+        if prev is not None:
+            # keep the first representative's params (any tenant of
+            # the bucket reproduces the same programs — bucket_key is
+            # tenant-blind), refresh only the tuned knobs: per-tenant
+            # param churn then never rewrites the manifest
+            entry["problem"], entry["params"] = \
+                prev["problem"], prev["params"]
+            entry["horizon"] = max(entry["horizon"], prev["horizon"])
+        if prev != entry:
+            self._warm_recorded[label] = entry
+            self._warm_dirty = True
+        if self._warm_dirty:
+            self._write_warm_manifest()
+
+    def _warm_start(self) -> None:
+        """Prewarm the recorded lattice BEFORE the driver pumps any
+        command — runs on the driver thread (the scheduler's exclusive
+        owner), so the replayed cohort's first repack finds its
+        programs already loaded (from the artifact store when one is
+        active, else compiled). ``/healthz`` answers ``warming`` (503)
+        for the duration; any failure degrades to a normal cold start."""
+        plan, self._warm_plan = self._warm_plan, []
+        if not plan:
+            self._warming = False
+            return
+        t0 = time.perf_counter()
+        warmed = 0
+        try:
+            for rec in plan:
+                factory = self.problems.get(str(rec.get("problem")))
+                if factory is None:
+                    continue
+                try:
+                    job = factory("__prewarm__",
+                                  dict(rec.get("params") or {}))
+                    warmed += self.scheduler.prewarm(
+                        [job], lane_counts=[int(rec.get("lanes", 1))])
+                except Exception:
+                    continue  # cold-compile fallback for this bucket
+        finally:
+            self._warming = False
+            self._note_startup_phase("prewarm",
+                                     time.perf_counter() - t0)
+            self.journal.event("service_request", route="warm_start",
+                               buckets=len(plan), warmed=warmed)
+
+    def _note_first_result(self) -> None:
+        """First tenant completion after start: close the startup
+        ledger (restore delta + start→first-result wall)."""
+        self._first_result_pending = False
+        from deap_tpu.support.checkpoint import restore_seconds_total
+        self._note_startup_phase(
+            "restore", restore_seconds_total() - self._restore_s0)
+        self._note_startup_phase(
+            "first_result", time.monotonic() - self._t_start)
 
     def _fire_fault(self, event: str, **ctx) -> None:
         if self.fault_plan is not None:
@@ -575,6 +748,10 @@ class EvolutionService:
         sched = self.scheduler
         sched.bind_driver()
         try:
+            # warm handoff: restore the previous process's lattice
+            # before touching the command queue — the WAL-replay batch
+            # then repacks against already-loaded programs
+            self._warm_start()
             while not self._drain_req.is_set():
                 self._beat = time.monotonic()
                 self._drain_touches()
@@ -720,6 +897,9 @@ class EvolutionService:
             return
         bucket = self.scheduler.buckets[bucket_key(job)]
         self._rep_jobs.setdefault(bucket.label, job)
+        self._record_warm_bucket(bucket.label, problem,
+                                 getattr(job, "_wal_params", None),
+                                 bucket.max_lanes, bucket.horizon)
         tenant = self.scheduler.tenants[tid]
         view.status = ("resuming" if tenant.has_checkpoint
                        else "queued")
@@ -754,6 +934,8 @@ class EvolutionService:
                 view.set_result(t.result)
                 view.status = t.status
                 self._wal_done(t.id, t.status)
+                if self._first_result_pending:
+                    self._note_first_result()
                 view.done.set()
                 self._publish(t.id, {"event": t.status,
                                      "tenant_id": t.id,
@@ -814,6 +996,14 @@ class EvolutionService:
         for label, n in decision.lane_counts.items():
             before = snap[label]["lanes"]
             applied = sched.set_bucket_lanes(label, n)
+            if label in self._warm_recorded:
+                # the tuned knob follows into the warm manifest, so a
+                # restart prewarms the lattice point the autoscaler
+                # actually converged on, not the configured default
+                rec = self._warm_recorded[label]
+                self._record_warm_bucket(label, rec["problem"],
+                                         rec["params"], applied,
+                                         rec["horizon"])
             self.journal.event(
                 "autoscale_decision", action="lanes", bucket=label,
                 lanes_from=before, lanes_to=applied,
@@ -1229,7 +1419,8 @@ class EvolutionService:
         qs = urllib.parse.parse_qs(parsed.query)
         if route == "/healthz" and method == "GET":
             status = ("stalled" if self._stalled
-                      else "draining" if self.draining else "ok")
+                      else "draining" if self.draining
+                      else "warming" if self._warming else "ok")
             code = 200 if status == "ok" else 503
             return code, "application/json", json.dumps({
                 "status": status,

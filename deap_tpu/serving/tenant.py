@@ -194,8 +194,12 @@ class Tenant:
     @property
     def ckpt(self) -> Checkpointer:
         if self._ckpt is None:
+            # fsync=False: tenants checkpoint every boundary, and a
+            # service kill (SIGKILL) leaves the page cache intact — the
+            # fsync pair per save only buys durability across a host
+            # power cut, where restore falls back one boundary anyway
             self._ckpt = Checkpointer(
-                os.path.join(self.run_dir, "ckpt"), keep=2)
+                os.path.join(self.run_dir, "ckpt"), keep=2, fsync=False)
         return self._ckpt
 
     @property

@@ -1,0 +1,325 @@
+"""AOT executable artifact store — the compile side of cold start.
+
+The persistent XLA compile cache (:mod:`~deap_tpu.support.compilecache`)
+makes the second process's *compiles* a disk read, but the restarted
+driver still pays the whole compilation pipeline in front of the cache
+lookup. ``jax.experimental.serialize_executable`` can persist the
+**loaded executable itself** — deserializing one measured ~20× faster
+than a cache-warm compile on the committed CPU config — which is what
+turns a kill-9 restart's first generation from a compile wall into a
+file read (ISSUE 18, ROADMAP item 4).
+
+This module is the sibling cache of the compile cache (the PR 16
+``sibling_cache_dir()`` pattern): one directory holding
+
+- ``artifact_manifest.json`` — a **stdlib-only** JSON manifest mapping
+  artifact keys to blob files, with a CRC32 and the environment stamps
+  (jax version, backend, device kind) that gate reuse. Atomic
+  read-merge-write like the tuning cache, so concurrent processes
+  merge instead of clobbering.
+- ``<key>.exec`` blob files — a pickled plain dict holding the
+  serialized executable bytes plus the pickled in/out treedefs (kept
+  as raw bytes, so the container itself loads without jax).
+
+Keying: ``(backend, device kind, jax version, HLO hash)``. The HLO
+hash is the observatory's existing program fingerprint (sha1 of the
+lowered StableHLO text, :func:`deap_tpu.telemetry.costs.
+_hlo_fingerprint`) — two processes asking XLA for the same program
+agree on the key; any shape/closure/version change misses and falls
+through to a fresh compile. Every consult is journaled
+(``artifact_hit`` / ``artifact_miss``) so a restart's cold-start
+economics are attributable from the journal alone.
+
+Fallback contract: any failure — torn blob, CRC mismatch, stamp
+mismatch, deserialize error — returns ``None`` and the caller compiles
+exactly what it would have compiled with no store active. Results are
+bit-identical either way (the deserialized executable IS the compiled
+one; pinned by ``tests/test_artifacts.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import tempfile
+import threading
+import time
+import zlib
+from typing import Any, Dict, Optional
+
+__all__ = ["ExecutableArtifactStore", "active_store",
+           "enable_artifact_store", "disable_artifact_store",
+           "default_dir", "ARTIFACT_JOURNAL_KINDS"]
+
+#: the environment opt-in (mirrors DEAP_TPU_COMPILE_CACHE)
+ENV_VAR = "DEAP_TPU_ARTIFACT_CACHE"
+
+#: manifest file-format stamp; bump on layout changes (readers skip
+#: unknown formats rather than guessing)
+MANIFEST_FORMAT = 1
+
+#: journal kinds this module writes (documented in the
+#: docs/advanced/telemetry.md kind table; drift-gated by
+#: tests/test_artifacts.py)
+ARTIFACT_JOURNAL_KINDS = ("artifact_hit", "artifact_miss")
+
+MANIFEST_NAME = "artifact_manifest.json"
+
+#: the active store — one slot, module-global (the instrumented seams
+#: that consult it are constructed far from whoever enabled it)
+_ACTIVE: list = [None]
+
+
+def active_store() -> Optional["ExecutableArtifactStore"]:
+    """The currently active artifact store, or None."""
+    return _ACTIVE[0]
+
+
+def default_dir() -> str:
+    """Where the store lives when the caller names no path:
+    ``$DEAP_TPU_ARTIFACT_CACHE``, else an ``artifacts/`` directory
+    INSIDE the enabled compile cache (sibling artifacts live — and are
+    wiped — together), else ``~/.cache/deap_tpu/artifacts``."""
+    env = os.environ.get(ENV_VAR)
+    if env:
+        return env
+    from deap_tpu.support.compilecache import sibling_cache_dir
+    sib = sibling_cache_dir()
+    if sib is not None:
+        return os.path.join(sib, "artifacts")
+    return os.path.join(os.path.expanduser("~"), ".cache",
+                        "deap_tpu", "artifacts")
+
+
+def _broadcast(kind: str, **payload: Any) -> None:
+    try:
+        from deap_tpu.telemetry.journal import broadcast
+        broadcast(kind, **payload)
+    except Exception:
+        pass
+
+
+def _env_stamp() -> Dict[str, str]:
+    """The reuse gate: a serialized executable is device- and
+    version-specific, so entries written under any other (backend,
+    device kind, jax version) triple are skipped, never loaded."""
+    import jax
+
+    try:
+        backend = jax.default_backend()
+        device_kind = jax.devices()[0].device_kind
+    except Exception:
+        backend, device_kind = "unknown", "unknown"
+    return {"jax": jax.__version__, "backend": str(backend),
+            "device_kind": str(device_kind)}
+
+
+class ExecutableArtifactStore:
+    """One artifact directory: manifest + serialized-executable blobs.
+
+    Thread-safe (one lock around manifest state — engine prewarms run
+    off the driver thread); safe across processes (atomic
+    read-merge-write puts). All jax imports are lazy: constructing a
+    store, or reading its manifest, never initialises a backend.
+    """
+
+    def __init__(self, directory: str):
+        self.directory = os.path.abspath(os.path.expanduser(
+            str(directory)))
+        os.makedirs(self.directory, exist_ok=True)
+        self.manifest_path = os.path.join(self.directory, MANIFEST_NAME)
+        self._lock = threading.Lock()
+        self._entries: Dict[str, Dict[str, Any]] = self._read_manifest()
+        self._stamp: Optional[Dict[str, str]] = None
+        self.hits = 0
+        self.misses = 0
+
+    # ------------------------------------------------------- manifest ----
+
+    def _read_manifest(self) -> Dict[str, Dict[str, Any]]:
+        """Tolerant read: a missing, torn, or foreign-format manifest
+        is an empty store, never an exception."""
+        try:
+            with open(self.manifest_path, "r") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            return {}
+        if not isinstance(doc, dict) \
+                or doc.get("format") != MANIFEST_FORMAT:
+            return {}
+        entries = doc.get("entries")
+        return dict(entries) if isinstance(entries, dict) else {}
+
+    def _write_manifest(self, entries: Dict[str, Dict[str, Any]]) -> None:
+        doc = {"format": MANIFEST_FORMAT, "entries": entries}
+        fd, tmp = tempfile.mkstemp(dir=self.directory,
+                                   suffix=".manifest.tmp")
+        try:
+            with os.fdopen(fd, "w") as fh:
+                json.dump(doc, fh, sort_keys=True, indent=1)
+            os.replace(tmp, self.manifest_path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def _merge_put(self, key: str, entry: Dict[str, Any]) -> None:
+        """Read-merge-write: re-read the file under the lock, fold the
+        new entry in, replace atomically — two processes writing
+        different keys both survive (same-key last-writer-wins is fine:
+        the blobs are content-identical by construction)."""
+        with self._lock:
+            on_disk = self._read_manifest()
+            on_disk.update(self._entries)
+            on_disk[key] = entry
+            self._entries = on_disk
+            self._write_manifest(on_disk)
+
+    # ----------------------------------------------------------- keys ----
+
+    def stamp(self) -> Dict[str, str]:
+        with self._lock:
+            if self._stamp is None:
+                self._stamp = _env_stamp()
+            return dict(self._stamp)
+
+    def key_for(self, hlo_hash: str) -> str:
+        s = self.stamp()
+        kind = "".join(c if c.isalnum() else "-"
+                       for c in s["device_kind"])[:32]
+        return (f"{s['backend']}-{kind}-{s['jax']}-{hlo_hash}"
+                .replace("/", "-"))
+
+    def _blob_path(self, key: str) -> str:
+        return os.path.join(self.directory, key + ".exec")
+
+    # ------------------------------------------------------- get / put ----
+
+    def get(self, label: str, hlo_hash: str) -> Optional[Any]:
+        """The loaded executable for ``hlo_hash`` under the current
+        environment stamp, or ``None`` (journaled ``artifact_miss``
+        with the reason) — the caller then compiles, bit-identically."""
+        t0 = time.perf_counter()
+        key = self.key_for(hlo_hash)
+        with self._lock:
+            entry = self._entries.get(key)
+        if entry is None:
+            # another process may have written since we loaded the
+            # manifest (the serving restart races its own first child)
+            with self._lock:
+                fresh = self._read_manifest()
+                fresh.update({k: v for k, v in self._entries.items()
+                              if k not in fresh})
+                self._entries = fresh
+                entry = self._entries.get(key)
+        reason = None
+        if entry is None:
+            reason = "absent"
+        else:
+            stamp = self.stamp()
+            for field in ("jax", "backend", "device_kind"):
+                if entry.get(field) != stamp[field]:
+                    reason = "stamp_mismatch"
+                    break
+        compiled = None
+        if reason is None:
+            compiled, reason = self._load(entry)
+        if compiled is None:
+            self.misses += 1
+            _broadcast("artifact_miss", label=str(label),
+                       hlo_hash=str(hlo_hash), reason=reason)
+            return None
+        self.hits += 1
+        _broadcast("artifact_hit", label=str(label),
+                   hlo_hash=str(hlo_hash),
+                   deserialize_s=round(time.perf_counter() - t0, 6),
+                   bytes=int(entry.get("bytes", 0)))
+        return compiled
+
+    def _load(self, entry: Dict[str, Any]):
+        """(compiled, None) or (None, reason)."""
+        path = os.path.join(self.directory,
+                            os.path.basename(str(entry.get("file", ""))))
+        try:
+            with open(path, "rb") as fh:
+                raw = fh.read()
+        except OSError:
+            return None, "read_error"
+        if zlib.crc32(raw) != entry.get("crc"):
+            return None, "crc_mismatch"
+        try:
+            doc = pickle.loads(raw)
+            from jax.experimental import serialize_executable as se
+            in_tree, out_tree = pickle.loads(doc["trees"])
+            return se.deserialize_and_load(doc["blob"], in_tree,
+                                           out_tree), None
+        except Exception:
+            return None, "deserialize_error"
+
+    def put(self, label: str, hlo_hash: str, compiled: Any) -> bool:
+        """Persist one freshly compiled executable. Best-effort: a
+        program the pinned jax cannot serialize (or a full disk) is
+        skipped silently — the store only ever removes future compiles,
+        never adds failure modes to the run that populated it."""
+        try:
+            from jax.experimental import serialize_executable as se
+            blob, in_tree, out_tree = se.serialize(compiled)
+            payload = pickle.dumps(
+                {"format": MANIFEST_FORMAT, "label": str(label),
+                 "hlo_hash": str(hlo_hash), "blob": blob,
+                 "trees": pickle.dumps((in_tree, out_tree))},
+                protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception:
+            return False
+        key = self.key_for(hlo_hash)
+        path = self._blob_path(key)
+        try:
+            fd, tmp = tempfile.mkstemp(dir=self.directory,
+                                       suffix=".exec.tmp")
+            with os.fdopen(fd, "wb") as fh:
+                fh.write(payload)
+            os.replace(tmp, path)
+        except OSError:
+            return False
+        entry = dict(self.stamp())
+        entry.update(file=os.path.basename(path),
+                     crc=zlib.crc32(payload), bytes=len(payload),
+                     hlo_hash=str(hlo_hash), label=str(label))
+        self._merge_put(key, entry)
+        return True
+
+    # ------------------------------------------------------ lifecycle ----
+
+    def activate(self) -> "ExecutableArtifactStore":
+        """Install as the process-wide active store (the instrumented
+        AOT seams consult the active slot at call time)."""
+        self._prev = _ACTIVE[0]
+        _ACTIVE[0] = self
+        return self
+
+    def deactivate(self) -> None:
+        if _ACTIVE[0] is self:
+            _ACTIVE[0] = getattr(self, "_prev", None)
+        self._prev = None
+
+
+def enable_artifact_store(path: Optional[str] = None
+                          ) -> ExecutableArtifactStore:
+    """Create (or reuse) the store at ``path`` (default:
+    :func:`default_dir`) and activate it. Idempotent: re-enabling the
+    already-active directory returns the live store."""
+    resolved = os.path.abspath(os.path.expanduser(
+        str(path or default_dir())))
+    cur = _ACTIVE[0]
+    if cur is not None and cur.directory == resolved:
+        return cur
+    return ExecutableArtifactStore(resolved).activate()
+
+
+def disable_artifact_store() -> None:
+    """Deactivate the current store (tests, scheduler teardown)."""
+    cur = _ACTIVE[0]
+    if cur is not None:
+        cur.deactivate()

@@ -96,12 +96,27 @@ def sibling_cache_dir() -> Optional[str]:
     """Directory for sibling caches that should live — and be wiped —
     together with the compiled executables. The dispatch tuning cache
     (:mod:`deap_tpu.tuning`) stores its probe winners here when the
-    compile cache is enabled: the two artifacts that make a process
-    warm-start (compiled programs, and the measured dispatch choices
-    that select between them) stay one directory. None when the
-    compile cache is off (the tuning cache then falls back to
-    ``$DEAP_TPU_TUNING_CACHE`` or ``~/.cache/deap_tpu``)."""
+    compile cache is enabled, and the serialized-executable artifact
+    store (:mod:`deap_tpu.support.artifacts`) defaults its directory
+    under here too: the three artifacts that make a process warm-start
+    (compiled programs, loadable executables, and the measured
+    dispatch choices that select between them) stay one directory.
+    None when the compile cache is off (the siblings then fall back to
+    their own env vars or ``~/.cache/deap_tpu``)."""
     return _enabled_path
+
+
+def enable_artifact_cache(path: Optional[str] = None):
+    """Enable the serialized-executable artifact store — the sibling
+    cache that persists **loaded executables** (via
+    ``jax.experimental.serialize_executable``) so a restarted process
+    deserializes instead of compiling. Defaults to living inside the
+    enabled compile cache (see :func:`sibling_cache_dir`); thin
+    delegation so callers that already import this module need no
+    second import. Returns the active
+    :class:`~deap_tpu.support.artifacts.ExecutableArtifactStore`."""
+    from deap_tpu.support.artifacts import enable_artifact_store
+    return enable_artifact_store(path)
 
 
 def enable_from_env(var: str = ENV_VAR) -> Optional[str]:

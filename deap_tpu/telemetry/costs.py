@@ -61,6 +61,21 @@ __all__ = ["ProgramObservatory", "instrument", "observatory",
 #: instrument their programs are constructed far from the run driver)
 _ACTIVE: list = [None]
 
+#: lazily-bound deap_tpu.support.artifacts module (imported on first
+#: use, not at module import — support imports jax eagerly and this
+#: module must stay cheap to import)
+_ARTIFACTS: list = [None]
+
+
+def _artifact_store():
+    """The active executable artifact store, or None — the second
+    activator (besides an observatory) of the explicit AOT path."""
+    mod = _ARTIFACTS[0]
+    if mod is None:
+        from deap_tpu.support import artifacts as mod
+        _ARTIFACTS[0] = mod
+    return mod.active_store()
+
 
 def observatory() -> Optional["ProgramObservatory"]:
     """The currently active observatory, or None."""
@@ -261,11 +276,14 @@ def profile_compiled(label: str, lowered: Any, compiled: Any,
 
 
 class _InstrumentedFunction:
-    """The wrapper :func:`instrument` returns. Inactive observatory →
-    one None-check and a tail call into the wrapped jit. Active →
-    explicit ``.lower().compile()`` with a per-signature executable
-    cache (bit-identical: the executable is the one jit would build),
-    each compile profiled and drift-checked."""
+    """The wrapper :func:`instrument` returns. No active observatory
+    and no active artifact store → two None-checks and a tail call
+    into the wrapped jit. Either active → explicit
+    ``.lower().compile()`` with a per-signature executable cache
+    (bit-identical: the executable is the one jit would build), each
+    compile profiled and drift-checked (observatory) and each HLO hash
+    consulted against / persisted into the serialized-executable store
+    (:mod:`deap_tpu.support.artifacts`) — the restart fast path."""
 
     def __init__(self, fn: Callable, label: str,
                  static_argnums: Tuple[int, ...] = (),
@@ -308,7 +326,8 @@ class _InstrumentedFunction:
 
     def __call__(self, *args, **kwargs):
         obs = _ACTIVE[0]
-        if obs is None or self._broken:
+        store = _artifact_store()
+        if (obs is None and store is None) or self._broken:
             return self._fn(*args, **kwargs)
         try:
             sig = self._signature(args, kwargs)
@@ -316,20 +335,35 @@ class _InstrumentedFunction:
             return self._fn(*args, **kwargs)
         compiled = self._cache.get(sig)
         if compiled is None:
+            from_artifact = False
             try:
                 t0 = time.perf_counter()
                 lowered = self._fn.lower(*args, **kwargs)
-                compiled = lowered.compile()
+                hlo_hash = _hlo_fingerprint(lowered)
+                # the artifact fast path: a serialized executable for
+                # this exact HLO under this exact (backend, device
+                # kind, jax version) loads instead of compiling — any
+                # store failure returns None and the compile below
+                # builds the bit-identical program
+                if store is not None:
+                    compiled = store.get(self.label, hlo_hash)
+                    from_artifact = compiled is not None
+                if compiled is None:
+                    compiled = lowered.compile()
                 compile_s = time.perf_counter() - t0
             except Exception as exc:
                 # an exotic argument the AOT path can't take: profile
                 # nothing, run the program — observability must never
                 # take down the run it observes
                 self._broken = True
-                obs.record_error(self.label, exc)
+                if obs is not None:
+                    obs.record_error(self.label, exc)
                 return self._fn(*args, **kwargs)
-            obs.record(self.label, lowered, compiled, compile_s,
-                       signature=sig, donating=self._donating)
+            if obs is not None:
+                obs.record(self.label, lowered, compiled, compile_s,
+                           signature=sig, donating=self._donating)
+            if store is not None and not from_artifact:
+                store.put(self.label, hlo_hash, compiled)
             self._cache[sig] = compiled
         call_args, call_kwargs = self._strip_static(args, kwargs)
         return compiled(*call_args, **call_kwargs)

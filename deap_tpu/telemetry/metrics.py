@@ -50,7 +50,7 @@ __all__ = ["Counter", "Gauge", "Histogram", "HistogramSnapshot",
            "MetricsRegistry", "MetricsServer", "SERVING_PHASE_BUCKETS",
            "SERVING_SEGMENT_BUCKETS", "SERVING_WAIT_BUCKETS",
            "get_registry", "metrics_text", "phase_histogram",
-           "serve_metrics"]
+           "serve_metrics", "startup_phase_histogram"]
 
 #: default histogram bucket bounds (seconds) — spans sub-ms host work
 #: to multi-minute compiles; ``+Inf`` is implicit
@@ -502,6 +502,26 @@ def phase_histogram(registry: Optional[MetricsRegistry] = None
         "(queue_wait, wal_fsync, admission, compile, device, "
         "checkpoint, wire_encode, replay, build).",
         labels=("phase",), buckets=SERVING_PHASE_BUCKETS)
+
+
+def startup_phase_histogram(registry: Optional[MetricsRegistry] = None
+                            ) -> Histogram:
+    """Declare (or fetch) the startup waterfall histogram
+    ``deap_service_startup_phase_seconds{phase=...}`` on ``registry``
+    (default: the process registry). One observation per phase per
+    service start — wal_replay (reading + rebuilding accepted jobs),
+    restore (checkpoint payload verify + materialise), prewarm
+    (warm-handoff lattice compile/deserialize), first_result (start →
+    first completed tenant). The metrics face of the journal's
+    ``startup_phase`` rows (docs/advanced/coldstart.md)."""
+    reg = registry if registry is not None else get_registry()
+    return reg.histogram(
+        "deap_service_startup_phase_seconds",
+        "Per-phase service startup wall time (wal_replay, restore, "
+        "prewarm, first_result) — the cold-start waterfall.",
+        labels=("phase",),
+        buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 15.0,
+                 30.0, 60.0, 120.0))
 
 
 def metrics_text(registry: Optional[MetricsRegistry] = None) -> str:

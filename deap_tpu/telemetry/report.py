@@ -326,17 +326,33 @@ def _loadgen_section(events: List[Dict[str, Any]], out: List[str]
         return
     out.append("")
     out.append("## Load observatory")
+    _restart_keys = ("restart_t", "restart_ready_t",
+                     "time_to_first_result_after_restart_s")
     for e in runs:
         tallies = ", ".join(
             f"{k}×{v}" for k, v in sorted(e.items())
             if k not in ("kind", "t", "model", "seed", "speed",
-                         "n_arrivals", "planned_s", "wall_s"))
+                         "n_arrivals", "planned_s", "wall_s")
+            and k not in _restart_keys)
         out.append(f"- loadgen {e.get('model')} (seed "
                    f"{e.get('seed')}, ×{_fmt(e.get('speed', 1.0))}): "
                    f"{e.get('n_arrivals')} arrival(s) over "
                    f"{_fmt(e.get('wall_s'))}s "
                    f"(planned {_fmt(e.get('planned_s'))}s)"
                    + (f" — {tallies}" if tallies else ""))
+        if e.get("restart_t") is not None:
+            rt, ready = e.get("restart_t"), e.get("restart_ready_t")
+            first = e.get("time_to_first_result_after_restart_s")
+            outage = (_fmt(ready - rt)
+                      if isinstance(ready, (int, float))
+                      and isinstance(rt, (int, float)) else "?")
+            out.append(
+                f"  - restart drill: killed at t={_fmt(rt)}s, "
+                f"serving again at t={_fmt(ready)}s "
+                f"(outage {outage}s), first result "
+                + (f"+{_fmt(first)}s after the kill"
+                   if first is not None else
+                   "never landed after the kill ▲"))
     if gates:
         bad = [g for g in gates if not g.get("ok")]
         out.append(f"- SLO gates: {len(gates) - len(bad)}/{len(gates)} "
@@ -345,6 +361,49 @@ def _loadgen_section(events: List[Dict[str, Any]], out: List[str]
             out.append(f"  - ▲ {g.get('slo')}: worst "
                        f"{_fmt(g.get('worst'))} > threshold "
                        f"{_fmt(g.get('threshold'))}")
+
+
+def _startup_section(events: List[Dict[str, Any]], out: List[str]
+                     ) -> None:
+    """Startup ledger: the ``startup_phase`` waterfall a restarted
+    service journals (wal_replay → restore → prewarm → first_result)
+    plus the artifact-store hit/miss tally — together they answer
+    "where did the cold start go" without attaching a profiler."""
+    phases = [e for e in events if e.get("kind") == "startup_phase"]
+    hits = [e for e in events if e.get("kind") == "artifact_hit"]
+    misses = [e for e in events if e.get("kind") == "artifact_miss"]
+    if not (phases or hits or misses):
+        return
+    out.append("")
+    out.append("## Startup ledger")
+    if phases:
+        # journal order IS wall order (each phase notes its duration
+        # as it completes); a bar per phase scaled to the longest
+        longest = max(float(e.get("seconds", 0.0)) for e in phases)
+        total = 0.0
+        for e in phases:
+            s = float(e.get("seconds", 0.0))
+            total += s
+            width = (int(round(s / longest * 24))
+                     if longest > 0 else 0)
+            out.append(f"- {str(e.get('phase', '?')).ljust(14)} "
+                       f"{_fmt(s)}s {'█' * max(width, 1)}")
+        out.append(f"- startup phases total: {_fmt(total)}s "
+                   "(traffic was held until prewarm finished — "
+                   "`/healthz` served 503 `warming`)")
+    if hits or misses:
+        n = len(hits) + len(misses)
+        saved = sum(float(e.get("deserialize_s", 0.0)) for e in hits)
+        out.append(f"- executable artifact store: {len(hits)}/{n} "
+                   f"hit(s) ({_fmt(saved)}s deserializing instead of "
+                   "compiling)")
+        reasons: Dict[str, int] = {}
+        for e in misses:
+            r = str(e.get("reason", "?"))
+            reasons[r] = reasons.get(r, 0) + 1
+        if reasons:
+            out.append("  - misses: " + ", ".join(
+                f"{k}×{v}" for k, v in sorted(reasons.items())))
 
 
 def _service_section(events: List[Dict[str, Any]], out: List[str]
@@ -585,6 +644,7 @@ def render_report(path: str, lines: Optional[List[str]] = None) -> str:
         # recorder) and the summary still apply to the process
         _slo_section(events, out)
         _loadgen_section(events, out)
+        _startup_section(events, out)
         _service_section(events, out)
         _program_table(events, out)
         _memory_section(events, out)
@@ -886,6 +946,22 @@ def render_slo(path: str, window_s: float = 1.0) -> str:
         out.append(f"| {g['slo']} | {g['metric']} "
                    f"| {_fmt(g['threshold'])} | {_fmt_opt(g['worst'])} "
                    f"| {'ok' if g['ok'] else '**FAIL**'} |")
+    drills = [e for e in events if e.get("kind") == "loadgen_run"
+              and e.get("restart_t") is not None]
+    if drills:
+        out.append("")
+        out.append("## Restart drill")
+        out.append("")
+        for e in drills:
+            first = e.get("time_to_first_result_after_restart_s")
+            out.append(
+                f"- {e.get('model')}: service killed at "
+                f"t={_fmt(e.get('restart_t'))}s, serving again at "
+                f"t={_fmt_opt(e.get('restart_ready_t'))}s; first "
+                "result landed "
+                + (f"{_fmt(first)}s after the kill"
+                   if first is not None
+                   else "**never** after the kill"))
     return "\n".join(out)
 
 

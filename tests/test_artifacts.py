@@ -1,0 +1,186 @@
+"""Executable artifact store (ISSUE 18): integrity, fallback and
+concurrency contracts.
+
+The store's one promise is that it can only ever REMOVE compiles from a
+restart, never change results or add failure modes: every corruption /
+mismatch path must fall back to ``None`` (caller compiles, journaled),
+and a loaded artifact must execute bit-identically to the executable it
+serialized.
+"""
+
+import json
+import importlib.util
+import os
+import subprocess
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deap_tpu.support.artifacts import (ARTIFACT_JOURNAL_KINDS,
+                                        ExecutableArtifactStore,
+                                        disable_artifact_store,
+                                        enable_artifact_store)
+from deap_tpu.telemetry.journal import RunJournal, read_journal
+
+DOCS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "docs")
+
+
+def _compiled(c=2.0):
+    x = jnp.arange(8, dtype=jnp.float32)
+    lowered = jax.jit(lambda v: v * c + 1.0).lower(x)
+    return lowered.compile(), x
+
+
+def _rows(path, kind):
+    return [e for e in read_journal(path) if e.get("kind") == kind]
+
+
+def test_round_trip_bit_identity(tmp_path):
+    store = ExecutableArtifactStore(str(tmp_path / "a"))
+    compiled, x = _compiled()
+    want = np.asarray(compiled(x)[0])
+    assert store.put("f", "h1", compiled)
+
+    # a FRESH store over the same directory (the restarted process)
+    jpath = str(tmp_path / "j.jsonl")
+    with RunJournal(jpath):
+        loaded = ExecutableArtifactStore(str(tmp_path / "a")).get(
+            "f", "h1")
+        assert loaded is not None
+        np.testing.assert_array_equal(np.asarray(loaded(x)[0]), want)
+    hits = _rows(jpath, "artifact_hit")
+    assert len(hits) == 1 and hits[0]["hlo_hash"] == "h1"
+
+
+@pytest.mark.parametrize("damage", ["flip", "truncate"])
+def test_corrupt_blob_falls_back_to_compile(tmp_path, damage):
+    store = ExecutableArtifactStore(str(tmp_path / "a"))
+    compiled, x = _compiled()
+    assert store.put("f", "h1", compiled)
+    blob = store._blob_path(store.key_for("h1"))
+    raw = open(blob, "rb").read()
+    if damage == "flip":
+        bad = raw[: len(raw) // 2] + bytes([raw[len(raw) // 2] ^ 0xFF]) \
+            + raw[len(raw) // 2 + 1:]
+    else:
+        bad = raw[: len(raw) // 3]
+    with open(blob, "wb") as fh:
+        fh.write(bad)
+
+    jpath = str(tmp_path / "j.jsonl")
+    with RunJournal(jpath):
+        assert ExecutableArtifactStore(str(tmp_path / "a")).get(
+            "f", "h1") is None
+    misses = _rows(jpath, "artifact_miss")
+    assert len(misses) == 1
+    assert misses[0]["reason"] == "crc_mismatch"
+    # ... and the caller's compile of the same program is the result
+    # the store would have produced: bit-identity holds through the
+    # fallback path too
+    want = np.asarray(compiled(x)[0])
+    refetched, _ = _compiled()
+    np.testing.assert_array_equal(np.asarray(refetched(x)[0]), want)
+
+
+def test_stamp_mismatch_skips_entry(tmp_path):
+    store = ExecutableArtifactStore(str(tmp_path / "a"))
+    compiled, _ = _compiled()
+    assert store.put("f", "h1", compiled)
+    mpath = store.manifest_path
+    doc = json.load(open(mpath))
+    for entry in doc["entries"].values():
+        entry["jax"] = "0.0.0-other"
+    with open(mpath, "w") as fh:
+        json.dump(doc, fh)
+
+    jpath = str(tmp_path / "j.jsonl")
+    with RunJournal(jpath):
+        assert ExecutableArtifactStore(str(tmp_path / "a")).get(
+            "f", "h1") is None
+    assert _rows(jpath, "artifact_miss")[0]["reason"] == "stamp_mismatch"
+
+
+def test_missing_key_is_a_journaled_miss(tmp_path):
+    jpath = str(tmp_path / "j.jsonl")
+    with RunJournal(jpath):
+        assert ExecutableArtifactStore(str(tmp_path / "a")).get(
+            "f", "never-compiled") is None
+    assert _rows(jpath, "artifact_miss")[0]["reason"] == "absent"
+
+
+def test_concurrent_read_merge_write_merges_both(tmp_path):
+    """Two store instances over one directory — the two-process race a
+    serving restart actually runs (the dying child's last put vs the
+    fresh child's first). Both entries must survive the merge."""
+    a = ExecutableArtifactStore(str(tmp_path / "a"))
+    b = ExecutableArtifactStore(str(tmp_path / "a"))
+    ca, _ = _compiled(2.0)
+    cb, _ = _compiled(3.0)
+    assert a.put("fa", "ha", ca)
+    # b's in-memory manifest predates a's put; its own put must merge,
+    # not clobber
+    assert b.put("fb", "hb", cb)
+    fresh = ExecutableArtifactStore(str(tmp_path / "a"))
+    assert fresh.get("fa", "ha") is not None
+    assert fresh.get("fb", "hb") is not None
+
+
+def test_manifest_and_container_load_without_jax(tmp_path):
+    """The manifest is stdlib JSON and the blob container a plain
+    pickled dict — tooling (report.py, fleet jobs) must be able to
+    inventory a store with no jax importable at all."""
+    store = ExecutableArtifactStore(str(tmp_path / "a"))
+    compiled, _ = _compiled()
+    assert store.put("f", "h1", compiled)
+    mod_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "deap_tpu", "support", "artifacts.py")
+    child = textwrap.dedent(f"""
+        import builtins, importlib.util, json, pickle, os, sys
+        real_import = builtins.__import__
+        def guard(name, *a, **k):
+            if name == "jax" or name.startswith("jax."):
+                raise AssertionError("jax imported in no-jax child")
+            return real_import(name, *a, **k)
+        builtins.__import__ = guard
+        spec = importlib.util.spec_from_file_location(
+            "artifacts_standalone", {mod_path!r})
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[spec.name] = mod
+        spec.loader.exec_module(mod)
+        store = mod.ExecutableArtifactStore({str(tmp_path / "a")!r})
+        assert store._entries, "manifest empty in child"
+        entry = next(iter(store._entries.values()))
+        blob = os.path.join(store.directory, entry["file"])
+        doc = pickle.loads(open(blob, "rb").read())
+        assert isinstance(doc["blob"], bytes)
+        print("OK", len(store._entries))
+    """)
+    out = subprocess.run([sys.executable, "-c", child],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.startswith("OK 1")
+
+
+def test_enable_is_idempotent_and_disable_restores(tmp_path):
+    try:
+        s1 = enable_artifact_store(str(tmp_path / "a"))
+        s2 = enable_artifact_store(str(tmp_path / "a"))
+        assert s1 is s2
+    finally:
+        disable_artifact_store()
+    from deap_tpu.support.artifacts import active_store
+    assert active_store() is None
+
+
+def test_journal_kinds_documented():
+    """Drift gate: every journal kind this module writes is in the
+    telemetry doc's kind table (mirrors the SLO_JOURNAL_KINDS gate)."""
+    doc = open(os.path.join(DOCS, "advanced", "telemetry.md")).read()
+    for kind in ARTIFACT_JOURNAL_KINDS:
+        assert f"`{kind}`" in doc, f"{kind} missing from telemetry.md"

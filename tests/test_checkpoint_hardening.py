@@ -125,6 +125,85 @@ def test_all_corrupt_raises(tmp_path):
         ckpt.restore()
 
 
+def test_restore_latest_verifies_each_file_once(tmp_path, monkeypatch):
+    """ISSUE 18: the tenant-filtered restore walk used to CRC-sweep a
+    file in checkpoint_meta() and then AGAIN in restore_state() —
+    restore_latest must load + verify each candidate exactly once."""
+    from deap_tpu.support import checkpoint as cp
+
+    ckpt = Checkpointer(str(tmp_path / "c"), keep=4)
+    for s in range(3):
+        ckpt.save(s, {"s": jnp.asarray(s)}, meta={"tenant_id": "t1"})
+    calls: list = []
+    real = cp._verify_payload
+
+    def counting(path, payload):
+        calls.append(path)
+        return real(path, payload)
+
+    monkeypatch.setattr(cp, "_verify_payload", counting)
+    step, state = ckpt.restore_latest(tenant_id="t1")
+    assert step == 2 and int(state["s"]) == 2
+    # one verification total: the newest file passed, walk stopped
+    assert calls == [ckpt._path(2)]
+
+    # a corrupt newest file is verified once, skipped, and the walk
+    # verifies the next file once — never the same path twice
+    calls.clear()
+    corrupt_file(ckpt._path(2), mode="flip")
+    step, _ = ckpt.restore_latest(tenant_id="t1")
+    assert step == 1
+    assert calls == [ckpt._path(2), ckpt._path(1)]
+
+
+def test_save_without_fsync_round_trips(tmp_path):
+    """fsync=False (the per-boundary serving mode) keeps the atomic
+    rename and the CRC format — only the two fsync syscalls go."""
+    ckpt = Checkpointer(str(tmp_path / "c"), keep=2, fsync=False)
+    state = {"x": jnp.arange(64, dtype=jnp.float32),
+             "key": jax.random.key(5)}
+    ckpt.save(0, state, meta={"tenant_id": "t1"})
+    verify_checkpoint(ckpt._path(0))  # full per-leaf CRC sweep passes
+    step, got = ckpt.restore_latest(tenant_id="t1")
+    assert step == 0
+    _assert_tree_equal(
+        {"x": state["x"],
+         "key": jax.random.key_data(state["key"])},
+        {"x": got["x"], "key": jax.random.key_data(got["key"])})
+
+
+def test_post_save_verify_does_not_reload_payload(tmp_path,
+                                                  monkeypatch):
+    """ISSUE 18: Checkpointer.save's post-write check is a raw
+    read-back CRC compare — it must not re-unpickle the file (the old
+    verify_checkpoint() round cost ~1.2s/run at serving frequency)."""
+    from deap_tpu.support import checkpoint as cp
+
+    ckpt = Checkpointer(str(tmp_path / "c"), keep=2)
+    loads: list = []
+    real = cp._load_payload
+
+    def counting(path):
+        loads.append(path)
+        return real(path)
+
+    monkeypatch.setattr(cp, "_load_payload", counting)
+    ckpt.save(0, {"s": jnp.arange(16)})
+    assert loads == []  # no unpickle on the save path
+    # ... while a corrupted write is still caught (read-back compare)
+    real_save = cp.save_state
+
+    def torn_save(path, state, meta=None, **kw):
+        crc = real_save(path, state, meta=meta, **kw)
+        corrupt_file(path, mode="truncate", offset=-32)
+        return crc
+
+    monkeypatch.setattr(cp, "save_state", torn_save)
+    ckpt.save(1, {"s": jnp.arange(16)})
+    monkeypatch.undo()
+    assert 1 not in ckpt._verified
+
+
 def test_rotation_never_deletes_last_verified_good(tmp_path,
                                                    monkeypatch):
     """A save whose own post-write verification fails must rotate
@@ -138,9 +217,10 @@ def test_rotation_never_deletes_last_verified_good(tmp_path,
 
     real_save = cp.save_state
 
-    def broken_save(path, state, meta=None):
-        real_save(path, state, meta=meta)
+    def broken_save(path, state, meta=None, **kw):
+        crc = real_save(path, state, meta=meta, **kw)
         corrupt_file(path, mode="flip")  # disk fault on the new file
+        return crc
 
     monkeypatch.setattr(cp, "save_state", broken_save)
     ckpt.save(1, {"s": 1})

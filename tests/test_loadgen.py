@@ -346,6 +346,60 @@ def test_loadgen_live_run_digests_and_replay(tmp_path):
     assert rrep.counts.get("finished") == len(rsched.arrivals)
 
 
+def test_loadgen_restart_drill(tmp_path):
+    """ISSUE 18: a :class:`RestartPlan` kills-and-restarts the service
+    mid-schedule. Workers that die into the outage park on the ready
+    event and re-offer once (tenant id = idempotency key), every job
+    still lands, and the report carries the restart marks +
+    time-to-first-result-after-restart — the client-observed mirror of
+    the service's own ``first_result`` startup phase."""
+    from deap_tpu.serving.loadgen import RestartPlan
+
+    model = PoissonTraffic(rate_per_s=50.0, problem="onemax",
+                           params={"ngen": 6}, n=6)
+    sched = model.schedule(seed=11)
+    root = tmp_path / "svc"
+    svc1 = _live_service(root)
+    later = []
+
+    def _restart() -> str:
+        svc1.close()
+        svc2 = _live_service(root)   # same root: WAL + checkpoints
+        later.append(svc2)
+        return svc2.url
+
+    class _J:
+        rows: list = []
+
+        def event(self, kind, **kw):
+            self.rows.append({"kind": kind, **kw})
+
+    j = _J()
+    try:
+        rep = run_schedule(sched, svc1.url,
+                           max_workers=len(sched.arrivals),
+                           poll_timeout_s=120.0,
+                           restart=RestartPlan(at_s=1.0,
+                                               restart=_restart),
+                           journal=j)
+    finally:
+        svc1.close()
+        for s in later:
+            s.close()
+    assert later, "restart never fired"
+    assert rep.counts.get("finished") == len(sched.arrivals), rep.counts
+    assert rep.restart_t is not None
+    assert rep.restart_ready_t is not None
+    assert rep.restart_ready_t >= rep.restart_t
+    assert rep.time_to_first_result_after_restart_s is not None
+    assert rep.time_to_first_result_after_restart_s >= 0.0
+    lg = [r for r in j.rows if r["kind"] == "loadgen_run"]
+    assert len(lg) == 1
+    assert lg[0]["restart_t"] == rep.restart_t
+    assert lg[0]["time_to_first_result_after_restart_s"] == \
+        rep.time_to_first_result_after_restart_s
+
+
 def test_loadgen_live_segment_attribution(tmp_path):
     """An injected in-segment stall (the ``segment`` fault seam) must
     come out of :func:`attribute_regression` named ``segment`` — the
