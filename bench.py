@@ -1777,6 +1777,217 @@ def tracing_lines(out_path: str = "BENCH_TRACING.json") -> list:
     return rows
 
 
+# ------------------------------- canary observability (ISSUE 19) ----
+
+#: the canary job is deliberately tiny (ngen 8 vs the load's 30): it
+#: rides the production scheduler, so its cost IS the overhead the
+#: <= 3% gate bounds
+CANARY_JOB = dict(seed=424242, pop=16, length=32, ngen=8)
+CANARY_CADENCE = 20         # boundaries between canaries under load
+CANARY_DETECT_SEG = 2       # segment_len of the detection mini-run
+
+
+def canary_lines(out_path: str = "BENCH_CANARY.json") -> list:
+    """The canary/alerting acceptance measurement (ISSUE 19), two
+    halves in one session:
+
+    1. **Clean-run cost + false positives** — the 1k-tenant socket
+       config from :func:`service_lines` run canary-off vs canary-on
+       (known-answer canaries every ``CANARY_CADENCE`` boundaries,
+       burn-rate alert engine live), interleaved min-of-reps. Gates:
+       overhead <= 3% and ZERO alert transitions / canary failures
+       across every clean canary-on rep — a paging signal that cries
+       wolf is worse than none.
+    2. **Detection latency** — a dedicated run with
+       ``CorruptResult`` armed for the second canary (the first
+       learns the trust-on-first-use reference): the corrupted wire
+       digest must produce the ``canary_failed`` row, the ``canary``
+       alarm and the FIRING ``canary_failure`` alert within two
+       segment boundaries of the canary completing.
+    """
+    import shutil
+    import tempfile
+    from concurrent.futures import ThreadPoolExecutor
+
+    from deap_tpu.resilience.faultinject import (CorruptResult,
+                                                 FaultPlan)
+    from deap_tpu.serving import (EvolutionService, Scheduler,
+                                  ServiceClient)
+    from deap_tpu.serving.canary import CanarySpec
+    from deap_tpu.support.compilecache import enable_compile_cache
+    from deap_tpu.telemetry.journal import read_journal
+    from deap_tpu.telemetry.metrics import MetricsRegistry
+    from deap_tpu.telemetry.probes import HealthMonitor
+
+    envfp = _env_fingerprint("cpu")
+    onemax = _service_problem()
+    work = tempfile.mkdtemp(prefix="deap_canary_bench_")
+    enable_compile_cache(os.path.join(work, "xla_cache"))
+
+    canary_params = dict(CANARY_JOB)
+    canary_seed = canary_params.pop("seed")
+
+    def canary_spec(cadence=CANARY_CADENCE):
+        return CanarySpec("onemax",
+                          dict(canary_params, seed=canary_seed),
+                          cadence_boundaries=cadence)
+
+    # lattice warmup, same as the other service benches: the timed
+    # lane count into the persistent cache so no arm pays a cold
+    # compile (the canary job shape warms in rep 0's first arm)
+    warm = Scheduler(os.path.join(work, "warm"),
+                     **_service_sched_kwargs(SERVICE_LANES_FIXED))
+    warm.prewarm([onemax("warm0", {"seed": 0})], lane_counts=(64,))
+    warm.close()
+
+    def arm_run(label, with_canary, rep):
+        root = os.path.join(work, f"{label}{rep}")
+        svc = EvolutionService(
+            root, {"onemax": onemax}, metrics=MetricsRegistry(),
+            canary=canary_spec() if with_canary else None,
+            **_service_sched_kwargs(SERVICE_LANES_FIXED))
+
+        def drive(chunk):
+            c = ServiceClient(svc.url)
+            tids = c.submit_many([
+                {"problem": "onemax", "params": p, "tenant_id": tid}
+                for tid, p in chunk])
+            got = c.results_many(tids, wait=True, timeout=600)
+            c.close()
+            for tid, entry in got.items():
+                assert entry["status"] == "finished", (tid, entry)
+
+        all_specs = [(f"t{i:04d}", {"seed": i})
+                     for i in range(SERVICE_N)]
+        per = (SERVICE_N + SERVICE_CLIENTS - 1) // SERVICE_CLIENTS
+        chunks = [all_specs[i * per:(i + 1) * per]
+                  for i in range(SERVICE_CLIENTS)]
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(SERVICE_CLIENTS) as pool:
+            list(pool.map(drive, chunks))
+        dt = time.perf_counter() - t0
+        svc.close()
+        rows = read_journal(os.path.join(root, "journal.jsonl"))
+        alerts = [r for r in rows if r.get("kind") == "alert"]
+        failed = [r for r in rows
+                  if r.get("kind") == "canary_failed"]
+        oks = [r for r in rows if r.get("kind") == "canary_ok"]
+        return dt, alerts, failed, oks
+
+    ARMS = (("canary_off", False), ("canary_on", True))
+    times = {label: [] for label, _ in ARMS}
+    false_alerts = 0
+    false_failures = 0
+    clean_oks = 0
+    for rep in range(SERVICE_REPS):
+        order = ARMS[rep % len(ARMS):] + ARMS[:rep % len(ARMS)]
+        for label, with_canary in order:
+            dt, alerts, failed, oks = arm_run(
+                label, with_canary, rep)
+            times[label].append(dt)
+            false_alerts += len(alerts)
+            false_failures += len(failed)
+            if with_canary:
+                clean_oks += len(oks)
+
+    best = {label: min(ts) for label, ts in times.items()}
+    overhead_pct = (100.0 * (best["canary_on"] - best["canary_off"])
+                    / best["canary_off"])
+
+    # -- detection latency: corrupt the SECOND canary (the first
+    # learns the clean reference), cadence 1 so boundaries tick fast
+    det_root = os.path.join(work, "detect")
+    health = HealthMonitor()
+    svc = EvolutionService(
+        det_root, {"onemax": onemax}, metrics=MetricsRegistry(),
+        health=health,
+        fault_plan=FaultPlan([CorruptResult(
+            tenant_substr="canary-2")]),
+        canary=canary_spec(cadence=1),
+        max_lanes=8, segment_len=CANARY_DETECT_SEG,
+        fair_quantum=None, checkpoint_every=0, telemetry=False)
+    t0 = time.perf_counter()
+    detect_wall = None
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        if svc.canary.failed >= 1 and svc.alerts.firing():
+            detect_wall = time.perf_counter() - t0
+            break
+        time.sleep(0.05)
+    alarm_fired = any(a.get("alarm") == "canary"
+                      for a in health.alarms)
+    firing = list(svc.alerts.firing())
+    svc.close()
+    rows = read_journal(os.path.join(det_root, "journal.jsonl"))
+    idx_fail = next((i for i, r in enumerate(rows)
+                     if r.get("kind") == "canary_failed"), None)
+    idx_alert = next((i for i, r in enumerate(rows)
+                      if r.get("kind") == "alert"
+                      and r.get("state") == "firing"
+                      and r.get("name") == "canary_failure"), None)
+    if idx_fail is not None and idx_alert is not None:
+        detect_boundaries = len(
+            [r for r in rows[idx_fail:idx_alert]
+             if r.get("kind") == "slo"])
+    else:
+        detect_boundaries = None
+    detected = (idx_fail is not None and idx_alert is not None
+                and alarm_fired and "canary_failure" in firing)
+
+    total_gens = SERVICE_N * SERVICE_JOB["ngen"]
+    rows_out = []
+    for label, _ in ARMS:
+        rows_out.append(
+            {"metric": f"{label}_seconds",
+             "value": round(best[label], 3), "unit": "seconds",
+             "tenants": SERVICE_N, "clients": SERVICE_CLIENTS,
+             "lanes": SERVICE_LANES_FIXED,
+             "gens_per_sec": round(total_gens / best[label], 1),
+             "reps": [round(t, 3) for t in times[label]],
+             **SERVICE_JOB, "env": envfp})
+    rows_out += [
+        {"metric": "canary_overhead_pct",
+         "value": round(overhead_pct, 2), "unit": "%", "gate": "<= 3",
+         "cadence_boundaries": CANARY_CADENCE,
+         "canary_job": CANARY_JOB,
+         "note": "interleaved min-of-reps pair, same session",
+         "env": envfp},
+        {"metric": "canary_false_alarms",
+         "value": int(false_alerts + false_failures), "unit": "count",
+         "gate": "== 0", "alert_rows": int(false_alerts),
+         "canary_failed_rows": int(false_failures),
+         "clean_canary_ok_rows": int(clean_oks),
+         "reps": SERVICE_REPS, "env": envfp},
+        {"metric": "canary_detection_boundaries",
+         "value": detect_boundaries, "unit": "segment boundaries",
+         "gate": "<= 2",
+         "detect_wall_s": (round(detect_wall, 3)
+                           if detect_wall is not None else None),
+         "segment_len": CANARY_DETECT_SEG, "env": envfp},
+        {"metric": "canary_detected",
+         "value": bool(detected), "unit": "bool",
+         "alarm": bool(alarm_fired), "firing": firing,
+         "env": envfp},
+    ]
+
+    shutil.rmtree(work, ignore_errors=True)
+    if out_path:
+        payload = {
+            "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "env": envfp,
+            "config": {"tenants": SERVICE_N,
+                       "clients": SERVICE_CLIENTS, "job": SERVICE_JOB,
+                       "segment_len": SERVICE_SEG,
+                       "lanes": SERVICE_LANES_FIXED,
+                       "canary_job": CANARY_JOB,
+                       "cadence_boundaries": CANARY_CADENCE},
+            "tail": "\n".join(json.dumps(r) for r in rows_out),
+        }
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=1)
+    return rows_out
+
+
 # ------------------------------- service chaos plane (ISSUE 12) ----
 
 CHAOS_N = 200               # live retrying tenants under the kill
@@ -3387,6 +3598,20 @@ if __name__ == "__main__":
         out = (nxt if nxt and not nxt.startswith("--")
                else "BENCH_LOADGEN.json")
         for row in loadgen_lines(out):
+            print(json.dumps(row), flush=True)
+    elif "--canary" in sys.argv:
+        # the canary/alerting acceptance measurement (ISSUE 19): the
+        # 1k-tenant socket config canary-off vs canary-on (zero false
+        # alarms, overhead <= 3%) plus the injected-corruption
+        # detection-latency run (firing alert within two segment
+        # boundaries) — committed as BENCH_CANARY.json;
+        # bench_report.py --tripwire gates all three
+        jax.config.update("jax_platforms", "cpu")
+        i = sys.argv.index("--canary")
+        nxt = sys.argv[i + 1] if i + 1 < len(sys.argv) else None
+        out = (nxt if nxt and not nxt.startswith("--")
+               else "BENCH_CANARY.json")
+        for row in canary_lines(out):
             print(json.dumps(row), flush=True)
     elif "--tracing" in sys.argv:
         # the tracing-overhead acceptance measurement (ISSUE 15): the

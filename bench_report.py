@@ -833,6 +833,86 @@ def tracing_tripwire(threshold: float = TRACING_OVERHEAD_THRESHOLD) -> int:
     return tripped
 
 
+#: canary steady-state cost ceiling — the known-answer probe rides
+#: the production scheduler, so its overhead at the 1k-tenant socket
+#: config must stay within noise of the canary-off arm
+CANARY_OVERHEAD_THRESHOLD = 0.03
+#: the injected corruption must produce a FIRING canary_failure
+#: alert within this many segment boundaries of the canary completing
+CANARY_DETECT_BOUNDARIES = 2
+
+
+def canary_tripwire(threshold: float = CANARY_OVERHEAD_THRESHOLD) -> int:
+    """The canary/alerting gate (ISSUE 19), over the latest committed
+    BENCH_CANARY*.json: (1) ZERO false alarms across every clean rep
+    — no ``alert`` transitions and no ``canary_failed`` rows when
+    nothing is wrong (a paging signal that cries wolf is worse than
+    none); (2) the injected-corruption run detected end to end
+    (``canary_failed`` row + ``canary`` alarm + firing
+    ``canary_failure`` alert) within ``CANARY_DETECT_BOUNDARIES``
+    segment boundaries; (3) the canary-on arm within ``threshold`` of
+    canary-off at the 1k-tenant socket config, interleaved
+    min-of-reps. Returns the number of tripped rows."""
+    files = sorted(glob.glob(os.path.join(HERE, "BENCH_CANARY*.json")))
+    if not files:
+        print("canary tripwire: no committed BENCH_CANARY*.json yet")
+        return 0
+    rows = _bench_rows(files[-1])
+    tripped = 0
+    print(f"\n## Canary observability ({os.path.basename(files[-1])})\n")
+    fa = rows.get("canary_false_alarms")
+    if fa is not None and isinstance(fa.get("value"), int):
+        ok = fa["value"] == 0
+        print(f"- clean-run false alarms: {fa['value']} "
+              f"({fa.get('alert_rows', '?')} alert rows, "
+              f"{fa.get('canary_failed_rows', '?')} canary_failed, "
+              f"{fa.get('clean_canary_ok_rows', '?')} canary_ok over "
+              f"{fa.get('reps', '?')} reps) "
+              + ("ok" if ok else "**REGRESSION** (the alert plane "
+                 "pages on a healthy run)"))
+        tripped += 0 if ok else 1
+    else:
+        print("- canary_false_alarms row missing")
+        tripped += 1
+    det = rows.get("canary_detection_boundaries")
+    flag = rows.get("canary_detected")
+    detected = bool(flag and flag.get("value"))
+    if (det is not None and isinstance(det.get("value"), int)
+            and detected):
+        ok = det["value"] <= CANARY_DETECT_BOUNDARIES
+        print(f"- injected corruption → firing alert in "
+              f"{det['value']} boundary(ies) "
+              f"({det.get('detect_wall_s', '?')}s wall) "
+              + ("ok" if ok else "**REGRESSION** (> "
+                 f"{CANARY_DETECT_BOUNDARIES} boundaries — detection "
+                 "got slow)"))
+        tripped += 0 if ok else 1
+    else:
+        print("- corruption detection: **REGRESSION** (the injected "
+              "wrong answer was not detected end to end)")
+        tripped += 1
+    ov = rows.get("canary_overhead_pct")
+    off = rows.get("canary_off_seconds")
+    on = rows.get("canary_on_seconds")
+    if ov is not None and isinstance(ov.get("value"), (int, float)):
+        overhead = ov["value"] / 100.0
+        ok = overhead <= threshold
+        pair = ""
+        if off and on:
+            pair = (f"on {on['value']}s vs off {off['value']}s "
+                    f"({off.get('tenants', '?')} tenants), ")
+        print(f"- {pair}same session: {100 * overhead:+.2f}% overhead "
+              + ("ok" if ok else f"**REGRESSION** (> {threshold:.0%} "
+                 "— the canary got expensive)"))
+        tripped += 0 if ok else 1
+    else:
+        print("- canary_overhead_pct row missing")
+        tripped += 1
+    if len(files) >= 2:
+        tripped += _diff_rows(files[-2], files[-1], TRIPWIRE_THRESHOLD)
+    return tripped
+
+
 TUNING_WINNER_THRESHOLD_X = 0.95
 TUNING_WARM_THRESHOLD_PCT = 1.0
 
@@ -1028,6 +1108,7 @@ def tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
     tripped += tracing_tripwire()
     tripped += tuning_tripwire()
     tripped += loadgen_tripwire()
+    tripped += canary_tripwire()
     return tripped
 
 

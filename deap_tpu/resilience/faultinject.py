@@ -51,6 +51,13 @@ written, ``wal_append`` after an admission-WAL record lands):
 - :class:`TornWAL` — tear the tail off the admission WAL right after a
   record lands (then optionally ``SIGKILL``), emulating a power cut
   mid-append; replay must drop exactly the torn (never-ACKed) record.
+- :class:`CorruptResult` — silently corrupt a finishing tenant's raw
+  result (one flipped byte in the first array leaf) at the service's
+  ``result`` seam, BEFORE the wire encode. Every layer still reports
+  success — journal, status, HTTP 200 — which is exactly the silent
+  wrong-answer failure only the known-answer canary tenants
+  (:mod:`deap_tpu.serving.canary`) can catch: the corrupted result's
+  wire digest no longer matches the canary's precomputed reference.
 """
 
 from __future__ import annotations
@@ -63,11 +70,12 @@ from typing import Any, List, Optional
 import jax.numpy as jnp
 
 __all__ = ["InjectedCrash", "InjectedTransient", "InjectedDrop",
-           "InjectedReject", "Fault", "FaultPlan", "KillAt",
-           "PreemptAt", "CorruptCheckpoint", "FailSegments",
-           "DropResponse", "Reject429", "DelaySegment",
-           "KillServiceAt", "TornWAL", "nan_inject_evaluate",
-           "corrupt_file"]
+           "InjectedReject", "InjectedCorruption", "Fault",
+           "FaultPlan", "KillAt", "PreemptAt", "CorruptCheckpoint",
+           "FailSegments", "DropResponse", "Reject429",
+           "DelaySegment", "KillServiceAt", "TornWAL",
+           "CorruptResult", "nan_inject_evaluate", "corrupt_file",
+           "corrupt_pytree"]
 
 
 class InjectedCrash(RuntimeError):
@@ -97,6 +105,14 @@ class InjectedReject(RuntimeError):
     def __init__(self, message: str, retry_after_s: float = 1.0):
         super().__init__(message)
         self.retry_after_s = float(retry_after_s)
+
+
+class InjectedCorruption(RuntimeError):
+    """A simulated silent wrong answer: the service's boundary handler
+    catches this around the result handoff and perturbs the finishing
+    tenant's raw result (:func:`corrupt_pytree`) *before* the wire
+    encode — so every success signal still fires and only a
+    known-answer digest compare can tell."""
 
 
 class Fault:
@@ -354,6 +370,60 @@ class TornWAL(Fault):
             if self.then_crash:
                 raise InjectedCrash(
                     f"injected crash after tearing {ctx['path']}")
+
+
+class CorruptResult(Fault):
+    """Silently corrupt the raw result of the next ``times`` finishing
+    tenants whose id contains ``tenant_substr`` — fired on the
+    service's ``result`` event at the segment boundary where the
+    tenant completes. The service catches the raised
+    :class:`InjectedCorruption` and swaps in
+    ``corrupt_pytree(result)`` before the result view is published, so
+    the corruption is upstream of the wire digest: journal, tenant
+    status and HTTP all report success, and only the known-answer
+    canary's digest compare (:mod:`deap_tpu.serving.canary`) can
+    detect it. The default ``tenant_substr='canary'`` aims the fault
+    straight at the canary tenants — the end-to-end detection proof
+    ``bench.py --canary`` measures the latency of."""
+
+    def __init__(self, tenant_substr: str = "canary", times: int = 1):
+        super().__init__()
+        self.tenant_substr = str(tenant_substr)
+        self.times = int(times)
+
+    def fire(self, event: str, **ctx) -> None:
+        if event == "result" and self.fired < self.times \
+                and self.tenant_substr in str(ctx.get("tenant_id", "")):
+            self.fired += 1
+            raise InjectedCorruption(
+                f"injected result corruption for "
+                f"{ctx.get('tenant_id')} (#{self.fired}/{self.times})")
+
+
+def corrupt_pytree(tree: Any) -> Any:
+    """Return ``tree`` with the first byte of its first numeric array
+    leaf XOR-flipped — the smallest corruption that is *guaranteed* to
+    change the wire digest (which hashes raw leaf bytes), independent
+    of dtype and of special values like NaN/inf that arithmetic
+    perturbations can leave fixed. Structure, shapes and dtypes are
+    untouched; non-array leaves pass through."""
+    import jax
+    import numpy as np
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    for i, leaf in enumerate(leaves):
+        try:
+            arr = np.asarray(leaf)
+        except Exception:
+            continue
+        if arr.size == 0 or arr.dtype.kind not in "biufc":
+            continue
+        damaged = np.array(arr)  # contiguous owned copy
+        raw = damaged.reshape(-1).view(np.uint8)
+        raw[0] ^= 0xA5
+        leaves[i] = damaged
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+    return tree
 
 
 def nan_inject_evaluate(evaluate, rows: Any):

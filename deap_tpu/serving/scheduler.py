@@ -792,6 +792,10 @@ class Scheduler:
             for alarm in health.check_row(row, gen=row.get("gen")):
                 self.journal.event("alarm", tenant_id=tenant.id,
                                    **alarm)
+                if self.metrics is not None:
+                    from deap_tpu.telemetry.metrics import alarms_total
+                    alarms_total(self.metrics).inc(
+                        kind=alarm.get("alarm", "unknown"))
 
     def _drain_boundary(self, bucket: _Bucket, seg: Dict[str, Any],
                         t_start: Optional[float] = None) -> None:

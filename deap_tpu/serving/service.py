@@ -80,12 +80,21 @@ that is threaded through the journal for end-to-end tracing):
 ``GET /v1/jobs/<id>/stream``          NDJSON per-segment events until a
                                       terminal event
 ``GET /healthz``                      liveness (``ok`` / ``warming`` /
-                                      ``draining`` / ``stalled``; only
-                                      ``ok`` answers 200)
+                                      ``draining`` / ``stalled`` /
+                                      ``degraded`` — firing alerts;
+                                      only ``ok`` answers 200) + a
+                                      JSON detail body (watchdog
+                                      verdict, prewarm progress,
+                                      startup phases, seconds since
+                                      the last boundary, firing
+                                      alerts, canary counters)
 ``GET /metrics``                      the scheduler's Prometheus
                                       registry (same text as
                                       ``serve_metrics`` — one port
                                       serves both planes)
+``GET /v1/alerts``                    the burn-rate alert engine's
+                                      state (per-rule state/burn
+                                      rates + firing list)
 ``POST /v1/drain``                    begin graceful drain
 ====================================  =================================
 
@@ -141,25 +150,36 @@ import traceback
 import urllib.parse
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from deap_tpu.resilience.faultinject import InjectedDrop, InjectedReject
+from deap_tpu.resilience.faultinject import (InjectedCorruption,
+                                             InjectedDrop,
+                                             InjectedReject,
+                                             corrupt_pytree)
 from deap_tpu.serving import wire
 from deap_tpu.serving.autoscale import AutoscaleConfig, AutoscalePolicy
+from deap_tpu.serving.canary import CanaryRunner, CanarySpec
 from deap_tpu.serving.scheduler import Scheduler
 from deap_tpu.serving.tenant import Job, bucket_key
 from deap_tpu.serving.wal import AdmissionWAL
 from deap_tpu.telemetry import tracing
+from deap_tpu.telemetry.alerts import (ALERT_STATE_VALUES, AlertEngine,
+                                       service_rules)
 
 __all__ = ["EvolutionService", "SERVICE_JOURNAL_KINDS"]
 
 #: journal kinds this module writes (documented in the
 #: docs/advanced/telemetry.md kind table; drift-gated by
-#: tests/test_service.py)
+#: tests/test_service.py). ``alert`` rows come from the burn-rate
+#: engine (telemetry/alerts.py), ``canary_ok``/``canary_failed`` from
+#: the known-answer canary runner (serving/canary.py) — both driven
+#: from the service's boundary fan-out, so their rows land in the
+#: scheduler journal alongside everything else.
 SERVICE_JOURNAL_KINDS = ("service_request", "service_drain",
                          "autoscale_decision", "auth_rejected",
                          "wal_replay", "idempotent_replay",
                          "deadline_exceeded", "load_shed",
                          "driver_stall", "trace_span",
-                         "startup_phase")
+                         "startup_phase", "alert",
+                         "canary_ok", "canary_failed")
 
 #: file the warm-handoff lattice manifest persists to, next to the WAL
 WARM_MANIFEST_NAME = "warm_manifest.json"
@@ -283,6 +303,21 @@ class EvolutionService:
         thread after every scheduler step — the deterministic
         fault-injection seam (drain-mid-segment tests, bursty-load
         generators) in the spirit of ``resilience/faultinject.py``.
+    :param alerts: the burn-rate alert plane (ISSUE 19): ``True``
+        (default) builds an :class:`~deap_tpu.telemetry.alerts.
+        AlertEngine` over :func:`~deap_tpu.telemetry.alerts.
+        service_rules` (canary failures, shed rate, deadline-miss
+        rate), journaling ``alert`` transition rows and serving
+        ``GET /v1/alerts`` + the ``deap_alert_state`` gauge; pass an
+        engine instance for custom rules, or ``None``/``False`` to
+        disable. Firing alerts flip ``/healthz`` to ``degraded``
+        (503).
+    :param canary: a :class:`~deap_tpu.serving.canary.CanarySpec`
+        (or prebuilt :class:`~deap_tpu.serving.canary.CanaryRunner`)
+        enabling known-answer canary tenants — fixed-seed jobs
+        submitted through the real front end at a boundary cadence,
+        digest-checked against a precomputed (or trust-on-first-use)
+        reference. ``None`` (default) = no canaries.
     :param scheduler_kwargs: forwarded to :class:`Scheduler`
         (``max_lanes``, ``segment_len``, ``fair_quantum``,
         ``metrics``, ``compile_cache``, ``trace_sample`` — the
@@ -305,6 +340,8 @@ class EvolutionService:
                  health=None,
                  fault_plan=None,
                  step_hook: Optional[Callable[[int], None]] = None,
+                 alerts=True,
+                 canary=None,
                  **scheduler_kwargs):
         self.root = str(root)
         self.problems = dict(problems)
@@ -327,6 +364,37 @@ class EvolutionService:
                                    fault_hook=self._sched_fault,
                                    **scheduler_kwargs)
         self.journal = self.scheduler.journal
+
+        # ---- active observability plane (ISSUE 19): burn-rate alert
+        # engine + known-answer canary tenants, both driven from the
+        # boundary fan-out on the driver thread (deterministic order,
+        # no extra threads, no clocks inside the engine)
+        if alerts is True:
+            alerts = AlertEngine(service_rules(),
+                                 journal=self.journal,
+                                 on_transition=self._on_alert)
+        elif alerts:
+            if alerts.journal is None:
+                alerts.journal = self.journal
+            if alerts.on_transition is None:
+                alerts.on_transition = self._on_alert
+        self.alerts: Optional[AlertEngine] = alerts or None
+        if isinstance(canary, CanarySpec):
+            canary = CanaryRunner(canary)
+        self.canary: Optional[CanaryRunner] = canary or None
+        self._canary_token: Optional[str] = None
+        if self.canary is not None and self.tokens is not None:
+            # internal quota-free bearer identity for the canary's
+            # own submits; never handed out
+            self._canary_token = "canary-" + os.urandom(12).hex()
+            self.tokens[self._canary_token] = {"tenant": "canary",
+                                               "max_jobs": None}
+        self._last_boundary: Optional[float] = None
+        # previous boundary's cumulative load counters — the deltas
+        # are the live shed/deadline-miss rate samples the alert
+        # engine burns on
+        self._prev_load = {"arrivals": 0, "sheds": 0,
+                           "deadline_misses": 0}
 
         self._lock = threading.Lock()
         # job factories run eager array ops; dozens of request threads
@@ -379,6 +447,9 @@ class EvolutionService:
         self._warm_dirty = False
         self._warm_plan = self._read_warm_manifest()
         self._warming = bool(self._warm_plan)
+        # prewarm progress for the /healthz detail body
+        self._warm_total = len(self._warm_plan)
+        self._warm_done = 0
 
         # ---- durable admission: open (healing any torn tail) and
         # replay the WAL BEFORE any thread starts — recovered jobs are
@@ -606,6 +677,7 @@ class EvolutionService:
         warmed = 0
         try:
             for rec in plan:
+                self._warm_done += 1   # buckets attempted, for /healthz
                 factory = self.problems.get(str(rec.get("problem")))
                 if factory is None:
                     continue
@@ -636,6 +708,23 @@ class EvolutionService:
     def _fire_fault(self, event: str, **ctx) -> None:
         if self.fault_plan is not None:
             self.fault_plan.fire(event, **ctx)
+
+    def _on_alert(self, tr: Dict[str, Any]) -> None:
+        """Alert transitions → the ``deap_alert_state{name}`` gauge
+        (0 inactive/resolved, 1 pending, 2 firing)."""
+        reg = self.scheduler.metrics
+        if reg is not None:
+            from deap_tpu.telemetry.metrics import alert_state_gauge
+            alert_state_gauge(reg).set(
+                ALERT_STATE_VALUES[tr["to"]], name=tr["name"])
+
+    def _alarm_metric(self, kind: str) -> None:
+        """HealthMonitor alarms → ``deap_alarms_total{kind}`` —
+        alarms used to reach only the journal (ISSUE 19 satellite)."""
+        reg = self.scheduler.metrics
+        if reg is not None:
+            from deap_tpu.telemetry.metrics import alarms_total
+            alarms_total(reg).inc(kind=kind)
 
     def _sched_fault(self, event: str, **ctx) -> None:
         """The scheduler's fault seam (``fault_hook``), stamped with
@@ -778,6 +867,10 @@ class EvolutionService:
                         self.step_hook(self._steps)
                     if self._steps % self.autoscale_every == 0:
                         self._autoscale_tick()
+                elif self.canary is not None:
+                    # idle bootstrap: with nothing runnable there are
+                    # no boundaries, so the canary primes itself here
+                    self.canary.prime(self)
             # ------------------------------------------- graceful drain
             self._pump_commands(block=False)
             saved = sched.checkpoint_all()
@@ -912,6 +1005,7 @@ class EvolutionService:
     def _on_boundary(self, bucket_label: str,
                      updates: List[Dict[str, Any]]) -> None:
         self._beat = time.monotonic()
+        self._last_boundary = self._beat
         self._fire_fault("boundary", step=self._steps + 1,
                          bucket=bucket_label)
         for u in updates:
@@ -931,7 +1025,18 @@ class EvolutionService:
                 ev["records"] = wire.pack(u["chunk"])
             self._publish(t.id, ev)
             if u["finished"]:
-                view.set_result(t.result)
+                raw = t.result
+                try:
+                    # the silent-wrong-answer seam: a CorruptResult
+                    # fault raises here and the raw result is
+                    # perturbed BEFORE the view publishes it — every
+                    # success signal below still fires, only the
+                    # canary's digest compare can tell
+                    self._fire_fault("result", step=self._steps + 1,
+                                     tenant_id=t.id)
+                except InjectedCorruption:
+                    raw = corrupt_pytree(raw)
+                view.set_result(raw)
                 view.status = t.status
                 self._wal_done(t.id, t.status)
                 if self._first_result_pending:
@@ -941,6 +1046,35 @@ class EvolutionService:
                                      "tenant_id": t.id,
                                      "gen": u["gen"]})
                 self._publish(t.id, None)
+        if self.canary is not None or self.alerts is not None:
+            self._observability_tick()
+
+    def _observability_tick(self) -> None:
+        """Driver thread, once per boundary fan-out: canary verdicts
+        and cadence submissions first (so an injected corruption is
+        alarmed at the boundary it finishes), then the live alert
+        samples — this boundary's shed/deadline-miss deltas — and one
+        deterministic alert-engine tick."""
+        t = time.monotonic() - self._t_start
+        if self.canary is not None:
+            self.canary.on_boundary(self, t)
+        if self.alerts is None:
+            return
+        counts = self.scheduler.load_counts()
+        arrivals = sum(counts["arrivals"].values())
+        d_arr = arrivals - self._prev_load["arrivals"]
+        d_shed = counts["sheds"] - self._prev_load["sheds"]
+        d_miss = (counts["deadline_misses"]
+                  - self._prev_load["deadline_misses"])
+        self._prev_load = {"arrivals": arrivals,
+                           "sheds": counts["sheds"],
+                           "deadline_misses": counts["deadline_misses"]}
+        offered = d_arr + d_shed
+        if offered > 0:
+            self.alerts.observe(t, "shed_rate", d_shed / offered)
+            self.alerts.observe(t, "deadline_miss_rate",
+                                d_miss / max(1, d_arr))
+        self.alerts.tick(t)
 
     # ------------------------------------------------------ watchdog ----
 
@@ -979,6 +1113,7 @@ class EvolutionService:
             if self.health is not None:
                 self.health.driver_stall(stalled_s=round(age, 3),
                                          steps=self._steps)
+            self._alarm_metric("driver_stall")
             if self.watchdog_exit:
                 # no drain, no flush beyond the journal line above
                 # (journal writes flush per row): the recovery path is
@@ -1418,14 +1553,36 @@ class EvolutionService:
         route = parsed.path.rstrip("/") or "/"
         qs = urllib.parse.parse_qs(parsed.query)
         if route == "/healthz" and method == "GET":
+            firing = (self.alerts.firing()
+                      if self.alerts is not None else [])
             status = ("stalled" if self._stalled
                       else "draining" if self.draining
-                      else "warming" if self._warming else "ok")
+                      else "warming" if self._warming
+                      else "degraded" if firing else "ok")
             code = 200 if status == "ok" else 503
-            return code, "application/json", json.dumps({
+            # the detail body is additive (ISSUE 19): existing probes
+            # keep the status-string + 200-only-on-ok contract
+            out = {
                 "status": status,
                 "jobs": len(self._views),
-                "problems": sorted(self.problems)}).encode(), False
+                "problems": sorted(self.problems),
+                "watchdog": {"enabled": self.watchdog_s is not None,
+                             "budget_s": self.watchdog_s,
+                             "stalled": self._stalled},
+                "warming": {"active": self._warming,
+                            "buckets_done": self._warm_done,
+                            "buckets_total": self._warm_total},
+                "startup_phases": dict(self._startup_phases),
+                "seconds_since_boundary": (
+                    round(time.monotonic() - self._last_boundary, 3)
+                    if self._last_boundary is not None else None),
+                "steps": self._steps,
+                "firing_alerts": firing,
+            }
+            if self.canary is not None:
+                out["canary"] = self.canary.snapshot()
+            return code, "application/json", \
+                json.dumps(out).encode(), False
         if route == "/metrics" and method == "GET":
             # the unified serving surface: the same registry text
             # serve_metrics() exposes, on the service's own port
@@ -1433,6 +1590,18 @@ class EvolutionService:
             text = reg.metrics_text() if reg is not None else ""
             return 200, ("text/plain; version=0.0.4; charset=utf-8"), \
                 text.encode(), False
+        if route == "/v1/alerts" and method == "GET":
+            # unauthenticated like /healthz and /metrics: the alert
+            # surface is operator plumbing, not tenant data
+            eng = self.alerts
+            out = {"alerts": (eng.snapshot()
+                              if eng is not None else []),
+                   "firing": (eng.firing()
+                              if eng is not None else []),
+                   "transitions": (len(eng.transitions)
+                                   if eng is not None else 0)}
+            return 200, "application/json", \
+                json.dumps(out).encode(), False
         token, info = self._auth(headers)
         if route == "/v1/jobs" and method == "POST":
             payload = json.loads(body or b"{}")

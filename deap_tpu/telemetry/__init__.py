@@ -40,10 +40,22 @@ structured machine-readable run telemetry either. This subsystem is
 opt-in everywhere and changes no computed result when enabled.
 """
 
+from deap_tpu.telemetry.alerts import (
+    AlertEngine,
+    AlertRule,
+    default_rules,
+    service_rules,
+)
 from deap_tpu.telemetry.costs import (
     ProgramObservatory,
     observatory,
     profile_compiled,
+)
+from deap_tpu.telemetry.federation import (
+    federate,
+    fleet_summary,
+    fleet_trace,
+    register_process,
 )
 from deap_tpu.telemetry.journal import (
     RunJournal,
@@ -85,6 +97,8 @@ from deap_tpu.telemetry.probes import (
 from deap_tpu.telemetry.run import RunTelemetry, strategy_probe
 
 __all__ = [
+    "AlertEngine",
+    "AlertRule",
     "DEFAULT_SLOS",
     "HistogramSnapshot",
     "Meter",
@@ -107,7 +121,11 @@ __all__ = [
     "attribute_regression",
     "broadcast",
     "compose_probes",
+    "default_rules",
     "evaluate_gates",
+    "federate",
+    "fleet_summary",
+    "fleet_trace",
     "windowed_curve",
     "environment_fingerprint",
     "exact_hypervolume",
@@ -117,7 +135,9 @@ __all__ = [
     "profile_compiled",
     "read_journal",
     "register_probe",
+    "register_process",
     "serve_metrics",
+    "service_rules",
     "strategy_probe",
     "toolbox_fingerprint",
 ]

@@ -49,8 +49,9 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 __all__ = ["Counter", "Gauge", "Histogram", "HistogramSnapshot",
            "MetricsRegistry", "MetricsServer", "SERVING_PHASE_BUCKETS",
            "SERVING_SEGMENT_BUCKETS", "SERVING_WAIT_BUCKETS",
-           "get_registry", "metrics_text", "phase_histogram",
-           "serve_metrics", "startup_phase_histogram"]
+           "alarms_total", "alert_state_gauge", "get_registry",
+           "metrics_text", "phase_histogram", "serve_metrics",
+           "startup_phase_histogram"]
 
 #: default histogram bucket bounds (seconds) — spans sub-ms host work
 #: to multi-minute compiles; ``+Inf`` is implicit
@@ -186,9 +187,16 @@ class Counter(_Instrument):
             return float(child[0]) if child else 0.0
 
     def samples(self):
-        for key in sorted(self._children):
-            yield "", _render_labels(self.labels, key), \
-                self._children[key][0]
+        # copy under the lock, render outside it: a concurrent inc()
+        # creating a new label child must not blow up ("dictionary
+        # changed size during iteration") mid-scrape — the exposition
+        # path used to iterate _children unlocked (ISSUE 19 satellite;
+        # hammer-tested by tests/test_alerts.py)
+        with self._lock:
+            items = [(key, self._children[key][0])
+                     for key in sorted(self._children)]
+        for key, value in items:
+            yield "", _render_labels(self.labels, key), value
 
 
 class Gauge(_Instrument):
@@ -214,9 +222,11 @@ class Gauge(_Instrument):
             return float(child[0]) if child else 0.0
 
     def samples(self):
-        for key in sorted(self._children):
-            yield "", _render_labels(self.labels, key), \
-                self._children[key][0]
+        with self._lock:   # see Counter.samples
+            items = [(key, self._children[key][0])
+                     for key in sorted(self._children)]
+        for key, value in items:
+            yield "", _render_labels(self.labels, key), value
 
 
 class _HistChild:
@@ -340,15 +350,20 @@ class Histogram(_Instrument):
                     for key in sorted(self._children)]
 
     def samples(self):
-        for key in sorted(self._children):
-            child = self._children[key]
-            for bound, c in zip(self.buckets, child.counts):
+        # consistent per-child copy under the lock (see
+        # Counter.samples): a mid-copy observe would otherwise tear a
+        # child's counts/total/n apart across the exposition
+        with self._lock:
+            items = [(key, list(child.counts), child.total, child.n)
+                     for key, child in sorted(self._children.items())]
+        for key, counts, total, n in items:
+            for bound, c in zip(self.buckets, counts):
                 yield "_bucket", _render_labels(
                     self.labels, key, f'le="{_fmt_value(bound)}"'), c
             yield "_bucket", _render_labels(self.labels, key,
-                                            'le="+Inf"'), child.n
-            yield "_sum", _render_labels(self.labels, key), child.total
-            yield "_count", _render_labels(self.labels, key), child.n
+                                            'le="+Inf"'), n
+            yield "_sum", _render_labels(self.labels, key), total
+            yield "_count", _render_labels(self.labels, key), n
 
 
 class MetricsRegistry:
@@ -522,6 +537,39 @@ def startup_phase_histogram(registry: Optional[MetricsRegistry] = None
         labels=("phase",),
         buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 15.0,
                  30.0, 60.0, 120.0))
+
+
+def alarms_total(registry: Optional[MetricsRegistry] = None
+                 ) -> Counter:
+    """Declare (or fetch) the HealthMonitor alarm counter
+    ``deap_alarms_total{kind=...}`` on ``registry`` (default: the
+    process registry). Before ISSUE 19 alarms reached only the
+    journal; this is their scrapeable face — the label vocabulary is
+    ``probes.HealthMonitor.ALARM_KINDS`` (non_finite, clone_spike,
+    premature_convergence, zero_improvement, hlo_drift, driver_stall,
+    canary)."""
+    reg = registry if registry is not None else get_registry()
+    return reg.counter(
+        "deap_alarms_total",
+        "HealthMonitor alarms fired, by kind (the journal's alarm "
+        "rows as a scrapeable counter).",
+        labels=("kind",))
+
+
+def alert_state_gauge(registry: Optional[MetricsRegistry] = None
+                      ) -> Gauge:
+    """Declare (or fetch) the burn-rate alert state gauge
+    ``deap_alert_state{name=...}`` on ``registry`` (default: the
+    process registry) — 0 inactive/resolved, 1 pending, 2 firing
+    (``telemetry.alerts.ALERT_STATE_VALUES``). The service updates it
+    on every alert transition, so a scraper sees exactly what
+    ``GET /v1/alerts`` reports."""
+    reg = registry if registry is not None else get_registry()
+    return reg.gauge(
+        "deap_alert_state",
+        "Burn-rate alert state by rule name (0 inactive/resolved, "
+        "1 pending, 2 firing).",
+        labels=("name",))
 
 
 def metrics_text(registry: Optional[MetricsRegistry] = None) -> str:

@@ -658,7 +658,8 @@ class HealthMonitor:
 
     #: every alarm kind this monitor can emit (report/tests key on it)
     ALARM_KINDS = ("non_finite", "clone_spike", "premature_convergence",
-                   "zero_improvement", "hlo_drift", "driver_stall")
+                   "zero_improvement", "hlo_drift", "driver_stall",
+                   "canary")
 
     def __init__(self, *, nan_check: bool = True,
                  clone_rate_max: Optional[float] = None,
@@ -717,6 +718,16 @@ class HealthMonitor:
         ``hlo_drift``, host-event-driven rather than row-driven;
         honours ``early_stop``/``on_alarm``."""
         return self._fire("driver_stall", gen, **detail)
+
+    def canary(self, gen=None, **detail) -> dict:
+        """Fire the ``canary`` alarm — called by the
+        :class:`~deap_tpu.serving.canary.CanaryRunner` when a
+        known-answer canary tenant's wire digest mismatches its
+        reference (or the canary cannot complete): the silent
+        wrong-answer failure class nothing row-driven can see. Like
+        ``driver_stall``, host-event-driven; honours
+        ``early_stop``/``on_alarm``."""
+        return self._fire("canary", gen, **detail)
 
     def _clone_rate(self, row) -> Optional[float]:
         v = row.get(self.clone_key)

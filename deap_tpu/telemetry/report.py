@@ -27,8 +27,8 @@ import os
 import sys
 from typing import Any, Dict, List, Optional
 
-__all__ = ["render_attribution", "render_report", "render_slo",
-           "render_trace", "sparkline", "main"]
+__all__ = ["render_attribution", "render_fleet", "render_report",
+           "render_slo", "render_trace", "sparkline", "main"]
 
 _SPARK = "▁▂▃▄▅▆▇█"
 _MAX_SPARK = 48  # terminal budget per series
@@ -36,6 +36,7 @@ _MAX_SPARK = 48  # terminal budget per series
 _journal_mod = None
 _tracing_mod = None
 _slo_mod = None
+_federation_mod = None
 
 
 def _journal():
@@ -89,6 +90,23 @@ def _slo():
         spec.loader.exec_module(mod)
         _slo_mod = mod
     return _slo_mod
+
+
+def _federation():
+    """federation.py loaded standalone — same no-jax guarantee as
+    :func:`_journal` (federation.py is pure stdlib and loads its own
+    siblings by path)."""
+    global _federation_mod
+    if _federation_mod is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "federation.py")
+        spec = importlib.util.spec_from_file_location(
+            "_deap_tpu_federation_standalone", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[spec.name] = mod
+        spec.loader.exec_module(mod)
+        _federation_mod = mod
+    return _federation_mod
 
 
 def sparkline(values: List[float], width: int = _MAX_SPARK) -> str:
@@ -1008,6 +1026,118 @@ def render_attribution(base_path: str, probe_path: str,
     return "\n".join(out)
 
 
+def render_fleet(root: str, window_s: float = 1.0) -> str:
+    """The fleet observatory view (``report.py --fleet``): every
+    registered process's journal generations merged into one
+    monotonic-rebased timeline, with per-process health columns, the
+    fleet-wide SLO curve, and the traces that crossed a process
+    boundary (stdlib-only, like every other view)."""
+    fed = _federation()
+    summary = fed.fleet_summary(root, window_s=window_s)
+    procs: Dict[str, Any] = summary["processes"]
+    rows = summary["rows"]
+    out: List[str] = []
+    out.append(f"# Fleet: {os.path.abspath(root)}")
+    out.append("")
+    if not procs:
+        out.append("- no registered processes under this root "
+                   "(expected <root>/<process_id>/journal.jsonl)")
+        return "\n".join(out)
+    timed = [r for r in rows if r.get("wall") is not None]
+    span = ((max(r["wall"] for r in timed)
+             - min(r["wall"] for r in timed)) if timed else 0.0)
+    out.append(f"- {len(procs)} process(es), {len(rows)} merged "
+               f"rows, {_fmt(span)}s of fleet timeline")
+    out.append("")
+    out.append("## Processes")
+    out.append("")
+    out.append("| process | gens | rows | tears | alarms | stalls "
+               "| canary ok/fail | sheds | ddl miss | firing alerts |")
+    out.append("|---|---|---|---|---|---|---|---|---|---|")
+    for pid in sorted(procs):
+        h = procs[pid]
+        flags = []
+        if h["missing_headers"]:
+            flags.append(f"▲{h['missing_headers']} headerless")
+        alarm_n = sum(h["alarms"].values())
+        firing = ", ".join(h["firing_alerts"]) if h["firing_alerts"] \
+            else "—"
+        out.append(
+            f"| {pid}{' ' + ' '.join(flags) if flags else ''} "
+            f"| {h['generations']} | {h['rows']} | {h['torn_tails']} "
+            f"| {alarm_n} | {h['driver_stalls']} "
+            f"| {h['canary_ok']}/{h['canary_failed']} "
+            f"| {h['load_sheds']} | {h['deadline_misses']} "
+            f"| {firing} |")
+    alarm_kinds: Dict[str, int] = {}
+    for h in procs.values():
+        for k, n in h["alarms"].items():
+            alarm_kinds[k] = alarm_kinds.get(k, 0) + n
+    if alarm_kinds:
+        out.append("")
+        out.append("- fleet alarms: " + ", ".join(
+            f"{k}×{n}" for k, n in sorted(alarm_kinds.items())))
+
+    curve = summary["curve"]
+    if curve:
+        out.append("")
+        out.append("## Fleet SLO curve")
+        out.append("")
+        out.append(f"- {len(curve)} window(s) of {_fmt(window_s)}s "
+                   "over the merged timeline")
+        out.append("")
+        out.append("| window | arrivals/s | shed | ddl miss "
+                   "| adm p99 s | wait p99 s | seg p99 s |")
+        out.append("|---|---|---|---|---|---|---|")
+        for w in curve:
+            out.append(
+                f"| {_fmt(w['t0'])}–{_fmt(w['t1'])} "
+                f"| {_fmt(w['arrival_rate'])} "
+                f"| {_fmt(w['shed_rate'])} "
+                f"| {_fmt(w['deadline_miss_rate'])} "
+                f"| {_fmt_opt(w['admission_p99'])} "
+                f"| {_fmt_opt(w['queue_wait_p99'])} "
+                f"| {_fmt_opt(w['segment_p99'])} |")
+        out.append("")
+        out.append("## Fleet gates (worst window vs threshold)")
+        out.append("")
+        out.append("| gate | metric | threshold | worst | verdict |")
+        out.append("|---|---|---|---|---|")
+        for g in _slo().evaluate_gates(curve):
+            out.append(
+                f"| {g['slo']} | {g['metric']} "
+                f"| {_fmt(g['threshold'])} | {_fmt_opt(g['worst'])} "
+                f"| {'ok' if g['ok'] else '**FAIL**'} |")
+
+    xt = summary["cross_traces"]
+    out.append("")
+    out.append("## Cross-process traces")
+    out.append("")
+    if not xt:
+        out.append("- none (no trace id spans more than one member — "
+                   "single process, or trace_sample unset)")
+    else:
+        for rec in xt[:10]:
+            rid = rec.get("request_id")
+            out.append(
+                f"- `{rec['trace_id']}`: {rec['spans']} span(s) "
+                f"across {', '.join(rec['processes'])}"
+                + (f" (request {rid})" if rid else ""))
+        if len(xt) > 10:
+            out.append(f"- … and {len(xt) - 10} more")
+        top = xt[0]
+        ident = top.get("request_id")
+        if ident:
+            trace = fed.fleet_trace(root, ident)
+            if trace and trace["spans"]:
+                out.append("")
+                out.append(f"### Waterfall: request {ident} "
+                           f"({', '.join(trace['processes'])})")
+                out.append("")
+                _waterfall(trace["spans"], out)
+    return "\n".join(out)
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     trace_id = perfetto = None
@@ -1030,6 +1160,23 @@ def main(argv=None) -> int:
     slo_view = "--slo" in argv
     if slo_view:
         argv.remove("--slo")
+    fleet_view = "--fleet" in argv
+    if fleet_view:
+        argv.remove("--fleet")
+    watch_s = None
+    if "--watch" in argv:
+        i = argv.index("--watch")
+        # optional interval value; defaults to 2 s
+        if i + 1 < len(argv) and not argv[i + 1].startswith("-"):
+            try:
+                watch_s = float(argv[i + 1])
+                del argv[i:i + 2]
+            except ValueError:
+                watch_s = 2.0
+                del argv[i:i + 1]
+        else:
+            watch_s = 2.0
+            del argv[i:i + 1]
     window_s = 1.0
     if "--window" in argv:
         i = argv.index("--window")
@@ -1042,9 +1189,26 @@ def main(argv=None) -> int:
     if not paths:
         print("usage: report.py [--trace <request-id|tenant-id> "
               "[--perfetto out.json]] [--slo [--window s]] "
-              "<journal.jsonl> [...]",
+              "[--fleet [--watch [s]]] "
+              "<journal.jsonl|fleet-root> [...]",
               file=sys.stderr)
         return 2
+    if fleet_view:
+        import time as _time
+        while True:
+            text = "\n\n".join(render_fleet(p, window_s=window_s)
+                               for p in paths)
+            if watch_s is not None:
+                # live refresh: clear screen + home, rerender
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print(text)
+            if watch_s is None:
+                return 0
+            sys.stdout.flush()
+            try:
+                _time.sleep(watch_s)
+            except KeyboardInterrupt:
+                return 0
     if slo_view:
         # one journal: windowed curves + gates; two journals:
         # curves for each, then base → probe attribution

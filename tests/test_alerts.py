@@ -1,0 +1,274 @@
+"""Burn-rate alert engine — state machine, determinism, metrics race.
+
+The acceptance bar of ``deap_tpu/telemetry/alerts.py`` (ISSUE 19):
+the multi-window state machine follows its documented transition
+table exactly, the engine is deterministic (same sample stream and
+config → byte-identical journaled transitions — it never reads a
+clock), the canary rule's epsilon burn makes ANY failing sample fire
+even when surrounded by passing canaries, and the metrics plane the
+alerts export through survives a snapshot-vs-observe hammer (the
+``samples()``/``expose()`` iteration is now taken under the registry
+lock — satellite (c))."""
+
+import json
+import threading
+
+import pytest
+
+from deap_tpu.telemetry.alerts import (ALERT_STATE_VALUES,
+                                       ALERT_STATES, AlertEngine,
+                                       AlertRule, default_rules,
+                                       service_rules)
+from deap_tpu.telemetry.metrics import (MetricsRegistry, alarms_total,
+                                        alert_state_gauge,
+                                        metrics_text)
+
+
+class _Sink:
+    def __init__(self):
+        self.rows = []
+
+    def event(self, kind, **payload):
+        self.rows.append(dict(kind=kind, **payload))
+
+
+def _engine(**rule_kw):
+    kw = dict(name="r", metric="m", threshold=0.5,
+              fast_window_s=10.0, slow_window_s=60.0, burn=0.5)
+    kw.update(rule_kw)
+    sink = _Sink()
+    return AlertEngine([AlertRule(**kw)], journal=sink), sink
+
+
+# ---------------------------------------------------- state machine ----
+
+def test_states_and_gauge_encoding():
+    assert ALERT_STATES == ("inactive", "pending", "firing",
+                            "resolved")
+    # resolved encodes as 0 so the gauge shows live state, not history
+    assert ALERT_STATE_VALUES["resolved"] == 0
+    assert ALERT_STATE_VALUES["firing"] == 2
+
+
+def test_rule_validation():
+    with pytest.raises(ValueError):
+        AlertRule("r", "m", 1.0, fast_window_s=0.0)
+    with pytest.raises(ValueError):
+        AlertRule("r", "m", 1.0, fast_window_s=10.0, slow_window_s=5.0)
+    with pytest.raises(ValueError):
+        AlertRule("r", "m", 1.0, burn=0.0)
+    with pytest.raises(ValueError):
+        AlertRule("r", "m", 1.0, burn=1.5)
+    with pytest.raises(ValueError):
+        AlertEngine([AlertRule("dup", "m", 1.0),
+                     AlertRule("dup", "m2", 1.0)])
+
+
+def test_no_samples_never_transitions():
+    eng, sink = _engine()
+    for t in (0.0, 5.0, 100.0):
+        assert eng.tick(t) == []
+    assert eng.state("r") == "inactive"
+    assert sink.rows == []
+
+
+def test_none_values_are_skipped():
+    eng, _ = _engine()
+    eng.observe(1.0, "m", None)
+    eng.tick(2.0)
+    assert eng.state("r") == "inactive"
+
+
+def test_fast_hot_slow_cold_goes_pending_then_firing():
+    # slow window twice the fast one: early hot samples make the fast
+    # window burn before the slow window accumulates confidence
+    eng, sink = _engine(fast_window_s=10.0, slow_window_s=20.0)
+    for t in (0.0, 1.0):
+        eng.observe(t, "m", 0.0)          # cold history
+    for t in (12.0, 13.0, 14.0):
+        eng.observe(t, "m", 1.0)          # hot burst
+    # at t=15: fast window (5..15] is all hot; slow window (-5..15]
+    # still majority-diluted by the cold samples? 3 hot / 5 = 0.6 ≥
+    # 0.5 — tune the cold history so slow stays below the burn
+    eng2, sink2 = _engine(fast_window_s=10.0, slow_window_s=20.0)
+    for t in (0.0, 1.0, 2.0, 3.0):
+        eng2.observe(t, "m", 0.0)
+    for t in (12.0, 13.0, 14.0):
+        eng2.observe(t, "m", 1.0)
+    out = eng2.tick(15.0)
+    assert eng2.state("r") == "pending"    # fast 3/3, slow 3/7
+    assert [tr["to"] for tr in out] == ["pending"]
+    # hot keeps coming: the slow window crosses the burn → firing
+    for t in (16.0, 17.0, 18.0, 19.0):
+        eng2.observe(t, "m", 1.0)
+    eng2.tick(20.0)
+    assert eng2.state("r") == "firing"
+    assert [r["state"] for r in sink2.rows] == ["pending", "firing"]
+
+
+def test_pending_decays_to_inactive():
+    eng, sink = _engine(fast_window_s=10.0, slow_window_s=40.0)
+    for t in (0.0, 1.0, 2.0, 3.0, 4.0):
+        eng.observe(t, "m", 0.0)
+    eng.observe(5.0, "m", 1.0)
+    eng.tick(14.0)                         # fast (4..14]: only the hot
+    assert eng.state("r") == "pending"     # slow 1/6 stays cold
+    eng.tick(20.0)                         # hot sample left the window
+    assert eng.state("r") == "inactive"
+    assert [r["state"] for r in sink.rows] == ["pending", "inactive"]
+
+
+def test_firing_resolves_then_collapses_silently():
+    eng, sink = _engine()
+    eng.observe(1.0, "m", 1.0)
+    eng.tick(2.0)                          # 1/1 in both → firing
+    assert eng.state("r") == "firing"
+    eng.observe(3.0, "m", 0.0)
+    eng.observe(4.0, "m", 0.0)
+    eng.tick(5.0)                          # fast burn 1/3 < 0.5
+    assert eng.state("r") == "resolved"
+    eng.tick(6.0)                          # silent collapse
+    assert eng.state("r") == "inactive"
+    assert [r["state"] for r in sink.rows] == ["firing", "resolved"]
+    # the collapse journaled nothing
+    assert len(sink.rows) == 2
+
+
+def test_sample_trim_never_changes_verdicts():
+    eng, _ = _engine(fast_window_s=5.0, slow_window_s=10.0)
+    for t in range(100):
+        eng.observe(float(t), "m", 1.0 if t % 2 else 0.0)
+        eng.tick(float(t) + 0.5)
+    # trimmed buffer only holds the slow window
+    assert all(t > 90.5 - 10.0 for t, _ in eng._samples["r"])
+
+
+# ------------------------------------------------------ determinism ----
+
+def test_determinism_identical_streams_identical_transitions():
+    import random
+    rng = random.Random(19)
+    stream = [(i * 0.5, rng.random()) for i in range(400)]
+
+    def run():
+        eng, sink = _engine(threshold=0.6, fast_window_s=5.0,
+                            slow_window_s=30.0)
+        for t, v in stream:
+            eng.observe(t, "m", v)
+            if int(t * 2) % 4 == 0:
+                eng.tick(t)
+        return json.dumps(sink.rows, sort_keys=True)
+
+    assert run() == run()
+
+
+def test_observe_curve_feeds_window_edges():
+    eng = AlertEngine(default_rules())
+    eng.observe_curve([
+        {"t0": 0.0, "t1": 1.0, "shed_rate": 0.5,
+         "deadline_miss_rate": 0.0},
+        {"t0": 1.0, "t1": 2.0, "shed_rate": 0.5},
+    ])
+    eng.tick(2.0)
+    assert eng.state("shed_rate") == "firing"
+    assert eng.state("deadline_miss_rate") == "inactive"
+    # queue_wait_p99 got no samples at all: untouched
+    assert eng.state("queue_wait_p99") == "inactive"
+
+
+# ------------------------------------------------------ canary rule ----
+
+def test_canary_epsilon_burn_fires_despite_passing_neighbours():
+    """A known-answer failure is an incident, not a rate: one failing
+    canary surrounded by passing ones at a tight cadence must fire
+    the same tick, and resolve once the fast window is clean."""
+    eng = AlertEngine(service_rules())
+    for i in range(8):
+        eng.observe(float(i) * 0.2, "canary_fail", 0.0)
+        eng.tick(float(i) * 0.2)
+    assert eng.state("canary_failure") == "inactive"
+    eng.observe(2.0, "canary_fail", 1.0)
+    out = eng.tick(2.0)
+    assert eng.state("canary_failure") == "firing"
+    assert [tr["to"] for tr in out] == ["firing"]
+    assert eng.firing() == ["canary_failure"]
+    # clean canaries resume; the failure ages out of the 10 s fast
+    # window and the alert resolves
+    for i in range(70):
+        t = 2.5 + i * 0.2
+        eng.observe(t, "canary_fail", 0.0)
+        eng.tick(t)
+    assert eng.state("canary_failure") == "inactive"
+    states = [tr["to"] for tr in eng.transitions
+              if tr["name"] == "canary_failure"]
+    assert states == ["firing", "resolved"]
+
+
+def test_snapshot_shape():
+    eng = AlertEngine(service_rules())
+    snap = eng.snapshot()
+    assert [s["name"] for s in snap] == \
+        ["canary_failure", "shed_rate", "deadline_miss_rate"]
+    for s in snap:
+        assert set(s) >= {"name", "metric", "threshold", "burn",
+                          "state", "since", "fast_burn", "slow_burn",
+                          "fast_window_s", "slow_window_s"}
+        assert s["state"] == "inactive"
+
+
+# ------------------------------------------- metrics exposition race ----
+
+def test_alarm_and_alert_instruments_register_once():
+    reg = MetricsRegistry()
+    c = alarms_total(reg)
+    assert alarms_total(reg) is c
+    g = alert_state_gauge(reg)
+    assert alert_state_gauge(reg) is g
+    c.inc(kind="canary")
+    g.set(2, name="canary_failure")
+    text = metrics_text(reg)
+    assert 'deap_alarms_total{kind="canary"} 1' in text
+    assert 'deap_alert_state{name="canary_failure"} 2' in text
+
+
+def test_metrics_exposition_hammer_vs_concurrent_observes():
+    """Satellite (c): ``samples()`` used to iterate the live child
+    dict while observers insert new label children — a dict-changed-
+    size crash under concurrency. The snapshot is now taken under the
+    registry lock; this hammer pins it (fails with RuntimeError on
+    the unlocked iteration)."""
+    reg = MetricsRegistry()
+    hist = reg.histogram("h", "hammer", labels=("k",),
+                         buckets=(0.1, 1.0, 10.0))
+    ctr = reg.counter("c", "hammer", labels=("k",))
+    gge = reg.gauge("g", "hammer", labels=("k",))
+    stop = threading.Event()
+    errors = []
+
+    def observer():
+        i = 0
+        while not stop.is_set():
+            hist.observe(i % 7, k=f"h{i % 97}")
+            ctr.inc(k=f"c{i % 97}")
+            gge.set(i, k=f"g{i % 97}")
+            i += 1
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                text = metrics_text(reg)
+                assert "# TYPE h histogram" in text
+            except Exception as e:  # pragma: no cover - the bug
+                errors.append(e)
+                return
+
+    threads = ([threading.Thread(target=observer) for _ in range(3)]
+               + [threading.Thread(target=scraper) for _ in range(2)])
+    for th in threads:
+        th.start()
+    import time
+    time.sleep(1.0)
+    stop.set()
+    for th in threads:
+        th.join(timeout=10)
+    assert not errors, errors

@@ -1,0 +1,260 @@
+"""Known-answer canary tenants — the bit-identity contract live.
+
+The acceptance bar of ``deap_tpu/serving/canary.py`` (ISSUE 19): a
+fixed-seed canary rides the REAL front end (auth, WAL, command queue,
+scheduler, wire encode) at a boundary cadence, an idle service
+bootstraps its own first canary from the driver's idle loop, a clean
+run journals ``canary_ok`` rows and ZERO alert transitions, and an
+injected silent wrong answer (``CorruptResult`` — the failure class
+nothing else can see, since the corrupted job still journals success
+and returns HTTP 200) is detected within two segment boundaries:
+``canary_failed`` row, ``canary`` HealthMonitor alarm,
+``deap_alarms_total``/``deap_alert_state`` on ``/metrics``, a firing
+``canary_failure`` alert at ``/v1/alerts`` and ``/healthz`` flipping
+to ``degraded`` (503) with the new detail body."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deap_tpu import ops
+from deap_tpu.core.fitness import FitnessSpec
+from deap_tpu.core.population import init_population
+from deap_tpu.core.toolbox import Toolbox
+from deap_tpu.resilience.faultinject import (CorruptResult, FaultPlan,
+                                             InjectedCorruption,
+                                             corrupt_pytree)
+from deap_tpu.serving.canary import (CANARY_JOURNAL_KINDS,
+                                     CanaryRunner, CanarySpec)
+from deap_tpu.serving.service import (SERVICE_JOURNAL_KINDS,
+                                      EvolutionService)
+from deap_tpu.serving.tenant import Job
+from deap_tpu.telemetry.journal import read_journal
+from deap_tpu.telemetry.metrics import MetricsRegistry
+from deap_tpu.telemetry.probes import HealthMonitor
+
+_TB = Toolbox()
+_TB.register("evaluate", lambda g: g.sum(-1).astype(jnp.float32))
+_TB.register("mate", ops.cx_two_point)
+_TB.register("mutate", ops.mut_flip_bit, indpb=0.1)
+_TB.register("select", ops.sel_tournament, tournsize=3)
+
+
+def _onemax_job(tid, params):
+    seed = int(params.get("seed", 0))
+    pop = init_population(jax.random.key(seed), 16,
+                          ops.bernoulli_genome(12), FitnessSpec((1.0,)))
+    return Job(tenant_id=tid, family="ea_simple", toolbox=_TB,
+               key=jax.random.key(1000 + seed), init=pop,
+               ngen=int(params.get("ngen", 4)),
+               hyper={"cxpb": 0.5, "mutpb": 0.2}, program="onemax")
+
+
+PROBLEMS = {"onemax": _onemax_job}
+SPEC = dict(problem="onemax", params={"seed": 7, "ngen": 4})
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def _wait(pred, timeout=120.0, interval=0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ------------------------------------------------------------ units ----
+
+def test_journal_kinds_registered():
+    # the drift gate over docs/advanced/telemetry.md covers these via
+    # SERVICE_JOURNAL_KINDS — canary kinds must ride it
+    for kind in CANARY_JOURNAL_KINDS:
+        assert kind in SERVICE_JOURNAL_KINDS
+    assert "alert" in SERVICE_JOURNAL_KINDS
+    assert "canary" in HealthMonitor.ALARM_KINDS
+
+
+def test_corrupt_result_fault_targets_tenant_substring():
+    f = CorruptResult(tenant_substr="canary-2", times=1)
+    f.fire("result", tenant_id="canary-1")       # wrong tenant
+    f.fire("submit", tenant_id="canary-2")       # wrong event
+    with pytest.raises(InjectedCorruption):
+        f.fire("result", tenant_id="canary-2")
+    f.fire("result", tenant_id="canary-2")       # budget spent
+
+
+def test_corrupt_pytree_changes_bytes_once():
+    tree = {"a": np.array([np.nan, np.inf, 1.5]),
+            "b": np.arange(4, dtype=np.int8)}
+    out = corrupt_pytree(tree)
+    # exactly one leaf damaged, and damaged even though it leads with
+    # NaN (byte-flip, not arithmetic)
+    assert out["a"].tobytes() != tree["a"].tobytes()
+    assert out["b"].tobytes() == tree["b"].tobytes()
+    assert out["a"].dtype == tree["a"].dtype
+    assert out["a"].shape == tree["a"].shape
+    # nothing corruptible → unchanged
+    empty = {"s": "text", "n": None}
+    assert corrupt_pytree(empty) == empty
+
+
+def test_spec_defaults_and_runner_snapshot():
+    spec = CanarySpec("onemax")
+    assert spec.cadence_boundaries == 20 and spec.max_in_flight == 1
+    assert CanarySpec("x", cadence_boundaries=0).cadence_boundaries == 1
+    r = CanaryRunner(CanarySpec("onemax", expected_digest="abc"))
+    assert r.reference == "abc"
+    snap = r.snapshot()
+    assert snap == {"submitted": 0, "ok": 0, "failed": 0, "shed": 0,
+                    "in_flight": 0, "reference": "abc"}
+
+
+# ------------------------------------------------------- e2e: clean ----
+
+def test_clean_run_idle_bootstrap_zero_alerts(tmp_path):
+    """An idle service (no client traffic at all) primes its own
+    first canary from the driver loop; the canary chain then
+    self-sustains at the boundary cadence; TOFU learns the reference
+    and every later canary matches — zero alert rows, zero failures,
+    /healthz stays ok and carries the new detail body."""
+    reg = MetricsRegistry()
+    with EvolutionService(str(tmp_path), PROBLEMS, port=0,
+                          segment_len=2, metrics=reg,
+                          canary=CanarySpec(**SPEC,
+                                            cadence_boundaries=1)
+                          ) as svc:
+        assert _wait(lambda: svc.canary.ok >= 3), svc.canary.snapshot()
+        assert svc.canary.failed == 0
+        assert svc.canary.reference        # learned trust-on-first-use
+        assert svc.alerts.firing() == []
+
+        code, body = _get(svc.url + "/healthz")
+        assert code == 200 and body["status"] == "ok"
+        # the detail body contract (satellite b) — the old status
+        # string stays, everything else is additive
+        assert set(body) >= {"status", "jobs", "problems", "watchdog",
+                             "warming", "startup_phases",
+                             "seconds_since_boundary", "steps",
+                             "firing_alerts", "canary"}
+        assert body["watchdog"] == {"enabled": False, "budget_s": None,
+                                    "stalled": False}
+        assert body["warming"]["active"] is False
+        assert body["seconds_since_boundary"] is not None
+        assert body["firing_alerts"] == []
+        assert body["canary"]["ok"] >= 3
+        assert body["canary"]["reference"] == svc.canary.reference
+
+        code, body = _get(svc.url + "/v1/alerts")
+        assert code == 200
+        assert body["firing"] == []
+        states = {a["name"]: a["state"] for a in body["alerts"]}
+        assert states["canary_failure"] == "inactive"
+
+    rows = read_journal(str(tmp_path / "journal.jsonl"))
+    oks = [r for r in rows if r.get("kind") == "canary_ok"]
+    assert len(oks) >= 3
+    assert oks[0].get("learned") is True       # auditable TOFU
+    assert all("digest" in r and "request_id" in r for r in oks)
+    assert not [r for r in rows if r.get("kind") == "canary_failed"]
+    # the determinism headline: ZERO alert transitions on a clean run
+    assert not [r for r in rows if r.get("kind") == "alert"]
+
+
+# -------------------------------------------------- e2e: corruption ----
+
+def test_injected_corruption_detected_within_two_boundaries(tmp_path):
+    """CorruptResult on the second canary (the first learns the clean
+    TOFU reference): the full detection chain within two segment
+    boundaries of the corrupted canary completing."""
+    reg = MetricsRegistry()
+    health = HealthMonitor()
+    plan = FaultPlan([CorruptResult(tenant_substr="canary-2")])
+    with EvolutionService(str(tmp_path), PROBLEMS, port=0,
+                          segment_len=2, metrics=reg, health=health,
+                          fault_plan=plan,
+                          canary=CanarySpec(**SPEC,
+                                            cadence_boundaries=1)
+                          ) as svc:
+        assert _wait(lambda: svc.canary.failed >= 1), \
+            svc.canary.snapshot()
+        # later canaries keep passing — corruption was one-shot
+        before = svc.canary.ok
+        assert _wait(lambda: svc.canary.ok >= before + 1)
+
+        # the alarm fired
+        kinds = [a["alarm"] for a in health.alarms]
+        assert "canary" in kinds
+        alarm = next(a for a in health.alarms
+                     if a["alarm"] == "canary")
+        assert alarm["tenant_id"] == "canary-2"
+        assert alarm["reason"] == "digest_mismatch"
+        assert alarm["expected"] != alarm["got"]
+
+        # the alert is firing at /v1/alerts
+        code, body = _get(svc.url + "/v1/alerts")
+        assert code == 200
+        assert "canary_failure" in body["firing"]
+
+        # /healthz degrades (503) but keeps the status-string contract
+        code, body = _get(svc.url + "/healthz")
+        assert code == 503 and body["status"] == "degraded"
+        assert body["firing_alerts"] == ["canary_failure"]
+        assert body["canary"]["failed"] == 1
+
+        # both new metric families are scrapeable
+        with urllib.request.urlopen(svc.url + "/metrics") as r:
+            text = r.read().decode()
+        assert 'deap_alarms_total{kind="canary"} 1' in text
+        assert 'deap_alert_state{name="canary_failure"} 2' in text
+
+    rows = read_journal(str(tmp_path / "journal.jsonl"))
+    fails = [r for r in rows if r.get("kind") == "canary_failed"]
+    assert len(fails) == 1
+    fail = fails[0]
+    assert fail["tenant_id"] == "canary-2"
+    assert fail["reason"] == "digest_mismatch"
+    assert fail["expected"] != fail["got"]
+
+    # detection latency: the firing alert row lands within two
+    # boundary (`slo`) rows of the canary_failed row — the bench's
+    # ≤ 2 boundary gate, pinned structurally
+    idx_fail = rows.index(fail)
+    firing = [i for i, r in enumerate(rows)
+              if r.get("kind") == "alert" and r.get("state") == "firing"
+              and r.get("name") == "canary_failure"]
+    assert firing, "canary_failure never fired in the journal"
+    between = [r for r in rows[idx_fail:firing[0]]
+               if r.get("kind") == "slo"]
+    assert len(between) <= 2, (idx_fail, firing, between)
+
+
+def test_canary_rejected_submission_counts_as_shed(tmp_path):
+    """A front end that refuses the canary (unknown problem → 404) is
+    a shed beat, not a failure — an overloaded or misconfigured
+    service must not page through the bit-identity alarm."""
+    with EvolutionService(str(tmp_path), PROBLEMS, port=0,
+                          segment_len=2,
+                          canary=CanarySpec("no-such-problem",
+                                            cadence_boundaries=1)
+                          ) as svc:
+        assert _wait(lambda: svc.canary.shed >= 1, timeout=30), \
+            svc.canary.snapshot()
+        assert svc.canary.failed == 0
+        assert svc.canary.ok == 0
+    rows = read_journal(str(tmp_path / "journal.jsonl"))
+    assert not [r for r in rows
+                if r.get("kind") in CANARY_JOURNAL_KINDS]

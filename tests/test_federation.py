@@ -1,0 +1,311 @@
+"""Fleet journal federation — merge, rebase, stitch, render.
+
+The acceptance bar of ``deap_tpu/telemetry/federation.py`` (ISSUE
+19): a fleet root of ≥ 3 per-process journal dirs federates into one
+monotonic-rebased timeline (rotated generations oldest-first, torn
+tails and headerless generations tolerated — the kill-9'd member
+still counts), deterministic trace ids stitch one request's spans
+across process boundaries with zero coordination, and ``report.py
+--fleet`` renders the whole observatory in a subprocess that never
+imports jax."""
+
+import json
+import os
+import subprocess
+import sys
+
+from deap_tpu.telemetry import federation, tracing
+from deap_tpu.telemetry.federation import (JOURNAL_NAME,
+                                           cross_process_traces,
+                                           federate, fleet_curve,
+                                           fleet_processes,
+                                           fleet_summary, fleet_trace,
+                                           process_groups,
+                                           process_meta,
+                                           register_process,
+                                           resolve_request_id)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPORT = os.path.join(REPO, "deap_tpu", "telemetry", "report.py")
+
+RID = "req-fleet-1"
+
+
+def _write(path, rows, torn_tail=None):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as fh:
+        for row in rows:
+            fh.write(json.dumps(row) + "\n")
+        if torn_tail is not None:
+            fh.write(torn_tail)  # no newline: a writer died mid-write
+
+
+def _span(t, name, wall_epoch_rid=None, *, trace_id=None, span_id,
+          parent_id, dur=0.1, **extra):
+    row = dict(t=t, kind="trace_span", name=name,
+               trace_id=trace_id or tracing.trace_id_for(RID),
+               span_id=span_id, parent_id=parent_id,
+               dur_s=dur, request_id=RID)
+    row.update(extra)
+    return row
+
+
+def _make_fleet(root):
+    """Three processes:
+
+    - ``router``: one generation, holds the request's root span and a
+      ``job_submitted`` arrival (epoch 1000.0);
+    - ``driver-a``: TWO generations (a kill-9 restart rotated the
+      first) — the pre-kill generation carries early spans of the
+      same trace and ends in a torn tail, the post-restart generation
+      (epoch 1012.0) carries the late spans, an alarm and an alert
+      row;
+    - ``driver-b``: one generation with NO header (lost in a crash)
+      plus an unrelated single-process trace and a shed row.
+    """
+    tid = tracing.trace_id_for(RID)
+    root_sid = tracing.root_span_id(RID)
+
+    p = register_process(root, "router", role="router")
+    _write(p, [
+        dict(kind="header", t=0.0, run_id="r0", wall_start=1000.0),
+        dict(t=0.5, kind="job_submitted", tenant_id="t0",
+             request_id=RID),
+        _span(1.0, "request", span_id=root_sid, parent_id=None,
+              dur=11.0),
+    ])
+
+    p = register_process(root, "driver-a", role="driver")
+    _write(p + ".1", [
+        dict(kind="header", t=0.0, run_id="a0", wall_start=1001.0),
+        _span(1.0, "queue.wait", span_id="aaaa000000000001",
+              parent_id=root_sid, dur=0.4),
+        _span(2.0, "segment", span_id="aaaa000000000002",
+              parent_id=root_sid, dur=0.9),
+    ], torn_tail='{"t": 3.0, "kind": "trace_span", "na')
+    _write(p, [
+        dict(kind="header", t=0.0, run_id="a1", wall_start=1012.0),
+        _span(0.5, "segment", span_id="aaaa000000000003",
+              parent_id=root_sid, dur=0.5),
+        dict(t=0.6, kind="alarm", alarm="driver_stall", stalled_s=3.0),
+        dict(t=0.7, kind="driver_stall", stalled_s=3.0),
+        dict(t=0.9, kind="alert", name="canary_failure",
+             state="firing", prev="inactive", at=0.9),
+        dict(t=1.0, kind="canary_failed", tenant_id="canary-1",
+             request_id="req-c1", expected="aa", got="bb",
+             reason="digest_mismatch"),
+    ])
+
+    p = register_process(root, "driver-b", role="driver")
+    lone = tracing.trace_id_for("req-lonely")
+    _write(p, [
+        # no header row at all: epoch lost with the crash
+        _span(2.0, "wire.encode", span_id="bbbb000000000001",
+              parent_id=root_sid, dur=0.2),
+        dict(t=2.5, kind="load_shed", tenant_id="t9", new=1),
+        _span(3.0, "request", trace_id=lone,
+              span_id=tracing.root_span_id("req-lonely"),
+              parent_id=None, dur=0.1, request_id="req-lonely"),
+    ])
+    return tid
+
+
+# ------------------------------------------------------- fleet root ----
+
+def test_register_process_layout_and_meta(tmp_path):
+    root = str(tmp_path)
+    p = register_process(root, "alpha", role="driver", port=1234)
+    assert p == os.path.join(root, "alpha", JOURNAL_NAME)
+    assert os.path.isdir(os.path.dirname(p))
+    meta = process_meta(root, "alpha")
+    assert meta["process_id"] == "alpha"
+    assert meta["role"] == "driver" and meta["port"] == 1234
+    # a registered-but-never-journaled member is not listed (no
+    # generations); an empty journal file is
+    assert fleet_processes(root) == []
+    open(p, "w").close()
+    assert fleet_processes(root) == ["alpha"]
+    # path-escaping ids are rejected
+    import pytest
+    with pytest.raises(ValueError):
+        register_process(root, "../evil")
+
+
+def test_process_groups_generations_oldest_first(tmp_path):
+    root = str(tmp_path)
+    _make_fleet(root)
+    groups = process_groups(root, "driver-a")
+    assert len(groups) == 2
+    assert groups[0][0]["run_id"] == "a0"   # rotated .1 comes first
+    assert groups[1][0]["run_id"] == "a1"
+    # the pre-kill generation's torn tail is tolerated and reported
+    assert groups[0][1].tear_offset is not None
+    assert groups[1][1].tear_offset is None
+
+
+# ------------------------------------------------------------ merge ----
+
+def test_federate_rebases_and_sorts_one_timeline(tmp_path):
+    root = str(tmp_path)
+    _make_fleet(root)
+    fed = federate(root)
+    assert sorted(fed["processes"]) == ["driver-a", "driver-b",
+                                       "router"]
+    rows = fed["rows"]
+    assert all("process" in r and "wall" in r for r in rows)
+    walls = [r["wall"] for r in rows]
+    assert walls == sorted(walls)            # one monotone timeline
+    # epoch rebase: driver-a's post-restart segment (t=0.5 at epoch
+    # 1012.0) lands AFTER its pre-kill spans (t≈2 at epoch 1001.0)
+    segs = [r for r in rows if r["process"] == "driver-a"
+            and r.get("kind") == "trace_span"
+            and r.get("name") == "segment"]
+    assert [round(s["wall"], 1) for s in segs] == [1003.0, 1012.5]
+    # the headerless member's rows sit at the timeline origin rather
+    # than poisoning the merge
+    b = [r for r in rows if r["process"] == "driver-b"]
+    assert all(r["wall"] == r["t"] for r in b)
+
+
+def test_process_health_columns(tmp_path):
+    root = str(tmp_path)
+    _make_fleet(root)
+    fed = federate(root)
+    a = fed["processes"]["driver-a"]
+    assert a["generations"] == 2
+    assert a["torn_tails"] == 1
+    assert a["missing_headers"] == 0
+    assert a["alarms"] == {"driver_stall": 1}
+    assert a["driver_stalls"] == 1
+    assert a["canary_failed"] == 1 and a["canary_ok"] == 0
+    assert a["firing_alerts"] == ["canary_failure"]
+    assert a["meta"]["role"] == "driver"
+    b = fed["processes"]["driver-b"]
+    assert b["missing_headers"] == 1
+    assert b["load_sheds"] == 1
+    r = fed["processes"]["router"]
+    assert r["rows"] == 3 and r["torn_tails"] == 0
+    assert r["wall_lo"] == 1000.0
+
+
+def test_fleet_curve_windows_merged_rows(tmp_path):
+    root = str(tmp_path)
+    _make_fleet(root)
+    fed = federate(root)
+    curve = fleet_curve(fed["rows"], window_s=5.0)
+    assert curve
+    # the arrival and the shed land in the fleet curve
+    assert sum(w["arrivals"] for w in curve) == 1
+    assert sum(w["sheds"] for w in curve) == 1
+    assert fleet_curve([], window_s=5.0) == []
+
+
+# ----------------------------------------------------------- stitch ----
+
+def test_cross_process_trace_stitch_spans_three_members(tmp_path):
+    root = str(tmp_path)
+    tid = _make_fleet(root)
+    xt = cross_process_traces(root)
+    assert len(xt) == 1                      # the lonely trace is not
+    assert xt[0]["trace_id"] == tid          # cross-process
+    assert xt[0]["processes"] == ["driver-a", "driver-b", "router"]
+    assert xt[0]["spans"] == 5               # the torn 6th span is lost
+    assert xt[0]["request_id"] == RID
+
+    assert resolve_request_id(root, RID) == RID
+    assert resolve_request_id(root, "t0") == RID   # via tenant id
+    assert resolve_request_id(root, "nope") is None
+    assert fleet_trace(root, "nope") is None
+
+    trace = fleet_trace(root, "t0")
+    assert trace["request_id"] == RID
+    assert trace["processes"] == ["driver-a", "driver-b", "router"]
+    names = {s["name"] for s in trace["spans"]}
+    assert {"request", "queue.wait", "segment",
+            "wire.encode"} <= names
+    # every span resolves to the deterministic root: the kill-9
+    # restart and the missing header orphaned nothing
+    assert trace["orphans"] == []
+    assert trace["root"]["span_id"] == tracing.root_span_id(RID)
+    assert not trace["root"].get("synthetic")
+
+
+def test_fleet_summary_is_the_report_payload(tmp_path):
+    root = str(tmp_path)
+    _make_fleet(root)
+    s = fleet_summary(root, window_s=5.0)
+    assert set(s) == {"root", "processes", "rows", "curve",
+                      "cross_traces"}
+    assert len(s["cross_traces"]) == 1
+
+
+def test_empty_root_degrades_gracefully(tmp_path):
+    root = str(tmp_path / "nothing")
+    assert fleet_processes(root) == []
+    assert federate(root)["rows"] == []
+    assert cross_process_traces(root) == []
+    assert fleet_summary(root)["curve"] == []
+
+
+# ----------------------------------------------------------- render ----
+
+def test_render_fleet_no_jax_subprocess(tmp_path):
+    """``report.py --fleet`` in a clean subprocess: the process table,
+    the fleet curve, the gates and the cross-process waterfall all
+    render — and jax never enters sys.modules (federation is part of
+    the laptop/CI triage surface)."""
+    root = str(tmp_path)
+    tid = _make_fleet(root)
+    code = (
+        "import sys, runpy\n"
+        f"sys.argv = ['report.py', '--fleet', {root!r}]\n"
+        f"runpy.run_path({REPORT!r}, run_name='__main__')\n"
+        "assert 'jax' not in sys.modules, 'fleet report imported jax'\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    out = r.stdout
+    assert "# Fleet:" in out
+    assert "3 process(es)" in out
+    for pid in ("router", "driver-a", "driver-b"):
+        assert pid in out
+    assert "▲1 headerless" in out            # driver-b's lost header
+    assert "canary_failure" in out           # firing alert column
+    assert "driver_stall×1" in out           # fleet alarm rollup
+    assert "## Fleet SLO curve" in out
+    assert "## Cross-process traces" in out
+    assert tid in out
+    assert f"request {RID}" in out
+    # the waterfall stitched spans from all three members
+    assert "### Waterfall" in out
+    for name in ("queue.wait", "segment", "wire.encode"):
+        assert name in out
+
+
+def test_render_fleet_empty_root_message(tmp_path):
+    from deap_tpu.telemetry.report import render_fleet
+    msg = render_fleet(str(tmp_path))
+    assert "no registered processes" in msg
+
+
+def test_federation_module_loads_standalone(tmp_path):
+    """The module itself must import without the deap_tpu package
+    (stdlib only) — the same guarantee report.py gives."""
+    fed_py = os.path.join(REPO, "deap_tpu", "telemetry",
+                          "federation.py")
+    code = (
+        "import sys, importlib.util\n"
+        f"spec = importlib.util.spec_from_file_location("
+        f"'fed_standalone', {fed_py!r})\n"
+        "mod = importlib.util.module_from_spec(spec)\n"
+        "sys.modules[spec.name] = mod\n"
+        "spec.loader.exec_module(mod)\n"
+        f"print(sorted(mod.fleet_processes({str(tmp_path)!r})))\n"
+        "assert 'jax' not in sys.modules\n"
+        "assert 'deap_tpu' not in sys.modules\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert r.stdout.strip() == "[]"
