@@ -2315,6 +2315,218 @@ def loadgen_lines(out_path: str = "BENCH_LOADGEN.json") -> list:
     return rows
 
 
+# ------------------------------ zero-downtime operations (ISSUE 20) ----
+
+MIG_N = 6            # tenants in the rolling-upgrade drill
+MIG_NGEN = 30        # long enough that the rollout catches residents
+#                      mid-run (at ngen=12 they finish before the drain)
+#: per-tenant migration pause budget: checkpoint-at-boundary → resumed
+#: on the adopting side. The point of live migration is to be far
+#: cheaper than a kill/restart cycle — bench_report cross-checks this
+#: p99 against BENCH_CHAOS's whole-service recovery wall.
+MIG_PAUSE_BUDGET_S = 30.0
+MIG_LG_N = 10        # arrivals per upgrade-under-load loadgen arm
+MIG_LG_RATE = 6.0    # Poisson arrivals/s
+MIG_LG_NGEN = 24     # arm job length — residents must straddle the roll
+MIG_LG_AT_S = 1.5    # schedule offset at which the rollout fires
+
+
+def migration_lines(out_path: str = "BENCH_MIGRATION.json") -> list:
+    """The zero-downtime acceptance measurement (ISSUE 20), two arms:
+
+    1. **Rolling-upgrade drill** (subprocess pair): an old-version
+       child (known-answer canary on) serves ``MIG_N`` live tenants;
+       a new-version child starts with the checkpoint compat gate
+       open; ``POST /v1/drain?handoff=<new>`` migrates every resident
+       mid-run through fsync'd WAL ownership-transfer records. Gates:
+       zero lost jobs, 100% wire-digest identity vs the uninterrupted
+       reference, canaries green on BOTH sides, at least one journaled
+       ``compat_restore`` (the version skew was real), and migration
+       pause p99 within ``MIG_PAUSE_BUDGET_S``.
+    2. **Upgrade-under-load delta**: the same seeded Poisson schedule
+       driven twice — once against a single service (baseline), once
+       with an :class:`~deap_tpu.serving.UpgradePlan` rolling the
+       fleet mid-schedule. Gates: the upgrade arm completes every
+       arrival, bit-identical to the baseline arm, and at least one
+       arrival observed ``migrated`` and re-offered (the rollout
+       really crossed live traffic); the completion-latency p99 delta
+       is committed ungated as the cost-of-rollout signal."""
+    import dataclasses
+    import shutil
+    import tempfile
+
+    from deap_tpu.serving import (PoissonTraffic, UpgradePlan,
+                                  run_schedule)
+    from deap_tpu.serving import chaos as chaos_mod
+
+    envfp = _env_fingerprint("cpu")
+    work = tempfile.mkdtemp(prefix="deap_migration_bench_")
+    rows = []
+
+    def p99(vals):
+        if not vals:
+            return None
+        s = sorted(vals)
+        return round(s[min(len(s) - 1, int(0.99 * len(s)))], 4)
+
+    # ---- arm 1: rolling-upgrade drill ------------------------------
+    specs = chaos_mod.chaos_specs(MIG_N, ngen=MIG_NGEN)
+    ref = chaos_mod.reference_digests(os.path.join(work, "ref"),
+                                      specs)
+    drill = chaos_mod.run_upgrade_drill(os.path.join(work, "drill"),
+                                        n_tenants=MIG_N,
+                                        ngen=MIG_NGEN)
+    identical = sum(1 for tid, d in drill["digests"].items()
+                    if ref.get(tid) == d)
+    canary_failed = (drill["old_kinds"].get("canary_failed", 0)
+                     + drill["new_kinds"].get("canary_failed", 0))
+    cfg = {"tenants": MIG_N, "ngen": MIG_NGEN}
+    rows += [
+        {"metric": "upgrade_lost_jobs",
+         "value": len(drill["lost"]), "unit": "jobs", "gate": "== 0",
+         "lost": drill["lost"][:20], "old_rc": drill["old_rc"],
+         **cfg, "env": envfp},
+        {"metric": "upgrade_digest_identity_frac",
+         "value": round(identical / MIG_N, 6), "unit": "frac",
+         "gate": "== 1.0", "identical": identical,
+         "compared": len(drill["digests"]), **cfg, "env": envfp},
+        {"metric": "upgrade_canary_failed",
+         "value": canary_failed, "unit": "rows", "gate": "== 0",
+         "canary_ok": (drill["old_kinds"].get("canary_ok", 0)
+                       + drill["new_kinds"].get("canary_ok", 0)),
+         **cfg, "env": envfp},
+        {"metric": "upgrade_compat_restores",
+         "value": drill["new_kinds"].get("compat_restore", 0),
+         "unit": "rows", "gate": ">= 1",
+         "note": "new-version child restoring old-version checkpoint "
+                 "stamps under the explicit compat gate", **cfg,
+         "env": envfp},
+        {"metric": "migration_pause_p99_s",
+         "value": p99(drill["migration_pauses_s"]),
+         "unit": "seconds", "gate": f"<= {MIG_PAUSE_BUDGET_S:.0f}",
+         "pauses_s": drill["migration_pauses_s"][:20],
+         "migrations": len(drill["migration_pauses_s"]),
+         "drain_s": drill["drain_s"],
+         "note": "per-tenant ownership-transfer pause: checkpoint at "
+                 "segment boundary -> transferred on the source "
+                 "(adoption ACKed)", **cfg, "env": envfp},
+    ]
+
+    # ---- arm 2: upgrade-under-load delta ---------------------------
+    base = PoissonTraffic(rate_per_s=MIG_LG_RATE, problem="onemax",
+                          params=dict(pop=16, length=32,
+                                      ngen=MIG_LG_NGEN),
+                          n=MIG_LG_N).schedule(seed=LOADGEN_SEED)
+    # per-arrival seeds: the chaos problem registry requires one, and
+    # distinct jobs make the arm-to-arm digest identity meaningful
+    sched = dataclasses.replace(base, arrivals=tuple(
+        dataclasses.replace(a, params={**a.params, "seed": i})
+        for i, a in enumerate(base.arrivals)))
+
+    def lg_arm(label, *, rolling: bool):
+        """One loadgen pass on a fresh child; with ``rolling`` the
+        UpgradePlan spawns a new-version compat-gated child and drains
+        the old one into it mid-schedule."""
+        root = os.path.join(work, label)
+        os.makedirs(root, exist_ok=True)
+        ready = os.path.join(root, "ready.url")
+        proc = chaos_mod._spawn_child(
+            os.path.join(root, "svc"), chaos_mod._free_port(), ready,
+            telemetry=True,
+            version=("0.1.0+bench-old" if rolling else None))
+        procs = [proc]
+        url = chaos_mod._wait_ready(proc, ready)
+
+        def handoff():
+            ready2 = os.path.join(root, "ready2.url")
+            p2 = chaos_mod._spawn_child(
+                os.path.join(root, "svc2"), chaos_mod._free_port(),
+                ready2, telemetry=True, compat_restore=True,
+                version="0.1.1+bench-new")
+            procs.append(p2)
+            new_url = chaos_mod._wait_ready(p2, ready2)
+            chaos_mod._post_drain(url, handoff=new_url)
+            proc.wait(timeout=300)   # old child exits once drained
+            return new_url
+
+        plan = (UpgradePlan(at_s=MIG_LG_AT_S, handoff=handoff)
+                if rolling else None)
+        try:
+            return run_schedule(sched, url,
+                                max_workers=len(sched.arrivals),
+                                poll_timeout_s=600.0, upgrade=plan)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+                    try:
+                        p.wait(timeout=60)
+                    except Exception:
+                        p.kill()
+
+    t0 = time.perf_counter()
+    base_rep = lg_arm("lg_base", rolling=False)
+    up_rep = lg_arm("lg_up", rolling=True)
+    lg_wall_s = time.perf_counter() - t0
+
+    def latencies(rep):
+        return [r.done_t - r.submit_t for r in rep.results
+                if r.done_t is not None and r.submit_t is not None]
+
+    base_dig, up_dig = base_rep.digests(), up_rep.digests()
+    lg_identical = sum(1 for tid, d in up_dig.items()
+                      if base_dig.get(tid) == d)
+    lg_lost = [a.tenant_id for a in sched.arrivals
+               if a.tenant_id not in up_dig]
+    base_p99, up_p99 = p99(latencies(base_rep)), p99(latencies(up_rep))
+    lcfg = {"arrivals": MIG_LG_N, "rate_per_s": MIG_LG_RATE,
+            "ngen": MIG_LG_NGEN, "upgrade_at_s": MIG_LG_AT_S,
+            "seed": LOADGEN_SEED}
+    rows += [
+        {"metric": "upgrade_loadgen_lost_jobs",
+         "value": len(lg_lost), "unit": "jobs", "gate": "== 0",
+         "lost": lg_lost[:20], "counts": up_rep.counts,
+         **lcfg, "env": envfp},
+        {"metric": "upgrade_loadgen_digest_identity_frac",
+         "value": (round(lg_identical / len(up_dig), 6)
+                   if up_dig else None),
+         "unit": "frac", "gate": "== 1.0",
+         "identical": lg_identical, "compared": len(up_dig),
+         **lcfg, "env": envfp},
+        {"metric": "upgrade_loadgen_migrated_reoffers",
+         "value": up_rep.migrated_reoffers or 0, "unit": "arrivals",
+         "gate": ">= 1",
+         "upgrade_t": up_rep.upgrade_t,
+         "upgrade_ready_t": up_rep.upgrade_ready_t,
+         "note": "arrivals that observed the terminal `migrated` "
+                 "status and re-offered to the new side — proof the "
+                 "rollout crossed live traffic", **lcfg,
+         "env": envfp},
+        {"metric": "upgrade_loadgen_p99_delta_s",
+         "value": (round(up_p99 - base_p99, 4)
+                   if None not in (up_p99, base_p99) else None),
+         "unit": "seconds", "baseline_p99_s": base_p99,
+         "upgrade_p99_s": up_p99, "wall_s": round(lg_wall_s, 3),
+         "note": "completion-latency p99, rolling-upgrade arm minus "
+                 "baseline arm on the identical schedule (ungated: "
+                 "the cost-of-rollout signal)", **lcfg,
+         "env": envfp},
+    ]
+
+    shutil.rmtree(work, ignore_errors=True)
+    if out_path:
+        payload = {
+            "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "env": envfp,
+            "config": {**cfg, "pause_budget_s": MIG_PAUSE_BUDGET_S,
+                       "loadgen": lcfg},
+            "tail": "\n".join(json.dumps(r) for r in rows),
+        }
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=1)
+    return rows
+
+
 # ---------------------------------- resilience overhead (pop=100k) ----
 
 #: headline config length for the paired segmented-vs-monolithic rows
@@ -3598,6 +3810,22 @@ if __name__ == "__main__":
         out = (nxt if nxt and not nxt.startswith("--")
                else "BENCH_LOADGEN.json")
         for row in loadgen_lines(out):
+            print(json.dumps(row), flush=True)
+    elif "--migration" in sys.argv:
+        # the zero-downtime acceptance measurement (ISSUE 20): the
+        # rolling-upgrade drill (old-version child drains ?handoff=
+        # into a compat-gated new-version child — zero lost, 100%
+        # digest identity, canaries green, compat_restore journaled,
+        # pause p99 budget) plus the upgrade-under-load loadgen delta
+        # — committed as BENCH_MIGRATION.json; bench_report.py
+        # --tripwire gates every row and cross-checks the pause p99
+        # against BENCH_CHAOS's whole-service recovery wall
+        jax.config.update("jax_platforms", "cpu")
+        i = sys.argv.index("--migration")
+        nxt = sys.argv[i + 1] if i + 1 < len(sys.argv) else None
+        out = (nxt if nxt and not nxt.startswith("--")
+               else "BENCH_MIGRATION.json")
+        for row in migration_lines(out):
             print(json.dumps(row), flush=True)
     elif "--canary" in sys.argv:
         # the canary/alerting acceptance measurement (ISSUE 19): the

@@ -1078,6 +1078,128 @@ def loadgen_tripwire(budget_s: float = LOADGEN_FIDELITY_BUDGET_S
     return tripped
 
 
+def migration_tripwire() -> int:
+    """The zero-downtime gate (ISSUE 20). The latest
+    BENCH_MIGRATION*.json — a rolling upgrade under live load, the
+    new-version child adopting the old-version child's tenants through
+    fsync'd WAL ownership-transfer records — must show (1) zero lost
+    jobs and (2) 100% wire-digest identity in the drill, (3) canaries
+    green on both sides, (4) the compat gate actually exercised, (5)
+    migration pause p99 within its budget AND under BENCH_CHAOS's
+    whole-service recovery wall (live migration must beat
+    kill/restart, or it has no reason to exist), and (6) the
+    upgrade-under-load arm losing nothing, bit-identical to its
+    baseline, with at least one arrival re-offered across the roll."""
+    files = sorted(glob.glob(os.path.join(HERE,
+                                          "BENCH_MIGRATION*.json")))
+    if not files:
+        print("migration tripwire: no committed BENCH_MIGRATION*.json "
+              "yet")
+        return 0
+    rows = _bench_rows(files[-1])
+    print(f"\n## Zero-downtime operations "
+          f"({os.path.basename(files[-1])})\n")
+    tripped = 0
+
+    lost = rows.get("upgrade_lost_jobs")
+    if lost is None or lost.get("value") != 0:
+        print(f"- **REGRESSION**: {(lost or {}).get('value', '?')} "
+              "job(s) lost across the rolling upgrade (gate: 0) — "
+              "the ownership-transfer chain is leaking work")
+        tripped += 1
+    else:
+        print(f"- upgrade drill: 0 of {lost.get('tenants', '?')} "
+              f"job(s) lost (old child exit rc="
+              f"{lost.get('old_rc', '?')}) ok")
+
+    ident = rows.get("upgrade_digest_identity_frac")
+    if ident is None or ident.get("value") != 1.0:
+        print(f"- **REGRESSION**: drill digest identity "
+              f"{(ident or {}).get('value', '?')} (gate: 1.0) — "
+              "migration is changing numerics")
+        tripped += 1
+    else:
+        print(f"- wire digests: {ident.get('identical', '?')}/"
+              f"{ident.get('compared', '?')} bit-identical through "
+              "the handoff ok")
+
+    can = rows.get("upgrade_canary_failed")
+    if can is None or can.get("value") != 0:
+        print(f"- **REGRESSION**: {(can or {}).get('value', '?')} "
+              "canary_failed row(s) during the roll (gate: 0)")
+        tripped += 1
+    else:
+        print(f"- canaries: 0 failures "
+              f"({can.get('canary_ok', '?')} green run(s)) across "
+              "both versions ok")
+
+    compat = rows.get("upgrade_compat_restores")
+    if compat is None or not compat.get("value"):
+        print("- **REGRESSION**: no compat_restore rows — the drill "
+              "never exercised the version-skew gate, the run proved "
+              "nothing about upgrades")
+        tripped += 1
+    else:
+        print(f"- compat gate: {compat['value']} cross-version "
+              "restore(s) journaled under the explicit gate ok")
+
+    pause = rows.get("migration_pause_p99_s")
+    if pause is None or not isinstance(pause.get("value"),
+                                       (int, float)):
+        print("- migration-pause row missing")
+        tripped += 1
+    else:
+        budget = float(str(pause.get("gate", "<= 30")
+                           ).split("<=")[-1])
+        ok = pause["value"] <= budget
+        # the cross-file teeth: a live migration that pauses a tenant
+        # longer than a whole-service kill/restart recovery is a
+        # regression even inside its static budget
+        chaos_files = sorted(glob.glob(os.path.join(
+            HERE, "BENCH_CHAOS*.json")))
+        rec = None
+        if chaos_files:
+            rec_row = _bench_rows(chaos_files[-1]).get(
+                "chaos_recovery_seconds")
+            if rec_row and isinstance(rec_row.get("value"),
+                                      (int, float)):
+                rec = float(rec_row["value"])
+        ok_rec = rec is None or pause["value"] <= rec
+        print(f"- migration pause p99: {pause['value']}s over "
+              f"{pause.get('migrations', '?')} migration(s) (budget "
+              f"{budget:.0f}s"
+              + (f", kill/restart recovery {rec}s" if rec is not None
+                 else "") + ") "
+              + ("ok" if ok and ok_rec else
+                 "**REGRESSION** ("
+                 + ("pause blew its budget" if not ok else
+                    "pausing longer than a full kill/restart — live "
+                    "migration lost its reason to exist") + ")"))
+        tripped += 0 if (ok and ok_rec) else 1
+
+    lg_lost = rows.get("upgrade_loadgen_lost_jobs")
+    lg_ident = rows.get("upgrade_loadgen_digest_identity_frac")
+    lg_cross = rows.get("upgrade_loadgen_migrated_reoffers")
+    lg_ok = (lg_lost is not None and lg_lost.get("value") == 0
+             and lg_ident is not None and lg_ident.get("value") == 1.0
+             and lg_cross is not None and (lg_cross.get("value") or 0)
+             >= 1)
+    if not lg_ok:
+        print("- **REGRESSION**: upgrade-under-load arm — lost="
+              f"{(lg_lost or {}).get('value', '?')} (gate 0), "
+              f"identity={(lg_ident or {}).get('value', '?')} "
+              "(gate 1.0), migrated re-offers="
+              f"{(lg_cross or {}).get('value', '?')} (gate >= 1)")
+        tripped += 1
+    else:
+        delta = rows.get("upgrade_loadgen_p99_delta_s") or {}
+        print(f"- under load: {lg_ident.get('compared', '?')} "
+              "arrival(s) bit-identical to the no-upgrade arm, "
+              f"{lg_cross['value']} re-offered across the roll, "
+              f"completion p99 delta {delta.get('value', '?')}s ok")
+    return tripped
+
+
 def tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
     """Diff the two most recent committed ``BENCH_r*.json`` files and
     flag regressions; then the gp_symbreg paired rows
@@ -1109,6 +1231,7 @@ def tripwire(threshold: float = TRIPWIRE_THRESHOLD) -> int:
     tripped += tuning_tripwire()
     tripped += loadgen_tripwire()
     tripped += canary_tripwire()
+    tripped += migration_tripwire()
     return tripped
 
 

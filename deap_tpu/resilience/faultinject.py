@@ -58,6 +58,14 @@ written, ``wal_append`` after an admission-WAL record lands):
   wrong-answer failure only the known-answer canary tenants
   (:mod:`deap_tpu.serving.canary`) can catch: the corrupted result's
   wire digest no longer matches the canary's precomputed reference.
+- :class:`KillDuringHandoff` — ``SIGKILL`` the source driver at a
+  chosen seam of the live-migration handshake
+  (:mod:`deap_tpu.serving.migration` fires ``migration`` events at
+  ``after_offer`` / ``before_adopted`` / ``before_transferred``):
+  between offer-fsync and adoption-ACK is the exactly-once protocol's
+  worst window, and the chaos tests pin that the tenant survives on
+  exactly one driver with bit-identical digests no matter which seam
+  the kill lands on.
 """
 
 from __future__ import annotations
@@ -73,9 +81,9 @@ __all__ = ["InjectedCrash", "InjectedTransient", "InjectedDrop",
            "InjectedReject", "InjectedCorruption", "Fault",
            "FaultPlan", "KillAt", "PreemptAt", "CorruptCheckpoint",
            "FailSegments", "DropResponse", "Reject429",
-           "DelaySegment", "KillServiceAt", "TornWAL",
-           "CorruptResult", "nan_inject_evaluate", "corrupt_file",
-           "corrupt_pytree"]
+           "DelaySegment", "KillServiceAt", "KillDuringHandoff",
+           "TornWAL", "CorruptResult", "nan_inject_evaluate",
+           "corrupt_file", "corrupt_pytree"]
 
 
 class InjectedCrash(RuntimeError):
@@ -343,6 +351,42 @@ class KillServiceAt(Fault):
     def fire(self, event: str, **ctx) -> None:
         if event == self.event and not self.fired \
                 and int(ctx.get("step", -1)) >= self.step:
+            self.fired += 1
+            os.kill(os.getpid(), self.signum)
+
+
+class KillDuringHandoff(Fault):
+    """``SIGKILL`` the source process at a chosen **seam of the
+    live-migration handshake** — fired on the ``migration`` event
+    :mod:`deap_tpu.serving.migration` emits with ``seam=`` context:
+
+    - ``after_offer`` — the offer record is fsync'd but the target has
+      heard nothing: the tenant must replay on the SOURCE.
+    - ``before_adopted`` — the target received the checkpoint but its
+      ``adopted`` record is not yet durable: still the source's.
+    - ``before_transferred`` — the target ACKed (its adoption is
+      durable) but the source died before writing ``transferred``: the
+      tenant must resume on the TARGET, and the restarted source must
+      discover that from the target's WAL and retroactively close its
+      open offer.
+
+    Optionally filtered to one tenant (``tenant_substr``). Only
+    meaningful in a chaos-harness child process."""
+
+    def __init__(self, seam: str, tenant_substr: str = "",
+                 signum: int = signal.SIGKILL):
+        super().__init__()
+        if seam not in ("after_offer", "before_adopted",
+                        "before_transferred"):
+            raise ValueError(f"unknown migration seam {seam!r}")
+        self.seam = seam
+        self.tenant_substr = str(tenant_substr)
+        self.signum = signum
+
+    def fire(self, event: str, **ctx) -> None:
+        if event == "migration" and not self.fired \
+                and str(ctx.get("seam")) == self.seam \
+                and self.tenant_substr in str(ctx.get("tenant_id", "")):
             self.fired += 1
             os.kill(os.getpid(), self.signum)
 

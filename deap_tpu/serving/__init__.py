@@ -64,11 +64,17 @@ from deap_tpu.serving.loadgen import (
     Schedule,
     ThunderingHerd,
     TrafficModel,
+    UpgradePlan,
     replay_fidelity,
     run_schedule,
     schedule_from_journal,
 )
-from deap_tpu.serving.wal import AdmissionWAL
+from deap_tpu.serving.wal import AdmissionWAL, scan_wal
+from deap_tpu.serving.migration import (
+    MigrationError,
+    adopt_orphans,
+    migrate_tenant,
+)
 from deap_tpu.support.compilecache import enable_compile_cache
 
 __all__ = [
@@ -92,18 +98,23 @@ __all__ = [
     "IslandJobSpec",
     "IslandMultiRunEngine",
     "Job",
+    "MigrationError",
     "MultiRunEngine",
     "Scheduler",
     "SchedulerBusyError",
     "ServiceClient",
     "ServiceError",
     "Tenant",
+    "UpgradePlan",
+    "adopt_orphans",
     "bucket_key",
     "enable_compile_cache",
+    "migrate_tenant",
     "multirun",
     "pad_pow2",
     "prewarm",
     "replay_fidelity",
     "run_schedule",
+    "scan_wal",
     "schedule_from_journal",
 ]

@@ -28,6 +28,23 @@ Consumed by ``tests/test_service_chaos.py`` (``-m chaos``) and
 ``bench.py --service-chaos`` (``BENCH_CHAOS.json``, gated by
 ``bench_report.py --tripwire``'s ``chaos_tripwire``: zero lost jobs,
 100% digest identity, bounded recovery time).
+
+Zero-downtime operations (ISSUE 20) extend the same rig three ways:
+
+- :func:`run_migration_chaos` — live migration killed (SIGKILL) at an
+  exact ownership-transfer seam (``after_offer`` on the source,
+  ``before_adopted`` on the target, ``before_transferred`` on the
+  source — :class:`~deap_tpu.resilience.faultinject.
+  KillDuringHandoff`); the tenant must survive on exactly one driver
+  with a bit-identical digest;
+- :func:`run_orphan_drill` — a fleet member dies mid-run and a live
+  peer adopts its accepted-not-terminal WAL records through the same
+  transfer machinery (``--fleet-root`` registration +
+  ``--adopt-every`` polling);
+- :func:`run_upgrade_drill` — a rolling version upgrade under live
+  load: old-version child drains with ``?handoff=`` to a new-version
+  child (``DEAP_TPU_VERSION_OVERRIDE`` + ``--compat-restore``), zero
+  lost jobs, all digests bit-identical, canaries green on both sides.
 """
 
 from __future__ import annotations
@@ -42,7 +59,8 @@ import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["chaos_problems", "reference_digests", "run_chaos",
-           "child_main"]
+           "run_migration_chaos", "run_orphan_drill",
+           "run_upgrade_drill", "child_main"]
 
 #: default job shape: tiny pops, enough generations that a mid-run
 #: kill lands with tenants in every state (queued / resident /
@@ -129,6 +147,31 @@ def child_main(argv: Optional[Sequence[str]] = None) -> None:
     p.add_argument("--kill-at", type=int, default=None)
     p.add_argument("--kill-event", default="step",
                    choices=("step", "boundary"))
+    p.add_argument("--kill-seam", default=None,
+                   choices=("after_offer", "before_adopted",
+                            "before_transferred"),
+                   help="SIGKILL self at this ownership-transfer seam"
+                        " (KillDuringHandoff): after_offer/"
+                        "before_transferred fire on a migration "
+                        "SOURCE, before_adopted on a TARGET")
+    p.add_argument("--fleet-root", default=None,
+                   help="federation root (PR 19): register this "
+                        "process (pid + serving root + url) so peers "
+                        "can detect death and adopt orphans")
+    p.add_argument("--process-id", default=None)
+    p.add_argument("--adopt-every", type=float, default=0.0,
+                   help="poll the fleet root every S seconds and "
+                        "adopt dead members' tenants (0 = off)")
+    p.add_argument("--compat-restore", action="store_true",
+                   help="open the checkpoint compat gate: this build "
+                        "may restore checkpoints stamped by a "
+                        "different deap_tpu version (rolling-upgrade "
+                        "target side); each such restore journals "
+                        "compat_restore")
+    p.add_argument("--canary", action="store_true",
+                   help="run a known-answer canary tenant "
+                        "(trust-on-first-use digest) at a short "
+                        "boundary cadence")
     p.add_argument("--segment-len", type=int, default=2)
     p.add_argument("--max-lanes", type=int, default=8)
     p.add_argument("--max-pending", type=int, default=0)
@@ -157,23 +200,58 @@ def child_main(argv: Optional[Sequence[str]] = None) -> None:
         import jax
         jax.config.update("jax_platforms", args.platform)
 
-    from deap_tpu.resilience.faultinject import FaultPlan, KillServiceAt
+    from deap_tpu.resilience.faultinject import (FaultPlan,
+                                                 KillDuringHandoff,
+                                                 KillServiceAt)
+    from deap_tpu.serving.canary import CanarySpec
     from deap_tpu.serving.service import EvolutionService
 
-    plan = None
+    faults = []
     if args.kill_at is not None:
-        plan = FaultPlan([KillServiceAt(args.kill_at,
-                                        event=args.kill_event)])
+        faults.append(KillServiceAt(args.kill_at,
+                                    event=args.kill_event))
+    if args.kill_seam:
+        faults.append(KillDuringHandoff(args.kill_seam))
+    canary = None
+    if args.canary:
+        # fixed-seed known-answer probe, TOFU digest: the first clean
+        # completion pins the expectation, every later completion must
+        # match it bit-for-bit — across restarts AND upgrades, since
+        # the expectation rides the journal
+        canary = CanarySpec("onemax",
+                            {"seed": 990_001, "pop": 16,
+                             "length": 32, "ngen": 6},
+                            cadence_boundaries=8)
     svc = EvolutionService(
         args.root, chaos_problems(), port=args.port,
-        fault_plan=plan,
+        fault_plan=(FaultPlan(faults) if faults else None),
         max_pending=(args.max_pending or None),
         watchdog_s=(args.watchdog_s or None),
         max_lanes=args.max_lanes, segment_len=args.segment_len,
         fair_quantum=None, checkpoint_every=1,
         telemetry=bool(args.telemetry),
+        canary=canary, compat_restore=bool(args.compat_restore),
         metrics=False, trace_sample=args.trace_sample,
         compile_cache=(args.compile_cache or None))
+    if args.fleet_root:
+        from deap_tpu.telemetry.federation import register_process
+        register_process(args.fleet_root, args.process_id,
+                         serving_root=os.path.abspath(args.root),
+                         url=svc.url,
+                         deap_tpu_version=os.environ.get(
+                             "DEAP_TPU_VERSION_OVERRIDE") or None)
+    adopt_stop = threading.Event()
+    adopter = None
+    if args.adopt_every > 0 and args.fleet_root:
+        def adopt_loop():
+            while not adopt_stop.wait(args.adopt_every):
+                try:
+                    svc.adopt_orphans(args.fleet_root,
+                                      process_id=args.process_id)
+                except Exception:
+                    pass   # a racing peer or a torn meta is not fatal
+        adopter = threading.Thread(target=adopt_loop, daemon=True)
+        adopter.start()
     ds = svc.install_signal_handlers()
     tmp = args.ready + ".tmp"
     with open(tmp, "w") as fh:
@@ -183,6 +261,9 @@ def child_main(argv: Optional[Sequence[str]] = None) -> None:
         while not svc.drained:
             time.sleep(0.05)
     finally:
+        adopt_stop.set()
+        if adopter is not None:
+            adopter.join(timeout=5)
         ds.uninstall()
         svc.close()
 
@@ -198,13 +279,21 @@ def _free_port() -> int:
 
 
 def _spawn_child(root: str, port: int, ready: str, *,
-                 kill_at: Optional[int], kill_event: str,
-                 segment_len: int, max_lanes: int,
-                 max_pending: Optional[int],
-                 python: str,
+                 kill_at: Optional[int] = None,
+                 kill_event: str = "step",
+                 segment_len: int = 2, max_lanes: int = 8,
+                 max_pending: Optional[int] = None,
+                 python: str = sys.executable,
                  trace_sample: Optional[float] = None,
                  compile_cache: Optional[str] = None,
-                 telemetry: bool = False
+                 telemetry: bool = False,
+                 kill_seam: Optional[str] = None,
+                 fleet_root: Optional[str] = None,
+                 process_id: Optional[str] = None,
+                 adopt_every: float = 0.0,
+                 compat_restore: bool = False,
+                 canary: bool = False,
+                 version: Optional[str] = None
                  ) -> subprocess.Popen:
     try:
         os.remove(ready)
@@ -217,6 +306,18 @@ def _spawn_child(root: str, port: int, ready: str, *,
            "--max-pending", str(max_pending or 0)]
     if kill_at is not None:
         cmd += ["--kill-at", str(kill_at), "--kill-event", kill_event]
+    if kill_seam:
+        cmd += ["--kill-seam", kill_seam]
+    if fleet_root:
+        cmd += ["--fleet-root", fleet_root]
+    if process_id:
+        cmd += ["--process-id", process_id]
+    if adopt_every:
+        cmd += ["--adopt-every", str(adopt_every)]
+    if compat_restore:
+        cmd += ["--compat-restore"]
+    if canary:
+        cmd += ["--canary"]
     if trace_sample is not None:
         cmd += ["--trace-sample", str(trace_sample)]
     if compile_cache:
@@ -225,6 +326,13 @@ def _spawn_child(root: str, port: int, ready: str, *,
         cmd += ["--telemetry"]
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
+    if version is not None:
+        # the rolling-upgrade drill's version lever: the child's
+        # checkpoint stamps (and compat gate) see this as the build
+        # version — two binaries from one checkout
+        env["DEAP_TPU_VERSION_OVERRIDE"] = version
+    else:
+        env.pop("DEAP_TPU_VERSION_OVERRIDE", None)
     return subprocess.Popen(cmd, env=env,
                             stdout=subprocess.DEVNULL,
                             stderr=subprocess.DEVNULL)
@@ -388,6 +496,402 @@ def run_chaos(root: str, *, n_tenants: int = 8,
                            if recovery_s is not None else None),
             "client_errors": errors[0],
             "wall_s": round(wall_s, 3)}
+
+
+# -------------------------------------- zero-downtime drills (ISSUE 20) ----
+
+def _journal_rows(root: str) -> List[Dict[str, Any]]:
+    """Every journal row under ``root``, across restart generations,
+    oldest first — what the drills assert canary/migration/compat
+    facts against."""
+    from deap_tpu.telemetry.journal import (journal_generations,
+                                            read_journal)
+    rows: List[Dict[str, Any]] = []
+    for gen in journal_generations(os.path.join(root,
+                                                "journal.jsonl")):
+        rows.extend(read_journal(gen))
+    return rows
+
+
+def _kinds(rows: Sequence[Dict[str, Any]]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for r in rows:
+        k = r.get("kind")
+        if k:
+            out[k] = out.get(k, 0) + 1
+    return out
+
+
+def _post_drain(url: str, handoff: Optional[str] = None,
+                timeout: float = 10.0) -> None:
+    import urllib.request
+    path = "/v1/drain"
+    if handoff:
+        import urllib.parse as up
+        path += "?handoff=" + up.quote(handoff, safe="")
+    req = urllib.request.Request(url + path, data=b"", method="POST")
+    with urllib.request.urlopen(req, timeout=timeout):
+        pass
+
+
+def _submit_specs(url: str, specs: Sequence[Tuple[str, dict]]) -> None:
+    from deap_tpu.serving.client import ServiceClient
+    c = ServiceClient(url, timeout=30)
+    try:
+        c.submit_many([{"problem": "onemax", "params": params,
+                        "tenant_id": tid,
+                        "idempotency_key": f"key-{tid}"}
+                       for tid, params in specs])
+    finally:
+        c.close()
+
+
+def _wait_progress(url: str, tids: Sequence[str], min_gen: int,
+                   timeout_s: float = 60.0) -> None:
+    """Block until every tenant's view reports ``gen >= min_gen`` —
+    the drills migrate MID-RUN, never at gen 0."""
+    from deap_tpu.serving.client import ServiceClient
+    c = ServiceClient(url, timeout=10)
+    t0 = time.monotonic()
+    try:
+        while time.monotonic() - t0 < timeout_s:
+            try:
+                got = c.results_many(list(tids), wait=False)
+            except Exception:
+                time.sleep(0.1)
+                continue
+            gens = [int(got.get(t, {}).get("gen") or 0) for t in tids]
+            done = [bool(got.get(t, {}).get("result")) for t in tids]
+            if all(g >= min_gen or d
+                   for g, d in zip(gens, done)):
+                return
+            time.sleep(0.05)
+    finally:
+        c.close()
+
+
+def _converge(owner_of, specs: Sequence[Tuple[str, dict]],
+              timeout_s: float, reoffer: bool = True
+              ) -> Tuple[Dict[str, str], List[str]]:
+    """Poll every tenant's OWNING service until all digests land.
+    ``owner_of(tid) -> url`` is re-evaluated every round, so ownership
+    that moves mid-drill (resolution, adoption) is followed. With
+    ``reoffer`` the client idempotently re-submits tenants their owner
+    no longer has live (the run_chaos client contract)."""
+    from deap_tpu.serving.client import ServiceClient, ServiceError
+    digests: Dict[str, str] = {}
+    clients: Dict[str, ServiceClient] = {}
+    stop_at = time.monotonic() + timeout_s
+
+    def _offer(c, tid, params):
+        try:
+            c.submit_many([{"problem": "onemax", "params": params,
+                            "tenant_id": tid,
+                            "idempotency_key": f"key-{tid}"}])
+        except Exception:
+            pass
+
+    try:
+        while len(digests) < len(specs) \
+                and time.monotonic() < stop_at:
+            for tid, params in specs:
+                if tid in digests:
+                    continue
+                url = owner_of(tid)
+                if url is None:
+                    continue
+                c = clients.get(url)
+                if c is None:
+                    c = clients[url] = ServiceClient(url, timeout=10)
+                try:
+                    got = c.results_many([tid], wait=True, timeout=2)
+                    entry = got.get(tid, {})
+                except ServiceError as e:
+                    # 404: the owner has never heard of the tenant —
+                    # an adoption not yet registered, or a job that
+                    # finished-and-exited on the departed side. The
+                    # client contract is an idempotent re-offer:
+                    # determinism makes a rerun bit-identical, and the
+                    # idempotency key (which rides the ownership
+                    # transfer) maps a raced re-offer onto the
+                    # adopted tenant instead of forking a twin.
+                    if reoffer and e.code == 404:
+                        _offer(c, tid, params)
+                    continue
+                except Exception:
+                    continue
+                res = entry.get("result")
+                if res is not None:
+                    digests[tid] = res["digest"]
+                elif reoffer and entry.get("status") in (
+                        "drained", "migrated"):
+                    _offer(c, tid, params)
+            time.sleep(0.05)
+    finally:
+        for c in clients.values():
+            c.close()
+    lost = sorted(t for t, _ in specs if t not in digests)
+    return digests, lost
+
+
+def run_migration_chaos(root: str, seam: str, *, n_tenants: int = 6,
+                        ngen: Optional[int] = None,
+                        segment_len: int = 2, max_lanes: int = 8,
+                        converge_timeout_s: float = 300.0,
+                        python: str = sys.executable
+                        ) -> Dict[str, Any]:
+    """Kill -9 a live migration at an exact ownership-transfer seam.
+
+    ``after_offer`` / ``before_transferred`` arm the SOURCE child's
+    :class:`KillDuringHandoff`; ``before_adopted`` arms the TARGET's.
+    The parent submits ``n_tenants``, waits for mid-run progress,
+    triggers ``POST /v1/drain?handoff=<target>`` on the source, lets
+    the kill fire, restarts the dead child over its own root, and
+    converges every tenant against whichever driver the commit files
+    say owns it. Returns digests/lost/kill_rc plus the per-side
+    journal-kind counts and the set of tenants the target ended up
+    owning."""
+    from deap_tpu.serving import migration as migration_mod
+
+    os.makedirs(root, exist_ok=True)
+    src_root = os.path.join(root, "src")
+    dst_root = os.path.join(root, "dst")
+    specs = chaos_specs(n_tenants, ngen=ngen)
+    src_port, dst_port = _free_port(), _free_port()
+    src_ready = os.path.join(root, "src.url")
+    dst_ready = os.path.join(root, "dst.url")
+    src_url = f"http://127.0.0.1:{src_port}"
+    dst_url = f"http://127.0.0.1:{dst_port}"
+    kill_side = ("dst" if seam == "before_adopted" else "src")
+
+    procs = {
+        "src": _spawn_child(src_root, src_port, src_ready,
+                            segment_len=segment_len,
+                            max_lanes=max_lanes, python=python,
+                            telemetry=True,
+                            kill_seam=(seam if kill_side == "src"
+                                       else None)),
+        "dst": _spawn_child(dst_root, dst_port, dst_ready,
+                            segment_len=segment_len,
+                            max_lanes=max_lanes, python=python,
+                            telemetry=True,
+                            kill_seam=(seam if kill_side == "dst"
+                                       else None)),
+    }
+    _wait_ready(procs["src"], src_ready)
+    _wait_ready(procs["dst"], dst_ready)
+
+    kill_info: Dict[str, Any] = {"rc": None}
+    stopping = threading.Event()
+
+    def supervise(side: str, proc: subprocess.Popen,
+                  sroot: str, port: int, ready: str):
+        # restart whoever dies — the real deployment's supervisor.
+        # A clean drain exit (rc 0) restarts too: its parked tenants
+        # need a live service to finish on. `stopping` gates the
+        # respawn so the drill's own final SIGTERM isn't "healed".
+        while not stopping.is_set():
+            proc.wait()
+            if stopping.is_set():
+                return
+            if side == kill_side and kill_info["rc"] is None:
+                kill_info["rc"] = proc.returncode
+            proc = _spawn_child(sroot, port, ready,
+                                segment_len=segment_len,
+                                max_lanes=max_lanes, python=python,
+                                telemetry=True)
+            procs[side] = proc
+            _wait_ready(proc, ready)
+
+    sups = [threading.Thread(target=supervise,
+                             args=(side, procs[side], sroot, port,
+                                   ready), daemon=True)
+            for side, sroot, port, ready in (
+                ("src", src_root, src_port, src_ready),
+                ("dst", dst_root, dst_port, dst_ready))]
+    for s in sups:
+        s.start()
+
+    _submit_specs(src_url, specs)
+    _wait_progress(src_url, [t for t, _ in specs], min_gen=2)
+    try:
+        _post_drain(src_url, handoff=dst_url)
+    except Exception:
+        pass   # the source may die mid-response at the seam
+
+    dst_abs = os.path.abspath(dst_root)
+
+    def owner_of(tid: str) -> str:
+        for rec in migration_mod.commits_for(src_root, tid):
+            owner = rec.get("owner_root")
+            if owner and os.path.abspath(owner) == dst_abs:
+                return dst_url
+        return src_url
+
+    t0 = time.monotonic()
+    digests, lost = _converge(owner_of, specs, converge_timeout_s)
+    wall_s = time.monotonic() - t0
+
+    stopping.set()
+    for side in ("src", "dst"):
+        p = procs[side]
+        if p.poll() is None:
+            p.terminate()
+            try:
+                p.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    on_target = sorted(
+        tid for tid, _ in specs
+        if any(os.path.abspath(r.get("owner_root") or "") == dst_abs
+               for r in migration_mod.commits_for(src_root, tid)))
+    return {"digests": digests, "lost": lost,
+            "kill_rc": kill_info["rc"],
+            "adopted_by_target": on_target,
+            "src_kinds": _kinds(_journal_rows(src_root)),
+            "dst_kinds": _kinds(_journal_rows(dst_root)),
+            "src_root": src_root, "dst_root": dst_root,
+            "wall_s": round(wall_s, 3)}
+
+
+def run_orphan_drill(root: str, *, n_tenants: int = 6,
+                     ngen: Optional[int] = None,
+                     kill_at_step: int = 4,
+                     segment_len: int = 2, max_lanes: int = 8,
+                     converge_timeout_s: float = 300.0,
+                     python: str = sys.executable) -> Dict[str, Any]:
+    """A fleet member dies mid-run; a live peer discovers the death
+    through the federation metadata (recorded pid no longer alive)
+    and adopts its accepted-not-terminal tenants. The dead member is
+    NEVER restarted — every tenant must converge on the peer, bit-
+    identical."""
+    os.makedirs(root, exist_ok=True)
+    fleet = os.path.join(root, "fleet")
+    a_root, b_root = os.path.join(root, "a"), os.path.join(root, "b")
+    specs = chaos_specs(n_tenants, ngen=ngen)
+    a_port, b_port = _free_port(), _free_port()
+    a_ready = os.path.join(root, "a.url")
+    b_ready = os.path.join(root, "b.url")
+    a_url = f"http://127.0.0.1:{a_port}"
+    b_url = f"http://127.0.0.1:{b_port}"
+
+    pa = _spawn_child(a_root, a_port, a_ready,
+                      kill_at=kill_at_step,
+                      segment_len=segment_len, max_lanes=max_lanes,
+                      python=python, telemetry=True,
+                      fleet_root=fleet, process_id="member-a")
+    pb = _spawn_child(b_root, b_port, b_ready,
+                      segment_len=segment_len, max_lanes=max_lanes,
+                      python=python, telemetry=True,
+                      fleet_root=fleet, process_id="member-b",
+                      adopt_every=0.5)
+    _wait_ready(pa, a_ready)
+    _wait_ready(pb, b_ready)
+
+    _submit_specs(a_url, specs)
+    pa.wait()   # the deterministic kill
+    kill_rc = pa.returncode
+
+    # ownership follows adoption: a tenant 404s on the peer until its
+    # orphan commit lands, then converges there. No re-offer — the
+    # drill proves ADOPTION recovers the work, not client retry.
+    def owner_of(tid: str) -> str:
+        return b_url
+
+    t_dead = time.monotonic()
+    digests, lost = _converge(owner_of, specs, converge_timeout_s,
+                              reoffer=False)
+    adoption_s = time.monotonic() - t_dead
+
+    if pb.poll() is None:
+        pb.terminate()
+        try:
+            pb.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            pb.kill()
+
+    return {"digests": digests, "lost": lost, "kill_rc": kill_rc,
+            "peer_kinds": _kinds(_journal_rows(b_root)),
+            "a_root": a_root, "b_root": b_root,
+            "fleet_root": fleet,
+            "adoption_s": round(adoption_s, 3)}
+
+
+def run_upgrade_drill(root: str, *, n_tenants: int = 6,
+                      ngen: Optional[int] = None,
+                      old_version: str = "0.0.9+drill",
+                      new_version: str = "0.1.1+drill",
+                      segment_len: int = 2, max_lanes: int = 8,
+                      converge_timeout_s: float = 300.0,
+                      python: str = sys.executable) -> Dict[str, Any]:
+    """Rolling upgrade under live load: an old-version child serves
+    ``n_tenants`` (plus a known-answer canary); a new-version child
+    starts with the compat gate open; ``POST /v1/drain?handoff=`` on
+    the old child migrates every resident mid-run. The pin: zero lost
+    jobs, all wire digests bit-identical to the uninterrupted
+    reference, ``compat_restore`` journaled for the cross-version
+    resumes, canaries green on both sides."""
+    os.makedirs(root, exist_ok=True)
+    fleet = os.path.join(root, "fleet")
+    old_root = os.path.join(root, "old")
+    new_root = os.path.join(root, "new")
+    specs = chaos_specs(n_tenants, ngen=ngen)
+    old_port, new_port = _free_port(), _free_port()
+    old_ready = os.path.join(root, "old.url")
+    new_ready = os.path.join(root, "new.url")
+    old_url = f"http://127.0.0.1:{old_port}"
+    new_url = f"http://127.0.0.1:{new_port}"
+
+    po = _spawn_child(old_root, old_port, old_ready,
+                      segment_len=segment_len, max_lanes=max_lanes,
+                      python=python, telemetry=True, canary=True,
+                      fleet_root=fleet, process_id="member-old",
+                      version=old_version)
+    # the new-version child boots BEFORE load is submitted: a rolling
+    # upgrade drains into a warm replacement, and a cold ~10s jax
+    # import here would let short jobs finish (and exit with the old
+    # child) before the drain ever lands.
+    pn = _spawn_child(new_root, new_port, new_ready,
+                      segment_len=segment_len, max_lanes=max_lanes,
+                      python=python, telemetry=True, canary=True,
+                      fleet_root=fleet, process_id="member-new",
+                      compat_restore=True, version=new_version)
+    _wait_ready(po, old_ready)
+    _wait_ready(pn, new_ready)
+    _submit_specs(old_url, specs)
+    _wait_progress(old_url, [t for t, _ in specs], min_gen=2)
+
+    t_drain = time.monotonic()
+    _post_drain(old_url, handoff=new_url)
+    po.wait()
+    old_rc = po.returncode
+    drain_s = time.monotonic() - t_drain
+
+    def owner_of(tid: str) -> str:
+        return new_url
+
+    digests, lost = _converge(owner_of, specs, converge_timeout_s)
+
+    if pn.poll() is None:
+        pn.terminate()
+        try:
+            pn.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            pn.kill()
+
+    old_rows = _journal_rows(old_root)
+    new_rows = _journal_rows(new_root)
+    pauses = sorted(float(r.get("pause_s") or 0.0)
+                    for r in old_rows
+                    if r.get("kind") == "migration_offer"
+                    and r.get("phase") == "transferred")
+    return {"digests": digests, "lost": lost, "old_rc": old_rc,
+            "drain_s": round(drain_s, 3),
+            "migration_pauses_s": pauses,
+            "old_kinds": _kinds(old_rows),
+            "new_kinds": _kinds(new_rows),
+            "old_root": old_root, "new_root": new_root}
 
 
 if __name__ == "__main__":

@@ -72,8 +72,9 @@ else:
 
 __all__ = ["Arrival", "Schedule", "TrafficModel", "PoissonTraffic",
            "DiurnalTraffic", "ParetoMixTraffic", "ThunderingHerd",
-           "LoadgenReport", "RestartPlan", "run_schedule",
-           "schedule_from_journal", "replay_fidelity"]
+           "LoadgenReport", "RestartPlan", "UpgradePlan",
+           "run_schedule", "schedule_from_journal",
+           "replay_fidelity"]
 
 
 # ------------------------------------------------------- schedule ----
@@ -386,7 +387,8 @@ def replay_fidelity(recorded: Schedule, results:
 #: Job statuses after which polling stops — everything else
 #: ("queued", "running", "evicted", ...) means keep waiting.
 _TERMINAL = frozenset(
-    {"finished", "stopped", "failed", "drained", "deadline_exceeded"})
+    {"finished", "stopped", "failed", "drained", "deadline_exceeded",
+     "migrated"})
 
 
 @dataclass
@@ -426,6 +428,25 @@ class RestartPlan:
 
 
 @dataclass
+class UpgradePlan:
+    """Rolling upgrade mid-schedule (the ISSUE 20 zero-downtime
+    drill): at run offset ``at_s``, :func:`run_schedule` calls
+    ``handoff()`` on a side thread. The callable owns the whole
+    rollout — spawn the new-version service, ``POST
+    /v1/drain?handoff=<new_url>`` on the old one, wait for the old
+    process to exit — and returns the new base URL. Unlike
+    :class:`RestartPlan` there is **no outage**: the old service keeps
+    answering until every resident has been handed off, so a worker
+    only re-offers after its tenant reports the terminal ``migrated``
+    status (digest-less — the result lives on the adopting side), and
+    the re-offer's idempotency key maps onto the adopted tenant
+    because the key rides the ownership-transfer offer."""
+
+    at_s: float
+    handoff: Any  # Callable[[], str] — returns the new base URL
+
+
+@dataclass
 class LoadgenReport:
     """A run's outcome: per-arrival results + tallies."""
 
@@ -442,6 +463,13 @@ class LoadgenReport:
     restart_t: Optional[float] = None
     restart_ready_t: Optional[float] = None
     time_to_first_result_after_restart_s: Optional[float] = None
+    #: upgrade drill (set when run with an :class:`UpgradePlan`): run
+    #: offsets of the rollout start / the old service fully drained
+    #: into the new one, plus how many arrivals observed the
+    #: ``migrated`` status and re-offered to the new side
+    upgrade_t: Optional[float] = None
+    upgrade_ready_t: Optional[float] = None
+    migrated_reoffers: Optional[int] = None
 
     @property
     def counts(self) -> Dict[str, int]:
@@ -462,6 +490,7 @@ def run_schedule(schedule: Schedule, base_url: str,
                  poll_timeout_s: float = 600.0,
                  storm_retry: Optional[RetryPolicy] = None,
                  restart: Optional[RestartPlan] = None,
+                 upgrade: Optional[UpgradePlan] = None,
                  journal=None) -> LoadgenReport:
     """Replay ``schedule`` against a live service, **open-loop**: each
     arrival fires at its scheduled offset (scaled by ``speed``)
@@ -476,6 +505,19 @@ def run_schedule(schedule: Schedule, base_url: str,
     speed = float(speed)
     if speed <= 0:
         raise ValueError("speed must be positive")
+    if restart is not None and upgrade is not None:
+        raise ValueError("restart and upgrade plans are mutually "
+                         "exclusive (one mid-run event per drill)")
+    # both plans share the machinery: a side thread fires the event
+    # at `at_s`, workers park on `plan_ready` and re-offer once
+    # against the URL the callable returns
+    plan_at = (restart.at_s if restart is not None
+               else upgrade.at_s if upgrade is not None else None)
+    plan_call = (restart.restart if restart is not None
+                 else upgrade.handoff if upgrade is not None
+                 else None)
+    reoffer_statuses = (("drained",) if restart is not None
+                        else ("drained", "migrated"))
     arrivals = sorted(schedule.arrivals, key=lambda a: a.t)
     results = {a.tenant_id: ArrivalResult(a.tenant_id, a.t)
                for a in arrivals}
@@ -489,24 +531,25 @@ def run_schedule(schedule: Schedule, base_url: str,
     # its one retry instead of hammering a dead socket
     url_holder = [base_url]
     restart_marks: Dict[str, Optional[float]] = {"t": None, "ready": None}
+    reoffer_count = [0]
     restart_ready = threading.Event()
-    if restart is None:
+    if plan_call is None:
         restart_ready.set()
 
-    def _fire_restart(plan: RestartPlan) -> None:
-        delay = plan.at_s / speed - (time.monotonic() - t_run0)
+    def _fire_plan() -> None:
+        delay = plan_at / speed - (time.monotonic() - t_run0)
         if delay > 0:
             time.sleep(delay)
         restart_marks["t"] = time.monotonic() - t_run0
         try:
-            url_holder[0] = plan.restart() or url_holder[0]
+            url_holder[0] = plan_call() or url_holder[0]
         finally:
             restart_marks["ready"] = time.monotonic() - t_run0
             restart_ready.set()
 
     def _work(a: Arrival) -> None:
         res = results[a.tenant_id]
-        attempts = 2 if restart is not None else 1
+        attempts = 2 if plan_call is not None else 1
         try:
             for attempt in range(attempts):
                 retry = storm_retry if a.storm else None
@@ -541,13 +584,16 @@ def run_schedule(schedule: Schedule, base_url: str,
                         res.digest = r.get("digest")
                         if res.digest is not None:
                             res.done_t = time.monotonic() - t_run0
-                    if res.digest is None and res.status == "drained" \
-                            and restart is not None \
+                    if res.digest is None \
+                            and res.status in reoffer_statuses \
+                            and plan_call is not None \
                             and attempt + 1 < attempts:
-                        # the service checkpointed us and went down —
-                        # that IS the outage, not a final fate: park
-                        # and re-offer to the restarted service below
-                        pass
+                        # the service checkpointed us and went down
+                        # (restart) or handed us to a peer (upgrade's
+                        # ``migrated``) — that is the event, not a
+                        # final fate: park and re-offer below
+                        if res.status == "migrated":
+                            reoffer_count[0] += 1
                     else:
                         return
                 except ClientAbandoned:
@@ -572,10 +618,11 @@ def run_schedule(schedule: Schedule, base_url: str,
             sem.release()
 
     restart_thread: Optional[threading.Thread] = None
-    if restart is not None:
+    if plan_call is not None:
         restart_thread = threading.Thread(
-            target=_fire_restart, args=(restart,), daemon=True,
-            name="loadgen-restart")
+            target=_fire_plan, daemon=True,
+            name=("loadgen-restart" if restart is not None
+                  else "loadgen-upgrade"))
         restart_thread.start()
 
     for a in arrivals:
@@ -597,7 +644,7 @@ def run_schedule(schedule: Schedule, base_url: str,
                            wall_s=round(time.monotonic() - t_run0, 4),
                            results=[results[a.tenant_id]
                                     for a in arrivals])
-    if restart_marks["t"] is not None:
+    if restart is not None and restart_marks["t"] is not None:
         report.restart_t = round(restart_marks["t"], 4)
         if restart_marks["ready"] is not None:
             report.restart_ready_t = round(restart_marks["ready"], 4)
@@ -607,6 +654,11 @@ def run_schedule(schedule: Schedule, base_url: str,
         if after:
             report.time_to_first_result_after_restart_s = round(
                 min(after) - restart_marks["t"], 4)
+    if upgrade is not None and restart_marks["t"] is not None:
+        report.upgrade_t = round(restart_marks["t"], 4)
+        if restart_marks["ready"] is not None:
+            report.upgrade_ready_t = round(restart_marks["ready"], 4)
+        report.migrated_reoffers = reoffer_count[0]
     if journal is not None:
         extra: Dict[str, Any] = {}
         if report.restart_t is not None:
@@ -615,6 +667,11 @@ def run_schedule(schedule: Schedule, base_url: str,
                 restart_ready_t=report.restart_ready_t,
                 time_to_first_result_after_restart_s=(
                     report.time_to_first_result_after_restart_s))
+        if report.upgrade_t is not None:
+            extra.update(
+                upgrade_t=report.upgrade_t,
+                upgrade_ready_t=report.upgrade_ready_t,
+                migrated_reoffers=report.migrated_reoffers)
         journal.event("loadgen_run", model=schedule.model,
                       seed=schedule.seed, speed=speed,
                       n_arrivals=len(arrivals),

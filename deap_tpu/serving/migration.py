@@ -1,0 +1,694 @@
+"""Live tenant migration, WAL ownership transfer, orphan adoption.
+
+Zero-downtime operations (ISSUE 20): a tenant mid-run can move between
+driver processes without losing a generation of work and without ever
+being advanced by two drivers at once. The protocol is exactly-once
+**by construction** — every state change is durable before the next
+step depends on it, and a single atomic arbiter decides contested
+ownership:
+
+1. **Offer** (source, driver thread): the tenant is extracted from the
+   scheduler at a segment boundary (checkpointed at its current gen,
+   removed — :meth:`Scheduler.extract`), then an ``offer`` record is
+   appended + fsync'd to the source WAL *before* anything is handed
+   over (fsync-before-offer). An offered tenant stays ``pending`` in
+   the source log: the offer is an intent, not a transfer.
+2. **Adopt** (target, request thread): the target lands the offered
+   checkpoint bytes in its own tenant directory, appends + fsyncs an
+   ``adopted`` record to *its* WAL (the durable claim), then tries to
+   create the **commit file** ``<source_root>/migrations/
+   <offer_id>.commit`` with ``O_CREAT|O_EXCL``. The commit file is the
+   arbiter: exactly one process can ever create it, so a racing
+   reclaim (or a second adopter replaying the same synthesized orphan
+   offer) loses deterministically — the loser voids its own adopted
+   record with a ``done`` follow-up and walks away.
+3. **Transfer** (source): only after the target ACKs (or the commit
+   file proves the target won) does the source append ``transferred``,
+   which folds the tenant out of its pending set. A crash at ANY seam
+   leaves the tenant recoverable on exactly one side:
+
+   - after offer-fsync, before the POST: no commit file exists, the
+     source replays the tenant locally (and commits the offer to
+     itself to shut the door on a late adopter);
+   - after the target copied the checkpoint, before its adopted fsync:
+     the target has no durable claim — the source reclaims;
+   - after the target's adopted fsync + commit, before the source's
+     ``transferred``: the commit file names the target, so the
+     restarted source appends ``transferred`` retroactively and never
+     resubmits.
+
+**Orphan adoption** reuses the same machinery with a synthesized,
+*deterministic* offer id (``orphan-<tenant>``): peers that discover a
+dead fleet member (PR 19 federation metadata — recorded pid no longer
+alive) each replay its WAL and race for the same commit file; the
+second claimant loses the ``O_EXCL`` create and stands down.
+
+Caveats, by design: commit files and orphan checkpoint pickup assume
+the fleet shares a filesystem (the PR 19 federation-root assumption).
+Liveness detection via pid is advisory — declaring a *live* member
+dead and adopting its tenants is a split brain no file protocol can
+fully fence; the deployment's supervisor owns that guarantee (the
+chaos drill kills members before adoption runs). Live migration does
+NOT need the shared root for the checkpoint itself: the offer carries
+the checkpoint bytes inline (states are small — a population, a few
+counters).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import queue
+import tempfile
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+from deap_tpu.serving.wal import scan_wal
+from deap_tpu.support.checkpoint import checkpoint_meta
+
+__all__ = [
+    "MIGRATIONS_DIR",
+    "MigrationError",
+    "adopt_orphans",
+    "adopt_tenant",
+    "commit_path",
+    "commits_for",
+    "install_checkpoint",
+    "migrate_tenant",
+    "newest_tenant_checkpoint",
+    "read_commit",
+    "resolve_replay",
+    "try_commit",
+]
+
+#: subdirectory of a driver's serving root holding per-offer commit
+#: files — the single-writer arbiters of contested ownership
+MIGRATIONS_DIR = "migrations"
+
+
+class MigrationError(RuntimeError):
+    """A migration step that cannot proceed (unknown tenant, no WAL,
+    terminal tenant, unbuildable offer)."""
+
+
+# ------------------------------------------------------------ commits ----
+
+
+def _migrations_dir(source_root: str) -> str:
+    path = os.path.join(str(source_root), MIGRATIONS_DIR)
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def commit_path(source_root: str, offer_id: str) -> str:
+    return os.path.join(_migrations_dir(source_root),
+                        f"{offer_id}.commit")
+
+
+def try_commit(source_root: str, *, offer_id: str, tenant_id: str,
+               owner_root: str, owner_wal: str,
+               owner: str = "") -> Tuple[bool, Dict[str, Any]]:
+    """Atomically decide the offer: ``O_CREAT|O_EXCL`` on the commit
+    file means exactly one caller ever wins. Returns ``(won,
+    commit_record)`` — on a loss the record is the *winner's* (so the
+    loser can tell "I already own this" idempotent retries from a
+    genuine loss)."""
+    rec = {"offer_id": str(offer_id), "tenant_id": str(tenant_id),
+           "owner_root": os.path.abspath(owner_root),
+           "owner_wal": str(owner_wal), "owner": str(owner)}
+    path = commit_path(source_root, offer_id)
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+    except FileExistsError:
+        return False, (read_commit(source_root, offer_id) or rec)
+    try:
+        os.write(fd, json.dumps(rec, sort_keys=True).encode("utf-8"))
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    return True, rec
+
+
+def read_commit(source_root: str,
+                offer_id: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(commit_path(source_root, offer_id), "rb") as fh:
+            rec = json.loads(fh.read().decode("utf-8"))
+    except (OSError, ValueError, UnicodeDecodeError):
+        return None
+    return rec if isinstance(rec, dict) else None
+
+
+def commits_for(source_root: str,
+                tenant_id: str) -> List[Dict[str, Any]]:
+    """Every commit record in ``source_root`` naming ``tenant_id``.
+    The migrations dir is small (one file per completed arbitration),
+    so reading them all is the simple, correct scan."""
+    mdir = os.path.join(str(source_root), MIGRATIONS_DIR)
+    try:
+        names = sorted(os.listdir(mdir))
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        if not name.endswith(".commit"):
+            continue
+        rec = read_commit(source_root, name[:-len(".commit")])
+        if rec is not None and rec.get("tenant_id") == str(tenant_id):
+            out.append(rec)
+    return out
+
+
+def _foreign_commit(source_root: str, tenant_id: str
+                    ) -> Optional[Dict[str, Any]]:
+    """The commit (if any) that moved ``tenant_id`` OUT of
+    ``source_root``. Self-owned commits are closed reclaims; a tenant
+    leaves a root at most once, so any foreign-owned commit is the
+    transfer."""
+    root = os.path.abspath(source_root)
+    for rec in commits_for(source_root, tenant_id):
+        owner = rec.get("owner_root")
+        if owner and os.path.abspath(owner) != root:
+            return rec
+    return None
+
+
+# -------------------------------------------------------- checkpoints ----
+
+
+def newest_tenant_checkpoint(root: str, tenant_id: str
+                             ) -> Optional[Tuple[int, str]]:
+    """``(step, path)`` of the newest checkpoint file in
+    ``<root>/tenants/<tid>/ckpt`` whose meta verifies AND is stamped
+    with this tenant id — the file a migration hands over. Walks
+    newest-first and skips damage, like ``restore_latest``."""
+    ckpt_dir = os.path.join(str(root), "tenants", str(tenant_id),
+                            "ckpt")
+    try:
+        names = os.listdir(ckpt_dir)
+    except OSError:
+        return None
+    steps = []
+    for name in names:
+        if name.startswith("ckpt_") and name.endswith(".pkl"):
+            try:
+                steps.append(int(name[5:-4]))
+            except ValueError:
+                continue
+    for step in sorted(steps, reverse=True):
+        path = os.path.join(ckpt_dir, f"ckpt_{step:08d}.pkl")
+        try:
+            meta = checkpoint_meta(path)
+        except Exception:
+            continue
+        if meta.get("tenant_id") == str(tenant_id):
+            return step, path
+    return None
+
+
+def install_checkpoint(root: str, tenant_id: str, step: int,
+                       data: bytes) -> str:
+    """Land handed-over checkpoint bytes in this root's tenant
+    directory (tmp + rename, the checkpoint module's atomicity rule)
+    and verify them — a torn hand-off must fail HERE, before any
+    durable adoption record claims the tenant."""
+    ckpt_dir = os.path.join(str(root), "tenants", str(tenant_id),
+                            "ckpt")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    final = os.path.join(ckpt_dir, f"ckpt_{int(step):08d}.pkl")
+    fd, tmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, final)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    meta = checkpoint_meta(final)   # CRC + stamp check; raises on rot
+    if meta.get("tenant_id") != str(tenant_id):
+        raise MigrationError(
+            f"handed-over checkpoint {final} is stamped for "
+            f"{meta.get('tenant_id')!r}, not {tenant_id!r}")
+    return final
+
+
+def copy_checkpoint(source_root: str, target_root: str,
+                    tenant_id: str) -> Optional[str]:
+    """Shared-filesystem checkpoint pickup (the orphan path): copy the
+    source's newest valid tenant-stamped file into the target's tenant
+    dir. Returns the installed path, or ``None`` when the tenant never
+    ran (fresh deterministic re-run on the target)."""
+    found = newest_tenant_checkpoint(source_root, tenant_id)
+    if found is None:
+        return None
+    step, path = found
+    with open(path, "rb") as fh:
+        data = fh.read()
+    return install_checkpoint(target_root, tenant_id, step, data)
+
+
+# ------------------------------------------------------- source side ----
+
+
+def migrate_tenant(service, tenant_id: str, target_url: str,
+                   timeout_s: float = 30.0) -> Dict[str, Any]:
+    """Move one live tenant to the peer at ``target_url``. DRIVER
+    THREAD ONLY (extraction mutates the scheduler); front-end callers
+    go through :meth:`EvolutionService.migrate`, which routes here via
+    the command queue."""
+    sched = service.scheduler
+    wal = service.wal
+    if wal is None:
+        raise MigrationError("live migration requires the admission "
+                             "WAL (service started with wal=False)")
+    tenant = sched.tenants.get(tenant_id)
+    if tenant is None:
+        raise MigrationError(f"unknown tenant {tenant_id!r}")
+    if tenant.done:
+        raise MigrationError(f"tenant {tenant_id!r} is terminal")
+    with service._lock:
+        view = service._views.get(tenant_id)
+    params = getattr(tenant.job, "_wal_params", None)
+    if view is None or params is None:
+        raise MigrationError(
+            f"tenant {tenant_id!r} was not admitted through the "
+            "service (no view/WAL params); only service-admitted "
+            "tenants can migrate")
+    problem = view.problem
+    target = str(target_url).rstrip("/")
+
+    t0 = time.perf_counter()
+    desc = sched.extract(tenant_id)
+    service._migration_seq += 1
+    offer_id = (f"{tenant_id}-g{desc['gen']}-p{os.getpid()}"
+                f"-m{service._migration_seq}")
+    offer_fields = dict(tenant_id=tenant_id, offer_id=offer_id,
+                        target=target, gen=desc["gen"],
+                        problem=problem, params=dict(params),
+                        idempotency_key=view.idempotency_key,
+                        request_id=view.request_id, token=view.token)
+    # fsync-before-offer: the intent is durable before ANY byte leaves
+    # this process — a crash from here on replays the tenant exactly
+    # once, by the resolution rule
+    wal.append("offer", **offer_fields)
+    service._fire_fault("wal_append", path=wal.path,
+                        seq=wal.n_appended)
+    service.journal.event("migration_offer", phase="offered",
+                          tenant_id=tenant_id, offer_id=offer_id,
+                          target=target, gen=desc["gen"])
+    service._fire_fault("migration", seam="after_offer",
+                        tenant_id=tenant_id, offer_id=offer_id)
+
+    payload = dict(offer_fields, source=service.url,
+                   source_root=service.root, source_wal=wal.path,
+                   ngen=desc["ngen"])
+    found = newest_tenant_checkpoint(service.root, tenant_id)
+    if found is not None:
+        step, path = found
+        with open(path, "rb") as fh:
+            payload["checkpoint"] = base64.b64encode(
+                fh.read()).decode("ascii")
+        payload["checkpoint_step"] = step
+
+    out, err = None, None
+    try:
+        req = urllib.request.Request(
+            target + "/v1/migrate",
+            data=json.dumps(payload).encode("utf-8"),
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            out = json.loads(resp.read().decode("utf-8"))
+    except Exception as e:
+        err = f"{type(e).__name__}: {e}"
+
+    if out is not None and out.get("adopted"):
+        return _finish_transfer(service, view, desc, offer_id, target,
+                                t0)
+
+    # refused or unreachable: arbitrate. Winning the commit (or
+    # already owning it) means the target never durably adopted — the
+    # tenant is still ours and resubmits locally, bit-exact from its
+    # checkpoint.
+    won, commit = try_commit(service.root, offer_id=offer_id,
+                             tenant_id=tenant_id,
+                             owner_root=service.root,
+                             owner_wal=wal.path, owner=service.url)
+    mine = os.path.abspath(service.root)
+    if won or os.path.abspath(commit.get("owner_root", "")) == mine:
+        _reclaim(service, view, desc, problem, params)
+        service.journal.event("migration_offer", phase="reclaimed",
+                              tenant_id=tenant_id, offer_id=offer_id,
+                              target=target,
+                              error=err or json.dumps(out))
+        return {"migrated": False, "reclaimed": True,
+                "tenant_id": tenant_id, "offer_id": offer_id,
+                "target": target, "error": err}
+    # the target committed its adoption before we could reclaim (an
+    # ACK lost on the wire) — the transfer stands
+    return _finish_transfer(service, view, desc, offer_id, target, t0,
+                            resolved=True)
+
+
+def _finish_transfer(service, view, desc, offer_id: str, target: str,
+                     t0: float, resolved: bool = False
+                     ) -> Dict[str, Any]:
+    tenant_id = desc["tenant_id"]
+    service._fire_fault("migration", seam="before_transferred",
+                        tenant_id=tenant_id, offer_id=offer_id)
+    service.wal.append("transferred", tenant_id=tenant_id,
+                       offer_id=offer_id, target=target)
+    pause_s = round(time.perf_counter() - t0, 6)
+    service.journal.event("migration_offer", phase="transferred",
+                          tenant_id=tenant_id, offer_id=offer_id,
+                          target=target, gen=desc["gen"],
+                          resolved=resolved, pause_s=pause_s)
+    service._finish_migrated_view(tenant_id, target)
+    return {"migrated": True, "tenant_id": tenant_id,
+            "offer_id": offer_id, "target": target,
+            "resolved": resolved, "pause_s": pause_s}
+
+
+def _reclaim(service, view, desc, problem: str, params: dict) -> None:
+    """Failed offer, arbitration won: the tenant never left. Rebuild
+    its job from the factory and resubmit on this driver — the
+    checkpoint written at extraction resumes it bit-exact."""
+    tenant_id = desc["tenant_id"]
+    job = service.problems[problem](tenant_id, dict(params))
+    job.request_id = view.request_id or None
+    job._wal_params = dict(params)
+    with service._lock:
+        # admission already happened once; a stale admission deadline
+        # must not drop the reclaim
+        view.deadline = None
+    service._apply_submit(job, problem)
+
+
+# ------------------------------------------------------- target side ----
+
+
+def adopt_tenant(service, spec: Dict[str, Any],
+                 orphan: bool = False) -> Tuple[int, Dict[str, Any]]:
+    """The target half: land the checkpoint, durably adopt, win the
+    arbitration, submit. Runs on a request thread (live offers via
+    ``POST /v1/migrate``) or any caller's thread (orphan adoption) —
+    everything here is the thread-safe front-end surface; the
+    scheduler mutation rides the command queue."""
+    wal = service.wal
+    if wal is None:
+        return 503, {"adopted": False,
+                     "error": "adoption requires the admission WAL"}
+    if service.draining:
+        return 503, {"adopted": False, "error": "service is draining"}
+    tid = str(spec.get("tenant_id") or "")
+    offer_id = str(spec.get("offer_id") or "")
+    problem = spec.get("problem")
+    if not tid or not offer_id:
+        return 400, {"adopted": False,
+                     "error": "tenant_id and offer_id required"}
+    with service._lock:
+        if service._adopted_offers.get(offer_id) == tid:
+            # idempotent retry: we already durably adopted this offer
+            # (the source's ACK was lost) — say yes again
+            return 200, {"adopted": True, "tenant_id": tid,
+                         "idempotent": True}
+    factory = service.problems.get(problem)
+    if factory is None:
+        return 404, {"adopted": False,
+                     "error": f"unknown problem {problem!r}"}
+    params = dict(spec.get("params") or {})
+    gen = int(spec.get("gen") or 0)
+    source_root = str(spec.get("source_root") or "")
+
+    # 1. land the checkpoint FIRST — if the bytes are torn, fail
+    # before any durable claim exists
+    has_ckpt = False
+    try:
+        blob = spec.get("checkpoint")
+        step = spec.get("checkpoint_step")
+        if blob is not None and step is not None:
+            install_checkpoint(service.root, tid, int(step),
+                               base64.b64decode(blob))
+            has_ckpt = True
+        elif source_root:
+            has_ckpt = copy_checkpoint(source_root, service.root,
+                                       tid) is not None
+    except Exception as e:
+        return 422, {"adopted": False,
+                     "error": f"checkpoint rejected: "
+                              f"{type(e).__name__}: {e}"}
+
+    # 2. the target-side kill seam: checkpoint landed, adoption not
+    # yet durable — a kill here leaves NO claim, the source reclaims
+    service._fire_fault("migration", seam="before_adopted",
+                        tenant_id=tid, offer_id=offer_id)
+
+    # 3. durable adoption in OUR wal (fsync before any ACK)
+    try:
+        wal.append("adopted", tenant_id=tid, offer_id=offer_id,
+                   source=spec.get("source"), source_root=source_root,
+                   problem=problem, params=params,
+                   idempotency_key=spec.get("idempotency_key"),
+                   request_id=spec.get("request_id"),
+                   token=spec.get("token"), gen=gen)
+    except ValueError:
+        return 503, {"adopted": False, "error": "WAL closed"}
+    service._fire_fault("wal_append", path=wal.path,
+                        seq=wal.n_appended)
+
+    # 4. arbitration: first commit wins — against a reclaiming source
+    # or a peer racing for the same orphan
+    if source_root:
+        won, commit = try_commit(source_root, offer_id=offer_id,
+                                 tenant_id=tid,
+                                 owner_root=service.root,
+                                 owner_wal=wal.path,
+                                 owner=service.url)
+        mine = os.path.abspath(service.root)
+        if not won and \
+                os.path.abspath(commit.get("owner_root", "")) != mine:
+            # lost: void our adopted record so OUR replay never
+            # resubmits a tenant somebody else owns
+            try:
+                wal.append("done", tenant_id=tid,
+                           status="adoption_lost")
+            except ValueError:
+                pass
+            service.journal.event(
+                "orphan_adopted" if orphan else "migration_adopted",
+                tenant_id=tid, offer_id=offer_id, lost=True,
+                winner=commit.get("owner_root"))
+            return 409, {"adopted": False,
+                         "error": "lost adoption race",
+                         "winner": commit.get("owner_root")}
+
+    code, out = _register_adopted(service, tid, problem, params, spec,
+                                  has_ckpt)
+    service.journal.event(
+        "orphan_adopted" if orphan else "migration_adopted",
+        tenant_id=tid, offer_id=offer_id,
+        source=spec.get("source") or source_root or None, gen=gen,
+        has_checkpoint=has_ckpt,
+        request_id=str(spec.get("request_id") or ""))
+    with service._lock:
+        service._adopted_offers[offer_id] = tid
+    return code, out
+
+
+def _register_adopted(service, tid: str, problem: str, params: dict,
+                      spec: Dict[str, Any], has_ckpt: bool
+                      ) -> Tuple[int, Dict[str, Any]]:
+    from deap_tpu.serving.service import _JobView
+    try:
+        with service._build_sem:
+            job = service.problems[problem](tid, dict(params))
+    except Exception as e:
+        # adoption is already durable — the tenant is OURS even though
+        # this build failed; surface it as a failed view (and let a
+        # restart's replay retry the factory)
+        err = f"{type(e).__name__}: {e}"
+        view = _JobView(tid, str(problem),
+                        str(spec.get("token") or ""),
+                        request_id=str(spec.get("request_id") or ""),
+                        idempotency_key=spec.get("idempotency_key"))
+        view.status = "failed"
+        view.error = err
+        view.done.set()
+        with service._lock:
+            service._views.setdefault(tid, view)
+        return 200, {"adopted": True, "tenant_id": tid,
+                     "submitted": False, "error": err}
+    job.request_id = spec.get("request_id") or None
+    job._wal_params = dict(params)
+    view = _JobView(tid, str(problem), str(spec.get("token") or ""),
+                    request_id=str(spec.get("request_id") or ""),
+                    idempotency_key=spec.get("idempotency_key"))
+    view.ngen = int(job.ngen)
+    view.status = "adopted"
+    with service._lock:
+        existing = service._views.get(tid)
+        if existing is not None and not existing.done.is_set():
+            # already live here (a replayed duplicate) — idempotent
+            return 200, {"adopted": True, "tenant_id": tid}
+        service._views[tid] = view
+        if view.idempotency_key:
+            service._idem[str(view.idempotency_key)] = tid
+    try:
+        service._cmds.put(("submit_many", [(job, str(problem))]),
+                          timeout=5.0)
+    except queue.Full:
+        # the adoption is durable; a wedged command queue just defers
+        # the resume to this process's own restart replay
+        pass
+    return 200, {"adopted": True, "tenant_id": tid,
+                 "has_checkpoint": has_ckpt}
+
+
+# ---------------------------------------------------- orphan adoption ----
+
+
+def _member_alive(meta: Dict[str, Any]) -> bool:
+    try:
+        os.kill(int(meta["pid"]), 0)
+    except (OSError, TypeError, ValueError, KeyError):
+        return False
+    return True
+
+
+def adopt_orphans(service, fleet_root: str,
+                  process_id: Optional[str] = None,
+                  skip_prefixes: Tuple[str, ...] = ("canary",)
+                  ) -> List[str]:
+    """Scan the fleet directory (PR 19 federation root) for dead
+    members and adopt their accepted-not-terminal tenants through the
+    same transfer records as live migration. Deterministic offer ids
+    (``orphan-<tenant>``) make racing peers contend for the SAME
+    commit file — the second claimant loses the ``O_EXCL`` create and
+    stands down. Canary tenants are skipped by default: they are
+    known-answer probes of their home process, not user work."""
+    from deap_tpu.telemetry import federation
+    adopted: List[str] = []
+    my_root = os.path.abspath(service.root)
+    try:
+        members = sorted(os.listdir(str(fleet_root)))
+    except OSError:
+        return []
+    for member in members:
+        if not os.path.isdir(os.path.join(str(fleet_root), member)):
+            continue
+        if process_id is not None and member == process_id:
+            continue
+        meta = federation.process_meta(fleet_root, member)
+        if not meta:
+            continue   # never registered (or meta torn): can't locate
+            #            its serving root, nothing to adopt
+        sroot = meta.get("serving_root")
+        if not sroot or os.path.abspath(sroot) == my_root:
+            continue
+        if _member_alive(meta):
+            continue
+        wal_path = os.path.join(sroot, "admission.wal")
+        if not os.path.exists(wal_path):
+            continue
+        state = scan_wal(wal_path)
+        for tid in sorted(state.pending):
+            rec = state.pending[tid]
+            if any(tid.startswith(p) for p in skip_prefixes):
+                continue
+            if rec.get("problem") not in service.problems:
+                continue
+            if _foreign_commit(sroot, tid) is not None:
+                continue   # already adopted by someone (maybe us)
+            found = newest_tenant_checkpoint(sroot, tid)
+            spec = dict(rec)
+            spec.update(tenant_id=tid, offer_id=f"orphan-{tid}",
+                        source=meta.get("url") or member,
+                        source_root=sroot,
+                        gen=found[0] if found else 0)
+            code, out = adopt_tenant(service, spec, orphan=True)
+            if code == 200 and out.get("adopted"):
+                adopted.append(tid)
+    return adopted
+
+
+# -------------------------------------------------- restart resolution ----
+
+
+def resolve_replay(service, state) -> List[str]:
+    """Ownership resolution at WAL replay (source or target restart).
+    Mutates ``state.pending`` in place, removing tenants this process
+    no longer owns, and returns their ids. Runs in ``__init__`` before
+    the HTTP server exists — no live races.
+
+    - a foreign commit for a pending tenant → it was transferred (or
+      orphan-adopted) away; append ``transferred`` so future replays
+      skip the scan, and don't resubmit;
+    - an unresolved outbound ``offer`` with no foreign commit → commit
+      it to ourselves (shutting the door on a late adopter), then
+      replay locally;
+    - our own ``adopted`` record whose commit never landed (we crashed
+      between the adopted fsync and the commit create) → finish the
+      arbitration now: win → keep the tenant, lose → void it.
+    """
+    gone: List[str] = []
+    mine = os.path.abspath(service.root)
+    for tid in sorted(state.pending):
+        rec = state.pending[tid]
+        if rec.get("kind") == "adopted":
+            sroot = rec.get("source_root") or ""
+            oid = str(rec.get("offer_id") or "")
+            if not sroot or not oid:
+                continue
+            won, commit = try_commit(sroot, offer_id=oid,
+                                     tenant_id=tid, owner_root=mine,
+                                     owner_wal=service.wal.path)
+            owner = os.path.abspath(commit.get("owner_root", ""))
+            if not won and owner != mine:
+                try:
+                    service.wal.append("done", tenant_id=tid,
+                                       status="adoption_lost")
+                except ValueError:
+                    pass
+                service.journal.event("migration_offer",
+                                      phase="resolved", owner="peer",
+                                      tenant_id=tid, offer_id=oid)
+                state.pending.pop(tid, None)
+                gone.append(tid)
+            continue
+        foreign = _foreign_commit(service.root, tid)
+        offer = state.offers.get(tid)
+        if foreign is None and offer is not None:
+            won, commit = try_commit(
+                service.root,
+                offer_id=str(offer.get("offer_id")), tenant_id=tid,
+                owner_root=mine, owner_wal=service.wal.path)
+            owner = os.path.abspath(commit.get("owner_root", ""))
+            if not won and owner != mine:
+                foreign = commit
+        if foreign is not None:
+            try:
+                service.wal.append(
+                    "transferred", tenant_id=tid,
+                    offer_id=foreign.get("offer_id"),
+                    target=foreign.get("owner")
+                    or foreign.get("owner_root"))
+            except ValueError:
+                pass
+            service.journal.event(
+                "migration_offer", phase="resolved", owner="target",
+                tenant_id=tid, offer_id=foreign.get("offer_id"),
+                target=foreign.get("owner_root"))
+            state.pending.pop(tid, None)
+            gone.append(tid)
+        elif offer is not None:
+            service.journal.event(
+                "migration_offer", phase="resolved", owner="source",
+                tenant_id=tid, offer_id=offer.get("offer_id"))
+    return gone

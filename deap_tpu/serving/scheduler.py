@@ -1004,6 +1004,52 @@ class Scheduler:
                 }
             return snap
 
+    def extract(self, tenant_id: str) -> Dict[str, Any]:
+        """Checkpoint (when resident) and **remove** one tenant — the
+        live-migration primitive (ISSUE 20). Unlike :meth:`_evict` the
+        tenant is not re-queued: it leaves this scheduler entirely,
+        because ownership is moving to another driver process. Returns
+        a handoff descriptor (``tenant_id`` / ``gen`` / ``ngen`` /
+        ``has_checkpoint`` / ``ckpt_dir``) the migration protocol
+        offers to the target. Driver thread only; raises ``KeyError``
+        for unknown tenants and ``ValueError`` for terminal ones (a
+        finished tenant's result lives in its view — nothing to
+        move)."""
+        with self._exclusive("extract"):
+            t = self.tenants.get(tenant_id)
+            if t is None:
+                raise KeyError(f"unknown tenant {tenant_id!r}")
+            if t.done:
+                raise ValueError(f"tenant {tenant_id!r} is terminal "
+                                 f"({t.status}); nothing to migrate")
+            for b in self.buckets.values():
+                if t in b.residents:
+                    self._checkpoint_traced(b.engine, t,
+                                            "checkpoint.migrate")
+                    t.evict()
+                    b.residents.remove(t)
+                    # residency changed mid-lattice: slots are stale,
+                    # repack at the next boundary (the finished-tenant
+                    # path's rule)
+                    b.batch = None
+                    if self._minst is not None:
+                        self._minst.occupancy.set(
+                            len(b.residents) / b.max_lanes,
+                            bucket=b.label)
+                    break
+                if t in b.queue:
+                    b.queue.remove(t)
+                    if self._minst is not None:
+                        self._minst.queue_depth.set(len(b.queue),
+                                                    bucket=b.label)
+                    break
+            del self.tenants[tenant_id]
+            self._spill.discard(tenant_id)
+            return {"tenant_id": t.id, "gen": int(t.gen),
+                    "ngen": int(t.job.ngen),
+                    "has_checkpoint": bool(t.has_checkpoint),
+                    "ckpt_dir": os.path.join(t.run_dir, "ckpt")}
+
     def checkpoint_all(self) -> List[str]:
         """Checkpoint every resident tenant (tenant-stamped v2/v3
         meta) — the graceful-drain hook: after the in-flight segment

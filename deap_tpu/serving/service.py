@@ -179,7 +179,9 @@ SERVICE_JOURNAL_KINDS = ("service_request", "service_drain",
                          "deadline_exceeded", "load_shed",
                          "driver_stall", "trace_span",
                          "startup_phase", "alert",
-                         "canary_ok", "canary_failed")
+                         "canary_ok", "canary_failed",
+                         "migration_offer", "migration_adopted",
+                         "orphan_adopted", "compat_restore")
 
 #: file the warm-handoff lattice manifest persists to, next to the WAL
 WARM_MANIFEST_NAME = "warm_manifest.json"
@@ -296,9 +298,12 @@ class EvolutionService:
     :param fault_plan: a :class:`~deap_tpu.resilience.faultinject.
         FaultPlan` fired at the service's deterministic seams
         (``step`` / ``boundary`` / ``segment`` / ``http_response`` /
-        ``wal_append``; ``segment`` fires INSIDE the scheduler's
-        segment-latency window, so a ``DelaySegment`` there is
-        attributable to the segment phase) — the chaos-test hook.
+        ``wal_append`` / ``migration``; ``segment`` fires INSIDE the
+        scheduler's segment-latency window, so a ``DelaySegment``
+        there is attributable to the segment phase; ``migration``
+        fires at the ownership-transfer seams ``after_offer`` /
+        ``before_adopted`` / ``before_transferred`` — see
+        ``KillDuringHandoff``) — the chaos-test hook.
     :param step_hook: optional ``hook(step_count)`` run on the driver
         thread after every scheduler step — the deterministic
         fault-injection seam (drain-mid-segment tests, bursty-load
@@ -318,6 +323,12 @@ class EvolutionService:
         submitted through the real front end at a boundary cadence,
         digest-checked against a precomputed (or trust-on-first-use)
         reference. ``None`` (default) = no canaries.
+    :param compat_restore: open the checkpoint compat gate (ISSUE
+        20): this build may restore checkpoints stamped by a
+        DIFFERENT deap_tpu version — the rolling-upgrade adoption
+        path. Every cross-version restore journals a
+        ``compat_restore`` row; with the gate closed (default) such
+        restores raise ``CheckpointFormatError`` loudly.
     :param scheduler_kwargs: forwarded to :class:`Scheduler`
         (``max_lanes``, ``segment_len``, ``fair_quantum``,
         ``metrics``, ``compile_cache``, ``trace_sample`` — the
@@ -342,6 +353,7 @@ class EvolutionService:
                  step_hook: Optional[Callable[[int], None]] = None,
                  alerts=True,
                  canary=None,
+                 compat_restore: bool = False,
                  **scheduler_kwargs):
         self.root = str(root)
         self.problems = dict(problems)
@@ -414,6 +426,18 @@ class EvolutionService:
         self._rid_seq = 0
         self._steps = 0
         self._idem: Dict[str, str] = {}   # idempotency key -> tenant
+        # ---- zero-downtime operations (ISSUE 20): live migration
+        # sequencing, durable-adoption index (offer_id -> tenant, for
+        # idempotent re-offers), and the drain?handoff peer target
+        self._migration_seq = 0
+        self._adopted_offers: Dict[str, str] = {}
+        self._handoff_peer: Optional[str] = None
+        if compat_restore:
+            # rolling upgrade: this (newer) build may restore
+            # checkpoints stamped by a different deap_tpu version —
+            # every such restore is journaled as ``compat_restore``
+            from deap_tpu.support.checkpoint import set_compat_restore
+            set_compat_restore(True)
         self._touched: set = set()        # tenant ids polled since
         #                                   the driver's last drain of
         #                                   the interaction set
@@ -513,6 +537,16 @@ class EvolutionService:
         idempotency key — the key map is complete before the first
         request lands."""
         state = self.wal.replay()
+        # ownership resolution (ISSUE 20): pending tenants may have
+        # been migrated/adopted away while we were down — the commit
+        # files and peer WALs decide, and resolved tenants leave
+        # state.pending before any job is built
+        from deap_tpu.serving import migration as _migration
+        transferred_away = _migration.resolve_replay(self, state)
+        for oid, rec in state.adoptions.items():
+            tid = str(rec.get("tenant_id") or "")
+            if tid in state.pending:
+                self._adopted_offers[oid] = tid
         self._idem.update(state.idempotency)
         replayed, failed = [], []
         batch: List[Tuple[Job, str]] = []
@@ -567,6 +601,7 @@ class EvolutionService:
             self.journal.event(
                 "wal_replay", records=len(state.records),
                 replayed=sorted(replayed), failed=sorted(failed),
+                transferred=sorted(transferred_away),
                 idempotency_keys=len(state.idempotency),
                 torn_tail=state.tear_offset is not None)
 
@@ -803,6 +838,75 @@ class EvolutionService:
     def drained(self) -> bool:
         return self._drained.is_set()
 
+    # ------------------------------------- zero-downtime operations ----
+
+    def migrate(self, tenant_id: str, target_url: str,
+                timeout_s: float = 30.0,
+                wait_s: float = 120.0) -> Dict[str, Any]:
+        """Live-migrate one tenant to the peer service at
+        ``target_url``. Callable from any thread: the migration
+        itself runs on the driver (extraction is a scheduler
+        mutation), this call waits for its reply. Returns the
+        migration result dict (``{"migrated": True, ...}`` /
+        ``{"reclaimed": True, ...}``)."""
+        reply: "queue.Queue" = queue.Queue()
+        self._cmds.put(("migrate", str(tenant_id), str(target_url),
+                        float(timeout_s), reply))
+        try:
+            return reply.get(timeout=wait_s)
+        except queue.Empty:
+            raise TimeoutError(
+                f"migration of {tenant_id!r} did not complete within "
+                f"{wait_s}s")
+
+    def adopt_orphans(self, fleet_root: str,
+                      process_id: Optional[str] = None) -> List[str]:
+        """Adopt accepted-not-terminal tenants of DEAD fleet members
+        (PR 19 federation root) onto this service; returns the
+        adopted tenant ids. See
+        :func:`deap_tpu.serving.migration.adopt_orphans`."""
+        from deap_tpu.serving import migration as _migration
+        return _migration.adopt_orphans(self, fleet_root,
+                                        process_id=process_id)
+
+    def _finish_migrated_view(self, tenant_id: str,
+                              target: str) -> None:
+        """Terminal bookkeeping for a transferred tenant: its view
+        goes ``migrated`` (the re-offer signal for clients — like
+        ``drained``, but naming a live new home) and its stream
+        ends."""
+        with self._lock:
+            view = self._views.get(tenant_id)
+        if view is None:
+            return
+        view.status = "migrated"
+        view.error = None
+        self._publish(tenant_id, {"event": "migrated",
+                                  "tenant_id": tenant_id,
+                                  "gen": view.gen, "target": target})
+        self._publish(tenant_id, None)
+        view.done.set()
+
+    def _migration_candidates(self) -> List[str]:
+        """Tenants eligible for a drain hand-off: live, service-
+        admitted, not a canary (canaries are known-answer probes of
+        THIS process — they die with it)."""
+        skip: Tuple[str, ...] = ()
+        if self.canary is not None:
+            skip = (self.canary.spec.tenant_prefix,)
+        out = []
+        for tid, t in self.scheduler.tenants.items():
+            if t.done:
+                continue
+            if any(tid.startswith(p) for p in skip):
+                continue
+            with self._lock:
+                v = self._views.get(tid)
+            if v is None or v.done.is_set():
+                continue
+            out.append(tid)
+        return sorted(out)
+
     def install_signal_handlers(self):
         """Install a SIGTERM/SIGINT → :meth:`drain` handler (main
         thread only); returns the :class:`~deap_tpu.resilience.drain.
@@ -873,6 +977,28 @@ class EvolutionService:
                     self.canary.prime(self)
             # ------------------------------------------- graceful drain
             self._pump_commands(block=False)
+            # drain?handoff=<peer>: migrate residents to the peer
+            # instead of parking them — a rolling upgrade's zero-
+            # downtime path. Failures fall back to the park-and-
+            # checkpoint drain below (migrate_tenant reclaims on any
+            # refused/unreachable offer, so a failed candidate is
+            # back in the scheduler for checkpoint_all)
+            migrated: List[str] = []
+            peer = self._handoff_peer
+            if peer:
+                from deap_tpu.serving import migration as _migration
+                for tid in self._migration_candidates():
+                    try:
+                        res = _migration.migrate_tenant(self, tid,
+                                                        peer)
+                    except Exception as e:
+                        self.journal.event(
+                            "migration_offer", phase="error",
+                            tenant_id=tid, target=peer,
+                            error=f"{type(e).__name__}: {e}")
+                        continue
+                    if res.get("migrated"):
+                        migrated.append(tid)
             saved = sched.checkpoint_all()
             open_views = []
             with self._lock:
@@ -884,6 +1010,7 @@ class EvolutionService:
                 "service_drain",
                 checkpointed=sorted(saved),
                 open_tenants=sorted(v.tenant_id for v in open_views),
+                migrated=sorted(migrated),
                 steps=self._steps)
             for v in open_views:
                 self._publish(v.tenant_id,
@@ -937,6 +1064,19 @@ class EvolutionService:
             t_enq = cmd[2] if len(cmd) > 2 else None
             for job, problem in cmd[1]:
                 self._apply_submit(job, problem, t_enq=t_enq)
+        elif cmd[0] == "migrate":
+            _, tid, target, timeout_s, reply = cmd
+            from deap_tpu.serving import migration as _migration
+            try:
+                res = _migration.migrate_tenant(self, tid, target,
+                                                timeout_s=timeout_s)
+            except Exception as e:
+                res = {"migrated": False,
+                       "error": f"{type(e).__name__}: {e}"}
+                self.journal.event("migration_offer", phase="error",
+                                   tenant_id=tid, target=target,
+                                   error=res["error"])
+            reply.put(res)
 
     def _apply_submit(self, job: Job, problem: str,
                       t_enq: Optional[float] = None) -> None:
@@ -1602,6 +1742,25 @@ class EvolutionService:
                                    if eng is not None else 0)}
             return 200, "application/json", \
                 json.dumps(out).encode(), False
+        if route == "/v1/migrate" and method == "POST":
+            # peer-to-peer adoption endpoint (ISSUE 20): a source
+            # driver offers one tenant (spec + inline checkpoint
+            # bytes); the reply is the adoption ACK. Unauthenticated
+            # like /healthz — peer identity is deployment plumbing
+            # (loopback/LAN trust), not tenant data: the adopted
+            # job's own token rides in the offer and gates all
+            # subsequent client access exactly as it did on the
+            # source.
+            from deap_tpu.serving import migration as _migration
+            spec = json.loads(body or b"{}")
+            self.journal.event(
+                "service_request", route="migrate",
+                request_id=request_id,
+                tenant_id=str(spec.get("tenant_id") or ""),
+                offer_id=str(spec.get("offer_id") or ""))
+            code, out = _migration.adopt_tenant(self, spec)
+            return code, "application/json", \
+                json.dumps(out).encode(), False
         token, info = self._auth(headers)
         if route == "/v1/jobs" and method == "POST":
             payload = json.loads(body or b"{}")
@@ -1618,10 +1777,18 @@ class EvolutionService:
             return code, "application/json", \
                 json.dumps(out).encode(), False
         if route == "/v1/drain" and method == "POST":
+            # ?handoff=<peer-url>: migrate residents to the peer
+            # instead of parking them (rolling upgrade, ISSUE 20)
+            peer = qs.get("handoff", [None])[0]
+            if peer:
+                self._handoff_peer = str(peer)
             self.journal.event("service_request", route="drain",
-                               request_id=request_id)
+                               request_id=request_id,
+                               handoff=peer or None)
             self.drain(wait=False)
-            return 200, "application/json", b'{"draining": true}', False
+            out = {"draining": True, "handoff": peer or None}
+            return 200, "application/json", \
+                json.dumps(out).encode(), False
         if route == "/v1/results" and method == "GET":
             # batch result fetch: one request, N tenants — the
             # long-poll deadline is shared across the batch

@@ -29,6 +29,26 @@ Record kinds (free-form dicts; the service writes these):
 - ``done`` — tenant_id, status: the job reached a terminal state
   (finished / stopped / failed / deadline_exceeded) — replay skips it.
 
+Ownership-transfer records (ISSUE 20 — live migration): moving a
+tenant between driver processes is a two-WAL handshake in which the
+tenant is, at every instant, owned by exactly one log:
+
+- ``offer`` (source WAL) — tenant_id, offer_id, target, gen + the
+  original accept fields: fsync'd *before* the checkpoint is handed to
+  the target. An offered tenant stays ``pending`` on the source — an
+  offer is an intent, not a transfer — so a crash mid-handoff replays
+  it on the source unless the target's durable adoption says otherwise
+  (the resolution rule lives in ``serving/migration.py``).
+- ``adopted`` (TARGET's own WAL) — same fields plus ``source``: folds
+  exactly like an ``accept`` (the adopted tenant joins the target's
+  pending set, its idempotency key maps on the target), and is indexed
+  by ``offer_id`` in ``WALState.adoptions`` — the durable fact the
+  source checks to decide who won.
+- ``transferred`` (source WAL) — tenant_id, offer_id, target: the
+  source's commit record, written only after the target ACKed. Folds
+  as a terminal: the tenant leaves the source's pending set and its
+  open offer closes.
+
 :meth:`replay` folds the log into ``WALState``: the records, the
 surviving ``pending`` jobs (accepted, not done — resubmitted by a
 restarted :class:`~deap_tpu.serving.service.EvolutionService`, where
@@ -46,7 +66,7 @@ import threading
 import zlib
 from typing import Any, Dict, List, Optional
 
-__all__ = ["AdmissionWAL", "WALState"]
+__all__ = ["AdmissionWAL", "WALState", "scan_wal"]
 
 
 class WALState:
@@ -61,6 +81,14 @@ class WALState:
         #: idempotency key -> tenant_id for every accepted job (done
         #: or not: a retry of a finished job must still map to it)
         self.idempotency: Dict[str, str] = {}
+        #: tenant_id -> its newest UNRESOLVED ``offer`` record (no
+        #: ``transferred`` follow-up): the migrations a restarted
+        #: source must resolve against the target's WAL
+        self.offers: Dict[str, Dict[str, Any]] = {}
+        #: offer_id -> the ``adopted`` record THIS log holds — the
+        #: durable proof of adoption a source (or racing peer)
+        #: resolves ownership against
+        self.adoptions: Dict[str, Dict[str, Any]] = {}
         #: byte offset of a torn tail record (None = clean log)
         self.tear_offset: Optional[int] = None
 
@@ -149,30 +177,7 @@ class AdmissionWAL:
     # ------------------------------------------------------------- read ----
 
     def _scan(self) -> WALState:
-        state = WALState()
-        try:
-            with open(self.path, "rb") as fh:
-                data = fh.read()
-        except FileNotFoundError:
-            return state
-        offset = 0
-        for raw in data.split(b"\n"):
-            terminated = offset + len(raw) < len(data)
-            line = raw.strip()
-            if line:
-                rec = self._parse(line)
-                if rec is None:
-                    # CRC/parse failure: mid-file damage is skipped
-                    # (same policy as read_journal); an unterminated
-                    # final line is the torn tail — by the
-                    # fsync-before-ACK contract it was never ACKed,
-                    # so dropping it loses nothing
-                    if not terminated:
-                        state.tear_offset = offset
-                else:
-                    self._fold(state, rec)
-            offset += len(raw) + 1
-        return state
+        return scan_wal(self.path)
 
     @staticmethod
     def _parse(line: bytes) -> Optional[Dict[str, Any]]:
@@ -199,8 +204,59 @@ class AdmissionWAL:
                 state.idempotency.setdefault(str(key), str(tid))
         elif kind == "done" and tid is not None:
             state.pending.pop(str(tid), None)
+        elif kind == "offer" and tid is not None:
+            # intent only: the tenant STAYS pending here — ownership
+            # moves when `transferred` lands (or, after a crash, when
+            # the resolution rule finds the target's durable adoption)
+            state.offers[str(tid)] = rec
+        elif kind == "adopted" and tid is not None:
+            # the target's side: folds like an accept (this log now
+            # owns the tenant) and is indexed by offer id as the
+            # durable adoption proof
+            state.pending.setdefault(str(tid), rec)
+            key = rec.get("idempotency_key")
+            if key:
+                state.idempotency.setdefault(str(key), str(tid))
+            oid = rec.get("offer_id")
+            if oid:
+                state.adoptions[str(oid)] = rec
+        elif kind == "transferred" and tid is not None:
+            state.pending.pop(str(tid), None)
+            state.offers.pop(str(tid), None)
 
     def replay(self) -> WALState:
         """The fold of the log as it stood at open time (the
         constructor already healed any torn tail)."""
         return self._state
+
+
+def scan_wal(path: str) -> WALState:
+    """Read-only fold of a WAL file — **no healing**. The migration
+    resolution rule reads a *peer's* log with this (is the adoption
+    durable over there?); truncating another process's possibly-live
+    torn tail would be a corruption, so only the owning
+    :class:`AdmissionWAL` constructor ever heals."""
+    state = WALState()
+    try:
+        with open(path, "rb") as fh:
+            data = fh.read()
+    except (FileNotFoundError, OSError):
+        return state
+    offset = 0
+    for raw in data.split(b"\n"):
+        terminated = offset + len(raw) < len(data)
+        line = raw.strip()
+        if line:
+            rec = AdmissionWAL._parse(line)
+            if rec is None:
+                # CRC/parse failure: mid-file damage is skipped
+                # (same policy as read_journal); an unterminated
+                # final line is the torn tail — by the
+                # fsync-before-ACK contract it was never ACKed,
+                # so dropping it loses nothing
+                if not terminated:
+                    state.tear_offset = offset
+            else:
+                AdmissionWAL._fold(state, rec)
+        offset += len(raw) + 1
+    return state

@@ -23,10 +23,13 @@ from deap_tpu.support.profiling import (
 from deap_tpu.support.checkpoint import (
     AsyncCheckpointWriter,
     CheckpointCorruptError,
+    CheckpointFormatError,
     Checkpointer,
+    allow_compat_restore,
     checkpoint_meta,
     restore_state,
     save_state,
+    set_compat_restore,
     verify_checkpoint,
 )
 from deap_tpu.support import compilecache
@@ -58,10 +61,13 @@ __all__ = [
     "pair_parents",
     "AsyncCheckpointWriter",
     "CheckpointCorruptError",
+    "CheckpointFormatError",
     "Checkpointer",
+    "allow_compat_restore",
     "checkpoint_meta",
     "compilecache",
     "save_state",
     "restore_state",
+    "set_compat_restore",
     "verify_checkpoint",
 ]

@@ -27,6 +27,7 @@ checkpointer behind the same :class:`Checkpointer` interface.)
 
 from __future__ import annotations
 
+import contextlib
 import os
 import pickle
 import re
@@ -84,6 +85,56 @@ class CheckpointCorruptError(RuntimeError):
         super().__init__(f"corrupt checkpoint {path}: {detail}")
         self.path = path
         self.detail = detail
+
+
+class CheckpointFormatError(CheckpointCorruptError):
+    """The checkpoint's bytes are intact but this code must not
+    restore them: the file carries a NEWER format than this build
+    understands, or it was written by a different ``deap_tpu`` version
+    and the compat gate (:func:`allow_compat_restore`) is closed. The
+    named refusal the rolling-upgrade path relies on — before it, a
+    foreign-format file died as an arbitrary unpickle error."""
+
+
+#: process-wide checkpoint compat gate: closed (default) → restoring a
+#: file stamped by a different ``deap_tpu`` version raises
+#: :class:`CheckpointFormatError`; open → the restore proceeds and
+#: journals a ``compat_restore`` row. The rolling-upgrade drill opens
+#: it on the NEW-version process so it can adopt the old version's
+#: tenants — an explicit operator decision, never a silent default.
+_COMPAT_ALLOW = [False]
+
+
+def _code_version() -> str:
+    """The running code's version stamp (``deap_tpu.__version__``,
+    overridable via ``DEAP_TPU_VERSION_OVERRIDE`` — the chaos drill's
+    hook for running two "versions" from one checkout)."""
+    env = os.environ.get("DEAP_TPU_VERSION_OVERRIDE")
+    if env:
+        return env
+    from deap_tpu import __version__
+    return __version__
+
+
+def set_compat_restore(allow: bool) -> bool:
+    """Open/close the process-wide compat gate; returns the previous
+    state. A service doing a rolling upgrade sets this once at startup
+    (``EvolutionService(compat_restore=True)``)."""
+    prev = _COMPAT_ALLOW[0]
+    _COMPAT_ALLOW[0] = bool(allow)
+    return prev
+
+
+@contextlib.contextmanager
+def allow_compat_restore():
+    """Scoped form of :func:`set_compat_restore` — restores made
+    inside the ``with`` block may cross ``deap_tpu`` versions (each
+    journals ``compat_restore``); the gate snaps back on exit."""
+    prev = set_compat_restore(True)
+    try:
+        yield
+    finally:
+        set_compat_restore(prev)
 
 
 def _key_impl_name(key: jax.Array) -> str:
@@ -231,13 +282,19 @@ def save_state(path: str, state: Any, meta: Optional[Dict[str, Any]] = None,
     blobs = [pickle.dumps(_pack_leaf(l), protocol=pickle.HIGHEST_PROTOCOL)
              for l in leaves]
     treedef_blob = pickle.dumps(treedef, protocol=pickle.HIGHEST_PROTOCOL)
+    # provenance stamp (rolling-upgrade compat gate): which code wrote
+    # this file, in which layout — setdefault, so a caller migrating
+    # a foreign checkpoint may preserve the original stamps
+    stamped = dict(meta or {})
+    stamped.setdefault("deap_tpu_version", _code_version())
+    stamped.setdefault("checkpoint_format", FORMAT_VERSION)
     payload = {
         "format_version": FORMAT_VERSION,
         "treedef": treedef_blob,
         "treedef_crc": zlib.crc32(treedef_blob),
         "leaves": blobs,
         "crcs": [zlib.crc32(b) for b in blobs],
-        "meta": dict(meta or {}),
+        "meta": stamped,
     }
     buf = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
     tmp = path + ".tmp"
@@ -287,6 +344,14 @@ def _verify_payload(path: str, payload: Any) -> None:
         if "leaves" not in payload or "treedef" not in payload:
             raise CheckpointCorruptError(path, "not a checkpoint payload")
         return
+    if int(version) > FORMAT_VERSION:
+        # a FUTURE layout: this build cannot know what the fields mean,
+        # so refuse by name instead of failing on an arbitrary unpickle
+        # (the old-code-meets-new-file half of a rolling upgrade)
+        raise CheckpointFormatError(
+            path, f"format_version {version} is newer than this "
+                  f"build's {FORMAT_VERSION}; upgrade deap_tpu to "
+                  "restore it")
     for k in ("treedef", "treedef_crc", "leaves", "crcs"):
         if k not in payload:
             raise CheckpointCorruptError(path, f"missing field {k!r}")
@@ -344,6 +409,26 @@ def _materialize(path: str, payload: Any) -> Any:
     Leaf order is preserved (``pool.map``), so the reassembled pytree
     — and therefore the resumed run — is bit-identical to the serial
     path."""
+    # code-version gate (single choke point: restore_state AND
+    # Checkpointer.restore_latest both materialise through here;
+    # verify_checkpoint/checkpoint_meta stay exempt so discovery can
+    # read foreign metas freely). Unstamped files — every pre-gate
+    # checkpoint — restore unconditionally.
+    meta = payload.get("meta")
+    meta = meta if isinstance(meta, dict) else {}
+    written_by = meta.get("deap_tpu_version")
+    if written_by and written_by != _code_version():
+        if not _COMPAT_ALLOW[0]:
+            raise CheckpointFormatError(
+                path, f"written by deap_tpu {written_by}, running "
+                      f"{_code_version()}; cross-version restore needs "
+                      "the explicit compat gate (allow_compat_restore"
+                      "() / set_compat_restore(True))")
+        from deap_tpu.telemetry.journal import broadcast
+        broadcast("compat_restore", path=path,
+                  written_by=str(written_by), running=_code_version(),
+                  **{k: meta[k] for k in ("tenant_id", "request_id")
+                     if meta.get(k)})
     if payload.get("format_version") is None:
         leaves = [_unpack_leaf(l) for l in payload["leaves"]]
         return jax.tree_util.tree_unflatten(payload["treedef"], leaves)

@@ -147,13 +147,16 @@ def test_restore_latest_verifies_each_file_once(tmp_path, monkeypatch):
     # one verification total: the newest file passed, walk stopped
     assert calls == [ckpt._path(2)]
 
-    # a corrupt newest file is verified once, skipped, and the walk
-    # verifies the next file once — never the same path twice
+    # a corrupt newest file is verified AT MOST once (a flip landing
+    # in pickle structure fails the load before CRC verification even
+    # starts), skipped, and the walk verifies the next file once —
+    # never the same path twice
     calls.clear()
     corrupt_file(ckpt._path(2), mode="flip")
     step, _ = ckpt.restore_latest(tenant_id="t1")
     assert step == 1
-    assert calls == [ckpt._path(2), ckpt._path(1)]
+    assert calls in ([ckpt._path(2), ckpt._path(1)],
+                     [ckpt._path(1)])
 
 
 def test_save_without_fsync_round_trips(tmp_path):
@@ -256,7 +259,12 @@ def test_meta_roundtrip_without_state(tmp_path):
     path = str(tmp_path / "m.pkl")
     save_state(path, {"x": jnp.zeros(8)},
                meta={"run_id": "abc123", "step": 7})
-    assert checkpoint_meta(path) == {"run_id": "abc123", "step": 7}
+    meta = checkpoint_meta(path)
+    assert meta["run_id"] == "abc123" and meta["step"] == 7
+    # every save stamps its writer — the rolling-upgrade compat gate's
+    # decision input (and the reason meta is no longer caller-only)
+    assert meta["deap_tpu_version"]
+    assert meta["checkpoint_format"] >= 3
     ckpt = Checkpointer(str(tmp_path / "c"))
     ckpt.save(3, {"x": 1}, meta={"run_id": "zzz"})
     assert ckpt.meta()["run_id"] == "zzz"
@@ -272,6 +280,73 @@ def test_checkpoint_event_broadcast(tmp_path):
         del j
     kinds = [r["kind"] for r in read_journal(jpath)]
     assert "checkpoint" in kinds
+
+
+# ------------------------------- version stamps + compat gate (PR 20) ----
+
+def test_newer_format_version_refused_by_name(tmp_path):
+    """A file carrying format_version > this build's is refused with
+    CheckpointFormatError (a CheckpointCorruptError subclass), not an
+    arbitrary unpickle failure — the old-code-meets-new-file half of a
+    rolling upgrade."""
+    import pickle
+
+    from deap_tpu.support import CheckpointFormatError
+    from deap_tpu.support.checkpoint import FORMAT_VERSION
+
+    path = str(tmp_path / "future.pkl")
+    save_state(path, {"x": jnp.arange(4)})
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    payload["format_version"] = FORMAT_VERSION + 1
+    with open(path, "wb") as f:
+        pickle.dump(payload, f)
+    with pytest.raises(CheckpointFormatError):
+        restore_state(path)
+    with pytest.raises(CheckpointCorruptError):   # subclass contract:
+        restore_state(path)                       # old callers keep
+    #                                               catching it
+
+
+def test_cross_version_restore_gated(tmp_path, monkeypatch):
+    """A checkpoint stamped by another deap_tpu version refuses to
+    restore until the compat gate is explicitly opened; the gated
+    restore journals a ``compat_restore`` row, and meta reads stay
+    exempt (you can always inspect what you cannot restore)."""
+    from deap_tpu.support import (CheckpointFormatError,
+                                  allow_compat_restore)
+    from deap_tpu.telemetry import RunJournal, read_journal
+
+    path = str(tmp_path / "old.pkl")
+    monkeypatch.setenv("DEAP_TPU_VERSION_OVERRIDE", "0.0.9+old")
+    save_state(path, {"x": jnp.arange(8)}, meta={"tenant_id": "t-1"})
+    monkeypatch.setenv("DEAP_TPU_VERSION_OVERRIDE", "0.1.1+new")
+
+    with pytest.raises(CheckpointFormatError):
+        restore_state(path)
+    assert checkpoint_meta(path)["deap_tpu_version"] == "0.0.9+old"
+    verify_checkpoint(path)   # integrity != restorability
+
+    jpath = str(tmp_path / "j.jsonl")
+    with RunJournal(jpath):
+        with allow_compat_restore():
+            out = restore_state(path)
+    np.testing.assert_array_equal(np.asarray(out["x"]), np.arange(8))
+    rows = [r for r in read_journal(jpath)
+            if r["kind"] == "compat_restore"]
+    assert rows and rows[0]["written_by"] == "0.0.9+old"
+    assert rows[0]["running"] == "0.1.1+new"
+    assert rows[0]["tenant_id"] == "t-1"
+    # the gate snapped shut on context exit
+    with pytest.raises(CheckpointFormatError):
+        restore_state(path)
+
+
+def test_same_version_restore_needs_no_gate(tmp_path):
+    path = str(tmp_path / "same.pkl")
+    save_state(path, {"x": jnp.arange(3)})
+    out = restore_state(path)   # no gate, no error, no journal row
+    np.testing.assert_array_equal(np.asarray(out["x"]), np.arange(3))
 
 
 # ------------------------------------------- state-family round trips ----

@@ -38,6 +38,7 @@ from deap_tpu.resilience import (
     DropResponse,
     FaultPlan,
     RetryPolicy,
+    TornWAL,
     corrupt_file,
 )
 from deap_tpu.serving import (
@@ -47,7 +48,9 @@ from deap_tpu.serving import (
     Scheduler,
     ServiceClient,
     ServiceError,
+    scan_wal,
 )
+from deap_tpu.serving import migration
 from deap_tpu.serving.wire import result_digest
 from deap_tpu.support.checkpoint import Checkpointer
 from deap_tpu.telemetry import read_journal
@@ -586,3 +589,422 @@ def test_kill9_trace_stitches_across_restart(tmp_path):
                  if s.get("trace_id") == trace_id)
     assert n_pre >= 1 and n_post >= 1
     assert len(trace["spans"]) >= n_pre + n_post
+
+
+# ------------------------------------ zero-downtime migration ----
+# (ISSUE 20: WAL ownership transfer, orphan adoption, rolling
+# upgrade. Fast tier = the transfer-record state machine and the
+# in-process protocol seams; chaos tier = subprocess kill -9 at the
+# exact handoff seams.)
+
+
+def _wait_gen(client, tid, min_gen, timeout_s=60.0):
+    """Poll until the tenant is mid-run (``gen >= min_gen``) — the
+    migration tests move LIVE tenants, never gen-0 ones."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        got = client.result(tid, wait=False)
+        if got.get("result") or int(got.get("gen") or 0) >= min_gen:
+            return got
+        time.sleep(0.02)
+    raise AssertionError(f"{tid} never reached gen {min_gen}")
+
+
+def test_wal_migration_record_fold(tmp_path):
+    """offer keeps the tenant pending (the source still owns it until
+    resolution), adopted folds like an accept on the target, and
+    transferred closes the tenant on the source — while the
+    idempotency mapping survives the transfer (a late client retry on
+    the source must still resolve)."""
+    src = str(tmp_path / "src.wal")
+    with AdmissionWAL(src) as w:
+        w.append("accept", tenant_id="t0", problem="p",
+                 params={"s": 1}, idempotency_key="k0")
+        w.append("offer", tenant_id="t0", offer_id="X",
+                 target="http://peer", gen=3, problem="p",
+                 params={"s": 1}, idempotency_key="k0")
+    st = scan_wal(src)
+    assert set(st.pending) == {"t0"}          # offer is NOT terminal
+    assert st.offers["t0"]["offer_id"] == "X"
+
+    tgt = str(tmp_path / "tgt.wal")
+    with AdmissionWAL(tgt) as w:
+        w.append("adopted", tenant_id="t0", offer_id="X",
+                 source="http://peer", source_root=str(tmp_path),
+                 gen=3, problem="p", params={"s": 1},
+                 idempotency_key="k0")
+    st2 = scan_wal(tgt)
+    assert set(st2.pending) == {"t0"}         # adopted = an accept
+    assert st2.pending["t0"]["kind"] == "adopted"
+    assert st2.adoptions["X"]["tenant_id"] == "t0"
+    assert st2.idempotency == {"k0": "t0"}
+
+    with AdmissionWAL(src) as w:
+        w.append("transferred", tenant_id="t0", offer_id="X",
+                 target="http://peer")
+    st3 = scan_wal(src)
+    assert st3.pending == {} and st3.offers == {}
+    assert st3.idempotency == {"k0": "t0"}
+
+    # scan_wal is STRICTLY read-only: scanning a peer's torn log (the
+    # adoption path reads logs of processes that died mid-append)
+    # must never heal-truncate a file this process doesn't own
+    corrupt_file(tgt, mode="truncate", offset=-7)
+    size = os.path.getsize(tgt)
+    st4 = scan_wal(tgt)
+    assert st4.tear_offset is not None
+    assert os.path.getsize(tgt) == size
+
+
+def test_transfer_commit_race_single_winner(tmp_path):
+    """Ownership arbitration is an O_EXCL create: N racing claimants
+    for the same offer produce exactly one winner, and every loser
+    reads back the SAME winning record."""
+    src = str(tmp_path / "dead")
+    os.makedirs(src)
+    results = []
+    lock = threading.Lock()
+
+    def claim(i):
+        own = str(tmp_path / f"peer{i}")
+        won, rec = migration.try_commit(
+            src, offer_id="orphan-tx", tenant_id="tx",
+            owner_root=own, owner_wal=os.path.join(own, "a.wal"))
+        with lock:
+            results.append((won, rec))
+
+    threads = [threading.Thread(target=claim, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    winners = [rec for won, rec in results if won]
+    assert len(winners) == 1
+    # losers converge on the winner's record, not their own attempt
+    owner = winners[0]["owner_root"]
+    assert all(rec["owner_root"] == owner for _, rec in results)
+    assert len(migration.commits_for(src, "tx")) == 1
+    # a foreign owner is a transfer; a self-owned commit is a closed
+    # reclaim (the door shut on late adopters, nothing moved)
+    assert migration._foreign_commit(src, "tx") is not None
+    migration.try_commit(src, offer_id="orphan-ty", tenant_id="ty",
+                         owner_root=src, owner_wal="w")
+    assert migration._foreign_commit(src, "ty") is None
+
+
+def test_live_migration_bit_exact_mid_run(tmp_path):
+    """THE tentpole pin, in process: a tenant is migrated MID-RUN
+    between two live services and its final wire digest is
+    bit-identical to an unmigrated single-scheduler run. Source
+    journals offered->transferred, target journals the adoption, and
+    the commit file records the new owner."""
+    NGEN = 400   # enough runway that the migrate lands MID-RUN
+    ref = _inprocess_digests(
+        tmp_path / "ref",
+        [_onemax_job("tA", {"seed": 41, "ngen": NGEN})])["tA"]
+    src_root = str(tmp_path / "srcsvc")
+    dst_root = str(tmp_path / "dstsvc")
+    with EvolutionService(src_root, PROBLEMS, **_svc_kwargs()) as src, \
+            EvolutionService(dst_root, PROBLEMS,
+                             **_svc_kwargs()) as dst:
+        c = ServiceClient(src.url)
+        c.submit("onemax", params={"seed": 41, "ngen": NGEN},
+                 tenant_id="tA", idempotency_key="ka")
+        _wait_gen(c, "tA", 2)
+        out = src.migrate("tA", dst.url)
+        assert out.get("migrated") is True, out
+        # the source's view is terminal `migrated` — the client
+        # re-offer signal, naming the live new home
+        res_src = c.result("tA", wait=False)
+        assert res_src["status"] == "migrated"
+        # the target finishes the run bit-identically
+        c2 = ServiceClient(dst.url)
+        res = c2.result("tA", wait=True, timeout=300)
+        assert res["status"] == "finished"
+        assert res["result"]["digest"] == ref
+        # idempotency rode the transfer: re-offering the same key to
+        # the new owner maps onto the adopted tenant, no twin run
+        again = c2.submit("onemax",
+                          params={"seed": 41, "ngen": NGEN},
+                          idempotency_key="ka")
+        assert again == "tA"
+    commit = migration._foreign_commit(src_root, "tA")
+    assert commit is not None
+    assert os.path.abspath(commit["owner_root"]) == \
+        os.path.abspath(dst_root)
+    src_rows = [r for r in _journal(src_root)
+                if r.get("kind") == "migration_offer"]
+    assert [r["phase"] for r in src_rows] == ["offered",
+                                              "transferred"]
+    assert any(r.get("kind") == "migration_adopted"
+               for r in _journal(dst_root))
+    # the ownership pause is bounded and recorded
+    assert 0 < src_rows[-1]["pause_s"] < 30
+
+
+def test_migrate_to_dead_target_reclaims(tmp_path):
+    """An offer the target never ACKs resolves to the SOURCE: the
+    self-owned commit shuts the door on a late adopter, the tenant
+    resumes locally, and the run still converges bit-identically."""
+    NGEN = 400   # enough runway that the migrate lands MID-RUN
+    ref = _inprocess_digests(
+        tmp_path / "ref",
+        [_onemax_job("tA", {"seed": 42, "ngen": NGEN})])["tA"]
+    root = str(tmp_path / "svc")
+    # a port with no listener: connect is refused immediately
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+    with EvolutionService(root, PROBLEMS, **_svc_kwargs()) as svc:
+        c = ServiceClient(svc.url)
+        c.submit("onemax", params={"seed": 42, "ngen": NGEN},
+                 tenant_id="tA", idempotency_key="ka")
+        _wait_gen(c, "tA", 2)
+        out = svc.migrate("tA", f"http://127.0.0.1:{dead_port}")
+        assert out.get("migrated") is False
+        assert out.get("reclaimed") is True, out
+        res = c.result("tA", wait=True, timeout=300)
+        assert res["status"] == "finished"
+        assert res["result"]["digest"] == ref
+    # the commit is self-owned — a closed reclaim, not a transfer
+    assert migration._foreign_commit(root, "tA") is None
+    assert len(migration.commits_for(root, "tA")) == 1
+    phases = [r["phase"] for r in _journal(root)
+              if r.get("kind") == "migration_offer"]
+    assert phases == ["offered", "reclaimed"]
+
+
+def test_orphan_adoption_race_deterministic_loser(tmp_path):
+    """Two live peers discover the same dead member. Deterministic
+    offer ids (``orphan-<tenant>``) make them contend for the SAME
+    commit file: the first claimant wins, the second voids its own
+    durable adoption (``done adoption_lost``) so its restart can
+    never resurrect a twin."""
+    import subprocess
+    import sys as _sys
+    NGEN = 6
+    ref = _inprocess_digests(
+        tmp_path / "ref",
+        [_onemax_job("tO", {"seed": 7, "ngen": NGEN})])["tO"]
+
+    # the dead member: a WAL with an accepted-not-terminal tenant,
+    # registered in the fleet root under a pid that is gone
+    dead_root = str(tmp_path / "dead")
+    os.makedirs(dead_root)
+    with AdmissionWAL(os.path.join(dead_root, "admission.wal")) as w:
+        w.append("accept", tenant_id="tO", problem="onemax",
+                 params={"seed": 7, "ngen": NGEN},
+                 idempotency_key="kO")
+    gone = subprocess.Popen([_sys.executable, "-c", "pass"])
+    gone.wait()
+    fleet = tmp_path / "fleet"
+    member = fleet / "member-dead"
+    member.mkdir(parents=True)
+    (member / "meta.json").write_text(json.dumps({
+        "process_id": "member-dead", "pid": gone.pid,
+        "serving_root": dead_root, "url": "http://127.0.0.1:9"}))
+
+    spec = dict(tenant_id="tO", offer_id="orphan-tO",
+                source="member-dead", source_root=dead_root, gen=0,
+                problem="onemax",
+                params={"seed": 7, "ngen": NGEN},
+                idempotency_key="kO")
+    root_a, root_b = str(tmp_path / "a"), str(tmp_path / "b")
+    with EvolutionService(root_a, PROBLEMS, **_svc_kwargs()) as a, \
+            EvolutionService(root_b, PROBLEMS,
+                             **_svc_kwargs()) as b:
+        assert a.adopt_orphans(str(fleet)) == ["tO"]
+        # the scan pre-check: a committed transfer is skipped
+        assert b.adopt_orphans(str(fleet)) == []
+        # the RACE: b passed the pre-check concurrently and reached
+        # the claim — it must lose the O_EXCL create and stand down
+        code, out = migration.adopt_tenant(b, spec, orphan=True)
+        assert code == 409, (code, out)
+        assert out.get("adopted") is False
+        res = ServiceClient(a.url).result("tO", wait=True,
+                                          timeout=300)
+        assert res["status"] == "finished"
+        assert res["result"]["digest"] == ref
+    # b's durable claim is voided: its restart replays NO tenant
+    assert "tO" not in scan_wal(
+        os.path.join(root_b, "admission.wal")).pending
+    lost_rows = [r for r in _journal(root_b)
+                 if r.get("kind") == "orphan_adopted"
+                 and r.get("lost")]
+    assert lost_rows and lost_rows[0]["tenant_id"] == "tO"
+
+
+def test_resolve_replay_acked_but_source_died(tmp_path):
+    """The source dies AFTER the target ACKed adoption (commit on
+    disk) but BEFORE appending ``transferred``: the restart must
+    resolve the offer to the target — append the missing record, not
+    resubmit, and journal the resolution."""
+    root = str(tmp_path / "svc")
+    os.makedirs(root)
+    with AdmissionWAL(os.path.join(root, "admission.wal")) as w:
+        w.append("accept", tenant_id="tA", problem="onemax",
+                 params={"seed": 5, "ngen": 6},
+                 idempotency_key="ka")
+        w.append("offer", tenant_id="tA", offer_id="X",
+                 target="http://peer", gen=2, problem="onemax",
+                 params={"seed": 5, "ngen": 6},
+                 idempotency_key="ka")
+    peer_root = str(tmp_path / "peer")
+    won, _ = migration.try_commit(
+        root, offer_id="X", tenant_id="tA", owner_root=peer_root,
+        owner_wal=os.path.join(peer_root, "admission.wal"))
+    assert won
+    with EvolutionService(root, PROBLEMS, **_svc_kwargs()) as svc:
+        with pytest.raises(ServiceError) as ei:
+            ServiceClient(svc.url).result("tA", wait=False)
+        assert ei.value.code == 404      # not resubmitted: not ours
+    st = scan_wal(os.path.join(root, "admission.wal"))
+    assert "tA" not in st.pending        # transferred was appended
+    rows = [r for r in _journal(root)
+            if r.get("kind") == "migration_offer"]
+    assert rows and rows[-1]["phase"] == "resolved"
+    assert rows[-1]["owner"] == "target"
+
+
+def test_resolve_replay_unresolved_offer_commits_to_self(tmp_path):
+    """The source dies right after the durable offer, before any byte
+    reached the target: the restart commits the offer to ITSELF
+    (shutting the door on a late adopter) and replays the tenant
+    locally to the uninterrupted digest."""
+    NGEN = 6
+    ref = _inprocess_digests(
+        tmp_path / "ref",
+        [_onemax_job("tA", {"seed": 5, "ngen": NGEN})])["tA"]
+    root = str(tmp_path / "svc")
+    os.makedirs(root)
+    with AdmissionWAL(os.path.join(root, "admission.wal")) as w:
+        w.append("accept", tenant_id="tA", problem="onemax",
+                 params={"seed": 5, "ngen": NGEN},
+                 idempotency_key="ka")
+        w.append("offer", tenant_id="tA", offer_id="X",
+                 target="http://peer", gen=2, problem="onemax",
+                 params={"seed": 5, "ngen": NGEN},
+                 idempotency_key="ka")
+    with EvolutionService(root, PROBLEMS, **_svc_kwargs()) as svc:
+        res = ServiceClient(svc.url).result("tA", wait=True,
+                                            timeout=300)
+        assert res["status"] == "finished"
+        assert res["result"]["digest"] == ref
+    commit = migration.read_commit(root, "X")
+    assert commit is not None
+    assert os.path.abspath(commit["owner_root"]) == \
+        os.path.abspath(root)
+    rows = [r for r in _journal(root)
+            if r.get("kind") == "migration_offer"
+            and r.get("phase") == "resolved"]
+    assert rows and rows[0]["owner"] == "source"
+
+
+def test_torn_transfer_record_is_no_offer(tmp_path):
+    """A power cut mid-append of the OFFER record: the torn record
+    never became durable, so after restart the offer simply never
+    happened — the tenant replays locally, exactly once, to the
+    uninterrupted digest. (Seq 2 = the offer: the accept was seq 1.)"""
+    NGEN = 400   # enough runway that the migrate lands MID-RUN
+    ref = _inprocess_digests(
+        tmp_path / "ref",
+        [_onemax_job("tA", {"seed": 6, "ngen": NGEN})])["tA"]
+    root = str(tmp_path / "svc")
+    plan = FaultPlan([TornWAL(seq=2, nbytes=7, then_crash=True)])
+    svc = EvolutionService(root, PROBLEMS, fault_plan=plan,
+                           **_svc_kwargs())
+    c = ServiceClient(svc.url)
+    c.submit("onemax", params={"seed": 6, "ngen": NGEN},
+             tenant_id="tA", idempotency_key="ka")
+    _wait_gen(c, "tA", 2)
+    out = svc.migrate("tA", "http://127.0.0.1:9")
+    assert out.get("migrated") is False
+    assert "InjectedCrash" in out.get("error", ""), out
+    svc.close()
+    # the log still carries the tear; the offer never folded
+    st = scan_wal(os.path.join(root, "admission.wal"))
+    assert st.tear_offset is not None
+    assert st.offers == {} and set(st.pending) == {"tA"}
+    assert migration.commits_for(root, "tA") == []
+    with EvolutionService(root, PROBLEMS, **_svc_kwargs()) as svc2:
+        res = ServiceClient(svc2.url).result("tA", wait=True,
+                                             timeout=300)
+        assert res["status"] == "finished"
+        assert res["result"]["digest"] == ref
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seam", ["after_offer", "before_adopted",
+                                  "before_transferred"])
+def test_migration_seam_kill_bit_identical(tmp_path, seam):
+    """kill -9 at each ownership-transfer seam, under a supervisor
+    that restarts the dead side: zero lost jobs and every digest
+    bit-identical to the uninterrupted reference — wherever the
+    commit files say each tenant ended up."""
+    from deap_tpu.serving import chaos
+
+    NGEN = 30   # jobs must still be mid-run when the drain lands
+    specs = chaos.chaos_specs(6, ngen=NGEN)
+    ref = chaos.reference_digests(str(tmp_path / "ref"), specs,
+                                  segment_len=2, max_lanes=8)
+    out = chaos.run_migration_chaos(str(tmp_path / "mig"), seam,
+                                    n_tenants=6, ngen=NGEN)
+    assert out["kill_rc"] == -9, out
+    assert out["lost"] == [], out
+    assert out["digests"] == ref
+    if seam == "before_transferred":
+        # the target ACKed before the source died: the ACKed
+        # adoption STANDS — resolution must follow the commit file
+        assert out["adopted_by_target"], out
+    if seam == "after_offer":
+        # the source died before any byte reached the target: no
+        # claim can exist, the restart resolves every offer to self
+        assert out["adopted_by_target"] == [], out
+
+
+@pytest.mark.chaos
+def test_orphan_adoption_drill(tmp_path):
+    """A fleet member is kill -9ed and NEVER restarted: a live peer
+    discovers the death through the federation metadata and adopts
+    every accepted-not-terminal tenant, bit-identically."""
+    from deap_tpu.serving import chaos
+
+    NGEN = 30
+    specs = chaos.chaos_specs(6, ngen=NGEN)
+    ref = chaos.reference_digests(str(tmp_path / "ref"), specs,
+                                  segment_len=2, max_lanes=8)
+    out = chaos.run_orphan_drill(str(tmp_path / "orph"),
+                                 n_tenants=6, ngen=NGEN)
+    assert out["kill_rc"] == -9, out
+    assert out["lost"] == [], out
+    assert out["digests"] == ref
+    assert out["peer_kinds"].get("orphan_adopted", 0) >= 1, out
+
+
+@pytest.mark.chaos
+def test_rolling_upgrade_drill(tmp_path):
+    """The ISSUE 20 acceptance drill: drain an old-version service
+    into a warm new-version one under live load. Zero lost jobs,
+    every digest bit-identical, the cross-version resumes journaled
+    under the explicit compat gate, canaries green on both sides,
+    and every per-tenant ownership pause bounded."""
+    from deap_tpu.serving import chaos
+
+    NGEN = 30
+    specs = chaos.chaos_specs(6, ngen=NGEN)
+    ref = chaos.reference_digests(str(tmp_path / "ref"), specs,
+                                  segment_len=2, max_lanes=8)
+    out = chaos.run_upgrade_drill(str(tmp_path / "up"),
+                                  n_tenants=6, ngen=NGEN)
+    assert out["old_rc"] == 0, out           # a DRAIN, not a crash
+    assert out["lost"] == [], out
+    assert out["digests"] == ref
+    assert out["new_kinds"].get("migration_adopted", 0) >= 1, out
+    assert out["new_kinds"].get("compat_restore", 0) >= 1, out
+    assert out["old_kinds"].get("canary_failed", 0) == 0
+    assert out["new_kinds"].get("canary_failed", 0) == 0
+    assert out["migration_pauses_s"], out
+    assert max(out["migration_pauses_s"]) < 30
